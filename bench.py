@@ -1,0 +1,79 @@
+#!/usr/bin/env python
+"""Benchmark entry — run by the driver on real TPU hardware.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Workload: TPC-H Q1 at SF1 (6M lineitem rows) — the reference's own headline
+scan benchmark (presto-orc results.txt:19: Aria selective reader runs the
+Q1 scan kernel over SF1 lineitem in 0.79 s ≈ 7.6M rows/s; the stock batch
+reader takes 3.99 s ≈ 1.5M rows/s). We run the FULL Q1 (scan + filter +
+aggregate + sort), not just the scan, and report engine rows/s.
+vs_baseline = our rows/s ÷ the Aria selective reader's rows/s.
+"""
+
+import json
+import sys
+import time
+
+SF = float(sys.argv[1]) if len(sys.argv) > 1 else 1.0
+
+Q1 = """
+select l_returnflag, l_linestatus,
+       sum(l_quantity) as sum_qty,
+       sum(l_extendedprice) as sum_base_price,
+       sum(l_extendedprice * (1 - l_discount)) as sum_disc_price,
+       sum(l_extendedprice * (1 - l_discount) * (1 + l_tax)) as sum_charge,
+       avg(l_quantity) as avg_qty,
+       avg(l_extendedprice) as avg_price,
+       avg(l_discount) as avg_disc,
+       count(*) as count_order
+from lineitem
+where l_shipdate <= date '1998-12-01' - interval '90' day
+group by l_returnflag, l_linestatus
+order by l_returnflag, l_linestatus
+"""
+
+# reference: Aria selective reader, TPC-H Q1 scan kernel, SF1 lineitem
+# (presto-orc/src/main/java/com/facebook/presto/orc/results.txt:19)
+_REF_SECONDS_SF1 = 0.79
+_SF1_ROWS = 6_001_215
+
+
+def main():
+    from presto_tpu.catalog.tpch import tpch_catalog
+    from presto_tpu.exec import ExecConfig, LocalRunner
+
+    cat = tpch_catalog(SF)
+    conn = cat.connectors["tpch"]
+    conn._ensure("lineitem")  # generation outside the timed region
+    nrows = conn.tables["lineitem"].num_rows
+
+    runner = LocalRunner(cat, ExecConfig(batch_rows=1 << 20, agg_capacity=1 << 10))
+
+    # warm-up: compile caches (Presto also excludes codegen from steady-state)
+    runner.run_batch(Q1)
+
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        out = runner.run_batch(Q1)
+        out.num_live()  # block on device completion
+        times.append(time.perf_counter() - t0)
+    best = min(times)
+
+    rows_per_s = nrows / best
+    ref_rows_per_s = _SF1_ROWS / _REF_SECONDS_SF1
+    print(
+        json.dumps(
+            {
+                "metric": f"tpch_q1_sf{SF:g}_rows_per_sec",
+                "value": round(rows_per_s, 1),
+                "unit": "rows/s",
+                "vs_baseline": round(rows_per_s / ref_rows_per_s, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

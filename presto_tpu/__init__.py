@@ -1,0 +1,54 @@
+"""presto_tpu — a TPU-native distributed SQL query engine.
+
+A from-scratch re-design of the capability surface of Presto (reference:
+oerling/presto, the "Aria" fork of prestodb 0.227) for TPU hardware:
+
+- SQL frontend (lexer/parser/analyzer)             ~ presto-parser, sql/analyzer
+- Logical planner + optimizer + fragmenter         ~ sql/planner
+- Columnar execution on fixed-shape device batches ~ operator/* over Page/Block
+- XLA-jitted fused pipelines (scan-filter-project-agg) ~ presto-bytecode codegen
+- Distributed exchanges via jax.sharding + all_to_all  ~ execution/buffer + ExchangeClient
+- TPC-H connector + parquet storage                ~ presto-tpch, presto-orc/hive
+
+Architecture stance (NOT a port): Presto compensates for the JVM with runtime
+bytecode generation and flat long[] hash tables; we compensate for XLA's
+static-shape world with fixed-capacity column batches, validity + live-row
+masks instead of selection vectors, sort-based grouping instead of
+pointer-chasing hash tables, and host-precomputed dictionary lookup tables
+instead of on-device string processing.
+"""
+
+import jax
+
+# A SQL engine needs 64-bit integers (BIGINT, DECIMAL-as-scaled-int64) and
+# float64 (DOUBLE). TPU emulates both; hot money arithmetic uses int64.
+jax.config.update("jax_enable_x64", True)
+
+__version__ = "0.1.0"
+
+from presto_tpu.types import (  # noqa: E402
+    BOOLEAN,
+    BIGINT,
+    INTEGER,
+    DOUBLE,
+    REAL,
+    DATE,
+    VARCHAR,
+    DecimalType,
+    Type,
+)
+from presto_tpu.batch import Batch, Column  # noqa: E402
+
+__all__ = [
+    "BOOLEAN",
+    "BIGINT",
+    "INTEGER",
+    "DOUBLE",
+    "REAL",
+    "DATE",
+    "VARCHAR",
+    "DecimalType",
+    "Type",
+    "Batch",
+    "Column",
+]

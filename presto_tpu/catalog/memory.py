@@ -1,0 +1,141 @@
+"""In-memory connector — tables registered from host arrays / DataFrames.
+
+Analog of presto-memory (the test/demo connector) and the primary fixture
+for the engine's own tests (the role presto-tpch + presto-memory play in
+AbstractTestQueries setups).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from presto_tpu.batch import Batch, round_up_capacity
+from presto_tpu.connector import ColumnInfo, Connector, Split, TableHandle
+from presto_tpu.dictionary import Dictionary
+from presto_tpu.types import (
+    BIGINT,
+    BOOLEAN,
+    DATE,
+    DOUBLE,
+    DecimalType,
+    INTEGER,
+    Type,
+    VARCHAR,
+)
+
+
+def _infer_type(arr: np.ndarray) -> Type:
+    if arr.dtype == np.bool_:
+        return BOOLEAN
+    if np.issubdtype(arr.dtype, np.integer):
+        return BIGINT if arr.dtype.itemsize > 4 else INTEGER
+    if np.issubdtype(arr.dtype, np.floating):
+        return DOUBLE
+    if arr.dtype.kind in ("U", "O", "S"):
+        return VARCHAR
+    if arr.dtype.kind == "M":  # datetime64
+        return DATE
+    raise TypeError(f"cannot infer SQL type for {arr.dtype}")
+
+
+class MemoryTable:
+    def __init__(self, name: str, data: Dict[str, np.ndarray],
+                 types: Optional[Dict[str, Type]] = None,
+                 primary_key: Optional[List[str]] = None):
+        self.name = name
+        self.types: Dict[str, Type] = {}
+        self.arrays: Dict[str, np.ndarray] = {}
+        self.validity: Dict[str, Optional[np.ndarray]] = {}
+        self.dicts: Dict[str, Dictionary] = {}
+        self.primary_key = primary_key
+        n = None
+        for col, raw in data.items():
+            arr = np.asarray(raw)
+            n = len(arr) if n is None else n
+            t = (types or {}).get(col) or _infer_type(arr)
+            valid = None
+            if arr.dtype == object:
+                nulls = np.array([v is None for v in arr])
+                if nulls.any():
+                    valid = ~nulls
+                    arr = np.where(nulls, "" if t.is_string else 0, arr)
+            if t.is_string:
+                d, codes = Dictionary.encode(arr.astype(str))
+                if valid is not None:
+                    codes = np.where(valid, codes, -1)
+                self.dicts[col] = d
+                arr = codes
+            elif t is DATE and arr.dtype.kind == "M":
+                arr = arr.astype("datetime64[D]").astype(np.int64)
+            elif isinstance(t, DecimalType):
+                if np.issubdtype(arr.dtype, np.floating):
+                    arr = np.round(arr.astype(np.float64) * 10 ** t.scale).astype(np.int64)
+                else:
+                    arr = arr.astype(np.int64) * 10 ** t.scale
+            self.types[col] = t
+            self.arrays[col] = np.ascontiguousarray(arr.astype(t.dtype))
+            self.validity[col] = valid
+        self.num_rows = n or 0
+
+    def handle(self, catalog: str) -> TableHandle:
+        return TableHandle(
+            catalog=catalog,
+            name=self.name,
+            columns=[ColumnInfo(c, t, self.dicts.get(c)) for c, t in self.types.items()],
+            row_count=float(self.num_rows),
+            primary_key=self.primary_key,
+        )
+
+
+class MemoryConnector(Connector):
+    def __init__(self, name: str = "memory"):
+        self.name = name
+        self.tables: Dict[str, MemoryTable] = {}
+
+    def add_table(self, name: str, data, types=None, primary_key=None):
+        import pandas as pd
+
+        if isinstance(data, pd.DataFrame):
+            data = {c: data[c].to_numpy() for c in data.columns}
+        self.tables[name] = MemoryTable(name, data, types, primary_key)
+
+    def table_names(self):
+        return list(self.tables)
+
+    def get_table(self, name: str) -> TableHandle:
+        if name not in self.tables:
+            raise KeyError(f"table not found: {name}")
+        return self.tables[name].handle(self.name)
+
+    def splits(self, handle: TableHandle, desired: int = 1) -> List[Split]:
+        return [Split(handle.name, i, desired) for i in range(desired)]
+
+    def read_split(self, split: Split, columns: Sequence[str],
+                   capacity: Optional[int] = None) -> Batch:
+        t = self.tables[split.table]
+        n = t.num_rows
+        lo = n * split.part // split.total
+        hi = n * (split.part + 1) // split.total
+        data = {c: t.arrays[c][lo:hi] for c in columns}
+        types = {c: t.types[c] for c in columns}
+        b = Batch.from_numpy(data, types,
+                             dicts={c: t.dicts[c] for c in columns if c in t.dicts},
+                             capacity=capacity)
+        # apply column validity (nullable object columns)
+        import jax.numpy as jnp
+
+        for c in columns:
+            v = t.validity[c]
+            if v is not None:
+                col = b.column(c)
+                pad = np.zeros(b.capacity, dtype=bool)
+                pad[: hi - lo] = v[lo:hi]
+                idx = b.names.index(c)
+                cols = list(b.columns)
+                from presto_tpu.batch import Column
+
+                cols[idx] = Column(col.values, jnp.asarray(pad))
+                b = Batch(b.names, b.types, cols, b.live, b.dicts)
+        return b

@@ -1,0 +1,98 @@
+"""Connector SPI — the plugin boundary between engine and data sources.
+
+Analog of presto-spi's connector surface (spi/connector/ConnectorMetadata.java,
+ConnectorSplitManager, ConnectorPageSourceProvider.java:24), reduced to the
+read path: a Connector names tables, describes their schemas (including the
+per-column string Dictionary, which is first-class metadata here), produces
+Splits, and reads a Split into a Batch.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence
+
+from presto_tpu.batch import Batch
+from presto_tpu.dictionary import Dictionary
+from presto_tpu.types import Type
+
+
+@dataclasses.dataclass
+class ColumnInfo:
+    name: str
+    type: Type
+    dictionary: Optional[Dictionary] = None
+
+
+@dataclasses.dataclass
+class TableHandle:
+    catalog: str
+    name: str
+    columns: List[ColumnInfo]
+    # statistics + constraints the planner uses (reference:
+    # ConnectorMetadata.getTableStatistics / primary-key-ness is implicit in
+    # Presto via hidden bucketing metadata; here it is first-class)
+    row_count: Optional[float] = None
+    primary_key: Optional[List[str]] = None
+
+    def column(self, name: str) -> ColumnInfo:
+        for c in self.columns:
+            if c.name == name:
+                return c
+        raise KeyError(name)
+
+
+@dataclasses.dataclass
+class Split:
+    """A unit of scan parallelism (spi/ConnectorSplit). `part` indexes into
+    the table's row partitioning; `total` is the partition count."""
+
+    table: str
+    part: int
+    total: int
+
+
+class Connector:
+    name: str = ""
+
+    def table_names(self) -> List[str]:
+        raise NotImplementedError
+
+    def get_table(self, name: str) -> TableHandle:
+        raise NotImplementedError
+
+    def splits(self, handle: TableHandle, desired: int = 1) -> List[Split]:
+        raise NotImplementedError
+
+    def read_split(
+        self,
+        split: Split,
+        columns: Sequence[str],
+        capacity: Optional[int] = None,
+    ) -> Batch:
+        raise NotImplementedError
+
+
+class Catalog:
+    """Catalog/metadata facade (reference: metadata/MetadataManager.java +
+    CatalogManager)."""
+
+    def __init__(self):
+        self.connectors: Dict[str, Connector] = {}
+        self.default: Optional[str] = None
+
+    def register(self, name: str, connector: Connector, default: bool = False):
+        connector.name = name  # the registered name is authoritative
+        self.connectors[name] = connector
+        if default or self.default is None:
+            self.default = name
+
+    def resolve(self, parts) -> tuple[Connector, TableHandle]:
+        if len(parts) == 1:
+            cname, tname = self.default, parts[0]
+        else:
+            cname, tname = parts[-2], parts[-1]
+        if cname not in self.connectors:
+            raise KeyError(f"unknown catalog {cname}")
+        conn = self.connectors[cname]
+        return conn, conn.get_table(tname)

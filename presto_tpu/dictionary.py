@@ -1,0 +1,102 @@
+"""Order-preserving string dictionaries.
+
+The device never sees string bytes. Every VARCHAR column is encoded as int32
+codes into a sorted, deduplicated host-side dictionary, so that:
+
+- equality / range comparison on codes == comparison on strings
+- ORDER BY / min / max on codes is correct
+- arbitrary string predicates (LIKE, substring, regexp) are evaluated ONCE on
+  the host over the dictionary values, producing a boolean lookup table that
+  the device applies as `lut[codes]` — a gather, which TPUs do well.
+
+This replaces the per-row string machinery of the reference
+(presto-spi/.../block/VariableWidthBlock.java, operator/scalar/StringFunctions.java,
+joni regexps) with plan-time host work + O(|dict|) tables. Presto itself leans
+on DictionaryBlock (spi/block/DictionaryBlock.java) for hot paths; we make it
+the only representation.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class Dictionary:
+    """Sorted unique string values; identity-hashed so jit caches by object."""
+
+    __slots__ = ("values", "_index")
+
+    def __init__(self, values: np.ndarray):
+        # values must be sorted & unique (np.str_ / object array of str)
+        self.values = np.asarray(values)
+        self._index = None
+
+    @staticmethod
+    def encode(strings) -> tuple["Dictionary", np.ndarray]:
+        """Build a dictionary from raw strings; return (dict, int32 codes)."""
+        arr = np.asarray(strings)
+        uniq, codes = np.unique(arr, return_inverse=True)
+        return Dictionary(uniq), codes.astype(np.int32)
+
+    def __len__(self) -> int:
+        return len(self.values)
+
+    def code_of(self, s: str) -> int:
+        """Exact-match code of a string, or -1 if absent."""
+        i = int(np.searchsorted(self.values, s))
+        if i < len(self.values) and self.values[i] == s:
+            return i
+        return -1
+
+    def range_codes(self, s: str, side: str = "left") -> int:
+        """searchsorted position for range predicates on codes."""
+        return int(np.searchsorted(self.values, s, side=side))
+
+    def decode(self, codes: np.ndarray) -> np.ndarray:
+        codes = np.asarray(codes)
+        out = np.empty(codes.shape, dtype=object)
+        valid = codes >= 0
+        out[valid] = self.values[codes[valid]]
+        out[~valid] = None
+        return out
+
+    def lut(self, predicate) -> np.ndarray:
+        """Host-evaluate `predicate(str) -> bool` over dictionary values.
+
+        Returns a bool table of shape (len+1,) indexed by code+1 so that
+        code -1 (null) maps to slot 0 == False. Device applies as
+        table[codes + 1].
+        """
+        table = np.zeros(len(self.values) + 1, dtype=bool)
+        for i, v in enumerate(self.values):
+            table[i + 1] = bool(predicate(str(v)))
+        return table
+
+    def map_to(self, other: "Dictionary") -> np.ndarray:
+        """Code-remap table: self codes -> other codes (-1 if absent).
+
+        Used when joining / unioning string columns encoded against different
+        dictionaries (analog of DictionaryBlock id remapping).
+        """
+        pos = np.searchsorted(other.values, self.values)
+        pos = np.clip(pos, 0, max(len(other.values) - 1, 0))
+        if len(other.values):
+            ok = other.values[pos] == self.values
+        else:
+            ok = np.zeros(len(self.values), dtype=bool)
+        out = np.where(ok, pos, -1).astype(np.int32)
+        # slot for null code (-1) — prepend so device indexes with codes+1
+        return np.concatenate([np.array([-1], np.int32), out])
+
+    @staticmethod
+    def merge(a: "Dictionary", b: "Dictionary") -> "Dictionary":
+        return Dictionary(np.unique(np.concatenate([a.values, b.values])))
+
+    # identity hash/eq: a Dictionary is immutable once built; jit static-arg
+    # caching keys off the object, and reusing the same object per table
+    # column avoids retraces.
+    def __hash__(self):
+        return id(self)
+
+    def __eq__(self, other):
+        return self is other

@@ -1,0 +1,3 @@
+from presto_tpu.exec.runner import LocalRunner, ExecConfig
+
+__all__ = ["LocalRunner", "ExecConfig"]

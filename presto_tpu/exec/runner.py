@@ -1,0 +1,38 @@
+"""LocalRunner — single-process query runner.
+
+Analog of the reference's LocalQueryRunner
+(presto-main/.../testing/LocalQueryRunner.java:218): full
+parse → analyze/plan → optimize → execute in-process, no RPC. The
+workhorse for tests and single-chip benchmarks.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from presto_tpu.connector import Catalog
+from presto_tpu.exec.runtime import ExecConfig, ExecContext, run_plan
+from presto_tpu.plan.builder import plan_query
+from presto_tpu.plan.nodes import QueryPlan, plan_to_string
+from presto_tpu.plan.optimizer import optimize
+
+
+class LocalRunner:
+    def __init__(self, catalog: Catalog, config: Optional[ExecConfig] = None):
+        self.catalog = catalog
+        self.config = config or ExecConfig()
+
+    def plan(self, sql: str) -> QueryPlan:
+        return optimize(plan_query(sql, self.catalog))
+
+    def explain(self, sql: str) -> str:
+        return plan_to_string(self.plan(sql).root)
+
+    def run_batch(self, sql: str):
+        qp = self.plan(sql)
+        ctx = ExecContext(self.catalog, self.config)
+        return run_plan(qp, ctx)
+
+    def run(self, sql: str):
+        """Execute and return a pandas DataFrame (host materialization)."""
+        return self.run_batch(sql).to_pandas()

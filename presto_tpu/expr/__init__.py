@@ -1,0 +1,16 @@
+from presto_tpu.expr.ir import (
+    RowExpression,
+    InputRef,
+    Constant,
+    Call,
+)
+from presto_tpu.expr.compile import compile_expr, compile_predicate
+
+__all__ = [
+    "RowExpression",
+    "InputRef",
+    "Constant",
+    "Call",
+    "compile_expr",
+    "compile_predicate",
+]

@@ -1,0 +1,134 @@
+"""Sort-based grouped aggregation — the GROUP BY kernel.
+
+Reference: operator/MultiChannelGroupByHash.java:54 (open-addressing table
+over flat long[] with codegen'd hash strategies) feeding
+InMemoryHashAggregationBuilder.
+
+TPU-native redesign: scatter-with-conflicts is hostile to XLA, so grouping is
+a *sort*: lexicographic `lax.sort` over (deadness, per-key null bit, key
+value)*, boundary detection, then `segment_sum/min/max` into a fixed-capacity
+group table. Everything is static-shape; the only dynamic quantity (group
+count) is returned as a device scalar so the driver can detect capacity
+overflow and recompile with a bigger bucket.
+
+The same kernel does partial aggregation, state merging, and final
+aggregation: inputs are "state columns" each with a merge op
+(sum/min/max/count-add), exactly like the reference's
+partial/intermediate/final accumulator phases
+(operator/aggregation/builder/InMemoryHashAggregationBuilder.java:160).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class StateCol(NamedTuple):
+    values: jnp.ndarray
+    validity: Optional[jnp.ndarray]  # None = all valid
+    op: str  # 'sum' | 'min' | 'max' | 'count_add' (values are counts)
+
+
+class KeyCol(NamedTuple):
+    values: jnp.ndarray
+    validity: Optional[jnp.ndarray]
+
+
+def _minmax_identity(dtype, op):
+    if jnp.issubdtype(dtype, jnp.floating):
+        return jnp.array(jnp.inf if op == "min" else -jnp.inf, dtype)
+    info = jnp.iinfo(dtype)
+    return jnp.array(info.max if op == "min" else info.min, dtype)
+
+
+def grouped_merge(
+    keys: Sequence[KeyCol],
+    states: Sequence[StateCol],
+    live: jnp.ndarray,
+    num_groups_cap: int,
+) -> Tuple[list, list, jnp.ndarray, jnp.ndarray]:
+    """Group rows by `keys`, merging `states` within each group.
+
+    Returns (key_cols_out, state_cols_out, out_live, n_groups) where all
+    output arrays have length num_groups_cap and rows beyond n_groups are
+    dead. NULL key values form their own group (SQL GROUP BY semantics).
+    Rows with live=False are ignored. If n_groups > num_groups_cap the
+    caller must retry with a bigger capacity (groups beyond cap are dropped
+    deterministically — the driver checks).
+    """
+    n = live.shape[0]
+    dead = (~live).astype(jnp.int32)
+
+    operands = [dead]
+    for k in keys:
+        if k.validity is not None:
+            operands.append((~k.validity).astype(jnp.int32))
+            operands.append(jnp.where(k.validity, k.values, jnp.zeros_like(k.values)))
+        else:
+            operands.append(k.values)
+    num_keys = len(operands)
+    perm = jnp.arange(n, dtype=jnp.int32)
+    sorted_ops = jax.lax.sort(operands + [perm], num_keys=num_keys)
+    sorted_keys = sorted_ops[:num_keys]
+    sperm = sorted_ops[-1]
+    sdead = sorted_keys[0]
+
+    # boundary where any sort key changes (first row is always a boundary)
+    change = jnp.zeros(n, dtype=bool).at[0].set(True)
+    for sk in sorted_keys:
+        change = change.at[1:].set(change[1:] | (sk[1:] != sk[:-1]))
+    seg = jnp.cumsum(change.astype(jnp.int32)) - 1
+    # dead rows sort last; push their segment out of range so segment ops drop them
+    seg = jnp.where(sdead == 1, num_groups_cap, seg)
+    n_groups = jnp.max(jnp.where(sdead == 1, -1, seg)) + 1
+
+    # materialize group keys: first (any) row of each segment
+    key_out = []
+    ki = 1
+    for k in keys:
+        if k.validity is not None:
+            nullbit = sorted_keys[ki]
+            vals = sorted_keys[ki + 1]
+            ki += 2
+            kv = jnp.zeros(num_groups_cap, dtype=vals.dtype).at[seg].set(vals, mode="drop")
+            kvd = jnp.zeros(num_groups_cap, dtype=bool).at[seg].set(nullbit == 0, mode="drop")
+            key_out.append(KeyCol(kv, kvd))
+        else:
+            vals = sorted_keys[ki]
+            ki += 1
+            kv = jnp.zeros(num_groups_cap, dtype=vals.dtype).at[seg].set(vals, mode="drop")
+            key_out.append(KeyCol(kv, None))
+
+    state_out = []
+    for s in states:
+        sv = s.values[sperm]
+        svalid = s.validity[sperm] if s.validity is not None else None
+        if s.op in ("sum", "count_add"):
+            contrib = sv if svalid is None else jnp.where(svalid, sv, jnp.zeros_like(sv))
+            agg = jax.ops.segment_sum(contrib, seg, num_segments=num_groups_cap)
+            if s.op == "count_add":
+                state_out.append(StateCol(agg, None, s.op))
+            else:
+                if svalid is None:
+                    nvalid = jax.ops.segment_sum(jnp.ones(n, jnp.int32), seg, num_segments=num_groups_cap)
+                else:
+                    nvalid = jax.ops.segment_sum(svalid.astype(jnp.int32), seg, num_segments=num_groups_cap)
+                state_out.append(StateCol(agg, nvalid > 0, s.op))
+        elif s.op in ("min", "max"):
+            ident = _minmax_identity(sv.dtype, s.op)
+            contrib = sv if svalid is None else jnp.where(svalid, sv, ident)
+            segop = jax.ops.segment_min if s.op == "min" else jax.ops.segment_max
+            agg = segop(contrib, seg, num_segments=num_groups_cap)
+            if svalid is None:
+                nvalid = jax.ops.segment_sum(jnp.ones(n, jnp.int32), seg, num_segments=num_groups_cap)
+            else:
+                nvalid = jax.ops.segment_sum(svalid.astype(jnp.int32), seg, num_segments=num_groups_cap)
+            state_out.append(StateCol(agg, nvalid > 0, s.op))
+        else:
+            raise ValueError(f"unknown merge op {s.op}")
+
+    out_live = jnp.arange(num_groups_cap) < n_groups
+    return key_out, state_out, out_live, n_groups

@@ -1,0 +1,266 @@
+"""Distributed relational primitives over a device mesh.
+
+The reference's shuffle is an asynchronous HTTP pull between worker buffers
+(PartitionedOutputOperator → OutputBuffer → ExchangeClient, SURVEY §2e).
+On TPU the shuffle *within a slice* is a synchronous collective over ICI:
+
+    rows --[hash-partition kernel]--> (P, C) lanes --all_to_all--> peers
+
+Each worker (device) owns one hash slice of every repartitioned relation:
+FIXED_HASH_DISTRIBUTION becomes "device d holds rows with
+hash(key) % P == d". Partial-aggregate → exchange → final-aggregate is the
+AddExchanges partial/final aggregation split; partitioned joins co-locate
+both sides' slices before a local build/probe.
+
+Everything here runs under jax.shard_map on a 1-D mesh and composes with
+jit; the host never touches row data between stages.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from presto_tpu.batch import Batch, Column, round_up_capacity
+from presto_tpu.ops.grouping import KeyCol, StateCol, grouped_merge
+from presto_tpu.ops.join import build_side, gather_join_output, probe_unique
+from presto_tpu.ops.partition import partition_for_exchange
+from presto_tpu.parallel.mesh import WORKERS
+
+
+def _specs_like(batch: Batch, spec):
+    return jax.tree.map(lambda _: spec, batch)
+
+
+def shard_batch_arrays(data: dict, types: dict, mesh, dicts=None,
+                       capacity_per_device: Optional[int] = None) -> Batch:
+    """Host numpy columns → a global Batch row-sharded over the mesh.
+
+    Rows are split round-robin-contiguously; each device's lanes are padded
+    to a common capacity (SOURCE_DISTRIBUTION: splits go wherever capacity
+    exists, here statically balanced)."""
+    n_dev = mesh.shape[WORKERS]
+    names = list(data.keys())
+    n = len(next(iter(data.values()))) if names else 0
+    per = -(-n // n_dev) if n else 1
+    cap = capacity_per_device or round_up_capacity(per)
+    cols = {}
+    live = np.zeros((n_dev, cap), dtype=bool)
+    for d in range(n_dev):
+        lo, hi = d * per, min((d + 1) * per, n)
+        if hi > lo:
+            live[d, : hi - lo] = True
+    for name in names:
+        arr = np.asarray(data[name])
+        t = types[name]
+        buf = np.zeros((n_dev, cap), dtype=t.dtype)
+        for d in range(n_dev):
+            lo, hi = d * per, min((d + 1) * per, n)
+            if hi > lo:
+                buf[d, : hi - lo] = arr[lo:hi]
+        cols[name] = buf.reshape(-1)
+    sharding = NamedSharding(mesh, P(WORKERS))
+    batch = Batch(
+        names,
+        [types[k] for k in names],
+        [Column(jax.device_put(cols[k], sharding), None) for k in names],
+        jax.device_put(live.reshape(-1), sharding),
+        dicts or {},
+    )
+    return batch
+
+
+def _all_to_all_batch(b: Batch, n_dev: int, per_cap: int) -> Batch:
+    """Exchange a partitioned (P*C rows) local batch so each peer receives
+    its hash slice from everyone → (P*C rows) local again."""
+
+    def a2a(x):
+        if x is None:
+            return None
+        x2 = x.reshape(n_dev, per_cap)
+        y = jax.lax.all_to_all(x2, WORKERS, split_axis=0, concat_axis=0, tiled=False)
+        return y.reshape(-1)
+
+    cols = [Column(a2a(c.values), a2a(c.validity)) for c in b.columns]
+    return Batch(b.names, b.types, cols, a2a(b.live), b.dicts)
+
+
+def distributed_aggregate(
+    mesh,
+    batch: Batch,
+    key_syms: Sequence[str],
+    states: Sequence[Tuple[str, str, str]],  # (state_name, source_col, op)
+    group_cap: int,
+    part_cap: Optional[int] = None,
+) -> Tuple[Batch, jnp.ndarray]:
+    """Row-sharded batch → hash-partitioned global group table.
+
+    Per device: partial grouped_merge → hash-partition partials by key →
+    all_to_all → final grouped_merge. Output: global Batch whose rows are the
+    union of per-device group-table slices (device d holds groups with
+    hash % P == d). Second return: total partition overflow count (0 means
+    the exchange was lossless; caller re-runs with bigger part_cap if not).
+    """
+    n_dev = mesh.shape[WORKERS]
+    pc = part_cap or group_cap
+    key_types = [batch.type_of(k) for k in key_syms]
+    state_types = [batch.type_of(src) for _, src, _ in states]
+
+    def local(b: Batch):
+        keys = [KeyCol(b.column(k).values, b.column(k).validity) for k in key_syms]
+        scols = []
+        for name, src, op in states:
+            c = b.column(src)
+            if op == "count_add":
+                vals = (
+                    c.validity.astype(jnp.int64)
+                    if c.validity is not None
+                    else b.live.astype(jnp.int64)
+                )
+                scols.append(StateCol(vals, None, op))
+            else:
+                scols.append(StateCol(c.values, c.validity, op))
+        kout, sout, out_live, _ = grouped_merge(keys, scols, b.live, group_cap)
+        from presto_tpu.types import BIGINT
+
+        names = list(key_syms) + [name for name, _, _ in states]
+        types = key_types + [
+            BIGINT if op == "count_add" else batch.type_of(src)
+            for _, src, op in states
+        ]
+        cols = [Column(k.values, k.validity) for k in kout] + [
+            Column(s.values, None if s.op == "count_add" else s.validity) for s in sout
+        ]
+        return Batch(names, types, cols, out_live, {k: batch.dicts[k] for k in key_syms if k in batch.dicts})
+
+    def device_program(b: Batch):
+        partial = local(b)
+        parts, counts, ovf = partition_for_exchange(partial, list(key_syms), n_dev, pc)
+        received = _all_to_all_batch(parts, n_dev, pc)
+        # merge the received partials (states merge with their ops)
+        keys = [KeyCol(received.column(k).values, received.column(k).validity) for k in key_syms]
+        scols = [
+            StateCol(
+                received.column(name).values,
+                received.column(name).validity,
+                "sum" if op == "count_add" else op,
+            )
+            for name, _, op in states
+        ]
+        kout, sout, out_live, _ = grouped_merge(keys, scols, received.live, group_cap)
+        cols = [Column(k.values, k.validity) for k in kout] + [
+            Column(s.values, None if states[i][2] == "count_add" else s.validity)
+            for i, s in enumerate(sout)
+        ]
+        out = Batch(partial.names, partial.types, cols, out_live, partial.dicts)
+        return out, jax.lax.psum(ovf, WORKERS)
+
+    prog = jax.shard_map(
+        device_program,
+        mesh=mesh,
+        in_specs=(_specs_like(batch, P(WORKERS)),),
+        out_specs=(
+            jax.tree.map(lambda _: P(WORKERS), _template_out(batch, key_syms, states, group_cap)),
+            P(),
+        ),
+        check_vma=False,
+    )
+    return prog(batch)
+
+
+def _template_out(batch, key_syms, states, group_cap):
+    """Structure template for out_specs (same pytree as device_program's
+    first output)."""
+    from presto_tpu.types import BIGINT
+
+    names = list(key_syms) + [name for name, _, _ in states]
+    types = [batch.type_of(k) for k in key_syms] + [
+        BIGINT if op == "count_add" else batch.type_of(src) for _, src, op in states
+    ]
+    cols = []
+    for k in key_syms:
+        c = batch.column(k)
+        cols.append(Column(jnp.zeros(group_cap, c.values.dtype),
+                           None if c.validity is None else jnp.zeros(group_cap, bool)))
+    for _, src, op in states:
+        c = batch.column(src)
+        dt = jnp.int64 if op == "count_add" else c.values.dtype
+        # grouped_merge emits a validity array for sum/min/max states even
+        # when the input column had none (empty groups are NULL)
+        cols.append(Column(jnp.zeros(group_cap, dt),
+                           None if op == "count_add" else jnp.zeros(group_cap, bool)))
+    return Batch(names, types, cols, jnp.zeros(group_cap, bool),
+                 {k: batch.dicts[k] for k in key_syms if k in batch.dicts})
+
+
+def distributed_join_probe(
+    mesh,
+    probe: Batch,
+    build: Batch,
+    probe_keys: Sequence[str],
+    build_keys: Sequence[str],
+    probe_out: Sequence[str],
+    build_out: Sequence[str],
+    part_cap: int,
+) -> Tuple[Batch, jnp.ndarray]:
+    """Partitioned hash join over the mesh (inner, unique build keys).
+
+    Both sides are row-sharded; each is hash-partitioned on its join key and
+    exchanged so device d holds both sides' hash-slice d, then joined
+    locally — the FIXED_HASH_DISTRIBUTION co-located join (AddExchanges
+    partitioned join path). Returns the (row-sharded) join output and the
+    total partition overflow count.
+    """
+    n_dev = mesh.shape[WORKERS]
+
+    def device_program(pb: Batch, bb: Batch):
+        bparts, _, bovf = partition_for_exchange(bb, list(build_keys), n_dev, part_cap)
+        brecv = _all_to_all_batch(bparts, n_dev, part_cap)
+        table = build_side(brecv, tuple(build_keys))
+        pparts, _, povf = partition_for_exchange(pb, list(probe_keys), n_dev, part_cap)
+        precv = _all_to_all_batch(pparts, n_dev, part_cap)
+        idx, matched = probe_unique(table, precv, tuple(probe_keys), tuple(build_keys))
+        out = gather_join_output(
+            precv, table,
+            jnp.arange(precv.capacity, dtype=jnp.int32), idx,
+            precv.live & matched, list(probe_out), list(build_out),
+        )
+        return out, jax.lax.psum(bovf + povf, WORKERS)
+
+    # build out_specs template
+    tmpl_cols = []
+    names, types = [], []
+    dicts = {}
+    for c in probe_out:
+        names.append(c)
+        types.append(probe.type_of(c))
+        col = probe.column(c)
+        tmpl_cols.append(Column(jnp.zeros(1, col.values.dtype),
+                                None if col.validity is None else jnp.zeros(1, bool)))
+        if c in probe.dicts:
+            dicts[c] = probe.dicts[c]
+    for c in build_out:
+        names.append(c)
+        types.append(build.type_of(c))
+        col = build.column(c)
+        tmpl_cols.append(Column(jnp.zeros(1, col.values.dtype),
+                                None if col.validity is None else jnp.zeros(1, bool)))
+        if c in build.dicts:
+            dicts[c] = build.dicts[c]
+    tmpl = Batch(names, types, tmpl_cols, jnp.zeros(1, bool), dicts)
+
+    prog = jax.shard_map(
+        device_program,
+        mesh=mesh,
+        in_specs=(
+            _specs_like(probe, P(WORKERS)),
+            _specs_like(build, P(WORKERS)),
+        ),
+        out_specs=(jax.tree.map(lambda _: P(WORKERS), tmpl), P()),
+        check_vma=False,
+    )
+    return prog(probe, build)

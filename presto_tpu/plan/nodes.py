@@ -1,0 +1,216 @@
+"""Logical plan nodes.
+
+Analog of presto-main's PlanNode hierarchy
+(sql/planner/plan/*.java — 45 node types) reduced to the executed surface.
+Every node exposes `output`: an ordered list of (symbol, Type). Symbols are
+unique column names within a plan (Presto's Symbol allocator —
+sql/planner/SymbolAllocator.java).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+from presto_tpu.expr.ir import RowExpression
+from presto_tpu.types import Type
+
+
+class PlanNode:
+    output: List[Tuple[str, Type]]
+
+    @property
+    def out_names(self) -> List[str]:
+        return [n for n, _ in self.output]
+
+    def children(self) -> List["PlanNode"]:
+        return []
+
+
+@dataclasses.dataclass
+class TableScan(PlanNode):
+    catalog: str
+    table: str
+    # symbol -> source column name
+    assignments: Dict[str, str] = dataclasses.field(default_factory=dict)
+    output: List[Tuple[str, Type]] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class Filter(PlanNode):
+    child: PlanNode
+    predicate: RowExpression
+
+    @property
+    def output(self):
+        return self.child.output
+
+    def children(self):
+        return [self.child]
+
+
+@dataclasses.dataclass
+class Project(PlanNode):
+    child: PlanNode
+    # ordered (symbol, expression); identity projections are InputRefs
+    exprs: List[Tuple[str, RowExpression]]
+
+    @property
+    def output(self):
+        return [(n, e.type) for n, e in self.exprs]
+
+    def children(self):
+        return [self.child]
+
+
+@dataclasses.dataclass
+class AggSpec:
+    symbol: str
+    fn: str  # sum | count | count_star | avg | min | max
+    arg: Optional[str]  # input symbol (None for count_star)
+    type: Type  # output type
+    distinct: bool = False
+
+
+@dataclasses.dataclass
+class Aggregate(PlanNode):
+    child: PlanNode
+    group_keys: List[str]  # input symbols
+    aggs: List[AggSpec]
+    # step mirrors Presto's AggregationNode.Step: SINGLE initially; the
+    # distributed planner splits into PARTIAL / FINAL around an exchange
+    step: str = "single"
+
+    @property
+    def output(self):
+        key_types = dict(self.child.output)
+        return [(k, key_types[k]) for k in self.group_keys] + [
+            (a.symbol, a.type) for a in self.aggs
+        ]
+
+    def children(self):
+        return [self.child]
+
+
+@dataclasses.dataclass
+class HashJoin(PlanNode):
+    kind: str  # inner | left
+    left: PlanNode  # probe
+    right: PlanNode  # build
+    left_keys: List[str]
+    right_keys: List[str]
+    residual: Optional[RowExpression] = None
+    # planner hint: build side keys are unique (dimension table)
+    build_unique: bool = False
+
+    @property
+    def output(self):
+        return list(self.left.output) + list(self.right.output)
+
+    def children(self):
+        return [self.left, self.right]
+
+
+@dataclasses.dataclass
+class SemiJoin(PlanNode):
+    """left [NOT] IN (subquery) / EXISTS — probe side filtered by membership
+    (reference: HashSemiJoinOperator / SemiJoinNode)."""
+
+    left: PlanNode
+    right: PlanNode
+    left_key: str
+    right_key: str
+    negated: bool = False
+
+    @property
+    def output(self):
+        return self.left.output
+
+    def children(self):
+        return [self.left, self.right]
+
+
+@dataclasses.dataclass
+class SortItem:
+    symbol: str
+    ascending: bool = True
+    nulls_first: Optional[bool] = None
+
+
+@dataclasses.dataclass
+class Sort(PlanNode):
+    child: PlanNode
+    keys: List[SortItem]
+    limit: Optional[int] = None  # TopN fusion (TopNNode)
+
+    @property
+    def output(self):
+        return self.child.output
+
+    def children(self):
+        return [self.child]
+
+
+@dataclasses.dataclass
+class Limit(PlanNode):
+    child: PlanNode
+    count: int
+
+    @property
+    def output(self):
+        return self.child.output
+
+    def children(self):
+        return [self.child]
+
+
+@dataclasses.dataclass
+class Output(PlanNode):
+    child: PlanNode
+    names: List[str]  # user-facing column names
+    symbols: List[str]
+
+    @property
+    def output(self):
+        types = dict(self.child.output)
+        return [(n, types[s]) for n, s in zip(self.names, self.symbols)]
+
+    def children(self):
+        return [self.child]
+
+
+@dataclasses.dataclass
+class QueryPlan:
+    root: Output
+    # uncorrelated scalar subqueries: symbol -> plan producing 1 row / 1 col;
+    # the executor evaluates these first and binds them as constants
+    scalar_subqueries: Dict[str, "QueryPlan"] = dataclasses.field(default_factory=dict)
+
+
+def plan_to_string(node: PlanNode, indent: int = 0) -> str:
+    """EXPLAIN-style rendering (reference: sql/planner/planPrinter)."""
+    pad = "  " * indent
+    if isinstance(node, TableScan):
+        cols = ", ".join(f"{s}:={c}" for s, c in node.assignments.items())
+        s = f"{pad}TableScan[{node.catalog}.{node.table}] {cols}"
+    elif isinstance(node, Filter):
+        s = f"{pad}Filter[{node.predicate}]"
+    elif isinstance(node, Project):
+        s = f"{pad}Project[{', '.join(f'{n} := {e}' for n, e in node.exprs)}]"
+    elif isinstance(node, Aggregate):
+        aggs = ", ".join(f"{a.symbol} := {a.fn}({a.arg or '*'})" for a in node.aggs)
+        s = f"{pad}Aggregate[{node.step}; keys={node.group_keys}; {aggs}]"
+    elif isinstance(node, HashJoin):
+        s = f"{pad}HashJoin[{node.kind}; {node.left_keys} = {node.right_keys}{'; unique' if node.build_unique else ''}]"
+    elif isinstance(node, SemiJoin):
+        s = f"{pad}SemiJoin[{'NOT ' if node.negated else ''}{node.left_key} IN {node.right_key}]"
+    elif isinstance(node, Sort):
+        keys = ", ".join(f"{k.symbol}{'' if k.ascending else ' desc'}" for k in node.keys)
+        s = f"{pad}Sort[{keys}{f'; limit={node.limit}' if node.limit else ''}]"
+    elif isinstance(node, Limit):
+        s = f"{pad}Limit[{node.count}]"
+    elif isinstance(node, Output):
+        s = f"{pad}Output[{', '.join(node.names)}]"
+    else:
+        s = f"{pad}{type(node).__name__}"
+    return s + "".join("\n" + plan_to_string(c, indent + 1) for c in node.children())

@@ -1,0 +1,234 @@
+"""Logical plan optimization passes.
+
+Analog of sql/planner/PlanOptimizers.java (76 passes) reduced to the ones
+that matter for this execution model:
+
+- PredicatePushdown (optimizations/PredicatePushDown.java): split conjuncts,
+  push each to the deepest node whose output covers its inputs — through
+  Projects (with substitution), past Joins into the covering side, below
+  Aggregates when the conjunct only references group keys.
+- PruneUnreferencedOutputs / PushdownSubfields-style column pruning: trim
+  Project expressions and TableScan assignments to what the query needs.
+  On this engine column pruning is the *scan pushdown* — the parquet reader
+  only materializes referenced columns (the moral of the Aria selective
+  reader's column skipping).
+- Cleanup: merge adjacent Filters, drop identity Projects.
+
+Join ordering happens at plan-build time (builder._assemble_joins) with
+connector row counts — the stand-in for the cost-based ReorderJoins.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Set
+
+from presto_tpu.expr.ir import (
+    Call,
+    InputRef,
+    RowExpression,
+    expr_inputs,
+    substitute_refs,
+)
+from presto_tpu.plan.nodes import (
+    Aggregate,
+    Filter,
+    HashJoin,
+    Limit,
+    Output,
+    PlanNode,
+    Project,
+    QueryPlan,
+    SemiJoin,
+    Sort,
+    TableScan,
+)
+from presto_tpu.types import BOOLEAN
+
+
+def _conjuncts(e: RowExpression) -> List[RowExpression]:
+    if isinstance(e, Call) and e.fn == "and":
+        out = []
+        for a in e.args:
+            out.extend(_conjuncts(a))
+        return out
+    return [e]
+
+
+def _combine(es: List[RowExpression]) -> Optional[RowExpression]:
+    if not es:
+        return None
+    out = es[0]
+    for e in es[1:]:
+        out = Call(BOOLEAN, "and", (out, e))
+    return out
+
+
+def push_filters(node: PlanNode) -> PlanNode:
+    """Recursively push filter conjuncts toward the leaves."""
+    if isinstance(node, Filter):
+        child = push_filters(node.child)
+        conjs = _conjuncts(node.predicate)
+        return _push_into(child, conjs)
+    for attr in ("child", "left", "right"):
+        if hasattr(node, attr):
+            setattr(node, attr, push_filters(getattr(node, attr)))
+    return node
+
+
+def _push_into(node: PlanNode, conjs: List[RowExpression]) -> PlanNode:
+    if not conjs:
+        return node
+    if isinstance(node, Filter):
+        return _push_into(node.child, conjs + _conjuncts(node.predicate))
+    if isinstance(node, Project):
+        mapping = {s: e for s, e in node.exprs}
+        pushable, kept = [], []
+        for c in conjs:
+            # only substitute through cheap expressions (refs / arithmetic);
+            # always safe since Project is stateless and deterministic
+            pushable.append(substitute_refs(c, mapping))
+        node.child = _push_into(node.child, pushable)
+        return node
+    if isinstance(node, HashJoin):
+        lsyms = {n for n, _ in node.left.output}
+        rsyms = {n for n, _ in node.right.output}
+        lpush, rpush, kept = [], [], []
+        for c in conjs:
+            ins = expr_inputs(c)
+            if ins <= lsyms:
+                lpush.append(c)
+            elif ins <= rsyms and node.kind == "inner":
+                rpush.append(c)
+            else:
+                # NOTE: a WHERE conjunct on build-side columns above a LEFT
+                # join must NOT be pushed below it — it filters the
+                # NULL-extended post-join rows (pushing it would resurrect
+                # non-matching probe rows). ON-clause residuals are pushed at
+                # plan-build time instead (builder.plan_join).
+                kept.append(c)
+        if lpush:
+            node.left = _push_into(node.left, lpush)
+        if rpush:
+            node.right = _push_into(node.right, rpush)
+        node.left = push_filters(node.left)
+        node.right = push_filters(node.right)
+        if kept:
+            if node.kind == "inner":
+                return Filter(node, _combine(kept))
+            return Filter(node, _combine(kept))
+        return node
+    if isinstance(node, SemiJoin):
+        lsyms = {n for n, _ in node.left.output}
+        lpush, kept = [], []
+        for c in conjs:
+            (lpush if expr_inputs(c) <= lsyms else kept).append(c)
+        if lpush:
+            node.left = _push_into(node.left, lpush)
+        node.left = push_filters(node.left)
+        node.right = push_filters(node.right)
+        return Filter(node, _combine(kept)) if kept else node
+    if isinstance(node, Aggregate):
+        keys = set(node.group_keys)
+        below, above = [], []
+        for c in conjs:
+            (below if expr_inputs(c) <= keys else above).append(c)
+        if below:
+            node.child = _push_into(node.child, below)
+        node.child = push_filters(node.child)
+        return Filter(node, _combine(above)) if above else node
+    if isinstance(node, (Sort, Limit)):
+        # filters commute with sort/limit only if limit absent
+        if isinstance(node, Sort) and node.limit is None:
+            node.child = _push_into(node.child, conjs)
+            return node
+        node.child = push_filters(node.child)
+        return Filter(node, _combine(conjs))
+    # TableScan and everything else: stop here
+    node2 = push_filters(node) if node.children() else node
+    return Filter(node2, _combine(conjs))
+
+
+# ---------------------------------------------------------------------------
+# column pruning
+
+
+def prune_columns(node: PlanNode, required: Set[str]) -> PlanNode:
+    if isinstance(node, Output):
+        node.child = prune_columns(node.child, set(node.symbols))
+        return node
+    if isinstance(node, TableScan):
+        node.assignments = {s: c for s, c in node.assignments.items() if s in required}
+        node.output = [(s, t) for s, t in node.output if s in required]
+        return node
+    if isinstance(node, Filter):
+        need = required | expr_inputs(node.predicate)
+        node.child = prune_columns(node.child, need)
+        return node
+    if isinstance(node, Project):
+        node.exprs = [(s, e) for s, e in node.exprs if s in required]
+        need = set()
+        for _, e in node.exprs:
+            need |= expr_inputs(e)
+        node.child = prune_columns(node.child, need)
+        return node
+    if isinstance(node, Aggregate):
+        node.aggs = [a for a in node.aggs if a.symbol in required]
+        need = set(node.group_keys) | {a.arg for a in node.aggs if a.arg}
+        node.child = prune_columns(node.child, need)
+        return node
+    if isinstance(node, HashJoin):
+        need = required | set(node.left_keys) | set(node.right_keys)
+        if node.residual is not None:
+            need |= expr_inputs(node.residual)
+        lsyms = {n for n, _ in node.left.output}
+        rsyms = {n for n, _ in node.right.output}
+        node.left = prune_columns(node.left, need & lsyms)
+        node.right = prune_columns(node.right, need & rsyms)
+        return node
+    if isinstance(node, SemiJoin):
+        need = required | {node.left_key}
+        node.left = prune_columns(node.left, need)
+        node.right = prune_columns(node.right, {node.right_key})
+        return node
+    if isinstance(node, Sort):
+        need = required | {k.symbol for k in node.keys}
+        node.child = prune_columns(node.child, need)
+        return node
+    if isinstance(node, Limit):
+        node.child = prune_columns(node.child, required)
+        return node
+    for c in node.children():
+        prune_columns(c, required)
+    return node
+
+
+def cleanup(node: PlanNode) -> PlanNode:
+    """Merge adjacent filters; drop empty/identity projects."""
+    for attr in ("child", "left", "right"):
+        if hasattr(node, attr):
+            setattr(node, attr, cleanup(getattr(node, attr)))
+    if isinstance(node, Filter) and isinstance(node.child, Filter):
+        inner = node.child
+        return cleanup(Filter(inner.child, _combine(_conjuncts(node.predicate) + _conjuncts(inner.predicate))))
+    if isinstance(node, Project):
+        child_names = [n for n, _ in node.child.output]
+        if (
+            len(node.exprs) == len(child_names)
+            and all(
+                isinstance(e, InputRef) and e.name == s and s == cn
+                for (s, e), cn in zip(node.exprs, child_names)
+            )
+        ):
+            return node.child
+    return node
+
+
+def optimize(plan: QueryPlan) -> QueryPlan:
+    """Run the pass pipeline (reference: PlanOptimizers.java:146 ordering)."""
+    root = plan.root
+    root.child = push_filters(root.child)
+    prune_columns(root, set(root.symbols))
+    root.child = cleanup(root.child)
+    for sub in plan.scalar_subqueries.values():
+        optimize(sub)
+    return plan

@@ -1,0 +1,3 @@
+from presto_tpu.sql.parser import parse_sql
+
+__all__ = ["parse_sql"]

@@ -1,0 +1,187 @@
+"""SQL abstract syntax tree.
+
+Analog of presto-parser's tree package (164 node classes under
+presto-parser/src/main/java/com/facebook/presto/sql/tree/) — reduced to the
+query surface this engine executes. Untyped; the analyzer lowers AST
+expressions into the typed IR (presto_tpu.expr.ir).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Tuple
+
+
+class Node:
+    pass
+
+
+# ---------------------------------------------------------------------------
+# expressions
+
+
+@dataclasses.dataclass
+class Identifier(Node):
+    parts: Tuple[str, ...]  # possibly qualified: (table, column) or (column,)
+
+    def __str__(self):
+        return ".".join(self.parts)
+
+
+@dataclasses.dataclass
+class Literal(Node):
+    value: object  # int | float | str | bool | None
+    kind: str  # 'integer' | 'decimal' | 'double' | 'string' | 'boolean' | 'null' | 'date'
+    text: str = ""
+
+
+@dataclasses.dataclass
+class IntervalLiteral(Node):
+    value: int
+    unit: str  # 'day' | 'month' | 'year'
+
+
+@dataclasses.dataclass
+class UnaryOp(Node):
+    op: str  # '-' | '+' | 'not'
+    operand: Node
+
+
+@dataclasses.dataclass
+class BinaryOp(Node):
+    op: str  # arithmetic / comparison / 'and' / 'or'
+    left: Node
+    right: Node
+
+
+@dataclasses.dataclass
+class Between(Node):
+    value: Node
+    low: Node
+    high: Node
+    negated: bool = False
+
+
+@dataclasses.dataclass
+class InList(Node):
+    value: Node
+    items: List[Node]
+    negated: bool = False
+
+
+@dataclasses.dataclass
+class InSubquery(Node):
+    value: Node
+    query: "Query"
+    negated: bool = False
+
+
+@dataclasses.dataclass
+class Exists(Node):
+    query: "Query"
+    negated: bool = False
+
+
+@dataclasses.dataclass
+class ScalarSubquery(Node):
+    query: "Query"
+
+
+@dataclasses.dataclass
+class Like(Node):
+    value: Node
+    pattern: Node
+    escape: Optional[Node] = None
+    negated: bool = False
+
+
+@dataclasses.dataclass
+class IsNull(Node):
+    value: Node
+    negated: bool = False
+
+
+@dataclasses.dataclass
+class FunctionCall(Node):
+    name: str
+    args: List[Node]
+    distinct: bool = False
+    is_star: bool = False  # count(*)
+
+
+@dataclasses.dataclass
+class Cast(Node):
+    value: Node
+    type_name: str
+
+
+@dataclasses.dataclass
+class Case(Node):
+    operand: Optional[Node]  # simple CASE x WHEN ... vs searched CASE WHEN
+    whens: List[Tuple[Node, Node]]
+    default: Optional[Node]
+
+
+@dataclasses.dataclass
+class Extract(Node):
+    field: str  # 'year' | 'month' | 'day'
+    value: Node
+
+
+@dataclasses.dataclass
+class Star(Node):
+    qualifier: Optional[str] = None
+
+
+# ---------------------------------------------------------------------------
+# relations
+
+
+@dataclasses.dataclass
+class Table(Node):
+    name: Tuple[str, ...]
+    alias: Optional[str] = None
+
+
+@dataclasses.dataclass
+class SubqueryRelation(Node):
+    query: "Query"
+    alias: str = ""
+
+
+@dataclasses.dataclass
+class Join(Node):
+    kind: str  # 'inner' | 'left' | 'right' | 'cross'
+    left: Node
+    right: Node
+    condition: Optional[Node] = None
+
+
+# ---------------------------------------------------------------------------
+# query
+
+
+@dataclasses.dataclass
+class SelectItem(Node):
+    expr: Node
+    alias: Optional[str] = None
+
+
+@dataclasses.dataclass
+class OrderItem(Node):
+    expr: Node
+    ascending: bool = True
+    nulls_first: Optional[bool] = None  # None = default (last for asc)
+
+
+@dataclasses.dataclass
+class Query(Node):
+    select: List[SelectItem]
+    distinct: bool = False
+    from_: Optional[Node] = None
+    where: Optional[Node] = None
+    group_by: List[Node] = dataclasses.field(default_factory=list)
+    having: Optional[Node] = None
+    order_by: List[OrderItem] = dataclasses.field(default_factory=list)
+    limit: Optional[int] = None
+    ctes: List[Tuple[str, "Query"]] = dataclasses.field(default_factory=list)

@@ -1,0 +1,539 @@
+"""SQL lexer + recursive-descent parser.
+
+Analog of presto-parser (SqlBase.g4, 802-line ANTLR4 grammar +
+parser/AstBuilder.java). Hand-written recursive descent over the query
+subset the engine executes: SELECT .. FROM .. [JOIN ..] WHERE .. GROUP BY ..
+HAVING .. ORDER BY .. LIMIT, WITH CTEs, subqueries (FROM / IN / EXISTS /
+scalar), the TPC-H expression surface.
+
+Operator precedence (low→high): OR, AND, NOT, comparison/IN/BETWEEN/LIKE/IS,
+additive, multiplicative, unary.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List, Optional
+
+from presto_tpu.sql import ast
+
+_KEYWORDS = {
+    "select", "from", "where", "group", "by", "having", "order", "limit",
+    "as", "and", "or", "not", "in", "between", "like", "escape", "is",
+    "null", "true", "false", "case", "when", "then", "else", "end", "cast",
+    "join", "inner", "left", "right", "full", "outer", "cross", "on",
+    "distinct", "all", "asc", "desc", "nulls", "first", "last", "exists",
+    "date", "interval", "day", "month", "year", "extract", "with", "union",
+    "substring", "for",
+}
+
+_TOKEN_RE = re.compile(
+    r"""
+    (?P<ws>\s+|--[^\n]*\n?|/\*.*?\*/)
+  | (?P<number>\d+(\.\d*)?([eE][+-]?\d+)?|\.\d+([eE][+-]?\d+)?)
+  | (?P<string>'(?:[^']|'')*')
+  | (?P<qident>"(?:[^"]|"")*")
+  | (?P<ident>[A-Za-z_][A-Za-z0-9_$]*)
+  | (?P<op><>|!=|>=|<=|\|\||[-+*/%(),.<>=;])
+    """,
+    re.VERBOSE | re.DOTALL,
+)
+
+
+class Token:
+    __slots__ = ("kind", "value", "pos")
+
+    def __init__(self, kind, value, pos):
+        self.kind = kind  # 'number' | 'string' | 'ident' | 'keyword' | 'op' | 'eof'
+        self.value = value
+        self.pos = pos
+
+    def __repr__(self):
+        return f"Token({self.kind},{self.value!r})"
+
+
+class ParseError(Exception):
+    pass
+
+
+def tokenize(sql: str) -> List[Token]:
+    out = []
+    i = 0
+    while i < len(sql):
+        m = _TOKEN_RE.match(sql, i)
+        if not m:
+            raise ParseError(f"unexpected character {sql[i]!r} at {i}")
+        i = m.end()
+        if m.lastgroup == "ws":
+            continue
+        v = m.group()
+        if m.lastgroup == "ident":
+            low = v.lower()
+            if low in _KEYWORDS:
+                out.append(Token("keyword", low, m.start()))
+            else:
+                out.append(Token("ident", low, m.start()))
+        elif m.lastgroup == "qident":
+            out.append(Token("ident", v[1:-1].replace('""', '"'), m.start()))
+        elif m.lastgroup == "string":
+            out.append(Token("string", v[1:-1].replace("''", "'"), m.start()))
+        elif m.lastgroup == "number":
+            out.append(Token("number", v, m.start()))
+        else:
+            out.append(Token("op", v, m.start()))
+    out.append(Token("eof", "", len(sql)))
+    return out
+
+
+class Parser:
+    def __init__(self, sql: str):
+        self.tokens = tokenize(sql)
+        self.i = 0
+
+    # -- token helpers ----------------------------------------------------
+
+    def peek(self, ahead=0) -> Token:
+        return self.tokens[min(self.i + ahead, len(self.tokens) - 1)]
+
+    def next(self) -> Token:
+        t = self.tokens[self.i]
+        self.i += 1
+        return t
+
+    def accept_kw(self, *kws) -> Optional[str]:
+        t = self.peek()
+        if t.kind == "keyword" and t.value in kws:
+            self.next()
+            return t.value
+        return None
+
+    def expect_kw(self, kw):
+        if not self.accept_kw(kw):
+            raise ParseError(f"expected {kw.upper()}, got {self.peek()!r}")
+
+    def accept_op(self, *ops) -> Optional[str]:
+        t = self.peek()
+        if t.kind == "op" and t.value in ops:
+            self.next()
+            return t.value
+        return None
+
+    def expect_op(self, op):
+        if not self.accept_op(op):
+            raise ParseError(f"expected {op!r}, got {self.peek()!r}")
+
+    def ident(self) -> str:
+        t = self.peek()
+        # allow non-reserved keywords as identifiers where unambiguous
+        if t.kind in ("ident",) or (t.kind == "keyword" and t.value in ("year", "month", "day", "date", "first", "last")):
+            self.next()
+            return t.value
+        raise ParseError(f"expected identifier, got {t!r}")
+
+    # -- entry ------------------------------------------------------------
+
+    def parse_statement(self) -> ast.Query:
+        q = self.parse_query()
+        self.accept_op(";")
+        if self.peek().kind != "eof":
+            raise ParseError(f"trailing tokens at {self.peek()!r}")
+        return q
+
+    def parse_query(self) -> ast.Query:
+        ctes = []
+        if self.accept_kw("with"):
+            while True:
+                name = self.ident()
+                self.expect_kw("as")
+                self.expect_op("(")
+                sub = self.parse_query()
+                self.expect_op(")")
+                ctes.append((name, sub))
+                if not self.accept_op(","):
+                    break
+        q = self.parse_query_body()
+        q.ctes = ctes
+        return q
+
+    def parse_query_body(self) -> ast.Query:
+        self.expect_kw("select")
+        distinct = bool(self.accept_kw("distinct"))
+        self.accept_kw("all")
+        select = [self.parse_select_item()]
+        while self.accept_op(","):
+            select.append(self.parse_select_item())
+        from_ = None
+        if self.accept_kw("from"):
+            from_ = self.parse_relation()
+        where = None
+        if self.accept_kw("where"):
+            where = self.parse_expr()
+        group_by: List[ast.Node] = []
+        if self.accept_kw("group"):
+            self.expect_kw("by")
+            group_by.append(self.parse_expr())
+            while self.accept_op(","):
+                group_by.append(self.parse_expr())
+        having = None
+        if self.accept_kw("having"):
+            having = self.parse_expr()
+        order_by: List[ast.OrderItem] = []
+        if self.accept_kw("order"):
+            self.expect_kw("by")
+            order_by.append(self.parse_order_item())
+            while self.accept_op(","):
+                order_by.append(self.parse_order_item())
+        limit = None
+        if self.accept_kw("limit"):
+            t = self.next()
+            if t.kind != "number":
+                raise ParseError("LIMIT expects a number")
+            limit = int(t.value)
+        return ast.Query(
+            select=select, distinct=distinct, from_=from_, where=where,
+            group_by=group_by, having=having, order_by=order_by, limit=limit,
+        )
+
+    def parse_select_item(self) -> ast.SelectItem:
+        t = self.peek()
+        if t.kind == "op" and t.value == "*":
+            self.next()
+            return ast.SelectItem(ast.Star(), None)
+        # qualified star: ident '.' '*'
+        if (
+            t.kind == "ident"
+            and self.peek(1).kind == "op" and self.peek(1).value == "."
+            and self.peek(2).kind == "op" and self.peek(2).value == "*"
+        ):
+            self.next(); self.next(); self.next()
+            return ast.SelectItem(ast.Star(qualifier=t.value), None)
+        e = self.parse_expr()
+        alias = None
+        if self.accept_kw("as"):
+            alias = self.ident()
+        elif self.peek().kind == "ident":
+            alias = self.ident()
+        return ast.SelectItem(e, alias)
+
+    def parse_order_item(self) -> ast.OrderItem:
+        e = self.parse_expr()
+        asc = True
+        if self.accept_kw("desc"):
+            asc = False
+        else:
+            self.accept_kw("asc")
+        nulls_first = None
+        if self.accept_kw("nulls"):
+            if self.accept_kw("first"):
+                nulls_first = True
+            else:
+                self.expect_kw("last")
+                nulls_first = False
+        return ast.OrderItem(e, asc, nulls_first)
+
+    # -- relations --------------------------------------------------------
+
+    def parse_relation(self) -> ast.Node:
+        rel = self.parse_table_primary()
+        while True:
+            if self.accept_kw("cross"):
+                self.expect_kw("join")
+                right = self.parse_table_primary()
+                rel = ast.Join("cross", rel, right, None)
+                continue
+            kind = None
+            if self.accept_kw("inner"):
+                kind = "inner"
+            elif self.accept_kw("left"):
+                self.accept_kw("outer")
+                kind = "left"
+            elif self.accept_kw("right"):
+                self.accept_kw("outer")
+                kind = "right"
+            elif self.accept_kw("full"):
+                self.accept_kw("outer")
+                kind = "full"
+            if kind is not None:
+                self.expect_kw("join")
+            elif self.accept_kw("join"):
+                kind = "inner"
+            elif self.accept_op(","):
+                right = self.parse_table_primary()
+                rel = ast.Join("cross", rel, right, None)
+                continue
+            else:
+                break
+            right = self.parse_table_primary()
+            self.expect_kw("on")
+            cond = self.parse_expr()
+            rel = ast.Join(kind, rel, right, cond)
+        return rel
+
+    def parse_table_primary(self) -> ast.Node:
+        if self.accept_op("("):
+            if self.peek().kind == "keyword" and self.peek().value in ("select", "with"):
+                q = self.parse_query()
+                self.expect_op(")")
+                self.accept_kw("as")
+                alias = self.ident()
+                return ast.SubqueryRelation(q, alias)
+            rel = self.parse_relation()
+            self.expect_op(")")
+            return rel
+        parts = [self.ident()]
+        while self.accept_op("."):
+            parts.append(self.ident())
+        alias = None
+        if self.accept_kw("as"):
+            alias = self.ident()
+        elif self.peek().kind == "ident":
+            alias = self.ident()
+        return ast.Table(tuple(parts), alias)
+
+    # -- expressions ------------------------------------------------------
+
+    def parse_expr(self) -> ast.Node:
+        return self.parse_or()
+
+    def parse_or(self) -> ast.Node:
+        left = self.parse_and()
+        while self.accept_kw("or"):
+            left = ast.BinaryOp("or", left, self.parse_and())
+        return left
+
+    def parse_and(self) -> ast.Node:
+        left = self.parse_not()
+        while self.accept_kw("and"):
+            left = ast.BinaryOp("and", left, self.parse_not())
+        return left
+
+    def parse_not(self) -> ast.Node:
+        if self.accept_kw("not"):
+            return ast.UnaryOp("not", self.parse_not())
+        return self.parse_comparison()
+
+    def parse_comparison(self) -> ast.Node:
+        left = self.parse_additive()
+        while True:
+            negated = False
+            save = self.i
+            if self.accept_kw("not"):
+                negated = True
+            if self.accept_kw("between"):
+                low = self.parse_additive()
+                self.expect_kw("and")
+                high = self.parse_additive()
+                left = ast.Between(left, low, high, negated)
+                continue
+            if self.accept_kw("in"):
+                self.expect_op("(")
+                if self.peek().kind == "keyword" and self.peek().value in ("select", "with"):
+                    q = self.parse_query()
+                    self.expect_op(")")
+                    left = ast.InSubquery(left, q, negated)
+                else:
+                    items = [self.parse_expr()]
+                    while self.accept_op(","):
+                        items.append(self.parse_expr())
+                    self.expect_op(")")
+                    left = ast.InList(left, items, negated)
+                continue
+            if self.accept_kw("like"):
+                pattern = self.parse_additive()
+                escape = None
+                if self.accept_kw("escape"):
+                    escape = self.parse_additive()
+                left = ast.Like(left, pattern, escape, negated)
+                continue
+            if negated:
+                self.i = save
+                break
+            if self.accept_kw("is"):
+                neg = bool(self.accept_kw("not"))
+                self.expect_kw("null")
+                left = ast.IsNull(left, neg)
+                continue
+            op = self.accept_op("=", "<>", "!=", "<", "<=", ">", ">=")
+            if op:
+                opmap = {"=": "eq", "<>": "ne", "!=": "ne", "<": "lt",
+                         "<=": "le", ">": "gt", ">=": "ge"}
+                right = self.parse_additive()
+                left = ast.BinaryOp(opmap[op], left, right)
+                continue
+            break
+        return left
+
+    def parse_additive(self) -> ast.Node:
+        left = self.parse_multiplicative()
+        while True:
+            op = self.accept_op("+", "-", "||")
+            if not op:
+                break
+            right = self.parse_multiplicative()
+            left = ast.BinaryOp({"+": "add", "-": "sub", "||": "concat"}[op], left, right)
+        return left
+
+    def parse_multiplicative(self) -> ast.Node:
+        left = self.parse_unary()
+        while True:
+            op = self.accept_op("*", "/", "%")
+            if not op:
+                break
+            right = self.parse_unary()
+            left = ast.BinaryOp({"*": "mul", "/": "div", "%": "mod"}[op], left, right)
+        return left
+
+    def parse_unary(self) -> ast.Node:
+        if self.accept_op("-"):
+            return ast.UnaryOp("-", self.parse_unary())
+        if self.accept_op("+"):
+            return self.parse_unary()
+        return self.parse_primary()
+
+    def parse_primary(self) -> ast.Node:
+        t = self.peek()
+        # literals
+        if t.kind == "number":
+            self.next()
+            txt = t.value
+            if re.fullmatch(r"\d+", txt):
+                return ast.Literal(int(txt), "integer", txt)
+            if "e" in txt.lower():
+                return ast.Literal(float(txt), "double", txt)
+            return ast.Literal(float(txt), "decimal", txt)
+        if t.kind == "string":
+            self.next()
+            return ast.Literal(t.value, "string", t.value)
+        if t.kind == "keyword":
+            kw = t.value
+            if kw == "null":
+                self.next()
+                return ast.Literal(None, "null")
+            if kw in ("true", "false"):
+                self.next()
+                return ast.Literal(kw == "true", "boolean")
+            if kw == "date":
+                # DATE 'yyyy-mm-dd'
+                if self.peek(1).kind == "string":
+                    self.next()
+                    s = self.next().value
+                    return ast.Literal(s, "date", s)
+            if kw == "interval":
+                self.next()
+                v = self.next()
+                if v.kind != "string":
+                    raise ParseError("INTERVAL expects a quoted value")
+                unit_tok = self.next()
+                unit = unit_tok.value.lower().rstrip("s")
+                if unit not in ("day", "month", "year"):
+                    raise ParseError(f"unsupported interval unit {unit}")
+                return ast.IntervalLiteral(int(v.value), unit)
+            if kw == "case":
+                return self.parse_case()
+            if kw == "cast":
+                self.next()
+                self.expect_op("(")
+                e = self.parse_expr()
+                self.expect_kw("as")
+                # type name: ident or keyword ('date'), optional (p[,s])
+                tt = self.next()
+                type_name = tt.value
+                if self.accept_op("("):
+                    args = [self.next().value]
+                    while self.accept_op(","):
+                        args.append(self.next().value)
+                    self.expect_op(")")
+                    type_name += "(" + ",".join(args) + ")"
+                self.expect_op(")")
+                return ast.Cast(e, type_name)
+            if kw == "extract":
+                self.next()
+                self.expect_op("(")
+                field = self.next().value.lower()
+                self.expect_kw("from")
+                e = self.parse_expr()
+                self.expect_op(")")
+                return ast.Extract(field, e)
+            if kw == "exists":
+                self.next()
+                self.expect_op("(")
+                q = self.parse_query()
+                self.expect_op(")")
+                return ast.Exists(q)
+            if kw == "substring":
+                self.next()
+                self.expect_op("(")
+                e = self.parse_expr()
+                if self.accept_kw("from"):
+                    start = self.parse_expr()
+                    length = None
+                    if self.accept_kw("for"):
+                        length = self.parse_expr()
+                else:
+                    self.expect_op(",")
+                    start = self.parse_expr()
+                    length = None
+                    if self.accept_op(","):
+                        length = self.parse_expr()
+                self.expect_op(")")
+                args = [e, start] + ([length] if length is not None else [])
+                return ast.FunctionCall("substr", args)
+            if kw in ("year", "month", "day") and self.peek(1).kind == "op" and self.peek(1).value == "(":
+                self.next()
+                self.expect_op("(")
+                e = self.parse_expr()
+                self.expect_op(")")
+                return ast.Extract(kw, e)
+        if t.kind == "op" and t.value == "(":
+            self.next()
+            if self.peek().kind == "keyword" and self.peek().value in ("select", "with"):
+                q = self.parse_query()
+                self.expect_op(")")
+                return ast.ScalarSubquery(q)
+            e = self.parse_expr()
+            self.expect_op(")")
+            return e
+        # identifier or function call
+        if t.kind in ("ident", "keyword"):
+            name = self.ident()
+            if self.peek().kind == "op" and self.peek().value == "(":
+                self.next()
+                if self.accept_op("*"):
+                    self.expect_op(")")
+                    return ast.FunctionCall(name, [], is_star=True)
+                distinct = bool(self.accept_kw("distinct"))
+                args = []
+                if not (self.peek().kind == "op" and self.peek().value == ")"):
+                    args.append(self.parse_expr())
+                    while self.accept_op(","):
+                        args.append(self.parse_expr())
+                self.expect_op(")")
+                return ast.FunctionCall(name, args, distinct=distinct)
+            parts = [name]
+            while self.accept_op("."):
+                parts.append(self.ident())
+            return ast.Identifier(tuple(parts))
+        raise ParseError(f"unexpected token {t!r}")
+
+    def parse_case(self) -> ast.Node:
+        self.expect_kw("case")
+        operand = None
+        if not (self.peek().kind == "keyword" and self.peek().value == "when"):
+            operand = self.parse_expr()
+        whens = []
+        while self.accept_kw("when"):
+            cond = self.parse_expr()
+            self.expect_kw("then")
+            val = self.parse_expr()
+            whens.append((cond, val))
+        default = None
+        if self.accept_kw("else"):
+            default = self.parse_expr()
+        self.expect_kw("end")
+        return ast.Case(operand, whens, default)
+
+
+def parse_sql(sql: str) -> ast.Query:
+    """Parse a SQL query string into an AST (reference:
+    presto-parser/.../SqlParser.java:91 createStatement)."""
+    return Parser(sql).parse_statement()

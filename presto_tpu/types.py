@@ -1,0 +1,196 @@
+"""SQL type system mapped onto TPU-friendly device representations.
+
+Reference surface: presto-spi/src/main/java/com/facebook/presto/spi/type/
+(Type.java, BigintType, DoubleType, DecimalType, VarcharType, DateType, ...).
+
+Design (TPU-first, not a port):
+
+- Every type has exactly one flat device representation (a jnp dtype); there
+  are no variable-width device values. VARCHAR is dictionary-encoded: the
+  device sees order-preserving int32 codes, the host keeps the dictionary
+  (see presto_tpu.dictionary). This generalizes Presto's DictionaryBlock
+  (spi/block/DictionaryBlock.java) from an optimization into the only string
+  representation the device ever touches.
+- DECIMAL(p, s) with p <= 18 is a scaled int64 ("unscaled value", like
+  Presto's short decimal, spi/type/DecimalType.java); arithmetic is exact
+  int64 math with explicit rescales. p > 18 is not yet supported (reference
+  uses int128 limbs, UnscaledDecimal128Arithmetic.java) — tracked for a
+  later round as paired-int32-limb Pallas math.
+- DATE is int32 days since 1970-01-01 (same as Presto, spi/type/DateType).
+- TIMESTAMP is int64 microseconds since epoch.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class Type:
+    """Base class for SQL types. Frozen/hashable: types are plan-time values."""
+
+    name: str
+
+    @property
+    def dtype(self):
+        raise NotImplementedError
+
+    @property
+    def is_string(self) -> bool:
+        return False
+
+    @property
+    def null_value(self):
+        """Placeholder stored in value slots whose validity bit is 0."""
+        return np.zeros((), dtype=self.dtype).item()
+
+    def __str__(self) -> str:
+        return self.name
+
+
+@dataclasses.dataclass(frozen=True)
+class _FixedType(Type):
+    _dtype: str
+
+    @property
+    def dtype(self):
+        return jnp.dtype(self._dtype)
+
+
+@dataclasses.dataclass(frozen=True)
+class DecimalType(Type):
+    """Short decimal: scaled int64. DECIMAL(p, s), p <= 18."""
+
+    precision: int = 18
+    scale: int = 0
+
+    def __init__(self, precision: int = 18, scale: int = 0):
+        if precision > 18:
+            raise NotImplementedError(
+                "DECIMAL precision > 18 (long decimal / int128) not yet supported"
+            )
+        object.__setattr__(self, "name", f"decimal({precision},{scale})")
+        object.__setattr__(self, "precision", precision)
+        object.__setattr__(self, "scale", scale)
+
+    @property
+    def dtype(self):
+        return jnp.dtype("int64")
+
+
+@dataclasses.dataclass(frozen=True)
+class VarcharType(Type):
+    """Dictionary-encoded string. Device value: int32 code, order-preserving."""
+
+    def __init__(self):
+        object.__setattr__(self, "name", "varchar")
+
+    @property
+    def dtype(self):
+        return jnp.dtype("int32")
+
+    @property
+    def is_string(self) -> bool:
+        return True
+
+    @property
+    def null_value(self):
+        return -1  # codes are >= 0; -1 marks null even without a validity mask
+
+
+BOOLEAN = _FixedType("boolean", "bool")
+TINYINT = _FixedType("tinyint", "int8")
+SMALLINT = _FixedType("smallint", "int16")
+INTEGER = _FixedType("integer", "int32")
+BIGINT = _FixedType("bigint", "int64")
+REAL = _FixedType("real", "float32")
+DOUBLE = _FixedType("double", "float64")
+DATE = _FixedType("date", "int32")
+TIMESTAMP = _FixedType("timestamp", "int64")
+VARCHAR = VarcharType()
+
+
+_NUMERIC_RANK = {
+    "tinyint": 1,
+    "smallint": 2,
+    "integer": 3,
+    "bigint": 4,
+    "real": 6,
+    "double": 7,
+}
+
+
+def is_numeric(t: Type) -> bool:
+    return t.name in _NUMERIC_RANK or isinstance(t, DecimalType)
+
+
+def is_integral(t: Type) -> bool:
+    return t.name in ("tinyint", "smallint", "integer", "bigint")
+
+
+def is_floating(t: Type) -> bool:
+    return t.name in ("real", "double")
+
+
+def common_super_type(a: Type, b: Type) -> Type:
+    """Implicit coercion for binary ops (analog of TypeCoercion in
+    sql/analyzer — simplified to the numeric tower + identical types)."""
+    if a == b:
+        return a
+    if isinstance(a, DecimalType) and isinstance(b, DecimalType):
+        scale = max(a.scale, b.scale)
+        intd = max(a.precision - a.scale, b.precision - b.scale)
+        return DecimalType(min(18, intd + scale), scale)
+    if isinstance(a, DecimalType) and is_integral(b):
+        return a
+    if isinstance(b, DecimalType) and is_integral(a):
+        return b
+    if isinstance(a, DecimalType) and is_floating(b):
+        return DOUBLE
+    if isinstance(b, DecimalType) and is_floating(a):
+        return DOUBLE
+    if a.name in _NUMERIC_RANK and b.name in _NUMERIC_RANK:
+        r = max(_NUMERIC_RANK[a.name], _NUMERIC_RANK[b.name])
+        for name, rank in _NUMERIC_RANK.items():
+            if rank == r:
+                return {"tinyint": TINYINT, "smallint": SMALLINT,
+                        "integer": INTEGER, "bigint": BIGINT,
+                        "real": REAL, "double": DOUBLE}[name]
+    if a.name == "date" and b.name == "date":
+        return DATE
+    raise TypeError(f"no common type for {a} and {b}")
+
+
+def parse_type(s: str) -> Type:
+    """Parse a SQL type name (for CAST and DDL)."""
+    s = s.strip().lower()
+    simple = {
+        "boolean": BOOLEAN,
+        "tinyint": TINYINT,
+        "smallint": SMALLINT,
+        "int": INTEGER,
+        "integer": INTEGER,
+        "bigint": BIGINT,
+        "real": REAL,
+        "float": REAL,
+        "double": DOUBLE,
+        "date": DATE,
+        "timestamp": TIMESTAMP,
+        "varchar": VARCHAR,
+        "string": VARCHAR,
+    }
+    if s in simple:
+        return simple[s]
+    if s.startswith("varchar(") and s.endswith(")"):
+        return VARCHAR
+    if s.startswith("decimal"):
+        if "(" in s:
+            args = s[s.index("(") + 1 : s.rindex(")")].split(",")
+            p = int(args[0])
+            sc = int(args[1]) if len(args) > 1 else 0
+            return DecimalType(p, sc)
+        return DecimalType(18, 0)
+    raise ValueError(f"unknown type: {s}")

@@ -1,0 +1,69 @@
+"""Test configuration: force an 8-device virtual CPU platform so every test
+exercises the same mesh/sharding code paths the driver validates multi-chip
+(xla_force_host_platform_device_count), without TPU compile latency."""
+
+import os
+
+# NOTE: the axon sitecustomize forces jax_platforms="axon,cpu" regardless of
+# the JAX_PLATFORMS env var, so the override must be programmatic, after
+# importing jax but before any backend is initialized.
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pandas as pd  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def rng():
+    return np.random.default_rng(1234)
+
+
+def assert_frames_match(got: pd.DataFrame, exp: pd.DataFrame, sort_by=None,
+                        rtol=1e-9, check_order=False):
+    """QueryAssertions analog: compare result sets, numeric tolerance,
+    optional row-order insensitivity."""
+    import decimal
+
+    assert list(got.columns) == list(exp.columns), (
+        f"columns differ: {list(got.columns)} vs {list(exp.columns)}"
+    )
+    g, e = got.copy(), exp.copy()
+
+    def normalize(df):
+        for c in df.columns:
+            vals = df[c].to_numpy()
+            if len(vals) and isinstance(
+                next((v for v in vals if v is not None), None), decimal.Decimal
+            ):
+                df[c] = [float(v) if v is not None else None for v in vals]
+        return df
+
+    g, e = normalize(g), normalize(e)
+    if not check_order:
+        by = sort_by or list(g.columns)
+        g = g.sort_values(by=by, ignore_index=True)
+        e = e.sort_values(by=by, ignore_index=True)
+    assert len(g) == len(e), f"row count: {len(g)} vs {len(e)}"
+    for c in g.columns:
+        gv, ev = g[c].to_numpy(), e[c].to_numpy()
+        if np.issubdtype(np.asarray(ev).dtype, np.number):
+            np.testing.assert_allclose(
+                np.asarray(gv, dtype=float), np.asarray(ev, dtype=float),
+                rtol=rtol, err_msg=f"column {c}",
+            )
+        else:
+            assert list(gv) == list(ev), f"column {c}: {gv[:10]} vs {ev[:10]}"
+
+
+@pytest.fixture
+def frames_match():
+    return assert_frames_match

@@ -1,0 +1,206 @@
+"""Kernel unit tests against numpy oracles (the tier-1 analog of
+presto-main's per-operator tests, e.g. operator/TestHashAggregationOperator,
+TestHashJoinOperator — SURVEY §4 tier 1)."""
+
+import numpy as np
+import jax.numpy as jnp
+import pandas as pd
+import pytest
+
+from presto_tpu.batch import Batch
+from presto_tpu.types import BIGINT, DOUBLE, INTEGER
+from presto_tpu.ops.grouping import grouped_merge, KeyCol, StateCol
+from presto_tpu.ops.join import build_side, probe_unique, probe_counts, probe_expand
+from presto_tpu.ops.partition import partition_for_exchange
+from presto_tpu.ops.sort import sort_batch, SortKey, compact, limit_batch
+from presto_tpu.ops.hashing import hash_columns
+
+
+def make_batch(rng, n=1000, live_frac=0.9, nkeys=7):
+    k = rng.integers(0, nkeys, n)
+    v = rng.normal(size=n)
+    live = rng.random(n) < live_frac
+    b = Batch.from_numpy({"k": k, "v": v}, {"k": BIGINT, "v": DOUBLE})
+    pad = np.zeros(b.capacity, bool)
+    pad[:n] = live
+    return b.with_live(b.live & jnp.asarray(pad)), k, v, live
+
+
+class TestGrouping:
+    def test_sum_count(self, rng):
+        b, k, v, live = make_batch(rng)
+        keys, states, out_live, ng = grouped_merge(
+            [KeyCol(b.column("k").values, None)],
+            [StateCol(b.column("v").values, None, "sum")],
+            b.live, 64,
+        )
+        df = pd.DataFrame({"k": k[live], "v": v[live]})
+        exp = df.groupby("k")["v"].sum().sort_index()
+        lv = np.asarray(out_live)
+        got_k = np.asarray(keys[0].values)[lv]
+        got_s = np.asarray(states[0].values)[lv]
+        order = np.argsort(got_k)
+        assert int(ng) == len(exp)
+        np.testing.assert_array_equal(got_k[order], exp.index.values)
+        np.testing.assert_allclose(got_s[order], exp.values)
+
+    def test_min_max_with_nulls(self, rng):
+        n = 500
+        k = rng.integers(0, 5, n)
+        v = rng.integers(-1000, 1000, n)
+        valid = rng.random(n) < 0.8
+        b = Batch.from_numpy({"k": k, "v": v}, {"k": BIGINT, "v": BIGINT})
+        vcol = np.zeros(b.capacity, bool)
+        vcol[:n] = valid
+        from presto_tpu.batch import Column
+
+        col = Column(b.column("v").values, jnp.asarray(vcol))
+        b = b.with_column("v", BIGINT, col)
+        keys, states, out_live, ng = grouped_merge(
+            [KeyCol(b.column("k").values, None)],
+            [
+                StateCol(col.values, col.validity, "min"),
+                StateCol(col.values, col.validity, "max"),
+            ],
+            b.live, 64,
+        )
+        df = pd.DataFrame({"k": k, "v": np.where(valid, v, np.nan)})
+        exp_min = df.groupby("k")["v"].min().sort_index()
+        exp_max = df.groupby("k")["v"].max().sort_index()
+        lv = np.asarray(out_live)
+        got_k = np.asarray(keys[0].values)[lv]
+        order = np.argsort(got_k)
+        got_min = np.asarray(states[0].values)[lv][order]
+        got_max = np.asarray(states[1].values)[lv][order]
+        np.testing.assert_allclose(got_min, exp_min.values)
+        np.testing.assert_allclose(got_max, exp_max.values)
+
+    def test_null_keys_group_together(self, rng):
+        n = 100
+        k = rng.integers(0, 3, n)
+        valid = rng.random(n) < 0.7
+        b = Batch.from_numpy({"k": k}, {"k": BIGINT})
+        vk = np.zeros(b.capacity, bool)
+        vk[:n] = valid
+        keys, states, out_live, ng = grouped_merge(
+            [KeyCol(b.column("k").values, jnp.asarray(vk))],
+            [StateCol(jnp.ones(b.capacity, jnp.int64), None, "count_add")],
+            b.live, 16,
+        )
+        # distinct live key values + one null group
+        expected_groups = len(np.unique(k[valid])) + (1 if (~valid).any() else 0)
+        assert int(ng) == expected_groups
+
+    def test_capacity_overflow_reported(self, rng):
+        b, k, v, live = make_batch(rng, nkeys=50)
+        _, _, _, ng = grouped_merge(
+            [KeyCol(b.column("k").values, None)],
+            [StateCol(b.column("v").values, None, "sum")],
+            b.live, 8,
+        )
+        assert int(ng) == len(np.unique(k[live]))  # true count reported
+
+
+class TestJoin:
+    def test_unique_probe(self, rng):
+        nb, npr = 64, 500
+        bk = np.arange(nb)
+        bv = rng.normal(size=nb)
+        bb = Batch.from_numpy({"id": bk, "x": bv}, {"id": BIGINT, "x": DOUBLE})
+        tbl = build_side(bb, ("id",))
+        pk = rng.integers(0, 100, npr)
+        pb = Batch.from_numpy({"id": pk}, {"id": BIGINT})
+        idx, matched = probe_unique(tbl, pb, ("id",), ("id",))
+        exp = pk < nb
+        np.testing.assert_array_equal(np.asarray(matched)[:npr], exp)
+        got_x = np.asarray(tbl.batch.column("x").values)[np.asarray(idx)[:npr]]
+        np.testing.assert_allclose(got_x[exp], bv[pk[exp]])
+
+    def test_fanout_expand(self, rng):
+        bk = rng.integers(0, 10, 200)
+        bb = Batch.from_numpy({"id": bk, "y": np.arange(200)}, {"id": BIGINT, "y": BIGINT})
+        tbl = build_side(bb, ("id",))
+        pk = rng.integers(0, 12, 100)
+        pb = Batch.from_numpy({"id": pk}, {"id": BIGINT})
+        lo, counts, offsets, total, _ = probe_counts(tbl, pb, ("id",), ("id",), max_fanout_scan=4)
+        pr, bi, ol = probe_expand(tbl, pb, ("id",), ("id",), lo, counts, offsets, 0, 8192)
+        got = set()
+        y = np.asarray(tbl.batch.column("y").values)
+        prn, bin_, oln = np.asarray(pr), np.asarray(bi), np.asarray(ol)
+        for i in range(8192):
+            if oln[i]:
+                got.add((int(prn[i]), int(y[bin_[i]])))
+        exp = {(i, int(j)) for i, x in enumerate(pk) for j in np.where(bk == x)[0]}
+        assert got == exp
+
+    def test_null_keys_never_match(self, rng):
+        bk = np.arange(10)
+        bb = Batch.from_numpy({"id": bk}, {"id": BIGINT})
+        tbl = build_side(bb, ("id",))
+        pk = np.arange(10)
+        pb = Batch.from_numpy({"id": pk}, {"id": BIGINT})
+        from presto_tpu.batch import Column
+
+        valid = np.zeros(pb.capacity, bool)
+        valid[:5] = True  # rows 5..9 have NULL keys
+        pb = pb.with_column("id", BIGINT, Column(pb.column("id").values, jnp.asarray(valid)))
+        _, matched = probe_unique(tbl, pb, ("id",), ("id",))
+        m = np.asarray(matched)[:10]
+        assert m[:5].all() and not m[5:].any()
+
+
+class TestSortCompact:
+    def test_multi_key_desc_nulls(self, rng):
+        n = 300
+        a = rng.integers(0, 5, n)
+        v = rng.normal(size=n)
+        b = Batch.from_numpy({"a": a, "v": v}, {"a": BIGINT, "v": DOUBLE})
+        out = sort_batch(
+            b,
+            [
+                SortKey(b.column("a").values, None, descending=False),
+                SortKey(b.column("v").values, None, descending=True),
+            ],
+        )
+        d = out.to_pydict()
+        df = pd.DataFrame({"a": a, "v": v}).sort_values(
+            ["a", "v"], ascending=[True, False], ignore_index=True
+        )
+        np.testing.assert_array_equal(d["a"], df["a"].values)
+        np.testing.assert_allclose(d["v"], df["v"].values)
+
+    def test_limit(self, rng):
+        b, k, v, live = make_batch(rng)
+        out = limit_batch(b, 17)
+        assert out.num_live() == 17
+
+    def test_compact_preserves_order(self, rng):
+        b, k, v, live = make_batch(rng)
+        out = compact(b)
+        d = out.to_pydict()
+        np.testing.assert_allclose(d["v"], v[live])
+
+
+class TestPartition:
+    def test_counts_and_overflow(self, rng):
+        n = 2000
+        k = rng.integers(0, 1000, n)
+        b = Batch.from_numpy({"k": k}, {"k": BIGINT})
+        out, counts, ovf = partition_for_exchange(b, ["k"], 8, 1024)
+        assert int(ovf) == 0
+        assert int(np.asarray(counts).sum()) == n
+        # same key → same partition
+        d = out.to_pydict()
+        from presto_tpu.ops.partition import partition_ids
+
+        pid = np.asarray(partition_ids(b, ["k"], 8))[:n]
+        got_rows = np.asarray(out.live).reshape(8, -1).sum(axis=1)
+        exp_rows = np.bincount(pid, minlength=8)
+        np.testing.assert_array_equal(got_rows, exp_rows)
+
+    def test_hash_stability(self):
+        a = jnp.asarray(np.arange(100, dtype=np.int64))
+        h1 = hash_columns([a])
+        h2 = hash_columns([a])
+        np.testing.assert_array_equal(np.asarray(h1), np.asarray(h2))
+        assert (np.asarray(h1) >= 0).all()

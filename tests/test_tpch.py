@@ -1,0 +1,318 @@
+"""TPC-H query tests at small scale factor against pandas oracles —
+the engine's AbstractTestQueries/TpchTableResults analog (SURVEY §4)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from presto_tpu.catalog.tpch import TpchConnector, tpch_catalog
+from presto_tpu.exec import ExecConfig, LocalRunner
+from presto_tpu.types import DecimalType
+
+
+SF = 0.01
+
+
+@pytest.fixture(scope="module")
+def runner():
+    return LocalRunner(tpch_catalog(SF), ExecConfig(batch_rows=1 << 14, agg_capacity=1 << 10))
+
+
+@pytest.fixture(scope="module")
+def tables(runner):
+    """Host pandas copies with decimals scaled to float (oracle side)."""
+    conn = runner.catalog.connectors["tpch"]
+    out = {}
+    for t in conn.table_names():
+        conn._ensure(t)
+        mt = conn.tables[t]
+        df = {}
+        for c, arr in mt.arrays.items():
+            tt = mt.types[c]
+            if isinstance(tt, DecimalType):
+                df[c] = arr.astype(np.float64) / 10 ** tt.scale
+            elif tt.is_string:
+                df[c] = mt.dicts[c].decode(arr)
+            else:
+                df[c] = arr
+        out[t] = pd.DataFrame(df)
+    return out
+
+
+def _d(s: str) -> int:
+    return (pd.Timestamp(s) - pd.Timestamp("1970-01-01")).days
+
+
+def test_q1(runner, tables, frames_match):
+    got = runner.run(
+        """
+        select l_returnflag, l_linestatus,
+               sum(l_quantity) as sum_qty,
+               sum(l_extendedprice) as sum_base_price,
+               sum(l_extendedprice * (1 - l_discount)) as sum_disc_price,
+               sum(l_extendedprice * (1 - l_discount) * (1 + l_tax)) as sum_charge,
+               avg(l_quantity) as avg_qty,
+               avg(l_extendedprice) as avg_price,
+               avg(l_discount) as avg_disc,
+               count(*) as count_order
+        from lineitem
+        where l_shipdate <= date '1998-12-01' - interval '90' day
+        group by l_returnflag, l_linestatus
+        order by l_returnflag, l_linestatus
+        """
+    )
+    li = tables["lineitem"]
+    m = li[li.l_shipdate <= _d("1998-12-01") - 90]
+    exp = (
+        m.assign(
+            disc_price=m.l_extendedprice * (1 - m.l_discount),
+            charge=m.l_extendedprice * (1 - m.l_discount) * (1 + m.l_tax),
+        )
+        .groupby(["l_returnflag", "l_linestatus"])
+        .agg(
+            sum_qty=("l_quantity", "sum"),
+            sum_base_price=("l_extendedprice", "sum"),
+            sum_disc_price=("disc_price", "sum"),
+            sum_charge=("charge", "sum"),
+            avg_qty=("l_quantity", "mean"),
+            avg_price=("l_extendedprice", "mean"),
+            avg_disc=("l_discount", "mean"),
+            count_order=("l_quantity", "size"),
+        )
+        .reset_index()
+    )
+    frames_match(got, exp, rtol=1e-9, check_order=True)
+
+
+def test_q3(runner, tables, frames_match):
+    got = runner.run(
+        """
+        select l_orderkey, sum(l_extendedprice * (1 - l_discount)) as revenue,
+               o_orderdate, o_shippriority
+        from customer, orders, lineitem
+        where c_mktsegment = 'BUILDING' and c_custkey = o_custkey
+          and l_orderkey = o_orderkey
+          and o_orderdate < date '1995-03-15' and l_shipdate > date '1995-03-15'
+        group by l_orderkey, o_orderdate, o_shippriority
+        order by revenue desc, o_orderdate
+        limit 10
+        """
+    )
+    c, o, li = tables["customer"], tables["orders"], tables["lineitem"]
+    m = (
+        li[li.l_shipdate > _d("1995-03-15")]
+        .merge(o[o.o_orderdate < _d("1995-03-15")], left_on="l_orderkey", right_on="o_orderkey")
+        .merge(c[c.c_mktsegment == "BUILDING"], left_on="o_custkey", right_on="c_custkey")
+    )
+    m = m.assign(rev=m.l_extendedprice * (1 - m.l_discount))
+    exp = (
+        m.groupby(["l_orderkey", "o_orderdate", "o_shippriority"])
+        .agg(revenue=("rev", "sum"))
+        .reset_index()
+        .sort_values(["revenue", "o_orderdate"], ascending=[False, True])
+        .head(10)[["l_orderkey", "revenue", "o_orderdate", "o_shippriority"]]
+        .reset_index(drop=True)
+    )
+    frames_match(got, exp, rtol=1e-9, check_order=True)
+
+
+def test_q5(runner, tables, frames_match):
+    got = runner.run(
+        """
+        select n_name, sum(l_extendedprice * (1 - l_discount)) as revenue
+        from customer, orders, lineitem, supplier, nation, region
+        where c_custkey = o_custkey and l_orderkey = o_orderkey
+          and l_suppkey = s_suppkey and c_nationkey = s_nationkey
+          and s_nationkey = n_nationkey and n_regionkey = r_regionkey
+          and r_name = 'ASIA'
+          and o_orderdate >= date '1994-01-01' and o_orderdate < date '1995-01-01'
+        group by n_name
+        order by revenue desc
+        """
+    )
+    t = tables
+    m = (
+        t["lineitem"]
+        .merge(t["orders"], left_on="l_orderkey", right_on="o_orderkey")
+        .merge(t["customer"], left_on="o_custkey", right_on="c_custkey")
+        .merge(t["supplier"], left_on="l_suppkey", right_on="s_suppkey")
+        .merge(t["nation"], left_on="s_nationkey", right_on="n_nationkey")
+        .merge(t["region"], left_on="n_regionkey", right_on="r_regionkey")
+    )
+    m = m[
+        (m.c_nationkey == m.s_nationkey)
+        & (m.r_name == "ASIA")
+        & (m.o_orderdate >= _d("1994-01-01"))
+        & (m.o_orderdate < _d("1995-01-01"))
+    ]
+    m = m.assign(rev=m.l_extendedprice * (1 - m.l_discount))
+    exp = (
+        m.groupby("n_name").agg(revenue=("rev", "sum")).reset_index()
+        .sort_values("revenue", ascending=False).reset_index(drop=True)
+    )
+    frames_match(got, exp, rtol=1e-9, check_order=True)
+
+
+def test_q6(runner, tables, frames_match):
+    got = runner.run(
+        """
+        select sum(l_extendedprice * l_discount) as revenue
+        from lineitem
+        where l_shipdate >= date '1994-01-01' and l_shipdate < date '1995-01-01'
+          and l_discount between 0.05 and 0.07 and l_quantity < 24
+        """
+    )
+    li = tables["lineitem"]
+    m = li[
+        (li.l_shipdate >= _d("1994-01-01"))
+        & (li.l_shipdate < _d("1995-01-01"))
+        & (li.l_discount >= 0.05 - 1e-9)
+        & (li.l_discount <= 0.07 + 1e-9)
+        & (li.l_quantity < 24)
+    ]
+    exp = pd.DataFrame({"revenue": [(m.l_extendedprice * m.l_discount).sum()]})
+    frames_match(got, exp, rtol=1e-9)
+
+
+def test_q9(runner, tables, frames_match):
+    got = runner.run(
+        """
+        select nation, o_year, sum(amount) as sum_profit
+        from (
+          select n_name as nation, year(o_orderdate) as o_year,
+                 l_extendedprice * (1 - l_discount) - ps_supplycost * l_quantity as amount
+          from part, supplier, lineitem, partsupp, orders, nation
+          where s_suppkey = l_suppkey and ps_suppkey = l_suppkey
+            and ps_partkey = l_partkey and p_partkey = l_partkey
+            and o_orderkey = l_orderkey and s_nationkey = n_nationkey
+            and p_name like '%green%'
+        ) profit
+        group by nation, o_year
+        order by nation, o_year desc
+        """
+    )
+    t = tables
+    m = (
+        t["lineitem"]
+        .merge(t["part"][t["part"].p_name.str.contains("green")], left_on="l_partkey", right_on="p_partkey")
+        .merge(t["supplier"], left_on="l_suppkey", right_on="s_suppkey")
+        .merge(t["partsupp"], left_on=["l_partkey", "l_suppkey"], right_on=["ps_partkey", "ps_suppkey"])
+        .merge(t["orders"], left_on="l_orderkey", right_on="o_orderkey")
+        .merge(t["nation"], left_on="s_nationkey", right_on="n_nationkey")
+    )
+    years = (m.o_orderdate.values.astype("datetime64[D]") if False else None)
+    oy = pd.to_datetime(m.o_orderdate, unit="D", origin="1970-01-01").dt.year
+    m = m.assign(
+        nation=m.n_name,
+        o_year=oy,
+        amount=m.l_extendedprice * (1 - m.l_discount) - m.ps_supplycost * m.l_quantity,
+    )
+    exp = (
+        m.groupby(["nation", "o_year"]).agg(sum_profit=("amount", "sum")).reset_index()
+        .sort_values(["nation", "o_year"], ascending=[True, False]).reset_index(drop=True)
+    )
+    frames_match(got, exp, rtol=1e-9, check_order=True)
+
+
+def test_q12(runner, tables, frames_match):
+    got = runner.run(
+        """
+        select l_shipmode,
+               sum(case when o_orderpriority = '1-URGENT' or o_orderpriority = '2-HIGH'
+                        then 1 else 0 end) as high_line_count,
+               sum(case when o_orderpriority <> '1-URGENT' and o_orderpriority <> '2-HIGH'
+                        then 1 else 0 end) as low_line_count
+        from orders, lineitem
+        where o_orderkey = l_orderkey and l_shipmode in ('MAIL', 'SHIP')
+          and l_commitdate < l_receiptdate and l_shipdate < l_commitdate
+          and l_receiptdate >= date '1994-01-01' and l_receiptdate < date '1995-01-01'
+        group by l_shipmode order by l_shipmode
+        """
+    )
+    t = tables
+    li, o = t["lineitem"], t["orders"]
+    m = li[
+        li.l_shipmode.isin(["MAIL", "SHIP"])
+        & (li.l_commitdate < li.l_receiptdate)
+        & (li.l_shipdate < li.l_commitdate)
+        & (li.l_receiptdate >= _d("1994-01-01"))
+        & (li.l_receiptdate < _d("1995-01-01"))
+    ].merge(o, left_on="l_orderkey", right_on="o_orderkey")
+    hi = m.o_orderpriority.isin(["1-URGENT", "2-HIGH"])
+    exp = (
+        m.assign(high=hi.astype(np.int64), low=(~hi).astype(np.int64))
+        .groupby("l_shipmode")
+        .agg(high_line_count=("high", "sum"), low_line_count=("low", "sum"))
+        .reset_index()
+    )
+    frames_match(got, exp, check_order=True)
+
+
+def test_q14(runner, tables, frames_match):
+    got = runner.run(
+        """
+        select 100.00 * sum(case when p_type like 'PROMO%'
+                                 then l_extendedprice * (1 - l_discount) else 0 end)
+               / sum(l_extendedprice * (1 - l_discount)) as promo_revenue
+        from lineitem, part
+        where l_partkey = p_partkey
+          and l_shipdate >= date '1995-09-01' and l_shipdate < date '1995-10-01'
+        """
+    )
+    t = tables
+    m = t["lineitem"].merge(t["part"], left_on="l_partkey", right_on="p_partkey")
+    m = m[(m.l_shipdate >= _d("1995-09-01")) & (m.l_shipdate < _d("1995-10-01"))]
+    rev = m.l_extendedprice * (1 - m.l_discount)
+    promo = rev.where(m.p_type.str.startswith("PROMO"), 0.0)
+    exp = pd.DataFrame({"promo_revenue": [100.0 * promo.sum() / rev.sum()]})
+    frames_match(got, exp, rtol=1e-9)
+
+
+def test_q18(runner, tables, frames_match):
+    got = runner.run(
+        """
+        select c_name, c_custkey, o_orderkey, o_orderdate, o_totalprice,
+               sum(l_quantity) as total_qty
+        from customer, orders, lineitem
+        where o_orderkey in (
+            select l_orderkey from lineitem group by l_orderkey
+            having sum(l_quantity) > 250
+          )
+          and c_custkey = o_custkey and o_orderkey = l_orderkey
+        group by c_name, c_custkey, o_orderkey, o_orderdate, o_totalprice
+        order by o_totalprice desc, o_orderdate
+        limit 100
+        """
+    )
+    t = tables
+    li, o, c = t["lineitem"], t["orders"], t["customer"]
+    big = li.groupby("l_orderkey")["l_quantity"].sum()
+    keys = big[big > 250].index
+    m = (
+        li[li.l_orderkey.isin(keys)]
+        .merge(o, left_on="l_orderkey", right_on="o_orderkey")
+        .merge(c, left_on="o_custkey", right_on="c_custkey")
+    )
+    exp = (
+        m.groupby(["c_name", "c_custkey", "o_orderkey", "o_orderdate", "o_totalprice"])
+        .agg(total_qty=("l_quantity", "sum"))
+        .reset_index()
+        .sort_values(["o_totalprice", "o_orderdate"], ascending=[False, True])
+        .head(100)
+        .reset_index(drop=True)
+    )
+    frames_match(got, exp, rtol=1e-9, check_order=True)
+
+
+def test_referential_integrity(tables):
+    t = tables
+    assert set(t["lineitem"].l_orderkey).issubset(set(t["orders"].o_orderkey))
+    assert set(t["orders"].o_custkey).issubset(set(t["customer"].c_custkey))
+    ps_pairs = set(zip(t["partsupp"].ps_partkey, t["partsupp"].ps_suppkey))
+    li_pairs = set(zip(t["lineitem"].l_partkey, t["lineitem"].l_suppkey))
+    assert li_pairs.issubset(ps_pairs)
+    # o_totalprice consistency with lineitems (cents-exact)
+    li = t["lineitem"]
+    tot = (
+        (li.l_extendedprice * (1 - li.l_discount) * (1 + li.l_tax) * 10000 + 0.5).astype(np.int64)
+    )
