@@ -52,6 +52,16 @@ class MemoryTable:
         self.primary_key = primary_key
         n = None
         for col, raw in data.items():
+            # pre-encoded string columns: (Dictionary, codes) — avoids
+            # materializing millions of python strings in generators
+            if isinstance(raw, tuple) and len(raw) == 2 and isinstance(raw[0], Dictionary):
+                d, codes = raw
+                n = len(codes) if n is None else n
+                self.dicts[col] = d
+                self.types[col] = VARCHAR
+                self.arrays[col] = np.ascontiguousarray(codes.astype(np.int32))
+                self.validity[col] = None
+                continue
             arr = np.asarray(raw)
             n = len(arr) if n is None else n
             t = (types or {}).get(col) or _infer_type(arr)

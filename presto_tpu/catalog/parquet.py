@@ -1,0 +1,323 @@
+"""Parquet storage connector — the persistent-format layer.
+
+Reference analog: presto-hive + presto-orc/presto-parquet. Where the Aria
+work makes the ORC reader *selective* (filter pushdown into the decode loop,
+OrcSelectiveRecordReader.java:54, TupleDomainFilter.java:92), the TPU-native
+equivalents are:
+
+- row-group pruning with parquet min/max statistics (coarse TupleDomain
+  filtering before any IO),
+- column pruning (only referenced columns are decoded — driven by the
+  planner's column pruning, SURVEY §2a PushdownSubfields analog),
+- dictionary-preserving reads: parquet dictionary-encoded string columns map
+  straight onto the engine's Dictionary codes without materializing strings.
+
+Splits are row-group ranges; batches decode straight into fixed-capacity
+device arrays.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+from presto_tpu.batch import Batch, round_up_capacity
+from presto_tpu.connector import ColumnInfo, Connector, Split, TableHandle
+from presto_tpu.dictionary import Dictionary
+from presto_tpu.types import (
+    BIGINT,
+    BOOLEAN,
+    DATE,
+    DOUBLE,
+    DecimalType,
+    INTEGER,
+    REAL,
+    Type,
+    VARCHAR,
+)
+
+
+_DECIMAL_META = b"presto_tpu.decimal"
+
+
+def _arrow_to_sql(field: pa.Field) -> Type:
+    t = field.type
+    if field.metadata and _DECIMAL_META in field.metadata:
+        p, s = map(int, field.metadata[_DECIMAL_META].decode().split(","))
+        return DecimalType(p, s)
+    if pa.types.is_boolean(t):
+        return BOOLEAN
+    if pa.types.is_int8(t) or pa.types.is_int16(t) or pa.types.is_int32(t):
+        return INTEGER
+    if pa.types.is_int64(t):
+        return BIGINT
+    if pa.types.is_float32(t):
+        return REAL
+    if pa.types.is_float64(t):
+        return DOUBLE
+    if pa.types.is_date32(t):
+        return DATE
+    if pa.types.is_decimal(t):
+        if t.precision <= 18:
+            return DecimalType(t.precision, t.scale)
+        raise NotImplementedError("decimal precision > 18")
+    if pa.types.is_string(t) or pa.types.is_large_string(t) or (
+        pa.types.is_dictionary(t)
+    ):
+        return VARCHAR
+    raise NotImplementedError(f"arrow type {t}")
+
+
+def _sql_to_arrow(t: Type):
+    if t is BOOLEAN:
+        return pa.bool_()
+    if t is INTEGER:
+        return pa.int32()
+    if t is BIGINT:
+        return pa.int64()
+    if t is REAL:
+        return pa.float32()
+    if t is DOUBLE:
+        return pa.float64()
+    if t is DATE:
+        return pa.date32()
+    if isinstance(t, DecimalType):
+        # unscaled int64 physical storage; the SQL type travels in field
+        # metadata (fast zero-copy IO; readers see plain int64)
+        return pa.int64()
+    if t.is_string:
+        return pa.dictionary(pa.int32(), pa.string())
+    raise NotImplementedError(str(t))
+
+
+def write_table(path: str, data: Dict[str, np.ndarray], types: Dict[str, Type],
+                dicts: Optional[Dict[str, Dictionary]] = None,
+                row_group_rows: int = 1 << 20):
+    """Write engine-native columns (dict codes, unscaled decimals, day ints)
+    to a parquet file."""
+    arrays = []
+    fields = []
+    for name, arr in data.items():
+        t = types[name]
+        at = _sql_to_arrow(t)
+        meta = None
+        if t.is_string:
+            d = (dicts or {})[name]
+            idx = pa.array(arr.astype(np.int32), pa.int32())
+            vocab = pa.array([str(v) for v in d.values], pa.string())
+            a = pa.DictionaryArray.from_arrays(idx, vocab)
+        elif isinstance(t, DecimalType):
+            a = pa.array(arr.astype(np.int64), pa.int64())
+            meta = {_DECIMAL_META: f"{t.precision},{t.scale}".encode()}
+        elif t is DATE:
+            a = pa.array(arr.astype(np.int32), pa.int32()).cast(pa.date32())
+        else:
+            a = pa.array(arr, at)
+        arrays.append(a)
+        fields.append(pa.field(name, at, metadata=meta))
+    table = pa.Table.from_arrays(arrays, schema=pa.schema(fields))
+    pq.write_table(table, path, row_group_size=row_group_rows,
+                   use_dictionary=True, compression="zstd")
+
+
+@dataclasses.dataclass
+class _PqTable:
+    path: str
+    handle: TableHandle
+    dicts: Dict[str, Dictionary]
+    num_rows: int
+    num_row_groups: int
+
+
+class ParquetConnector(Connector):
+    """Directory-of-parquet-files connector: each file <table>.parquet."""
+
+    def __init__(self, directory: str, name: str = "parquet"):
+        self.name = name
+        self.directory = directory
+        self._tables: Dict[str, _PqTable] = {}
+
+    def table_names(self) -> List[str]:
+        out = []
+        for f in os.listdir(self.directory):
+            if f.endswith(".parquet"):
+                out.append(f[: -len(".parquet")])
+        return sorted(out)
+
+    def _load(self, name: str) -> _PqTable:
+        if name in self._tables:
+            return self._tables[name]
+        path = os.path.join(self.directory, f"{name}.parquet")
+        if not os.path.exists(path):
+            raise KeyError(f"table not found: {name}")
+        f = pq.ParquetFile(path)
+        schema = f.schema_arrow
+        cols = []
+        dicts: Dict[str, Dictionary] = {}
+        for field in schema:
+            t = _arrow_to_sql(field)
+            if t.is_string:
+                # global per-column dictionary: union of per-row-group
+                # dictionaries, built once at open (order-preserving)
+                vocab = set()
+                for rg in range(f.num_row_groups):
+                    col = f.read_row_group(rg, columns=[field.name]).column(0)
+                    for chunk in col.chunks:
+                        if pa.types.is_dictionary(chunk.type):
+                            vocab.update(chunk.dictionary.to_pylist())
+                        else:
+                            vocab.update(chunk.to_pylist())
+                d = Dictionary(np.array(sorted(v for v in vocab if v is not None)))
+                dicts[field.name] = d
+                cols.append(ColumnInfo(field.name, t, d))
+            else:
+                cols.append(ColumnInfo(field.name, t))
+        handle = TableHandle(self.name, name, cols, row_count=float(f.metadata.num_rows))
+        t = _PqTable(path, handle, dicts, f.metadata.num_rows, f.num_row_groups)
+        self._tables[name] = t
+        return t
+
+    def get_table(self, name: str) -> TableHandle:
+        return self._load(name).handle
+
+    def splits(self, handle: TableHandle, desired: int = 1) -> List[Split]:
+        """Scan-parallelism units: row groups (like ORC stripes), subdivided
+        when the engine wants finer batches than a row group. Split.part is
+        (row_group, sub_index, sub_count)."""
+        t = self._load(handle.name)
+        f = pq.ParquetFile(t.path)
+        target = max(1, -(-t.num_rows // max(desired, 1)))
+        out = []
+        for rg in range(t.num_row_groups):
+            rg_rows = f.metadata.row_group(rg).num_rows
+            subs = max(1, -(-rg_rows // target))
+            for s in range(subs):
+                out.append(Split(handle.name, (rg, s, subs), t.num_row_groups))
+        return out
+
+    def prune_splits(self, handle: TableHandle, splits: Sequence[Split],
+                     min_max: Dict[str, Tuple[object, object]]) -> List[Split]:
+        """Row-group pruning with column min/max constraints (the coarse
+        TupleDomain pushdown of the selective reader)."""
+        t = self._load(handle.name)
+        f = pq.ParquetFile(t.path)
+        keep = []
+        name_to_idx = {f.schema_arrow.field(i).name: i for i in range(len(f.schema_arrow.names))}
+        for s in splits:
+            rg_idx = s.part[0] if isinstance(s.part, tuple) else s.part
+            rg = f.metadata.row_group(rg_idx)
+            ok = True
+            for col, (lo, hi) in min_max.items():
+                if col not in name_to_idx:
+                    continue
+                st = rg.column(name_to_idx[col]).statistics
+                if st is None or not st.has_min_max:
+                    continue
+                if lo is not None and st.max is not None and st.max < lo:
+                    ok = False
+                    break
+                if hi is not None and st.min is not None and st.min > hi:
+                    ok = False
+                    break
+            if ok:
+                keep.append(s)
+        return keep
+
+    def read_split(self, split: Split, columns: Sequence[str],
+                   capacity: Optional[int] = None) -> Batch:
+        t = self._load(split.table)
+        f = pq.ParquetFile(t.path)
+        if isinstance(split.part, tuple):
+            rg, sub, sub_count = split.part
+        else:
+            rg, sub, sub_count = split.part, 0, 1
+        tbl = f.read_row_group(rg, columns=list(columns))
+        if sub_count > 1:
+            per = -(-tbl.num_rows // sub_count)
+            tbl = tbl.slice(sub * per, per)
+        n = tbl.num_rows
+        cap = capacity or round_up_capacity(max(n, 1))
+        data = {}
+        types = {}
+        import jax.numpy as jnp
+
+        from presto_tpu.batch import Column
+
+        names, typelist, cols = [], [], []
+        live = np.zeros(cap, bool)
+        live[:n] = True
+        validity_map = {}
+        for name in columns:
+            col = tbl.column(name)
+            info = t.handle.column(name)
+            st = info.type
+            arr, valid = _decode_column(col, st, t.dicts.get(name))
+            buf = np.zeros(cap, dtype=st.dtype)
+            buf[:n] = arr
+            if valid is not None:
+                vb = np.zeros(cap, bool)
+                vb[:n] = valid
+                validity_map[name] = jnp.asarray(vb)
+            names.append(name)
+            typelist.append(st)
+            cols.append(Column(jnp.asarray(buf), validity_map.get(name)))
+        return Batch(
+            names, typelist, cols, jnp.asarray(live),
+            {c: t.dicts[c] for c in columns if c in t.dicts},
+        )
+
+
+def _decode_column(col: pa.ChunkedArray, t: Type, d: Optional[Dictionary]):
+    """Arrow column → engine-native numpy (codes / unscaled / day ints)."""
+    combined = col.combine_chunks() if col.num_chunks > 1 else (
+        col.chunk(0) if col.num_chunks == 1 else pa.array([], col.type)
+    )
+    valid = None
+    if combined.null_count:
+        valid = np.asarray(combined.is_valid())
+    if t.is_string:
+        if pa.types.is_dictionary(combined.type):
+            # remap this row group's dictionary codes into the table-global
+            # dictionary (pure integer gather — no string materialization)
+            local_vocab = np.asarray(combined.dictionary.to_pylist(), dtype=object)
+            remap = np.searchsorted(d.values, local_vocab.astype(str))
+            idx = combined.indices.to_numpy(zero_copy_only=False)
+            idx = np.where(idx < 0, 0, idx)
+            arr = remap[idx].astype(np.int32)
+        else:
+            strs = np.asarray(combined.to_pylist(), dtype=object)
+            arr = np.array([d.code_of(s) if s is not None else -1 for s in strs], np.int32)
+        if valid is not None:
+            arr = np.where(valid, arr, -1)
+        return arr, valid
+    if isinstance(t, DecimalType):
+        if pa.types.is_decimal(combined.type):
+            arr = combined.cast(pa.decimal128(38, t.scale)).cast(pa.int64(), safe=False)
+        else:
+            arr = combined  # unscaled int64 storage
+        return arr.to_numpy(zero_copy_only=False), valid
+    if t is DATE:
+        return combined.cast(pa.int32()).to_numpy(zero_copy_only=False), valid
+    return combined.to_numpy(zero_copy_only=False), valid
+
+
+def export_tpch(directory: str, sf: float = 1.0):
+    """Materialize the TPC-H dataset to parquet (the dbgen→warehouse path)."""
+    from presto_tpu.catalog.tpch import TpchConnector
+
+    os.makedirs(directory, exist_ok=True)
+    conn = TpchConnector(sf)
+    for tname in conn.table_names():
+        conn._ensure(tname)
+        mt = conn.tables[tname]
+        write_table(
+            os.path.join(directory, f"{tname}.parquet"),
+            mt.arrays,
+            mt.types,
+            mt.dicts,
+        )

@@ -222,7 +222,9 @@ class TpchGenerator:
         nline = rng.integers(1, 8, n)  # 1..7 lines per order
         total_lines = int(nline.sum())
         l_order_idx = np.repeat(np.arange(n), nline)  # index into orders
-        lnum_base = np.concatenate([np.arange(1, k + 1) for k in nline]) if n else np.array([])
+        # linenumber = position within order, vectorized
+        starts = np.cumsum(nline) - nline
+        lnum_base = np.arange(total_lines) - starts[l_order_idx] + 1
 
         lrng = self._rng(6)
         m = total_lines
@@ -243,15 +245,21 @@ class TpchGenerator:
         commitdate = l_odate + lrng.integers(30, 91, m)
         receiptdate = shipdate + lrng.integers(1, 31, m)
 
-        returnflag = np.where(
-            receiptdate <= _CURRENT_DATE,
-            np.asarray(lrng.choice(["R", "A"], m)),
-            "N",
-        ).astype(object)
-        linestatus = np.where(shipdate > _CURRENT_DATE, "O", "F").astype(object)
+        # string columns generate as dictionary codes directly (vocabularies
+        # are sorted so codes are order-preserving) — no per-row python strs
+        from presto_tpu.dictionary import Dictionary
 
-        smode = np.asarray(lrng.choice(_SHIP_MODES, m), dtype=object)
-        sinstr = np.asarray(lrng.choice(_INSTRUCTIONS, m), dtype=object)
+        rf_dict = Dictionary(np.array(["A", "N", "R"]))
+        ra = np.where(lrng.integers(0, 2, m) == 0, 0, 2).astype(np.int32)  # A or R
+        returnflag = (rf_dict, np.where(receiptdate <= _CURRENT_DATE, ra, 1).astype(np.int32))
+        ls_dict = Dictionary(np.array(["F", "O"]))
+        ls_codes = (shipdate > _CURRENT_DATE).astype(np.int32)
+        linestatus = (ls_dict, ls_codes)
+
+        smode = (Dictionary(np.array(_SHIP_MODES)),
+                 lrng.integers(0, len(_SHIP_MODES), m).astype(np.int32))
+        sinstr = (Dictionary(np.array(_INSTRUCTIONS)),
+                  lrng.integers(0, len(_INSTRUCTIONS), m).astype(np.int32))
 
         # order totalprice = sum(extendedprice*(1+tax)*(1-disc)) per order —
         # computed exactly in cents with the same rounding as a decimal engine
@@ -260,25 +268,35 @@ class TpchGenerator:
         ototal = np.zeros(n, dtype=np.int64)
         np.add.at(ototal, l_order_idx, line_total)
 
-        ostatus = np.full(n, "P", dtype=object)
+        f_mask = ls_codes == 0
         all_f = np.ones(n, bool)
         any_f = np.zeros(n, bool)
-        f_mask = linestatus == "F"
         np.logical_and.at(all_f, l_order_idx, f_mask)
         np.logical_or.at(any_f, l_order_idx, f_mask)
-        ostatus[all_f] = "F"
-        ostatus[~any_f] = "O"
+        ostatus_codes = np.full(n, 2, dtype=np.int32)  # P
+        ostatus_codes[all_f] = 0  # F
+        ostatus_codes[~any_f] = 1  # O
+        ostatus = (Dictionary(np.array(["F", "O", "P"])), ostatus_codes)
 
+        n_clerk = max(1, int(1000 * self.sf))
+        clerk_dict = Dictionary(np.array([f"Clerk#{i:09d}" for i in range(1, n_clerk + 1)]))
+        ocomment_vocab = np.sort(np.array([f"order comment {i}" for i in range(9973)]))
         orders = {
             "o_orderkey": okey,
             "o_custkey": ckey,
             "o_orderstatus": ostatus,
             "o_totalprice": ototal,
             "o_orderdate": odate,
-            "o_orderpriority": np.asarray(rng.choice(_PRIORITIES, n), dtype=object),
-            "o_clerk": np.array([f"Clerk#{i:09d}" for i in rng.integers(1, max(1, int(1000 * self.sf)) + 1, n)], dtype=object),
+            "o_orderpriority": (
+                Dictionary(np.array(_PRIORITIES)),
+                rng.integers(0, len(_PRIORITIES), n).astype(np.int32),
+            ),
+            "o_clerk": (clerk_dict, rng.integers(0, n_clerk, n).astype(np.int32)),
             "o_shippriority": np.zeros(n, dtype=np.int64),
-            "o_comment": np.array([f"order comment {i}" for i in range(n)], dtype=object),
+            "o_comment": (
+                Dictionary(ocomment_vocab),
+                rng.integers(0, 9973, n).astype(np.int32),
+            ),
         }
         lineitem = {
             "l_orderkey": okey[l_order_idx],
@@ -296,7 +314,10 @@ class TpchGenerator:
             "l_receiptdate": receiptdate,
             "l_shipinstruct": sinstr,
             "l_shipmode": smode,
-            "l_comment": np.array([f"line comment {i%9973}" for i in range(m)], dtype=object),
+            "l_comment": (
+                Dictionary(np.sort(np.array([f"line comment {i}" for i in range(9973)]))),
+                lrng.integers(0, 9973, m).astype(np.int32),
+            ),
         }
         return orders, lineitem
 

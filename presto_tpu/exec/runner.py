@@ -21,9 +21,21 @@ class LocalRunner:
     def __init__(self, catalog: Catalog, config: Optional[ExecConfig] = None):
         self.catalog = catalog
         self.config = config or ExecConfig()
+        # prepared-plan cache: repeated executions of the same SQL reuse the
+        # plan objects and therefore every per-node compiled XLA program
+        # (Presto analog: ExpressionCompiler/PageFunctionCompiler caches).
+        # Plans with scalar subqueries mutate during param binding → not
+        # cacheable.
+        self._plan_cache = {}
 
     def plan(self, sql: str) -> QueryPlan:
-        return optimize(plan_query(sql, self.catalog))
+        qp = self._plan_cache.get(sql)
+        if qp is not None:
+            return qp
+        qp = optimize(plan_query(sql, self.catalog))
+        if not qp.scalar_subqueries:
+            self._plan_cache[sql] = qp
+        return qp
 
     def explain(self, sql: str) -> str:
         return plan_to_string(self.plan(sql).root)
@@ -36,3 +48,14 @@ class LocalRunner:
     def run(self, sql: str):
         """Execute and return a pandas DataFrame (host materialization)."""
         return self.run_batch(sql).to_pandas()
+
+    def explain_analyze(self, sql: str) -> str:
+        """Execute with per-operator stats and render the annotated plan
+        (reference: EXPLAIN ANALYZE via ExplainAnalyzeOperator)."""
+        import dataclasses as _dc
+
+        qp = self.plan(sql)
+        cfg = _dc.replace(self.config, collect_stats=True)
+        ctx = ExecContext(self.catalog, cfg)
+        run_plan(qp, ctx)
+        return plan_to_string(qp.root, node_stats=ctx.node_stats)

@@ -69,6 +69,20 @@ class ExecConfig:
     topn_slack: int = 4
     join_out_capacity: Optional[int] = None  # default: probe batch capacity
     max_growth_retries: int = 24
+    # EXPLAIN ANALYZE: per-operator wall/rows/batches accounting (forces a
+    # device sync per batch — off in production, like Presto's verbose stats)
+    collect_stats: bool = False
+
+
+def _node_jit(node: PlanNode, key: str, builder, **jit_kwargs):
+    """Per-plan-node memoized jit compilation (the analog of Presto's
+    codegen class cache: ExpressionCompiler's generated classes are cached
+    and reused across executions of the same plan). Executing a cached
+    QueryPlan twice reuses every compiled XLA program."""
+    cache = node.__dict__.setdefault("_jit_cache", {})
+    if key not in cache:
+        cache[key] = jax.jit(builder(), **jit_kwargs)
+    return cache[key]
 
 
 class ExecContext:
@@ -76,6 +90,17 @@ class ExecContext:
         self.catalog = catalog
         self.config = config
         self.stats: Dict[str, float] = {}
+        # per-plan-node OperatorStats analog (keyed by id(node)):
+        # {"rows": ..., "batches": ..., "wall_s": ...}
+        self.node_stats: Dict[int, Dict[str, float]] = {}
+
+    def record(self, node, rows: int, wall_s: float):
+        s = self.node_stats.setdefault(
+            id(node), {"rows": 0, "batches": 0, "wall_s": 0.0}
+        )
+        s["rows"] += rows
+        s["batches"] += 1
+        s["wall_s"] += wall_s
 
 
 # ---------------------------------------------------------------------------
@@ -85,7 +110,12 @@ class ExecContext:
 def collapse_chain(node: PlanNode) -> Tuple[PlanNode, Callable[[Batch], Batch]]:
     """Peel Filter/Project off `node` until a breaker; return (base, fn)
     where fn applies the whole chain at trace time (so it fuses into
-    whatever jit program calls it)."""
+    whatever jit program calls it). Memoized per node so repeated
+    executions of a cached plan reuse the same function objects (and hence
+    every jit trace)."""
+    memo = node.__dict__.get("_collapsed")
+    if memo is not None:
+        return memo
     steps: List[Callable[[Batch], Batch]] = []
     cur = node
     while True:
@@ -123,16 +153,18 @@ def collapse_chain(node: PlanNode) -> Tuple[PlanNode, Callable[[Batch], Batch]]:
             break
 
     if not steps:
-        return cur, None
+        result = (cur, None)
+    else:
+        steps.reverse()
 
-    steps.reverse()
+        def chain(b: Batch) -> Batch:
+            for s in steps:
+                b = s(b)
+            return b
 
-    def chain(b: Batch) -> Batch:
-        for s in steps:
-            b = s(b)
-        return b
-
-    return cur, chain
+        result = (cur, chain)
+    node.__dict__["_collapsed"] = result
+    return result
 
 
 # ---------------------------------------------------------------------------
@@ -146,19 +178,40 @@ def execute_node(node: PlanNode, ctx: ExecContext) -> Iterator[Batch]:
     via _fused_child."""
     base, down = collapse_chain(node)
     stream = _execute_base(base, ctx)
+    if ctx.config.collect_stats:
+        stream = _instrumented(stream, base, ctx)
     if down is None:
         yield from stream
     else:
-        jfn = jax.jit(down)
+        jfn = _node_jit(node, "down", lambda: down)
         for b in stream:
             yield jfn(b)
+
+
+def _instrumented(stream: Iterator[Batch], node: PlanNode, ctx: ExecContext):
+    """OperatorStats collection (reference: OperationTimer stamping every
+    addInput/getOutput into OperatorStats, Driver.java:277)."""
+    import time as _time
+
+    while True:
+        t0 = _time.perf_counter()
+        try:
+            b = next(stream)
+        except StopIteration:
+            return
+        rows = int(jnp.sum(b.live))  # forces device sync
+        ctx.record(node, rows, _time.perf_counter() - t0)
+        yield b
 
 
 def _fused_child(node: PlanNode, ctx: ExecContext):
     """(raw input stream, chain-to-apply-inside-your-jit) for a breaker's
     child — the ScanFilterAndProject fusion point."""
     base, up = collapse_chain(node)
-    return _execute_base(base, ctx), (up or (lambda b: b))
+    stream = _execute_base(base, ctx)
+    if ctx.config.collect_stats:
+        stream = _instrumented(stream, base, ctx)
+    return stream, (up or (lambda b: b))
 
 
 def _execute_base(base: PlanNode, ctx: ExecContext) -> Iterator[Batch]:
@@ -179,7 +232,7 @@ def _execute_base(base: PlanNode, ctx: ExecContext) -> Iterator[Batch]:
         return
     if isinstance(base, Limit):
         remaining = base.count
-        jlimit = jax.jit(limit_batch)  # `n` traced: one compile per shape
+        jlimit = _JIT_LIMIT  # `n` traced: one compile per shape
         for b in execute_node(base.child, ctx):
             out = jlimit(b, remaining)
             n = out.num_live()
@@ -218,9 +271,35 @@ def _scan_batches(scan: TableScan, ctx: ExecContext) -> Iterator[Batch]:
                 return
         return
     cap = round_up_capacity(min(nrows, ctx.config.batch_rows) or 1)
-    for split in conn.splits(handle, nsplits):
+    splits = conn.splits(handle, nsplits)
+    if scan.constraints and hasattr(conn, "prune_splits"):
+        storage_bounds = _constraints_to_storage(scan, handle)
+        if storage_bounds:
+            before = len(splits)
+            splits = conn.prune_splits(handle, splits, storage_bounds)
+            ctx.stats[f"scan.{scan.table}.splits_pruned"] = before - len(splits)
+    for split in splits:
         b = conn.read_split(split, columns, capacity=cap)
         yield b.rename(symbols)
+
+
+def _constraints_to_storage(scan: TableScan, handle):
+    """Engine-level (lo, hi) bounds → the connector's storage value domain
+    (dates become datetime.date for parquet date32 statistics)."""
+    import datetime
+
+    col_types = {c.name: c.type for c in handle.columns}
+    out = {}
+    for col, (lo, hi) in scan.constraints.items():
+        t = col_types.get(col)
+        if t is None:
+            continue
+        if t.name == "date":
+            conv = lambda d: None if d is None else datetime.date.fromordinal(719163 + int(d))
+            out[col] = (conv(lo), conv(hi))
+        else:
+            out[col] = (lo, hi)
+    return out
 
 
 # -- aggregation ------------------------------------------------------------
@@ -328,12 +407,8 @@ def _execute_aggregate(node: Aggregate, ctx: ExecContext) -> Iterator[Batch]:
         out = Batch(names, types, cols, out_live, dicts)
         return out, n_groups
 
-    jit_step = jax.jit(
-        lambda acc, b, cap: merge_step(acc, b, cap), static_argnums=(2,)
-    )
-    jit_step0 = jax.jit(
-        lambda b, cap: merge_step(None, b, cap), static_argnums=(1,)
-    )
+    jit_step = _node_jit(node, "step", lambda: (lambda acc, b, cap: merge_step(acc, b, cap)), static_argnums=(2,))
+    jit_step0 = _node_jit(node, "step0", lambda: (lambda b, cap: merge_step(None, b, cap)), static_argnums=(1,))
 
     cap = ctx.config.agg_capacity
     acc: Optional[Batch] = None
@@ -422,16 +497,48 @@ def _finalize_aggregate(node, acc, layout, key_syms, key_types, state_types, in_
                 cols.append(c)
             names.append(a.symbol)
             types.append(a.type)
-        return Batch(names, types, cols, acc.live, acc.dicts)
+        live = acc.live
+        if not key_syms:
+            # SQL: global aggregation yields exactly one row even when every
+            # input row was filtered out (count=0, sums NULL)
+            live = live.at[0].set(True)
+        return Batch(names, types, cols, live, acc.dicts)
 
-    out = jax.jit(finalize)(acc)
-    if not key_syms:
-        # global aggregation over non-empty stream produced exactly one group
-        pass
-    return out
+    return _node_jit(node, "finalize", lambda: finalize)(acc)
 
 
 # -- joins ------------------------------------------------------------------
+
+
+def _cat_batches(bs: List[Batch]) -> Batch:
+    names = bs[0].names
+    types = bs[0].types
+    cols = []
+    for i in range(len(names)):
+        vals = jnp.concatenate([b.columns[i].values for b in bs])
+        if any(b.columns[i].validity is not None for b in bs):
+            valid = jnp.concatenate(
+                [
+                    b.columns[i].validity
+                    if b.columns[i].validity is not None
+                    else jnp.ones(b.capacity, bool)
+                    for b in bs
+                ]
+            )
+        else:
+            valid = None
+        cols.append(Column(vals, valid))
+    live = jnp.concatenate([b.live for b in bs])
+    dicts = {}
+    for b in bs:
+        dicts.update(b.dicts)
+    return Batch(names, types, cols, live, dicts)
+
+
+# module-level jit wrappers: trace caches persist across queries
+_JIT_CAT = jax.jit(_cat_batches)
+_JIT_COMPACT = jax.jit(compact)
+_JIT_LIMIT = jax.jit(limit_batch)
 
 
 def _collect_concat(stream: Iterator[Batch]) -> Optional[Batch]:
@@ -440,32 +547,7 @@ def _collect_concat(stream: Iterator[Batch]) -> Optional[Batch]:
         return None
     if len(batches) == 1:
         return batches[0]
-
-    def cat(bs: List[Batch]) -> Batch:
-        names = bs[0].names
-        types = bs[0].types
-        cols = []
-        for i in range(len(names)):
-            vals = jnp.concatenate([b.columns[i].values for b in bs])
-            if any(b.columns[i].validity is not None for b in bs):
-                valid = jnp.concatenate(
-                    [
-                        b.columns[i].validity
-                        if b.columns[i].validity is not None
-                        else jnp.ones(b.capacity, bool)
-                        for b in bs
-                    ]
-                )
-            else:
-                valid = None
-            cols.append(Column(vals, valid))
-        live = jnp.concatenate([b.live for b in bs])
-        dicts = {}
-        for b in bs:
-            dicts.update(b.dicts)
-        return Batch(names, types, cols, live, dicts)
-
-    return jax.jit(cat)(batches)
+    return _JIT_CAT(batches)
 
 
 def _execute_join(node: HashJoin, ctx: ExecContext) -> Iterator[Batch]:
@@ -485,7 +567,7 @@ def _execute_join(node: HashJoin, ctx: ExecContext) -> Iterator[Batch]:
             {},
         )
 
-    table = jax.jit(build_side, static_argnames=("key_names",))(
+    table = _node_jit(node, "build", lambda: build_side, static_argnames=("key_names",))(
         build_in, tuple(node.right_keys)
     )
 
@@ -510,7 +592,7 @@ def _execute_join(node: HashJoin, ctx: ExecContext) -> Iterator[Batch]:
                     cols[i] = Column(c.values, valid & matched)
             return Batch(out.names, out.types, cols, out.live, out.dicts)
 
-        jfn = jax.jit(probe_fn)
+        jfn = _node_jit(node, "probe", lambda: probe_fn)
         for pb in probe_stream:
             yield jfn(table, pb)
         return
@@ -524,9 +606,12 @@ def _execute_join(node: HashJoin, ctx: ExecContext) -> Iterator[Batch]:
         pba = align_probe_strings(pb, tuple(node.left_keys), table, tuple(node.right_keys))
         return pb, pba
 
-    chain_j = jax.jit(chain_align)
-    counts_fn = jax.jit(
-        lambda t, pba: probe_counts(t, pba, tuple(node.left_keys), tuple(node.right_keys))
+    chain_j = _node_jit(node, "chain_align", lambda: chain_align)
+    counts_fn = _node_jit(
+        node, "counts",
+        lambda: lambda t, pba: probe_counts(
+            t, pba, tuple(node.left_keys), tuple(node.right_keys)
+        ),
     )
 
     def expand_fn(t, pb, pba, lo, counts, offsets, base, out_cap):
@@ -556,8 +641,8 @@ def _execute_join(node: HashJoin, ctx: ExecContext) -> Iterator[Batch]:
                 cols[i] = Column(cols[i].values, jnp.zeros(out.capacity, bool))
         return Batch(out.names, out.types, cols, out.live, out.dicts)
 
-    jexpand = jax.jit(expand_fn, static_argnames=("out_cap",))
-    jnull = jax.jit(null_extend_fn)
+    jexpand = _node_jit(node, "expand", lambda: expand_fn, static_argnames=("out_cap",))
+    jnull = _node_jit(node, "null_extend", lambda: null_extend_fn)
     for pb_raw in probe_stream:
         pb, pba = chain_j(pb_raw)
         lo, counts, offsets, total, _ = counts_fn(table, pba)
@@ -581,7 +666,7 @@ def _execute_semijoin(node: SemiJoin, ctx: ExecContext) -> Iterator[Batch]:
     probe_stream, chain = _fused_child(node.left, ctx)
     lsym, rsym = node.left_key, node.right_key
     if right_in is None:
-        jfn = jax.jit(chain)
+        jfn = _node_jit(node, "chain", lambda: chain)
         for pb in probe_stream:
             b = jfn(pb)
             if node.negated:
@@ -599,7 +684,7 @@ def _execute_semijoin(node: SemiJoin, ctx: ExecContext) -> Iterator[Batch]:
                    out_live, b.dicts)
         return build_side(db, (rsym,))
 
-    table = jax.jit(dedup_build)(right_in)
+    table = _node_jit(node, "dedup_build", lambda: dedup_build)(right_in)
 
     def probe_fn(t, pb: Batch):
         b = chain(pb)
@@ -615,7 +700,7 @@ def _execute_semijoin(node: SemiJoin, ctx: ExecContext) -> Iterator[Batch]:
             return b.with_live(b.live & keep)
         return b.with_live(b.live & matched)
 
-    jfn = jax.jit(probe_fn)
+    jfn = _node_jit(node, "probe", lambda: probe_fn)
     for pb in probe_stream:
         yield jfn(table, pb)
 
@@ -646,18 +731,18 @@ def _execute_sort(node: Sort, ctx: ExecContext) -> Iterator[Batch]:
             out = sort_batch(merged, _sort_keys(node, merged), limit=node.limit)
             return _truncate(out, cap)
 
-        jstep = jax.jit(topn_step)
+        jstep = _node_jit(node, "topn", lambda: topn_step)
         for raw in in_stream:
             acc = jstep(acc, raw)
         if acc is not None:
             yield acc
         return
 
-    jchain = jax.jit(chain)
+    jchain = _node_jit(node, "chain", lambda: chain)
     full = _collect_concat(jchain(b) for b in in_stream)
     if full is None:
         return
-    yield jax.jit(lambda b: sort_batch(b, _sort_keys(node, b)))(full)
+    yield _node_jit(node, "sort", lambda: (lambda b: sort_batch(b, _sort_keys(node, b))))(full)
 
 
 def _concat2(a: Batch, b: Batch) -> Batch:
@@ -721,7 +806,7 @@ def run_plan(qp: QueryPlan, ctx: ExecContext) -> Batch:
             {},
         )
     merged = merged.select(out_node.symbols).rename(out_node.names)
-    return jax.jit(compact)(merged)
+    return _JIT_COMPACT(merged)
 
 
 def _bind_plan_params(node: PlanNode, bindings):

@@ -581,6 +581,10 @@ class Planner:
         if q.from_ is None:
             raise AnalysisError("SELECT without FROM not supported")
 
+        from presto_tpu.plan.decorrelate import decorrelate
+
+        q = decorrelate(q, self.catalog, self.ctes)
+
         rp = self.plan_relation(q.from_)
 
         # WHERE: analyze conjuncts; subquery predicates become semi-joins

@@ -1,0 +1,277 @@
+"""Subquery decorrelation — AST→AST rewrites applied before planning.
+
+The reference implements decorrelation as plan rewrites
+(TransformCorrelatedScalarAggregationToJoin, TransformExistsApplyToLateralNode,
+PlanNodeDecorrelator under sql/planner/optimizations + iterative/rule).
+Here the classic cases are rewritten at the AST level, which composes with
+the existing planner without an Apply/Lateral node:
+
+1. [NOT] EXISTS (SELECT ... FROM t WHERE outer = inner AND rest)
+     → outer [NOT] IN (SELECT inner FROM t WHERE rest)          (Q4, Q21-lite)
+
+2. expr CMP (SELECT agg(x) FROM t WHERE inner = outer [AND rest])   (Q2, Q17)
+     → join a grouped derived table on the correlation key:
+       FROM ..., (SELECT inner AS __ck, agg(x) AS __agg FROM t
+                  [WHERE rest] GROUP BY inner) __dtN
+       WHERE __dtN.__ck = outer AND expr CMP __dtN.__agg
+   (valid in WHERE position: an empty subquery yields NULL which fails the
+   comparison, exactly like the dropped row of the inner join)
+
+Correlation detection is name-based: a column referenced in the subquery
+that does not resolve against the subquery's own FROM (via catalog schemas)
+is an outer reference.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Set, Tuple
+
+from presto_tpu.connector import Catalog
+from presto_tpu.sql import ast
+
+
+def _relation_columns(rel, catalog: Catalog, ctes: Dict[str, ast.Query]) -> Set[str]:
+    """Column names visible from a FROM tree (unqualified)."""
+    if rel is None:
+        return set()
+    if isinstance(rel, ast.Table):
+        name = rel.name[-1]
+        if len(rel.name) == 1 and name in ctes:
+            sub = ctes[name]
+            out = set()
+            for it in sub.select:
+                if it.alias:
+                    out.add(it.alias)
+                elif isinstance(it.expr, ast.Identifier):
+                    out.add(it.expr.parts[-1])
+            return out
+        try:
+            _, handle = catalog.resolve(rel.name)
+        except KeyError:
+            return set()
+        return {c.name for c in handle.columns}
+    if isinstance(rel, ast.SubqueryRelation):
+        out = set()
+        for it in rel.query.select:
+            if it.alias:
+                out.add(it.alias)
+            elif isinstance(it.expr, ast.Identifier):
+                out.add(it.expr.parts[-1])
+        return out
+    if isinstance(rel, ast.Join):
+        return _relation_columns(rel.left, catalog, ctes) | _relation_columns(
+            rel.right, catalog, ctes
+        )
+    return set()
+
+
+def _split_conjuncts(e) -> List:
+    if isinstance(e, ast.BinaryOp) and e.op == "and":
+        return _split_conjuncts(e.left) + _split_conjuncts(e.right)
+    return [e]
+
+
+def _combine(es: List) -> Optional[ast.Node]:
+    if not es:
+        return None
+    out = es[0]
+    for e in es[1:]:
+        out = ast.BinaryOp("and", out, e)
+    return out
+
+
+def _factor_or(c) -> List:
+    """(a AND x) OR (a AND y) → a AND (x OR y). Returns conjunct list."""
+    if not (isinstance(c, ast.BinaryOp) and c.op == "or"):
+        return [c]
+
+    def branches(n):
+        if isinstance(n, ast.BinaryOp) and n.op == "or":
+            return branches(n.left) + branches(n.right)
+        return [n]
+
+    from presto_tpu.plan.builder import ast_key
+
+    brs = [_split_conjuncts(b) for b in branches(c)]
+    if len(brs) < 2:
+        return [c]
+    common_keys = set(ast_key(x) for x in brs[0])
+    for b in brs[1:]:
+        common_keys &= {ast_key(x) for x in b}
+    if not common_keys:
+        return [c]
+    hoisted = [x for x in brs[0] if ast_key(x) in common_keys]
+    residual_branches = []
+    for b in brs:
+        rest = [x for x in b if ast_key(x) not in common_keys]
+        if not rest:
+            # a branch fully covered by the common part → OR is implied true
+            residual_branches = None
+            break
+        residual_branches.append(_combine(rest))
+    out = list(hoisted)
+    if residual_branches is not None:
+        orr = residual_branches[0]
+        for b in residual_branches[1:]:
+            orr = ast.BinaryOp("or", orr, b)
+        out.append(orr)
+    return out
+
+
+def _find_correlation(
+    sub: ast.Query, catalog: Catalog, ctes: Dict[str, ast.Query]
+) -> Optional[Tuple[ast.Identifier, ast.Identifier, List]]:
+    """If sub's WHERE contains exactly one `inner_col = outer_col` conjunct
+    (one side resolving in sub's FROM, the other not), return
+    (outer_ident, inner_ident, remaining_conjuncts)."""
+    if sub.where is None:
+        return None
+    inner_cols = _relation_columns(sub.from_, catalog, ctes)
+    conjs = _split_conjuncts(sub.where)
+    corr = None
+    rest = []
+    for c in conjs:
+        if (
+            corr is None
+            and isinstance(c, ast.BinaryOp)
+            and c.op == "eq"
+            and isinstance(c.left, ast.Identifier)
+            and isinstance(c.right, ast.Identifier)
+        ):
+            l_in = c.left.parts[-1] in inner_cols and len(c.left.parts) == 1
+            r_in = c.right.parts[-1] in inner_cols and len(c.right.parts) == 1
+            if l_in and not r_in:
+                corr = (c.right, c.left)
+                continue
+            if r_in and not l_in:
+                corr = (c.left, c.right)
+                continue
+        rest.append(c)
+    if corr is None:
+        return None
+    # any remaining outer references → too correlated for these rewrites
+    outer_refs = set()
+
+    def scan(n):
+        if isinstance(n, ast.Identifier) and len(n.parts) == 1:
+            if n.parts[0] not in inner_cols:
+                outer_refs.add(n.parts[0])
+        for ch in _children(n):
+            scan(ch)
+
+    for c in rest:
+        scan(c)
+    for it in sub.select:
+        scan(it.expr)
+    if outer_refs:
+        return None
+    return corr[0], corr[1], rest
+
+
+def _children(n):
+    from presto_tpu.plan.builder import _ast_children
+
+    return _ast_children(n)
+
+
+class Decorrelator:
+    def __init__(self, catalog: Catalog, ctes: Dict[str, ast.Query]):
+        self.catalog = catalog
+        self.ctes = ctes
+        self.derived: List[ast.Join] = []  # pending joins to graft onto FROM
+        self.counter = 0
+
+    def rewrite_where(self, q: ast.Query) -> None:
+        """Rewrite EXISTS and correlated scalar subqueries in q.where;
+        grafts derived-table joins onto q.from_."""
+        if q.where is None:
+            return
+        conjs = _split_conjuncts(q.where)
+        # OR factoring: hoist conjuncts common to every OR branch
+        # (ExtractCommonPredicatesExpressionRewriter analog) — unlocks the
+        # Q19 shape where the equi-join conjunct lives inside each branch
+        expanded = []
+        for c in conjs:
+            expanded.extend(_factor_or(c))
+        conjs = expanded
+        out = []
+        for c in conjs:
+            out.append(self._rewrite_conjunct(c))
+        # graft derived tables as cross joins + WHERE equi-conjuncts so the
+        # planner's comma-join assembly orders them with everything else
+        for dt, cond in self._pending:
+            q.from_ = ast.Join("cross", q.from_, dt, None)
+            out.append(cond)
+        q.where = _combine(out)
+
+    _pending: List
+
+    def _rewrite_conjunct(self, c):
+        self._pending = getattr(self, "_pending", [])
+        # EXISTS → IN
+        if isinstance(c, ast.Exists):
+            corr = _find_correlation(c.query, self.catalog, self.ctes)
+            if corr is None:
+                return c
+            outer, inner, rest = corr
+            sub = ast.Query(
+                select=[ast.SelectItem(inner, None)],
+                from_=c.query.from_,
+                where=_combine(rest),
+            )
+            sub.ctes = c.query.ctes
+            return ast.InSubquery(outer, sub, negated=c.negated)
+        # comparisons containing correlated scalar aggregates
+        if isinstance(c, ast.BinaryOp) and c.op in ("eq", "ne", "lt", "le", "gt", "ge"):
+            c.left = self._rewrite_scalar(c.left)
+            c.right = self._rewrite_scalar(c.right)
+        return c
+
+    def _rewrite_scalar(self, e):
+        """Replace a correlated scalar-aggregate subquery inside an
+        expression with a reference into a grouped derived table."""
+        if isinstance(e, ast.ScalarSubquery):
+            from presto_tpu.plan.builder import _contains_agg
+
+            sub = e.query
+            if (
+                sub.group_by
+                or len(sub.select) != 1
+                or not _contains_agg(sub.select[0].expr)
+            ):
+                return e
+            corr = _find_correlation(sub, self.catalog, self.ctes)
+            if corr is None:
+                return e  # uncorrelated: handled as a Param at plan time
+            outer, inner, rest = corr
+            self.counter += 1
+            alias = f"__dt{self.counter}"
+            dq = ast.Query(
+                select=[
+                    ast.SelectItem(inner, "__ck"),
+                    ast.SelectItem(sub.select[0].expr, "__agg"),
+                ],
+                from_=sub.from_,
+                where=_combine(rest),
+                group_by=[inner],
+            )
+            dq.ctes = sub.ctes
+            dt = ast.SubqueryRelation(dq, alias)
+            cond = ast.BinaryOp("eq", ast.Identifier((alias, "__ck")), outer)
+            self._pending.append((dt, cond))
+            return ast.Identifier((alias, "__agg"))
+        if isinstance(e, ast.BinaryOp):
+            e.left = self._rewrite_scalar(e.left)
+            e.right = self._rewrite_scalar(e.right)
+        if isinstance(e, ast.UnaryOp):
+            e.operand = self._rewrite_scalar(e.operand)
+        return e
+
+
+def decorrelate(q: ast.Query, catalog: Catalog, ctes: Dict[str, ast.Query]) -> ast.Query:
+    d = Decorrelator(catalog, dict(ctes))
+    for name, sub in q.ctes:
+        d.ctes[name] = sub
+    d._pending = []
+    d.rewrite_where(q)
+    return q

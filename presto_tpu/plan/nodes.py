@@ -34,6 +34,9 @@ class TableScan(PlanNode):
     # symbol -> source column name
     assignments: Dict[str, str] = dataclasses.field(default_factory=dict)
     output: List[Tuple[str, Type]] = dataclasses.field(default_factory=list)
+    # column-name-keyed (lo, hi) bounds derived from filters above this scan
+    # (TupleDomain pushdown; connectors use them to prune splits/row-groups)
+    constraints: Dict[str, tuple] = dataclasses.field(default_factory=dict)
 
 
 @dataclasses.dataclass
@@ -187,8 +190,10 @@ class QueryPlan:
     scalar_subqueries: Dict[str, "QueryPlan"] = dataclasses.field(default_factory=dict)
 
 
-def plan_to_string(node: PlanNode, indent: int = 0) -> str:
-    """EXPLAIN-style rendering (reference: sql/planner/planPrinter)."""
+def plan_to_string(node: PlanNode, indent: int = 0, node_stats=None) -> str:
+    """EXPLAIN-style rendering (reference: sql/planner/planPrinter); with
+    node_stats, renders EXPLAIN ANALYZE-style per-operator output rows /
+    batches / wall time (ExplainAnalyzeOperator analog)."""
     pad = "  " * indent
     if isinstance(node, TableScan):
         cols = ", ".join(f"{s}:={c}" for s, c in node.assignments.items())
@@ -213,4 +218,10 @@ def plan_to_string(node: PlanNode, indent: int = 0) -> str:
         s = f"{pad}Output[{', '.join(node.names)}]"
     else:
         s = f"{pad}{type(node).__name__}"
-    return s + "".join("\n" + plan_to_string(c, indent + 1) for c in node.children())
+    if node_stats and id(node) in node_stats:
+        st = node_stats[id(node)]
+        s += (f"   [rows={int(st['rows'])}, batches={int(st['batches'])}, "
+              f"wall={st['wall_s']*1000:.1f}ms]")
+    return s + "".join(
+        "\n" + plan_to_string(c, indent + 1, node_stats) for c in node.children()
+    )

@@ -144,8 +144,49 @@ def _push_into(node: PlanNode, conjs: List[RowExpression]) -> PlanNode:
         node.child = push_filters(node.child)
         return Filter(node, _combine(conjs))
     # TableScan and everything else: stop here
+    if isinstance(node, TableScan):
+        _derive_scan_constraints(node, conjs)
     node2 = push_filters(node) if node.children() else node
     return Filter(node2, _combine(conjs))
+
+
+def _derive_scan_constraints(scan: TableScan, conjs: List[RowExpression]):
+    """Extract per-column (lo, hi) bounds from simple comparison conjuncts
+    for connector split pruning (coarse TupleDomain pushdown — the IO-level
+    slice of the reference's selective-reader filter pushdown). The exact
+    filter still runs on-device; this only skips row groups."""
+    from presto_tpu.expr.ir import Constant
+
+    sym_to_col = {s: c for s, c in scan.assignments.items()}
+    for c in conjs:
+        if not (isinstance(c, Call) and c.fn in ("lt", "le", "gt", "ge", "eq")):
+            continue
+        a, b = c.args
+        if isinstance(a, InputRef) and isinstance(b, Constant) and b.value is not None:
+            ref, const, op = a, b, c.fn
+        elif isinstance(b, InputRef) and isinstance(a, Constant) and a.value is not None:
+            flip = {"lt": "gt", "le": "ge", "gt": "lt", "ge": "le", "eq": "eq"}
+            ref, const, op = b, a, flip[c.fn]
+        else:
+            continue
+        if ref.name not in sym_to_col or const.type.is_string:
+            continue
+        col = sym_to_col[ref.name]
+        lo, hi = scan.constraints.get(col, (None, None))
+        v = const.value
+        t = const.type
+        from presto_tpu.types import DecimalType as _Dec
+
+        if isinstance(t, _Dec) and not const.raw:
+            v = int(round(float(v) * 10 ** t.scale))
+        if op in ("gt", "ge"):
+            lo = v if lo is None else max(lo, v)
+        elif op in ("lt", "le"):
+            hi = v if hi is None else min(hi, v)
+        else:  # eq
+            lo = v if lo is None else max(lo, v)
+            hi = v if hi is None else min(hi, v)
+        scan.constraints[col] = (lo, hi)
 
 
 # ---------------------------------------------------------------------------

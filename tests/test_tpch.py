@@ -316,3 +316,385 @@ def test_referential_integrity(tables):
     tot = (
         (li.l_extendedprice * (1 - li.l_discount) * (1 + li.l_tax) * 10000 + 0.5).astype(np.int64)
     )
+
+
+def test_q2(runner, tables, frames_match):
+    got = runner.run(
+        """
+        select s_acctbal, s_name, n_name, p_partkey, p_mfgr
+        from part, supplier, partsupp, nation, region
+        where p_partkey = ps_partkey and s_suppkey = ps_suppkey
+          and p_size = 15 and n_regionkey = r_regionkey
+          and s_nationkey = n_nationkey and r_name = 'EUROPE'
+          and ps_supplycost = (
+            select min(ps_supplycost) from partsupp, supplier, nation, region
+            where p_partkey = ps_partkey and s_suppkey = ps_suppkey
+              and s_nationkey = n_nationkey and n_regionkey = r_regionkey
+              and r_name = 'EUROPE')
+        order by s_acctbal desc, n_name, s_name, p_partkey
+        limit 100
+        """
+    )
+    t = tables
+    base = (
+        t["partsupp"]
+        .merge(t["supplier"], left_on="ps_suppkey", right_on="s_suppkey")
+        .merge(t["nation"], left_on="s_nationkey", right_on="n_nationkey")
+        .merge(t["region"], left_on="n_regionkey", right_on="r_regionkey")
+    )
+    eur = base[base.r_name == "EUROPE"]
+    min_cost = eur.groupby("ps_partkey").ps_supplycost.min()
+    m = eur.merge(t["part"][t["part"].p_size == 15], left_on="ps_partkey", right_on="p_partkey")
+    m = m[m.ps_supplycost == min_cost.reindex(m.ps_partkey).values]
+    exp = (
+        m.sort_values(["s_acctbal", "n_name", "s_name", "p_partkey"],
+                      ascending=[False, True, True, True])
+        .head(100)[["s_acctbal", "s_name", "n_name", "p_partkey", "p_mfgr"]]
+        .reset_index(drop=True)
+    )
+    frames_match(got, exp, rtol=1e-9, check_order=True)
+
+
+def test_q4(runner, tables, frames_match):
+    got = runner.run(
+        """
+        select o_orderpriority, count(*) as order_count from orders
+        where o_orderdate >= date '1993-07-01' and o_orderdate < date '1993-10-01'
+          and exists (select * from lineitem
+                      where l_orderkey = o_orderkey and l_commitdate < l_receiptdate)
+        group by o_orderpriority order by o_orderpriority
+        """
+    )
+    o, li = tables["orders"], tables["lineitem"]
+    keys = set(li[li.l_commitdate < li.l_receiptdate].l_orderkey)
+    m = o[
+        (o.o_orderdate >= _d("1993-07-01"))
+        & (o.o_orderdate < _d("1993-10-01"))
+        & o.o_orderkey.isin(keys)
+    ]
+    exp = m.groupby("o_orderpriority").size().reset_index(name="order_count")
+    frames_match(got, exp, check_order=True)
+
+
+def test_q10(runner, tables, frames_match):
+    got = runner.run(
+        """
+        select c_custkey, c_name, sum(l_extendedprice * (1 - l_discount)) as revenue,
+               c_acctbal, n_name
+        from customer, orders, lineitem, nation
+        where c_custkey = o_custkey and l_orderkey = o_orderkey
+          and o_orderdate >= date '1993-10-01' and o_orderdate < date '1994-01-01'
+          and l_returnflag = 'R' and c_nationkey = n_nationkey
+        group by c_custkey, c_name, c_acctbal, n_name
+        order by revenue desc limit 20
+        """
+    )
+    t = tables
+    m = (
+        t["lineitem"][t["lineitem"].l_returnflag == "R"]
+        .merge(t["orders"], left_on="l_orderkey", right_on="o_orderkey")
+        .merge(t["customer"], left_on="o_custkey", right_on="c_custkey")
+        .merge(t["nation"], left_on="c_nationkey", right_on="n_nationkey")
+    )
+    m = m[(m.o_orderdate >= _d("1993-10-01")) & (m.o_orderdate < _d("1994-01-01"))]
+    m = m.assign(rev=m.l_extendedprice * (1 - m.l_discount))
+    exp = (
+        m.groupby(["c_custkey", "c_name", "c_acctbal", "n_name"])
+        .agg(revenue=("rev", "sum")).reset_index()
+        .sort_values("revenue", ascending=False).head(20)
+        [["c_custkey", "c_name", "revenue", "c_acctbal", "n_name"]]
+        .reset_index(drop=True)
+    )
+    frames_match(got, exp, rtol=1e-9, check_order=True)
+
+
+def test_q11(runner, tables, frames_match):
+    got = runner.run(
+        """
+        select ps_partkey, sum(ps_supplycost * ps_availqty) as value
+        from partsupp, supplier, nation
+        where ps_suppkey = s_suppkey and s_nationkey = n_nationkey
+          and n_name = 'GERMANY'
+        group by ps_partkey
+        having sum(ps_supplycost * ps_availqty) > (
+          select sum(ps_supplycost * ps_availqty) * 0.0005
+          from partsupp, supplier, nation
+          where ps_suppkey = s_suppkey and s_nationkey = n_nationkey
+            and n_name = 'GERMANY')
+        order by value desc
+        """
+    )
+    t = tables
+    m = (
+        t["partsupp"]
+        .merge(t["supplier"], left_on="ps_suppkey", right_on="s_suppkey")
+        .merge(t["nation"], left_on="s_nationkey", right_on="n_nationkey")
+    )
+    m = m[m.n_name == "GERMANY"].assign(v=lambda d: d.ps_supplycost * d.ps_availqty)
+    g = m.groupby("ps_partkey").v.sum()
+    thresh = m.v.sum() * 0.0005
+    exp = (
+        g[g > thresh].reset_index().rename(columns={"v": "value"})
+        .sort_values("value", ascending=False).reset_index(drop=True)
+    )
+    frames_match(got, exp, rtol=1e-9, check_order=True)
+
+
+def test_q13(runner, tables, frames_match):
+    got = runner.run(
+        """
+        select c_count, count(*) as custdist from (
+          select c_custkey, count(o_orderkey) as c_count
+          from customer left join orders
+            on c_custkey = o_custkey and o_comment not like '%comment 1%'
+          group by c_custkey
+        ) c_orders
+        group by c_count
+        order by custdist desc, c_count desc
+        """
+    )
+    t = tables
+    o = t["orders"][~t["orders"].o_comment.str.contains("comment 1", regex=False)]
+    m = t["customer"].merge(o, left_on="c_custkey", right_on="o_custkey", how="left")
+    cc = m.groupby("c_custkey").o_orderkey.count().reset_index(name="c_count")
+    exp = (
+        cc.groupby("c_count").size().reset_index(name="custdist")
+        .sort_values(["custdist", "c_count"], ascending=[False, False])
+        [["c_count", "custdist"]].reset_index(drop=True)
+    )
+    frames_match(got, exp, check_order=True)
+
+
+def test_q15(runner, tables, frames_match):
+    got = runner.run(
+        """
+        with revenue0 as (
+          select l_suppkey as supplier_no, sum(l_extendedprice * (1 - l_discount)) as total_revenue
+          from lineitem
+          where l_shipdate >= date '1996-01-01' and l_shipdate < date '1996-04-01'
+          group by l_suppkey
+        )
+        select s_suppkey, s_name, total_revenue
+        from supplier, revenue0
+        where s_suppkey = supplier_no
+          and total_revenue = (select max(total_revenue) from revenue0)
+        order by s_suppkey
+        """
+    )
+    t = tables
+    li = t["lineitem"]
+    m = li[(li.l_shipdate >= _d("1996-01-01")) & (li.l_shipdate < _d("1996-04-01"))]
+    rev = (
+        m.assign(r=m.l_extendedprice * (1 - m.l_discount))
+        .groupby("l_suppkey").r.sum()
+    )
+    best = rev[np.isclose(rev, rev.max(), rtol=1e-12)]
+    sup = t["supplier"][t["supplier"].s_suppkey.isin(best.index)]
+    exp = pd.DataFrame(
+        {
+            "s_suppkey": sup.s_suppkey.values,
+            "s_name": sup.s_name.values,
+            "total_revenue": best.reindex(sup.s_suppkey).values,
+        }
+    ).sort_values("s_suppkey").reset_index(drop=True)
+    frames_match(got, exp, rtol=1e-9, check_order=True)
+
+
+def test_q16(runner, tables, frames_match):
+    got = runner.run(
+        """
+        select p_brand, p_type, p_size, count(distinct ps_suppkey) as supplier_cnt
+        from partsupp, part
+        where p_partkey = ps_partkey and p_brand <> 'Brand#45'
+          and p_size in (49, 14, 23, 45, 19, 3, 36, 9)
+          and ps_suppkey not in (
+            select s_suppkey from supplier where s_comment like '%Customer%Complaints%')
+        group by p_brand, p_type, p_size
+        order by supplier_cnt desc, p_brand, p_type, p_size
+        """
+    )
+    t = tables
+    bad = set(
+        t["supplier"][t["supplier"].s_comment.str.contains("Customer Complaints", regex=False)].s_suppkey
+    )
+    m = t["partsupp"].merge(t["part"], left_on="ps_partkey", right_on="p_partkey")
+    m = m[
+        (m.p_brand != "Brand#45")
+        & m.p_size.isin([49, 14, 23, 45, 19, 3, 36, 9])
+        & ~m.ps_suppkey.isin(bad)
+    ]
+    exp = (
+        m.groupby(["p_brand", "p_type", "p_size"]).ps_suppkey.nunique()
+        .reset_index(name="supplier_cnt")
+        .sort_values(["supplier_cnt", "p_brand", "p_type", "p_size"],
+                     ascending=[False, True, True, True])
+        .reset_index(drop=True)
+    )
+    frames_match(got, exp, check_order=True)
+
+
+def test_q17(runner, tables, frames_match):
+    got = runner.run(
+        """
+        select sum(l_extendedprice) / 7.0 as avg_yearly from lineitem, part
+        where p_partkey = l_partkey and p_brand = 'Brand#23' and p_container = 'MED BOX'
+          and l_quantity < (select 0.2 * avg(l_quantity) from lineitem
+                            where l_partkey = p_partkey)
+        """
+    )
+    t = tables
+    li, p = t["lineitem"], t["part"]
+    pp = p[(p.p_brand == "Brand#23") & (p.p_container == "MED BOX")]
+    m = li.merge(pp, left_on="l_partkey", right_on="p_partkey")
+    avg_q = li.groupby("l_partkey").l_quantity.mean()
+    m = m[m.l_quantity < 0.2 * avg_q.reindex(m.l_partkey).values]
+    v = got.avg_yearly[0]
+    if len(m) == 0:
+        assert v is None
+    else:
+        exp_v = m.l_extendedprice.sum() / 7.0
+        assert abs(float(v) - exp_v) <= 1e-9 * max(1.0, abs(exp_v))
+
+
+def test_q19(runner, tables, frames_match):
+    got = runner.run(
+        """
+        select sum(l_extendedprice * (1 - l_discount)) as revenue
+        from lineitem, part
+        where (p_partkey = l_partkey and p_brand = 'Brand#12'
+               and p_container in ('SM CASE', 'SM BOX', 'SM PACK', 'SM PKG')
+               and l_quantity >= 1 and l_quantity <= 11 and p_size between 1 and 5
+               and l_shipmode in ('AIR', 'REG AIR')
+               and l_shipinstruct = 'DELIVER IN PERSON')
+           or (p_partkey = l_partkey and p_brand = 'Brand#23'
+               and p_container in ('MED BAG', 'MED BOX', 'MED PKG', 'MED PACK')
+               and l_quantity >= 10 and l_quantity <= 20 and p_size between 1 and 10
+               and l_shipmode in ('AIR', 'REG AIR')
+               and l_shipinstruct = 'DELIVER IN PERSON')
+           or (p_partkey = l_partkey and p_brand = 'Brand#34'
+               and p_container in ('LG CASE', 'LG BOX', 'LG PACK', 'LG PKG')
+               and l_quantity >= 20 and l_quantity <= 30 and p_size between 1 and 15
+               and l_shipmode in ('AIR', 'REG AIR')
+               and l_shipinstruct = 'DELIVER IN PERSON')
+        """
+    )
+    t = tables
+    m = t["lineitem"].merge(t["part"], left_on="l_partkey", right_on="p_partkey")
+    m = m[m.l_shipmode.isin(["AIR", "REG AIR"]) & (m.l_shipinstruct == "DELIVER IN PERSON")]
+    b1 = (
+        (m.p_brand == "Brand#12")
+        & m.p_container.isin(["SM CASE", "SM BOX", "SM PACK", "SM PKG"])
+        & m.l_quantity.between(1, 11) & m.p_size.between(1, 5)
+    )
+    b2 = (
+        (m.p_brand == "Brand#23")
+        & m.p_container.isin(["MED BAG", "MED BOX", "MED PKG", "MED PACK"])
+        & m.l_quantity.between(10, 20) & m.p_size.between(1, 10)
+    )
+    b3 = (
+        (m.p_brand == "Brand#34")
+        & m.p_container.isin(["LG CASE", "LG BOX", "LG PACK", "LG PKG"])
+        & m.l_quantity.between(20, 30) & m.p_size.between(1, 15)
+    )
+    mm = m[b1 | b2 | b3]
+    exp_v = (mm.l_extendedprice * (1 - mm.l_discount)).sum()
+    v = got.revenue[0]
+    if len(mm) == 0:
+        assert v is None
+    else:
+        assert abs(float(v) - exp_v) <= 1e-9 * max(1.0, abs(exp_v))
+
+
+def test_q7(runner, tables, frames_match):
+    got = runner.run(
+        """
+        select supp_nation, cust_nation, l_year, sum(volume) as revenue
+        from (
+          select n1.n_name as supp_nation, n2.n_name as cust_nation,
+                 year(l_shipdate) as l_year,
+                 l_extendedprice * (1 - l_discount) as volume
+          from supplier, lineitem, orders, customer, nation n1, nation n2
+          where s_suppkey = l_suppkey and o_orderkey = l_orderkey
+            and c_custkey = o_custkey and s_nationkey = n1.n_nationkey
+            and c_nationkey = n2.n_nationkey
+            and ((n1.n_name = 'FRANCE' and n2.n_name = 'GERMANY')
+                 or (n1.n_name = 'GERMANY' and n2.n_name = 'FRANCE'))
+            and l_shipdate between date '1995-01-01' and date '1996-12-31'
+        ) shipping
+        group by supp_nation, cust_nation, l_year
+        order by supp_nation, cust_nation, l_year
+        """
+    )
+    t = tables
+    n = t["nation"]
+    m = (
+        t["lineitem"]
+        .merge(t["supplier"], left_on="l_suppkey", right_on="s_suppkey")
+        .merge(t["orders"], left_on="l_orderkey", right_on="o_orderkey")
+        .merge(t["customer"], left_on="o_custkey", right_on="c_custkey")
+        .merge(n.add_prefix("s1_"), left_on="s_nationkey", right_on="s1_n_nationkey")
+        .merge(n.add_prefix("s2_"), left_on="c_nationkey", right_on="s2_n_nationkey")
+    )
+    m = m[
+        (((m.s1_n_name == "FRANCE") & (m.s2_n_name == "GERMANY"))
+         | ((m.s1_n_name == "GERMANY") & (m.s2_n_name == "FRANCE")))
+        & m.l_shipdate.between(_d("1995-01-01"), _d("1996-12-31"))
+    ]
+    m = m.assign(
+        l_year=pd.to_datetime(m.l_shipdate, unit="D").dt.year,
+        volume=m.l_extendedprice * (1 - m.l_discount),
+    )
+    exp = (
+        m.groupby(["s1_n_name", "s2_n_name", "l_year"]).volume.sum()
+        .reset_index(name="revenue")
+        .rename(columns={"s1_n_name": "supp_nation", "s2_n_name": "cust_nation"})
+        .sort_values(["supp_nation", "cust_nation", "l_year"]).reset_index(drop=True)
+    )
+    frames_match(got, exp, rtol=1e-9, check_order=True)
+
+
+def test_q8(runner, tables, frames_match):
+    got = runner.run(
+        """
+        select o_year, sum(case when nation = 'BRAZIL' then volume else 0 end) / sum(volume) as mkt_share
+        from (
+          select year(o_orderdate) as o_year,
+                 l_extendedprice * (1 - l_discount) as volume,
+                 n2.n_name as nation
+          from part, supplier, lineitem, orders, customer, nation n1, nation n2, region
+          where p_partkey = l_partkey and s_suppkey = l_suppkey
+            and l_orderkey = o_orderkey and o_custkey = c_custkey
+            and c_nationkey = n1.n_nationkey and n1.n_regionkey = r_regionkey
+            and r_name = 'AMERICA' and s_nationkey = n2.n_nationkey
+            and o_orderdate between date '1995-01-01' and date '1996-12-31'
+            and p_type = 'ECONOMY ANODIZED STEEL'
+        ) all_nations
+        group by o_year order by o_year
+        """
+    )
+    t = tables
+    n = t["nation"]
+    m = (
+        t["lineitem"]
+        .merge(t["part"][t["part"].p_type == "ECONOMY ANODIZED STEEL"],
+               left_on="l_partkey", right_on="p_partkey")
+        .merge(t["supplier"], left_on="l_suppkey", right_on="s_suppkey")
+        .merge(t["orders"], left_on="l_orderkey", right_on="o_orderkey")
+        .merge(t["customer"], left_on="o_custkey", right_on="c_custkey")
+        .merge(n.add_prefix("c_"), left_on="c_nationkey", right_on="c_n_nationkey")
+        .merge(t["region"], left_on="c_n_regionkey", right_on="r_regionkey")
+        .merge(n.add_prefix("s_"), left_on="s_nationkey", right_on="s_n_nationkey")
+    )
+    m = m[(m.r_name == "AMERICA")
+          & m.o_orderdate.between(_d("1995-01-01"), _d("1996-12-31"))]
+    if len(m) == 0:
+        assert len(got) == 0 or got.mkt_share.isna().all() or len(got) == 0
+        return
+    m = m.assign(
+        o_year=pd.to_datetime(m.o_orderdate, unit="D").dt.year,
+        volume=m.l_extendedprice * (1 - m.l_discount),
+    )
+    m = m.assign(bz=np.where(m.s_n_name == "BRAZIL", m.volume, 0.0))
+    g = m.groupby("o_year").agg(num=("bz", "sum"), den=("volume", "sum"))
+    exp = pd.DataFrame({"o_year": g.index, "mkt_share": (g.num / g.den).values}).reset_index(drop=True)
+    frames_match(got, exp, rtol=1e-9, check_order=True)
