@@ -24,12 +24,13 @@ import numpy as np
 class Dictionary:
     """Sorted unique string values; identity-hashed so jit caches by object."""
 
-    __slots__ = ("values", "_index")
+    __slots__ = ("values", "_index", "_memo")
 
     def __init__(self, values: np.ndarray):
         # values must be sorted & unique (np.str_ / object array of str)
         self.values = np.asarray(values)
         self._index = None
+        self._memo = {}
 
     @staticmethod
     def encode(strings) -> tuple["Dictionary", np.ndarray]:
@@ -87,6 +88,38 @@ class Dictionary:
         out = np.where(ok, pos, -1).astype(np.int32)
         # slot for null code (-1) — prepend so device indexes with codes+1
         return np.concatenate([np.array([-1], np.int32), out])
+
+    def transform(self, key, fn) -> tuple["Dictionary", np.ndarray]:
+        """String→string function applied over the dictionary (substr, upper,
+        concat-with-constant, …). Returns (new_dict, remap) where
+        remap[code+1] is the new code (remap[0] = -1 for null). The result is
+        canonical: equal output strings collapse to one code, so grouping /
+        equality on the output column stay exact. Memoized by `key` so
+        repeated jit traces reuse the identical Dictionary object (identity
+        hashing keeps the XLA cache warm)."""
+        hit = self._memo.get(key)
+        if hit is not None:
+            return hit
+        outs = np.asarray([str(fn(str(v))) for v in self.values], dtype=object)
+        uniq, inv = np.unique(outs.astype(str), return_inverse=True)
+        nd = Dictionary(uniq)
+        remap = np.concatenate(
+            [np.array([-1], np.int32), inv.astype(np.int32)]
+        )
+        self._memo[key] = (nd, remap)
+        return nd, remap
+
+    def int_lut(self, key, fn, dtype=np.int64) -> np.ndarray:
+        """String→int function over the dictionary (length, strpos, …) as a
+        code-indexed table; slot 0 (null) = 0. Memoized like transform()."""
+        hit = self._memo.get(key)
+        if hit is not None:
+            return hit
+        table = np.zeros(len(self.values) + 1, dtype=dtype)
+        for i, v in enumerate(self.values):
+            table[i + 1] = fn(str(v))
+        self._memo[key] = table
+        return table
 
     @staticmethod
     def merge(a: "Dictionary", b: "Dictionary") -> "Dictionary":

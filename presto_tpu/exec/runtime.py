@@ -42,7 +42,14 @@ from presto_tpu.ops.join import (
     probe_expand,
     probe_unique,
 )
-from presto_tpu.ops.sort import SortKey, compact, limit_batch, sort_batch
+from presto_tpu.ops.sort import (
+    SortKey,
+    compact,
+    limit_batch,
+    permute_batch,
+    sort_batch,
+    sort_permutation,
+)
 from presto_tpu.plan.nodes import (
     Aggregate,
     AggSpec,
@@ -56,6 +63,7 @@ from presto_tpu.plan.nodes import (
     SemiJoin,
     Sort,
     TableScan,
+    Window,
 )
 from presto_tpu.types import BIGINT, DOUBLE, DecimalType, Type
 
@@ -145,6 +153,10 @@ def collapse_chain(node: PlanNode) -> Tuple[PlanNode, Callable[[Batch], Batch]]:
                         dicts[s] = b.dicts[e.name]
                     elif getattr(fn, "out_dict", None) is not None:
                         dicts[s] = fn.out_dict
+                    elif getattr(fn, "dyn_dict", None) is not None:
+                        d = fn.dyn_dict(b)
+                        if d is not None:
+                            dicts[s] = d
                 return Batch(names, types, cols, b.live, dicts)
 
             steps.append(step)
@@ -229,6 +241,9 @@ def _execute_base(base: PlanNode, ctx: ExecContext) -> Iterator[Batch]:
         return
     if isinstance(base, Sort):
         yield from _execute_sort(base, ctx)
+        return
+    if isinstance(base, Window):
+        yield from _execute_window(base, ctx)
         return
     if isinstance(base, Limit):
         remaining = base.count
@@ -664,7 +679,7 @@ def _execute_join(node: HashJoin, ctx: ExecContext) -> Iterator[Batch]:
 def _execute_semijoin(node: SemiJoin, ctx: ExecContext) -> Iterator[Batch]:
     right_in = _collect_concat(execute_node(node.right, ctx))
     probe_stream, chain = _fused_child(node.left, ctx)
-    lsym, rsym = node.left_key, node.right_key
+    lkeys, rkeys = tuple(node.left_keys), tuple(node.right_keys)
     if right_in is None:
         jfn = _node_jit(node, "chain", lambda: chain)
         for pb in probe_stream:
@@ -675,34 +690,206 @@ def _execute_semijoin(node: SemiJoin, ctx: ExecContext) -> Iterator[Batch]:
                 yield b.with_live(jnp.zeros(b.capacity, bool))
         return
 
-    def dedup_build(b: Batch):
-        c = b.column(rsym)
-        keys, _, out_live, _ = grouped_merge(
-            [KeyCol(c.values, c.validity)], [], b.live, b.capacity
+    if node.residual is None:
+
+        def dedup_build(b: Batch):
+            cols = [b.column(r) for r in rkeys]
+            keys, _, out_live, _ = grouped_merge(
+                [KeyCol(c.values, c.validity) for c in cols], [], b.live, b.capacity
+            )
+            db = Batch(
+                list(rkeys), [b.type_of(r) for r in rkeys],
+                [Column(k.values, k.validity) for k in keys], out_live, b.dicts,
+            )
+            return build_side(db, rkeys)
+
+        table = _node_jit(node, "dedup_build", lambda: dedup_build)(right_in)
+
+        def probe_fn(t, pb: Batch):
+            b = chain(pb)
+            ba = align_probe_strings(b, lkeys, t, rkeys)
+            _, matched = probe_unique(t, ba, lkeys, rkeys)
+            if node.negated:
+                if node.null_aware:
+                    # SQL: NULL NOT IN (non-empty set) is NULL → row filtered.
+                    # (Deviation: NULLs *inside* the subquery should poison
+                    # every row; that case is documented as unsupported.)
+                    key_valid = jnp.ones(b.capacity, bool)
+                    for lk in lkeys:
+                        kv = b.column(lk).validity
+                        if kv is not None:
+                            key_valid = key_valid & kv
+                    keep = ~matched & (key_valid | (t.n_rows == 0))
+                else:
+                    # NOT EXISTS is a pure anti-join: a NULL correlation key
+                    # simply never matches, keeping the row
+                    keep = ~matched
+                return b.with_live(b.live & keep)
+            return b.with_live(b.live & matched)
+
+        jfn = _node_jit(node, "probe", lambda: probe_fn)
+        for pb in probe_stream:
+            yield jfn(table, pb)
+        return
+
+    # residual path (correlated EXISTS with non-equi conjuncts, e.g. Q21):
+    # full build table, chunked pair expansion, residual predicate on pairs,
+    # per-probe-row ANY-reduction across chunks.
+    lsyms = [n for n, _ in node.left.output]
+    rsyms = [n for n, _ in node.right.output]
+    pred = compile_predicate(node.residual)
+    table = _node_jit(node, "build", lambda: build_side, static_argnames=("key_names",))(
+        right_in, rkeys
+    )
+
+    def chain_align(pb):
+        pb = chain(pb)
+        pba = align_probe_strings(pb, lkeys, table, rkeys)
+        return pb, pba
+
+    chain_j = _node_jit(node, "chain_align", lambda: chain_align)
+    counts_fn = _node_jit(
+        node, "counts", lambda: lambda t, pba: probe_counts(t, pba, lkeys, rkeys)
+    )
+
+    def exists_fn(t, pb, pba, lo, counts, offsets, base, out_cap):
+        pr, bi, ol = probe_expand(
+            t, pba, lkeys, rkeys, lo, counts, offsets, base, out_cap
         )
-        db = Batch([rsym], [b.type_of(rsym)], [Column(keys[0].values, keys[0].validity)],
-                   out_live, b.dicts)
-        return build_side(db, (rsym,))
+        pair = gather_join_output(pb, t, pr, bi, ol, lsyms, rsyms)
+        ok = pred(pair) & pair.live
+        return (
+            jnp.zeros(pb.capacity, dtype=jnp.int32)
+            .at[pr]
+            .max(ok.astype(jnp.int32), mode="drop")
+            .astype(bool)
+        )
 
-    table = _node_jit(node, "dedup_build", lambda: dedup_build)(right_in)
+    jexists = _node_jit(node, "exists", lambda: exists_fn, static_argnames=("out_cap",))
+    for pb_raw in probe_stream:
+        pb, pba = chain_j(pb_raw)
+        lo, counts, offsets, total, _ = counts_fn(table, pba)
+        tot = int(total)
+        out_cap = ctx.config.join_out_capacity or pb.capacity
+        base = 0
+        exists_acc = jnp.zeros(pb.capacity, dtype=bool)
+        while base < tot:
+            exists_acc = exists_acc | jexists(
+                table, pb, pba, lo, counts, offsets, base, out_cap
+            )
+            base += out_cap
+        keep = ~exists_acc if node.negated else exists_acc
+        yield pb.with_live(pb.live & keep)
 
-    def probe_fn(t, pb: Batch):
-        b = chain(pb)
-        ba = align_probe_strings(b, (lsym,), t, (rsym,))
-        _, matched = probe_unique(t, ba, (lsym,), (rsym,))
-        if node.negated:
-            # SQL: NULL NOT IN (non-empty set) is NULL → row filtered.
-            # (Deviation: NULLs *inside* the subquery should poison every
-            # row; that case is documented as unsupported.)
-            kv = b.column(lsym).validity
-            key_valid = kv if kv is not None else jnp.ones(b.capacity, bool)
-            keep = ~matched & (key_valid | (t.n_rows == 0))
-            return b.with_live(b.live & keep)
-        return b.with_live(b.live & matched)
 
-    jfn = _node_jit(node, "probe", lambda: probe_fn)
-    for pb in probe_stream:
-        yield jfn(table, pb)
+# -- window -----------------------------------------------------------------
+
+
+def _execute_window(node: Window, ctx: ExecContext) -> Iterator[Batch]:
+    """Pipeline breaker: materialize the input, sort once by
+    (partition keys, order keys), compute every function in the node's spec
+    as closed-form vector ops (ops/window.py), emit one batch with the
+    window columns appended (reference: WindowOperator.java:47 over a
+    PagesIndex — here one lax.sort + O(n) vector passes)."""
+    from presto_tpu.ops import window as W
+    from presto_tpu.types import DOUBLE as _DOUBLE, DecimalType as _Dec
+
+    acc = _collect_concat(execute_node(node.child, ctx))
+    if acc is None:
+        return
+
+    child_types = dict(node.child.output)
+
+    def compute(b: Batch) -> Batch:
+        keys = []
+        for pk in node.partition_keys:
+            c = b.column(pk)
+            keys.append(SortKey(c.values, c.validity))
+        for oi in node.order_items:
+            c = b.column(oi.symbol)
+            nf = oi.nulls_first
+            if nf is None:
+                nf = not oi.ascending  # SQL default: NULLS LAST for ASC
+            keys.append(SortKey(c.values, c.validity, not oi.ascending, nf))
+        perm = sort_permutation(keys, b.live)
+        sb = permute_batch(b, perm)
+
+        part_cols = [
+            (sb.column(pk).values, sb.column(pk).validity)
+            for pk in node.partition_keys
+        ]
+        order_cols = [
+            (sb.column(oi.symbol).values, sb.column(oi.symbol).validity)
+            for oi in node.order_items
+        ]
+        wk = W.window_keys(part_cols, order_cols, sb.live)
+
+        out = sb
+        for f in node.funcs:
+            if f.fn == "row_number":
+                v, valid = W.row_number(wk)
+            elif f.fn == "rank":
+                v, valid = W.rank(wk)
+            elif f.fn == "dense_rank":
+                v, valid = W.dense_rank(wk)
+            elif f.fn == "percent_rank":
+                v, valid = W.percent_rank(wk)
+            elif f.fn == "cume_dist":
+                v, valid = W.cume_dist(wk)
+            elif f.fn == "ntile":
+                v, valid = W.ntile(wk, f.param)
+            elif f.fn in ("lag", "lead", "first_value", "last_value", "nth_value"):
+                c = sb.column(f.arg)
+                if f.fn == "lag":
+                    v, valid = W.lag(wk, c.values, c.validity,
+                                     f.param if f.param is not None else 1)
+                elif f.fn == "lead":
+                    v, valid = W.lead(wk, c.values, c.validity,
+                                      f.param if f.param is not None else 1)
+                elif f.fn == "first_value":
+                    v, valid = W.first_value(wk, c.values, c.validity)
+                elif f.fn == "last_value":
+                    v, valid = W.last_value(wk, c.values, c.validity)
+                else:
+                    v, valid = W.nth_value(wk, c.values, c.validity, f.param)
+            elif f.fn in ("sum", "avg", "min", "max", "count"):
+                if not node.order_items:
+                    frame = "whole"
+                elif f.frame == "rows_unbounded_current":
+                    frame = "rows"
+                else:
+                    frame = "range"
+                if f.arg is None:
+                    v, valid = W.agg_window(
+                        wk, "count", jnp.zeros(sb.capacity, jnp.int64), None,
+                        frame, False,
+                    )
+                else:
+                    c = sb.column(f.arg)
+                    vals = c.values
+                    arg_t = child_types.get(f.arg)
+                    is_float = jnp.issubdtype(vals.dtype, jnp.floating)
+                    if f.fn == "avg" and not is_float:
+                        # avg computes in double (builder types avg → DOUBLE);
+                        # decimals are unscaled ints — rescale on conversion
+                        scale = arg_t.scale if isinstance(arg_t, _Dec) else 0
+                        vals = vals.astype(jnp.float64) / (10.0 ** scale)
+                        is_float = True
+                    v, valid = W.agg_window(
+                        wk, f.fn, vals, c.validity, frame, is_float
+                    )
+            else:
+                raise NotImplementedError(f"window function {f.fn}")
+            dict_ = None
+            if f.arg is not None and f.type.is_string:
+                dict_ = sb.dict_of(f.arg)
+            out = out.with_column(
+                f.symbol, f.type,
+                Column(v.astype(f.type.dtype), valid), dictionary=dict_,
+            )
+        return out
+
+    yield _node_jit(node, "window", lambda: compute)(acc)
 
 
 # -- sort / limit -----------------------------------------------------------

@@ -84,6 +84,149 @@ def like_to_regex(pattern: str, escape: str | None = None) -> str:
     return "^" + "".join(out) + "$"
 
 
+# string→string functions evaluated host-side over the dictionary
+# (reference: operator/scalar/StringFunctions.java — but O(|dict|) instead of
+# O(rows), then one device gather)
+_STR_TO_STR = {
+    "substr", "upper", "lower", "trim", "ltrim", "rtrim", "replace",
+    "reverse", "lpad", "rpad", "concat", "split_part",
+}
+# string→int functions (code-indexed int lut)
+_STR_TO_INT = {"length", "strpos", "codepoint"}
+# string→bool predicate functions (bool lut, like LIKE)
+_STR_PRED = {"regexp_like", "starts_with", "ends_with", "contains"}
+
+
+def _sql_substr(s: str, start: int, length: int | None) -> str:
+    # SQL substr: 1-based; negative start counts from the end (Presto
+    # StringFunctions.substr semantics)
+    n = len(s)
+    if start == 0:
+        return ""
+    if start > 0:
+        i = start - 1
+    else:
+        i = n + start
+        if i < 0:
+            return ""
+    if i >= n:
+        return ""
+    if length is None:
+        return s[i:]
+    if length <= 0:
+        return ""
+    return s[i : i + length]
+
+
+def _str_xform_pyfn(fn: str, cargs: tuple):
+    """Host python fn(str)->str for a string transform with constant args."""
+    if fn == "substr":
+        start = int(cargs[0])
+        length = int(cargs[1]) if len(cargs) > 1 and cargs[1] is not None else None
+        return lambda s: _sql_substr(s, start, length)
+    if fn == "upper":
+        return str.upper
+    if fn == "lower":
+        return str.lower
+    if fn == "trim":
+        return str.strip
+    if fn == "ltrim":
+        return str.lstrip
+    if fn == "rtrim":
+        return str.rstrip
+    if fn == "reverse":
+        return lambda s: s[::-1]
+    if fn == "replace":
+        old = str(cargs[0])
+        new = str(cargs[1]) if len(cargs) > 1 else ""
+        return lambda s: s.replace(old, new)
+    if fn == "lpad":
+        n, fill = int(cargs[0]), str(cargs[1]) if len(cargs) > 1 else " "
+        def lpad(s, n=n, fill=fill):
+            if len(s) >= n:
+                return s[:n]
+            pad = (fill * n)[: n - len(s)]
+            return pad + s
+        return lpad
+    if fn == "rpad":
+        n, fill = int(cargs[0]), str(cargs[1]) if len(cargs) > 1 else " "
+        def rpad(s, n=n, fill=fill):
+            if len(s) >= n:
+                return s[:n]
+            return s + (fill * n)[: n - len(s)]
+        return rpad
+    if fn == "concat":
+        pre, post = str(cargs[0]), str(cargs[1])
+        return lambda s: pre + s + post
+    if fn == "split_part":
+        delim, idx = str(cargs[0]), int(cargs[1])
+        def split_part(s, delim=delim, idx=idx):
+            parts = s.split(delim)
+            return parts[idx - 1] if 0 < idx <= len(parts) else ""
+        return split_part
+    raise NotImplementedError(fn)
+
+
+def _str_int_pyfn(fn: str, cargs: tuple):
+    if fn == "length":
+        return len
+    if fn == "strpos":
+        sub = str(cargs[0])
+        return lambda s: s.find(sub) + 1
+    if fn == "codepoint":
+        return lambda s: ord(s[0]) if s else 0
+    raise NotImplementedError(fn)
+
+
+def _str_pred_pyfn(fn: str, cargs: tuple):
+    if fn == "regexp_like":
+        rx = re.compile(str(cargs[0]))
+        return lambda s: rx.search(s) is not None
+    if fn == "starts_with":
+        p = str(cargs[0])
+        return lambda s: s.startswith(p)
+    if fn == "ends_with":
+        p = str(cargs[0])
+        return lambda s: s.endswith(p)
+    if fn == "contains":
+        p = str(cargs[0])
+        return lambda s: p in s
+    raise NotImplementedError(fn)
+
+
+def _xform_parts(e: Call):
+    """Split a string-function call into (string_operand, const_args_key).
+    For concat, the single non-constant operand with (prefix, suffix)."""
+    if e.fn == "concat":
+        pre, post, operand = [], [], None
+        for a in e.args:
+            if isinstance(a, Constant):
+                (pre if operand is None else post).append(
+                    None if a.value is None else str(a.value)
+                )
+            elif operand is None:
+                operand = a
+            else:
+                raise NotImplementedError(
+                    "concat of two non-constant strings (cross-product "
+                    "dictionary) not supported"
+                )
+        if operand is None:
+            raise NotImplementedError("all-constant concat should fold")
+        if any(p is None for p in pre + post):
+            return operand, None  # NULL operand poisons the whole concat
+        return operand, ("".join(pre), "".join(post))
+    consts = []
+    for a in e.args[1:]:
+        if not isinstance(a, Constant):
+            raise NotImplementedError(
+                f"{e.fn}: non-constant argument {a} not supported "
+                "(dictionary transforms need plan-time constants)"
+            )
+        consts.append(a.value)
+    return e.args[0], tuple(consts)
+
+
 class CompileContext:
     """Static info the compiler needs beyond the IR: the dictionaries of the
     string columns flowing through this fragment, captured at trace time from
@@ -99,11 +242,27 @@ class CompileContext:
         if isinstance(e, InputRef):
             return self.batch.dict_of(e.name)
         if isinstance(e, Call):
+            if e.fn in _STR_TO_STR:
+                nd, _, _ = self.transformed(e)
+                return nd
             for a in e.args:
                 d = self.dict_for(a)
                 if d is not None:
                     return d
         return None
+
+    def transformed(self, e: Call):
+        """(new_dict, remap, operand) for a string-transform call, memoized
+        on the operand's dictionary so jit retraces get identical objects.
+        remap=None signals a constant-NULL result (NULL in concat)."""
+        operand, cargs = _xform_parts(e)
+        if cargs is None:
+            return None, None, operand
+        d = self.dict_for(operand)
+        if d is None:
+            raise ValueError(f"string function {e.fn} needs a dictionary operand")
+        nd, remap = d.transform((e.fn, cargs), _str_xform_pyfn(e.fn, cargs))
+        return nd, remap, operand
 
 
 # ---------------------------------------------------------------------------
@@ -146,6 +305,14 @@ def compile_expr(e: RowExpression):
         return _eval(e, ctx)
 
     fn.out_dict = out_dict
+    if out_dict is None and e.type.is_string and not isinstance(e, InputRef):
+        # dictionary of the output column depends on the input batch's
+        # dictionaries (string transforms); resolved at trace time — batch
+        # dicts are static pytree aux, so this is jit-cache coherent
+        def dyn_dict(batch: Batch):
+            return CompileContext(batch, None).dict_for(e)
+
+        fn.dyn_dict = dyn_dict
     return fn
 
 
@@ -360,10 +527,32 @@ def _eval_call(e: Call, ctx: CompileContext):
         if d is None:
             raise ValueError("LIKE on non-dictionary column")
         rx = re.compile(like_to_regex(str(pat.value), escape))
-        table = d.lut(lambda s: rx.match(s) is not None)
+        table = d.int_lut(("like", pat.value, escape),
+                          lambda s: rx.match(s) is not None, dtype=np.bool_)
         vv, vvalid = _eval(val, ctx)
         out = jnp.asarray(table)[vv + 1]
         return out, vvalid
+
+    # ---- string functions over dictionaries ------------------------------
+    if fn in _STR_TO_STR:
+        _, remap, operand = ctx.transformed(e)
+        if remap is None:  # NULL constant operand → NULL result
+            cap = ctx.batch.capacity
+            return jnp.zeros(cap, jnp.int32), jnp.zeros(cap, bool)
+        codes, valid = _eval(operand, ctx)
+        return jnp.asarray(remap)[codes + 1], valid
+    if fn in _STR_TO_INT or fn in _STR_PRED:
+        operand, cargs = _xform_parts(e)
+        d = ctx.dict_for(operand)
+        if d is None:
+            raise ValueError(f"{fn} needs a dictionary operand")
+        if fn in _STR_TO_INT:
+            table = d.int_lut((fn, cargs), _str_int_pyfn(fn, cargs))
+        else:
+            table = d.int_lut((fn, cargs), _str_pred_pyfn(fn, cargs),
+                              dtype=np.bool_)
+        codes, valid = _eval(operand, ctx)
+        return jnp.asarray(table)[codes + 1], valid
 
     # ---- cast ------------------------------------------------------------
     if fn == "cast":
@@ -386,10 +575,40 @@ def _eval_call(e: Call, ctx: CompileContext):
         "ln": jnp.log,
         "floor": jnp.floor,
         "ceil": jnp.ceil,
+        "sin": jnp.sin,
+        "cos": jnp.cos,
+        "tan": jnp.tan,
+        "asin": jnp.arcsin,
+        "acos": jnp.arccos,
+        "atan": jnp.arctan,
+        "sinh": jnp.sinh,
+        "cosh": jnp.cosh,
+        "tanh": jnp.tanh,
+        "log2": jnp.log2,
+        "log10": jnp.log10,
+        "cbrt": jnp.cbrt,
+        "degrees": jnp.degrees,
+        "radians": jnp.radians,
+        "sign": jnp.sign,
+        "truncate": jnp.trunc,
     }
     if fn in _MATH:
         v, valid = _eval_arg(e.args[0], ctx)
         return _MATH[fn](v.astype(e.type.dtype)), valid
+    if fn == "atan2":
+        a, avalid = _eval_arg(e.args[0], ctx)
+        b, bvalid = _eval_arg(e.args[1], ctx)
+        return jnp.arctan2(a.astype(e.type.dtype), b.astype(e.type.dtype)), _and_valid(avalid, bvalid)
+    if fn in ("greatest", "least"):
+        # SQL: NULL if any argument is NULL (Presto MathFunctions.greatest)
+        op = jnp.maximum if fn == "greatest" else jnp.minimum
+        out_v, out_valid = _eval_arg(e.args[0], ctx)
+        out_v = out_v.astype(e.type.dtype)
+        for a in e.args[1:]:
+            av, avalid = _eval_arg(a, ctx)
+            out_v = op(out_v, av.astype(e.type.dtype))
+            out_valid = _and_valid(out_valid, avalid)
+        return out_v, out_valid
     if fn == "round":
         # SQL ROUND is half-away-from-zero (Presto MathFunctions.round),
         # not jnp.round's half-to-even
@@ -419,12 +638,100 @@ def _eval_call(e: Call, ctx: CompileContext):
         v, valid = _eval_arg(e.args[0], ctx)
         y, m, d = _civil_from_days(v.astype(jnp.int32))
         return {"year": y, "month": m, "day": d}[fn].astype(jnp.int64), valid
+    if fn == "quarter":
+        v, valid = _eval_arg(e.args[0], ctx)
+        _, m, _ = _civil_from_days(v.astype(jnp.int32))
+        return ((m - 1) // 3 + 1).astype(jnp.int64), valid
+    if fn == "day_of_week":
+        # ISO: 1 = Monday … 7 = Sunday; epoch day 0 (1970-01-01) is Thursday
+        v, valid = _eval_arg(e.args[0], ctx)
+        return (jnp.mod(v.astype(jnp.int64) + 3, 7) + 1), valid
+    if fn == "day_of_year":
+        v, valid = _eval_arg(e.args[0], ctx)
+        days = v.astype(jnp.int32)
+        y, _, _ = _civil_from_days(days)
+        return (days - _days_from_civil_vec(y, 1, 1) + 1).astype(jnp.int64), valid
     if fn == "date_add_days":
         v, valid = _eval_arg(e.args[0], ctx)
         dv, dvalid = _eval_arg(e.args[1], ctx)
         return v + dv.astype(v.dtype), _and_valid(valid, dvalid)
+    if fn == "date_trunc":
+        unit = str(e.args[0].value).lower()
+        v, valid = _eval_arg(e.args[1], ctx)
+        days = v.astype(jnp.int32)
+        if unit == "day":
+            return days, valid
+        if unit == "week":
+            return days - jnp.mod(days + 3, 7), valid
+        y, m, _ = _civil_from_days(days)
+        if unit == "month":
+            return _days_from_civil_vec(y, m, 1), valid
+        if unit == "quarter":
+            return _days_from_civil_vec(y, ((m - 1) // 3) * 3 + 1, 1), valid
+        if unit == "year":
+            return _days_from_civil_vec(y, 1, 1), valid
+        raise NotImplementedError(f"date_trunc unit {unit}")
+    if fn == "date_diff":
+        unit = str(e.args[0].value).lower()
+        a, avalid = _eval_arg(e.args[1], ctx)
+        b, bvalid = _eval_arg(e.args[2], ctx)
+        valid = _and_valid(avalid, bvalid)
+        a64, b64 = a.astype(jnp.int64), b.astype(jnp.int64)
+        if unit == "day":
+            return b64 - a64, valid
+        if unit == "week":
+            return (b64 - a64) // 7, valid
+        ya, ma, da = _civil_from_days(a.astype(jnp.int32))
+        yb, mb, db = _civil_from_days(b.astype(jnp.int32))
+        months = (yb.astype(jnp.int64) * 12 + mb) - (ya.astype(jnp.int64) * 12 + ma)
+        # truncate toward zero on the day-of-month remainder
+        months = months - jnp.where((months > 0) & (db < da), 1, 0)
+        months = months + jnp.where((months < 0) & (db > da), 1, 0)
+        if unit == "month":
+            return months, valid
+        if unit == "quarter":
+            return months // 3, valid
+        if unit == "year":
+            return months // 12, valid
+        raise NotImplementedError(f"date_diff unit {unit}")
+    if fn == "date_add_unit":
+        unit = str(e.args[0].value).lower()
+        n, nvalid = _eval_arg(e.args[1], ctx)
+        v, valid = _eval_arg(e.args[2], ctx)
+        valid = _and_valid(valid, nvalid)
+        days = v.astype(jnp.int32)
+        n = n.astype(jnp.int32)
+        if unit == "day":
+            return days + n, valid
+        if unit == "week":
+            return days + 7 * n, valid
+        y, m, d = _civil_from_days(days)
+        mult = {"month": 1, "quarter": 3, "year": 12}.get(unit)
+        if mult is None:
+            raise NotImplementedError(f"date_add unit {unit}")
+        total = y * 12 + (m - 1) + n * mult
+        y2 = total // 12
+        m2 = jnp.mod(total, 12) + 1
+        d2 = jnp.minimum(d, _days_in_month(y2, m2))
+        return _days_from_civil_vec(y2, m2, d2), valid
 
     raise NotImplementedError(f"scalar function not implemented: {fn}")
+
+
+def _days_in_month(y, m):
+    base = jnp.asarray([31, 28, 31, 30, 31, 30, 31, 31, 30, 31, 30, 31])[m - 1]
+    leap = ((jnp.mod(y, 4) == 0) & (jnp.mod(y, 100) != 0)) | (jnp.mod(y, 400) == 0)
+    return jnp.where((m == 2) & leap, 29, base)
+
+
+def _days_from_civil_vec(y, m, d):
+    """Vectorized inverse of _civil_from_days (same Hinnant algorithm)."""
+    y = y - (m <= 2)
+    era = jnp.where(y >= 0, y, y - 399) // 400
+    yoe = y - era * 400
+    doy = (153 * (m + jnp.where(m > 2, -3, 9)) + 2) // 5 + d - 1
+    doe = yoe * 365 + yoe // 4 - yoe // 100 + doy
+    return (era * 146097 + doe - 719468).astype(jnp.int32)
 
 
 def _numeric_align(lt: Type, rt: Type, lv, rv):

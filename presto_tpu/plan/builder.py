@@ -155,6 +155,13 @@ def ast_key(node) -> str:
         return f"case({op};{whens};{dflt})"
     if isinstance(node, ast.Extract):
         return f"extract:{node.field}({ast_key(node.value)})"
+    if isinstance(node, ast.WindowFunction):
+        args = ",".join(ast_key(a) for a in node.args)
+        part = ",".join(ast_key(p) for p in node.partition_by)
+        order = ",".join(
+            f"{ast_key(o.expr)}:{o.ascending}:{o.nulls_first}" for o in node.order_by
+        )
+        return f"win:{node.name}({'*' if node.is_star else args};{part};{order};{node.frame})"
     return f"?{id(node)}"
 
 
@@ -235,7 +242,21 @@ class ExprAnalyzer:
         if op in ("add", "sub", "mul", "div", "mod"):
             return self._arith(op, node.left, node.right)
         if op == "concat":
-            raise AnalysisError("string concat not yet supported on device")
+            l = self.analyze(node.left)
+            r = self.analyze(node.right)
+            # flatten nested concat so a || b || c becomes one call, and fold
+            # all-constant concat to a literal
+            args = []
+            for a in (l, r):
+                if isinstance(a, Call) and a.fn == "concat":
+                    args.extend(a.args)
+                else:
+                    args.append(a)
+            if all(isinstance(a, Constant) for a in args):
+                if any(a.value is None for a in args):
+                    return Constant(VARCHAR, None)  # NULL poisons concat
+                return Constant(VARCHAR, "".join(str(a.value) for a in args))
+            return Call(VARCHAR, "concat", tuple(args))
         raise AnalysisError(f"unknown operator {op}")
 
     def _align_comparable(self, l: RowExpression, r: RowExpression):
@@ -423,8 +444,69 @@ class ExprAnalyzer:
             return Call(t, "coalesce", args)
         if name == "nullif":
             return Call(args[0].type, "nullif", args)
-        if name in ("year", "month", "day"):
-            return Call(BIGINT, name, args)
+        if name in ("year", "month", "day", "quarter", "day_of_week", "dow",
+                    "day_of_year", "doy"):
+            canon = {"dow": "day_of_week", "doy": "day_of_year"}.get(name, name)
+            return Call(BIGINT, canon, args)
+        # string functions (dictionary transforms / luts — expr/compile.py)
+        if name in ("substr", "substring"):
+            return Call(VARCHAR, "substr", args)
+        if name in ("upper", "lower", "trim", "ltrim", "rtrim", "reverse",
+                    "replace", "lpad", "rpad", "split_part"):
+            return Call(VARCHAR, name, args)
+        if name == "concat":
+            if all(isinstance(a, Constant) for a in args):
+                if any(a.value is None for a in args):
+                    return Constant(VARCHAR, None)  # NULL poisons concat
+                return Constant(VARCHAR, "".join(str(a.value) for a in args))
+            return Call(VARCHAR, "concat", args)
+        if name in ("length", "strpos", "position", "codepoint"):
+            return Call(BIGINT, {"position": "strpos"}.get(name, name), args)
+        if name in ("regexp_like", "starts_with", "ends_with", "contains"):
+            return Call(BOOLEAN, name, args)
+        # math
+        if name in ("sin", "cos", "tan", "asin", "acos", "atan", "sinh",
+                    "cosh", "tanh", "log2", "log10", "cbrt", "degrees",
+                    "radians", "atan2"):
+            return Call(DOUBLE, name, tuple(self._to_double(a) for a in args))
+        if name == "log":
+            # log(base, x) = ln(x)/ln(base)
+            b, x = (self._to_double(a) for a in args)
+            return Call(DOUBLE, "div",
+                        (Call(DOUBLE, "ln", (x,)), Call(DOUBLE, "ln", (b,))))
+        if name == "sign":
+            return Call(args[0].type, "sign", args)
+        if name == "truncate":
+            return Call(DOUBLE, "truncate", (self._to_double(args[0]),))
+        if name == "mod":
+            return self._arith("mod", node.args[0], node.args[1])
+        if name == "pi":
+            return Constant(DOUBLE, 3.141592653589793, raw=True)
+        if name in ("e",):
+            return Constant(DOUBLE, 2.718281828459045, raw=True)
+        if name in ("greatest", "least"):
+            t = args[0].type
+            for a in args[1:]:
+                t = common_super_type(t, a.type)
+            if isinstance(t, DecimalType):
+                args = tuple(self._rescale(a, t.scale) for a in args)
+            elif t is DOUBLE:
+                args = tuple(self._to_double(a) for a in args)
+            return Call(t, name, args)
+        if name == "if":
+            return self._an_Case(
+                ast.Case(None, [(node.args[0], node.args[1])],
+                         node.args[2] if len(node.args) > 2 else None)
+            )
+        # date
+        if name == "date_trunc":
+            return Call(DATE, "date_trunc", args)
+        if name == "date_diff":
+            return Call(BIGINT, "date_diff", args)
+        if name == "date_add":
+            if len(args) == 2:
+                return Call(DATE, "date_add_days", (args[1], args[0]))
+            return Call(DATE, "date_add_unit", args)
         raise AnalysisError(f"unknown function {name}")
 
     def _an_ScalarSubquery(self, node: ast.ScalarSubquery) -> RowExpression:
@@ -592,6 +674,12 @@ class Planner:
         plain_conjs_ast = []
         semi_asts = []
         for c in where_conjs_ast:
+            # NOT EXISTS / NOT IN parse as UnaryOp('not', ...); fold the
+            # negation into the subquery predicate node
+            if isinstance(c, ast.UnaryOp) and c.op == "not" and isinstance(
+                c.operand, (ast.InSubquery, ast.Exists)
+            ):
+                c = dataclasses.replace(c.operand, negated=not c.operand.negated)
             if isinstance(c, ast.InSubquery):
                 semi_asts.append(("in", c))
             elif isinstance(c, ast.Exists):
@@ -642,11 +730,31 @@ class Planner:
             if q.having is not None:
                 having_ast = _rewrite_aggs_to_keys(q.having)
                 node = Filter(node, analyzer.analyze(having_ast))
+        else:
+            analyzer = ExprAnalyzer(scope, self)
+
+        # window functions (computed after WHERE/GROUP BY/HAVING, before the
+        # select projection — SQL evaluation order)
+        windows: List[ast.WindowFunction] = []
+
+        def collect_windows(n):
+            if isinstance(n, ast.WindowFunction):
+                windows.append(n)
+            for ch in _ast_children(n):
+                collect_windows(ch)
+
+        for it in select_items:
+            collect_windows(it.expr)
+        for oi in q.order_by or []:
+            collect_windows(oi.expr)
+        if windows:
+            node = self._plan_windows(node, analyzer, windows)
+
+        if has_group or has_aggs:
             select_exprs = [
                 analyzer.analyze(_rewrite_aggs_to_keys(it.expr)) for it in select_items
             ]
         else:
-            analyzer = ExprAnalyzer(scope, self)
             select_exprs = [analyzer.analyze(it.expr) for it in select_items]
 
         # select projection
@@ -765,8 +873,134 @@ class Planner:
             left_e = ExprAnalyzer(scope, self).analyze(c.value)
             if not isinstance(left_e, InputRef):
                 raise AnalysisError("IN subquery LHS must be a column")
-            return SemiJoin(node, out.child, left_e.name, out.symbols[0], c.negated)
-        raise AnalysisError("EXISTS subqueries not supported yet")
+            return SemiJoin(node, out.child, [left_e.name], [out.symbols[0]], c.negated)
+        # correlated [NOT] EXISTS (reference: TransformExistsApplyToLateralNode
+        # + PlanNodeDecorrelator → SemiJoinNode). The subquery's WHERE is split
+        # into pure-inner conjuncts (stay inside the build plan), equi
+        # correlation pairs (become semi-join keys), and residual correlated
+        # conjuncts (become the semi-join residual, evaluated over probe∪build
+        # pairs — covers Q21's `l2.l_suppkey <> l1.l_suppkey`).
+        sq = c.query
+        if sq.group_by or sq.having or sq.order_by or sq.limit:
+            raise AnalysisError("EXISTS subquery with group/order/limit unsupported")
+        for name, cq in sq.ctes:
+            sub.ctes[name] = cq
+        rel = sub.plan_relation(sq.from_)
+        inner_scope = rel.scope
+        inner_syms = {f.symbol for f in inner_scope.fields}
+        combined = scope + inner_scope
+        combined_an = ExprAnalyzer(combined, self)
+        inner_an = ExprAnalyzer(inner_scope, sub)
+        pure_inner: List[RowExpression] = []
+        correlated: List[RowExpression] = []
+        for conj in split_conjuncts(sq.where) if sq.where is not None else []:
+            try:
+                pure_inner.append(inner_an.analyze(conj))
+            except AnalysisError:
+                correlated.append(combined_an.analyze(conj))
+        # after the conjunct loop: scalar subqueries inside the EXISTS WHERE
+        # register params on the sub-planner during analysis above
+        self.scalar_subqueries.update(sub.scalar_subqueries)
+        outer_syms = {f.symbol for f in scope.fields}
+        lkeys, rkeys, residual = _extract_equi_keys(correlated, outer_syms, inner_syms)
+        if not lkeys:
+            raise AnalysisError("uncorrelated / non-equi-correlated EXISTS unsupported")
+        build = rel.node
+        if pure_inner:
+            build = Filter(build, combine_conjuncts(pure_inner))
+        return SemiJoin(node, build, lkeys, rkeys, c.negated,
+                        residual=combine_conjuncts(residual), null_aware=False)
+
+    # -- window functions -------------------------------------------------
+
+    def _plan_windows(self, node: PlanNode, analyzer: "ExprAnalyzer",
+                      windows: List[ast.WindowFunction]) -> PlanNode:
+        """Lower window function instances onto the plan: pre-project any
+        computed inputs, group instances by (partition, order) spec, stack a
+        Window node per spec, and register replacements so the select/order
+        analyzers resolve each OVER() expression to its output symbol
+        (reference: sql/planner/QueryPlanner.window + WindowNode)."""
+        from presto_tpu.plan.nodes import Window, WindowFunc
+
+        pre_exprs: List[Tuple[str, RowExpression]] = [
+            (s, InputRef(t, s)) for s, t in node.output
+        ]
+        added = False
+
+        def to_symbol(e_ast) -> Tuple[str, Type]:
+            nonlocal added
+            e = analyzer.analyze(_rewrite_aggs_to_keys(e_ast))
+            if isinstance(e, InputRef):
+                return e.name, e.type
+            sym = self.symbols.fresh("winexpr")
+            pre_exprs.append((sym, e))
+            added = True
+            return sym, e.type
+
+        def const_int(e_ast, what: str) -> int:
+            e = analyzer.analyze(e_ast)
+            if not isinstance(e, Constant) or e.value is None:
+                raise AnalysisError(f"{what} must be an integer literal")
+            return int(e.value)
+
+        specs: Dict[tuple, tuple] = {}
+        for w in windows:
+            key = ast_key(w)
+            if key in analyzer.replacements:
+                continue
+            part_syms = [to_symbol(p)[0] for p in w.partition_by]
+            order_items = [
+                SortItem(to_symbol(oi.expr)[0], oi.ascending, oi.nulls_first)
+                for oi in w.order_by
+            ]
+            name = w.name.lower()
+            arg_sym: Optional[str] = None
+            param: Optional[int] = None
+            if name in ("row_number", "rank", "dense_rank"):
+                t: Type = BIGINT
+            elif name in ("percent_rank", "cume_dist"):
+                t = DOUBLE
+            elif name == "ntile":
+                param = const_int(w.args[0], "ntile buckets")
+                t = BIGINT
+            elif name in ("lag", "lead"):
+                arg_sym, t = to_symbol(w.args[0])
+                param = const_int(w.args[1], f"{name} offset") if len(w.args) > 1 else 1
+                if len(w.args) > 2:
+                    raise AnalysisError(f"{name} default value not supported")
+            elif name in ("first_value", "last_value"):
+                arg_sym, t = to_symbol(w.args[0])
+            elif name == "nth_value":
+                arg_sym, t = to_symbol(w.args[0])
+                param = const_int(w.args[1], "nth_value n")
+            elif name in _AGG_FUNCS:
+                if w.is_star or (name == "count" and not w.args):
+                    name, t = "count", BIGINT
+                else:
+                    arg_sym, arg_t = to_symbol(w.args[0])
+                    t = _agg_output_type(name, arg_t, False)
+            else:
+                raise AnalysisError(f"unknown window function {name}")
+            if name in ("row_number", "rank", "dense_rank", "percent_rank",
+                        "cume_dist", "ntile", "lag", "lead") and not w.order_by:
+                raise AnalysisError(f"{name}() requires ORDER BY in its OVER clause")
+            wsym = self.symbols.fresh(name)
+            skey = (
+                tuple(part_syms),
+                tuple((o.symbol, o.ascending, o.nulls_first) for o in order_items),
+            )
+            if skey not in specs:
+                specs[skey] = (part_syms, order_items, [])
+            specs[skey][2].append(
+                WindowFunc(wsym, name, t, arg_sym, param, frame=w.frame)
+            )
+            analyzer.replacements[key] = (wsym, t)
+
+        if added:
+            node = Project(node, pre_exprs)
+        for part_syms, order_items, funcs in specs.values():
+            node = Window(node, part_syms, order_items, funcs)
+        return node
 
     # -- scalar subqueries ------------------------------------------------
 
@@ -974,6 +1208,8 @@ def _ast_children(n):
         return out
     if isinstance(n, ast.Extract):
         return [n.value]
+    if isinstance(n, ast.WindowFunction):
+        return list(n.args) + list(n.partition_by) + [o.expr for o in n.order_by]
     return []
 
 

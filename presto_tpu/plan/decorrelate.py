@@ -120,20 +120,19 @@ def _factor_or(c) -> List:
 
 def _find_correlation(
     sub: ast.Query, catalog: Catalog, ctes: Dict[str, ast.Query]
-) -> Optional[Tuple[ast.Identifier, ast.Identifier, List]]:
-    """If sub's WHERE contains exactly one `inner_col = outer_col` conjunct
-    (one side resolving in sub's FROM, the other not), return
-    (outer_ident, inner_ident, remaining_conjuncts)."""
+) -> Optional[Tuple[List[Tuple[ast.Identifier, ast.Identifier]], List]]:
+    """If sub's WHERE contains `inner_col = outer_col` conjuncts (one side
+    resolving in sub's FROM, the other not), return
+    ([(outer_ident, inner_ident), ...], remaining_conjuncts)."""
     if sub.where is None:
         return None
     inner_cols = _relation_columns(sub.from_, catalog, ctes)
     conjs = _split_conjuncts(sub.where)
-    corr = None
+    pairs: List[Tuple[ast.Identifier, ast.Identifier]] = []
     rest = []
     for c in conjs:
         if (
-            corr is None
-            and isinstance(c, ast.BinaryOp)
+            isinstance(c, ast.BinaryOp)
             and c.op == "eq"
             and isinstance(c.left, ast.Identifier)
             and isinstance(c.right, ast.Identifier)
@@ -141,13 +140,13 @@ def _find_correlation(
             l_in = c.left.parts[-1] in inner_cols and len(c.left.parts) == 1
             r_in = c.right.parts[-1] in inner_cols and len(c.right.parts) == 1
             if l_in and not r_in:
-                corr = (c.right, c.left)
+                pairs.append((c.right, c.left))
                 continue
             if r_in and not l_in:
-                corr = (c.left, c.right)
+                pairs.append((c.left, c.right))
                 continue
         rest.append(c)
-    if corr is None:
+    if not pairs:
         return None
     # any remaining outer references → too correlated for these rewrites
     outer_refs = set()
@@ -165,7 +164,7 @@ def _find_correlation(
         scan(it.expr)
     if outer_refs:
         return None
-    return corr[0], corr[1], rest
+    return pairs, rest
 
 
 def _children(n):
@@ -208,19 +207,8 @@ class Decorrelator:
 
     def _rewrite_conjunct(self, c):
         self._pending = getattr(self, "_pending", [])
-        # EXISTS → IN
-        if isinstance(c, ast.Exists):
-            corr = _find_correlation(c.query, self.catalog, self.ctes)
-            if corr is None:
-                return c
-            outer, inner, rest = corr
-            sub = ast.Query(
-                select=[ast.SelectItem(inner, None)],
-                from_=c.query.from_,
-                where=_combine(rest),
-            )
-            sub.ctes = c.query.ctes
-            return ast.InSubquery(outer, sub, negated=c.negated)
+        # EXISTS stays an AST node — the planner lowers it directly to a
+        # SemiJoin with keys + residual (null_aware=False)
         # comparisons containing correlated scalar aggregates
         if isinstance(c, ast.BinaryOp) and c.op in ("eq", "ne", "lt", "le", "gt", "ge"):
             c.left = self._rewrite_scalar(c.left)
@@ -243,21 +231,24 @@ class Decorrelator:
             corr = _find_correlation(sub, self.catalog, self.ctes)
             if corr is None:
                 return e  # uncorrelated: handled as a Param at plan time
-            outer, inner, rest = corr
+            pairs, rest = corr
             self.counter += 1
             alias = f"__dt{self.counter}"
+            key_items = [
+                ast.SelectItem(inner, f"__ck{i}") for i, (_, inner) in enumerate(pairs)
+            ]
             dq = ast.Query(
-                select=[
-                    ast.SelectItem(inner, "__ck"),
-                    ast.SelectItem(sub.select[0].expr, "__agg"),
-                ],
+                select=key_items + [ast.SelectItem(sub.select[0].expr, "__agg")],
                 from_=sub.from_,
                 where=_combine(rest),
-                group_by=[inner],
+                group_by=[inner for _, inner in pairs],
             )
             dq.ctes = sub.ctes
             dt = ast.SubqueryRelation(dq, alias)
-            cond = ast.BinaryOp("eq", ast.Identifier((alias, "__ck")), outer)
+            cond = _combine([
+                ast.BinaryOp("eq", ast.Identifier((alias, f"__ck{i}")), outer)
+                for i, (outer, _) in enumerate(pairs)
+            ])
             self._pending.append((dt, cond))
             return ast.Identifier((alias, "__agg"))
         if isinstance(e, ast.BinaryOp):

@@ -116,14 +116,21 @@ class HashJoin(PlanNode):
 
 @dataclasses.dataclass
 class SemiJoin(PlanNode):
-    """left [NOT] IN (subquery) / EXISTS — probe side filtered by membership
-    (reference: HashSemiJoinOperator / SemiJoinNode)."""
+    """left [NOT] IN (subquery) / [NOT] EXISTS — probe side filtered by
+    membership (reference: HashSemiJoinOperator / SemiJoinNode). Multi-key
+    with an optional residual predicate over (probe ∪ build) columns covers
+    correlated EXISTS with non-equi correlation (TPC-H Q21's
+    `l2.l_suppkey <> l1.l_suppkey`)."""
 
     left: PlanNode
     right: PlanNode
-    left_key: str
-    right_key: str
+    left_keys: List[str]
+    right_keys: List[str]
     negated: bool = False
+    residual: Optional[RowExpression] = None
+    # True for [NOT] IN (NULL key ⇒ NULL membership), False for [NOT] EXISTS
+    # (NULL correlation key simply never matches)
+    null_aware: bool = True
 
     @property
     def output(self):
@@ -138,6 +145,41 @@ class SortItem:
     symbol: str
     ascending: bool = True
     nulls_first: Optional[bool] = None
+
+
+@dataclasses.dataclass
+class WindowFunc:
+    """One window function instance (reference: operator/window/*)."""
+
+    symbol: str
+    fn: str                       # row_number|rank|dense_rank|percent_rank|
+                                  # cume_dist|ntile|lag|lead|first_value|
+                                  # last_value|nth_value|sum|avg|min|max|count
+    type: Type
+    arg: Optional[str] = None     # input column symbol (value functions/aggs)
+    param: Optional[int] = None   # ntile buckets / lag-lead offset / nth n
+    # None = default frame (RANGE UNBOUNDED..CURRENT with ORDER BY, whole
+    # partition without); "rows_unbounded_current" = explicit ROWS frame
+    frame: Optional[str] = None
+
+
+@dataclasses.dataclass
+class Window(PlanNode):
+    """Window functions over one (PARTITION BY, ORDER BY) spec. Multiple
+    specs chain as stacked Window nodes (reference: WindowOperator.java:47;
+    the local planner similarly splits by specification)."""
+
+    child: PlanNode
+    partition_keys: List[str]
+    order_items: List[SortItem]
+    funcs: List[WindowFunc]
+
+    @property
+    def output(self):
+        return list(self.child.output) + [(f.symbol, f.type) for f in self.funcs]
+
+    def children(self):
+        return [self.child]
 
 
 @dataclasses.dataclass
@@ -208,10 +250,15 @@ def plan_to_string(node: PlanNode, indent: int = 0, node_stats=None) -> str:
     elif isinstance(node, HashJoin):
         s = f"{pad}HashJoin[{node.kind}; {node.left_keys} = {node.right_keys}{'; unique' if node.build_unique else ''}]"
     elif isinstance(node, SemiJoin):
-        s = f"{pad}SemiJoin[{'NOT ' if node.negated else ''}{node.left_key} IN {node.right_key}]"
+        s = (f"{pad}SemiJoin[{'NOT ' if node.negated else ''}{node.left_keys} IN "
+             f"{node.right_keys}{f'; residual={node.residual}' if node.residual else ''}]")
     elif isinstance(node, Sort):
         keys = ", ".join(f"{k.symbol}{'' if k.ascending else ' desc'}" for k in node.keys)
         s = f"{pad}Sort[{keys}{f'; limit={node.limit}' if node.limit else ''}]"
+    elif isinstance(node, Window):
+        fns = ", ".join(f"{f.symbol} := {f.fn}({f.arg or ''})" for f in node.funcs)
+        s = (f"{pad}Window[partition={node.partition_keys}; "
+             f"order={[k.symbol for k in node.order_items]}; {fns}]")
     elif isinstance(node, Limit):
         s = f"{pad}Limit[{node.count}]"
     elif isinstance(node, Output):

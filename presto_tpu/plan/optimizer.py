@@ -41,6 +41,7 @@ from presto_tpu.plan.nodes import (
     SemiJoin,
     Sort,
     TableScan,
+    Window,
 )
 from presto_tpu.types import BOOLEAN
 
@@ -227,9 +228,21 @@ def prune_columns(node: PlanNode, required: Set[str]) -> PlanNode:
         node.right = prune_columns(node.right, need & rsyms)
         return node
     if isinstance(node, SemiJoin):
-        need = required | {node.left_key}
-        node.left = prune_columns(node.left, need)
-        node.right = prune_columns(node.right, {node.right_key})
+        res_syms = expr_inputs(node.residual) if node.residual is not None else set()
+        rsyms = {n for n, _ in node.right.output}
+        node.left = prune_columns(
+            node.left, required | set(node.left_keys) | (res_syms - rsyms)
+        )
+        node.right = prune_columns(
+            node.right, set(node.right_keys) | (res_syms & rsyms)
+        )
+        return node
+    if isinstance(node, Window):
+        need = set(required) - {f.symbol for f in node.funcs}
+        need |= set(node.partition_keys)
+        need |= {k.symbol for k in node.order_items}
+        need |= {f.arg for f in node.funcs if f.arg}
+        node.child = prune_columns(node.child, need)
         return node
     if isinstance(node, Sort):
         need = required | {k.symbol for k in node.keys}

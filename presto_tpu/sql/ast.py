@@ -110,6 +110,21 @@ class FunctionCall(Node):
 
 
 @dataclasses.dataclass
+class WindowFunction(Node):
+    """fn(args) OVER (PARTITION BY ... ORDER BY ... [frame])."""
+
+    name: str
+    args: List[Node]
+    partition_by: List[Node]
+    order_by: List["OrderItem"]
+    is_star: bool = False
+    # frame: None = default (RANGE UNBOUNDED..CURRENT with ORDER BY, whole
+    # partition otherwise); "rows_unbounded_current" = ROWS UNBOUNDED
+    # PRECEDING..CURRENT ROW
+    frame: object = None
+
+
+@dataclasses.dataclass
 class Cast(Node):
     value: Node
     type_name: str

@@ -24,7 +24,8 @@ _KEYWORDS = {
     "join", "inner", "left", "right", "full", "outer", "cross", "on",
     "distinct", "all", "asc", "desc", "nulls", "first", "last", "exists",
     "date", "interval", "day", "month", "year", "extract", "with", "union",
-    "substring", "for",
+    "substring", "for", "over", "partition", "rows", "range", "unbounded",
+    "preceding", "following", "current", "row",
 }
 
 _TOKEN_RE = re.compile(
@@ -500,20 +501,54 @@ class Parser:
                 self.next()
                 if self.accept_op("*"):
                     self.expect_op(")")
-                    return ast.FunctionCall(name, [], is_star=True)
-                distinct = bool(self.accept_kw("distinct"))
-                args = []
-                if not (self.peek().kind == "op" and self.peek().value == ")"):
-                    args.append(self.parse_expr())
-                    while self.accept_op(","):
+                    fc = ast.FunctionCall(name, [], is_star=True)
+                else:
+                    distinct = bool(self.accept_kw("distinct"))
+                    args = []
+                    if not (self.peek().kind == "op" and self.peek().value == ")"):
                         args.append(self.parse_expr())
-                self.expect_op(")")
-                return ast.FunctionCall(name, args, distinct=distinct)
+                        while self.accept_op(","):
+                            args.append(self.parse_expr())
+                    self.expect_op(")")
+                    fc = ast.FunctionCall(name, args, distinct=distinct)
+                if self.accept_kw("over"):
+                    return self.parse_over(fc)
+                return fc
             parts = [name]
             while self.accept_op("."):
                 parts.append(self.ident())
             return ast.Identifier(tuple(parts))
         raise ParseError(f"unexpected token {t!r}")
+
+    def parse_over(self, fc: ast.FunctionCall) -> ast.Node:
+        """OVER (PARTITION BY ... ORDER BY ... [ROWS|RANGE frame])."""
+        self.expect_op("(")
+        partition_by = []
+        order_by = []
+        frame = None
+        if self.accept_kw("partition"):
+            self.expect_kw("by")
+            partition_by.append(self.parse_expr())
+            while self.accept_op(","):
+                partition_by.append(self.parse_expr())
+        if self.accept_kw("order"):
+            self.expect_kw("by")
+            order_by.append(self.parse_order_item())
+            while self.accept_op(","):
+                order_by.append(self.parse_order_item())
+        if self.accept_kw("rows") or self.accept_kw("range"):
+            # only the default-equivalent frames are accepted
+            self.expect_kw("between")
+            self.expect_kw("unbounded")
+            self.expect_kw("preceding")
+            self.expect_kw("and")
+            self.expect_kw("current")
+            self.expect_kw("row")
+            frame = "rows_unbounded_current"
+        self.expect_op(")")
+        return ast.WindowFunction(
+            fc.name, fc.args, partition_by, order_by, fc.is_star, frame
+        )
 
     def parse_case(self) -> ast.Node:
         self.expect_kw("case")

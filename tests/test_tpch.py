@@ -698,3 +698,125 @@ def test_q8(runner, tables, frames_match):
     g = m.groupby("o_year").agg(num=("bz", "sum"), den=("volume", "sum"))
     exp = pd.DataFrame({"o_year": g.index, "mkt_share": (g.num / g.den).values}).reset_index(drop=True)
     frames_match(got, exp, rtol=1e-9, check_order=True)
+
+
+def test_q20(runner, tables, frames_match):
+    got = runner.run(
+        """
+        select s_name, s_address
+        from supplier, nation
+        where s_suppkey in (
+            select ps_suppkey from partsupp
+            where ps_partkey in (select p_partkey from part where p_name like 'forest%')
+              and ps_availqty > (
+                select 0.5 * sum(l_quantity) from lineitem
+                where l_partkey = ps_partkey and l_suppkey = ps_suppkey
+                  and l_shipdate >= date '1994-01-01'
+                  and l_shipdate < date '1995-01-01')
+          )
+          and s_nationkey = n_nationkey and n_name = 'CANADA'
+        order by s_name
+        """
+    )
+    t = tables
+    li = t["lineitem"]
+    li = li[(li.l_shipdate >= _d("1994-01-01")) & (li.l_shipdate < _d("1995-01-01"))]
+    half = (
+        li.groupby(["l_partkey", "l_suppkey"]).l_quantity.sum().mul(0.5)
+        .reset_index(name="half_qty")
+    )
+    parts = set(t["part"][t["part"].p_name.str.startswith("forest")].p_partkey)
+    ps = t["partsupp"][t["partsupp"].ps_partkey.isin(parts)]
+    ps = ps.merge(half, left_on=["ps_partkey", "ps_suppkey"],
+                  right_on=["l_partkey", "l_suppkey"])
+    ps = ps[ps.ps_availqty > ps.half_qty]
+    supp = set(ps.ps_suppkey)
+    s = t["supplier"].merge(t["nation"], left_on="s_nationkey", right_on="n_nationkey")
+    s = s[(s.n_name == "CANADA") & s.s_suppkey.isin(supp)]
+    exp = s[["s_name", "s_address"]].sort_values("s_name").reset_index(drop=True)
+    frames_match(got, exp, check_order=True)
+
+
+def test_q21(runner, tables, frames_match):
+    got = runner.run(
+        """
+        select s_name, count(*) as numwait
+        from supplier, lineitem l1, orders, nation
+        where s_suppkey = l1.l_suppkey and o_orderkey = l1.l_orderkey
+          and o_orderstatus = 'F' and l1.l_receiptdate > l1.l_commitdate
+          and exists (select * from lineitem l2
+                      where l2.l_orderkey = l1.l_orderkey
+                        and l2.l_suppkey <> l1.l_suppkey)
+          and not exists (select * from lineitem l3
+                          where l3.l_orderkey = l1.l_orderkey
+                            and l3.l_suppkey <> l1.l_suppkey
+                            and l3.l_receiptdate > l3.l_commitdate)
+          and s_nationkey = n_nationkey and n_name = 'SAUDI ARABIA'
+        group by s_name
+        order by numwait desc, s_name
+        limit 100
+        """
+    )
+    t = tables
+    li = t["lineitem"]
+    l1 = (
+        t["supplier"]
+        .merge(t["nation"], left_on="s_nationkey", right_on="n_nationkey")
+        .merge(li, left_on="s_suppkey", right_on="l_suppkey")
+        .merge(t["orders"], left_on="l_orderkey", right_on="o_orderkey")
+    )
+    l1 = l1[(l1.n_name == "SAUDI ARABIA") & (l1.o_orderstatus == "F")
+            & (l1.l_receiptdate > l1.l_commitdate)]
+
+    def has_other(df, row_ok, row_sk):
+        sub = li[li.l_orderkey == row_ok]
+        return (sub.l_suppkey != row_sk).any()
+
+    def has_other_late(row_ok, row_sk):
+        sub = li[(li.l_orderkey == row_ok) & (li.l_receiptdate > li.l_commitdate)]
+        return (sub.l_suppkey != row_sk).any()
+
+    keep = [
+        has_other(li, r.l_orderkey, r.l_suppkey) and not has_other_late(r.l_orderkey, r.l_suppkey)
+        for r in l1.itertuples()
+    ]
+    l1 = l1[np.asarray(keep, dtype=bool)] if len(l1) else l1
+    exp = (
+        l1.groupby("s_name").size().reset_index(name="numwait")
+        .sort_values(["numwait", "s_name"], ascending=[False, True])
+        .head(100).reset_index(drop=True)
+    )
+    frames_match(got, exp, check_order=True)
+
+
+def test_q22(runner, tables, frames_match):
+    got = runner.run(
+        """
+        select cntrycode, count(*) as numcust, sum(c_acctbal) as totacctbal
+        from (
+          select substring(c_phone from 1 for 2) as cntrycode, c_acctbal
+          from customer
+          where substring(c_phone from 1 for 2) in ('13','31','23','29','30','18','17')
+            and c_acctbal > (
+               select avg(c_acctbal) from customer
+               where c_acctbal > 0.00
+                 and substring(c_phone from 1 for 2) in ('13','31','23','29','30','18','17'))
+            and not exists (select * from orders where o_custkey = c_custkey)
+        ) as custsale
+        group by cntrycode
+        order by cntrycode
+        """
+    )
+    t = tables
+    c = t["customer"].assign(cntrycode=t["customer"].c_phone.str[:2])
+    codes = {"13", "31", "23", "29", "30", "18", "17"}
+    sel = c[c.cntrycode.isin(codes)]
+    avg_bal = sel[sel.c_acctbal > 0].c_acctbal.mean()
+    cust_with_orders = set(t["orders"].o_custkey)
+    m = sel[(sel.c_acctbal > avg_bal) & ~sel.c_custkey.isin(cust_with_orders)]
+    exp = (
+        m.groupby("cntrycode")
+        .agg(numcust=("c_acctbal", "size"), totacctbal=("c_acctbal", "sum"))
+        .reset_index().sort_values("cntrycode").reset_index(drop=True)
+    )
+    frames_match(got, exp, check_order=True)
