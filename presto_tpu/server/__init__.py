@@ -1,0 +1,2 @@
+"""Control plane: sessions, query lifecycle, admission, coordinator/worker
+services (reference: presto-main server/ + execution/ packages)."""

@@ -1,0 +1,209 @@
+"""Hierarchical resource groups — admission control for query execution.
+
+Analog of execution/resourceGroups/InternalResourceGroup.java +
+InternalResourceGroupManager and the file-based configuration manager
+(presto-resource-group-managers FileResourceGroupConfigurationManager.java):
+a tree of groups, each with concurrency/queue limits and a scheduling
+policy; selectors route an incoming query (by user/source) to a leaf group;
+queries queue when their group (or any ancestor) is at its hard concurrency
+limit and start in policy order as slots free up.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import heapq
+import itertools
+import re
+import threading
+from typing import Callable, Dict, List, Optional
+
+
+class QueryQueueFullError(RuntimeError):
+    pass
+
+
+@dataclasses.dataclass
+class ResourceGroupSpec:
+    """Config for one group (reference: ResourceGroupSpec in the file
+    config manager; `${USER}` expansion as in `global.adhoc.${USER}`)."""
+
+    name: str
+    hard_concurrency_limit: int = 100
+    max_queued: int = 1000
+    scheduling_policy: str = "fair"  # fair | weighted_fair | query_priority
+    scheduling_weight: int = 1
+    soft_memory_limit_fraction: float = 1.0
+    subgroups: List["ResourceGroupSpec"] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class SelectorSpec:
+    """user/source regex → group id template (reference: SelectorSpec)."""
+
+    group: str
+    user_regex: Optional[str] = None
+    source_regex: Optional[str] = None
+
+    def matches(self, user: str, source: str) -> bool:
+        if self.user_regex is not None and not re.search(self.user_regex, user or ""):
+            return False
+        if self.source_regex is not None and not re.search(
+            self.source_regex, source or ""
+        ):
+            return False
+        return True
+
+
+class _Group:
+    def __init__(self, spec: ResourceGroupSpec, parent: Optional["_Group"]):
+        self.spec = spec
+        self.parent = parent
+        self.id = spec.name if parent is None else f"{parent.id}.{spec.name}"
+        self.children: Dict[str, "_Group"] = {}
+        self.running = 0
+        self.queued: List = []  # heap of (sort_key, seq, entry)
+        self._seq = itertools.count()
+        for sub in spec.subgroups:
+            self.children[sub.name] = _Group(sub, self)
+
+    # -- capacity ----------------------------------------------------------
+
+    def can_run(self) -> bool:
+        g: Optional[_Group] = self
+        while g is not None:
+            if g.running >= g.spec.hard_concurrency_limit:
+                return False
+            g = g.parent
+        return True
+
+    def total_queued(self) -> int:
+        return len(self.queued) + sum(c.total_queued() for c in self.children.values())
+
+    # -- queue order -------------------------------------------------------
+
+    def _sort_key(self, priority: int):
+        if self.spec.scheduling_policy == "query_priority":
+            return -priority
+        if self.spec.scheduling_policy == "weighted_fair":
+            # smaller running/weight ratio first — approximated at enqueue
+            return self.running / max(1, self.spec.scheduling_weight)
+        return 0  # fair = FIFO via seq tiebreak
+
+    def enqueue(self, entry, priority: int):
+        if len(self.queued) >= self.spec.max_queued:
+            raise QueryQueueFullError(
+                f"Too many queued queries for {self.id!r} "
+                f"(max_queued={self.spec.max_queued})"
+            )
+        heapq.heappush(self.queued, (self._sort_key(priority), next(self._seq), entry))
+
+    def dequeue(self):
+        if not self.queued:
+            return None
+        return heapq.heappop(self.queued)[2]
+
+    def start(self):
+        g: Optional[_Group] = self
+        while g is not None:
+            g.running += 1
+            g = g.parent
+
+    def finish(self):
+        g: Optional[_Group] = self
+        while g is not None:
+            g.running -= 1
+            g = g.parent
+
+    def walk(self):
+        yield self
+        for c in self.children.values():
+            yield from c.walk()
+
+
+class ResourceGroupManager:
+    """Routes queries to groups and gates their start
+    (InternalResourceGroupManager.submit → group.run or group.queue)."""
+
+    def __init__(
+        self,
+        root: Optional[ResourceGroupSpec] = None,
+        selectors: Optional[List[SelectorSpec]] = None,
+    ):
+        self._lock = threading.Lock()
+        self.root = _Group(root or ResourceGroupSpec("global"), None)
+        self.selectors = selectors or [SelectorSpec(group=self.root.id)]
+
+    def _resolve(self, group_id: str, user: str) -> _Group:
+        group_id = group_id.replace("${USER}", user)
+        parts = group_id.split(".")
+        if parts[0] != self.root.spec.name:
+            raise KeyError(f"unknown resource group {group_id!r}")
+        g = self.root
+        for p in parts[1:]:
+            if p not in g.children:
+                # dynamic per-user leaf (the `${USER}` pattern): inherit limits
+                g.children[p] = _Group(
+                    dataclasses.replace(g.spec, name=p, subgroups=[]), g
+                )
+            g = g.children[p]
+        return g
+
+    def select(self, user: str, source: str) -> _Group:
+        for sel in self.selectors:
+            if sel.matches(user, source):
+                return self._resolve(sel.group, user)
+        raise QueryQueueFullError(
+            f"no resource group matches user={user!r} source={source!r}"
+        )
+
+    def submit(self, user: str, source: str, priority: int,
+               start_fn: Callable[[], None],
+               on_group: Optional[Callable[[str], None]] = None) -> str:
+        """Admit (calls start_fn now) or queue (start_fn called later when a
+        slot frees). `on_group` is invoked with the resolved group id BEFORE
+        start_fn can run — callers that release the slot from a completion
+        callback need the id recorded first. Raises QueryQueueFullError when
+        the group's queue is full."""
+        with self._lock:
+            g = self.select(user, source)
+            if on_group is not None:
+                on_group(g.id)
+            if g.can_run():
+                g.start()
+                run_now = True
+            else:
+                g.enqueue(start_fn, priority)
+                run_now = False
+        if run_now:
+            start_fn()
+        return g.id
+
+    def query_finished(self, group_id: str, user: str = ""):
+        """Release the slot and start queued queries that now fit."""
+        to_start = []
+        with self._lock:
+            g = self._resolve(group_id, user)
+            g.finish()
+            # drain eligible queued entries anywhere in the tree (a released
+            # ancestor slot can unblock several leaves)
+            for grp in self.root.walk():
+                while grp.queued and grp.can_run():
+                    entry = grp.dequeue()
+                    grp.start()
+                    to_start.append(entry)
+        for fn in to_start:
+            fn()
+
+    def info(self) -> Dict:
+        with self._lock:
+            return {
+                g.id: {
+                    "running": g.running,
+                    "queued": len(g.queued),
+                    "hard_concurrency_limit": g.spec.hard_concurrency_limit,
+                    "max_queued": g.spec.max_queued,
+                    "policy": g.spec.scheduling_policy,
+                }
+                for g in self.root.walk()
+            }

@@ -1,0 +1,233 @@
+"""Session + system session properties.
+
+Analog of presto-main's Session.java + SystemSessionProperties.java (1,099
+lines of typed PropertyMetadata definitions: join_distribution_type:59,
+grouped_execution_*:66-69, pushdown_subfields_enabled:132, ...). A Session
+carries the per-query identity, catalog/schema defaults, and a bag of typed
+property overrides; `exec_config()` lowers the system properties into the
+engine's ExecConfig the way Presto lowers them into TaskManagerConfig /
+FeaturesConfig-derived per-query settings.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from presto_tpu.exec.runtime import ExecConfig
+
+
+class SessionPropertyError(ValueError):
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class PropertyMetadata:
+    """Typed session property (reference: spi/session/PropertyMetadata)."""
+
+    name: str
+    description: str
+    py_type: type
+    default: Any
+    hidden: bool = False
+    decoder: Optional[Callable[[str], Any]] = None
+    validator: Optional[Callable[[Any], None]] = None
+
+    def decode(self, raw: Any) -> Any:
+        if isinstance(raw, str) and self.py_type is not str:
+            if self.decoder is not None:
+                v = self.decoder(raw)
+            elif self.py_type is bool:
+                low = raw.strip().lower()
+                if low not in ("true", "false"):
+                    raise SessionPropertyError(
+                        f"{self.name}: expected boolean, got {raw!r}"
+                    )
+                v = low == "true"
+            elif self.py_type is int:
+                try:
+                    v = int(raw.strip())
+                except ValueError:
+                    raise SessionPropertyError(
+                        f"{self.name}: expected integer, got {raw!r}"
+                    )
+            elif self.py_type is float:
+                try:
+                    v = float(raw.strip())
+                except ValueError:
+                    raise SessionPropertyError(
+                        f"{self.name}: expected number, got {raw!r}"
+                    )
+            else:
+                v = raw
+        else:
+            v = raw
+            if self.py_type is float and isinstance(v, int):
+                v = float(v)
+            if not isinstance(v, self.py_type) and v is not None:
+                raise SessionPropertyError(
+                    f"{self.name}: expected {self.py_type.__name__}, got {type(v).__name__}"
+                )
+        if self.validator is not None:
+            self.validator(v)
+        return v
+
+
+def _enum(name: str, allowed: List[str]) -> Callable[[Any], None]:
+    def check(v):
+        if v is not None and v.upper() not in allowed:
+            raise SessionPropertyError(f"{name}: must be one of {allowed}, got {v!r}")
+
+    return check
+
+
+def _positive(name: str) -> Callable[[Any], None]:
+    def check(v):
+        if v is not None and v <= 0:
+            raise SessionPropertyError(f"{name}: must be positive, got {v}")
+
+    return check
+
+
+class SystemSessionProperties:
+    """The engine's per-query flag registry (SystemSessionProperties.java)."""
+
+    def __init__(self):
+        self._props: Dict[str, PropertyMetadata] = {}
+        for p in self._defaults():
+            self._props[p.name] = p
+
+    @staticmethod
+    def _defaults() -> List[PropertyMetadata]:
+        return [
+            # engine execution shape (reference: TaskManagerConfig + task_concurrency)
+            PropertyMetadata("batch_rows", "Rows per scan batch", int, 1 << 17,
+                             validator=_positive("batch_rows")),
+            PropertyMetadata("agg_capacity", "Initial group-table capacity", int, 1 << 12,
+                             validator=_positive("agg_capacity")),
+            PropertyMetadata("join_out_capacity",
+                             "Join output chunk capacity (default: probe batch)",
+                             int, None),
+            PropertyMetadata("max_growth_retries",
+                             "Max geometric capacity growth retries", int, 24),
+            PropertyMetadata("collect_stats",
+                             "Per-operator stats (EXPLAIN ANALYZE)", bool, False),
+            # distribution (reference: join_distribution_type:59, hash_partition_count)
+            PropertyMetadata("join_distribution_type",
+                             "AUTOMATIC | PARTITIONED | BROADCAST", str, "AUTOMATIC",
+                             validator=_enum("join_distribution_type",
+                                             ["AUTOMATIC", "PARTITIONED", "BROADCAST"])),
+            PropertyMetadata("hash_partition_count",
+                             "Default partitions for hash exchanges", int, 8,
+                             validator=_positive("hash_partition_count")),
+            PropertyMetadata("redistribute_writes", "Redistribute before write",
+                             bool, True),
+            # resource limits (reference: query_max_memory, query_max_run_time)
+            PropertyMetadata("query_max_memory_mb",
+                             "Per-query device memory limit (MB)", int, 16384),
+            PropertyMetadata("query_max_run_time_s",
+                             "Wall-clock limit per query (s)", float, 3600.0),
+            PropertyMetadata("query_priority", "Priority within resource group",
+                             int, 1),
+            # spill (reference: spill_enabled / MemoryRevokingScheduler thresholds)
+            PropertyMetadata("spill_enabled", "Allow spilling to host", bool, True),
+            PropertyMetadata("memory_revoking_threshold",
+                             "Pool fraction that triggers revocation", float, 0.9),
+            PropertyMetadata("memory_revoking_target",
+                             "Pool fraction revocation aims for", float, 0.5),
+            # planner
+            PropertyMetadata("optimize_plan", "Run optimizer passes", bool, True),
+            PropertyMetadata("execution_policy", "all-at-once | phased", str,
+                             "all-at-once"),
+        ]
+
+    def names(self) -> List[str]:
+        return sorted(self._props)
+
+    def metadata(self, name: str) -> PropertyMetadata:
+        if name not in self._props:
+            raise SessionPropertyError(f"unknown session property: {name}")
+        return self._props[name]
+
+    def default(self, name: str) -> Any:
+        return self.metadata(name).default
+
+    def decode(self, name: str, raw: Any) -> Any:
+        return self.metadata(name).decode(raw)
+
+    def register(self, prop: PropertyMetadata):
+        self._props[prop.name] = prop
+
+
+SYSTEM_PROPERTIES = SystemSessionProperties()
+
+_query_counter = itertools.count(1)
+
+
+def new_query_id() -> str:
+    """Presto query ids look like 20190101_000000_00000_abcde; ours carry a
+    date bucket + counter (reference: QueryIdGenerator)."""
+    n = next(_query_counter)
+    return f"{time.strftime('%Y%m%d_%H%M%S')}_{n:05d}"
+
+
+@dataclasses.dataclass
+class Session:
+    """Per-query session (reference: Session.java — identity, defaults,
+    property overrides, start time)."""
+
+    user: str = "user"
+    source: str = ""
+    catalog: Optional[str] = None
+    schema: Optional[str] = None
+    query_id: str = ""
+    start_time: float = 0.0
+    properties: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    # catalog_name -> {prop: value} (reference: per-connector session props)
+    connector_properties: Dict[str, Dict[str, Any]] = dataclasses.field(
+        default_factory=dict
+    )
+    resource_group: Optional[str] = None
+
+    def __post_init__(self):
+        if not self.query_id:
+            self.query_id = new_query_id()
+        if not self.start_time:
+            self.start_time = time.time()
+
+    def get(self, name: str) -> Any:
+        if name in self.properties:
+            return self.properties[name]
+        return SYSTEM_PROPERTIES.default(name)
+
+    def set(self, name: str, raw: Any):
+        self.properties[name] = SYSTEM_PROPERTIES.decode(name, raw)
+
+    def unset(self, name: str):
+        SYSTEM_PROPERTIES.metadata(name)  # validate the name
+        self.properties.pop(name, None)
+
+    def child(self) -> "Session":
+        """A fresh query-scoped session inheriting this session's overrides
+        (the client session persists across queries; each query gets its own
+        id/start time)."""
+        return Session(
+            user=self.user,
+            source=self.source,
+            catalog=self.catalog,
+            schema=self.schema,
+            properties=dict(self.properties),
+            connector_properties={k: dict(v) for k, v in self.connector_properties.items()},
+            resource_group=self.resource_group,
+        )
+
+    def exec_config(self) -> ExecConfig:
+        return ExecConfig(
+            batch_rows=self.get("batch_rows"),
+            agg_capacity=self.get("agg_capacity"),
+            join_out_capacity=self.get("join_out_capacity"),
+            max_growth_retries=self.get("max_growth_retries"),
+            collect_stats=self.get("collect_stats"),
+        )

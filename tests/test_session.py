@@ -1,0 +1,228 @@
+"""Session properties, resource groups, query manager lifecycle
+(reference tests: TestSessionPropertyManager, TestResourceGroups,
+TestQueryManager in presto-main/src/test)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from presto_tpu.catalog.memory import MemoryConnector
+from presto_tpu.connector import Catalog
+from presto_tpu.exec import LocalRunner
+from presto_tpu.server.querymanager import (
+    FAILED,
+    FINISHED,
+    QueryManager,
+    batch_to_result,
+)
+from presto_tpu.server.resource_groups import (
+    QueryQueueFullError,
+    ResourceGroupManager,
+    ResourceGroupSpec,
+    SelectorSpec,
+)
+from presto_tpu.server.session import (
+    SYSTEM_PROPERTIES,
+    Session,
+    SessionPropertyError,
+)
+
+
+# ---------------------------------------------------------------------------
+# session properties
+
+
+def test_property_decode_types():
+    s = Session()
+    s.set("batch_rows", "4096")
+    assert s.get("batch_rows") == 4096
+    s.set("collect_stats", "true")
+    assert s.get("collect_stats") is True
+    s.set("query_max_run_time_s", "12.5")
+    assert s.get("query_max_run_time_s") == 12.5
+    s.unset("batch_rows")
+    assert s.get("batch_rows") == SYSTEM_PROPERTIES.default("batch_rows")
+
+
+def test_property_validation():
+    s = Session()
+    with pytest.raises(SessionPropertyError):
+        s.set("batch_rows", "not_a_number")
+    with pytest.raises(SessionPropertyError):
+        s.set("batch_rows", "-5")
+    with pytest.raises(SessionPropertyError):
+        s.set("join_distribution_type", "sideways")
+    with pytest.raises(SessionPropertyError):
+        s.set("no_such_property", "1")
+
+
+def test_exec_config_lowering():
+    s = Session()
+    s.set("batch_rows", 8192)
+    s.set("collect_stats", True)
+    cfg = s.exec_config()
+    assert cfg.batch_rows == 8192
+    assert cfg.collect_stats is True
+
+
+def test_session_child_inherits():
+    s = Session(user="alice", catalog="tpch")
+    s.set("agg_capacity", 256)
+    c = s.child()
+    assert c.user == "alice"
+    assert c.get("agg_capacity") == 256
+    assert c.query_id != s.query_id
+
+
+# ---------------------------------------------------------------------------
+# resource groups
+
+
+def test_resource_group_queueing():
+    rg = ResourceGroupManager(
+        ResourceGroupSpec("global", hard_concurrency_limit=2, max_queued=10)
+    )
+    started = []
+    rg.submit("u", "", 1, lambda: started.append("a"))
+    rg.submit("u", "", 1, lambda: started.append("b"))
+    rg.submit("u", "", 1, lambda: started.append("c"))
+    assert started == ["a", "b"]  # third is queued
+    rg.query_finished("global")
+    assert started == ["a", "b", "c"]
+
+
+def test_resource_group_queue_full():
+    rg = ResourceGroupManager(
+        ResourceGroupSpec("global", hard_concurrency_limit=1, max_queued=1)
+    )
+    rg.submit("u", "", 1, lambda: None)
+    rg.submit("u", "", 1, lambda: None)  # queued
+    with pytest.raises(QueryQueueFullError):
+        rg.submit("u", "", 1, lambda: None)
+
+
+def test_resource_group_priority_order():
+    rg = ResourceGroupManager(
+        ResourceGroupSpec(
+            "global", hard_concurrency_limit=1, scheduling_policy="query_priority"
+        )
+    )
+    order = []
+    rg.submit("u", "", 1, lambda: order.append("first"))
+    rg.submit("u", "", 1, lambda: order.append("low"))
+    rg.submit("u", "", 10, lambda: order.append("high"))
+    rg.query_finished("global")
+    rg.query_finished("global")
+    assert order == ["first", "high", "low"]
+
+
+def test_resource_group_user_template():
+    rg = ResourceGroupManager(
+        ResourceGroupSpec(
+            "global",
+            hard_concurrency_limit=10,
+            subgroups=[ResourceGroupSpec("adhoc", hard_concurrency_limit=1)],
+        ),
+        selectors=[SelectorSpec(group="global.adhoc.${USER}")],
+    )
+    started = []
+    rg.submit("alice", "", 1, lambda: started.append("alice1"))
+    # alice's leaf inherits adhoc's limit of 1 → queued; ancestor adhoc also full
+    rg.submit("alice", "", 1, lambda: started.append("alice2"))
+    assert started == ["alice1"]
+    info = rg.info()
+    assert info["global.adhoc.alice"]["running"] == 1
+    rg.query_finished("global.adhoc.alice", "alice")
+    assert started == ["alice1", "alice2"]
+
+
+# ---------------------------------------------------------------------------
+# query manager
+
+
+@pytest.fixture(scope="module")
+def qm_catalog():
+    cat = Catalog()
+    conn = MemoryConnector()
+    conn.add_table("t", {"x": np.arange(10, dtype=np.int64)})
+    cat.register("memory", conn, default=True)
+    return cat
+
+
+def _execute_fn(catalog):
+    def fn(session, sql):
+        runner = LocalRunner(catalog, session.exec_config())
+        return batch_to_result(runner.run_batch(sql))
+
+    return fn
+
+
+def test_query_manager_lifecycle(qm_catalog):
+    qm = QueryManager(_execute_fn(qm_catalog))
+    try:
+        qe = qm.create_query(Session(), "select sum(x) as s from t")
+        assert qe.wait(60)
+        assert qe.state == FINISHED, qe.error
+        assert qe.result.rows == [(45,)]
+        assert qm.get(qe.query_id) is qe
+    finally:
+        qm.close()
+
+
+def test_query_manager_failure(qm_catalog):
+    qm = QueryManager(_execute_fn(qm_catalog))
+    try:
+        qe = qm.create_query(Session(), "select * from no_such_table")
+        assert qe.wait(60)
+        assert qe.state == FAILED
+        assert "no_such_table" in qe.error
+    finally:
+        qm.close()
+
+
+def test_cancel_while_queued_does_not_leak_slot(qm_catalog):
+    """A query canceled in the queue must not corrupt group slot accounting
+    (it never held a slot; its deferred start must hand the slot back)."""
+    gate = threading.Event()
+
+    def blocking_fn(session, sql):
+        if sql == "BLOCK":
+            gate.wait(30)
+            from presto_tpu.server.querymanager import QueryResult
+            return QueryResult([], [], [])
+        runner = LocalRunner(qm_catalog, session.exec_config())
+        return batch_to_result(runner.run_batch(sql))
+
+    qm = QueryManager(
+        blocking_fn,
+        ResourceGroupManager(ResourceGroupSpec("global", hard_concurrency_limit=1)),
+    )
+    try:
+        q1 = qm.create_query(Session(), "BLOCK")
+        time.sleep(0.1)
+        q2 = qm.create_query(Session(), "select count(*) as c from t")  # queued
+        q2.cancel()
+        gate.set()  # q1 finishes → drain dequeues canceled q2 → slot returns
+        assert q1.wait(30)
+        q3 = qm.create_query(Session(), "select count(*) as c from t")
+        assert q3.wait(30)
+        assert q3.state == FINISHED, q3.error  # slot was not leaked
+    finally:
+        gate.set()
+        qm.close()
+
+
+def test_query_manager_events(qm_catalog):
+    qm = QueryManager(_execute_fn(qm_catalog))
+    events = []
+    qm.listeners.append(lambda ev, info: events.append((ev, info.state)))
+    try:
+        qe = qm.create_query(Session(), "select count(*) as c from t")
+        assert qe.wait(60) and qe.state == FINISHED
+        time.sleep(0.05)
+        kinds = [e[0] for e in events]
+        assert "queryCreated" in kinds and "queryCompleted" in kinds
+    finally:
+        qm.close()
