@@ -50,6 +50,7 @@ from presto_tpu.ops.sort import (
     sort_batch,
     sort_permutation,
 )
+from presto_tpu.plan.agg_states import agg_state_layout, sum_state_type
 from presto_tpu.plan.nodes import (
     Aggregate,
     AggSpec,
@@ -60,6 +61,7 @@ from presto_tpu.plan.nodes import (
     PlanNode,
     Project,
     QueryPlan,
+    RemoteSource,
     SemiJoin,
     Sort,
     TableScan,
@@ -101,6 +103,14 @@ class ExecContext:
         # per-plan-node OperatorStats analog (keyed by id(node)):
         # {"rows": ..., "batches": ..., "wall_s": ...}
         self.node_stats: Dict[int, Dict[str, float]] = {}
+        # distributed task context (set by the worker; None for LocalRunner):
+        # this task reads splits[task_index::n_tasks] of every scanned table
+        # (SOURCE_DISTRIBUTION split placement, statically assigned)
+        self.task_index: int = 0
+        self.n_tasks: int = 1
+        # fragment_id -> callable returning an iterator of Batches pulled
+        # from the exchange (the ExchangeOperator's client)
+        self.remote_sources = None
 
     def record(self, node, rows: int, wall_s: float):
         s = self.node_stats.setdefault(
@@ -257,7 +267,15 @@ def _execute_base(base: PlanNode, ctx: ExecContext) -> Iterator[Batch]:
                 return
         return
     if isinstance(base, Output):
-        yield from execute_node(base.child, ctx)
+        # project to the user-facing schema (worker-side in distributed
+        # plans; run_plan applies the same projection for local plans)
+        for b in execute_node(base.child, ctx):
+            yield b.select(base.symbols).rename(base.names)
+        return
+    if isinstance(base, RemoteSource):
+        if ctx.remote_sources is None:
+            raise RuntimeError("RemoteSource outside a distributed task")
+        yield from ctx.remote_sources(base.fragment_id)
         return
     raise NotImplementedError(f"no executor for {type(base).__name__}")
 
@@ -273,16 +291,18 @@ def _scan_batches(scan: TableScan, ctx: ExecContext) -> Iterator[Batch]:
     columns = list(scan.assignments.values())
     symbols = list(scan.assignments.keys())
     if not columns:
-        # COUNT(*)-style scan with no referenced columns: fabricate liveness
-        cap = round_up_capacity(min(nrows, ctx.config.batch_rows) or 1)
+        # COUNT(*)-style scan with no referenced columns: fabricate liveness.
+        # In a distributed task each task accounts its slice of the rows.
+        per = nrows // ctx.n_tasks + (1 if ctx.task_index < nrows % ctx.n_tasks else 0)
+        cap = round_up_capacity(min(per, ctx.config.batch_rows) or 1)
         done = 0
-        while done < nrows or done == 0:
-            take = min(cap, nrows - done)
+        while done < per or (done == 0 and ctx.task_index == 0):
+            take = min(cap, per - done)
             live = np.zeros(cap, bool)
             live[:take] = True
             yield Batch([], [], [], jnp.asarray(live), {})
             done += take
-            if done >= nrows:
+            if done >= per:
                 return
         return
     cap = round_up_capacity(min(nrows, ctx.config.batch_rows) or 1)
@@ -293,6 +313,8 @@ def _scan_batches(scan: TableScan, ctx: ExecContext) -> Iterator[Batch]:
             before = len(splits)
             splits = conn.prune_splits(handle, splits, storage_bounds)
             ctx.stats[f"scan.{scan.table}.splits_pruned"] = before - len(splits)
+    if ctx.n_tasks > 1:
+        splits = splits[ctx.task_index::ctx.n_tasks]
     for split in splits:
         b = conn.read_split(split, columns, capacity=cap)
         yield b.rename(symbols)
@@ -320,53 +342,30 @@ def _constraints_to_storage(scan: TableScan, handle):
 # -- aggregation ------------------------------------------------------------
 
 
-def _agg_state_layout(aggs: List[AggSpec]):
-    """Each AggSpec expands to one or more (state_name, merge_op, dtype-src)."""
-    layout = []
-    for a in aggs:
-        if a.fn == "sum":
-            layout.append((a.symbol, "sum", a))
-        elif a.fn in ("count", "count_star"):
-            layout.append((a.symbol, "count_add", a))
-        elif a.fn == "avg":
-            layout.append((a.symbol + "$sum", "sum", a))
-            layout.append((a.symbol + "$cnt", "count_add", a))
-        elif a.fn in ("min", "max"):
-            layout.append((a.symbol, a.fn, a))
-        else:
-            raise NotImplementedError(f"aggregate {a.fn}")
-    return layout
-
-
-def _sum_state_type(a: AggSpec, in_types: Dict[str, Type]) -> Type:
-    t = in_types[a.arg]
-    if isinstance(t, DecimalType):
-        return DecimalType(18, t.scale)
-    if t.name in ("tinyint", "smallint", "integer", "bigint"):
-        return BIGINT
-    return DOUBLE
-
-
 def _execute_aggregate(node: Aggregate, ctx: ExecContext) -> Iterator[Batch]:
+    from presto_tpu.plan.agg_states import state_types as _layout_state_types
+
     in_stream, chain = _fused_child(node.child, ctx)
     in_types = dict(node.child.output)
-    layout = _agg_state_layout(node.aggs)
+    layout = agg_state_layout(node.aggs)
     key_syms = node.group_keys
     key_types = [in_types[k] for k in key_syms]
-    state_types = []
-    for name, op, a in layout:
-        if op == "count_add":
-            state_types.append(BIGINT)
-        elif op == "sum":
-            state_types.append(_sum_state_type(a, in_types))
-        else:
-            state_types.append(in_types[a.arg])
+    final_mode = node.step == "final"
+    if final_mode:
+        # input columns ARE the partial state columns (post-exchange)
+        state_types = [in_types[name] for name, _, _ in layout]
+    else:
+        state_types = _layout_state_types(layout, in_types)
 
     def in_to_states(b: Batch):
         keys = [KeyCol(b.column(k).values, b.column(k).validity) for k in key_syms]
         states = []
         for (name, op, a), st in zip(layout, state_types):
-            if op == "count_add":
+            if final_mode:
+                c = b.column(name)
+                # count_add over count values degenerates to summing them
+                states.append(StateCol(c.values.astype(st.dtype), c.validity, op))
+            elif op == "count_add":
                 if a.fn == "count_star" or a.arg is None:
                     vals = b.live.astype(jnp.int64)
                 else:
@@ -441,6 +440,11 @@ def _execute_aggregate(node: Aggregate, ctx: ExecContext) -> Iterator[Batch]:
         else:
             raise RuntimeError("aggregate capacity growth exceeded retries")
 
+    if node.step == "partial":
+        # emit raw state columns for the exchange; no finalization
+        if acc is not None:
+            yield acc
+        return
     yield _finalize_aggregate(node, acc, layout, key_syms, key_types, state_types, in_types)
 
 
@@ -500,7 +504,10 @@ def _finalize_aggregate(node, acc, layout, key_syms, key_types, state_types, in_
                 cnt = c.values
                 ok = cnt > 0
                 denom = jnp.where(ok, cnt, 1).astype(jnp.float64)
-                src_t = _sum_state_type(a, in_types)
+                if node.step == "final":
+                    src_t = in_types[a.symbol + "$sum"]
+                else:
+                    src_t = sum_state_type(a, in_types)
                 if isinstance(src_t, DecimalType):
                     num = s.values.astype(jnp.float64) / (10.0 ** src_t.scale)
                 else:

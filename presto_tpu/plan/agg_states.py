@@ -1,0 +1,66 @@
+"""Aggregate state layouts shared by the planner (fragmenter) and runtime.
+
+Reference: AggregationNode.Step (PARTIAL/INTERMEDIATE/FINAL/SINGLE) and the
+accumulator state classes (operator/aggregation/state/*): a partial
+aggregation emits *state columns* (avg → sum+count) that travel through the
+exchange and are merged by the final aggregation.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+from presto_tpu.types import BIGINT, DOUBLE, DecimalType, Type
+
+
+def agg_state_layout(aggs) -> List[Tuple[str, str, object]]:
+    """Each AggSpec expands to one or more (state_name, merge_op, spec)."""
+    layout = []
+    for a in aggs:
+        if a.fn == "sum":
+            layout.append((a.symbol, "sum", a))
+        elif a.fn in ("count", "count_star"):
+            layout.append((a.symbol, "count_add", a))
+        elif a.fn == "avg":
+            layout.append((a.symbol + "$sum", "sum", a))
+            layout.append((a.symbol + "$cnt", "count_add", a))
+        elif a.fn in ("min", "max"):
+            layout.append((a.symbol, a.fn, a))
+        else:
+            raise NotImplementedError(f"aggregate {a.fn}")
+    return layout
+
+
+def sum_state_type(a, in_types: Dict[str, Type]) -> Type:
+    t = in_types[a.arg]
+    if isinstance(t, DecimalType):
+        return DecimalType(18, t.scale)
+    if t.name in ("tinyint", "smallint", "integer", "bigint"):
+        return BIGINT
+    return DOUBLE
+
+
+def state_types(layout, in_types: Dict[str, Type]) -> List[Type]:
+    out = []
+    for name, op, a in layout:
+        if op == "count_add":
+            out.append(BIGINT)
+        elif op == "sum":
+            if a.fn == "avg" or a.fn == "sum":
+                out.append(sum_state_type(a, in_types) if a.arg else BIGINT)
+            else:
+                out.append(DOUBLE)
+        elif op in ("min", "max"):
+            out.append(in_types[a.arg])
+        else:
+            out.append(DOUBLE)
+    return out
+
+
+def partial_output(child_output, group_keys, aggs) -> List[Tuple[str, Type]]:
+    """Schema of a step='partial' aggregation: keys then state columns."""
+    in_types = dict(child_output)
+    layout = agg_state_layout(aggs)
+    return [(k, in_types[k]) for k in group_keys] + list(
+        zip([name for name, _, _ in layout], state_types(layout, in_types))
+    )

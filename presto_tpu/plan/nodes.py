@@ -81,11 +81,16 @@ class Aggregate(PlanNode):
     group_keys: List[str]  # input symbols
     aggs: List[AggSpec]
     # step mirrors Presto's AggregationNode.Step: SINGLE initially; the
-    # distributed planner splits into PARTIAL / FINAL around an exchange
+    # fragmenter splits into PARTIAL (emits state columns) / FINAL (merges
+    # state columns arriving through the exchange)
     step: str = "single"
 
     @property
     def output(self):
+        if self.step == "partial":
+            from presto_tpu.plan.agg_states import partial_output
+
+            return partial_output(self.child.output, self.group_keys, self.aggs)
         key_types = dict(self.child.output)
         return [(k, key_types[k]) for k in self.group_keys] + [
             (a.symbol, a.type) for a in self.aggs
@@ -93,6 +98,15 @@ class Aggregate(PlanNode):
 
     def children(self):
         return [self.child]
+
+
+@dataclasses.dataclass
+class RemoteSource(PlanNode):
+    """Leaf reading pages from an upstream fragment through the exchange
+    (reference: plan/RemoteSourceNode + operator/ExchangeOperator.java:35)."""
+
+    fragment_id: int
+    output: List[Tuple[str, Type]] = dataclasses.field(default_factory=list)
 
 
 @dataclasses.dataclass
@@ -261,6 +275,8 @@ def plan_to_string(node: PlanNode, indent: int = 0, node_stats=None) -> str:
              f"order={[k.symbol for k in node.order_items]}; {fns}]")
     elif isinstance(node, Limit):
         s = f"{pad}Limit[{node.count}]"
+    elif isinstance(node, RemoteSource):
+        s = f"{pad}RemoteSource[fragment {node.fragment_id}]"
     elif isinstance(node, Output):
         s = f"{pad}Output[{', '.join(node.names)}]"
     else:

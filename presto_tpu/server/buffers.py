@@ -1,0 +1,148 @@
+"""Task output buffers — the producer side of the HTTP pull shuffle.
+
+Reference: execution/buffer/OutputBuffer.java and its Partitioned/Broadcast
+variants + ClientBuffer: pages are buffered per downstream consumer, fetched
+by explicit token sequence numbers, retained until acknowledged, so a
+consumer can re-fetch from any token (restart-safe, exactly-once delivery —
+TaskResource.java:245-304).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Optional, Tuple
+
+
+class _PartitionBuffer:
+    """Token-addressed page queue for one consumer."""
+
+    def __init__(self):
+        self.pages: List[bytes] = []
+        self.base_token = 0          # token of pages[0]
+        self.no_more = False
+        self.aborted = False
+
+    @property
+    def end_token(self) -> int:
+        return self.base_token + len(self.pages)
+
+
+class OutputBuffer:
+    """Pages per downstream partition with token/ack delivery.
+
+    broadcast=True appends every page to all partitions (shared bytes —
+    reference: BroadcastOutputBuffer page reference counting).
+    """
+
+    def __init__(self, n_partitions: int, broadcast: bool = False,
+                 max_buffered_bytes: int = 256 << 20):
+        self.n_partitions = n_partitions
+        self.broadcast = broadcast
+        self._parts = [_PartitionBuffer() for _ in range(n_partitions)]
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._bytes = 0
+        self._max_bytes = max_buffered_bytes
+        self._failed: Optional[str] = None
+
+    # -- producer ---------------------------------------------------------
+
+    def enqueue(self, partition: Optional[int], page: bytes):
+        """Append a page; partition=None broadcasts. Blocks for back-pressure
+        when the buffer is full (OutputBufferMemoryManager's blocked future)."""
+        with self._cond:
+            while self._bytes >= self._max_bytes and not self._all_aborted():
+                self._cond.wait(timeout=1.0)
+            targets = range(self.n_partitions) if (self.broadcast or partition is None) \
+                else (partition,)
+            for p in targets:
+                pb = self._parts[p]
+                if pb.aborted:
+                    continue
+                pb.pages.append(page)
+                self._bytes += len(page)
+            self._cond.notify_all()
+
+    def set_no_more_pages(self):
+        with self._cond:
+            for pb in self._parts:
+                pb.no_more = True
+            self._cond.notify_all()
+
+    def fail(self, message: str):
+        with self._cond:
+            self._failed = message
+            for pb in self._parts:
+                pb.no_more = True
+            self._cond.notify_all()
+
+    def _all_aborted(self) -> bool:
+        return all(pb.aborted for pb in self._parts)
+
+    # -- consumer ---------------------------------------------------------
+
+    def get(self, partition: int, token: int, max_bytes: int = 16 << 20,
+            max_wait_s: float = 1.0) -> Tuple[List[bytes], int, bool]:
+        """Pages from `token` on (long-poll up to max_wait_s).
+
+        Returns (pages, next_token, complete). Re-fetching an unacked token
+        returns the same pages (exactly-once via client-side dedup, like
+        SerializedPage token semantics)."""
+        with self._cond:
+            pb = self._parts[partition]
+            if self._failed is not None:
+                raise BufferFailed(self._failed)
+            deadline = max_wait_s
+            while token >= pb.end_token and not pb.no_more and deadline > 0:
+                step = min(deadline, 0.1)
+                self._cond.wait(timeout=step)
+                deadline -= step
+                if self._failed is not None:
+                    raise BufferFailed(self._failed)
+            pages = []
+            size = 0
+            t = token
+            if t < pb.base_token:
+                t = pb.base_token  # already acked past this point
+            while t < pb.end_token and size < max_bytes:
+                page = pb.pages[t - pb.base_token]
+                pages.append(page)
+                size += len(page)
+                t += 1
+            complete = pb.no_more and t >= pb.end_token
+            return pages, t, complete
+
+    def ack(self, partition: int, token: int):
+        """Discard pages before `token` (client acknowledged receipt)."""
+        with self._cond:
+            pb = self._parts[partition]
+            drop = min(max(token - pb.base_token, 0), len(pb.pages))
+            for i in range(drop):
+                self._bytes -= len(pb.pages[i])
+            del pb.pages[:drop]
+            pb.base_token += drop
+            self._cond.notify_all()
+
+    def abort(self, partition: int):
+        with self._cond:
+            pb = self._parts[partition]
+            pb.aborted = True
+            for p in pb.pages:
+                self._bytes -= len(p)
+            pb.pages.clear()
+            pb.no_more = True
+            self._cond.notify_all()
+
+    def buffered_bytes(self) -> int:
+        with self._lock:
+            return self._bytes
+
+    def is_finished(self) -> bool:
+        with self._lock:
+            return all(
+                pb.aborted or (pb.no_more and not pb.pages) for pb in self._parts
+            )
+
+
+class BufferFailed(RuntimeError):
+    pass

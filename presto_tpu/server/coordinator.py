@@ -1,0 +1,442 @@
+"""Coordinator — control plane: discovery, node health, distributed
+scheduling, result collection.
+
+Reference surface:
+- metadata/DiscoveryNodeManager.java + embedded airlift discovery: workers
+  announce themselves; the coordinator tracks active nodes
+- failureDetector/HeartbeatFailureDetector.java:77,225,360: periodic pings
+  with a decaying failure-rate gate; failed nodes are excluded from
+  scheduling
+- execution/scheduler/SqlQueryScheduler.java:640,657 + SqlStageExecution +
+  server/remotetask/HttpRemoteTask.java:336: stage-by-stage task creation
+  over HTTP
+- ClusterSizeMonitor: gate query start on minimum workers
+
+TPU-native shape: fragments are scheduled one-task-per-worker (HASH/SOURCE)
+or single-task (SINGLE); producers are created before consumers (ascending
+fragment id = topological order), everything runs concurrently and streams
+through the pull exchange.
+"""
+
+from __future__ import annotations
+
+import json
+import pickle
+import threading
+import time
+import urllib.request
+from typing import Dict, List, Optional
+
+from presto_tpu.batch import Batch
+from presto_tpu.connector import Catalog
+from presto_tpu.exec.runtime import ExecConfig
+from presto_tpu.plan.fragmenter import (
+    HASH,
+    OUT_BROADCAST,
+    SINGLE,
+    SOURCE,
+    DistributedPlan,
+    strip_runtime_state,
+)
+from presto_tpu.server.exchange import ExchangeClient, ExchangeFailure
+from presto_tpu.server.worker import TaskUpdate
+
+
+class NodeInfo:
+    def __init__(self, node_id: str, uri: str):
+        self.node_id = node_id
+        self.uri = uri
+        self.last_seen = time.monotonic()
+        # decayed failure counter (HeartbeatFailureDetector's
+        # DecayCounter(0.1) moral equivalent)
+        self.failure_score = 0.0
+        self.state = "active"
+
+    def record_success(self):
+        self.last_seen = time.monotonic()
+        self.failure_score *= 0.5
+
+    def record_failure(self):
+        self.failure_score = self.failure_score * 0.8 + 1.0
+
+    @property
+    def failed(self) -> bool:
+        return self.failure_score > 3.0
+
+
+class NodeManager:
+    """Registry of announced worker nodes (DiscoveryNodeManager analog)."""
+
+    def __init__(self, expire_s: float = 10.0):
+        self.nodes: Dict[str, NodeInfo] = {}
+        self._lock = threading.Lock()
+        self.expire_s = expire_s
+
+    def announce(self, node_id: str, uri: str):
+        with self._lock:
+            n = self.nodes.get(node_id)
+            if n is None or n.uri != uri:
+                self.nodes[node_id] = NodeInfo(node_id, uri)
+            else:
+                n.record_success()
+
+    def active_nodes(self) -> List[NodeInfo]:
+        now = time.monotonic()
+        with self._lock:
+            return [
+                n for n in self.nodes.values()
+                if not n.failed and n.state == "active"
+                and now - n.last_seen < self.expire_s
+            ]
+
+    def remove(self, node_id: str):
+        with self._lock:
+            self.nodes.pop(node_id, None)
+
+
+class HeartbeatFailureDetector:
+    """Background prober: GET /v1/status on every known node; nodes whose
+    decayed failure score crosses the threshold are excluded from
+    scheduling (HeartbeatFailureDetector.java:360 ping loop)."""
+
+    def __init__(self, node_manager: NodeManager, interval_s: float = 1.0):
+        self.node_manager = node_manager
+        self.interval_s = interval_s
+        self._stop = threading.Event()
+        self.thread = threading.Thread(target=self._loop, daemon=True,
+                                       name="failure-detector")
+
+    def start(self):
+        self.thread.start()
+
+    def _loop(self):
+        while not self._stop.wait(self.interval_s):
+            for n in list(self.node_manager.nodes.values()):
+                try:
+                    with urllib.request.urlopen(f"{n.uri}/v1/status", timeout=2) as r:
+                        status = json.loads(r.read())
+                    if status.get("state") in ("shutting_down", "shut_down"):
+                        n.state = "draining"
+                    else:
+                        n.record_success()
+                except Exception:
+                    n.record_failure()
+
+    def stop(self):
+        self._stop.set()
+
+
+class ClusterSizeMonitor:
+    def __init__(self, node_manager: NodeManager, min_workers: int = 1):
+        self.node_manager = node_manager
+        self.min_workers = min_workers
+
+    def wait_for_minimum(self, timeout_s: float = 30.0):
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if len(self.node_manager.active_nodes()) >= self.min_workers:
+                return
+            time.sleep(0.05)
+        raise RuntimeError(
+            f"insufficient active workers "
+            f"({len(self.node_manager.active_nodes())} < {self.min_workers})"
+        )
+
+
+class QueryFailed(RuntimeError):
+    pass
+
+
+class DistributedScheduler:
+    """Schedules a DistributedPlan onto workers and streams the result
+    (SqlQueryScheduler.schedule:657 analog; AllAtOnce policy — every stage
+    is started immediately, pages stream through the exchange)."""
+
+    def __init__(self, config: Optional[ExecConfig] = None):
+        self.config = config or ExecConfig()
+
+    def execute(self, query_id: str, dplan: DistributedPlan,
+                workers: List[NodeInfo]):
+        if not workers:
+            raise QueryFailed("no active workers")
+        frags = dplan.fragments
+        # task counts per fragment (FIXED_HASH → one per worker; SINGLE → 1)
+        n_tasks = {
+            fid: 1 if f.partitioning == SINGLE else len(workers)
+            for fid, f in frags.items()
+        }
+        # consumer fragment of each producer (tree: exactly one consumer)
+        consumer: Dict[int, int] = {}
+        for fid, f in frags.items():
+            for rs in f.remote_sources():
+                consumer[rs.fragment_id] = fid
+        # output partition count = consumer's task count
+        n_out = {
+            fid: n_tasks[consumer[fid]] if fid in consumer else 1
+            for fid in frags
+        }
+        task_urls: Dict[int, List[str]] = {}
+        assignments = []  # (task_id, worker, TaskUpdate)
+        for fid in sorted(frags):
+            f = frags[fid]
+            cnt = n_tasks[fid]
+            urls = []
+            for i in range(cnt):
+                w = workers[i % len(workers)]
+                tid = f"{query_id}.{fid}.{i}"
+                upstreams = {
+                    rs.fragment_id: [
+                        f"{u}/results/{i}" for u in task_urls[rs.fragment_id]
+                    ]
+                    for rs in f.remote_sources()
+                }
+                strip_runtime_state(f.root)
+                update = TaskUpdate(
+                    fragment=f,
+                    task_index=i,
+                    n_tasks=cnt,
+                    n_out_partitions=n_out[fid],
+                    upstreams=upstreams,
+                    config=_config_dict(self.config),
+                )
+                assignments.append((tid, w, update))
+                urls.append(f"{w.uri}/v1/task/{tid}")
+            task_urls[fid] = urls
+
+        created = []
+        completed = False
+        try:
+            # producers first (ascending fid = topological order)
+            for tid, w, update in assignments:
+                body = pickle.dumps(update)
+                req = urllib.request.Request(
+                    f"{w.uri}/v1/task/{tid}", data=body, method="POST",
+                    headers={"Content-Type": "application/x-pickle"},
+                )
+                with urllib.request.urlopen(req, timeout=30) as r:
+                    info = json.loads(r.read())
+                if info.get("state") == "failed":
+                    raise QueryFailed(info.get("error") or "task failed")
+                created.append((tid, w))
+            # stream the root fragment's single output buffer
+            root_urls = [f"{u}/results/0" for u in task_urls[dplan.root_fid]]
+            client = ExchangeClient(root_urls)
+            try:
+                for b in client.batches():
+                    yield b
+                completed = True
+            finally:
+                client.close()
+        except ExchangeFailure as e:
+            raise QueryFailed(str(e)) from e
+        finally:
+            # abort on ANY early exit — including GeneratorExit when the
+            # consumer abandons the stream (client disconnect / LIMIT) —
+            # so worker tasks and buffers are always released
+            if not completed:
+                self._abort(created)
+
+    def _abort(self, created):
+        for tid, w in created:
+            try:
+                req = urllib.request.Request(
+                    f"{w.uri}/v1/task/{tid}", method="DELETE"
+                )
+                urllib.request.urlopen(req, timeout=5).read()
+            except Exception:
+                pass
+
+
+def _config_dict(cfg: ExecConfig) -> dict:
+    import dataclasses
+
+    return dataclasses.asdict(cfg)
+
+
+class Coordinator:
+    """Discovery + health + scheduling service. Exposes the announcement
+    endpoint over HTTP; the statement protocol lives in
+    presto_tpu.server.protocol (mounted on the same server)."""
+
+    def __init__(self, catalog: Catalog, port: int = 0,
+                 config: Optional[ExecConfig] = None, min_workers: int = 1):
+        self.catalog = catalog
+        self.config = config or ExecConfig()
+        self.node_manager = NodeManager()
+        self.failure_detector = HeartbeatFailureDetector(self.node_manager)
+        self.size_monitor = ClusterSizeMonitor(self.node_manager, min_workers)
+        self.scheduler = DistributedScheduler(self.config)
+        self._query_seq = 0
+        self._lock = threading.Lock()
+        self._http = None
+        self._start_http(port)
+        self.failure_detector.start()
+
+    # -- http -------------------------------------------------------------
+
+    def _start_http(self, port: int):
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        coord = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                pass
+
+            def _json(self, obj, code=200):
+                data = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_PUT(self):
+                if self.path.startswith("/v1/announcement/"):
+                    node_id = self.path.rsplit("/", 1)[1]
+                    n = int(self.headers.get("Content-Length", 0))
+                    body = json.loads(self.rfile.read(n))
+                    coord.node_manager.announce(node_id, body["uri"])
+                    return self._json({"ok": True})
+                self._json({"error": "not found"}, 404)
+
+            def do_GET(self):
+                if self.path == "/v1/info":
+                    return self._json({
+                        "nodeId": "coordinator", "coordinator": True,
+                        "uri": coord.url,
+                    })
+                if self.path == "/v1/node":
+                    return self._json([
+                        {"nodeId": n.node_id, "uri": n.uri,
+                         "failureScore": n.failure_score, "state": n.state}
+                        for n in coord.node_manager.nodes.values()
+                    ])
+                self._json({"error": "not found"}, 404)
+
+        self._http = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        self.port = self._http.server_address[1]
+        self.url = f"http://127.0.0.1:{self.port}"
+        threading.Thread(target=self._http.serve_forever, daemon=True,
+                         name="coordinator-http").start()
+
+    # -- queries ----------------------------------------------------------
+
+    def next_query_id(self) -> str:
+        with self._lock:
+            self._query_seq += 1
+            return f"q{self._query_seq}"
+
+    def execute_distributed(self, dplan: DistributedPlan):
+        self.size_monitor.wait_for_minimum()
+        qid = self.next_query_id()
+        workers = self.node_manager.active_nodes()
+        yield from self.scheduler.execute(qid, dplan, workers)
+
+    def close(self):
+        self.failure_detector.stop()
+        if self._http is not None:
+            self._http.shutdown()
+            self._http.server_close()
+
+
+class DistributedRunner:
+    """In-process cluster: coordinator + N workers over real localhost HTTP
+    (DistributedQueryRunner.java:78 analog — multi-node without a cluster).
+
+    Every worker shares the same Catalog object (connectors are
+    deterministic; in a real deployment each worker constructs its own from
+    catalog properties)."""
+
+    def __init__(self, catalog: Catalog, n_workers: int = 2,
+                 config: Optional[ExecConfig] = None,
+                 broadcast_threshold_rows: float = 1_000_000):
+        from presto_tpu.server.worker import Worker
+
+        self.catalog = catalog
+        self.config = config or ExecConfig()
+        self.broadcast_threshold_rows = broadcast_threshold_rows
+        self.coordinator = Coordinator(catalog, config=self.config,
+                                       min_workers=n_workers)
+        self.workers = [
+            Worker(catalog, node_id=f"worker-{i}",
+                   coordinator_url=self.coordinator.url)
+            for i in range(n_workers)
+        ]
+        self._dplan_cache: Dict[str, DistributedPlan] = {}
+
+    def plan_distributed(self, sql: str) -> DistributedPlan:
+        from presto_tpu.exec.runtime import ExecContext, run_plan
+        from presto_tpu.plan.builder import plan_query
+        from presto_tpu.plan.fragmenter import fragment_plan
+        from presto_tpu.plan.optimizer import optimize
+
+        hit = self._dplan_cache.get(sql)
+        if hit is not None:
+            return hit
+        qp = optimize(plan_query(sql, self.catalog))
+        cacheable = not qp.scalar_subqueries
+        if qp.scalar_subqueries:
+            # bind uncorrelated scalar subqueries coordinator-side first
+            # (the reference runs them as separate plan stages)
+            from presto_tpu.exec.runtime import _bind_plan_params
+            from presto_tpu.expr.ir import Constant
+
+            ctx = ExecContext(self.catalog, self.config)
+            bindings = {}
+            for sym, sub in qp.scalar_subqueries.items():
+                sub_out = run_plan(sub, ctx)
+                vals = sub_out.to_pydict(decode_strings=False)[sub_out.names[0]]
+                if len(vals) != 1:
+                    raise RuntimeError(f"scalar subquery returned {len(vals)} rows")
+                bindings[sym] = Constant(sub_out.types[0], vals[0], raw=True)
+            _bind_plan_params(qp.root, bindings)
+        dplan = fragment_plan(
+            qp, self.catalog,
+            broadcast_threshold_rows=self.broadcast_threshold_rows,
+        )
+        if cacheable:
+            self._dplan_cache[sql] = dplan
+        return dplan
+
+    def explain_distributed(self, sql: str) -> str:
+        return self.plan_distributed(sql).to_string()
+
+    def run_batch(self, sql: str) -> Batch:
+        import jax.numpy as jnp
+
+        from presto_tpu.exec.runtime import _JIT_COMPACT, _collect_concat
+
+        dplan = self.plan_distributed(sql)
+        batches = list(self.coordinator.execute_distributed(dplan))
+        merged = _collect_concat(iter(batches))
+        if merged is None:
+            root = dplan.fragments[dplan.root_fid].root
+            types = dict(root.output)
+            from presto_tpu.batch import Column
+
+            merged = Batch(
+                dplan.output_names,
+                [types[n] for n in dplan.output_names],
+                [Column(jnp.zeros(128, types[n].dtype), None)
+                 for n in dplan.output_names],
+                jnp.zeros(128, bool),
+                {},
+            )
+        return _JIT_COMPACT(merged)
+
+    def run(self, sql: str):
+        return self.run_batch(sql).to_pandas()
+
+    def close(self):
+        for w in self.workers:
+            w.close()
+        self.coordinator.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -1,0 +1,366 @@
+"""Worker — the data-plane node: task CRUD over HTTP, fragment execution,
+output buffers.
+
+Reference surface:
+- server/TaskResource.java:84 — `@Path("/v1/task")`: create/update (POST
+  :126), status (GET :188), results by token (GET :245-247), ack (:304),
+  abort (DELETE :317)
+- execution/SqlTaskManager.java:84,351 + SqlTask / TaskStateMachine
+- execution/SqlTaskExecution.java:82 — splits → pipeline → drivers
+- server/GracefulShutdownHandler.java:43 — drain then exit on
+  PUT /v1/info/state "SHUTTING_DOWN"
+
+TPU-native shape: a task executes one plan fragment as a stream of
+fixed-capacity device batches (exec/runtime); the task's sink serializes
+output pages into an OutputBuffer partitioned for the consumer stage
+(hash / broadcast / gather). Fragments arrive pickled — the
+coordinator↔worker boundary is a trusted intra-cluster channel, exactly
+like the reference's Java-serialized-ish JSON/Smile plan fragments.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import pickle
+import re
+import threading
+import traceback
+from functools import lru_cache
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from presto_tpu.batch import Batch
+from presto_tpu.connector import Catalog
+from presto_tpu.exec.runtime import ExecConfig, ExecContext, execute_node
+from presto_tpu.ops.partition import partition_ids
+from presto_tpu.plan.fragmenter import (
+    OUT_BROADCAST,
+    OUT_GATHER,
+    OUT_HASH,
+    Fragment,
+)
+from presto_tpu.serde import serialize_batch
+from presto_tpu.server.buffers import BufferFailed, OutputBuffer
+from presto_tpu.server.exchange import ExchangeClient, encode_results_payload
+
+
+@dataclasses.dataclass
+class TaskUpdate:
+    """POST /v1/task/{id} body (TaskUpdateRequest analog: fragment + split
+    assignment + output buffer layout + upstream locations)."""
+
+    fragment: Fragment
+    task_index: int
+    n_tasks: int
+    n_out_partitions: int
+    upstreams: Dict[int, List[str]]  # fragment_id -> result-buffer base URLs
+    config: dict = dataclasses.field(default_factory=dict)
+
+
+@lru_cache(maxsize=256)
+def _jit_partition_ids(keys: tuple, n_parts: int):
+    import jax
+
+    return jax.jit(lambda b: partition_ids(b, keys, n_parts))
+
+
+class TaskExecution:
+    """One task: fragment + splits in, pages out (SqlTaskExecution analog)."""
+
+    def __init__(self, task_id: str, update: TaskUpdate, catalog: Catalog):
+        self.task_id = task_id
+        self.update = update
+        self.catalog = catalog
+        self.state = "running"
+        self.error: Optional[str] = None
+        f = update.fragment
+        self.buffer = OutputBuffer(
+            update.n_out_partitions,
+            broadcast=(f.output_partitioning == OUT_BROADCAST),
+        )
+        self._clients: List[ExchangeClient] = []
+        self.thread = threading.Thread(
+            target=self._run, daemon=True, name=f"task-{task_id}"
+        )
+        self.thread.start()
+
+    def _remote_source_factory(self, fragment_id: int):
+        urls = self.update.upstreams[fragment_id]
+        client = ExchangeClient(urls)
+        self._clients.append(client)
+        return client.batches()
+
+    def _run(self):
+        try:
+            cfg = ExecConfig(**self.update.config)
+            ctx = ExecContext(self.catalog, cfg)
+            ctx.task_index = self.update.task_index
+            ctx.n_tasks = self.update.n_tasks
+            ctx.remote_sources = self._remote_source_factory
+            f = self.update.fragment
+            sink = self._make_sink(f)
+            for batch in execute_node(f.root, ctx):
+                sink(batch)
+            self.buffer.set_no_more_pages()
+            self.state = "finished"
+        except Exception as e:
+            self.error = f"{type(e).__name__}: {e}\n{traceback.format_exc()}"
+            self.state = "failed"
+            self.buffer.fail(self.error)
+        finally:
+            for c in self._clients:
+                c.close()
+
+    def _make_sink(self, f: Fragment):
+        if f.output_partitioning == OUT_HASH and self.update.n_out_partitions > 1:
+            pid_fn = _jit_partition_ids(
+                tuple(f.output_keys), self.update.n_out_partitions
+            )
+
+            def sink(b: Batch):
+                # device-side hash, host-side scatter into per-consumer pages
+                # (PartitionedOutputOperator.partitionPage:377 analog)
+                pid = np.asarray(pid_fn(b))
+                live = np.asarray(b.live)
+                for p in range(self.update.n_out_partitions):
+                    mask = live & (pid == p)
+                    if mask.any():
+                        self.buffer.enqueue(p, serialize_batch(b.with_live(mask)))
+
+            return sink
+
+        def sink(b: Batch):
+            # gather/broadcast: one serialized page, fanned out by the buffer
+            if int(np.asarray(b.live).sum()) == 0:
+                return
+            page = serialize_batch(b)
+            if f.output_partitioning == OUT_BROADCAST:
+                self.buffer.enqueue(None, page)
+            else:
+                self.buffer.enqueue(0, page)
+
+        return sink
+
+    def abort(self):
+        self.state = "aborted"
+        for c in self._clients:
+            c.close()
+        for p in range(self.buffer.n_partitions):
+            self.buffer.abort(p)
+
+    def info(self) -> dict:
+        return {
+            "taskId": self.task_id,
+            "state": self.state,
+            "error": self.error,
+            "bufferedBytes": self.buffer.buffered_bytes(),
+        }
+
+
+class TaskManager:
+    """SqlTaskManager analog: task registry keyed by task id."""
+
+    def __init__(self, catalog: Catalog):
+        self.catalog = catalog
+        self.tasks: Dict[str, TaskExecution] = {}
+        self._lock = threading.Lock()
+
+    def update_task(self, task_id: str, update: TaskUpdate) -> dict:
+        with self._lock:
+            t = self.tasks.get(task_id)
+            if t is None:
+                t = TaskExecution(task_id, update, self.catalog)
+                self.tasks[task_id] = t
+            return t.info()
+
+    def get(self, task_id: str) -> Optional[TaskExecution]:
+        return self.tasks.get(task_id)
+
+    def abort_task(self, task_id: str):
+        t = self.tasks.get(task_id)
+        if t is not None:
+            t.abort()
+
+    def abort_all(self):
+        for t in list(self.tasks.values()):
+            t.abort()
+
+    def has_running(self) -> bool:
+        return any(t.state == "running" for t in self.tasks.values())
+
+
+_TASK_RE = re.compile(r"^/v1/task/([^/]+)$")
+_RESULTS_RE = re.compile(r"^/v1/task/([^/]+)/results/(\d+)/(\d+)$")
+_ACK_RE = re.compile(r"^/v1/task/([^/]+)/results/(\d+)/(\d+)/ack$")
+_BUFFER_RE = re.compile(r"^/v1/task/([^/]+)/results/(\d+)$")
+_STATUS_RE = re.compile(r"^/v1/task/([^/]+)/status$")
+
+
+class Worker:
+    """A worker node: HTTP server + task manager + node lifecycle."""
+
+    def __init__(self, catalog: Catalog, node_id: str = "worker-0",
+                 port: int = 0, coordinator_url: Optional[str] = None):
+        self.catalog = catalog
+        self.node_id = node_id
+        self.task_manager = TaskManager(catalog)
+        self.node_state = "active"   # active | shutting_down | shut_down
+        worker = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):  # quiet
+                pass
+
+            def _json(self, obj, code=200):
+                data = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def _bytes(self, data: bytes, code=200):
+                self.send_response(code)
+                self.send_header("Content-Type", "application/octet-stream")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_POST(self):
+                m = _TASK_RE.match(self.path)
+                if m:
+                    n = int(self.headers.get("Content-Length", 0))
+                    update = pickle.loads(self.rfile.read(n))
+                    info = worker.task_manager.update_task(m.group(1), update)
+                    return self._json(info)
+                self._json({"error": "not found"}, 404)
+
+            def do_GET(self):
+                m = _RESULTS_RE.match(self.path)
+                if m:
+                    tid, buf, token = m.group(1), int(m.group(2)), int(m.group(3))
+                    t = worker.task_manager.get(tid)
+                    if t is None:
+                        return self._json({"error": "no such task"}, 404)
+                    try:
+                        pages, next_token, complete = t.buffer.get(buf, token)
+                        header = {"next_token": next_token, "complete": complete,
+                                  "task_state": t.state, "error": None}
+                    except BufferFailed as e:
+                        header = {"next_token": token, "complete": True,
+                                  "task_state": t.state, "error": str(e)}
+                        pages = []
+                    return self._bytes(encode_results_payload(header, pages))
+                m = _ACK_RE.match(self.path)
+                if m:
+                    t = worker.task_manager.get(m.group(1))
+                    if t is not None:
+                        t.buffer.ack(int(m.group(2)), int(m.group(3)))
+                    return self._json({"ok": True})
+                m = _STATUS_RE.match(self.path)
+                if m:
+                    t = worker.task_manager.get(m.group(1))
+                    if t is None:
+                        return self._json({"error": "no such task"}, 404)
+                    return self._json(t.info())
+                if self.path == "/v1/info":
+                    return self._json({
+                        "nodeId": worker.node_id,
+                        "state": worker.node_state,
+                        "uri": worker.url,
+                        "coordinator": False,
+                    })
+                if self.path == "/v1/status":
+                    return self._json(worker.status())
+                self._json({"error": "not found"}, 404)
+
+            def do_DELETE(self):
+                m = _TASK_RE.match(self.path)
+                if m:
+                    worker.task_manager.abort_task(m.group(1))
+                    return self._json({"ok": True})
+                m = _BUFFER_RE.match(self.path)
+                if m:
+                    t = worker.task_manager.get(m.group(1))
+                    if t is not None:
+                        t.buffer.abort(int(m.group(2)))
+                    return self._json({"ok": True})
+                self._json({"error": "not found"}, 404)
+
+            def do_PUT(self):
+                if self.path == "/v1/info/state":
+                    n = int(self.headers.get("Content-Length", 0))
+                    body = json.loads(self.rfile.read(n) or b'""')
+                    if body == "SHUTTING_DOWN":
+                        worker.start_graceful_shutdown()
+                        return self._json({"ok": True})
+                    return self._json({"error": f"bad state {body}"}, 400)
+                self._json({"error": "not found"}, 404)
+
+        self.server = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        self.port = self.server.server_address[1]
+        self.url = f"http://127.0.0.1:{self.port}"
+        self._serve_thread = threading.Thread(
+            target=self.server.serve_forever, daemon=True,
+            name=f"worker-http-{self.node_id}",
+        )
+        self._serve_thread.start()
+        self._announce_thread = None
+        if coordinator_url:
+            self._announce_thread = threading.Thread(
+                target=self._announce_loop, args=(coordinator_url,), daemon=True
+            )
+            self._announce_thread.start()
+
+    def status(self) -> dict:
+        tasks = self.task_manager.tasks
+        return {
+            "nodeId": self.node_id,
+            "state": self.node_state,
+            "tasks": len(tasks),
+            "runningTasks": sum(1 for t in tasks.values() if t.state == "running"),
+        }
+
+    def _announce_loop(self, coordinator_url: str):
+        """Service announcement (airlift discovery analog): re-announce
+        periodically so the coordinator can expire dead nodes."""
+        import time
+        import urllib.request
+
+        while self.node_state == "active":
+            try:
+                body = json.dumps({"nodeId": self.node_id, "uri": self.url}).encode()
+                req = urllib.request.Request(
+                    f"{coordinator_url}/v1/announcement/{self.node_id}",
+                    data=body, method="PUT",
+                    headers={"Content-Type": "application/json"},
+                )
+                urllib.request.urlopen(req, timeout=5).read()
+            except Exception:
+                pass
+            time.sleep(1.0)
+
+    def start_graceful_shutdown(self):
+        """Drain: stop accepting tasks, wait for running tasks, then stop
+        (GracefulShutdownHandler.java:73)."""
+
+        def drain():
+            import time
+
+            self.node_state = "shutting_down"
+            while self.task_manager.has_running():
+                time.sleep(0.1)
+            self.close()
+            self.node_state = "shut_down"
+
+        threading.Thread(target=drain, daemon=True).start()
+
+    def close(self):
+        self.task_manager.abort_all()
+        self.server.shutdown()
+        self.server.server_close()
