@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import json
 import pickle
+import re
 import threading
 import time
 import urllib.request
@@ -61,24 +62,28 @@ class NodeInfo:
 
     @property
     def failed(self) -> bool:
-        return self.failure_score > 3.0
+        return self.failure_score > 4.0
 
 
 class NodeManager:
     """Registry of announced worker nodes (DiscoveryNodeManager analog)."""
 
-    def __init__(self, expire_s: float = 10.0):
+    def __init__(self, expire_s: float = 30.0):
         self.nodes: Dict[str, NodeInfo] = {}
         self._lock = threading.Lock()
         self.expire_s = expire_s
 
-    def announce(self, node_id: str, uri: str):
+    def announce(self, node_id: str, uri: str, state: str = "active"):
         with self._lock:
             n = self.nodes.get(node_id)
             if n is None or n.uri != uri:
-                self.nodes[node_id] = NodeInfo(node_id, uri)
+                n = NodeInfo(node_id, uri)
+                self.nodes[node_id] = n
             else:
                 n.record_success()
+            # the worker's own announcement is authoritative for its state —
+            # a restarted worker reusing node_id/uri returns to rotation
+            n.state = "active" if state == "active" else "draining"
 
     def active_nodes(self) -> List[NodeInfo]:
         now = time.monotonic()
@@ -99,7 +104,7 @@ class HeartbeatFailureDetector:
     decayed failure score crosses the threshold are excluded from
     scheduling (HeartbeatFailureDetector.java:360 ping loop)."""
 
-    def __init__(self, node_manager: NodeManager, interval_s: float = 1.0):
+    def __init__(self, node_manager: NodeManager, interval_s: float = 2.0):
         self.node_manager = node_manager
         self.interval_s = interval_s
         self._stop = threading.Event()
@@ -109,18 +114,29 @@ class HeartbeatFailureDetector:
     def start(self):
         self.thread.start()
 
+    def _probe(self, n: NodeInfo):
+        try:
+            with urllib.request.urlopen(f"{n.uri}/v1/status", timeout=5) as r:
+                status = json.loads(r.read())
+            if status.get("state") in ("shutting_down", "shut_down"):
+                n.state = "draining"
+            else:
+                n.record_success()
+        except Exception:
+            n.record_failure()
+
     def _loop(self):
         while not self._stop.wait(self.interval_s):
-            for n in list(self.node_manager.nodes.values()):
-                try:
-                    with urllib.request.urlopen(f"{n.uri}/v1/status", timeout=2) as r:
-                        status = json.loads(r.read())
-                    if status.get("state") in ("shutting_down", "shut_down"):
-                        n.state = "draining"
-                    else:
-                        n.record_success()
-                except Exception:
-                    n.record_failure()
+            # concurrent probes: one hung worker must not stall detection of
+            # the others (reference pings asynchronously per service)
+            probes = [
+                threading.Thread(target=self._probe, args=(n,), daemon=True)
+                for n in list(self.node_manager.nodes.values())
+            ]
+            for t in probes:
+                t.start()
+            for t in probes:
+                t.join(timeout=6)
 
     def stop(self):
         self._stop.set()
@@ -156,7 +172,9 @@ class DistributedScheduler:
         self.config = config or ExecConfig()
 
     def execute(self, query_id: str, dplan: DistributedPlan,
-                workers: List[NodeInfo]):
+                workers: List[NodeInfo],
+                config: Optional[ExecConfig] = None):
+        config = config or self.config
         if not workers:
             raise QueryFailed("no active workers")
         frags = dplan.fragments
@@ -197,7 +215,7 @@ class DistributedScheduler:
                     n_tasks=cnt,
                     n_out_partitions=n_out[fid],
                     upstreams=upstreams,
-                    config=_config_dict(self.config),
+                    config=_config_dict(config),
                 )
                 assignments.append((tid, w, update))
                 urls.append(f"{w.uri}/v1/task/{tid}")
@@ -259,22 +277,59 @@ class Coordinator:
     presto_tpu.server.protocol (mounted on the same server)."""
 
     def __init__(self, catalog: Catalog, port: int = 0,
-                 config: Optional[ExecConfig] = None, min_workers: int = 1):
+                 config: Optional[ExecConfig] = None, min_workers: int = 1,
+                 broadcast_threshold_rows: float = 1_000_000):
+        from presto_tpu.server.protocol import StatementProtocol
+        from presto_tpu.server.querymanager import (
+            QueryManager,
+            batch_to_result,
+        )
+
         self.catalog = catalog
         self.config = config or ExecConfig()
+        self.broadcast_threshold_rows = broadcast_threshold_rows
         self.node_manager = NodeManager()
         self.failure_detector = HeartbeatFailureDetector(self.node_manager)
         self.size_monitor = ClusterSizeMonitor(self.node_manager, min_workers)
         self.scheduler = DistributedScheduler(self.config)
         self._query_seq = 0
         self._lock = threading.Lock()
+        # keyed by (sql, plan-affecting session property values)
+        self._dplan_cache: Dict[tuple, DistributedPlan] = {}
         self._http = None
-        self._start_http(port)
+
+        def execute_fn(session, sql):
+            cfg = session.exec_config()
+            return batch_to_result(self.run_batch(sql, cfg, session))
+
+        self.query_manager = QueryManager(execute_fn)
+        # bind the socket first (determines self.url), wire the protocol,
+        # THEN start serving — no request can observe a half-built coordinator
+        self._bind_http(port)
+        self.protocol = StatementProtocol(
+            self.query_manager, catalog, self.url,
+            explain_fn=self._explain,
+        )
+        threading.Thread(target=self._http.serve_forever, daemon=True,
+                         name="coordinator-http").start()
         self.failure_detector.start()
+
+    def _explain(self, sql: str, analyze: bool, session) -> str:
+        if analyze:
+            from presto_tpu.exec.runner import LocalRunner
+
+            profile = LocalRunner(self.catalog, session.exec_config()).explain_analyze(sql)
+            return (
+                "-- single-node execution profile (distributed per-fragment "
+                "stats: see /v1/query)\n" + profile
+                + "\n\n-- distributed plan\n"
+                + self.plan_distributed(sql, session).to_string()
+            )
+        return self.plan_distributed(sql, session).to_string()
 
     # -- http -------------------------------------------------------------
 
-    def _start_http(self, port: int):
+    def _bind_http(self, port: int):
         from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
         coord = self
@@ -285,24 +340,66 @@ class Coordinator:
             def log_message(self, fmt, *args):
                 pass
 
-            def _json(self, obj, code=200):
+            def _json(self, obj, code=200, extra_headers=None):
                 data = json.dumps(obj).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(data)))
+                for k, v in (extra_headers or {}).items():
+                    self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(data)
+
+            def do_POST(self):
+                if self.path == "/v1/statement":
+                    n = int(self.headers.get("Content-Length", 0))
+                    sql = self.rfile.read(n).decode()
+                    try:
+                        out, extra = coord.protocol.create(sql, self.headers)
+                        return self._json(out, extra_headers=extra)
+                    except Exception as e:
+                        return self._json(
+                            {"error": {"message": str(e),
+                                       "errorName": type(e).__name__,
+                                       "errorType": "USER_ERROR"},
+                             "id": "", "stats": {"state": "FAILED"}})
+                self._json({"error": "not found"}, 404)
 
             def do_PUT(self):
                 if self.path.startswith("/v1/announcement/"):
                     node_id = self.path.rsplit("/", 1)[1]
                     n = int(self.headers.get("Content-Length", 0))
                     body = json.loads(self.rfile.read(n))
-                    coord.node_manager.announce(node_id, body["uri"])
+                    coord.node_manager.announce(
+                        node_id, body["uri"], body.get("state", "active")
+                    )
                     return self._json({"ok": True})
                 self._json({"error": "not found"}, 404)
 
             def do_GET(self):
+                m = re.match(r"^/v1/statement/([^/]+)/(\d+)$", self.path)
+                if m:
+                    try:
+                        return self._json(
+                            coord.protocol.poll(m.group(1), int(m.group(2)))
+                        )
+                    except KeyError:
+                        return self._json({"error": "unknown query"}, 404)
+                m = re.match(r"^/v1/query/([^/]+)$", self.path)
+                if m:
+                    try:
+                        qe = coord.query_manager.get(m.group(1))
+                    except KeyError:
+                        return self._json({"error": "unknown query"}, 404)
+                    import dataclasses as _dc
+
+                    return self._json(_dc.asdict(qe.info()))
+                if self.path == "/v1/query":
+                    import dataclasses as _dc
+
+                    return self._json(
+                        [_dc.asdict(i) for i in coord.query_manager.queries()]
+                    )
                 if self.path == "/v1/info":
                     return self._json({
                         "nodeId": "coordinator", "coordinator": True,
@@ -314,13 +411,26 @@ class Coordinator:
                          "failureScore": n.failure_score, "state": n.state}
                         for n in coord.node_manager.nodes.values()
                     ])
+                if self.path == "/v1/cluster":
+                    qs = coord.query_manager.queries()
+                    return self._json({
+                        "activeWorkers": len(coord.node_manager.active_nodes()),
+                        "runningQueries": sum(1 for q in qs if q.state == "RUNNING"),
+                        "queuedQueries": sum(1 for q in qs if q.state == "QUEUED"),
+                        "totalQueries": len(qs),
+                    })
+                self._json({"error": "not found"}, 404)
+
+            def do_DELETE(self):
+                m = re.match(r"^/v1/statement/([^/]+)(?:/\d+)?$", self.path)
+                if m:
+                    coord.protocol.cancel(m.group(1))
+                    return self._json({"ok": True})
                 self._json({"error": "not found"}, 404)
 
         self._http = ThreadingHTTPServer(("127.0.0.1", port), Handler)
         self.port = self._http.server_address[1]
         self.url = f"http://127.0.0.1:{self.port}"
-        threading.Thread(target=self._http.serve_forever, daemon=True,
-                         name="coordinator-http").start()
 
     # -- queries ----------------------------------------------------------
 
@@ -329,14 +439,80 @@ class Coordinator:
             self._query_seq += 1
             return f"q{self._query_seq}"
 
-    def execute_distributed(self, dplan: DistributedPlan):
+    def execute_distributed(self, dplan: DistributedPlan,
+                            config: Optional[ExecConfig] = None):
         self.size_monitor.wait_for_minimum()
         qid = self.next_query_id()
         workers = self.node_manager.active_nodes()
-        yield from self.scheduler.execute(qid, dplan, workers)
+        yield from self.scheduler.execute(qid, dplan, workers, config)
+
+    def plan_distributed(self, sql: str, session=None) -> DistributedPlan:
+        from presto_tpu.exec.runtime import ExecContext, _bind_plan_params, run_plan
+        from presto_tpu.expr.ir import Constant
+        from presto_tpu.plan.builder import plan_query
+        from presto_tpu.plan.fragmenter import fragment_plan
+        from presto_tpu.plan.optimizer import optimize
+
+        # session properties that change the PLAN feed the cache key
+        # (join_distribution_type — SystemSessionProperties.java:59)
+        jdt = (session.get("join_distribution_type") if session else "AUTOMATIC") or "AUTOMATIC"
+        jdt = jdt.upper()
+        threshold = {
+            "BROADCAST": float("inf"),
+            "PARTITIONED": 0.0,
+        }.get(jdt, self.broadcast_threshold_rows)
+        cache_key = (sql, jdt)
+        hit = self._dplan_cache.get(cache_key)
+        if hit is not None:
+            return hit
+        qp = optimize(plan_query(sql, self.catalog))
+        cacheable = not qp.scalar_subqueries
+        if qp.scalar_subqueries:
+            # bind uncorrelated scalar subqueries coordinator-side first
+            # (the reference runs them as separate plan stages)
+            ctx = ExecContext(self.catalog, self.config)
+            bindings = {}
+            for sym, sub in qp.scalar_subqueries.items():
+                sub_out = run_plan(sub, ctx)
+                vals = sub_out.to_pydict(decode_strings=False)[sub_out.names[0]]
+                if len(vals) != 1:
+                    raise RuntimeError(f"scalar subquery returned {len(vals)} rows")
+                bindings[sym] = Constant(sub_out.types[0], vals[0], raw=True)
+            _bind_plan_params(qp.root, bindings)
+        dplan = fragment_plan(
+            qp, self.catalog,
+            broadcast_threshold_rows=threshold,
+        )
+        if cacheable:
+            self._dplan_cache[cache_key] = dplan
+        return dplan
+
+    def run_batch(self, sql: str, config: Optional[ExecConfig] = None,
+                  session=None) -> Batch:
+        import jax.numpy as jnp
+
+        from presto_tpu.batch import Column
+        from presto_tpu.exec.runtime import _JIT_COMPACT, _collect_concat
+
+        dplan = self.plan_distributed(sql, session)
+        batches = list(self.execute_distributed(dplan, config))
+        merged = _collect_concat(iter(batches))
+        if merged is None:
+            root = dplan.fragments[dplan.root_fid].root
+            types = dict(root.output)
+            merged = Batch(
+                dplan.output_names,
+                [types[n] for n in dplan.output_names],
+                [Column(jnp.zeros(128, types[n].dtype), None)
+                 for n in dplan.output_names],
+                jnp.zeros(128, bool),
+                {},
+            )
+        return _JIT_COMPACT(merged)
 
     def close(self):
         self.failure_detector.stop()
+        self.query_manager.close()
         if self._http is not None:
             self._http.shutdown()
             self._http.server_close()
@@ -357,75 +533,24 @@ class DistributedRunner:
 
         self.catalog = catalog
         self.config = config or ExecConfig()
-        self.broadcast_threshold_rows = broadcast_threshold_rows
-        self.coordinator = Coordinator(catalog, config=self.config,
-                                       min_workers=n_workers)
+        self.coordinator = Coordinator(
+            catalog, config=self.config, min_workers=n_workers,
+            broadcast_threshold_rows=broadcast_threshold_rows,
+        )
         self.workers = [
             Worker(catalog, node_id=f"worker-{i}",
                    coordinator_url=self.coordinator.url)
             for i in range(n_workers)
         ]
-        self._dplan_cache: Dict[str, DistributedPlan] = {}
 
     def plan_distributed(self, sql: str) -> DistributedPlan:
-        from presto_tpu.exec.runtime import ExecContext, run_plan
-        from presto_tpu.plan.builder import plan_query
-        from presto_tpu.plan.fragmenter import fragment_plan
-        from presto_tpu.plan.optimizer import optimize
-
-        hit = self._dplan_cache.get(sql)
-        if hit is not None:
-            return hit
-        qp = optimize(plan_query(sql, self.catalog))
-        cacheable = not qp.scalar_subqueries
-        if qp.scalar_subqueries:
-            # bind uncorrelated scalar subqueries coordinator-side first
-            # (the reference runs them as separate plan stages)
-            from presto_tpu.exec.runtime import _bind_plan_params
-            from presto_tpu.expr.ir import Constant
-
-            ctx = ExecContext(self.catalog, self.config)
-            bindings = {}
-            for sym, sub in qp.scalar_subqueries.items():
-                sub_out = run_plan(sub, ctx)
-                vals = sub_out.to_pydict(decode_strings=False)[sub_out.names[0]]
-                if len(vals) != 1:
-                    raise RuntimeError(f"scalar subquery returned {len(vals)} rows")
-                bindings[sym] = Constant(sub_out.types[0], vals[0], raw=True)
-            _bind_plan_params(qp.root, bindings)
-        dplan = fragment_plan(
-            qp, self.catalog,
-            broadcast_threshold_rows=self.broadcast_threshold_rows,
-        )
-        if cacheable:
-            self._dplan_cache[sql] = dplan
-        return dplan
+        return self.coordinator.plan_distributed(sql)
 
     def explain_distributed(self, sql: str) -> str:
-        return self.plan_distributed(sql).to_string()
+        return self.coordinator.plan_distributed(sql).to_string()
 
     def run_batch(self, sql: str) -> Batch:
-        import jax.numpy as jnp
-
-        from presto_tpu.exec.runtime import _JIT_COMPACT, _collect_concat
-
-        dplan = self.plan_distributed(sql)
-        batches = list(self.coordinator.execute_distributed(dplan))
-        merged = _collect_concat(iter(batches))
-        if merged is None:
-            root = dplan.fragments[dplan.root_fid].root
-            types = dict(root.output)
-            from presto_tpu.batch import Column
-
-            merged = Batch(
-                dplan.output_names,
-                [types[n] for n in dplan.output_names],
-                [Column(jnp.zeros(128, types[n].dtype), None)
-                 for n in dplan.output_names],
-                jnp.zeros(128, bool),
-                {},
-            )
-        return _JIT_COMPACT(merged)
+        return self.coordinator.run_batch(sql)
 
     def run(self, sql: str):
         return self.run_batch(sql).to_pandas()

@@ -124,7 +124,7 @@ class QueryExecution:
             self.result = result
             self._transition(FINISHED)
         except Exception as e:  # noqa: BLE001 — query failure is data, not a crash
-            self.fail(f"{type(e).__name__}: {e}")
+            self.fail(f"{type(e).__name__}: {e}", error_type=type(e).__name__)
             self._traceback = traceback.format_exc()
 
     def info(self) -> QueryInfo:
@@ -169,8 +169,11 @@ class QueryManager:
 
     # -- lifecycle ---------------------------------------------------------
 
-    def create_query(self, session: Session, sql: str) -> QueryExecution:
-        qe = QueryExecution(session, sql, self._execute_fn)
+    def create_query(self, session: Session, sql: str,
+                     execute_fn: Optional[Callable] = None) -> QueryExecution:
+        """execute_fn override supports coordinator-side statements
+        (SHOW/SET/EXPLAIN — DataDefinitionExecution analog)."""
+        qe = QueryExecution(session, sql, execute_fn or self._execute_fn)
         # slot accounting: a group slot is held only once the group actually
         # starts the query (a query canceled while still queued never held
         # one); release exactly once whichever of {terminal transition,

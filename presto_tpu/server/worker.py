@@ -310,6 +310,7 @@ class Worker:
             name=f"worker-http-{self.node_id}",
         )
         self._serve_thread.start()
+        self._coordinator_url = coordinator_url
         self._announce_thread = None
         if coordinator_url:
             self._announce_thread = threading.Thread(
@@ -326,23 +327,31 @@ class Worker:
             "runningTasks": sum(1 for t in tasks.values() if t.state == "running"),
         }
 
+    def _announce_once(self):
+        """One announcement PUT carrying this node's current state."""
+        import urllib.request
+
+        if not self._coordinator_url:
+            return
+        try:
+            body = json.dumps({"nodeId": self.node_id, "uri": self.url,
+                               "state": self.node_state}).encode()
+            req = urllib.request.Request(
+                f"{self._coordinator_url}/v1/announcement/{self.node_id}",
+                data=body, method="PUT",
+                headers={"Content-Type": "application/json"},
+            )
+            urllib.request.urlopen(req, timeout=5).read()
+        except Exception:
+            pass
+
     def _announce_loop(self, coordinator_url: str):
         """Service announcement (airlift discovery analog): re-announce
         periodically so the coordinator can expire dead nodes."""
         import time
-        import urllib.request
 
-        while self.node_state == "active":
-            try:
-                body = json.dumps({"nodeId": self.node_id, "uri": self.url}).encode()
-                req = urllib.request.Request(
-                    f"{coordinator_url}/v1/announcement/{self.node_id}",
-                    data=body, method="PUT",
-                    headers={"Content-Type": "application/json"},
-                )
-                urllib.request.urlopen(req, timeout=5).read()
-            except Exception:
-                pass
+        while self.node_state != "shut_down":
+            self._announce_once()
             time.sleep(1.0)
 
     def start_graceful_shutdown(self):
@@ -353,6 +362,9 @@ class Worker:
             import time
 
             self.node_state = "shutting_down"
+            # tell discovery immediately (don't wait for the next
+            # announcement cycle) so scheduling stops routing here
+            self._announce_once()
             while self.task_manager.has_running():
                 time.sleep(0.1)
             self.close()

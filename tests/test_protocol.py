@@ -1,0 +1,180 @@
+"""Client protocol tier: /v1/statement paging, session headers, SET/SHOW
+statements, DBAPI, CLI formatting — over a real in-process cluster
+(reference: StatementResource + StatementClientV1 + presto-jdbc behavior)."""
+
+import pytest
+
+import presto_tpu.dbapi as dbapi
+from presto_tpu.catalog.tpch import tpch_catalog
+from presto_tpu.cli import format_table, run_statement
+from presto_tpu.client import ClientSession, QueryError, StatementClient, execute
+from presto_tpu.exec import ExecConfig
+from presto_tpu.server.coordinator import DistributedRunner
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    cat = tpch_catalog(0.01)
+    runner = DistributedRunner(cat, n_workers=2,
+                               config=ExecConfig(batch_rows=1 << 14))
+    yield runner
+    runner.close()
+
+
+def test_statement_roundtrip(cluster):
+    server = cluster.coordinator.url
+    cols, rows = execute(server, "select n_name, n_regionkey from nation where n_regionkey = 1")
+    assert cols == ["n_name", "n_regionkey"]
+    assert len(rows) == 5
+    assert all(r[1] == 1 for r in rows)
+
+
+def test_statement_paging(cluster):
+    # page_rows=1000 default; nation is 25 rows → single page, but exercise
+    # a result bigger than one page by shrinking the page size
+    cluster.coordinator.protocol.page_rows = 10
+    try:
+        cols, rows = execute(cluster.coordinator.url,
+                             "select o_orderkey from orders")
+        assert len(rows) > 10  # crossed page boundaries
+    finally:
+        cluster.coordinator.protocol.page_rows = 1000
+
+
+def test_date_and_decimal_wire_format(cluster):
+    _, rows = execute(
+        cluster.coordinator.url,
+        "select o_orderdate, o_totalprice from orders limit 1",
+    )
+    d, p = rows[0]
+    assert isinstance(d, str) and len(d.split("-")) == 3  # ISO date
+    float(p)  # decimal travels as exact string
+
+
+def test_error_reporting(cluster):
+    with pytest.raises(QueryError) as ei:
+        execute(cluster.coordinator.url, "select nonexistent_col from nation")
+    assert "nonexistent_col" in str(ei.value)
+
+
+def test_set_show_session(cluster):
+    server = cluster.coordinator.url
+    session = ClientSession()
+    c = StatementClient(server, "set session batch_rows = 4096", session)
+    list(c.rows())
+    assert session.properties.get("batch_rows") == "4096"
+    # SHOW SESSION reflects the override carried via headers
+    c = StatementClient(server, "show session", session)
+    rows = list(c.rows())
+    by_name = {r[0]: r[1] for r in rows}
+    assert by_name["batch_rows"] == "4096"
+    # RESET clears it
+    c = StatementClient(server, "reset session batch_rows", session)
+    list(c.rows())
+    assert "batch_rows" not in session.properties
+
+
+def test_show_tables_and_columns(cluster):
+    server = cluster.coordinator.url
+    _, tables = execute(server, "show tables")
+    names = {t[0] for t in tables}
+    assert {"lineitem", "orders", "nation"} <= names
+    _, cols = execute(server, "describe nation")
+    assert ("n_name", "varchar") in [tuple(c) for c in cols]
+
+
+def test_explain_statement(cluster):
+    _, rows = execute(cluster.coordinator.url,
+                      "explain select count(*) from nation")
+    text = "\n".join(r[0] for r in rows)
+    assert "Fragment" in text and "TableScan" in text
+
+
+def test_dbapi(cluster):
+    conn = dbapi.connect(cluster.coordinator.url, user="alice")
+    cur = conn.cursor()
+    cur.execute("select n_name from nation where n_regionkey = ?", (0,))
+    rows = cur.fetchall()
+    assert len(rows) == 5
+    assert cur.description[0][0] == "n_name"
+    cur.execute("select count(*) from region")
+    assert cur.fetchone()[0] == 5
+    assert cur.fetchone() is None
+    with pytest.raises(dbapi.DatabaseError):
+        cur.execute("select bogus from nation")
+        cur.fetchall()
+    conn.close()
+
+
+def test_cli_execute(cluster, capsys):
+    ok = run_statement(cluster.coordinator.url,
+                       "select r_name from region order by r_name",
+                       ClientSession())
+    assert ok
+    out = capsys.readouterr().out
+    assert "AFRICA" in out and "r_name" in out and "rows" in out
+
+
+def test_cli_table_format():
+    s = format_table(["a", "long_column"], [[1, "x"], [None, "yy"]])
+    lines = s.split("\n")
+    assert lines[0].startswith("a")
+    assert "NULL" in s
+    assert len(set(len(l) for l in lines)) <= 2  # aligned
+
+
+def test_dbapi_placeholder_in_string_literal(cluster):
+    conn = dbapi.connect(cluster.coordinator.url)
+    cur = conn.cursor()
+    # a '?' inside a quoted literal or inside a substituted value must
+    # not be treated as a placeholder
+    cur.execute("select count(*) as c from nation where n_name = ? and n_name <> '?'",
+                ("x?y",))
+    assert cur.fetchone()[0] == 0
+    with pytest.raises(dbapi.ProgrammingError):
+        cur.execute("select 1 from nation where n_name = ?", ("a", "extra"))
+    conn.close()
+
+
+def test_canceled_query_reports_user_canceled(cluster):
+    from presto_tpu.server.session import Session
+
+    qe = cluster.coordinator.query_manager.create_query(
+        Session(), "select 1", execute_fn=lambda s, q: __import__("time").sleep(30)
+    )
+    cluster.coordinator.query_manager.cancel(qe.query_id)
+    out = cluster.coordinator.protocol.poll(qe.query_id, 0)
+    assert out["error"]["errorName"] == "USER_CANCELED"
+
+
+def test_session_join_distribution_type_changes_plan(cluster):
+    from presto_tpu.server.session import Session
+
+    sql = ("select count(*) as c from orders join customer "
+           "on o_custkey = c_custkey")
+    s_bc = Session(properties={"join_distribution_type": "BROADCAST"})
+    s_part = Session(properties={"join_distribution_type": "PARTITIONED"})
+    p_bc = cluster.coordinator.plan_distributed(sql, s_bc).to_string()
+    p_part = cluster.coordinator.plan_distributed(sql, s_part).to_string()
+    assert "broadcast" in p_bc and "broadcast" not in p_part
+
+
+def test_cli_split_statements():
+    from presto_tpu.cli import split_statements
+
+    stmts = split_statements("select 'a;b' as x; select 2;\n-- nothing\n")
+    assert stmts[0] == "select 'a;b' as x"
+    assert stmts[1] == "select 2"
+
+
+def test_query_history_endpoint(cluster):
+    import json
+    import urllib.request
+
+    execute(cluster.coordinator.url, "select 1 as one from region limit 1")
+    with urllib.request.urlopen(f"{cluster.coordinator.url}/v1/query") as r:
+        queries = json.loads(r.read())
+    assert any(q["state"] == "FINISHED" for q in queries)
+    with urllib.request.urlopen(f"{cluster.coordinator.url}/v1/cluster") as r:
+        stats = json.loads(r.read())
+    assert stats["activeWorkers"] == 2
