@@ -46,8 +46,12 @@ class StatementClient:
         if self.session.schema:
             h["X-Presto-Schema"] = self.session.schema
         if self.session.properties:
+            from urllib.parse import quote
+
+            # values are URL-encoded: a comma inside a value must survive
+            # the comma-separated pair list (reference protocol does the same)
             h["X-Presto-Session"] = ",".join(
-                f"{k}={v}" for k, v in self.session.properties.items()
+                f"{k}={quote(str(v))}" for k, v in self.session.properties.items()
             )
         return h
 

@@ -82,6 +82,13 @@ class ExecConfig:
     # EXPLAIN ANALYZE: per-operator wall/rows/batches accounting (forces a
     # device sync per batch — off in production, like Presto's verbose stats)
     collect_stats: bool = False
+    # memory + spill (reference: MemoryPool / spiller; None = unlimited)
+    memory_pool_bytes: Optional[int] = None
+    spill_enabled: bool = True
+    spill_dir: Optional[str] = None
+    spill_partitions: int = 8
+    memory_revoking_threshold: float = 0.9
+    memory_revoking_target: float = 0.5
 
 
 def _node_jit(node: PlanNode, key: str, builder, **jit_kwargs):
@@ -96,7 +103,11 @@ def _node_jit(node: PlanNode, key: str, builder, **jit_kwargs):
 
 
 class ExecContext:
-    def __init__(self, catalog: Catalog, config: ExecConfig):
+    def __init__(self, catalog: Catalog, config: ExecConfig,
+                 memory_pool=None, spill_manager=None):
+        from presto_tpu.memory import MemoryPool
+        from presto_tpu.spiller import SpillManager
+
         self.catalog = catalog
         self.config = config
         self.stats: Dict[str, float] = {}
@@ -111,6 +122,22 @@ class ExecContext:
         # fragment_id -> callable returning an iterator of Batches pulled
         # from the exchange (the ExchangeOperator's client)
         self.remote_sources = None
+        # memory + spill: worker-shared when provided, else per-context
+        # (QueryContext → MemoryPool; SpillSpaceTracker)
+        self.memory_pool = memory_pool or MemoryPool(
+            config.memory_pool_bytes,
+            revoke_threshold=config.memory_revoking_threshold,
+            revoke_target=config.memory_revoking_target,
+        )
+        self.spill_manager = spill_manager or SpillManager(config.spill_dir)
+
+    def should_spill(self, projected_delta_bytes: int) -> bool:
+        """Would adding this reservation cross the revoke threshold?"""
+        pool = self.memory_pool
+        if pool.limit is None or not self.config.spill_enabled:
+            return False
+        return (pool.reserved + projected_delta_bytes
+                > pool.limit * pool.revoke_threshold)
 
     def record(self, node, rows: int, wall_s: float):
         s = self.node_stats.setdefault(
@@ -421,31 +448,157 @@ def _execute_aggregate(node: Aggregate, ctx: ExecContext) -> Iterator[Batch]:
         out = Batch(names, types, cols, out_live, dicts)
         return out, n_groups
 
+    def acc_merge_step(acc: Optional[Batch], b: Batch, cap: int):
+        """Merge a previously-spilled accumulator batch (state columns, not
+        raw input) into acc — both sides use accumulator semantics."""
+        kin, sin = acc_to_states(b)
+        live = b.live
+        if acc is not None:
+            ka, sa = acc_to_states(acc)
+            kin = [
+                KeyCol(
+                    jnp.concatenate([a.values, i.values]),
+                    _concat_validity(a.validity, i.validity, acc.capacity, b.capacity),
+                )
+                for a, i in zip(ka, kin)
+            ]
+            sin = [
+                StateCol(
+                    jnp.concatenate([a.values, i.values]),
+                    _concat_validity(a.validity, i.validity, acc.capacity, b.capacity),
+                    a.op,
+                )
+                for a, i in zip(sa, sin)
+            ]
+            live = jnp.concatenate([acc.live, live])
+        kout, sout, out_live, n_groups = grouped_merge(kin, sin, live, cap)
+        cols = [Column(k.values, k.validity) for k in kout] + [
+            Column(s.values, s.validity if s.op != "count_add" else None) for s in sout
+        ]
+        names = list(key_syms) + [name for name, _, _ in layout]
+        types = key_types + state_types
+        dicts = {k: b.dicts[k] for k in key_syms if k in b.dicts}
+        return Batch(names, types, cols, out_live, dicts), n_groups
+
     jit_step = _node_jit(node, "step", lambda: (lambda acc, b, cap: merge_step(acc, b, cap)), static_argnums=(2,))
     jit_step0 = _node_jit(node, "step0", lambda: (lambda b, cap: merge_step(None, b, cap)), static_argnums=(1,))
+    jit_accstep = _node_jit(node, "accstep", lambda: acc_merge_step, static_argnums=(2,))
+
+    from presto_tpu.memory import LocalMemoryContext, batch_device_bytes
+
+    import threading as _threading
 
     cap = ctx.config.agg_capacity
-    acc: Optional[Batch] = None
-    for b in in_stream:
-        for _ in range(ctx.config.max_growth_retries):
-            if acc is None:
-                out, ng = jit_step0(b, cap)
-            else:
-                out, ng = jit_step(acc, b, cap)
-            ngi = int(ng)
-            if ngi <= cap:
-                acc = out
-                break
-            cap = round_up_capacity(ngi * 2)
-        else:
-            raise RuntimeError("aggregate capacity growth exceeded retries")
+    state = {"acc": None, "spiller": None, "revoke_requested": False}
+    mctx = LocalMemoryContext(ctx.memory_pool, "aggregate")
+    can_spill = bool(key_syms) and ctx.config.spill_enabled
+    owner_thread = _threading.get_ident()
 
-    if node.step == "partial":
-        # emit raw state columns for the exchange; no finalization
-        if acc is not None:
-            yield acc
-        return
-    yield _finalize_aggregate(node, acc, layout, key_syms, key_types, state_types, in_types)
+    def do_spill() -> int:
+        """Partition-spill the accumulator (SpillableHashAggregationBuilder:
+        state pages leave memory partitioned by hash(keys) so each partition
+        finalizes independently later)."""
+        acc0 = state["acc"]
+        if acc0 is None:
+            return 0
+        if state["spiller"] is None:
+            state["spiller"] = ctx.spill_manager.partitioning_spiller(
+                key_syms, ctx.config.spill_partitions, "agg"
+            )
+        state["spiller"].spill(acc0)
+        freed = mctx.bytes
+        state["acc"] = None
+        mctx.set_bytes(0)
+        ctx.spill_manager.record(freed)
+        return freed
+
+    def revoke(_need: int) -> int:
+        """Pool-pressure callback. Like the reference's revocable-memory
+        protocol this is always a REQUEST honored at the next batch
+        boundary: spilling synchronously here would re-enter set_bytes
+        (a reserve() mid-flight can trigger our own revoker) and corrupt
+        the accounting on a worker-shared pool."""
+        state["revoke_requested"] = True
+        return 0
+
+    if can_spill:
+        ctx.memory_pool.add_revoker(revoke)
+    try:
+        def absorb(stream, step_fn, step0_fn, allow_spill=True):
+            nonlocal cap
+            for b in stream:
+                for _ in range(ctx.config.max_growth_retries):
+                    if state["acc"] is None:
+                        out, ng = step0_fn(b, cap)
+                    else:
+                        out, ng = step_fn(state["acc"], b, cap)
+                    ngi = int(ng)
+                    if ngi <= cap:
+                        break
+                    # power-of-two bucketing already gives ≤2× headroom;
+                    # doubling on top of it would 4× the memory footprint
+                    cap = round_up_capacity(ngi)
+                else:
+                    raise RuntimeError("aggregate capacity growth exceeded retries")
+                out_bytes = batch_device_bytes(out)
+                state["acc"] = out
+                if allow_spill and can_spill and (
+                    state["revoke_requested"]
+                    or ctx.should_spill(out_bytes - mctx.bytes)
+                ):
+                    state["revoke_requested"] = False
+                    do_spill()
+                else:
+                    mctx.set_bytes(out_bytes)
+
+        absorb(in_stream, jit_step, jit_step0)
+
+        if state["spiller"] is None:
+            acc = state["acc"]
+            if node.step == "partial":
+                # emit raw state columns for the exchange; no finalization
+                if acc is not None:
+                    yield acc
+                return
+            yield _finalize_aggregate(node, acc, layout, key_syms, key_types,
+                                      state_types, in_types)
+            return
+
+        # spilled: finalize bucket-by-bucket (grouped-execution style).
+        # Spilling is disabled during the per-partition merge — re-spilling
+        # into files being read back would corrupt them; a partition that
+        # still exceeds the limit fails the query (the reference's
+        # unspillable-final-merge failure mode).
+        do_spill()
+        ctx.memory_pool.remove_revoker(revoke)
+        spiller = state["spiller"]
+        jit_accstep0 = _node_jit(
+            node, "accstep0", lambda: (lambda b, cap: acc_merge_step(None, b, cap)),
+            static_argnums=(1,),
+        )
+        for p in range(spiller.n_partitions):
+            state["acc"] = None
+            # each bucket holds ~1/P of the groups — shrink the table back
+            # (it regrows geometrically if a bucket is skewed)
+            cap = ctx.config.agg_capacity
+            absorb(spiller.read_partition(p), jit_accstep, jit_accstep0,
+                   allow_spill=False)
+            acc = state["acc"]
+            if acc is None:
+                continue
+            if node.step == "partial":
+                yield acc
+            else:
+                yield _finalize_aggregate(node, acc, layout, key_syms,
+                                          key_types, state_types, in_types)
+            mctx.set_bytes(0)
+        spiller.close()
+    finally:
+        if can_spill:
+            ctx.memory_pool.remove_revoker(revoke)
+        mctx.set_bytes(0)
+        if state["spiller"] is not None:
+            state["spiller"].close()
 
 
 def _concat_validity(a, b, cap_a, cap_b):
@@ -573,8 +726,81 @@ def _collect_concat(stream: Iterator[Batch]) -> Optional[Batch]:
 
 
 def _execute_join(node: HashJoin, ctx: ExecContext) -> Iterator[Batch]:
-    build_in = _collect_concat(execute_node(node.right, ctx))
+    from presto_tpu.memory import LocalMemoryContext, batch_device_bytes
+
     probe_stream, chain = _fused_child(node.left, ctx)
+    build_stream = execute_node(node.right, ctx)
+
+    # Collect the build side with memory accounting; crossing the revoke
+    # threshold switches to the partitioned-spill path (HashBuilderOperator's
+    # SPILLING_INPUT state + GenericPartitioningSpiller: both sides are
+    # hash-partitioned to disk on the join keys and each bucket is joined
+    # independently).
+    mctx = LocalMemoryContext(ctx.memory_pool, "join-build")
+    build_batches: List[Batch] = []
+    bspiller = None
+    try:
+        for b in build_stream:
+            nb = batch_device_bytes(b)
+            if ctx.config.spill_enabled and ctx.should_spill(nb):
+                P = ctx.config.spill_partitions
+                bspiller = ctx.spill_manager.partitioning_spiller(
+                    node.right_keys, P, "join-build"
+                )
+                for bb in build_batches:
+                    bspiller.spill(bb)
+                ctx.spill_manager.record(mctx.bytes)
+                build_batches = []
+                mctx.set_bytes(0)
+                bspiller.spill(b)
+                for bb in build_stream:
+                    bspiller.spill(bb)
+                break
+            build_batches.append(b)
+            mctx.set_bytes(mctx.bytes + nb)
+
+        if bspiller is None:
+            build_in = _collect_concat(iter(build_batches))
+            yield from _join_probe(node, ctx, build_in, probe_stream, chain)
+            return
+
+        # spill the (chained) probe side partitioned by the probe keys —
+        # co-partitioned with the build because both sides hash the key
+        # CONTENT (string keys by dictionary-independent value hash) % P
+        P = bspiller.n_partitions
+        pspiller = ctx.spill_manager.partitioning_spiller(
+            node.left_keys, P, "join-probe"
+        )
+        try:
+            jchain = _node_jit(node, "spill_chain", lambda: chain)
+            for pb in probe_stream:
+                pspiller.spill(jchain(pb))
+            ident = lambda b: b  # noqa: E731 — chain already applied pre-spill
+            for p in range(P):
+                build_in = _collect_concat(bspiller.read_partition(p))
+                if build_in is None and node.kind == "inner":
+                    continue
+                # account the materialized bucket — a skewed partition that
+                # exceeds the pool limit must fail cleanly, not OOM silently
+                if build_in is not None:
+                    mctx.set_bytes(batch_device_bytes(build_in))
+                yield from _join_probe(node, ctx, build_in,
+                                       pspiller.read_partition(p), ident,
+                                       jkey="spill_")
+                mctx.set_bytes(0)
+        finally:
+            pspiller.close()
+    finally:
+        if bspiller is not None:
+            bspiller.close()
+        mctx.set_bytes(0)
+
+
+def _join_probe(node: HashJoin, ctx: ExecContext, build_in: Optional[Batch],
+                probe_stream: Iterator[Batch], chain,
+                jkey: str = "") -> Iterator[Batch]:
+    # jkey prefixes the per-node jit-cache keys: the spilled path probes with
+    # an identity chain and must not reuse closures compiled with the real one
     lsyms = [n for n, _ in node.left.output]
     rsyms = [n for n, _ in node.right.output]
 
@@ -614,7 +840,7 @@ def _execute_join(node: HashJoin, ctx: ExecContext) -> Iterator[Batch]:
                     cols[i] = Column(c.values, valid & matched)
             return Batch(out.names, out.types, cols, out.live, out.dicts)
 
-        jfn = _node_jit(node, "probe", lambda: probe_fn)
+        jfn = _node_jit(node, jkey + "probe", lambda: probe_fn)
         for pb in probe_stream:
             yield jfn(table, pb)
         return
@@ -628,7 +854,7 @@ def _execute_join(node: HashJoin, ctx: ExecContext) -> Iterator[Batch]:
         pba = align_probe_strings(pb, tuple(node.left_keys), table, tuple(node.right_keys))
         return pb, pba
 
-    chain_j = _node_jit(node, "chain_align", lambda: chain_align)
+    chain_j = _node_jit(node, jkey + "chain_align", lambda: chain_align)
     counts_fn = _node_jit(
         node, "counts",
         lambda: lambda t, pba: probe_counts(

@@ -1,0 +1,148 @@
+"""Memory accounting: context tree + pools + revocation.
+
+Reference: presto-memory-context (AggregatedMemoryContext /
+LocalMemoryContext), memory/MemoryPool.java + QueryContext.java (reserve /
+free with blocking), execution/MemoryRevokingScheduler.java:46 (when a pool
+crosses a threshold, ask revocable operators to spill down to a target).
+
+TPU-native shape: the scarce resource is HBM. Batches are fixed-capacity
+device arrays, so accounting is exact: capacity × itemsize summed over
+columns. Execution is synchronous per batch, so revocation is synchronous
+too — a reserve() that crosses the threshold invokes registered revokers
+(spillable aggregations / join builds) inline until usage drops below the
+target, then proceeds; if nothing can be revoked and the limit is exceeded,
+the query fails with EXCEEDED_MEMORY_LIMIT (the per-node slice of the
+cluster OOM-killer policy).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, List, Optional
+
+
+class ExceededMemoryLimit(RuntimeError):
+    pass
+
+
+class MemoryPool:
+    """A worker's query memory pool (MemoryPool.java analog)."""
+
+    def __init__(self, limit_bytes: Optional[int] = None,
+                 revoke_threshold: float = 0.9, revoke_target: float = 0.5):
+        self.limit = limit_bytes
+        self.reserved = 0
+        self.peak = 0
+        self.revoke_threshold = revoke_threshold
+        self.revoke_target = revoke_target
+        self._lock = threading.Lock()
+        # revocable-state owners: fn(bytes_to_free) -> bytes actually freed
+        self._revokers: List[Callable[[int], int]] = []
+
+    def add_revoker(self, fn: Callable[[int], int]):
+        with self._lock:
+            self._revokers.append(fn)
+
+    def remove_revoker(self, fn: Callable[[int], int]):
+        with self._lock:
+            try:
+                self._revokers.remove(fn)
+            except ValueError:
+                pass
+
+    def reserve(self, bytes_: int, tag: str = "") -> None:
+        if bytes_ <= 0:
+            return
+        if self.limit is not None:
+            with self._lock:
+                projected = self.reserved + bytes_
+                over_threshold = projected > self.limit * self.revoke_threshold
+                revokers = list(self._revokers) if over_threshold else []
+            if revokers:
+                # MemoryRevokingScheduler: revoke until usage ≤ target
+                target = int(self.limit * self.revoke_target)
+                for fn in revokers:
+                    if self.reserved + bytes_ <= target:
+                        break
+                    try:
+                        fn(self.reserved + bytes_ - target)
+                    except Exception:
+                        pass
+            with self._lock:
+                if self.reserved + bytes_ > self.limit:
+                    raise ExceededMemoryLimit(
+                        f"Query exceeded per-node memory limit of "
+                        f"{self.limit} bytes (requested {bytes_} for {tag}, "
+                        f"reserved {self.reserved})"
+                    )
+                self.reserved += bytes_
+                self.peak = max(self.peak, self.reserved)
+        else:
+            with self._lock:
+                self.reserved += bytes_
+                self.peak = max(self.peak, self.reserved)
+
+    def free(self, bytes_: int) -> None:
+        if bytes_ <= 0:
+            return
+        with self._lock:
+            self.reserved = max(0, self.reserved - bytes_)
+
+    def info(self) -> dict:
+        with self._lock:
+            return {"reservedBytes": self.reserved, "peakBytes": self.peak,
+                    "limitBytes": self.limit}
+
+
+class LocalMemoryContext:
+    """One operator's accounting slot (LocalMemoryContext.java): setBytes
+    semantics — the delta flows to the pool."""
+
+    def __init__(self, pool: MemoryPool, tag: str = ""):
+        self.pool = pool
+        self.tag = tag
+        self.bytes = 0
+
+    def set_bytes(self, n: int):
+        delta = n - self.bytes
+        if delta > 0:
+            self.pool.reserve(delta, self.tag)
+        else:
+            self.pool.free(-delta)
+        self.bytes = n
+
+    def close(self):
+        self.set_bytes(0)
+
+
+class AggregatedMemoryContext:
+    """Groups child contexts (task/query rollup —
+    AggregatedMemoryContext.java)."""
+
+    def __init__(self, pool: MemoryPool, tag: str = ""):
+        self.pool = pool
+        self.tag = tag
+        self._children: List[LocalMemoryContext] = []
+
+    def new_local(self, tag: str = "") -> LocalMemoryContext:
+        c = LocalMemoryContext(self.pool, f"{self.tag}/{tag}")
+        self._children.append(c)
+        return c
+
+    @property
+    def bytes(self) -> int:
+        return sum(c.bytes for c in self._children)
+
+    def close(self):
+        for c in self._children:
+            c.close()
+
+
+def batch_device_bytes(batch) -> int:
+    """Exact device footprint of a Batch (static shapes make this precise)."""
+    total = batch.live.shape[0]  # live mask: 1 byte/row
+    for c in batch.columns:
+        total += c.values.shape[0] * c.values.dtype.itemsize
+        if c.validity is not None:
+            total += c.validity.shape[0]
+    return total

@@ -31,12 +31,32 @@ _FLAG_ZSTD = 1
 
 try:
     import zstandard as _zstd
-
-    _ZC = _zstd.ZstdCompressor(level=1)
-    _ZD = _zstd.ZstdDecompressor()
 except Exception:  # pragma: no cover
     _zstd = None
-    _ZC = _ZD = None
+
+import threading as _threading
+
+_TLS = _threading.local()
+
+
+def _zc():
+    """Per-thread compressor: zstd (de)compressor objects are not safe for
+    concurrent use, and worker tasks serialize pages from many threads."""
+    if _zstd is None:
+        return None
+    c = getattr(_TLS, "zc", None)
+    if c is None:
+        c = _TLS.zc = _zstd.ZstdCompressor(level=1)
+    return c
+
+
+def _zd():
+    if _zstd is None:
+        return None
+    d = getattr(_TLS, "zd", None)
+    if d is None:
+        d = _TLS.zd = _zstd.ZstdDecompressor()
+    return d
 
 
 # -- dictionary interning ----------------------------------------------------
@@ -100,8 +120,9 @@ def serialize_batch(b: Batch, compress: bool = True) -> bytes:
             header["dicts"][name] = [str(v) for v in b.dicts[name].values]
     payload = b"".join(buffers)
     flags = 0
-    if compress and _ZC is not None and len(payload) > 512:
-        payload = _ZC.compress(payload)
+    zc = _zc()
+    if compress and zc is not None and len(payload) > 512:
+        payload = zc.compress(payload)
         flags |= _FLAG_ZSTD
     hj = json.dumps(header, separators=(",", ":")).encode()
     return _MAGIC + struct.pack("<BII", flags, len(hj), len(payload)) + hj + payload
@@ -115,7 +136,7 @@ def deserialize_batch(data: bytes, capacity: Optional[int] = None,
     header = json.loads(data[off:off + hlen])
     payload = data[off + hlen:off + hlen + plen]
     if flags & _FLAG_ZSTD:
-        payload = _ZD.decompress(payload)
+        payload = _zd().decompress(payload)
     n = header["n"]
     cap = capacity or round_up_capacity(max(n, 1))
     names = header["names"]

@@ -539,7 +539,11 @@ class DistributedRunner:
         )
         self.workers = [
             Worker(catalog, node_id=f"worker-{i}",
-                   coordinator_url=self.coordinator.url)
+                   coordinator_url=self.coordinator.url,
+                   memory_pool_bytes=self.config.memory_pool_bytes,
+                   spill_dir=self.config.spill_dir,
+                   revoke_threshold=self.config.memory_revoking_threshold,
+                   revoke_target=self.config.memory_revoking_target)
             for i in range(n_workers)
         ]
 

@@ -91,10 +91,14 @@ class StatementProtocol:
         props: Dict[str, Any] = {}
         raw = headers.get("X-Presto-Session") or headers.get("X-Trino-Session")
         if raw:
+            from urllib.parse import unquote
+
             for pair in raw.split(","):
                 if "=" in pair:
                     k, v = pair.split("=", 1)
-                    props[k.strip()] = SYSTEM_PROPERTIES.decode(k.strip(), v.strip())
+                    props[k.strip()] = SYSTEM_PROPERTIES.decode(
+                        k.strip(), unquote(v.strip())
+                    )
         return Session(
             user=headers.get("X-Presto-User") or "user",
             source=headers.get("X-Presto-Source") or "",

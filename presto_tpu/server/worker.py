@@ -70,10 +70,13 @@ def _jit_partition_ids(keys: tuple, n_parts: int):
 class TaskExecution:
     """One task: fragment + splits in, pages out (SqlTaskExecution analog)."""
 
-    def __init__(self, task_id: str, update: TaskUpdate, catalog: Catalog):
+    def __init__(self, task_id: str, update: TaskUpdate, catalog: Catalog,
+                 memory_pool=None, spill_manager=None):
         self.task_id = task_id
         self.update = update
         self.catalog = catalog
+        self.memory_pool = memory_pool
+        self.spill_manager = spill_manager
         self.state = "running"
         self.error: Optional[str] = None
         f = update.fragment
@@ -96,7 +99,9 @@ class TaskExecution:
     def _run(self):
         try:
             cfg = ExecConfig(**self.update.config)
-            ctx = ExecContext(self.catalog, cfg)
+            ctx = ExecContext(self.catalog, cfg,
+                              memory_pool=self.memory_pool,
+                              spill_manager=self.spill_manager)
             ctx.task_index = self.update.task_index
             ctx.n_tasks = self.update.n_tasks
             ctx.remote_sources = self._remote_source_factory
@@ -163,8 +168,13 @@ class TaskExecution:
 class TaskManager:
     """SqlTaskManager analog: task registry keyed by task id."""
 
-    def __init__(self, catalog: Catalog):
+    def __init__(self, catalog: Catalog, memory_pool=None, spill_manager=None):
+        from presto_tpu.memory import MemoryPool
+        from presto_tpu.spiller import SpillManager
+
         self.catalog = catalog
+        self.memory_pool = memory_pool or MemoryPool(None)
+        self.spill_manager = spill_manager or SpillManager()
         self.tasks: Dict[str, TaskExecution] = {}
         self._lock = threading.Lock()
 
@@ -172,7 +182,8 @@ class TaskManager:
         with self._lock:
             t = self.tasks.get(task_id)
             if t is None:
-                t = TaskExecution(task_id, update, self.catalog)
+                t = TaskExecution(task_id, update, self.catalog,
+                                  self.memory_pool, self.spill_manager)
                 self.tasks[task_id] = t
             return t.info()
 
@@ -203,10 +214,21 @@ class Worker:
     """A worker node: HTTP server + task manager + node lifecycle."""
 
     def __init__(self, catalog: Catalog, node_id: str = "worker-0",
-                 port: int = 0, coordinator_url: Optional[str] = None):
+                 port: int = 0, coordinator_url: Optional[str] = None,
+                 memory_pool_bytes: Optional[int] = None,
+                 spill_dir: Optional[str] = None,
+                 revoke_threshold: float = 0.9, revoke_target: float = 0.5):
+        from presto_tpu.memory import MemoryPool
+        from presto_tpu.spiller import SpillManager
+
         self.catalog = catalog
         self.node_id = node_id
-        self.task_manager = TaskManager(catalog)
+        self.memory_pool = MemoryPool(memory_pool_bytes,
+                                      revoke_threshold=revoke_threshold,
+                                      revoke_target=revoke_target)
+        self.spill_manager = SpillManager(spill_dir)
+        self.task_manager = TaskManager(catalog, self.memory_pool,
+                                        self.spill_manager)
         self.node_state = "active"   # active | shutting_down | shut_down
         worker = self
 
@@ -325,6 +347,9 @@ class Worker:
             "state": self.node_state,
             "tasks": len(tasks),
             "runningTasks": sum(1 for t in tasks.values() if t.state == "running"),
+            "memory": self.memory_pool.info(),
+            "spilledBytes": self.spill_manager.total_spilled_bytes,
+            "spillCount": self.spill_manager.spill_count,
         }
 
     def _announce_once(self):

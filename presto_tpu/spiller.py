@@ -1,0 +1,172 @@
+"""Spilling: hash-partitioned batch spill files.
+
+Reference: spiller/ (FileSingleStreamSpiller — pages serialized to a temp
+file; GenericPartitioningSpiller — rows routed to per-partition spill
+streams) driving SpillableHashAggregationBuilder and HashBuilderOperator's
+SPILLING_INPUT state.
+
+TPU-native shape: spill moves whole fixed-capacity batches HBM → host disk
+using the exchange page format (serde). Partitioning reuses the device
+hash-partition kernel: a spilled aggregation/join partitions rows by
+hash(keys) % P so each partition can later be processed independently within
+memory (the same bucket-by-bucket idea as grouped execution / Lifespans).
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+import threading
+from typing import Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from presto_tpu.batch import Batch
+from presto_tpu.serde import deserialize_batch, serialize_batch
+
+
+class SpillFile:
+    """Append-only page stream on disk (FileSingleStreamSpiller analog)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._f = open(path, "wb")
+        self.pages = 0
+        self.bytes = 0
+
+    def append(self, batch: Batch):
+        page = serialize_batch(batch)
+        self._f.write(len(page).to_bytes(8, "little"))
+        self._f.write(page)
+        self.pages += 1
+        self.bytes += len(page) + 8
+
+    def finish_writing(self):
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+
+    def read(self) -> Iterator[Batch]:
+        self.finish_writing()
+        if self.pages == 0:
+            return
+        with open(self.path, "rb") as f:
+            while True:
+                head = f.read(8)
+                if len(head) < 8:
+                    return
+                n = int.from_bytes(head, "little")
+                yield deserialize_batch(f.read(n))
+
+    def close(self):
+        self.finish_writing()
+        try:
+            os.unlink(self.path)
+        except OSError:
+            pass
+
+
+def _fnv64(s: str) -> int:
+    """Deterministic 64-bit FNV-1a over utf-8 (process- and
+    dictionary-independent, unlike Python's randomized hash())."""
+    h = 0xCBF29CE484222325
+    for b in s.encode("utf-8"):
+        h = ((h ^ b) * 0x100000001B3) & 0xFFFFFFFFFFFFFFFF
+    return h
+
+
+def _strhash_lut(d) -> np.ndarray:
+    """code+1-indexed table of string-content hashes (slot 0 = NULL)."""
+    return d.int_lut("__spill_strhash", lambda s: np.int64(_fnv64(s) & 0x7FFFFFFFFFFFFFFF))
+
+
+class PartitioningSpiller:
+    """Routes batch rows to P per-partition spill files by hash(keys)
+    (GenericPartitioningSpiller analog).
+
+    Routing hashes string keys by CONTENT (via a per-dictionary lookup
+    table), not by dictionary code — the two sides of a spilled join may be
+    encoded against different dictionaries, and co-partitioning must agree
+    on the string value itself."""
+
+    def __init__(self, spill_dir: str, key_names: Sequence[str],
+                 n_partitions: int, tag: str = "spill"):
+        self.key_names = tuple(key_names)
+        self.n_partitions = n_partitions
+        self.files: List[SpillFile] = [
+            SpillFile(os.path.join(spill_dir, f"{tag}-p{p}-{id(self)}.bin"))
+            for p in range(n_partitions)
+        ]
+
+    def _partition_ids(self, batch: Batch) -> np.ndarray:
+        h = np.zeros(batch.capacity, dtype=np.uint64)
+        for k in self.key_names:
+            c = batch.column(k)
+            vals = np.asarray(c.values).astype(np.int64)
+            d = batch.dicts.get(k)
+            if d is not None:
+                vals = _strhash_lut(d)[vals + 1]
+            if c.validity is not None:
+                vals = np.where(np.asarray(c.validity), vals, np.int64(-0x61c88647))
+            h = (h * np.uint64(0x9E3779B185EBCA87)) ^ vals.astype(np.uint64)
+            h = h ^ (h >> np.uint64(31))
+        return (h % np.uint64(self.n_partitions)).astype(np.int64)
+
+    def spill(self, batch: Batch):
+        pid = self._partition_ids(batch)
+        live = np.asarray(batch.live)
+        for p in range(self.n_partitions):
+            mask = live & (pid == p)
+            if mask.any():
+                self.files[p].append(batch.with_live(mask))
+
+    def spill_unpartitioned(self, batch: Batch):
+        """Whole-batch append to partition 0 (single-stream mode: sort runs,
+        no co-partitioning requirement)."""
+        self.files[0].append(batch)
+
+    def read_partition(self, p: int) -> Iterator[Batch]:
+        yield from self.files[p].read()
+
+    @property
+    def spilled_bytes(self) -> int:
+        return sum(f.bytes for f in self.files)
+
+    @property
+    def spilled_pages(self) -> int:
+        return sum(f.pages for f in self.files)
+
+    def close(self):
+        for f in self.files:
+            f.close()
+
+
+class SpillManager:
+    """Factory + accounting for a worker's spill directory
+    (SpillSpaceTracker analog)."""
+
+    def __init__(self, spill_dir: Optional[str] = None):
+        self._dir = spill_dir
+        self._tmp = None
+        self._lock = threading.Lock()
+        self.total_spilled_bytes = 0
+        self.spill_count = 0
+
+    @property
+    def dir(self) -> str:
+        with self._lock:
+            if self._dir is None:
+                self._tmp = tempfile.TemporaryDirectory(prefix="presto-tpu-spill-")
+                self._dir = self._tmp.name
+            return self._dir
+
+    def partitioning_spiller(self, key_names: Sequence[str], n_partitions: int,
+                             tag: str = "spill") -> PartitioningSpiller:
+        d = self.dir
+        with self._lock:
+            self.spill_count += 1
+        return PartitioningSpiller(d, key_names, n_partitions, tag)
+
+    def record(self, bytes_: int):
+        with self._lock:
+            self.total_spilled_bytes += bytes_

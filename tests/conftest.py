@@ -41,10 +41,16 @@ def assert_frames_match(got: pd.DataFrame, exp: pd.DataFrame, sort_by=None,
     def normalize(df):
         for c in df.columns:
             vals = df[c].to_numpy()
-            if len(vals) and isinstance(
-                next((v for v in vals if v is not None), None), decimal.Decimal
+            if not len(vals):
+                continue
+            first = next((v for v in vals if v is not None), None)
+            # object columns of Decimals/floats/ints (NULL-able columns
+            # materialize as object arrays) → float with NaN for None so
+            # numeric comparison applies
+            if isinstance(first, decimal.Decimal) or (
+                vals.dtype == object and isinstance(first, (float, int))
             ):
-                df[c] = [float(v) if v is not None else None for v in vals]
+                df[c] = [float(v) if v is not None else np.nan for v in vals]
         return df
 
     g, e = normalize(g), normalize(e)
