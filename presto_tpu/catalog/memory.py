@@ -33,7 +33,18 @@ def _infer_type(arr: np.ndarray) -> Type:
         return BIGINT if arr.dtype.itemsize > 4 else INTEGER
     if np.issubdtype(arr.dtype, np.floating):
         return DOUBLE
-    if arr.dtype.kind in ("U", "O", "S"):
+    if arr.dtype.kind == "O":
+        # nullable columns arrive as object arrays; infer from the first
+        # non-None value (None-only columns default to varchar)
+        first = next((v for v in arr if v is not None), None)
+        if isinstance(first, bool):
+            return BOOLEAN
+        if isinstance(first, (int, np.integer)):
+            return BIGINT
+        if isinstance(first, (float, np.floating)):
+            return DOUBLE
+        return VARCHAR
+    if arr.dtype.kind in ("U", "S"):
         return VARCHAR
     if arr.dtype.kind == "M":  # datetime64
         return DATE

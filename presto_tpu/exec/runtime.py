@@ -368,9 +368,242 @@ def _constraints_to_storage(scan: TableScan, handle):
 
 # -- aggregation ------------------------------------------------------------
 
+_VARIANCE_FNS = {"var_samp", "var_pop", "stddev_samp", "stddev_pop"}
+_COVAR_FNS = {"covar_pop", "covar_samp", "corr"}
+_NON_DECOMPOSABLE_FNS = {"approx_percentile", "max_by", "min_by"}
+
+_CHECKSUM_NULL = jnp.int64(-7046029254386353131)  # fixed NULL contribution
+
+
+def _as_double(c: Column, t: Type):
+    """Column values as float64, unscaling short decimals."""
+    v = c.values.astype(jnp.float64)
+    if isinstance(t, DecimalType):
+        v = v / (10.0 ** t.scale)
+    return v
+
+
+def _content_hash(c: Column, t: Type, dictionary) -> jnp.ndarray:
+    """Order-independent per-row content hash for checksum()
+    (reference: ChecksumAggregationFunction — XXHash64 of the block value).
+    Strings hash by dictionary VALUE (content), not code."""
+    if dictionary is not None:
+        from presto_tpu.spiller import _strhash_lut
+
+        v = jnp.asarray(_strhash_lut(dictionary))[c.values.astype(jnp.int32) + 1]
+    elif jnp.issubdtype(c.values.dtype, jnp.floating):
+        v = jax.lax.bitcast_convert_type(
+            c.values.astype(jnp.float64), jnp.int64
+        )
+    else:
+        v = c.values.astype(jnp.int64)
+    h = v * jnp.int64(-7070675565921424023)  # golden-ratio mix
+    h = h ^ (h >> 31)
+    if c.validity is not None:
+        h = jnp.where(c.validity, h, _CHECKSUM_NULL)
+    return h
+
+
+def _input_state(b: Batch, name: str, op: str, a: AggSpec, st: Type,
+                 in_types: Dict[str, Type]) -> StateCol:
+    """Raw input column(s) → one state column for grouped_merge
+    (the accumulator `addInput` step of the reference's per-fn states:
+    VarianceState tracks count/mean/m2; we track count/sum/sumsq etc.)."""
+    suffix = name[len(a.symbol):] if name.startswith(a.symbol) else ""
+    if op == "count_add":
+        if a.fn == "count_if":
+            c = b.column(a.arg)
+            vals = c.values.astype(jnp.int64)
+            if c.validity is not None:
+                vals = vals * c.validity.astype(jnp.int64)
+            return StateCol(vals, None, "count_add")
+        if a.fn in _COVAR_FNS:
+            both = b.column(a.arg).valid_mask() & b.column(a.arg2).valid_mask()
+            return StateCol(both.astype(jnp.int64), None, "count_add")
+        if a.fn == "count_star" or a.arg is None:
+            return StateCol(b.live.astype(jnp.int64), None, "count_add")
+        c = b.column(a.arg)
+        vals = (c.validity.astype(jnp.int64) if c.validity is not None
+                else jnp.ones(b.capacity, jnp.int64))
+        return StateCol(vals, None, "count_add")
+    if a.fn == "checksum":
+        c = b.column(a.arg)
+        return StateCol(_content_hash(c, in_types[a.arg], b.dicts.get(a.arg)),
+                        None, "sum")
+    if a.fn in ("bool_and", "bool_or"):
+        c = b.column(a.arg)
+        return StateCol(c.values.astype(jnp.int8), c.validity, op)
+    if a.fn in _VARIANCE_FNS:
+        c = b.column(a.arg)
+        x = _as_double(c, in_types[a.arg])
+        return StateCol(x * x if suffix == "$sumsq" else x, c.validity, "sum")
+    if a.fn in _COVAR_FNS:
+        cx, cy = b.column(a.arg), b.column(a.arg2)
+        x = _as_double(cx, in_types[a.arg])
+        y = _as_double(cy, in_types[a.arg2])
+        both = cx.valid_mask() & cy.valid_mask()
+        val = {"$sx": x, "$sy": y, "$sxy": x * y,
+               "$sxx": x * x, "$syy": y * y}[suffix]
+        return StateCol(val, both, "sum")
+    if a.fn == "geometric_mean":
+        c = b.column(a.arg)
+        x = _as_double(c, in_types[a.arg])
+        return StateCol(jnp.log(x), c.validity, "sum")
+    c = b.column(a.arg)
+    return StateCol(c.values.astype(st.dtype), c.validity, op)
+
+
+def _minmax_ident(dtype, want_min: bool):
+    if jnp.issubdtype(dtype, jnp.floating):
+        return jnp.array(jnp.inf if want_min else -jnp.inf, dtype)
+    info = jnp.iinfo(dtype)
+    return jnp.array(info.max if want_min else info.min, dtype)
+
+
+def _sorted_group_agg(b: Batch, key_syms, a: AggSpec, cap: int):
+    """Per-group order-dependent aggregate over materialized input:
+    approx_percentile (exact per-group quantile), max_by / min_by.
+    Sorts by (deadness, group keys, order value) — the group enumeration
+    (stable sort on the same key operands) matches grouped_merge's, so the
+    returned arrays align with its group table rows."""
+    n = b.capacity
+    dead = (~b.live).astype(jnp.int32)
+    operands = [dead]
+    for k in key_syms:
+        c = b.column(k)
+        if c.validity is not None:
+            operands.append((~c.validity).astype(jnp.int32))
+            operands.append(jnp.where(c.validity, c.values, jnp.zeros_like(c.values)))
+        else:
+            operands.append(c.values)
+    num_key_ops = len(operands)
+
+    cx = b.column(a.arg)
+    if a.fn == "approx_percentile":
+        ov = cx.valid_mask()
+        sortval = jnp.where(ov, cx.values, _minmax_ident(cx.values.dtype, True))
+    elif a.fn == "max_by":
+        cy = b.column(a.arg2)
+        ov = cy.valid_mask()
+        # NULL-ordering rows first so the LAST row is the max valid
+        sortval = jnp.where(ov, cy.values, _minmax_ident(cy.values.dtype, True))
+    else:  # min_by
+        cy = b.column(a.arg2)
+        ov = cy.valid_mask()
+        # NULLs last so the FIRST row is the min valid
+        sortval = jnp.where(ov, cy.values, _minmax_ident(cy.values.dtype, False))
+    operands.append(sortval)
+
+    perm = jnp.arange(n, dtype=jnp.int32)
+    sorted_ops = jax.lax.sort(operands + [perm], num_keys=len(operands))
+    sperm = sorted_ops[-1]
+    sdead = sorted_ops[0]
+    change = jnp.zeros(n, dtype=bool).at[0].set(True)
+    for sk in sorted_ops[:num_key_ops]:
+        change = change.at[1:].set(change[1:] | (sk[1:] != sk[:-1]))
+    seg = jnp.cumsum(change.astype(jnp.int32)) - 1
+    seg = jnp.where(sdead == 1, cap, seg)
+    idx = jnp.arange(n, dtype=jnp.int32)
+    start = jnp.full(cap, n, jnp.int32).at[seg].min(idx, mode="drop")
+    cnt = jax.ops.segment_sum(jnp.ones(n, jnp.int32), seg, num_segments=cap + 1)[:cap]
+    ov_sorted = ov[sperm]
+    cntv = jax.ops.segment_sum(ov_sorted.astype(jnp.int32), seg,
+                               num_segments=cap + 1)[:cap]
+    valid = cntv > 0
+
+    if a.fn == "approx_percentile":
+        # exact quantile: index ceil(p*n_valid)-1 of the sorted valid values
+        # (NULLs sort first, valid range is [start+cnt-cntv, start+cnt))
+        p = float(a.param)
+        k = jnp.clip(jnp.ceil(p * cntv).astype(jnp.int32) - 1, 0, jnp.maximum(cntv - 1, 0))
+        pos = start + (cnt - cntv) + k
+        pos = jnp.clip(pos, 0, n - 1)
+        rows = sperm[pos]
+        vals = cx.values[rows]
+        if cx.validity is not None:
+            valid = valid & cx.validity[rows]
+        return vals, valid
+    if a.fn == "max_by":
+        pos = jnp.clip(start + cnt - 1, 0, n - 1)
+    else:
+        pos = jnp.clip(start, 0, n - 1)
+    rows = sperm[pos]
+    vals = cx.values[rows]
+    if cx.validity is not None:
+        valid = valid & cx.validity[rows]
+    return vals, valid
+
+
+def _execute_materialized_aggregate(node: Aggregate, ctx: ExecContext) -> Iterator[Batch]:
+    """Aggregates with order-dependent, non-mergeable state
+    (approx_percentile / max_by / min_by): materialize the input and compute
+    per-group over one global sort. The fragmenter gathers such aggregations
+    to a single task (reference computes these via mergeable digest states;
+    exact computation satisfies the same contract)."""
+    from presto_tpu.plan.agg_states import (
+        agg_state_layout as _asl,
+        state_types as _sts,
+    )
+
+    in_stream, chain = _fused_child(node.child, ctx)
+    in_types = dict(node.child.output)
+    key_syms = node.group_keys
+    key_types = [in_types[k] for k in key_syms]
+    decomp = [a for a in node.aggs if a.fn not in _NON_DECOMPOSABLE_FNS]
+    ndec = [a for a in node.aggs if a.fn in _NON_DECOMPOSABLE_FNS]
+    layout = _asl(decomp)
+    state_types = _sts(layout, in_types)
+    jchain = _node_jit(node, "mat_chain", lambda: chain)
+    full = _collect_concat(jchain(b) for b in in_stream)
+    if full is None:
+        yield _finalize_aggregate(node, None, layout, key_syms, key_types,
+                                  state_types, in_types)
+        return
+
+    def compute(full: Batch) -> Batch:
+        cap = full.capacity  # groups ≤ live rows; trace-time constant
+        keys = [KeyCol(full.column(k).values, full.column(k).validity)
+                for k in key_syms]
+        states = [
+            _input_state(full, name, op, a, st, in_types)
+            for (name, op, a), st in zip(layout, state_types)
+        ]
+        kout, sout, out_live, _ = grouped_merge(keys, states, full.live, cap)
+        cols = [Column(k.values, k.validity) for k in kout] + [
+            Column(s.values, s.validity if s.op != "count_add" else None)
+            for s in sout
+        ]
+        names = list(key_syms) + [nm for nm, _, _ in layout]
+        types = key_types + state_types
+        dicts = {k: full.dicts[k] for k in key_syms if k in full.dicts}
+        for nm, op, a in layout:
+            if op in ("min", "max") and a.arg in full.dicts:
+                dicts[nm] = full.dicts[a.arg]
+        acc = Batch(names, types, cols, out_live, dicts)
+        for a in ndec:
+            vals, valid = _sorted_group_agg(full, key_syms, a, cap)
+            acc = acc.with_column(
+                a.symbol, a.type, Column(vals.astype(a.type.dtype), valid),
+                dictionary=full.dicts.get(a.arg),
+            )
+        return acc
+
+    acc = _node_jit(node, "mat_compute", lambda: compute)(full)
+    yield _finalize_aggregate(node, acc, layout, key_syms, key_types,
+                              state_types, in_types)
+
 
 def _execute_aggregate(node: Aggregate, ctx: ExecContext) -> Iterator[Batch]:
     from presto_tpu.plan.agg_states import state_types as _layout_state_types
+
+    if any(a.fn in _NON_DECOMPOSABLE_FNS for a in node.aggs):
+        if node.step != "single":
+            raise RuntimeError(
+                "non-decomposable aggregates must run single-step "
+                "(fragmenter gathers them)"
+            )
+        yield from _execute_materialized_aggregate(node, ctx)
+        return
 
     in_stream, chain = _fused_child(node.child, ctx)
     in_types = dict(node.child.output)
@@ -392,20 +625,8 @@ def _execute_aggregate(node: Aggregate, ctx: ExecContext) -> Iterator[Batch]:
                 c = b.column(name)
                 # count_add over count values degenerates to summing them
                 states.append(StateCol(c.values.astype(st.dtype), c.validity, op))
-            elif op == "count_add":
-                if a.fn == "count_star" or a.arg is None:
-                    vals = b.live.astype(jnp.int64)
-                else:
-                    c = b.column(a.arg)
-                    vals = (
-                        c.validity.astype(jnp.int64)
-                        if c.validity is not None
-                        else jnp.ones(b.capacity, jnp.int64)
-                    )
-                states.append(StateCol(vals, None, "count_add"))
             else:
-                c = b.column(a.arg)
-                states.append(StateCol(c.values.astype(st.dtype), c.validity, op))
+                states.append(_input_state(b, name, op, a, st, in_types))
         return keys, states
 
     def acc_to_states(acc: Batch):
@@ -445,6 +666,10 @@ def _execute_aggregate(node: Aggregate, ctx: ExecContext) -> Iterator[Batch]:
         names = list(key_syms) + [name for name, _, _ in layout]
         types = key_types + state_types
         dicts = {k: b.dicts[k] for k in key_syms if k in b.dicts}
+        # string-valued states (min/max/arbitrary) keep the arg's dictionary
+        for name, op, a in layout:
+            if op in ("min", "max") and a.arg in b.dicts:
+                dicts[name] = b.dicts[a.arg]
         out = Batch(names, types, cols, out_live, dicts)
         return out, n_groups
 
@@ -477,7 +702,7 @@ def _execute_aggregate(node: Aggregate, ctx: ExecContext) -> Iterator[Batch]:
         ]
         names = list(key_syms) + [name for name, _, _ in layout]
         types = key_types + state_types
-        dicts = {k: b.dicts[k] for k in key_syms if k in b.dicts}
+        dicts = {k: v for k, v in b.dicts.items() if k in names}
         return Batch(names, types, cols, out_live, dicts), n_groups
 
     jit_step = _node_jit(node, "step", lambda: (lambda acc, b, cap: merge_step(acc, b, cap)), static_argnums=(2,))
@@ -621,7 +846,7 @@ def _finalize_aggregate(node, acc, layout, key_syms, key_types, state_types, in_
             live[0] = True
             for a in node.aggs:
                 vals = np.zeros(128, dtype=a.type.dtype)
-                if a.fn in ("count", "count_star"):
+                if a.fn in ("count", "count_star", "count_if"):
                     cols.append(Column(jnp.asarray(vals), None))
                 else:
                     cols.append(Column(jnp.asarray(vals), jnp.zeros(128, bool)))
@@ -667,7 +892,53 @@ def _finalize_aggregate(node, acc, layout, key_syms, key_types, state_types, in_
                     num = s.values.astype(jnp.float64)
                 vals = num / denom
                 cols.append(Column(vals, ok))
+            elif a.fn in _VARIANCE_FNS:
+                n = acc.column(a.symbol + "$cnt").values.astype(jnp.float64)
+                s = acc.column(a.symbol + "$sum").values
+                ss = acc.column(a.symbol + "$sumsq").values
+                pop = a.fn.endswith("_pop")
+                ok = n > (0 if pop else 1)
+                nn = jnp.where(n > 0, n, 1.0)
+                denom = jnp.where(ok, n if pop else n - 1, 1.0)
+                var = jnp.maximum((ss - s * s / nn) / denom, 0.0)
+                vals = jnp.sqrt(var) if a.fn.startswith("stddev") else var
+                cols.append(Column(vals, ok))
+            elif a.fn in ("covar_pop", "covar_samp"):
+                n = acc.column(a.symbol + "$cnt").values.astype(jnp.float64)
+                sx = acc.column(a.symbol + "$sx").values
+                sy = acc.column(a.symbol + "$sy").values
+                sxy = acc.column(a.symbol + "$sxy").values
+                pop = a.fn.endswith("_pop")
+                ok = n > (0 if pop else 1)
+                nn = jnp.where(n > 0, n, 1.0)
+                denom = jnp.where(ok, n if pop else n - 1, 1.0)
+                cols.append(Column((sxy - sx * sy / nn) / denom, ok))
+            elif a.fn == "corr":
+                n = acc.column(a.symbol + "$cnt").values.astype(jnp.float64)
+                sx = acc.column(a.symbol + "$sx").values
+                sy = acc.column(a.symbol + "$sy").values
+                sxy = acc.column(a.symbol + "$sxy").values
+                sxx = acc.column(a.symbol + "$sxx").values
+                syy = acc.column(a.symbol + "$syy").values
+                vx = n * sxx - sx * sx
+                vy = n * syy - sy * sy
+                ok = (n > 1) & (vx > 0) & (vy > 0)
+                denom = jnp.sqrt(jnp.where(ok, vx * vy, 1.0))
+                cols.append(Column((n * sxy - sx * sy) / denom, ok))
+            elif a.fn == "geometric_mean":
+                n = acc.column(a.symbol + "$cnt").values.astype(jnp.float64)
+                ls = acc.column(a.symbol + "$lsum").values
+                ok = n > 0
+                cols.append(Column(jnp.exp(ls / jnp.where(ok, n, 1.0)), ok))
+            elif a.fn in ("bool_and", "bool_or"):
+                c = acc.column(a.symbol)
+                cols.append(Column(c.values.astype(bool), c.validity))
+            elif a.fn == "checksum":
+                c = acc.column(a.symbol)
+                cols.append(Column(c.values, None))
             else:
+                # count/sum/min/max/arbitrary/count_if + materialized
+                # (approx_percentile/max_by/min_by) pass through
                 c = acc.column(a.symbol)
                 cols.append(c)
             names.append(a.symbol)

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import re
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -90,9 +91,11 @@ def like_to_regex(pattern: str, escape: str | None = None) -> str:
 _STR_TO_STR = {
     "substr", "upper", "lower", "trim", "ltrim", "rtrim", "replace",
     "reverse", "lpad", "rpad", "concat", "split_part",
+    "regexp_extract", "regexp_replace", "json_extract_scalar",
 }
 # string→int functions (code-indexed int lut)
-_STR_TO_INT = {"length", "strpos", "codepoint"}
+_STR_TO_INT = {"length", "strpos", "codepoint", "json_array_length",
+               "levenshtein_distance_c", "hamming_distance_c"}
 # string→bool predicate functions (bool lut, like LIKE)
 _STR_PRED = {"regexp_like", "starts_with", "ends_with", "contains"}
 
@@ -164,7 +167,67 @@ def _str_xform_pyfn(fn: str, cargs: tuple):
             parts = s.split(delim)
             return parts[idx - 1] if 0 < idx <= len(parts) else ""
         return split_part
+    if fn == "regexp_extract":
+        rx = re.compile(str(cargs[0]))
+        group = int(cargs[1]) if len(cargs) > 1 and cargs[1] is not None else 0
+        def rex(s, rx=rx, group=group):
+            m = rx.search(s)
+            # deviation: Presto returns NULL on no match; dictionary
+            # transforms cannot emit NULL, so empty string stands in
+            return (m.group(group) or "") if m else ""
+        return rex
+    if fn == "regexp_replace":
+        rx = re.compile(str(cargs[0]))
+        repl = str(cargs[1]) if len(cargs) > 1 else ""
+        # Presto uses $1 for backrefs; python re uses \1
+        repl = re.sub(r"\$(\d+)", r"\\\1", repl)
+        return lambda s: rx.sub(repl, s)
+    if fn == "json_extract_scalar":
+        import json as _json
+
+        path = str(cargs[0])
+        steps = _parse_json_path(path)
+        def jes(s, steps=steps):
+            try:
+                v = _json.loads(s)
+                for st in steps:
+                    v = v[st]
+            except Exception:
+                return ""
+            if isinstance(v, (dict, list)) or v is None:
+                return ""  # deviation: NULL → empty string (see above)
+            if isinstance(v, bool):
+                return "true" if v else "false"
+            return str(v)
+        return jes
     raise NotImplementedError(fn)
+
+
+def _parse_json_path(path: str):
+    """Subset of JSONPath used by json_extract_scalar: $.a.b[0]['c']."""
+    steps = []
+    i = 0
+    if path.startswith("$"):
+        i = 1
+    while i < len(path):
+        ch = path[i]
+        if ch == ".":
+            j = i + 1
+            while j < len(path) and path[j] not in ".[":
+                j += 1
+            steps.append(path[i + 1:j])
+            i = j
+        elif ch == "[":
+            j = path.index("]", i)
+            inner = path[i + 1:j].strip()
+            if inner[:1] in ("'", '"'):
+                steps.append(inner[1:-1])
+            else:
+                steps.append(int(inner))
+            i = j + 1
+        else:
+            raise ValueError(f"bad json path: {path}")
+    return steps
 
 
 def _str_int_pyfn(fn: str, cargs: tuple):
@@ -175,6 +238,34 @@ def _str_int_pyfn(fn: str, cargs: tuple):
         return lambda s: s.find(sub) + 1
     if fn == "codepoint":
         return lambda s: ord(s[0]) if s else 0
+    if fn == "json_array_length":
+        import json as _json
+
+        def jal(s):
+            try:
+                v = _json.loads(s)
+            except Exception:
+                return -1
+            return len(v) if isinstance(v, list) else -1
+        return jal
+    if fn == "levenshtein_distance_c":
+        other = str(cargs[0])
+
+        def lev(s, other=other):
+            if len(s) < len(other):
+                s, other = other, s
+            prev = list(range(len(other) + 1))
+            for i, ca in enumerate(s):
+                cur = [i + 1]
+                for j, cb in enumerate(other):
+                    cur.append(min(prev[j + 1] + 1, cur[j] + 1,
+                                   prev[j] + (ca != cb)))
+                prev = cur
+            return prev[-1]
+        return lev
+    if fn == "hamming_distance_c":
+        other = str(cargs[0])
+        return lambda s: sum(a != b for a, b in zip(s, other)) if len(s) == len(other) else -1
     raise NotImplementedError(fn)
 
 
@@ -632,6 +723,43 @@ def _eval_call(e: Call, ctx: CompileContext):
         a, avalid = _eval_arg(e.args[0], ctx)
         b, bvalid = _eval_arg(e.args[1], ctx)
         return jnp.power(a.astype(e.type.dtype), b.astype(e.type.dtype)), _and_valid(avalid, bvalid)
+    if fn in ("bitwise_and", "bitwise_or", "bitwise_xor",
+              "bitwise_left_shift", "bitwise_right_shift"):
+        a, avalid = _eval_arg(e.args[0], ctx)
+        b, bvalid = _eval_arg(e.args[1], ctx)
+        a = a.astype(jnp.int64)
+        b = b.astype(jnp.int64)
+        out = {
+            "bitwise_and": lambda: a & b,
+            "bitwise_or": lambda: a | b,
+            "bitwise_xor": lambda: a ^ b,
+            "bitwise_left_shift": lambda: a << b,
+            "bitwise_right_shift": lambda: jax.lax.shift_right_logical(a, b),
+        }[fn]()
+        return out, _and_valid(avalid, bvalid)
+    if fn == "bitwise_not":
+        v, valid = _eval_arg(e.args[0], ctx)
+        return ~v.astype(jnp.int64), valid
+    if fn in ("is_nan", "is_finite", "is_infinite"):
+        v, valid = _eval_arg(e.args[0], ctx)
+        out = {"is_nan": jnp.isnan, "is_finite": jnp.isfinite,
+               "is_infinite": jnp.isinf}[fn](v.astype(jnp.float64))
+        return out, valid
+    if fn == "from_unixtime":
+        v, valid = _eval_arg(e.args[0], ctx)
+        return (v.astype(jnp.float64) * 1e6).astype(jnp.int64), valid
+    if fn == "to_unixtime":
+        v, valid = _eval_arg(e.args[0], ctx)
+        return v.astype(jnp.float64) / 1e6, valid
+    if fn == "width_bucket":
+        v, valid = _eval_arg(e.args[0], ctx)
+        lo = float(e.args[1].value)
+        hi = float(e.args[2].value)
+        nb = int(e.args[3].value)
+        x = v.astype(jnp.float64)
+        bucket = jnp.floor((x - lo) / (hi - lo) * nb).astype(jnp.int64) + 1
+        bucket = jnp.clip(bucket, 0, nb + 1)
+        return bucket, valid
 
     # ---- date ------------------------------------------------------------
     if fn in ("year", "month", "day"):

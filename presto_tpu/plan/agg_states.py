@@ -1,16 +1,34 @@
 """Aggregate state layouts shared by the planner (fragmenter) and runtime.
 
 Reference: AggregationNode.Step (PARTIAL/INTERMEDIATE/FINAL/SINGLE) and the
-accumulator state classes (operator/aggregation/state/*): a partial
-aggregation emits *state columns* (avg → sum+count) that travel through the
-exchange and are merged by the final aggregation.
+accumulator state classes (operator/aggregation/state/*, e.g.
+VarianceState, CovarianceState, CorrelationState): a partial aggregation
+emits *state columns* (avg → sum+count, variance → count+sum+sumsq) that
+travel through the exchange and are merged by the final aggregation.
+
+Decomposable aggregates expand into columns each merged with one of the
+kernel ops (sum / min / max / count_add — ops/grouping.py). Aggregates with
+no mergeable fixed-width state (approx_percentile, max_by/min_by) are
+non-decomposable: the fragmenter gathers their input to a single task and
+the runtime computes them over materialized sorted input.
 """
 
 from __future__ import annotations
 
 from typing import Dict, List, Tuple
 
-from presto_tpu.types import BIGINT, DOUBLE, DecimalType, Type
+from presto_tpu.types import BIGINT, DOUBLE, TINYINT, DecimalType, Type
+
+# fn → list of (state-suffix, merge-op); "" suffix = the agg's own symbol.
+# The suffix doubles as the input-transform tag (runtime in_to_states).
+_VARIANCE_FNS = {"variance", "var_samp", "var_pop", "stddev", "stddev_samp",
+                 "stddev_pop"}
+_COVAR_FNS = {"covar_pop", "covar_samp"}
+_NON_DECOMPOSABLE = {"approx_percentile", "max_by", "min_by"}
+
+
+def is_decomposable(aggs) -> bool:
+    return all(a.fn not in _NON_DECOMPOSABLE for a in aggs)
 
 
 def agg_state_layout(aggs) -> List[Tuple[str, str, object]]:
@@ -19,13 +37,40 @@ def agg_state_layout(aggs) -> List[Tuple[str, str, object]]:
     for a in aggs:
         if a.fn == "sum":
             layout.append((a.symbol, "sum", a))
-        elif a.fn in ("count", "count_star"):
+        elif a.fn in ("count", "count_star", "count_if"):
             layout.append((a.symbol, "count_add", a))
         elif a.fn == "avg":
             layout.append((a.symbol + "$sum", "sum", a))
             layout.append((a.symbol + "$cnt", "count_add", a))
         elif a.fn in ("min", "max"):
             layout.append((a.symbol, a.fn, a))
+        elif a.fn in ("arbitrary", "any_value"):
+            layout.append((a.symbol, "min", a))
+        elif a.fn in ("bool_and", "every"):
+            layout.append((a.symbol, "min", a))
+        elif a.fn == "bool_or":
+            layout.append((a.symbol, "max", a))
+        elif a.fn == "checksum":
+            layout.append((a.symbol, "sum", a))
+        elif a.fn in _VARIANCE_FNS:
+            layout.append((a.symbol + "$cnt", "count_add", a))
+            layout.append((a.symbol + "$sum", "sum", a))
+            layout.append((a.symbol + "$sumsq", "sum", a))
+        elif a.fn in _COVAR_FNS:
+            layout.append((a.symbol + "$cnt", "count_add", a))
+            layout.append((a.symbol + "$sx", "sum", a))
+            layout.append((a.symbol + "$sy", "sum", a))
+            layout.append((a.symbol + "$sxy", "sum", a))
+        elif a.fn == "corr":
+            layout.append((a.symbol + "$cnt", "count_add", a))
+            layout.append((a.symbol + "$sx", "sum", a))
+            layout.append((a.symbol + "$sy", "sum", a))
+            layout.append((a.symbol + "$sxy", "sum", a))
+            layout.append((a.symbol + "$sxx", "sum", a))
+            layout.append((a.symbol + "$syy", "sum", a))
+        elif a.fn == "geometric_mean":
+            layout.append((a.symbol + "$cnt", "count_add", a))
+            layout.append((a.symbol + "$lsum", "sum", a))
         else:
             raise NotImplementedError(f"aggregate {a.fn}")
     return layout
@@ -45,8 +90,15 @@ def state_types(layout, in_types: Dict[str, Type]) -> List[Type]:
     for name, op, a in layout:
         if op == "count_add":
             out.append(BIGINT)
+        elif a.fn == "checksum":
+            out.append(BIGINT)
+        elif a.fn in ("bool_and", "bool_or", "every"):
+            out.append(TINYINT)
+        elif a.fn in _VARIANCE_FNS or a.fn in _COVAR_FNS or a.fn in (
+                "corr", "geometric_mean"):
+            out.append(DOUBLE)
         elif op == "sum":
-            if a.fn == "avg" or a.fn == "sum":
+            if a.fn in ("avg", "sum"):
                 out.append(sum_state_type(a, in_types) if a.arg else BIGINT)
             else:
                 out.append(DOUBLE)

@@ -50,6 +50,7 @@ from presto_tpu.types import (
     DOUBLE,
     DecimalType,
     INTEGER,
+    TIMESTAMP,
     Type,
     VARCHAR,
     common_super_type,
@@ -165,7 +166,28 @@ def ast_key(node) -> str:
     return f"?{id(node)}"
 
 
-_AGG_FUNCS = {"sum", "avg", "count", "min", "max"}
+_AGG_FUNCS = {
+    "sum", "avg", "count", "min", "max",
+    # statistics (reference: operator/aggregation/Variance*, Covariance*,
+    # CorrelationAggregation, GeometricMeanAggregations)
+    "stddev", "stddev_pop", "stddev_samp", "variance", "var_pop", "var_samp",
+    "covar_pop", "covar_samp", "corr", "geometric_mean",
+    # boolean / misc (BooleanAndAggregation, ArbitraryAggregationFunction,
+    # ChecksumAggregationFunction, CountIfAggregation)
+    "bool_and", "bool_or", "every", "arbitrary", "any_value", "checksum",
+    "count_if",
+    # approx family (ApproximateCountDistinct / ApproximateLongPercentile —
+    # here computed exactly, which satisfies the approximation contract)
+    "approx_distinct", "approx_percentile",
+    # argmax family (AbstractMinMaxBy)
+    "max_by", "min_by",
+}
+
+# aliases → canonical names
+_AGG_CANON = {"every": "bool_and", "any_value": "arbitrary",
+              "stddev": "stddev_samp", "variance": "var_samp"}
+
+_TWO_ARG_AGGS = {"covar_pop", "covar_samp", "corr", "max_by", "min_by"}
 
 
 # ---------------------------------------------------------------------------
@@ -498,6 +520,25 @@ class ExprAnalyzer:
                 ast.Case(None, [(node.args[0], node.args[1])],
                          node.args[2] if len(node.args) > 2 else None)
             )
+        if name in ("bitwise_and", "bitwise_or", "bitwise_xor",
+                    "bitwise_left_shift", "bitwise_right_shift",
+                    "bitwise_not"):
+            return Call(BIGINT, name, args)
+        if name in ("is_nan", "is_finite", "is_infinite"):
+            return Call(BOOLEAN, name, args)
+        if name == "from_unixtime":
+            return Call(TIMESTAMP, name, args)
+        if name == "to_unixtime":
+            return Call(DOUBLE, name, args)
+        if name == "width_bucket":
+            return Call(BIGINT, name, args)
+        if name in ("regexp_extract", "regexp_replace", "json_extract_scalar"):
+            return Call(VARCHAR, name, args)
+        if name == "json_array_length":
+            return Call(BIGINT, name, args)
+        if name in ("levenshtein_distance", "hamming_distance"):
+            # second operand must be a plan-time constant (dictionary lut)
+            return Call(BIGINT, name + "_c", (args[0], args[1]))
         # date
         if name == "date_trunc":
             return Call(DATE, "date_trunc", args)
@@ -1055,7 +1096,14 @@ class Planner:
 
         agg_specs: List[AggSpec] = []
         for key, fc in aggs_by_key.items():
-            fn = fc.name.lower()
+            fn = _AGG_CANON.get(fc.name.lower(), fc.name.lower())
+            distinct = fc.distinct
+            if fn == "approx_distinct":
+                # exact count-distinct satisfies the approximation contract
+                # (reference would use HLL; the error here is simply 0)
+                fn, distinct = "count", True
+            arg2_sym = None
+            param = None
             if fc.is_star:
                 arg_sym = None
                 arg_t = BIGINT
@@ -1068,10 +1116,32 @@ class Planner:
                 if not any(s == arg_sym for s, _ in pre_exprs):
                     pre_exprs.append((arg_sym, ae))
                 arg_t = ae.type
+                if fn in _TWO_ARG_AGGS:
+                    if len(fc.args) < 2:
+                        raise AnalysisError(f"{fn} takes two arguments")
+                    ae2 = analyzer.analyze(fc.args[1])
+                    if isinstance(ae2, InputRef):
+                        arg2_sym = ae2.name
+                    else:
+                        arg2_sym = self.symbols.fresh(f"{fn}_arg2")
+                    if not any(s == arg2_sym for s, _ in pre_exprs):
+                        pre_exprs.append((arg2_sym, ae2))
+                elif fn == "approx_percentile":
+                    if len(fc.args) < 2:
+                        raise AnalysisError("approx_percentile(x, p) takes two arguments")
+                    pe = analyzer.analyze(fc.args[1])
+                    from presto_tpu.expr.ir import Constant as _Const
+
+                    if not isinstance(pe, _Const) or pe.value is None:
+                        raise AnalysisError("approx_percentile percentile must be a constant")
+                    param = float(pe.value)
+                    if not 0.0 <= param <= 1.0:
+                        raise AnalysisError("percentile must be in [0, 1]")
             out_t = _agg_output_type(fn, arg_t, fc.is_star)
             sym = self.symbols.fresh(fn)
             agg_specs.append(AggSpec(sym, "count_star" if fc.is_star else fn,
-                                     arg_sym, out_t, fc.distinct))
+                                     arg_sym, out_t, distinct,
+                                     arg2=arg2_sym, param=param))
             repl[key.replace("agg:", "", 1)] = (sym, out_t)
 
         # ensure group key InputRef identities present
@@ -1224,7 +1294,7 @@ def _derive_name(e) -> str:
 
 
 def _agg_output_type(fn: str, arg_t: Type, is_star: bool) -> Type:
-    if fn == "count" or is_star:
+    if fn in ("count", "count_if") or is_star:
         return BIGINT
     if fn == "sum":
         if isinstance(arg_t, DecimalType):
@@ -1234,8 +1304,16 @@ def _agg_output_type(fn: str, arg_t: Type, is_star: bool) -> Type:
         return DOUBLE
     if fn == "avg":
         return DOUBLE  # deviation: Presto returns decimal for decimal args
-    if fn in ("min", "max"):
+    if fn in ("min", "max", "arbitrary", "max_by", "min_by",
+              "approx_percentile"):
         return arg_t
+    if fn in ("stddev_pop", "stddev_samp", "var_pop", "var_samp",
+              "covar_pop", "covar_samp", "corr", "geometric_mean"):
+        return DOUBLE
+    if fn in ("bool_and", "bool_or"):
+        return BOOLEAN
+    if fn == "checksum":
+        return BIGINT
     raise AnalysisError(f"unknown aggregate {fn}")
 
 

@@ -111,10 +111,17 @@ class _Fragmenter:
             node.child, p = self.process(node.child)
             return node, p
         if isinstance(node, Aggregate):
+            from presto_tpu.plan.agg_states import is_decomposable
+
             child, cpart = self.process(node.child)
             if cpart == SINGLE:
                 # already on one task — no exchange needed
                 node.child = child
+                return node, SINGLE
+            if not is_decomposable(node.aggs):
+                # order-dependent states (approx_percentile / max_by / min_by)
+                # have no mergeable partial form: gather raw rows to one task
+                node.child = self.cut(child, cpart, OUT_GATHER)
                 return node, SINGLE
             partial = Aggregate(child, node.group_keys, node.aggs, step="partial")
             if node.group_keys:

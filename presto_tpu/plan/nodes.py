@@ -69,10 +69,14 @@ class Project(PlanNode):
 @dataclasses.dataclass
 class AggSpec:
     symbol: str
-    fn: str  # sum | count | count_star | avg | min | max
+    fn: str  # sum|count|count_star|avg|min|max|variance family|covar|corr|
+    #          bool_and|bool_or|arbitrary|checksum|count_if|geometric_mean|
+    #          approx_percentile|max_by|min_by
     arg: Optional[str]  # input symbol (None for count_star)
     type: Type  # output type
     distinct: bool = False
+    arg2: Optional[str] = None  # second input (covar/corr/max_by/min_by)
+    param: Optional[float] = None  # constant parameter (approx_percentile p)
 
 
 @dataclasses.dataclass

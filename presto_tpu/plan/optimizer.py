@@ -216,6 +216,7 @@ def prune_columns(node: PlanNode, required: Set[str]) -> PlanNode:
     if isinstance(node, Aggregate):
         node.aggs = [a for a in node.aggs if a.symbol in required]
         need = set(node.group_keys) | {a.arg for a in node.aggs if a.arg}
+        need |= {a.arg2 for a in node.aggs if a.arg2}
         node.child = prune_columns(node.child, need)
         return node
     if isinstance(node, HashJoin):
