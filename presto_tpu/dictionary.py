@@ -21,6 +21,15 @@ from __future__ import annotations
 import numpy as np
 
 
+def fnv64(s: str) -> int:
+    """Deterministic 64-bit FNV-1a over utf-8 (process- and
+    dictionary-independent, unlike Python's randomized hash())."""
+    h = 0xCBF29CE484222325
+    for b in s.encode("utf-8"):
+        h = ((h ^ b) * 0x100000001B3) & 0xFFFFFFFFFFFFFFFF
+    return h
+
+
 class Dictionary:
     """Sorted unique string values; identity-hashed so jit caches by object."""
 
@@ -92,20 +101,29 @@ class Dictionary:
     def transform(self, key, fn) -> tuple["Dictionary", np.ndarray]:
         """String→string function applied over the dictionary (substr, upper,
         concat-with-constant, …). Returns (new_dict, remap) where
-        remap[code+1] is the new code (remap[0] = -1 for null). The result is
-        canonical: equal output strings collapse to one code, so grouping /
-        equality on the output column stay exact. Memoized by `key` so
-        repeated jit traces reuse the identical Dictionary object (identity
-        hashing keeps the XLA cache warm)."""
+        remap[code+1] is the new code (remap[0] = -1 for null). `fn` may
+        return None to signal SQL NULL (regexp_extract with no match,
+        json_extract_scalar on absent paths) — those entries remap to -1 and
+        the device evaluator clears validity where the new code is negative.
+        The result is canonical: equal output strings collapse to one code,
+        so grouping / equality on the output column stay exact. Memoized by
+        `key` so repeated jit traces reuse the identical Dictionary object
+        (identity hashing keeps the XLA cache warm)."""
         hit = self._memo.get(key)
         if hit is not None:
             return hit
-        outs = np.asarray([str(fn(str(v))) for v in self.values], dtype=object)
-        uniq, inv = np.unique(outs.astype(str), return_inverse=True)
+        outs = [fn(str(v)) for v in self.values]
+        body = np.full(len(outs), -1, dtype=np.int32)
+        notnull = [i for i, o in enumerate(outs) if o is not None]
+        if notnull:
+            uniq, inv = np.unique(
+                np.asarray([str(outs[i]) for i in notnull]), return_inverse=True
+            )
+            body[notnull] = inv.astype(np.int32)
+        else:
+            uniq = np.asarray([], dtype=object)
         nd = Dictionary(uniq)
-        remap = np.concatenate(
-            [np.array([-1], np.int32), inv.astype(np.int32)]
-        )
+        remap = np.concatenate([np.array([-1], np.int32), body])
         self._memo[key] = (nd, remap)
         return nd, remap
 
@@ -120,6 +138,17 @@ class Dictionary:
             table[i + 1] = fn(str(v))
         self._memo[key] = table
         return table
+
+    def content_hash_lut(self) -> np.ndarray:
+        """code+1-indexed table of 64-bit string-content hashes (slot 0 =
+        NULL → 0). Partitioning/exchange MUST hash string keys by content,
+        not by dictionary code: two sides of a join may be encoded against
+        different dictionaries and equal strings must co-partition
+        (reference InterpretedHashGenerator hashes the value bytes)."""
+        return self.int_lut(
+            "__content_hash",
+            lambda s: np.int64(fnv64(s) & 0x7FFFFFFFFFFFFFFF),
+        )
 
     @staticmethod
     def merge(a: "Dictionary", b: "Dictionary") -> "Dictionary":

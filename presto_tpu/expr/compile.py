@@ -172,9 +172,8 @@ def _str_xform_pyfn(fn: str, cargs: tuple):
         group = int(cargs[1]) if len(cargs) > 1 and cargs[1] is not None else 0
         def rex(s, rx=rx, group=group):
             m = rx.search(s)
-            # deviation: Presto returns NULL on no match; dictionary
-            # transforms cannot emit NULL, so empty string stands in
-            return (m.group(group) or "") if m else ""
+            # Presto returns NULL on no match (and for an unmatched group)
+            return m.group(group) if m else None
         return rex
     if fn == "regexp_replace":
         rx = re.compile(str(cargs[0]))
@@ -193,9 +192,9 @@ def _str_xform_pyfn(fn: str, cargs: tuple):
                 for st in steps:
                     v = v[st]
             except Exception:
-                return ""
+                return None
             if isinstance(v, (dict, list)) or v is None:
-                return ""  # deviation: NULL → empty string (see above)
+                return None  # non-scalar / absent → SQL NULL
             if isinstance(v, bool):
                 return "true" if v else "false"
             return str(v)
@@ -631,7 +630,13 @@ def _eval_call(e: Call, ctx: CompileContext):
             cap = ctx.batch.capacity
             return jnp.zeros(cap, jnp.int32), jnp.zeros(cap, bool)
         codes, valid = _eval(operand, ctx)
-        return jnp.asarray(remap)[codes + 1], valid
+        out = jnp.asarray(remap)[codes + 1]
+        if bool((remap[1:] < 0).any()):
+            # transform produced NULLs (regexp_extract no-match, absent
+            # json path): a negative new code means SQL NULL
+            nullable = out >= 0
+            valid = nullable if valid is None else (valid & nullable)
+        return out, valid
     if fn in _STR_TO_INT or fn in _STR_PRED:
         operand, cargs = _xform_parts(e)
         d = ctx.dict_for(operand)

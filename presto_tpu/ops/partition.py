@@ -28,10 +28,27 @@ from presto_tpu.ops.hashing import hash_columns
 
 
 def partition_ids(batch: Batch, key_names: Sequence[str], num_partitions: int):
-    h = hash_columns(
-        [batch.column(k).values for k in key_names],
-        [batch.column(k).validity for k in key_names],
-    )
+    """Row → partition id by hash(keys).
+
+    String keys are remapped through the dictionary's content-hash LUT
+    before hashing: partitioning must agree on the string VALUE, not the
+    per-batch dictionary code, or equal keys encoded against different
+    dictionaries land on different partitions (reference
+    InterpretedHashGenerator hashes value bytes). The LUT is a trace-time
+    constant — batch dicts are static pytree aux, so each dictionary keys
+    its own compiled program.
+    """
+    vals, valids = [], []
+    for k in key_names:
+        c = batch.column(k)
+        v = c.values
+        d = batch.dicts.get(k)
+        if d is not None:
+            lut = jnp.asarray(d.content_hash_lut())
+            v = jnp.take(lut, v.astype(jnp.int32) + 1, mode="clip")
+        vals.append(v)
+        valids.append(c.validity)
+    h = hash_columns(vals, valids)
     return (h % num_partitions).astype(jnp.int32)
 
 

@@ -10,15 +10,13 @@ deserialize is a zero-copy-ish np.frombuffer + device_put) plus the string
 dictionaries, with optional zstd compression. Live rows are compacted before
 serialization — wire pages carry no padding.
 
-A native C++ serde (presto_tpu/native) accelerates the byte assembly when
-built; this module is the reference implementation and fallback.
 """
 
 from __future__ import annotations
 
 import json
 import struct
-from typing import List, Optional
+from typing import Callable, List, Optional
 
 import numpy as np
 
@@ -64,18 +62,46 @@ def _zd():
 # from many peers carrying the same logical dictionary; interning returns one
 # canonical object per content so (a) codes from different workers are
 # mergeable and (b) jitted programs don't retrace per page.
-_DICT_INTERN: dict = {}
+#
+# Keys are strong content digests (collisions would silently break the
+# one-object-per-content invariant) and the table is a bounded LRU: computed
+# string columns produce a fresh Dictionary per batch, so an unbounded table
+# leaks in a long-lived worker.
+import hashlib as _hashlib
+from collections import OrderedDict as _OrderedDict
+
+_DICT_INTERN: "_OrderedDict[bytes, Dictionary]" = _OrderedDict()
+_DICT_INTERN_CAP = 4096
+_DICT_INTERN_LOCK = _threading.Lock()
+
+
+def _dict_content_key(values: np.ndarray) -> bytes:
+    h = _hashlib.sha256()
+    if values.dtype.kind not in ("O", "U", "S"):
+        h.update(values.tobytes())
+    else:
+        h.update("\x00".join(map(str, values)).encode("utf-8", "surrogatepass"))
+    return h.digest()
+
+
+def _intern_put(key: bytes, make: "Callable[[], Dictionary]") -> Dictionary:
+    """Atomic get-or-insert + LRU bump; exchange fetcher threads intern
+    concurrently and must agree on ONE canonical object per content."""
+    with _DICT_INTERN_LOCK:
+        hit = _DICT_INTERN.get(key)
+        if hit is not None:
+            _DICT_INTERN.move_to_end(key)
+            return hit
+        d = make()
+        _DICT_INTERN[key] = d
+        while len(_DICT_INTERN) > _DICT_INTERN_CAP:
+            _DICT_INTERN.popitem(last=False)
+        return d
 
 
 def intern_dictionary(values: np.ndarray) -> Dictionary:
-    key = (len(values), hash(values.tobytes() if values.dtype.kind != "O"
-                             else "\x00".join(map(str, values))))
-    hit = _DICT_INTERN.get(key)
-    if hit is not None and np.array_equal(hit.values.astype(str), np.asarray(values).astype(str)):
-        return hit
-    d = Dictionary(np.asarray(values))
-    _DICT_INTERN[key] = d
-    return d
+    values = np.asarray(values)
+    return _intern_put(_dict_content_key(values), lambda: Dictionary(values))
 
 
 def register_dictionary(d: Dictionary) -> Dictionary:
@@ -84,9 +110,7 @@ def register_dictionary(d: Dictionary) -> Dictionary:
     caches warm across the exchange). Memoized per Dictionary object."""
     if d._memo.get("__interned"):
         return d
-    key = (len(d.values), hash(d.values.tobytes() if d.values.dtype.kind != "O"
-                               else "\x00".join(map(str, d.values))))
-    out = _DICT_INTERN.setdefault(key, d)
+    out = _intern_put(_dict_content_key(d.values), lambda: d)
     d._memo["__interned"] = True
     return out
 

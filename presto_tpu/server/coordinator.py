@@ -168,8 +168,16 @@ class DistributedScheduler:
     (SqlQueryScheduler.schedule:657 analog; AllAtOnce policy — every stage
     is started immediately, pages stream through the exchange)."""
 
-    def __init__(self, config: Optional[ExecConfig] = None):
+    def __init__(self, config: Optional[ExecConfig] = None,
+                 cluster_secret: Optional[str] = None):
         self.config = config or ExecConfig()
+        self.cluster_secret = cluster_secret
+
+    def _headers(self, extra: Optional[dict] = None) -> dict:
+        h = dict(extra or {})
+        if self.cluster_secret is not None:
+            h["X-Presto-Cluster-Secret"] = self.cluster_secret
+        return h
 
     def execute(self, query_id: str, dplan: DistributedPlan,
                 workers: List[NodeInfo],
@@ -229,7 +237,7 @@ class DistributedScheduler:
                 body = pickle.dumps(update)
                 req = urllib.request.Request(
                     f"{w.uri}/v1/task/{tid}", data=body, method="POST",
-                    headers={"Content-Type": "application/x-pickle"},
+                    headers=self._headers({"Content-Type": "application/x-pickle"}),
                 )
                 with urllib.request.urlopen(req, timeout=30) as r:
                     info = json.loads(r.read())
@@ -258,7 +266,8 @@ class DistributedScheduler:
         for tid, w in created:
             try:
                 req = urllib.request.Request(
-                    f"{w.uri}/v1/task/{tid}", method="DELETE"
+                    f"{w.uri}/v1/task/{tid}", method="DELETE",
+                    headers=self._headers(),
                 )
                 urllib.request.urlopen(req, timeout=5).read()
             except Exception:
@@ -278,7 +287,8 @@ class Coordinator:
 
     def __init__(self, catalog: Catalog, port: int = 0,
                  config: Optional[ExecConfig] = None, min_workers: int = 1,
-                 broadcast_threshold_rows: float = 1_000_000):
+                 broadcast_threshold_rows: float = 1_000_000,
+                 cluster_secret: Optional[str] = None):
         from presto_tpu.server.protocol import StatementProtocol
         from presto_tpu.server.querymanager import (
             QueryManager,
@@ -291,7 +301,8 @@ class Coordinator:
         self.node_manager = NodeManager()
         self.failure_detector = HeartbeatFailureDetector(self.node_manager)
         self.size_monitor = ClusterSizeMonitor(self.node_manager, min_workers)
-        self.scheduler = DistributedScheduler(self.config)
+        self.scheduler = DistributedScheduler(self.config,
+                                              cluster_secret=cluster_secret)
         self._query_seq = 0
         self._lock = threading.Lock()
         # keyed by (sql, plan-affecting session property values)
@@ -529,13 +540,17 @@ class DistributedRunner:
     def __init__(self, catalog: Catalog, n_workers: int = 2,
                  config: Optional[ExecConfig] = None,
                  broadcast_threshold_rows: float = 1_000_000):
+        import secrets as _secrets
+
         from presto_tpu.server.worker import Worker
 
         self.catalog = catalog
         self.config = config or ExecConfig()
+        cluster_secret = _secrets.token_hex(16)
         self.coordinator = Coordinator(
             catalog, config=self.config, min_workers=n_workers,
             broadcast_threshold_rows=broadcast_threshold_rows,
+            cluster_secret=cluster_secret,
         )
         self.workers = [
             Worker(catalog, node_id=f"worker-{i}",
@@ -543,7 +558,8 @@ class DistributedRunner:
                    memory_pool_bytes=self.config.memory_pool_bytes,
                    spill_dir=self.config.spill_dir,
                    revoke_threshold=self.config.memory_revoking_threshold,
-                   revoke_target=self.config.memory_revoking_target)
+                   revoke_target=self.config.memory_revoking_target,
+                   cluster_secret=cluster_secret)
             for i in range(n_workers)
         ]
 

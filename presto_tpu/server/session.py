@@ -224,10 +224,15 @@ class Session:
         )
 
     def exec_config(self) -> ExecConfig:
+        qmax = self.get("query_max_memory_mb")
         return ExecConfig(
             batch_rows=self.get("batch_rows"),
             agg_capacity=self.get("agg_capacity"),
             join_out_capacity=self.get("join_out_capacity"),
             max_growth_retries=self.get("max_growth_retries"),
             collect_stats=self.get("collect_stats"),
+            memory_pool_bytes=(qmax * (1 << 20)) if qmax else None,
+            spill_enabled=self.get("spill_enabled"),
+            memory_revoking_threshold=self.get("memory_revoking_threshold"),
+            memory_revoking_target=self.get("memory_revoking_target"),
         )

@@ -217,12 +217,17 @@ class Worker:
                  port: int = 0, coordinator_url: Optional[str] = None,
                  memory_pool_bytes: Optional[int] = None,
                  spill_dir: Optional[str] = None,
-                 revoke_threshold: float = 0.9, revoke_target: float = 0.5):
+                 revoke_threshold: float = 0.9, revoke_target: float = 0.5,
+                 cluster_secret: Optional[str] = None):
         from presto_tpu.memory import MemoryPool
         from presto_tpu.spiller import SpillManager
 
         self.catalog = catalog
         self.node_id = node_id
+        # Intra-cluster auth: task bodies arrive pickled (trusted channel like
+        # the reference's Java-deserialized plan fragments), so mutating
+        # endpoints require the shared cluster secret when one is configured.
+        self.cluster_secret = cluster_secret
         self.memory_pool = MemoryPool(memory_pool_bytes,
                                       revoke_threshold=revoke_threshold,
                                       revoke_target=revoke_target)
@@ -253,9 +258,17 @@ class Worker:
                 self.end_headers()
                 self.wfile.write(data)
 
+            def _authorized(self) -> bool:
+                if worker.cluster_secret is None:
+                    return True
+                return (self.headers.get("X-Presto-Cluster-Secret")
+                        == worker.cluster_secret)
+
             def do_POST(self):
                 m = _TASK_RE.match(self.path)
                 if m:
+                    if not self._authorized():
+                        return self._json({"error": "unauthorized"}, 403)
                     n = int(self.headers.get("Content-Length", 0))
                     update = pickle.loads(self.rfile.read(n))
                     info = worker.task_manager.update_task(m.group(1), update)
@@ -304,6 +317,8 @@ class Worker:
             def do_DELETE(self):
                 m = _TASK_RE.match(self.path)
                 if m:
+                    if not self._authorized():
+                        return self._json({"error": "unauthorized"}, 403)
                     worker.task_manager.abort_task(m.group(1))
                     return self._json({"ok": True})
                 m = _BUFFER_RE.match(self.path)
@@ -316,6 +331,8 @@ class Worker:
 
             def do_PUT(self):
                 if self.path == "/v1/info/state":
+                    if not self._authorized():
+                        return self._json({"error": "unauthorized"}, 403)
                     n = int(self.headers.get("Content-Length", 0))
                     body = json.loads(self.rfile.read(n) or b'""')
                     if body == "SHUTTING_DOWN":

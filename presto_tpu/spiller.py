@@ -66,18 +66,9 @@ class SpillFile:
             pass
 
 
-def _fnv64(s: str) -> int:
-    """Deterministic 64-bit FNV-1a over utf-8 (process- and
-    dictionary-independent, unlike Python's randomized hash())."""
-    h = 0xCBF29CE484222325
-    for b in s.encode("utf-8"):
-        h = ((h ^ b) * 0x100000001B3) & 0xFFFFFFFFFFFFFFFF
-    return h
-
-
 def _strhash_lut(d) -> np.ndarray:
     """code+1-indexed table of string-content hashes (slot 0 = NULL)."""
-    return d.int_lut("__spill_strhash", lambda s: np.int64(_fnv64(s) & 0x7FFFFFFFFFFFFFFF))
+    return d.content_hash_lut()
 
 
 class PartitioningSpiller:

@@ -163,7 +163,8 @@ def test_graceful_shutdown_and_failure_detection(cluster):
         w = r.workers[1]
         req = urllib.request.Request(
             f"{w.url}/v1/info/state", data=json.dumps("SHUTTING_DOWN").encode(),
-            method="PUT", headers={"Content-Type": "application/json"},
+            method="PUT", headers={"Content-Type": "application/json",
+                                   "X-Presto-Cluster-Secret": w.cluster_secret},
         )
         urllib.request.urlopen(req, timeout=5).read()
         deadline = time.monotonic() + 10
@@ -180,3 +181,46 @@ def test_graceful_shutdown_and_failure_detection(cluster):
         assert int(got.c[0]) == 25
     finally:
         r.close()
+
+
+def test_partitioned_string_join_cross_dictionary():
+    """Regression: a PARTITIONED join on varchar keys where the two sides are
+    dictionary-encoded against DIFFERENT dictionaries must route equal
+    strings to the same worker. Partitioning hashes string content via the
+    dictionary content-hash LUT (ops/partition.partition_ids), not the raw
+    code (reference InterpretedHashGenerator hashes value bytes)."""
+    import numpy as np
+
+    from presto_tpu.catalog.memory import MemoryConnector
+    from presto_tpu.connector import Catalog
+
+    rng = np.random.default_rng(7)
+    # overlapping-but-different key domains → different dictionaries,
+    # and equal strings get different codes on the two sides
+    left_keys = [f"k{i:04d}" for i in range(0, 600)]
+    right_keys = [f"k{i:04d}" for i in range(300, 900)]
+    left = pd.DataFrame({
+        "lk": rng.choice(left_keys, 2000),
+        "lv": rng.integers(0, 100, 2000),
+    })
+    right = pd.DataFrame({
+        "rk": rng.choice(right_keys, 1500),
+        "rv": rng.integers(0, 100, 1500),
+    })
+    conn = MemoryConnector()
+    conn.add_table("lhs", left)
+    conn.add_table("rhs", right)
+    cat = Catalog()
+    cat.register("mem", conn, default=True)
+    sql = ("select count(*) as c, sum(lv + rv) as s "
+           "from lhs join rhs on lk = rk")
+    cfg = ExecConfig(batch_rows=1 << 10)
+    local = LocalRunner(cat, cfg)
+    dist = DistributedRunner(cat, n_workers=2, config=cfg,
+                             broadcast_threshold_rows=0)
+    try:
+        plan_s = dist.explain_distributed(sql)
+        assert "hash" in plan_s.lower()
+        assert_frames_match(dist.run(sql), local.run(sql))
+    finally:
+        dist.close()

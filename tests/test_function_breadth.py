@@ -203,9 +203,19 @@ def test_json_functions(runner):
                json_extract_scalar(js, '$.b.c') as c,
                json_array_length(ja) as n
         from j""")
-    assert list(got.a) == ["1", "2", ""]
-    assert list(got.c) == ["hi", "", ""]
+    # absent paths / non-scalar values are SQL NULL (Presto JsonFunctions),
+    # observable through IS NULL / count
+    def norm(col):
+        return [v if isinstance(v, str) else None for v in col]
+
+    assert norm(got.a) == ["1", "2", None]
+    assert norm(got.c) == ["hi", None, None]
     assert list(got.n.astype(int)) == [3, 0, -1]
+    cnt = runner.run("""
+        select count(json_extract_scalar(js, '$.b.c')) as c,
+               count_if(json_extract_scalar(js, '$.a') is null) as n_null
+        from j""")
+    assert int(cnt.c[0]) == 1 and int(cnt.n_null[0]) == 1
 
 
 def test_unixtime_roundtrip(runner):
