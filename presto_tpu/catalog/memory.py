@@ -26,6 +26,11 @@ from presto_tpu.types import (
 )
 
 
+def _is_null(v) -> bool:
+    """None, or the float NaN pandas uses for missing object values."""
+    return v is None or (isinstance(v, float) and np.isnan(v))
+
+
 def _infer_type(arr: np.ndarray) -> Type:
     if arr.dtype == np.bool_:
         return BOOLEAN
@@ -35,8 +40,10 @@ def _infer_type(arr: np.ndarray) -> Type:
         return DOUBLE
     if arr.dtype.kind == "O":
         # nullable columns arrive as object arrays; infer from the first
-        # non-None value (None-only columns default to varchar)
-        first = next((v for v in arr if v is not None), None)
+        # non-null value (None-only columns default to varchar). pandas
+        # represents missing values in object columns as float NaN, so NaN
+        # counts as null here, not as a double.
+        first = next((v for v in arr if not _is_null(v)), None)
         if isinstance(first, bool):
             return BOOLEAN
         if isinstance(first, (int, np.integer)):
@@ -78,7 +85,7 @@ class MemoryTable:
             t = (types or {}).get(col) or _infer_type(arr)
             valid = None
             if arr.dtype == object:
-                nulls = np.array([v is None for v in arr])
+                nulls = np.array([_is_null(v) for v in arr])
                 if nulls.any():
                     valid = ~nulls
                     arr = np.where(nulls, "" if t.is_string else 0, arr)
@@ -111,9 +118,24 @@ class MemoryTable:
 
 
 class MemoryConnector(Connector):
+    # Device-resident split cache: scans of the same table slice re-serve the
+    # already-uploaded device arrays instead of re-staging host→device per
+    # query (the HBM-residency analog of the reference keeping hot pages in
+    # the buffer/OS cache; host→device PCIe is our dominant scan cost).
+    # Bounded LRU by device bytes; immutable Batches are safe to share.
+    split_cache_bytes: int = 6 << 30
+
     def __init__(self, name: str = "memory"):
+        import threading
+        from collections import OrderedDict
+
         self.name = name
         self.tables: Dict[str, MemoryTable] = {}
+        self._split_cache: "OrderedDict[tuple, tuple]" = OrderedDict()
+        self._split_cache_used = 0
+        self._cache_epoch = 0
+        # worker task threads share the connector; guard the LRU + counter
+        self._split_cache_lock = threading.Lock()
 
     def add_table(self, name: str, data, types=None, primary_key=None):
         import pandas as pd
@@ -121,6 +143,7 @@ class MemoryConnector(Connector):
         if isinstance(data, pd.DataFrame):
             data = {c: data[c].to_numpy() for c in data.columns}
         self.tables[name] = MemoryTable(name, data, types, primary_key)
+        self.invalidate_cache(name)
 
     def table_names(self):
         return list(self.tables)
@@ -133,8 +156,45 @@ class MemoryConnector(Connector):
     def splits(self, handle: TableHandle, desired: int = 1) -> List[Split]:
         return [Split(handle.name, i, desired) for i in range(desired)]
 
+    def invalidate_cache(self, table: Optional[str] = None):
+        with self._split_cache_lock:
+            self._cache_epoch = getattr(self, "_cache_epoch", 0) + 1
+            if table is None:
+                self._split_cache.clear()
+                self._split_cache_used = 0
+                return
+            for k in [k for k in self._split_cache if k[0] == table]:
+                _, nbytes = self._split_cache.pop(k)
+                self._split_cache_used -= nbytes
+
     def read_split(self, split: Split, columns: Sequence[str],
                    capacity: Optional[int] = None) -> Batch:
+        key = (split.table, split.part, split.total, tuple(columns), capacity)
+        with self._split_cache_lock:
+            epoch = getattr(self, "_cache_epoch", 0)
+            hit = self._split_cache.get(key)
+            if hit is not None:
+                self._split_cache.move_to_end(key)
+                return hit[0]
+        b = self._read_split_uncached(split, columns, capacity)
+        from presto_tpu.memory import batch_device_bytes
+
+        nbytes = batch_device_bytes(b)
+        if nbytes <= self.split_cache_bytes:
+            with self._split_cache_lock:
+                # an invalidation while we were reading means `b` may be
+                # stale — don't resurrect it into the fresh cache
+                if (getattr(self, "_cache_epoch", 0) == epoch
+                        and key not in self._split_cache):
+                    self._split_cache[key] = (b, nbytes)
+                    self._split_cache_used += nbytes
+                    while self._split_cache_used > self.split_cache_bytes:
+                        _, (_, freed) = self._split_cache.popitem(last=False)
+                        self._split_cache_used -= freed
+        return b
+
+    def _read_split_uncached(self, split: Split, columns: Sequence[str],
+                             capacity: Optional[int] = None) -> Batch:
         t = self.tables[split.table]
         n = t.num_rows
         lo = n * split.part // split.total

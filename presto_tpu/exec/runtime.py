@@ -617,8 +617,20 @@ def _execute_aggregate(node: Aggregate, ctx: ExecContext) -> Iterator[Batch]:
     else:
         state_types = _layout_state_types(layout, in_types)
 
+    def _key_domain(b: Batch, k: str, t: Type):
+        """Static value-domain bound for the direct (sort-free) group path:
+        dictionary codes ∈ [0, |dict|), booleans ∈ {0, 1}."""
+        d = b.dicts.get(k)
+        if d is not None:
+            return len(d)
+        if t.name == "boolean":
+            return 2
+        return None
+
     def in_to_states(b: Batch):
-        keys = [KeyCol(b.column(k).values, b.column(k).validity) for k in key_syms]
+        keys = [KeyCol(b.column(k).values, b.column(k).validity,
+                       _key_domain(b, k, t))
+                for k, t in zip(key_syms, key_types)]
         states = []
         for (name, op, a), st in zip(layout, state_types):
             if final_mode:
@@ -630,7 +642,9 @@ def _execute_aggregate(node: Aggregate, ctx: ExecContext) -> Iterator[Batch]:
         return keys, states
 
     def acc_to_states(acc: Batch):
-        keys = [KeyCol(acc.column(k).values, acc.column(k).validity) for k in key_syms]
+        keys = [KeyCol(acc.column(k).values, acc.column(k).validity,
+                       _key_domain(acc, k, t))
+                for k, t in zip(key_syms, key_types)]
         states = []
         for name, op, a in layout:
             c = acc.column(name)
@@ -647,6 +661,7 @@ def _execute_aggregate(node: Aggregate, ctx: ExecContext) -> Iterator[Batch]:
                 KeyCol(
                     jnp.concatenate([a.values, i.values]),
                     _concat_validity(a.validity, i.validity, acc.capacity, b.capacity),
+                    a.domain if a.domain == i.domain else None,
                 )
                 for a, i in zip(ka, kin)
             ]
@@ -684,6 +699,7 @@ def _execute_aggregate(node: Aggregate, ctx: ExecContext) -> Iterator[Batch]:
                 KeyCol(
                     jnp.concatenate([a.values, i.values]),
                     _concat_validity(a.validity, i.validity, acc.capacity, b.capacity),
+                    a.domain if a.domain == i.domain else None,
                 )
                 for a, i in zip(ka, kin)
             ]
