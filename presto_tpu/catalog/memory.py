@@ -145,6 +145,29 @@ class MemoryConnector(Connector):
         self.tables[name] = MemoryTable(name, data, types, primary_key)
         self.invalidate_cache(name)
 
+    def add_generated(self, name: str, data: Dict[str, object],
+                      types: Optional[Dict[str, Type]] = None,
+                      primary_key: Optional[List[str]] = None):
+        """Register a generator-produced table. A column value may be a
+        plain array or a ("raw_decimal", DecimalType, unscaled_int_array)
+        marker for pre-scaled decimal columns that must not be rescaled by
+        MemoryTable's float→cents conversion. Column order is preserved."""
+        plain, raw = {}, {}
+        for col, v in data.items():
+            if isinstance(v, tuple) and len(v) == 3 and v[0] == "raw_decimal":
+                raw[col] = (v[1], v[2])
+            else:
+                plain[col] = v
+        mt = MemoryTable(name, plain, types, primary_key=primary_key)
+        for col, (t, arr) in raw.items():
+            mt.types[col] = t
+            mt.arrays[col] = arr.astype(np.int64)
+            mt.validity[col] = None
+        mt.arrays = {c: mt.arrays[c] for c in data.keys()}
+        mt.types = {c: mt.types[c] for c in data.keys()}
+        self.tables[name] = mt
+        self.invalidate_cache(name)
+
     def table_names(self):
         return list(self.tables)
 

@@ -389,30 +389,21 @@ class TpchConnector(MemoryConnector):
 
     def _add(self, name: str, data: Dict[str, np.ndarray]):
         types = dict(_TYPES.get(name, {}))
-        # pre-scaled decimal columns must not be rescaled by MemoryTable
-        t = MemoryTable.__new__(MemoryTable)
-        fixed = {}
+        converted = {}
         for col, arr in data.items():
             ct = types.get(col)
-            if ct is not None and isinstance(ct, DecimalType) and (name, col) in _PRESCALED:
-                fixed[col] = ("raw_decimal", arr)
+            # pre-scaled decimal columns must not be rescaled by MemoryTable
+            if (ct is not None and isinstance(ct, DecimalType)
+                    and (name, col) in _PRESCALED):
+                converted[col] = ("raw_decimal", ct, arr)
             else:
-                fixed[col] = (None, arr)
-        mt = MemoryTable(
-            name,
-            {c: a for c, (k, a) in fixed.items() if k is None},
-            {c: tt for c, tt in types.items() if (name, c) not in _PRESCALED},
+                converted[col] = arr
+        self.add_generated(
+            name, converted,
+            types={c: t for c, t in types.items()
+                   if (name, c) not in _PRESCALED},
             primary_key=_PRIMARY_KEYS.get(name),
         )
-        for c, (k, a) in fixed.items():
-            if k == "raw_decimal":
-                mt.types[c] = types[c]
-                mt.arrays[c] = a.astype(np.int64)
-                mt.validity[c] = None
-        # preserve column order
-        mt.arrays = {c: mt.arrays[c] for c in data.keys()}
-        mt.types = {c: mt.types[c] for c in data.keys()}
-        self.tables[name] = mt
 
     def get_table(self, name: str):
         self._ensure(name)

@@ -1,0 +1,98 @@
+"""TPC-DS connector + star-join queries vs a pandas oracle
+(presto-tpcds analog; the Q64 star is BASELINE config #5's shape)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from presto_tpu.catalog.tpcds import tpcds_catalog
+from presto_tpu.exec import ExecConfig, LocalRunner
+
+SF = 0.01
+
+
+@pytest.fixture(scope="module")
+def env():
+    cat = tpcds_catalog(SF)
+    runner = LocalRunner(cat, ExecConfig(batch_rows=1 << 14, agg_capacity=1 << 10))
+    conn = cat.connectors["tpcds"]
+
+    def df(t):
+        conn._ensure(t)
+        mt = conn.tables[t]
+        d = {}
+        for c, arr in mt.arrays.items():
+            if c in mt.dicts:
+                d[c] = mt.dicts[c].decode(arr)
+            elif hasattr(mt.types[c], "scale"):
+                d[c] = arr / (10 ** mt.types[c].scale)
+            else:
+                d[c] = arr
+        return pd.DataFrame(d)
+
+    return runner, df
+
+
+def test_scaling_table():
+    from presto_tpu.catalog.tpcds import TpcdsGenerator
+
+    g1, g100 = TpcdsGenerator(1.0), TpcdsGenerator(100.0)
+    assert g1.n_customer == 100_000 and g100.n_customer == 2_000_000
+    assert g1.n_item == 18_000 and g100.n_item == 204_000
+    assert g1.n_store == 12 and g100.n_store == 402
+    assert g1.n_store_sales == 2_880_404
+
+
+def test_referential_integrity(env):
+    runner, _ = env
+    for fact_key, dim in (("ss_sold_date_sk", "select d_date_sk from date_dim"),
+                          ("ss_item_sk", "select i_item_sk from item"),
+                          ("ss_store_sk", "select s_store_sk from store")):
+        out = runner.run(
+            f"select count(*) as dangling from store_sales "
+            f"where {fact_key} not in ({dim})"
+        )
+        assert int(out.dangling[0]) == 0, fact_key
+
+
+def test_q64_star(env):
+    runner, df = env
+    out = runner.run("""
+        select i_product_name, s_store_name, d_year,
+               count(*) as cnt, sum(ss_wholesale_cost) as s1,
+               sum(ss_list_price) as s2, sum(ss_coupon_amt) as s3
+        from store_sales, date_dim, store, customer, item
+        where ss_sold_date_sk = d_date_sk and ss_store_sk = s_store_sk
+          and ss_customer_sk = c_customer_sk and ss_item_sk = i_item_sk
+          and i_current_price between 35 and 44
+        group by i_product_name, s_store_name, d_year
+        order by s1 limit 100
+    """)
+    ss, dd, st, cu, it = (df("store_sales"), df("date_dim"), df("store"),
+                          df("customer"), df("item"))
+    m = (ss.merge(dd, left_on="ss_sold_date_sk", right_on="d_date_sk")
+           .merge(st, left_on="ss_store_sk", right_on="s_store_sk")
+           .merge(cu, left_on="ss_customer_sk", right_on="c_customer_sk")
+           .merge(it, left_on="ss_item_sk", right_on="i_item_sk"))
+    m = m[(m.i_current_price >= 35) & (m.i_current_price <= 44)]
+    g = (m.groupby(["i_product_name", "s_store_name", "d_year"], as_index=False)
+          .agg(cnt=("ss_quantity", "count"), s1=("ss_wholesale_cost", "sum"),
+               s2=("ss_list_price", "sum"), s3=("ss_coupon_amt", "sum"))
+          .sort_values("s1").head(100))
+    assert len(out) == len(g)
+    np.testing.assert_allclose(sorted(out.s1.astype(float)), sorted(g.s1),
+                               rtol=1e-9)
+
+
+def test_returns_join(env):
+    runner, df = env
+    out = runner.run("""
+        select count(*) as c, sum(sr_return_quantity) as q
+        from store_sales join store_returns
+          on ss_ticket_number = sr_ticket_number and ss_item_sk = sr_item_sk
+    """)
+    ss, sr = df("store_sales"), df("store_returns")
+    m = ss.merge(sr, left_on=["ss_ticket_number", "ss_item_sk"],
+                 right_on=["sr_ticket_number", "sr_item_sk"])
+    assert int(out.c[0]) == len(m)
+    assert int(out.q[0]) == int(m.sr_return_quantity.sum())
