@@ -43,13 +43,19 @@ def round_up_capacity(n: int, minimum: int = 128) -> int:
 
 
 class Column:
-    """values + optional validity. A pytree node."""
+    """values + optional validity. A pytree node.
 
-    __slots__ = ("values", "validity")
+    `hi` is the optional high limb of a long-decimal column
+    (DecimalType precision > 18): value = hi * 2^32 + values, with values
+    (the low limb) kept canonical in [0, 2^32). None for all other types
+    (reference: UnscaledDecimal128Arithmetic two-long layout)."""
 
-    def __init__(self, values, validity=None):
+    __slots__ = ("values", "validity", "hi")
+
+    def __init__(self, values, validity=None, hi=None):
         self.values = values
         self.validity = validity
+        self.hi = hi
 
     @property
     def capacity(self) -> int:
@@ -60,16 +66,32 @@ class Column:
             return jnp.ones(self.values.shape[0], dtype=bool)
         return self.validity
 
+    def gather(self, idx) -> "Column":
+        """Row gather preserving validity and the long-decimal high limb."""
+        return Column(
+            self.values[idx],
+            None if self.validity is None else self.validity[idx],
+            None if self.hi is None else self.hi[idx],
+        )
+
+    def combined_f64(self):
+        """Full value as float64 (exact below 2^53; the lossy escape hatch
+        for arithmetic over long decimals)."""
+        if self.hi is None:
+            return self.values.astype(jnp.float64)
+        return (self.hi.astype(jnp.float64) * float(1 << 32)
+                + self.values.astype(jnp.float64))
+
     def __repr__(self):
         return f"Column({self.values!r}, validity={self.validity!r})"
 
 
 def _column_flatten(c: Column):
-    return (c.values, c.validity), None
+    return (c.values, c.validity, c.hi), None
 
 
 def _column_unflatten(aux, children):
-    return Column(children[0], children[1])
+    return Column(children[0], children[1], children[2])
 
 
 jax.tree_util.register_pytree_node(Column, _column_flatten, _column_unflatten)
@@ -188,6 +210,13 @@ class Batch:
         out = {}
         for name, t, c in zip(self.names, self.types, self.columns):
             vals = np.asarray(c.values)[live]
+            if c.hi is not None:
+                # long decimal: exact int128 value from the two limbs
+                his = np.asarray(c.hi)[live]
+                vals = np.array(
+                    [(int(h) << 32) + int(lo) for h, lo in zip(his, vals)],
+                    dtype=object,
+                )
             if c.validity is not None:
                 valid = np.asarray(c.validity)[live]
             else:

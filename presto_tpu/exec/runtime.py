@@ -50,7 +50,11 @@ from presto_tpu.ops.sort import (
     sort_batch,
     sort_permutation,
 )
-from presto_tpu.plan.agg_states import agg_state_layout, sum_state_type
+from presto_tpu.plan.agg_states import (
+    agg_state_layout,
+    limb_pairs,
+    sum_state_type,
+)
 from presto_tpu.plan.nodes import (
     Aggregate,
     AggSpec,
@@ -179,6 +183,17 @@ def collapse_chain(node: PlanNode) -> Tuple[PlanNode, Callable[[Batch], Batch]]:
                 names, types, cols = [], [], []
                 dicts = {}
                 for s, t, fn, e in compiled:
+                    if isinstance(e, InputRef):
+                        # identity projection: reuse the column object —
+                        # cheaper, and preserves long-decimal limbs that a
+                        # re-evaluation through the expression compiler
+                        # would truncate to int64
+                        names.append(s)
+                        types.append(t)
+                        cols.append(b.column(e.name))
+                        if e.name in b.dicts:
+                            dicts[s] = b.dicts[e.name]
+                        continue
                     v, valid = fn(b)
                     v = jnp.broadcast_to(v, (b.capacity,)).astype(t.dtype)
                     names.append(s)
@@ -376,8 +391,9 @@ _CHECKSUM_NULL = jnp.int64(-7046029254386353131)  # fixed NULL contribution
 
 
 def _as_double(c: Column, t: Type):
-    """Column values as float64, unscaling short decimals."""
-    v = c.values.astype(jnp.float64)
+    """Column values as float64, unscaling decimals (limb-combined for
+    long decimals)."""
+    v = c.combined_f64() if c.hi is not None else c.values.astype(jnp.float64)
     if isinstance(t, DecimalType):
         v = v / (10.0 ** t.scale)
     return v
@@ -426,6 +442,16 @@ def _input_state(b: Batch, name: str, op: str, a: AggSpec, st: Type,
         vals = (c.validity.astype(jnp.int64) if c.validity is not None
                 else jnp.ones(b.capacity, jnp.int64))
         return StateCol(vals, None, "count_add")
+    if suffix in ("$hi", "$sum_hi", "$lo", "$sum_lo"):
+        # int128 decimal sum limbs (UnscaledDecimal128Arithmetic analog):
+        # value = hi * 2^32 + lo, lo canonical in [0, 2^32). Short-decimal
+        # input splits arithmetically; long-decimal input is already limbed.
+        c = b.column(a.arg)
+        if suffix.endswith("hi"):
+            vals = c.hi if c.hi is not None else (c.values >> 32)
+        else:
+            vals = c.values if c.hi is not None else (c.values & 0xFFFFFFFF)
+        return StateCol(vals.astype(jnp.int64), c.validity, "sum")
     if a.fn == "checksum":
         c = b.column(a.arg)
         return StateCol(_content_hash(c, in_types[a.arg], b.dicts.get(a.arg)),
@@ -450,7 +476,23 @@ def _input_state(b: Batch, name: str, op: str, a: AggSpec, st: Type,
         x = _as_double(c, in_types[a.arg])
         return StateCol(jnp.log(x), c.validity, "sum")
     c = b.column(a.arg)
+    if c.hi is not None:
+        # long-decimal input to min/max/arbitrary: combined float64 value,
+        # scaled to the SQL value (matches the DOUBLE output type and the
+        # implicit decimal→double casts in comparisons)
+        return StateCol(_as_double(c, in_types[a.arg]), c.validity, op)
     return StateCol(c.values.astype(st.dtype), c.validity, op)
+
+
+def _renorm_limbs(sout: list, pairs) -> list:
+    """Carry-propagate int128 limb states after a merge: keep lo canonical
+    in [0, 2^32) so limb sums never overflow int64 regardless of row count."""
+    for ih, il in pairs:
+        hi_s, lo_s = sout[ih], sout[il]
+        carry = lo_s.values >> 32
+        sout[il] = StateCol(lo_s.values - (carry << 32), lo_s.validity, lo_s.op)
+        sout[ih] = StateCol(hi_s.values + carry, hi_s.validity, hi_s.op)
+    return sout
 
 
 def _minmax_ident(dtype, want_min: bool):
@@ -551,7 +593,7 @@ def _execute_materialized_aggregate(node: Aggregate, ctx: ExecContext) -> Iterat
     key_types = [in_types[k] for k in key_syms]
     decomp = [a for a in node.aggs if a.fn not in _NON_DECOMPOSABLE_FNS]
     ndec = [a for a in node.aggs if a.fn in _NON_DECOMPOSABLE_FNS]
-    layout = _asl(decomp)
+    layout = _asl(decomp, in_types)
     state_types = _sts(layout, in_types)
     jchain = _node_jit(node, "mat_chain", lambda: chain)
     full = _collect_concat(jchain(b) for b in in_stream)
@@ -569,6 +611,7 @@ def _execute_materialized_aggregate(node: Aggregate, ctx: ExecContext) -> Iterat
             for (name, op, a), st in zip(layout, state_types)
         ]
         kout, sout, out_live, _ = grouped_merge(keys, states, full.live, cap)
+        sout = _renorm_limbs(list(sout), limb_pairs(layout))
         cols = [Column(k.values, k.validity) for k in kout] + [
             Column(s.values, s.validity if s.op != "count_add" else None)
             for s in sout
@@ -607,7 +650,8 @@ def _execute_aggregate(node: Aggregate, ctx: ExecContext) -> Iterator[Batch]:
 
     in_stream, chain = _fused_child(node.child, ctx)
     in_types = dict(node.child.output)
-    layout = agg_state_layout(node.aggs)
+    layout = agg_state_layout(node.aggs, in_types)
+    lpairs = limb_pairs(layout)
     key_syms = node.group_keys
     key_types = [in_types[k] for k in key_syms]
     final_mode = node.step == "final"
@@ -675,6 +719,7 @@ def _execute_aggregate(node: Aggregate, ctx: ExecContext) -> Iterator[Batch]:
             ]
             live = jnp.concatenate([acc.live, live])
         kout, sout, out_live, n_groups = grouped_merge(kin, sin, live, cap)
+        sout = _renorm_limbs(list(sout), lpairs)
         cols = [Column(k.values, k.validity) for k in kout] + [
             Column(s.values, s.validity if s.op != "count_add" else None) for s in sout
         ]
@@ -713,6 +758,7 @@ def _execute_aggregate(node: Aggregate, ctx: ExecContext) -> Iterator[Batch]:
             ]
             live = jnp.concatenate([acc.live, live])
         kout, sout, out_live, n_groups = grouped_merge(kin, sin, live, cap)
+        sout = _renorm_limbs(list(sout), lpairs)
         cols = [Column(k.values, k.validity) for k in kout] + [
             Column(s.values, s.validity if s.op != "count_add" else None) for s in sout
         ]
@@ -893,21 +939,34 @@ def _finalize_aggregate(node, acc, layout, key_syms, key_types, state_types, in_
             cols.append(c)
         for a in node.aggs:
             if a.fn == "avg":
-                s = acc.column(a.symbol + "$sum")
                 c = acc.column(a.symbol + "$cnt")
                 cnt = c.values
                 ok = cnt > 0
                 denom = jnp.where(ok, cnt, 1).astype(jnp.float64)
-                if node.step == "final":
-                    src_t = in_types[a.symbol + "$sum"]
+                if (a.symbol + "$sum_hi") in acc.names:
+                    # int128 decimal sum limbs; scale rides the lo state type
+                    hi = acc.column(a.symbol + "$sum_hi").values
+                    lo = acc.column(a.symbol + "$sum_lo").values
+                    lo_t = acc.type_of(a.symbol + "$sum_lo")
+                    num = (hi.astype(jnp.float64) * float(1 << 32)
+                           + lo.astype(jnp.float64)) / (10.0 ** lo_t.scale)
                 else:
-                    src_t = sum_state_type(a, in_types)
-                if isinstance(src_t, DecimalType):
-                    num = s.values.astype(jnp.float64) / (10.0 ** src_t.scale)
-                else:
-                    num = s.values.astype(jnp.float64)
+                    s = acc.column(a.symbol + "$sum")
+                    if node.step == "final":
+                        src_t = in_types[a.symbol + "$sum"]
+                    else:
+                        src_t = sum_state_type(a, in_types)
+                    if isinstance(src_t, DecimalType):
+                        num = s.values.astype(jnp.float64) / (10.0 ** src_t.scale)
+                    else:
+                        num = s.values.astype(jnp.float64)
                 vals = num / denom
                 cols.append(Column(vals, ok))
+            elif a.fn == "sum" and (a.symbol + "$hi") in acc.names:
+                # exact int128 decimal total as a two-limb long-decimal column
+                hi = acc.column(a.symbol + "$hi")
+                lo = acc.column(a.symbol + "$lo")
+                cols.append(Column(lo.values, lo.validity, hi.values))
             elif a.fn in _VARIANCE_FNS:
                 n = acc.column(a.symbol + "$cnt").values.astype(jnp.float64)
                 s = acc.column(a.symbol + "$sum").values
@@ -989,7 +1048,18 @@ def _cat_batches(bs: List[Batch]) -> Batch:
             )
         else:
             valid = None
-        cols.append(Column(vals, valid))
+        if any(b.columns[i].hi is not None for b in bs):
+            hi = jnp.concatenate(
+                [
+                    b.columns[i].hi
+                    if b.columns[i].hi is not None
+                    else jnp.zeros(b.capacity, jnp.int64)
+                    for b in bs
+                ]
+            )
+        else:
+            hi = None
+        cols.append(Column(vals, valid, hi))
     live = jnp.concatenate([b.live for b in bs])
     dicts = {}
     for b in bs:
@@ -1422,6 +1492,11 @@ def _sort_keys(node: Sort, b: Batch) -> List[SortKey]:
         nulls_first = k.nulls_first
         if nulls_first is None:
             nulls_first = not k.ascending  # SQL default: NULLS LAST for ASC
+        if c.hi is not None:
+            # long decimal sorts lexicographically by (hi, lo): lo is the
+            # canonical nonnegative low limb, so per-limb monotone encoding
+            # composes into the int128 order
+            keys.append(SortKey(c.hi, c.validity, not k.ascending, nulls_first))
         keys.append(SortKey(c.values, c.validity, not k.ascending, nulls_first))
     return keys
 
@@ -1455,8 +1530,9 @@ def _execute_sort(node: Sort, ctx: ExecContext) -> Iterator[Batch]:
 def _concat2(a: Batch, b: Batch) -> Batch:
     cols = []
     for i in range(len(a.names)):
-        vals = jnp.concatenate([a.columns[i].values, b.columns[i].values])
-        va, vb = a.columns[i].validity, b.columns[i].validity
+        ca, cb = a.columns[i], b.columns[i]
+        vals = jnp.concatenate([ca.values, cb.values])
+        va, vb = ca.validity, cb.validity
         if va is None and vb is None:
             valid = None
         else:
@@ -1466,7 +1542,16 @@ def _concat2(a: Batch, b: Batch) -> Batch:
                     vb if vb is not None else jnp.ones(b.capacity, bool),
                 ]
             )
-        cols.append(Column(vals, valid))
+        if ca.hi is None and cb.hi is None:
+            hi = None
+        else:
+            hi = jnp.concatenate(
+                [
+                    ca.hi if ca.hi is not None else jnp.zeros(a.capacity, jnp.int64),
+                    cb.hi if cb.hi is not None else jnp.zeros(b.capacity, jnp.int64),
+                ]
+            )
+        cols.append(Column(vals, valid, hi))
     dicts = dict(a.dicts)
     dicts.update(b.dicts)
     return Batch(a.names, a.types, cols, jnp.concatenate([a.live, b.live]), dicts)
@@ -1474,7 +1559,9 @@ def _concat2(a: Batch, b: Batch) -> Batch:
 
 def _truncate(b: Batch, cap: int) -> Batch:
     cols = [
-        Column(c.values[:cap], None if c.validity is None else c.validity[:cap])
+        Column(c.values[:cap],
+               None if c.validity is None else c.validity[:cap],
+               None if c.hi is None else c.hi[:cap])
         for c in b.columns
     ]
     return Batch(b.names, b.types, cols, b.live[:cap], b.dicts)

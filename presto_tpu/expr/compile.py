@@ -428,6 +428,11 @@ def compile_predicate(e: RowExpression):
 def _eval(e: RowExpression, ctx: CompileContext):
     if isinstance(e, InputRef):
         c = ctx.batch.column(e.name)
+        if c.hi is not None:
+            # long decimal (two-limb int128): expressions compute over the
+            # combined float64 unscaled value — exact below 2^53, the lossy
+            # escape hatch for arithmetic over aggregated sums
+            return c.combined_f64(), c.validity
         return c.values, c.validity
     if isinstance(e, Constant):
         return _eval_constant(e, ctx, None)

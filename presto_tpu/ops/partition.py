@@ -90,7 +90,12 @@ def partition_for_exchange(
             oval = jnp.zeros(out_n, dtype=bool).at[dest].set(sval, mode="drop")
         else:
             oval = None
-        cols.append(Column(ov, oval))
+        if c.hi is not None:
+            shi = c.hi[sperm]
+            ohi = jnp.zeros(out_n, dtype=shi.dtype).at[dest].set(shi, mode="drop")
+        else:
+            ohi = None
+        cols.append(Column(ov, oval, ohi))
     out_live = jnp.zeros(out_n, dtype=bool).at[dest].set(live_sorted & in_cap, mode="drop")
 
     counts = jax.ops.segment_sum(

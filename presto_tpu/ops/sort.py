@@ -64,15 +64,8 @@ def sort_permutation(keys: Sequence[SortKey], live: jnp.ndarray) -> jnp.ndarray:
 
 
 def permute_batch(b: Batch, perm: jnp.ndarray) -> Batch:
-    cols = []
-    for c in b.columns:
-        cols.append(
-            Column(
-                c.values[perm],
-                None if c.validity is None else c.validity[perm],
-            )
-        )
-    return Batch(b.names, b.types, cols, b.live[perm], b.dicts)
+    return Batch(b.names, b.types, [c.gather(perm) for c in b.columns],
+                 b.live[perm], b.dicts)
 
 
 def sort_batch(b: Batch, keys: Sequence[SortKey], limit: Optional[int] = None) -> Batch:

@@ -31,16 +31,38 @@ def is_decomposable(aggs) -> bool:
     return all(a.fn not in _NON_DECOMPOSABLE for a in aggs)
 
 
-def agg_state_layout(aggs) -> List[Tuple[str, str, object]]:
-    """Each AggSpec expands to one or more (state_name, merge_op, spec)."""
+def _decimal_arg(a, in_types) -> bool:
+    t = in_types.get(a.arg) if a.arg else None
+    if isinstance(t, DecimalType):
+        return True
+    # final step: the child output carries the partial's limb state columns
+    return (a.symbol + "$hi") in in_types or (a.symbol + "$sum_hi") in in_types
+
+
+def agg_state_layout(aggs, in_types: Dict[str, Type]) -> List[Tuple[str, str, object]]:
+    """Each AggSpec expands to one or more (state_name, merge_op, spec).
+
+    Decimal sums accumulate in TWO int64 limb states ($hi carries the
+    arithmetic high limb, $lo the nonnegative low 32 bits) so int128-exact
+    totals survive any row count — the reference's
+    UnscaledDecimal128Arithmetic state (presto-spi/.../type/
+    UnscaledDecimal128Arithmetic.java) on TPU-friendly int64 lanes."""
     layout = []
     for a in aggs:
         if a.fn == "sum":
-            layout.append((a.symbol, "sum", a))
+            if _decimal_arg(a, in_types):
+                layout.append((a.symbol + "$hi", "sum", a))
+                layout.append((a.symbol + "$lo", "sum", a))
+            else:
+                layout.append((a.symbol, "sum", a))
         elif a.fn in ("count", "count_star", "count_if"):
             layout.append((a.symbol, "count_add", a))
         elif a.fn == "avg":
-            layout.append((a.symbol + "$sum", "sum", a))
+            if _decimal_arg(a, in_types):
+                layout.append((a.symbol + "$sum_hi", "sum", a))
+                layout.append((a.symbol + "$sum_lo", "sum", a))
+            else:
+                layout.append((a.symbol + "$sum", "sum", a))
             layout.append((a.symbol + "$cnt", "count_add", a))
         elif a.fn in ("min", "max"):
             layout.append((a.symbol, a.fn, a))
@@ -85,11 +107,31 @@ def sum_state_type(a, in_types: Dict[str, Type]) -> Type:
     return DOUBLE
 
 
+def limb_pairs(layout) -> List[Tuple[int, int]]:
+    """(hi_index, lo_index) state pairs needing carry renormalization after
+    each merge (lo kept canonical in [0, 2^32))."""
+    idx = {name: i for i, (name, _, _) in enumerate(layout)}
+    pairs = []
+    for name, i in idx.items():
+        if name.endswith("$hi") or name.endswith("$sum_hi"):
+            lo_name = name[: -len("hi")] + "lo"
+            if lo_name in idx:
+                pairs.append((i, idx[lo_name]))
+    return pairs
+
+
 def state_types(layout, in_types: Dict[str, Type]) -> List[Type]:
     out = []
     for name, op, a in layout:
         if op == "count_add":
             out.append(BIGINT)
+        elif name.endswith(("$hi", "$sum_hi")):
+            out.append(BIGINT)
+        elif name.endswith(("$lo", "$sum_lo")):
+            # the low limb carries the value's scale through the exchange
+            t = in_types.get(a.arg)
+            scale = t.scale if isinstance(t, DecimalType) else 0
+            out.append(DecimalType(38, scale))
         elif a.fn == "checksum":
             out.append(BIGINT)
         elif a.fn in ("bool_and", "bool_or", "every"):
@@ -103,7 +145,11 @@ def state_types(layout, in_types: Dict[str, Type]) -> List[Type]:
             else:
                 out.append(DOUBLE)
         elif op in ("min", "max"):
-            out.append(in_types[a.arg])
+            t = in_types[a.arg]
+            if isinstance(t, DecimalType) and t.is_long:
+                out.append(DOUBLE)  # combined-f64 extremes (see builder)
+            else:
+                out.append(t)
         else:
             out.append(DOUBLE)
     return out
@@ -112,7 +158,7 @@ def state_types(layout, in_types: Dict[str, Type]) -> List[Type]:
 def partial_output(child_output, group_keys, aggs) -> List[Tuple[str, Type]]:
     """Schema of a step='partial' aggregation: keys then state columns."""
     in_types = dict(child_output)
-    layout = agg_state_layout(aggs)
+    layout = agg_state_layout(aggs, in_types)
     return [(k, in_types[k]) for k in group_keys] + list(
         zip([name for name, _, _ in layout], state_types(layout, in_types))
     )

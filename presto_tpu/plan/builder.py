@@ -1298,7 +1298,8 @@ def _agg_output_type(fn: str, arg_t: Type, is_star: bool) -> Type:
         return BIGINT
     if fn == "sum":
         if isinstance(arg_t, DecimalType):
-            return DecimalType(18, arg_t.scale)
+            # Presto: sum(decimal(p,s)) -> decimal(38,s), int128-backed
+            return DecimalType(38, arg_t.scale)
         if is_integral(arg_t):
             return BIGINT
         return DOUBLE
@@ -1306,6 +1307,11 @@ def _agg_output_type(fn: str, arg_t: Type, is_star: bool) -> Type:
         return DOUBLE  # deviation: Presto returns decimal for decimal args
     if fn in ("min", "max", "arbitrary", "max_by", "min_by",
               "approx_percentile"):
+        if isinstance(arg_t, DecimalType) and arg_t.is_long:
+            # long-decimal extremes compare on the combined float64 value
+            # (deviation: Presto keeps decimal(38); exactness is preserved
+            # for sums, which is where int128 matters)
+            return DOUBLE
         return arg_t
     if fn in ("stddev_pop", "stddev_samp", "var_pop", "var_samp",
               "covar_pop", "covar_samp", "corr", "geometric_mean"):

@@ -128,7 +128,7 @@ def serialize_batch(b: Batch, compress: bool = True) -> bytes:
     live = np.asarray(b.live)
     n = int(live.sum())
     header = {"n": n, "names": list(b.names), "types": [str(t) for t in b.types],
-              "validity": [], "dicts": {}}
+              "validity": [], "limbs": [], "dicts": {}}
     buffers: List[bytes] = []
     for name, t, c in zip(b.names, b.types, b.columns):
         vals = np.asarray(c.values)[live]
@@ -139,6 +139,12 @@ def serialize_batch(b: Batch, compress: bool = True) -> bytes:
             buffers.append(_pack_bits(valid))
         else:
             header["validity"].append(False)
+        if c.hi is not None:
+            # long-decimal high limb rides as a second int64 buffer
+            header["limbs"].append(True)
+            buffers.append(np.ascontiguousarray(np.asarray(c.hi)[live]).tobytes())
+        else:
+            header["limbs"].append(False)
         if name in b.dicts:
             register_dictionary(b.dicts[name])
             header["dicts"][name] = [str(v) for v in b.dicts[name].values]
@@ -169,7 +175,9 @@ def deserialize_batch(data: bytes, capacity: Optional[int] = None,
 
     cols = []
     pos = 0
-    for name, t, has_valid in zip(names, types, header["validity"]):
+    limbs = header.get("limbs") or [False] * len(names)
+    for name, t, has_valid, has_hi in zip(names, types, header["validity"],
+                                          limbs):
         dt = np.dtype(str(t.dtype))
         nb = n * dt.itemsize
         vals = np.frombuffer(payload, dt, count=n, offset=pos)
@@ -182,9 +190,18 @@ def deserialize_batch(data: bytes, capacity: Optional[int] = None,
             pos += vb
             vbuf = np.zeros(cap, dtype=bool)
             vbuf[:n] = valid
-            cols.append(Column(jnp.asarray(buf), jnp.asarray(vbuf)))
+            valid_arr = jnp.asarray(vbuf)
         else:
-            cols.append(Column(jnp.asarray(buf), None))
+            valid_arr = None
+        if has_hi:
+            hb = n * 8
+            hi = np.frombuffer(payload, np.int64, count=n, offset=pos)
+            pos += hb
+            hbuf = np.zeros(cap, dtype=np.int64)
+            hbuf[:n] = hi
+            cols.append(Column(jnp.asarray(buf), valid_arr, jnp.asarray(hbuf)))
+        else:
+            cols.append(Column(jnp.asarray(buf), valid_arr))
     live = np.zeros(cap, dtype=bool)
     live[:n] = True
     dicts = {k: intern_dictionary(np.asarray(v, dtype=object))

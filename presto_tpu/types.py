@@ -62,19 +62,25 @@ class _FixedType(Type):
 
 @dataclasses.dataclass(frozen=True)
 class DecimalType(Type):
-    """Short decimal: scaled int64. DECIMAL(p, s), p <= 18."""
+    """DECIMAL(p, s). p <= 18: scaled int64. p > 18 ("long decimal"):
+    two-limb representation — Column.values holds the low 32 bits
+    (nonnegative int64) and Column.hi the arithmetic high limb, so
+    value = hi * 2^32 + lo exactly (the reference's
+    UnscaledDecimal128Arithmetic int128 on two int64 limbs)."""
 
     precision: int = 18
     scale: int = 0
 
     def __init__(self, precision: int = 18, scale: int = 0):
-        if precision > 18:
-            raise NotImplementedError(
-                "DECIMAL precision > 18 (long decimal / int128) not yet supported"
-            )
+        if precision > 38:
+            raise ValueError("DECIMAL precision > 38 unsupported")
         object.__setattr__(self, "name", f"decimal({precision},{scale})")
         object.__setattr__(self, "precision", precision)
         object.__setattr__(self, "scale", scale)
+
+    @property
+    def is_long(self) -> bool:
+        return self.precision > 18
 
     @property
     def dtype(self):
