@@ -1194,7 +1194,7 @@ def _join_probe(node: HashJoin, ctx: ExecContext, build_in: Optional[Batch],
                 if nme in rsyms:
                     c = cols[i]
                     valid = c.validity if c.validity is not None else jnp.ones(out.capacity, bool)
-                    cols[i] = Column(c.values, valid & matched)
+                    cols[i] = Column(c.values, valid & matched, c.hi)
             return Batch(out.names, out.types, cols, out.live, out.dicts)
 
         jfn = _node_jit(node, jkey + "probe", lambda: probe_fn)
@@ -1243,7 +1243,8 @@ def _join_probe(node: HashJoin, ctx: ExecContext, build_in: Optional[Batch],
         cols = list(out.columns)
         for i, nme in enumerate(out.names):
             if nme in rsyms:
-                cols[i] = Column(cols[i].values, jnp.zeros(out.capacity, bool))
+                cols[i] = Column(cols[i].values, jnp.zeros(out.capacity, bool),
+                                 cols[i].hi)
         return Batch(out.names, out.types, cols, out.live, out.dicts)
 
     jexpand = _node_jit(node, "expand", lambda: expand_fn, static_argnames=("out_cap",))

@@ -245,26 +245,15 @@ def gather_join_output(
     for c in probe_cols:
         names.append(c)
         types.append(probe.type_of(c))
-        col = probe.column(c)
-        cols.append(
-            Column(
-                col.values[probe_row],
-                None if col.validity is None else col.validity[probe_row],
-            )
-        )
+        # Column.gather preserves validity AND the long-decimal hi limb
+        cols.append(probe.column(c).gather(probe_row))
         if c in probe.dicts:
             dicts[c] = probe.dicts[c]
     for c in build_cols:
         out_name = build_prefix + c
         names.append(out_name)
         types.append(table.batch.type_of(c))
-        col = table.batch.column(c)
-        cols.append(
-            Column(
-                col.values[build_idx],
-                None if col.validity is None else col.validity[build_idx],
-            )
-        )
+        cols.append(table.batch.column(c).gather(build_idx))
         if c in table.batch.dicts:
             dicts[out_name] = table.batch.dicts[c]
     return Batch(names, types, cols, out_live, dicts)

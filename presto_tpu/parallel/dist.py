@@ -85,7 +85,7 @@ def _all_to_all_batch(b: Batch, n_dev: int, per_cap: int) -> Batch:
         y = jax.lax.all_to_all(x2, WORKERS, split_axis=0, concat_axis=0, tiled=False)
         return y.reshape(-1)
 
-    cols = [Column(a2a(c.values), a2a(c.validity)) for c in b.columns]
+    cols = [Column(a2a(c.values), a2a(c.validity), a2a(c.hi)) for c in b.columns]
     return Batch(b.names, b.types, cols, a2a(b.live), b.dicts)
 
 
@@ -240,7 +240,8 @@ def distributed_join_probe(
         types.append(probe.type_of(c))
         col = probe.column(c)
         tmpl_cols.append(Column(jnp.zeros(1, col.values.dtype),
-                                None if col.validity is None else jnp.zeros(1, bool)))
+                                None if col.validity is None else jnp.zeros(1, bool),
+                                None if col.hi is None else jnp.zeros(1, col.hi.dtype)))
         if c in probe.dicts:
             dicts[c] = probe.dicts[c]
     for c in build_out:
@@ -248,7 +249,8 @@ def distributed_join_probe(
         types.append(build.type_of(c))
         col = build.column(c)
         tmpl_cols.append(Column(jnp.zeros(1, col.values.dtype),
-                                None if col.validity is None else jnp.zeros(1, bool)))
+                                None if col.validity is None else jnp.zeros(1, bool),
+                                None if col.hi is None else jnp.zeros(1, col.hi.dtype)))
         if c in build.dicts:
             dicts[c] = build.dicts[c]
     tmpl = Batch(names, types, tmpl_cols, jnp.zeros(1, bool), dicts)

@@ -105,3 +105,53 @@ def test_spilled_sum_exact(env):
         exact = int(sum(int(c) for c in cents[grp == g]))
         got = out[out.g == g].s.iloc[0]
         assert int(got.scaleb(2)) == exact, f"group {g}"
+
+
+def test_long_decimal_through_join(env):
+    """Regression: sum(decimal) > 2^32 unscaled flowing through a hash join
+    must keep both limbs (gather_join_output once dropped Column.hi — Q15
+    returned totals mod 2^32 at scale). Covers unique-build, fanout, and
+    LEFT-join null-extension paths."""
+    runner, cents, grp = env
+    # derived table of per-group sums joined back to a dim table
+    conn = runner.catalog.connectors["m"]
+    conn.add_generated("dim", {
+        "g": np.arange(7),
+        "label": np.array([f"g{i}" for i in range(7)]),
+    })
+    out = runner.run(
+        "select d.label as label, x.s as s from "
+        "(select g, sum(v) as s from t group by g) x "
+        "join dim d on x.g = d.g order by d.label"
+    )
+    for g in range(7):
+        exact = int(sum(int(c) for c in cents[grp == g]))
+        got = out[out.label == f"g{g}"].s.iloc[0]
+        assert int(got.scaleb(2)) == exact, f"group {g}"
+    # LEFT join against a NON-unique build side (forces the fanout
+    # expand + null_extend path, not the unique-build fast path). The
+    # probe side is the sum subquery, so a LONG decimal (hi limb present —
+    # only sum(decimal) produces precision>18) flows through the fanout
+    # probe-row gather; groups 4..6 have no fan match, so the null-extend
+    # gather also carries the long decimal.
+    dup = np.concatenate([np.arange(4), np.arange(4)])
+    conn.add_generated("fan", {
+        "g": dup,
+        "tag": np.concatenate([np.zeros(4, np.int64), np.ones(4, np.int64)]),
+    })
+    out2 = runner.run(
+        "select x.g as g, x.s as s, f.tag as tag from "
+        "(select g, sum(v) as s from t group by g) x "
+        "left join fan f on x.g = f.g order by g, tag"
+    )
+    # groups 0..3 match 2 fan rows each; 4..6 are null-extended
+    assert len(out2) == 4 * 2 + 3
+    for g in range(7):
+        exact = int(sum(int(c) for c in cents[grp == g]))
+        rows = out2[out2.g == g]
+        assert len(rows) == (2 if g < 4 else 1), f"group {g}"
+        for got in rows.s:
+            assert int(got.scaleb(2)) == exact, f"group {g}"
+        if g >= 4:
+            tag = rows.tag.iloc[0]
+            assert tag is None or tag != tag

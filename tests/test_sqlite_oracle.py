@@ -41,6 +41,13 @@ def to_sqlite_sql(sql: str) -> str:
     # extract(year from x) -> cast(strftime('%Y', x) as integer)
     sql = re.sub(r"extract\s*\(\s*year\s+from\s+([a-z_][\w.]*)\s*\)",
                  r"cast(strftime('%Y', \1) as integer)", sql, flags=re.I)
+    # year(x) / month(x) / day(x) shorthand (Presto dialect) -> strftime
+    sql = re.sub(r"\byear\s*\(\s*([a-z_][\w.]*)\s*\)",
+                 r"cast(strftime('%Y', \1) as integer)", sql, flags=re.I)
+    sql = re.sub(r"\bmonth\s*\(\s*([a-z_][\w.]*)\s*\)",
+                 r"cast(strftime('%m', \1) as integer)", sql, flags=re.I)
+    sql = re.sub(r"\bday\s*\(\s*([a-z_][\w.]*)\s*\)",
+                 r"cast(strftime('%d', \1) as integer)", sql, flags=re.I)
     # substring(x from a for b) -> substr(x, a, b)
     sql = re.sub(r"substring\s*\(\s*([\w.]+)\s+from\s+(\d+)\s+for\s+(\d+)\s*\)",
                  r"substr(\1, \2, \3)", sql, flags=re.I)
