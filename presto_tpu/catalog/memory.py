@@ -107,35 +107,120 @@ class MemoryTable:
             self.validity[col] = valid
         self.num_rows = n or 0
 
+    def column_stats(self, col: str) -> "ColumnStats":
+        """NDV / null-fraction / min-max for the CBO (computed lazily and
+        cached — the analog of ANALYZE writing table statistics; generator
+        and user tables are immutable once registered). NDV above the exact
+        window is sample-extrapolated (GEE-style: keys saturate to n)."""
+        cache = self.__dict__.setdefault("_stats_cache", {})
+        if col in cache:
+            return cache[col]
+        from presto_tpu.connector import ColumnStats
+
+        arr = self.arrays[col]
+        valid = self.validity.get(col)
+        n = len(arr)
+        nf = 0.0 if valid is None else float((~valid).sum()) / max(n, 1)
+        if col in self.dicts:
+            cs = ColumnStats(ndv=float(len(self.dicts[col])), null_fraction=nf)
+        elif n == 0:
+            cs = ColumnStats(ndv=0.0, null_fraction=nf)
+        else:
+            vals = arr if valid is None else arr[valid]
+            if len(vals) == 0:
+                cs = ColumnStats(ndv=0.0, null_fraction=nf)
+            else:
+                mn, mx = float(vals.min()), float(vals.max())
+                if (self.primary_key and self.primary_key == [col]):
+                    ndv = float(len(vals))
+                elif len(vals) <= 2_000_000:
+                    ndv = float(len(np.unique(vals)))
+                else:
+                    samp = vals[:: max(1, len(vals) // 500_000)]
+                    sndv = float(len(np.unique(samp)))
+                    if sndv > 0.8 * len(samp):
+                        ndv = float(len(vals))  # key-like: saturates
+                    else:
+                        ndv = sndv  # value-domain-like: sample saw it all
+                cs = ColumnStats(ndv=ndv, null_fraction=nf,
+                                 min_value=mn, max_value=mx)
+        cache[col] = cs
+        return cs
+
     def handle(self, catalog: str) -> TableHandle:
         return TableHandle(
             catalog=catalog,
             name=self.name,
-            columns=[ColumnInfo(c, t, self.dicts.get(c)) for c, t in self.types.items()],
+            columns=[ColumnInfo(c, t, self.dicts.get(c), self.column_stats(c))
+                     for c, t in self.types.items()],
             row_count=float(self.num_rows),
             primary_key=self.primary_key,
         )
 
 
-class MemoryConnector(Connector):
-    # Device-resident split cache: scans of the same table slice re-serve the
-    # already-uploaded device arrays instead of re-staging host→device per
-    # query (the HBM-residency analog of the reference keeping hot pages in
-    # the buffer/OS cache; host→device PCIe is our dominant scan cost).
-    # Bounded LRU by device bytes; immutable Batches are safe to share.
+class DeviceSplitCache:
+    """Device-resident split cache mixin: scans of the same table slice
+    re-serve the already-uploaded device arrays instead of re-staging
+    host→device per query (the HBM-residency analog of the reference
+    keeping hot pages in the buffer/OS cache; host→device PCIe is our
+    dominant scan cost). Bounded LRU by device bytes; immutable Batches are
+    safe to share. Subclasses implement `_read_split_uncached`."""
+
     split_cache_bytes: int = 6 << 30
 
-    def __init__(self, name: str = "memory"):
+    def _init_split_cache(self):
         import threading
         from collections import OrderedDict
 
-        self.name = name
-        self.tables: Dict[str, MemoryTable] = {}
         self._split_cache: "OrderedDict[tuple, tuple]" = OrderedDict()
         self._split_cache_used = 0
         self._cache_epoch = 0
         # worker task threads share the connector; guard the LRU + counter
         self._split_cache_lock = threading.Lock()
+
+    def invalidate_cache(self, table: Optional[str] = None):
+        with self._split_cache_lock:
+            self._cache_epoch = getattr(self, "_cache_epoch", 0) + 1
+            if table is None:
+                self._split_cache.clear()
+                self._split_cache_used = 0
+                return
+            for k in [k for k in self._split_cache if k[0] == table]:
+                _, nbytes = self._split_cache.pop(k)
+                self._split_cache_used -= nbytes
+
+    def read_split(self, split: Split, columns: Sequence[str],
+                   capacity: Optional[int] = None) -> Batch:
+        key = (split.table, split.part, split.total, tuple(columns), capacity)
+        with self._split_cache_lock:
+            epoch = getattr(self, "_cache_epoch", 0)
+            hit = self._split_cache.get(key)
+            if hit is not None:
+                self._split_cache.move_to_end(key)
+                return hit[0]
+        b = self._read_split_uncached(split, columns, capacity)
+        from presto_tpu.memory import batch_device_bytes
+
+        nbytes = batch_device_bytes(b)
+        if nbytes <= self.split_cache_bytes:
+            with self._split_cache_lock:
+                # an invalidation while we were reading means `b` may be
+                # stale — don't resurrect it into the fresh cache
+                if (getattr(self, "_cache_epoch", 0) == epoch
+                        and key not in self._split_cache):
+                    self._split_cache[key] = (b, nbytes)
+                    self._split_cache_used += nbytes
+                    while self._split_cache_used > self.split_cache_bytes:
+                        _, (_, freed) = self._split_cache.popitem(last=False)
+                        self._split_cache_used -= freed
+        return b
+
+
+class MemoryConnector(DeviceSplitCache, Connector):
+    def __init__(self, name: str = "memory"):
+        self.name = name
+        self.tables: Dict[str, MemoryTable] = {}
+        self._init_split_cache()
 
     def add_table(self, name: str, data, types=None, primary_key=None):
         import pandas as pd
@@ -178,43 +263,6 @@ class MemoryConnector(Connector):
 
     def splits(self, handle: TableHandle, desired: int = 1) -> List[Split]:
         return [Split(handle.name, i, desired) for i in range(desired)]
-
-    def invalidate_cache(self, table: Optional[str] = None):
-        with self._split_cache_lock:
-            self._cache_epoch = getattr(self, "_cache_epoch", 0) + 1
-            if table is None:
-                self._split_cache.clear()
-                self._split_cache_used = 0
-                return
-            for k in [k for k in self._split_cache if k[0] == table]:
-                _, nbytes = self._split_cache.pop(k)
-                self._split_cache_used -= nbytes
-
-    def read_split(self, split: Split, columns: Sequence[str],
-                   capacity: Optional[int] = None) -> Batch:
-        key = (split.table, split.part, split.total, tuple(columns), capacity)
-        with self._split_cache_lock:
-            epoch = getattr(self, "_cache_epoch", 0)
-            hit = self._split_cache.get(key)
-            if hit is not None:
-                self._split_cache.move_to_end(key)
-                return hit[0]
-        b = self._read_split_uncached(split, columns, capacity)
-        from presto_tpu.memory import batch_device_bytes
-
-        nbytes = batch_device_bytes(b)
-        if nbytes <= self.split_cache_bytes:
-            with self._split_cache_lock:
-                # an invalidation while we were reading means `b` may be
-                # stale — don't resurrect it into the fresh cache
-                if (getattr(self, "_cache_epoch", 0) == epoch
-                        and key not in self._split_cache):
-                    self._split_cache[key] = (b, nbytes)
-                    self._split_cache_used += nbytes
-                    while self._split_cache_used > self.split_cache_bytes:
-                        _, (_, freed) = self._split_cache.popitem(last=False)
-                        self._split_cache_used -= freed
-        return b
 
     def _read_split_uncached(self, split: Split, columns: Sequence[str],
                              capacity: Optional[int] = None) -> Batch:

@@ -27,6 +27,7 @@ import pyarrow as pa
 import pyarrow.parquet as pq
 
 from presto_tpu.batch import Batch, round_up_capacity
+from presto_tpu.catalog.memory import DeviceSplitCache
 from presto_tpu.connector import ColumnInfo, Connector, Split, TableHandle
 from presto_tpu.dictionary import Dictionary
 from presto_tpu.types import (
@@ -125,6 +126,39 @@ def write_table(path: str, data: Dict[str, np.ndarray], types: Dict[str, Type],
                    use_dictionary=True, compression="zstd")
 
 
+def _footer_stats(f: "pq.ParquetFile", col_idx: int, t: Type,
+                  ndv=None) -> Optional["ColumnStats"]:
+    """CBO column stats from parquet footer metadata: min/max and null
+    counts aggregated over row groups, NDV from the global dictionary when
+    present (the reference's HiveMetastore-supplied table statistics analog;
+    here the file footer IS the metastore)."""
+    from presto_tpu.connector import ColumnStats
+
+    mn = mx = None
+    nulls = 0
+    rows = max(f.metadata.num_rows, 1)
+    for rg in range(f.num_row_groups):
+        st = f.metadata.row_group(rg).column(col_idx).statistics
+        if st is None:
+            return ColumnStats(ndv=ndv) if ndv else None
+        if st.null_count is not None:
+            nulls += st.null_count
+        if st.has_min_max and not t.is_string:
+            try:
+                lo, hi = float(st.min), float(st.max)
+            except (TypeError, ValueError):
+                try:  # date32 statistics arrive as datetime.date
+                    lo = float(st.min.toordinal() - 719163)
+                    hi = float(st.max.toordinal() - 719163)
+                except Exception:
+                    lo = hi = None
+            if lo is not None:
+                mn = lo if mn is None else min(mn, lo)
+                mx = hi if mx is None else max(mx, hi)
+    return ColumnStats(ndv=ndv, null_fraction=nulls / rows,
+                       min_value=mn, max_value=mx)
+
+
 @dataclasses.dataclass
 class _PqTable:
     path: str
@@ -134,13 +168,30 @@ class _PqTable:
     num_row_groups: int
 
 
-class ParquetConnector(Connector):
-    """Directory-of-parquet-files connector: each file <table>.parquet."""
+class ParquetConnector(DeviceSplitCache, Connector):
+    """Directory-of-parquet-files connector: each file <table>.parquet.
+
+    Two cache tiers over the raw file (the warm-path analog of the
+    reference's OS page cache + in-heap data cache):
+    - device-resident split LRU (DeviceSplitCache mixin, HBM budget)
+    - host-RAM decoded-column LRU (`host_cache_bytes`): parquet decode is
+      single-threaded and dominates re-scans of tables too big for HBM
+      (SF100 lineitem); decoded engine-native numpy columns are kept so
+      re-runs pay only host→device transfer."""
+
+    host_cache_bytes: int = 48 << 30
 
     def __init__(self, directory: str, name: str = "parquet"):
+        import threading
+        from collections import OrderedDict
+
         self.name = name
         self.directory = directory
         self._tables: Dict[str, _PqTable] = {}
+        self._init_split_cache()
+        self._host_cache: "OrderedDict[tuple, tuple]" = OrderedDict()
+        self._host_cache_used = 0
+        self._host_cache_lock = threading.Lock()
 
     def table_names(self) -> List[str]:
         out = []
@@ -159,6 +210,7 @@ class ParquetConnector(Connector):
         schema = f.schema_arrow
         cols = []
         dicts: Dict[str, Dictionary] = {}
+        name_to_idx = {schema.field(i).name: i for i in range(len(schema.names))}
         for field in schema:
             t = _arrow_to_sql(field)
             if t.is_string:
@@ -174,9 +226,14 @@ class ParquetConnector(Connector):
                             vocab.update(chunk.to_pylist())
                 d = Dictionary(np.array(sorted(v for v in vocab if v is not None)))
                 dicts[field.name] = d
-                cols.append(ColumnInfo(field.name, t, d))
+                cols.append(ColumnInfo(
+                    field.name, t, d,
+                    _footer_stats(f, name_to_idx[field.name], t,
+                                  ndv=float(len(d)))))
             else:
-                cols.append(ColumnInfo(field.name, t))
+                cols.append(ColumnInfo(
+                    field.name, t, None,
+                    _footer_stats(f, name_to_idx[field.name], t)))
         handle = TableHandle(self.name, name, cols, row_count=float(f.metadata.num_rows))
         t = _PqTable(path, handle, dicts, f.metadata.num_rows, f.num_row_groups)
         self._tables[name] = t
@@ -228,22 +285,50 @@ class ParquetConnector(Connector):
                 keep.append(s)
         return keep
 
-    def read_split(self, split: Split, columns: Sequence[str],
-                   capacity: Optional[int] = None) -> Batch:
-        t = self._load(split.table)
+    def _decoded_columns(self, t: _PqTable, rg: int, sub: int, sub_count: int,
+                         columns: Sequence[str]):
+        """Decode (or fetch from the host LRU) one split's engine-native
+        numpy columns: {name: (values, validity_or_None)} plus row count."""
+        key = (t.path, rg, sub, sub_count, tuple(columns))
+        with self._host_cache_lock:
+            hit = self._host_cache.get(key)
+            if hit is not None:
+                self._host_cache.move_to_end(key)
+                return hit[0]
         f = pq.ParquetFile(t.path)
-        if isinstance(split.part, tuple):
-            rg, sub, sub_count = split.part
-        else:
-            rg, sub, sub_count = split.part, 0, 1
         tbl = f.read_row_group(rg, columns=list(columns))
         if sub_count > 1:
             per = -(-tbl.num_rows // sub_count)
             tbl = tbl.slice(sub * per, per)
         n = tbl.num_rows
+        out = {}
+        nbytes = 0
+        for name in columns:
+            st = t.handle.column(name).type
+            arr, valid = _decode_column(tbl.column(name), st, t.dicts.get(name))
+            arr = np.ascontiguousarray(np.asarray(arr))
+            out[name] = (arr, valid)
+            nbytes += arr.nbytes + (valid.nbytes if valid is not None else 0)
+        result = (out, n)
+        if nbytes <= self.host_cache_bytes:
+            with self._host_cache_lock:
+                if key not in self._host_cache:
+                    self._host_cache[key] = (result, nbytes)
+                    self._host_cache_used += nbytes
+                    while self._host_cache_used > self.host_cache_bytes:
+                        _, (_, freed) = self._host_cache.popitem(last=False)
+                        self._host_cache_used -= freed
+        return result
+
+    def _read_split_uncached(self, split: Split, columns: Sequence[str],
+                             capacity: Optional[int] = None) -> Batch:
+        t = self._load(split.table)
+        if isinstance(split.part, tuple):
+            rg, sub, sub_count = split.part
+        else:
+            rg, sub, sub_count = split.part, 0, 1
+        decoded, n = self._decoded_columns(t, rg, sub, sub_count, columns)
         cap = capacity or round_up_capacity(max(n, 1))
-        data = {}
-        types = {}
         import jax.numpy as jnp
 
         from presto_tpu.batch import Column
@@ -251,21 +336,19 @@ class ParquetConnector(Connector):
         names, typelist, cols = [], [], []
         live = np.zeros(cap, bool)
         live[:n] = True
-        validity_map = {}
         for name in columns:
-            col = tbl.column(name)
-            info = t.handle.column(name)
-            st = info.type
-            arr, valid = _decode_column(col, st, t.dicts.get(name))
+            st = t.handle.column(name).type
+            arr, valid = decoded[name]
             buf = np.zeros(cap, dtype=st.dtype)
             buf[:n] = arr
+            vcol = None
             if valid is not None:
                 vb = np.zeros(cap, bool)
                 vb[:n] = valid
-                validity_map[name] = jnp.asarray(vb)
+                vcol = jnp.asarray(vb)
             names.append(name)
             typelist.append(st)
-            cols.append(Column(jnp.asarray(buf), validity_map.get(name)))
+            cols.append(Column(jnp.asarray(buf), vcol))
         return Batch(
             names, typelist, cols, jnp.asarray(live),
             {c: t.dicts[c] for c in columns if c in t.dicts},
@@ -321,3 +404,197 @@ def export_tpch(directory: str, sf: float = 1.0):
             mt.types,
             mt.dicts,
         )
+
+
+def _to_arrow_columns(data, types, dicts):
+    arrays, fields = [], []
+    for name, arr in data.items():
+        t = types[name]
+        at = _sql_to_arrow(t)
+        meta = None
+        if t.is_string:
+            d = dicts[name]
+            idx = pa.array(np.asarray(arr).astype(np.int32), pa.int32())
+            vocab = pa.array([str(v) for v in d.values], pa.string())
+            a = pa.DictionaryArray.from_arrays(idx, vocab)
+        elif isinstance(t, DecimalType):
+            a = pa.array(np.asarray(arr).astype(np.int64), pa.int64())
+            meta = {_DECIMAL_META: f"{t.precision},{t.scale}".encode()}
+        elif t is DATE:
+            a = pa.array(np.asarray(arr).astype(np.int32), pa.int32()).cast(pa.date32())
+        else:
+            a = pa.array(arr, at)
+        arrays.append(a)
+        fields.append(pa.field(name, at, metadata=meta))
+    return arrays, pa.schema(fields)
+
+
+def export_tpcds_chunked(directory: str, sf: float,
+                         rows_per_chunk: int = 30_000_000,
+                         row_group_rows: int = 1 << 20,
+                         log=None):
+    """Stream-generate TPC-DS to parquet with bounded memory (dimensions
+    whole, store_sales/store_returns chunked — see export_tpch_chunked)."""
+    from presto_tpu.catalog.tpcds import TpcdsConnector, TpcdsGenerator, _D72
+
+    os.makedirs(directory, exist_ok=True)
+    conn = TpcdsConnector(sf)
+    gen = TpcdsGenerator(sf)
+    dims = [t for t in conn.table_names()
+            if t not in ("store_sales", "store_returns")]
+    for tname in dims:
+        path = os.path.join(directory, f"{tname}.parquet")
+        if os.path.exists(path):
+            continue
+        conn._ensure(tname)
+        mt = conn.tables[tname]
+        write_table(path + ".tmp", mt.arrays, mt.types, mt.dicts,
+                    row_group_rows=row_group_rows)
+        os.replace(path + ".tmp", path)  # atomic: no truncated reuse
+        if log:
+            log(f"wrote {tname} ({mt.num_rows} rows)")
+        del conn.tables[tname]
+
+    s_path = os.path.join(directory, "store_sales.parquet")
+    r_path = os.path.join(directory, "store_returns.parquet")
+    if os.path.exists(s_path) and os.path.exists(r_path):
+        return
+
+    def types_fn(table, data):
+        from presto_tpu.types import BIGINT, DATE as _DATE, VARCHAR
+
+        out = {}
+        for c, v in data.items():
+            if isinstance(v, tuple) and len(v) == 2 and v[0] == "raw72":
+                out[c] = _D72
+            elif isinstance(v, tuple):
+                out[c] = VARCHAR
+            elif isinstance(v, np.ndarray) and v.dtype == object:
+                out[c] = VARCHAR
+            else:
+                out[c] = BIGINT
+        return out
+
+    def unwrap(data):
+        # ("raw72", arr) markers carry plain unscaled arrays for the writer
+        return {c: (v[1] if isinstance(v, tuple) and len(v) == 2
+                    and v[0] == "raw72" else v)
+                for c, v in data.items()}
+
+    n = gen.n_store_sales
+    chunk = min(rows_per_chunk, n)
+    s_writer = r_writer = None
+    done = False
+    try:
+        for start_row in range(0, n, chunk):
+            cnt = min(chunk, n - start_row)
+            sales, returns = gen.store_sales_chunk(start_row, cnt)
+            for (path, raw, is_sales) in ((s_path, sales, True),
+                                          (r_path, returns, False)):
+                types = types_fn("x", raw)
+                data = unwrap(raw)
+                arrays, schema = _to_arrow_columns(data, types, {})
+                tbl = pa.Table.from_arrays(arrays, schema=schema)
+                if is_sales:
+                    if s_writer is None:
+                        s_writer = pq.ParquetWriter(path + ".tmp", schema,
+                                                    compression="zstd")
+                    s_writer.write_table(tbl, row_group_size=row_group_rows)
+                else:
+                    if r_writer is None:
+                        r_writer = pq.ParquetWriter(path + ".tmp", schema,
+                                                    compression="zstd")
+                    r_writer.write_table(tbl, row_group_size=row_group_rows)
+            if log:
+                log(f"store_sales chunk {start_row}..{start_row + cnt} of {n}")
+        done = True
+    finally:
+        if s_writer is not None:
+            s_writer.close()
+        if r_writer is not None:
+            r_writer.close()
+        if done and s_writer is not None:
+            # rename only after BOTH writers closed cleanly — an
+            # interrupted export leaves .tmp files, never a silently
+            # truncated dataset future rounds would reuse
+            os.replace(s_path + ".tmp", s_path)
+            os.replace(r_path + ".tmp", r_path)
+
+
+def export_tpch_chunked(directory: str, sf: float,
+                        orders_per_chunk: int = 7_500_000,
+                        row_group_rows: int = 1 << 20,
+                        log=None):
+    """Stream-generate TPC-H to parquet with bounded memory.
+
+    Small tables materialize whole; orders/lineitem generate in
+    `orders_per_chunk` chunks appended as row groups (the dbgen -C/-S
+    chunking analog), so SF100 (600M lineitems) exports without ever
+    holding the table in RAM. Skips tables whose files already exist
+    (re-runs are incremental)."""
+    from presto_tpu.catalog.tpch import TpchConnector, TpchGenerator
+
+    os.makedirs(directory, exist_ok=True)
+    conn = TpchConnector(sf)
+    gen = TpchGenerator(sf)
+    for tname in ("region", "nation", "supplier", "customer", "part", "partsupp"):
+        path = os.path.join(directory, f"{tname}.parquet")
+        if os.path.exists(path):
+            continue
+        conn._ensure(tname)
+        mt = conn.tables[tname]
+        write_table(path + ".tmp", mt.arrays, mt.types, mt.dicts,
+                    row_group_rows=row_group_rows)
+        os.replace(path + ".tmp", path)  # atomic: no truncated reuse
+        if log:
+            log(f"wrote {tname} ({mt.num_rows} rows)")
+        del conn.tables[tname]
+
+    o_path = os.path.join(directory, "orders.parquet")
+    l_path = os.path.join(directory, "lineitem.parquet")
+    if os.path.exists(o_path) and os.path.exists(l_path):
+        return
+    n_orders = gen.n_orders
+    chunk = min(orders_per_chunk, n_orders)
+    o_writer = l_writer = None
+    done = False
+    try:
+        for start in range(0, n_orders, chunk):
+            cnt = min(chunk, n_orders - start)
+            orders, lineitem = gen.orders_lineitem_chunk(start, cnt)
+            from presto_tpu.catalog.tpch import _column_types
+            for (table, data) in (("orders", orders), ("lineitem", lineitem)):
+                plain, dicts = {}, {}
+                types = _column_types(table, data)
+                for cname, v in data.items():
+                    if isinstance(v, tuple):
+                        dicts[cname] = v[0]
+                        plain[cname] = v[1]
+                    else:
+                        plain[cname] = v
+                arrays, schema = _to_arrow_columns(plain, types, dicts)
+                tbl = pa.Table.from_arrays(arrays, schema=schema)
+                if table == "orders":
+                    if o_writer is None:
+                        o_writer = pq.ParquetWriter(o_path + ".tmp", schema,
+                                                    compression="zstd")
+                    o_writer.write_table(tbl, row_group_size=row_group_rows)
+                else:
+                    if l_writer is None:
+                        l_writer = pq.ParquetWriter(l_path + ".tmp", schema,
+                                                    compression="zstd")
+                    l_writer.write_table(tbl, row_group_size=row_group_rows)
+            if log:
+                log(f"orders/lineitem chunk {start}..{start + cnt} of {n_orders}")
+        done = True
+    finally:
+        if o_writer is not None:
+            o_writer.close()
+        if l_writer is not None:
+            l_writer.close()
+        if done and o_writer is not None:
+            # rename only after BOTH writers closed cleanly (see
+            # export_tpcds_chunked — interrupted exports must not be
+            # reused as complete datasets)
+            os.replace(o_path + ".tmp", o_path)
+            os.replace(l_path + ".tmp", l_path)

@@ -201,8 +201,19 @@ class TpcdsGenerator:
         }
 
     def store_sales_and_returns(self):
-        n = self.n_store_sales
-        rng = self._rng(7)
+        """Full-table generation (single chunk, original RNG stream)."""
+        return self.store_sales_chunk(0, self.n_store_sales, _salt=7)
+
+    def store_sales_chunk(self, start: int, count: int, _salt=None):
+        """Generate store_sales rows [start, start+count) plus their
+        returns. Chunking bounds peak memory so SF100 (288M rows) streams
+        to parquet (see tpch.orders_lineitem_chunk — same pattern; returns
+        reference only sales inside the chunk, preserving the ticket-number
+        join)."""
+        n = count
+        if _salt is None:
+            _salt = 2000 + start // max(count, 1)
+        rng = self._rng(_salt)
         # sales dates cluster in 1998-2002 (spec's active range)
         d_lo = _D_DATE_SK0 + 35_795  # ~1998-01-01
         d_hi = _D_DATE_SK0 + 37_621  # ~2002-12-31
@@ -226,7 +237,7 @@ class TpcdsGenerator:
             "ss_addr_sk": rng.integers(1, self.n_address + 1, n),
             "ss_store_sk": rng.integers(1, self.n_store + 1, n),
             "ss_promo_sk": rng.integers(1, self.n_promo + 1, n),
-            "ss_ticket_number": np.arange(1, n + 1),
+            "ss_ticket_number": np.arange(start + 1, start + n + 1),
             "ss_quantity": qty,
             "ss_wholesale_cost": ("raw72", wholesale),
             "ss_list_price": ("raw72", list_price),

@@ -72,6 +72,32 @@ def _money(rng, lo: float, hi: float, n: int) -> np.ndarray:
     return rng.integers(int(lo * 100), int(hi * 100) + 1, n, dtype=np.int64)
 
 
+def _keyed_names(prefix: str, keys: np.ndarray) -> np.ndarray:
+    """Vectorized f"{prefix}{key:09d}" (np.char, no per-row Python)."""
+    return np.char.add(prefix, np.char.zfill(keys.astype("U9"), 9)).astype(object)
+
+
+def _vocab_codes(prefix: str, rng, n: int, vocab_size: int = 9973):
+    """Rotating comment vocabulary as (Dictionary, codes) — the engine's
+    dictionary-encoded string form, generated without any per-row Python.
+    (Comments are uniform filler in the spec; a bounded sorted vocabulary
+    keeps generation and IO linear in vocab size, not row count.)"""
+    from presto_tpu.dictionary import Dictionary
+
+    vocab = np.sort(np.array([f"{prefix} {i}" for i in range(vocab_size)]))
+    return Dictionary(vocab), rng.integers(0, vocab_size, n).astype(np.int32)
+
+
+def _phones(keys: np.ndarray, nat: Optional[np.ndarray] = None) -> np.ndarray:
+    """Vectorized phone strings "{cc}-{nnn}-{nnnn}" (purely key-derived)."""
+    i = keys.astype(np.int64)
+    cc = (10 + (nat if nat is not None else i % 25)).astype("U2")
+    mid = (i % 900 + 100).astype("U3")
+    last = (i % 9000 + 1000).astype("U4")
+    return np.char.add(np.char.add(np.char.add(np.char.add(cc, "-"), mid), "-"),
+                       last).astype(object)
+
+
 class TpchGenerator:
     def __init__(self, sf: float = 1.0, seed: int = 19920101):
         self.sf = sf
@@ -115,78 +141,84 @@ class TpchGenerator:
     def supplier(self) -> Dict[str, np.ndarray]:
         n = self.n_supplier
         rng = self._rng(1)
+        keys = np.arange(1, n + 1, dtype=np.int64)
+        # spec: ~5/10000 suppliers carry the "Customer Complaints" marker
+        # (Q16's filter); the rest draw from the comment vocabulary
+        cd, cc = _vocab_codes("supplier comment", rng, n)
+        from presto_tpu.dictionary import Dictionary
+
+        marked = rng.random(n) < 0.0005
+        vocab = np.sort(np.append(cd.values, "Customer Complaints"))
+        d2 = Dictionary(vocab)
+        remap = np.searchsorted(vocab, cd.values)
+        codes = np.where(marked, np.searchsorted(vocab, "Customer Complaints"),
+                         remap[cc]).astype(np.int32)
         return {
-            "s_suppkey": np.arange(1, n + 1, dtype=np.int64),
-            "s_name": np.array([f"Supplier#{i:09d}" for i in range(1, n + 1)], dtype=object),
-            "s_address": np.array([f"addr sup {i}" for i in range(1, n + 1)], dtype=object),
+            "s_suppkey": keys,
+            "s_name": _keyed_names("Supplier#", keys),
+            "s_address": _keyed_names("addrsup#", keys),
             "s_nationkey": rng.integers(0, 25, n, dtype=np.int64),
-            "s_phone": np.array([f"{10+i%25}-{i%900+100}-{i%9000+1000}" for i in range(1, n + 1)], dtype=object),
+            "s_phone": _phones(keys),
             "s_acctbal": _money(rng, -999.99, 9999.99, n),
-            "s_comment": np.array(
-                [
-                    "Customer Complaints" if x < 0.0005 else f"supplier comment {i}"
-                    for i, x in enumerate(rng.random(n))
-                ],
-                dtype=object,
-            ),
+            "s_comment": (d2, codes),
         }
 
     def customer(self) -> Dict[str, np.ndarray]:
         n = self.n_customer
         rng = self._rng(2)
         nat = rng.integers(0, 25, n, dtype=np.int64)
+        keys = np.arange(1, n + 1, dtype=np.int64)
         return {
-            "c_custkey": np.arange(1, n + 1, dtype=np.int64),
-            "c_name": np.array([f"Customer#{i:09d}" for i in range(1, n + 1)], dtype=object),
-            "c_address": np.array([f"addr cust {i}" for i in range(1, n + 1)], dtype=object),
+            "c_custkey": keys,
+            "c_name": _keyed_names("Customer#", keys),
+            "c_address": _keyed_names("addrcust#", keys),
             "c_nationkey": nat,
-            "c_phone": np.array(
-                [f"{10+int(k)}-{i%900+100}-{i%9000+1000}" for i, k in enumerate(nat)],
-                dtype=object,
-            ),
+            "c_phone": _phones(keys, nat),
             "c_acctbal": _money(rng, -999.99, 9999.99, n),
             "c_mktsegment": np.asarray(rng.choice(_SEGMENTS, n), dtype=object),
-            "c_comment": np.array([f"customer comment {i}" for i in range(1, n + 1)], dtype=object),
+            "c_comment": _vocab_codes("customer comment", rng, n),
         }
 
     def part(self) -> Dict[str, np.ndarray]:
+        from presto_tpu.dictionary import Dictionary
+
         n = self.n_part
         rng = self._rng(3)
-        s1 = rng.integers(0, len(_TYPE_S1), n)
-        s2 = rng.integers(0, len(_TYPE_S2), n)
-        s3 = rng.integers(0, len(_TYPE_S3), n)
-        types = np.array(
-            [f"{_TYPE_S1[a]} {_TYPE_S2[b]} {_TYPE_S3[c]}" for a, b, c in zip(s1, s2, s3)],
-            dtype=object,
-        )
-        c1 = rng.integers(0, len(_CONTAINER_S1), n)
-        c2 = rng.integers(0, len(_CONTAINER_S2), n)
-        containers = np.array(
-            [f"{_CONTAINER_S1[a]} {_CONTAINER_S2[b]}" for a, b in zip(c1, c2)],
-            dtype=object,
-        )
-        color_idx = rng.integers(0, len(_COLORS), (n, 2))
-        names = np.array(
-            [f"{_COLORS[a]} {_COLORS[b]}" for a, b in color_idx],
-            dtype=object,
-        )
-        brands = np.array(
-            [f"Brand#{m}{x}" for m, x in zip(rng.integers(1, 6, n), rng.integers(1, 6, n))],
-            dtype=object,
-        )
+        # enum-product columns generate as dictionary codes over the full
+        # cross-product vocabulary (150 types, 40 containers, 8464 names) —
+        # no per-row Python string construction at any scale factor
+        type_vocab = np.sort(np.array(
+            [f"{a} {b} {c}" for a in _TYPE_S1 for b in _TYPE_S2 for c in _TYPE_S3]))
+        t_d = Dictionary(type_vocab)
+        s123 = rng.integers(0, len(type_vocab), n).astype(np.int32)
+        cont_vocab = np.sort(np.array(
+            [f"{a} {b}" for a in _CONTAINER_S1 for b in _CONTAINER_S2]))
+        c_d = Dictionary(cont_vocab)
+        c12 = rng.integers(0, len(cont_vocab), n).astype(np.int32)
+        name_vocab = np.sort(np.array(
+            [f"{a} {b}" for a in _COLORS for b in _COLORS if a != b]))
+        n_d = Dictionary(name_vocab)
+        nc = rng.integers(0, len(name_vocab), n).astype(np.int32)
+        brand_vocab = np.sort(np.array(
+            [f"Brand#{m}{x}" for m in range(1, 6) for x in range(1, 6)]))
+        b_d = Dictionary(brand_vocab)
+        bc = rng.integers(0, len(brand_vocab), n).astype(np.int32)
+        mfgr_vocab = np.array([f"Manufacturer#{m}" for m in range(1, 6)])
+        m_d = Dictionary(mfgr_vocab)
+        mc = rng.integers(0, 5, n).astype(np.int32)
         # retail price formula per spec: 90000+((pk/10)%20001)+100*(pk%1000), in cents
         pk = np.arange(1, n + 1, dtype=np.int64)
         retail = 90000 + (pk // 10) % 20001 + 100 * (pk % 1000)
         return {
             "p_partkey": pk,
-            "p_name": names,
-            "p_mfgr": np.array([f"Manufacturer#{m}" for m in rng.integers(1, 6, n)], dtype=object),
-            "p_brand": brands,
-            "p_type": types,
+            "p_name": (n_d, nc),
+            "p_mfgr": (m_d, mc),
+            "p_brand": (b_d, bc),
+            "p_type": (t_d, s123),
             "p_size": rng.integers(1, 51, n, dtype=np.int64),
-            "p_container": containers,
+            "p_container": (c_d, c12),
             "p_retailprice": retail,
-            "p_comment": np.array([f"part comment {i}" for i in range(n)], dtype=object),
+            "p_comment": _vocab_codes("part comment", rng, n),
         }
 
     def partsupp(self) -> Dict[str, np.ndarray]:
@@ -204,15 +236,31 @@ class TpchGenerator:
             "ps_suppkey": sk,
             "ps_availqty": rng.integers(1, 10_000, n, dtype=np.int64),
             "ps_supplycost": _money(rng, 1.00, 1000.00, n),
-            "ps_comment": np.array([f"partsupp comment {i}" for i in range(n)], dtype=object),
+            "ps_comment": _vocab_codes("partsupp comment", rng, n),
         }
 
     def orders_and_lineitem(self):
-        n = self.n_orders
-        rng = self._rng(5)
+        """Full-table generation (single chunk, original RNG streams)."""
+        return self.orders_lineitem_chunk(0, self.n_orders, _salt=(5, 6))
+
+    def orders_lineitem_chunk(self, start: int, count: int, _salt=None):
+        """Generate orders [start, start+count) plus their lineitems.
+
+        Chunking keeps peak memory proportional to the chunk, letting
+        SF100 (150M orders / 600M lineitems) stream to parquet without
+        materializing the table (reference: dbgen's -S step/-C chunk
+        options). Lines of an order always live in its chunk, so
+        o_totalprice/o_orderstatus stay exact. Each chunk draws from its
+        own deterministic RNG streams; foreign keys (customer, part,
+        supplier) span the full SF domain."""
+        n = count
+        if _salt is None:
+            _salt = (1000 + 2 * (start // max(count, 1)),
+                     1001 + 2 * (start // max(count, 1)))
+        rng = self._rng(_salt[0])
         # sparse orderkeys like dbgen (every 8-key block uses first 2... we
         # use *4 spacing for simplicity, keys still sparse + sorted)
-        okey = np.arange(1, n + 1, dtype=np.int64) * 4
+        okey = np.arange(start + 1, start + n + 1, dtype=np.int64) * 4
         # only 2/3 of customers have orders (spec: custkey % 3 != 0)
         ncust = self.n_customer
         ckey = rng.integers(1, max(ncust // 3, 1) + 1, n, dtype=np.int64) * 3 - 2
@@ -226,7 +274,7 @@ class TpchGenerator:
         starts = np.cumsum(nline) - nline
         lnum_base = np.arange(total_lines) - starts[l_order_idx] + 1
 
-        lrng = self._rng(6)
+        lrng = self._rng(_salt[1])
         m = total_lines
         lpart = lrng.integers(1, self.n_part + 1, m, dtype=np.int64)
         # one of the 4 partsupp suppliers for that part
@@ -279,8 +327,15 @@ class TpchGenerator:
         ostatus = (Dictionary(np.array(["F", "O", "P"])), ostatus_codes)
 
         n_clerk = max(1, int(1000 * self.sf))
-        clerk_dict = Dictionary(np.array([f"Clerk#{i:09d}" for i in range(1, n_clerk + 1)]))
-        ocomment_vocab = np.sort(np.array([f"order comment {i}" for i in range(9973)]))
+        if not hasattr(self, "_clerk_dict"):
+            self._clerk_dict = Dictionary(
+                _keyed_names("Clerk#", np.arange(1, n_clerk + 1)).astype(str))
+            self._ocomment_vocab = np.sort(
+                np.array([f"order comment {i}" for i in range(9973)]))
+            self._lcomment_dict = Dictionary(
+                np.sort(np.array([f"line comment {i}" for i in range(9973)])))
+            self._ocomment_dict = Dictionary(self._ocomment_vocab)
+        clerk_dict = self._clerk_dict
         orders = {
             "o_orderkey": okey,
             "o_custkey": ckey,
@@ -294,7 +349,7 @@ class TpchGenerator:
             "o_clerk": (clerk_dict, rng.integers(0, n_clerk, n).astype(np.int32)),
             "o_shippriority": np.zeros(n, dtype=np.int64),
             "o_comment": (
-                Dictionary(ocomment_vocab),
+                self._ocomment_dict,
                 rng.integers(0, 9973, n).astype(np.int32),
             ),
         }
@@ -315,7 +370,7 @@ class TpchGenerator:
             "l_shipinstruct": sinstr,
             "l_shipmode": smode,
             "l_comment": (
-                Dictionary(np.sort(np.array([f"line comment {i}" for i in range(9973)]))),
+                self._lcomment_dict,
                 lrng.integers(0, 9973, m).astype(np.int32),
             ),
         }
@@ -358,6 +413,23 @@ _PRIMARY_KEYS = {
     "orders": ["o_orderkey"],
     "partsupp": ["ps_partkey", "ps_suppkey"],
 }
+
+
+def _column_types(table: str, data: Dict[str, np.ndarray]) -> Dict[str, "Type"]:
+    """Full name→Type map for a generated table (export path): explicit
+    types from _TYPES, VARCHAR for dictionary/object columns, BIGINT rest."""
+    explicit = _TYPES.get(table, {})
+    out = {}
+    for col, v in data.items():
+        if col in explicit:
+            out[col] = explicit[col]
+        elif isinstance(v, tuple) or (
+            isinstance(v, np.ndarray) and v.dtype == object
+        ):
+            out[col] = VARCHAR
+        else:
+            out[col] = BIGINT
+    return out
 
 
 class TpchConnector(MemoryConnector):

@@ -18,10 +18,22 @@ from presto_tpu.types import Type
 
 
 @dataclasses.dataclass
+class ColumnStats:
+    """Per-column statistics for the cost-based optimizer (reference:
+    spi/statistics/ColumnStatistics — NDV, null fraction, range)."""
+
+    ndv: Optional[float] = None            # distinct non-null values
+    null_fraction: Optional[float] = None  # in [0, 1]
+    min_value: Optional[float] = None      # numeric/date low (None: unknown)
+    max_value: Optional[float] = None
+
+
+@dataclasses.dataclass
 class ColumnInfo:
     name: str
     type: Type
     dictionary: Optional[Dictionary] = None
+    stats: Optional[ColumnStats] = None
 
 
 @dataclasses.dataclass

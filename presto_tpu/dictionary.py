@@ -152,7 +152,40 @@ class Dictionary:
 
     @staticmethod
     def merge(a: "Dictionary", b: "Dictionary") -> "Dictionary":
-        return Dictionary(np.unique(np.concatenate([a.values, b.values])))
+        """Union dictionary, with identity stability: when one side already
+        contains the other, that object is returned unchanged, and repeated
+        merges of the same pair return the same object. Identity matters —
+        Batches key jit caches by dictionary identity, so an accumulator
+        loop that re-merged every step would otherwise retrace/recompile
+        per batch."""
+        if a is b:
+            return a
+        memo = a._memo.setdefault("__merge", {})
+        hit = memo.get(id(b))
+        if hit is not None:
+            return hit[1]
+        if len(b.values) <= len(a.values) and np.isin(
+            b.values, a.values, assume_unique=True
+        ).all():
+            out = a
+        elif len(a.values) < len(b.values) and np.isin(
+            a.values, b.values, assume_unique=True
+        ).all():
+            out = b
+        else:
+            out = Dictionary(np.unique(np.concatenate([a.values, b.values])))
+        # pin the partner object: the memo key is id(b), so b must not be
+        # collected and have its id reused. Bounded FIFO — long-lived table
+        # dictionaries in a server would otherwise accrete one entry per
+        # novel partner forever
+        def put(m, key, val):
+            if len(m) >= 64:
+                m.pop(next(iter(m)))
+            m[key] = val
+
+        put(memo, id(b), (b, out))
+        put(b._memo.setdefault("__merge", {}), id(a), (a, out))
+        return out
 
     # identity hash/eq: a Dictionary is immutable once built; jit static-arg
     # caching keys off the object, and reusing the same object per table

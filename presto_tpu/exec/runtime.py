@@ -67,6 +67,7 @@ from presto_tpu.plan.nodes import (
     QueryPlan,
     RemoteSource,
     SemiJoin,
+    SetOp,
     Sort,
     TableScan,
     Window,
@@ -80,6 +81,13 @@ class ExecConfig:
 
     batch_rows: int = 1 << 17  # rows per scan batch
     agg_capacity: int = 1 << 12  # initial group-table capacity
+    # how many aggregate merge steps may be in flight before their group
+    # counts are confirmed on the host. Device→host syncs on a tunneled TPU
+    # cost a full round trip (~70-90 ms measured), so the driver dispatches
+    # optimistically and replays from a held checkpoint on the rare
+    # capacity overflow (reference analog: none — the JVM has no dispatch
+    # latency; this is TPU-native pipelining)
+    agg_pipeline_depth: int = 3
     topn_slack: int = 4
     join_out_capacity: Optional[int] = None  # default: probe batch capacity
     max_growth_retries: int = 24
@@ -290,6 +298,9 @@ def _execute_base(base: PlanNode, ctx: ExecContext) -> Iterator[Batch]:
         return
     if isinstance(base, SemiJoin):
         yield from _execute_semijoin(base, ctx)
+        return
+    if isinstance(base, SetOp):
+        yield from _execute_setop(base, ctx)
         return
     if isinstance(base, Sort):
         yield from _execute_sort(base, ctx)
@@ -697,6 +708,11 @@ def _execute_aggregate(node: Aggregate, ctx: ExecContext) -> Iterator[Batch]:
 
     def merge_step(acc: Optional[Batch], b: Batch, cap: int):
         b = chain(b)
+        if acc is not None:
+            # group keys from different sources (UNION ALL branches,
+            # exchange pages) may be coded against different dictionaries;
+            # group equality is string equality, so re-encode first
+            acc, b = _unify_batch_dicts([acc, b])
         kin, sin = in_to_states(b)
         live = b.live
         if acc is not None:
@@ -736,6 +752,8 @@ def _execute_aggregate(node: Aggregate, ctx: ExecContext) -> Iterator[Batch]:
     def acc_merge_step(acc: Optional[Batch], b: Batch, cap: int):
         """Merge a previously-spilled accumulator batch (state columns, not
         raw input) into acc — both sides use accumulator semantics."""
+        if acc is not None:
+            acc, b = _unify_batch_dicts([acc, b])
         kin, sin = acc_to_states(b)
         live = b.live
         if acc is not None:
@@ -776,6 +794,20 @@ def _execute_aggregate(node: Aggregate, ctx: ExecContext) -> Iterator[Batch]:
     import threading as _threading
 
     cap = ctx.config.agg_capacity
+    if key_syms:
+        # CBO capacity pre-sizing: a group table sized from derived NDV
+        # stats skips the overflow→replay growth ladder entirely
+        # (DetermineJoinDistributionType's cousin for aggregation; the
+        # reference sizes hash tables from expectedGroups hints)
+        try:
+            from presto_tpu.plan.stats import derive as _derive_stats
+
+            _st = _derive_stats(node, ctx.catalog)
+        except Exception:
+            _st = None
+        if _st is not None and _st.rows:
+            want = round_up_capacity(int(min(_st.rows * 1.25, float(1 << 23))))
+            cap = max(cap, want)
     state = {"acc": None, "spiller": None, "revoke_requested": False}
     mctx = LocalMemoryContext(ctx.memory_pool, "aggregate")
     can_spill = bool(key_syms) and ctx.config.spill_enabled
@@ -812,31 +844,83 @@ def _execute_aggregate(node: Aggregate, ctx: ExecContext) -> Iterator[Batch]:
         ctx.memory_pool.add_revoker(revoke)
     try:
         def absorb(stream, step_fn, step0_fn, allow_spill=True):
+            """Merge the stream into the accumulator with OPTIMISTIC
+            dispatch: the per-step group count `ng` (the only data-dependent
+            control input) is fetched asynchronously and confirmed up to
+            `agg_pipeline_depth` steps later, so the device pipeline never
+            stalls on a host round trip (70-90 ms each through the TPU
+            tunnel — the dominant cost of the old sync-per-batch loop).
+            A window of (checkpoint-acc, input-batch) pairs is held; on the
+            rare capacity overflow the window replays synchronously from
+            the last confirmed checkpoint at a bigger capacity."""
             nonlocal cap
-            for b in stream:
-                for _ in range(ctx.config.max_growth_retries):
-                    if state["acc"] is None:
-                        out, ng = step0_fn(b, cap)
-                    else:
-                        out, ng = step_fn(state["acc"], b, cap)
-                    ngi = int(ng)
-                    if ngi <= cap:
-                        break
-                    # power-of-two bucketing already gives ≤2× headroom;
-                    # doubling on top of it would 4× the memory footprint
-                    cap = round_up_capacity(ngi)
+            depth = max(1, ctx.config.agg_pipeline_depth)
+            no_overflow = not key_syms  # global agg: ng ≤ 1, never grows
+            window = []  # (acc_before, batch, ng_device_scalar)
+
+            def dispatch(b):
+                acc_before = state["acc"]
+                if acc_before is None:
+                    out, ng = step0_fn(b, cap)
                 else:
-                    raise RuntimeError("aggregate capacity growth exceeded retries")
-                out_bytes = batch_device_bytes(out)
+                    out, ng = step_fn(acc_before, b, cap)
                 state["acc"] = out
+                if no_overflow:
+                    return
+                try:
+                    ng.copy_to_host_async()
+                except Exception:
+                    pass
+                window.append((acc_before, b, ng))
+
+            def replay(entries, ngi):
+                """Re-merge `entries` from the first entry's checkpoint at a
+                capacity that fits `ngi` groups (synchronous — rare path)."""
+                nonlocal cap
+                state["acc"] = entries[0][0]
+                cap = round_up_capacity(ngi)
+                for _, b, _ in entries:
+                    for _ in range(ctx.config.max_growth_retries):
+                        acc_before = state["acc"]
+                        if acc_before is None:
+                            out, ng2 = step0_fn(b, cap)
+                        else:
+                            out, ng2 = step_fn(acc_before, b, cap)
+                        n2 = int(ng2)
+                        if n2 <= cap:
+                            state["acc"] = out
+                            break
+                        # power-of-two bucketing already gives ≤2× headroom;
+                        # doubling on top would 4× the memory footprint
+                        cap = round_up_capacity(n2)
+                    else:
+                        raise RuntimeError(
+                            "aggregate capacity growth exceeded retries")
+
+            def confirm(block):
+                while window and (block or len(window) > depth):
+                    ngi = int(window[0][2])  # usually already on host
+                    if ngi <= cap:
+                        window.pop(0)
+                        continue
+                    entries = list(window)
+                    window.clear()
+                    replay(entries, ngi)
+
+            for b in stream:
+                dispatch(b)
+                confirm(block=False)
+                out_bytes = batch_device_bytes(state["acc"])
                 if allow_spill and can_spill and (
                     state["revoke_requested"]
                     or ctx.should_spill(out_bytes - mctx.bytes)
                 ):
+                    confirm(block=True)  # spill only a confirmed accumulator
                     state["revoke_requested"] = False
                     do_spill()
                 else:
                     mctx.set_bytes(out_bytes)
+            confirm(block=True)
 
         absorb(in_stream, jit_step, jit_step0)
 
@@ -1073,13 +1157,51 @@ _JIT_COMPACT = jax.jit(compact)
 _JIT_LIMIT = jax.jit(limit_batch)
 
 
+def _unify_batch_dicts(batches: List[Batch]) -> List[Batch]:
+    """Before concatenating, re-encode any string column whose batches
+    carry DIFFERENT Dictionary objects against their merged dictionary
+    (code equality must mean string equality across the result — the
+    DictionaryBlock id-canonicalization of the reference). Batches from
+    one table share dictionary objects, so this is a no-op on hot paths."""
+    from presto_tpu.dictionary import Dictionary
+
+    todo = {}
+    for name in batches[0].names:
+        ds = [b.dicts.get(name) for b in batches]
+        present = [d for d in ds if d is not None]
+        if not present or all(d is present[0] for d in present):
+            continue
+        m = present[0]
+        for d in present[1:]:
+            if d is not m:
+                m = Dictionary.merge(m, d)
+        todo[name] = m
+    if not todo:
+        return batches
+    out = []
+    for b in batches:
+        cols = list(b.columns)
+        dicts = dict(b.dicts)
+        for name, m in todo.items():
+            d = b.dicts.get(name)
+            dicts[name] = m
+            if d is None or d is m:
+                continue
+            i = b.names.index(name)
+            remap = jnp.asarray(d.map_to(m))
+            c = cols[i]
+            cols[i] = Column(remap[c.values.astype(jnp.int32) + 1], c.validity)
+        out.append(Batch(b.names, b.types, cols, b.live, dicts))
+    return out
+
+
 def _collect_concat(stream: Iterator[Batch]) -> Optional[Batch]:
     batches = list(stream)
     if not batches:
         return None
     if len(batches) == 1:
         return batches[0]
-    return _JIT_CAT(batches)
+    return _JIT_CAT(_unify_batch_dicts(batches))
 
 
 def _execute_join(node: HashJoin, ctx: ExecContext) -> Iterator[Batch]:
@@ -1176,9 +1298,36 @@ def _join_probe(node: HashJoin, ctx: ExecContext, build_in: Optional[Batch],
         build_in, tuple(node.right_keys)
     )
 
+    want_full = node.kind == "full"
+    build_cap = int(table.hashes.shape[0])
+
+    def build_remainder_fn(t: BuildTable, bm):
+        """FULL OUTER tail: build rows no probe row matched, with NULL
+        probe columns (reference: LookupJoinOperators.fullOuterJoin's
+        lookup-outer positions pass)."""
+        ltypes = dict(node.left.output)
+        names, types, cols = [], [], []
+        cap = t.hashes.shape[0]
+        for c in lsyms:
+            names.append(c)
+            types.append(ltypes[c])
+            cols.append(Column(jnp.zeros(cap, ltypes[c].dtype),
+                               jnp.zeros(cap, bool)))
+        for c in rsyms:
+            names.append(c)
+            types.append(t.batch.type_of(c))
+            cols.append(t.batch.column(c))
+        # orig_live, not batch.live: NULL-key build rows were live-killed
+        # for matching but a FULL JOIN must still emit them unmatched
+        live = t.orig_live & ~bm
+        return Batch(names, types, cols, live,
+                     {c: t.batch.dicts[c] for c in rsyms if c in t.batch.dicts})
+
+    jremainder = _node_jit(node, jkey + "full_tail", lambda: build_remainder_fn)
+
     if node.build_unique:
 
-        def probe_fn(table: BuildTable, pb: Batch):
+        def probe_fn(table: BuildTable, pb: Batch, bm):
             pb = chain(pb)
             pba = align_probe_strings(pb, tuple(node.left_keys), table, tuple(node.right_keys))
             idx, matched = probe_unique(table, pba, tuple(node.left_keys), tuple(node.right_keys))
@@ -1186,20 +1335,27 @@ def _join_probe(node: HashJoin, ctx: ExecContext, build_in: Optional[Batch],
                 pb, table, jnp.arange(pb.capacity, dtype=jnp.int32), idx,
                 pb.live, lsyms, rsyms,
             )
+            if bm is not None:
+                bm = bm.at[idx].max(matched & pb.live, mode="drop")
             if node.kind == "inner":
-                return out.with_live(out.live & matched)
-            # left outer: keep probe rows; null out build columns where unmatched
+                return out.with_live(out.live & matched), bm
+            # left/full outer: keep probe rows; null out build columns where
+            # unmatched
             cols = list(out.columns)
             for i, nme in enumerate(out.names):
                 if nme in rsyms:
                     c = cols[i]
                     valid = c.validity if c.validity is not None else jnp.ones(out.capacity, bool)
                     cols[i] = Column(c.values, valid & matched, c.hi)
-            return Batch(out.names, out.types, cols, out.live, out.dicts)
+            return Batch(out.names, out.types, cols, out.live, out.dicts), bm
 
         jfn = _node_jit(node, jkey + "probe", lambda: probe_fn)
+        bm = jnp.zeros(build_cap, bool) if want_full else None
         for pb in probe_stream:
-            yield jfn(table, pb)
+            out, bm = jfn(table, pb, bm)
+            yield out
+        if want_full:
+            yield jremainder(table, bm)
         return
 
     # general fanout join (inner / left): counts pass + chunked expansion.
@@ -1219,7 +1375,7 @@ def _join_probe(node: HashJoin, ctx: ExecContext, build_in: Optional[Batch],
         ),
     )
 
-    def expand_fn(t, pb, pba, lo, counts, offsets, base, out_cap):
+    def expand_fn(t, pb, pba, lo, counts, offsets, base, out_cap, bm):
         pr, bi, ol = probe_expand(
             t, pba, tuple(node.left_keys), tuple(node.right_keys),
             lo, counts, offsets, base, out_cap,
@@ -1231,7 +1387,9 @@ def _join_probe(node: HashJoin, ctx: ExecContext, build_in: Optional[Batch],
             .max(ol.astype(jnp.int32), mode="drop")
             .astype(bool)
         )
-        return out, exists
+        if bm is not None:
+            bm = bm.at[bi].max(ol, mode="drop")
+        return out, exists, bm
 
     def null_extend_fn(t, pb, exists):
         # unmatched probe rows with NULL build columns
@@ -1249,22 +1407,33 @@ def _join_probe(node: HashJoin, ctx: ExecContext, build_in: Optional[Batch],
 
     jexpand = _node_jit(node, "expand", lambda: expand_fn, static_argnames=("out_cap",))
     jnull = _node_jit(node, "null_extend", lambda: null_extend_fn)
+    bm = jnp.zeros(build_cap, bool) if want_full else None
     for pb_raw in probe_stream:
         pb, pba = chain_j(pb_raw)
         lo, counts, offsets, total, _ = counts_fn(table, pba)
-        tot = int(total)
+        # dispatch chunk 0 unconditionally while `total` travels to the
+        # host (it is usually the only chunk) — the host round trip
+        # overlaps chunk-0 execution and downstream dispatch
+        try:
+            total.copy_to_host_async()
+        except Exception:
+            pass
         out_cap = ctx.config.join_out_capacity or pb.capacity
-        base = 0
-        exists_acc = jnp.zeros(pb.capacity, dtype=bool)
-        while base < tot or base == 0:
-            out, exists = jexpand(table, pb, pba, lo, counts, offsets, base, out_cap)
+        out, exists_acc, bm = jexpand(table, pb, pba, lo, counts, offsets, 0,
+                                      out_cap, bm)
+        yield out
+        tot = int(total)
+        base = out_cap
+        while base < tot:
+            out, exists, bm = jexpand(table, pb, pba, lo, counts, offsets,
+                                      base, out_cap, bm)
             exists_acc = exists_acc | exists
             yield out
             base += out_cap
-            if base >= tot:
-                break
-        if node.kind == "left":
+        if node.kind in ("left", "full"):
             yield jnull(table, pb, exists_acc)
+    if want_full:
+        yield jremainder(table, bm)
 
 
 def _execute_semijoin(node: SemiJoin, ctx: ExecContext) -> Iterator[Batch]:
@@ -1360,10 +1529,16 @@ def _execute_semijoin(node: SemiJoin, ctx: ExecContext) -> Iterator[Batch]:
     for pb_raw in probe_stream:
         pb, pba = chain_j(pb_raw)
         lo, counts, offsets, total, _ = counts_fn(table, pba)
-        tot = int(total)
+        # chunk 0 dispatches while `total` travels to the host (see
+        # _join_probe — same round-trip overlap)
+        try:
+            total.copy_to_host_async()
+        except Exception:
+            pass
         out_cap = ctx.config.join_out_capacity or pb.capacity
-        base = 0
-        exists_acc = jnp.zeros(pb.capacity, dtype=bool)
+        exists_acc = jexists(table, pb, pba, lo, counts, offsets, 0, out_cap)
+        tot = int(total)
+        base = out_cap
         while base < tot:
             exists_acc = exists_acc | jexists(
                 table, pb, pba, lo, counts, offsets, base, out_cap
@@ -1371,6 +1546,125 @@ def _execute_semijoin(node: SemiJoin, ctx: ExecContext) -> Iterator[Batch]:
             base += out_cap
         keep = ~exists_acc if node.negated else exists_acc
         yield pb.with_live(pb.live & keep)
+
+
+# -- set operations ---------------------------------------------------------
+
+
+def _align_setop_dicts(node: SetOp, batches: List[Batch]) -> List[Batch]:
+    """Re-encode string columns of all batches against shared merged
+    dictionaries so code equality == string equality (the DictionaryBlock
+    id-canonicalization the reference does inside set-operation hashing).
+    Thin wrapper over _unify_batch_dicts, which stamps a dict-less side
+    with the merged dictionary too."""
+    out = _unify_batch_dicts(batches)
+    # a side whose string column carries no dictionary (all-NULL) still
+    # needs the shared one for decode
+    for i, t in enumerate(node.types):
+        if not t.is_string:
+            continue
+        name = node.symbols[i]
+        ds = [b.dicts.get(name) for b in out if b.dicts.get(name) is not None]
+        if ds:
+            out = [b if name in b.dicts else
+                   Batch(b.names, b.types, b.columns, b.live,
+                         {**b.dicts, name: ds[0]})
+                   for b in out]
+    return out
+
+
+def _null_safe_encode(b: Batch) -> Tuple[Batch, List[str]]:
+    """Rows as join keys with NULLs-equal semantics (SQL DISTINCT / set-op
+    equality treats NULL = NULL): every column contributes a zero-filled
+    value key plus a validity-bit key, so build_side/probe never null-kill
+    and NULL cells compare equal. Long decimals contribute their hi limb."""
+    names, types, cols = [], [], []
+    for i, c in enumerate(b.columns):
+        base = f"k{i}"
+        v = (c.values if c.validity is None
+             else jnp.where(c.validity, c.values, jnp.zeros_like(c.values)))
+        names.append(base)
+        types.append(b.types[i])
+        cols.append(Column(v, None))
+        names.append(base + "$v")
+        types.append(BIGINT)
+        vb = (jnp.ones(b.capacity, jnp.int8) if c.validity is None
+              else c.validity.astype(jnp.int8))
+        cols.append(Column(vb.astype(jnp.int64), None))
+        if c.hi is not None:
+            hv = (c.hi if c.validity is None
+                  else jnp.where(c.validity, c.hi, jnp.zeros_like(c.hi)))
+            names.append(base + "$hi")
+            types.append(BIGINT)
+            cols.append(Column(hv, None))
+    return Batch(names, types, cols, b.live, {}), names
+
+
+def _distinct_rows(b: Batch) -> Batch:
+    """Keep one row per distinct tuple (NULLs equal): sort by all null-safe
+    key encodings, keep the first row of each run. Preserves full rows
+    (validity + hi limbs) — unlike grouped_merge, which rebuilds columns."""
+    enc, _ = _null_safe_encode(b)
+    n = b.capacity
+    operands = [(~b.live).astype(jnp.int32)] + [c.values for c in enc.columns]
+    perm = jnp.arange(n, dtype=jnp.int32)
+    sorted_ops = jax.lax.sort(operands + [perm], num_keys=len(operands))
+    sperm = sorted_ops[-1]
+    sdead = sorted_ops[0]
+    first = jnp.zeros(n, dtype=bool).at[0].set(True)
+    for sk in sorted_ops[:-1]:
+        first = first.at[1:].set(first[1:] | (sk[1:] != sk[:-1]))
+    from presto_tpu.ops.sort import permute_batch
+
+    out = permute_batch(b, sperm)
+    return out.with_live((sdead == 0) & first)
+
+
+def _execute_setop(node: SetOp, ctx: ExecContext) -> Iterator[Batch]:
+    """UNION [ALL] / INTERSECT / EXCEPT executor (reference: UnionNode is
+    pass-through concat; INTERSECT/EXCEPT lower to mark-joins over hashed
+    rows — here a null-safe membership probe over the whole row)."""
+    syms = node.symbols
+
+    def renamed(child):
+        for b in execute_node(child, ctx):
+            yield b.rename(syms)
+
+    if node.all:  # UNION ALL: pure streaming concat
+        yield from renamed(node.left)
+        yield from renamed(node.right)
+        return
+
+    lb = _collect_concat(renamed(node.left))
+    rb = _collect_concat(renamed(node.right))
+    if node.kind == "union":
+        sides = [b for b in (lb, rb) if b is not None]
+        if not sides:
+            return
+        sides = _align_setop_dicts(node, sides)
+        merged = sides[0] if len(sides) == 1 else _concat2(sides[0], sides[1])
+        yield _node_jit(node, "distinct", lambda: _distinct_rows)(merged)
+        return
+
+    # INTERSECT / EXCEPT
+    if lb is None:
+        return
+    if rb is None:
+        if node.kind == "except":
+            yield _node_jit(node, "distinct", lambda: _distinct_rows)(lb)
+        return
+    lb, rb = _align_setop_dicts(node, [lb, rb])
+
+    def membership(lb: Batch, rb: Batch):
+        ld = _distinct_rows(lb)
+        lenc, keys = _null_safe_encode(ld)
+        renc, _ = _null_safe_encode(rb)
+        table = build_side(renc, tuple(keys))
+        _, matched = probe_unique(table, lenc, tuple(keys), tuple(keys))
+        keep = matched if node.kind == "intersect" else ~matched
+        return ld.with_live(ld.live & keep)
+
+    yield _node_jit(node, "membership", lambda: membership)(lb, rb)
 
 
 # -- window -----------------------------------------------------------------
@@ -1510,7 +1804,11 @@ def _execute_sort(node: Sort, ctx: ExecContext) -> Iterator[Batch]:
 
         def topn_step(acc: Optional[Batch], b: Batch):
             b = chain(b)
-            merged = b if acc is None else _concat2(acc, b)
+            if acc is not None:
+                acc, b = _unify_batch_dicts([acc, b])
+                merged = _concat2(acc, b)
+            else:
+                merged = b
             out = sort_batch(merged, _sort_keys(node, merged), limit=node.limit)
             return _truncate(out, cap)
 

@@ -6,10 +6,26 @@ InMemoryHashAggregationBuilder.
 
 TPU-native redesign: scatter-with-conflicts is hostile to XLA, so grouping is
 a *sort*: lexicographic `lax.sort` over (deadness, per-key null bit, key
-value)*, boundary detection, then `segment_sum/min/max` into a fixed-capacity
-group table. Everything is static-shape; the only dynamic quantity (group
-count) is returned as a device scalar so the driver can detect capacity
-overflow and recompile with a bigger bucket.
+value)*, boundary detection, then a segmented reduction into a
+fixed-capacity group table. Everything is static-shape; the only dynamic
+quantity (group count) is returned as a device scalar so the driver can
+detect capacity overflow and recompile with a bigger bucket.
+
+Scatter avoidance (the load-bearing perf property): XLA lowers
+`segment_sum` to HLO scatter, which TPU executes as a serialized
+read-modify-write loop (~95 GB of HBM traffic for a 1M-row batch at
+cap=1024 — measured ~0.8 s/batch). Three scatter-free strategies instead:
+
+- **no keys** (global aggregate): one masked reduction per state.
+- **small static key domain** (dictionary/boolean keys, ≤ _MASK_SLOTS
+  slots): the group id is the mixed-radix number of the key digits and
+  states reduce via a [G, n] masked-broadcast reduction — no sort, no
+  scatter (BigintGroupByHash's dense small-range analog).
+- **general**: lexicographic sort, then per-segment reduction by
+  *segmented associative scan* (log-depth, elementwise) and a gather at
+  segment ends; group keys materialize with a searchsorted + gather.
+  Per-segment scans also keep float sums exact per group (no
+  prefix-difference cancellation).
 
 The same kernel does partial aggregation, state merging, and final
 aggregation: inputs are "state columns" each with a merge op
@@ -50,6 +66,11 @@ def _minmax_identity(dtype, op):
     return jnp.array(info.max if op == "min" else info.min, dtype)
 
 
+# Masked-broadcast reduction is O(G·n); past this many slots the sorted
+# segmented-scan path (O(n log n) but G-independent) wins.
+_MASK_SLOTS = 128
+
+
 def grouped_merge(
     keys: Sequence[KeyCol],
     states: Sequence[StateCol],
@@ -65,7 +86,10 @@ def grouped_merge(
     caller must retry with a bigger capacity (groups beyond cap are dropped
     deterministically — the driver checks).
     """
-    if keys and all(k.domain is not None for k in keys):
+    if not keys:
+        return _global_merge(states, live, num_groups_cap)
+
+    if all(k.domain is not None for k in keys):
         dom_slots = [
             (k.domain + 1) if k.validity is not None else max(k.domain, 1)
             for k in keys
@@ -73,7 +97,7 @@ def grouped_merge(
         total = 1
         for ds in dom_slots:
             total *= ds
-        if 0 < total <= num_groups_cap:
+        if 0 < total <= min(num_groups_cap, _MASK_SLOTS):
             return _direct_grouped_merge(
                 keys, states, live, num_groups_cap, dom_slots
             )
@@ -100,11 +124,19 @@ def grouped_merge(
     for sk in sorted_keys:
         change = change.at[1:].set(change[1:] | (sk[1:] != sk[:-1]))
     seg = jnp.cumsum(change.astype(jnp.int32)) - 1
-    # dead rows sort last; push their segment out of range so segment ops drop them
+    # dead rows sort last; push their segment out of range so lookups miss
     seg = jnp.where(sdead == 1, num_groups_cap, seg)
     n_groups = jnp.max(jnp.where(sdead == 1, -1, seg)) + 1
 
-    # materialize group keys: first (any) row of each segment
+    # per-group first/last row positions in sorted order (gather, no scatter)
+    gids = jnp.arange(num_groups_cap, dtype=seg.dtype)
+    starts = jnp.searchsorted(seg, gids, side="left")        # [cap] in [0, n]
+    ends = jnp.searchsorted(seg, gids, side="right") - 1     # [cap] in [-1, n-1]
+    has = ends >= starts
+    starts_c = jnp.clip(starts, 0, n - 1).astype(jnp.int32)
+    ends_c = jnp.clip(ends, 0, n - 1).astype(jnp.int32)
+
+    # materialize group keys: first row of each segment
     key_out = []
     ki = 1
     for k in keys:
@@ -112,54 +144,92 @@ def grouped_merge(
             nullbit = sorted_keys[ki]
             vals = sorted_keys[ki + 1]
             ki += 2
-            kv = jnp.zeros(num_groups_cap, dtype=vals.dtype).at[seg].set(vals, mode="drop")
-            kvd = jnp.zeros(num_groups_cap, dtype=bool).at[seg].set(nullbit == 0, mode="drop")
+            kv = jnp.where(has, vals[starts_c], jnp.zeros((), vals.dtype))
+            kvd = has & (nullbit[starts_c] == 0)
             key_out.append(KeyCol(kv, kvd))
         else:
             vals = sorted_keys[ki]
             ki += 1
-            kv = jnp.zeros(num_groups_cap, dtype=vals.dtype).at[seg].set(vals, mode="drop")
+            kv = jnp.where(has, vals[starts_c], jnp.zeros((), vals.dtype))
             key_out.append(KeyCol(kv, None))
 
     state_out = []
     for s in states:
         sv = s.values[sperm]
         svalid = s.validity[sperm] if s.validity is not None else None
-        state_out.append(_state_merge(sv, svalid, s.op, seg, n, num_groups_cap))
+        state_out.append(
+            _state_merge_sorted(sv, svalid, s.op, change, ends_c, has)
+        )
 
     out_live = jnp.arange(num_groups_cap) < n_groups
     return key_out, state_out, out_live, n_groups
 
 
-def _state_merge(sv, svalid, op, seg, n, num_groups_cap):
-    """One state column → per-segment aggregate (+ validity). Shared by the
-    sort path (seg = dense rank over permuted rows) and the direct path
-    (seg = mixed-radix key digits over input order)."""
+def _segmented_scan(vals, first_flag, op: str):
+    """Inclusive segmented scan: within each run started by first_flag,
+    combine with `op`. Log-depth associative scan, pure elementwise —
+    the scatter-free backbone of the sorted reduction."""
+
+    def combine(a, b):
+        fa, va = a
+        fb, vb = b
+        if op == "sum":
+            v = jnp.where(fb, vb, va + vb)
+        elif op == "min":
+            v = jnp.where(fb, vb, jnp.minimum(va, vb))
+        else:
+            v = jnp.where(fb, vb, jnp.maximum(va, vb))
+        return fa | fb, v
+
+    _, scanned = jax.lax.associative_scan(combine, (first_flag, vals))
+    return scanned
+
+
+def _state_merge_sorted(sv, svalid, op, change, ends_c, has):
+    """One permuted state column → per-segment aggregate via segmented scan
+    + gather at segment ends. Exact per group (no prefix-difference
+    cancellation for floats; int sums are plain adds)."""
+    base_op = "sum" if op == "count_add" else op
     if op in ("sum", "count_add"):
         contrib = sv if svalid is None else jnp.where(svalid, sv, jnp.zeros_like(sv))
-        agg = jax.ops.segment_sum(contrib, seg, num_segments=num_groups_cap)
-        if op == "count_add":
-            return StateCol(agg, None, op)
-        if svalid is None:
-            nvalid = jax.ops.segment_sum(jnp.ones(n, jnp.int32), seg,
-                                         num_segments=num_groups_cap)
-        else:
-            nvalid = jax.ops.segment_sum(svalid.astype(jnp.int32), seg,
-                                         num_segments=num_groups_cap)
-        return StateCol(agg, nvalid > 0, op)
-    if op in ("min", "max"):
+    else:
         ident = _minmax_identity(sv.dtype, op)
         contrib = sv if svalid is None else jnp.where(svalid, sv, ident)
-        segop = jax.ops.segment_min if op == "min" else jax.ops.segment_max
-        agg = segop(contrib, seg, num_segments=num_groups_cap)
-        if svalid is None:
-            nvalid = jax.ops.segment_sum(jnp.ones(n, jnp.int32), seg,
-                                         num_segments=num_groups_cap)
+    scanned = _segmented_scan(contrib, change, base_op)
+    agg = jnp.where(has, scanned[ends_c], jnp.zeros((), sv.dtype))
+    if op == "count_add":
+        return StateCol(agg, None, op)
+    if svalid is None:
+        return StateCol(agg, has, op)
+    vscan = _segmented_scan(svalid.astype(jnp.int32), change, "sum")
+    nvalid = jnp.where(has, vscan[ends_c], 0)
+    return StateCol(agg, nvalid > 0, op)
+
+
+def _global_merge(states, live, num_groups_cap):
+    """No GROUP BY keys: one masked reduction per state into slot 0.
+    (The sort path would scatter; a global aggregate needs neither.)"""
+    any_live = jnp.any(live)
+    out_live = (jnp.arange(num_groups_cap) == 0) & any_live
+    n_groups = any_live.astype(jnp.int64)
+    state_out = []
+    for s in states:
+        sv, svalid = s.values, s.validity
+        valid = live if svalid is None else (live & svalid)
+        if s.op in ("sum", "count_add"):
+            total = jnp.sum(jnp.where(valid, sv, jnp.zeros_like(sv)))
+        elif s.op == "min":
+            total = jnp.min(jnp.where(valid, sv, _minmax_identity(sv.dtype, "min")))
         else:
-            nvalid = jax.ops.segment_sum(svalid.astype(jnp.int32), seg,
-                                         num_segments=num_groups_cap)
-        return StateCol(agg, nvalid > 0, op)
-    raise ValueError(f"unknown merge op {op}")
+            total = jnp.max(jnp.where(valid, sv, _minmax_identity(sv.dtype, "max")))
+        agg = jnp.zeros(num_groups_cap, sv.dtype).at[0].set(total)
+        if s.op == "count_add":
+            state_out.append(StateCol(agg, None, s.op))
+        else:
+            nvalid = jnp.sum(valid.astype(jnp.int32))
+            v0 = (jnp.arange(num_groups_cap) == 0) & (nvalid > 0)
+            state_out.append(StateCol(agg, v0, s.op))
+    return [], state_out, out_live, n_groups
 
 
 def _direct_grouped_merge(
@@ -171,16 +241,20 @@ def _direct_grouped_merge(
 ) -> Tuple[list, list, jnp.ndarray, jnp.ndarray]:
     """Small-key-domain GROUP BY: the group id IS the mixed-radix number of
     the key digits (nullable keys reserve digit 0 for NULL), so states
-    segment-reduce directly on input order — no sort, no permutation. The
-    group table is sparse: out_live marks occupied slots and key columns are
-    decoded from the slot index itself. Because Π dom_slots ≤ cap, overflow
-    is impossible (n_groups counts occupied slots).
+    reduce by a [G, n] masked-broadcast reduction on input order — no sort,
+    no permutation, no scatter. The group table is sparse: out_live marks
+    occupied slots and key columns are decoded from the slot index itself.
+    Because Π dom_slots ≤ min(cap, _MASK_SLOTS), overflow is impossible
+    (n_groups counts occupied slots).
 
     Reference analog: BigintGroupByHash's dense small-range path; here it
     also covers multi-key dictionary-coded GROUP BY (TPC-H Q1's
     returnflag×linestatus), which the reference would route through
     MultiChannelGroupByHash."""
     n = live.shape[0]
+    total = 1
+    for ds in dom_slots:
+        total *= ds
     gid = jnp.zeros(n, dtype=jnp.int32)
     for k, ds in zip(keys, dom_slots):
         v = k.values.astype(jnp.int32)
@@ -189,11 +263,13 @@ def _direct_grouped_merge(
         else:
             slot = jnp.clip(v, 0, ds - 1)
         gid = gid * ds + slot
-    gid = jnp.where(live, gid, num_groups_cap)  # dead rows dropped
+    gid = jnp.where(live, gid, total)  # dead rows match no slot
 
-    counts = jax.ops.segment_sum(
-        live.astype(jnp.int32), gid, num_segments=num_groups_cap
-    )
+    # [G, n] group-membership mask, reused across all states
+    eq = gid[None, :] == jnp.arange(total, dtype=jnp.int32)[:, None]
+
+    counts_g = jnp.sum(eq, axis=1, dtype=jnp.int32)  # [G]
+    counts = jnp.zeros(num_groups_cap, jnp.int32).at[:total].set(counts_g)
     out_live = counts > 0
     n_groups = jnp.sum(out_live.astype(jnp.int32))
 
@@ -215,7 +291,29 @@ def _direct_grouped_merge(
             key_out.append(KeyCol(d.astype(k.values.dtype), None, k.domain))
 
     state_out = [
-        _state_merge(s.values, s.validity, s.op, gid, n, num_groups_cap)
-        for s in states
+        _state_merge_masked(s, eq, total, num_groups_cap) for s in states
     ]
     return key_out, state_out, out_live, n_groups
+
+
+def _state_merge_masked(s: StateCol, eq, total: int, num_groups_cap: int):
+    """One state column → per-slot aggregate via the [G, n] mask."""
+    sv, svalid = s.values, s.validity
+    if s.op in ("sum", "count_add"):
+        contrib = sv if svalid is None else jnp.where(svalid, sv, jnp.zeros_like(sv))
+        agg_g = jnp.sum(jnp.where(eq, contrib[None, :], jnp.zeros((), sv.dtype)),
+                        axis=1)
+    else:
+        ident = _minmax_identity(sv.dtype, s.op)
+        contrib = sv if svalid is None else jnp.where(svalid, sv, ident)
+        masked = jnp.where(eq, contrib[None, :], ident)
+        agg_g = jnp.min(masked, axis=1) if s.op == "min" else jnp.max(masked, axis=1)
+    agg = jnp.zeros(num_groups_cap, sv.dtype).at[:total].set(agg_g)
+    if s.op == "count_add":
+        return StateCol(agg, None, s.op)
+    if svalid is None:
+        nvalid_g = jnp.sum(eq, axis=1, dtype=jnp.int32)
+    else:
+        nvalid_g = jnp.sum(eq & svalid[None, :], axis=1, dtype=jnp.int32)
+    nvalid = jnp.zeros(num_groups_cap, jnp.int32).at[:total].set(nvalid_g)
+    return StateCol(agg, nvalid > 0, s.op)

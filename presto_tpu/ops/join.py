@@ -32,11 +32,15 @@ from presto_tpu.ops.sort import permute_batch
 
 class BuildTable(NamedTuple):
     """Sorted-by-hash build side. `batch` holds payload + key columns,
-    compacted so live rows occupy [0, n_rows); `hashes` aligned with it."""
+    compacted so live rows occupy [0, n_rows); `hashes` aligned with it.
+    `orig_live` preserves input liveness BEFORE NULL-key rows were killed —
+    FULL OUTER must still emit those rows in its build remainder (a NULL
+    key never matches, but the row exists)."""
 
     hashes: jnp.ndarray  # int64[cap], sorted; dead lanes = int64.max
     batch: Batch
     n_rows: jnp.ndarray  # device scalar
+    orig_live: jnp.ndarray  # bool[cap], aligned with batch
 
 
 _SENTINEL = jnp.iinfo(jnp.int64).max
@@ -91,7 +95,7 @@ def build_side(batch: Batch, key_names: Sequence[str]) -> BuildTable:
     sorted_h, sperm = jax.lax.sort([h, perm], num_keys=1)
     sorted_batch = permute_batch(batch.with_live(live), sperm)
     n = jnp.sum(live.astype(jnp.int64))
-    return BuildTable(sorted_h, sorted_batch, n)
+    return BuildTable(sorted_h, sorted_batch, n, batch.live[sperm])
 
 
 def _probe_ranges(table: BuildTable, probe: Batch, key_names: Sequence[str]):

@@ -38,6 +38,7 @@ from presto_tpu.plan.nodes import (
     Project,
     QueryPlan,
     SemiJoin,
+    SetOp,
     Sort,
     SortItem,
     TableScan,
@@ -685,6 +686,10 @@ class Planner:
                 else:
                     raise AnalysisError("left join residual on probe side unsupported")
             residual = keep
+        if kind == "full" and residual:
+            # an ON residual must not drop unmatched rows on either side;
+            # no correct place to evaluate it outside the join yet
+            raise AnalysisError("FULL JOIN with non-equi residual not supported")
         node = HashJoin(kind=kind, left=left.node, right=right.node,
                         left_keys=lkeys, right_keys=rkeys,
                         build_unique=_derives_unique(right.node, rkeys))
@@ -693,9 +698,80 @@ class Planner:
             out = Filter(out, combine_conjuncts(residual))
         return RelationPlan(out, scope, rows=max(left.rows, right.rows))
 
+    # -- set operations ---------------------------------------------------
+
+    def plan_setop(self, q: ast.SetOp) -> QueryPlan:
+        """UNION/INTERSECT/EXCEPT: plan both sides independently, align
+        arity and types positionally, wrap in a SetOp node; a trailing
+        ORDER BY/LIMIT sorts the combined result (reference:
+        StatementAnalyzer set-operation analysis + UnionNode planning)."""
+        ctes = dict(self.ctes)
+        for name, sub in q.ctes:
+            ctes[name] = sub
+
+        def plan_side(side):
+            sub = Planner(self.catalog, self.symbols, ctes)
+            qp = sub.plan(side)
+            self.scalar_subqueries.update(sub.scalar_subqueries)
+            return qp
+
+        lqp, rqp = plan_side(q.left), plan_side(q.right)
+        lout, rout = lqp.root, rqp.root
+        self.scalar_subqueries.update(lqp.scalar_subqueries)
+        self.scalar_subqueries.update(rqp.scalar_subqueries)
+        if len(lout.symbols) != len(rout.symbols):
+            raise AnalysisError(
+                f"{q.kind.upper()} arity mismatch: {len(lout.symbols)} vs "
+                f"{len(rout.symbols)} columns")
+        ltypes = [t for _, t in lout.output]
+        rtypes = [t for _, t in rout.output]
+        for i, (lt, rt) in enumerate(zip(ltypes, rtypes)):
+            # exact logical-type compatibility: dtype equality is not
+            # enough (decimal scales, dates and bigints all share int64 —
+            # mixing them would compare raw representations)
+            same = lt.name == rt.name or (
+                lt.dtype == rt.dtype
+                and not lt.is_string and not rt.is_string
+                and not isinstance(lt, DecimalType)
+                and not isinstance(rt, DecimalType)
+                and lt.name not in ("date", "timestamp")
+                and rt.name not in ("date", "timestamp")
+            )
+            if not same:
+                raise AnalysisError(
+                    f"{q.kind.upper()} column {i + 1} type mismatch: "
+                    f"{lt} vs {rt}")
+        symbols = [self.symbols.fresh(n or f"col{i}")
+                   for i, n in enumerate(lout.names)]
+        node: PlanNode = SetOp(q.kind, q.all, lout, rout, symbols, ltypes)
+
+        # ORDER BY / LIMIT over the combined result (names or ordinals)
+        if q.order_by:
+            name_to_sym = dict(zip(lout.names, symbols))
+            keys = []
+            for oi in q.order_by:
+                if isinstance(oi.expr, ast.Literal) and oi.expr.kind == "integer":
+                    sym = symbols[int(oi.expr.value) - 1]
+                elif isinstance(oi.expr, ast.Identifier):
+                    nm = oi.expr.parts[-1]
+                    if nm not in name_to_sym:
+                        raise AnalysisError(f"ORDER BY column {nm} not in output")
+                    sym = name_to_sym[nm]
+                else:
+                    raise AnalysisError(
+                        "set-operation ORDER BY supports output columns only")
+                keys.append(SortItem(sym, oi.ascending, oi.nulls_first))
+            node = Sort(node, keys, q.limit)
+        elif q.limit is not None:
+            node = Limit(node, q.limit)
+        root = Output(node, list(lout.names), symbols)
+        return QueryPlan(root, self.scalar_subqueries)
+
     # -- query ------------------------------------------------------------
 
-    def plan(self, q: ast.Query) -> QueryPlan:
+    def plan(self, q) -> QueryPlan:
+        if isinstance(q, ast.SetOp):
+            return self.plan_setop(q)
         ctes = dict(self.ctes)
         for name, sub in q.ctes:
             ctes[name] = sub
@@ -864,28 +940,61 @@ class Planner:
         if len(leaves) == 1:
             return rp.node, scope, conjs
 
-        # greedy connected join ordering, smaller side builds
+        # Stats-driven greedy join ordering (CBO v1 — the role of
+        # ReorderJoins.java:94 with JoinStatsRule estimates): each leaf's
+        # cardinality is adjusted by the selectivity of its single-leaf
+        # WHERE conjuncts; each step joins the connected leaf minimizing the
+        # estimated intermediate; the smaller estimated side builds.
+        from presto_tpu.plan.stats import NodeStats, derive, filter_selectivity
+
+        def leaf_estimate(leaf: RelationPlan, pending) -> Tuple[float, Optional[NodeStats]]:
+            st = derive(leaf.node, self.catalog)
+            rows = st.rows if st is not None else leaf.rows
+            if st is not None:
+                syms = {f.symbol for f in leaf.scope.fields}
+                for c in pending:
+                    if expr_inputs(c) <= syms:
+                        rows *= filter_selectivity(c, st)
+            return max(rows, 1.0), st
+
+        def join_out_estimate(a_rows, a_st, a_keys, b_rows, b_st, b_keys) -> float:
+            ndvs = []
+            for ak, bk in zip(a_keys, b_keys):
+                for st, k in ((a_st, ak), (b_st, bk)):
+                    cs = st.col(k) if st is not None else None
+                    if cs is not None and cs.ndv:
+                        ndvs.append(cs.ndv)
+            if ndvs:
+                return max(1.0, a_rows * b_rows / max(ndvs))
+            return max(a_rows, b_rows)
+
         remaining = list(leaves)
-        # start from the largest relation (likely the fact table → probe side)
-        remaining.sort(key=lambda r: -r.rows)
-        current = remaining.pop(0)
         pending = list(conjs)
+        est = {id(l): leaf_estimate(l, pending) for l in remaining}
+        # start from the largest relation (likely the fact table → probe side)
+        remaining.sort(key=lambda r: -est[id(r)][0])
+        current = remaining.pop(0)
+        cur_rows, cur_st = est[id(current)]
         while remaining:
             cur_syms = {f.symbol for f in current.scope.fields}
             best = None
             for leaf in remaining:
                 leaf_syms = {f.symbol for f in leaf.scope.fields}
                 lkeys, rkeys, rest = _extract_equi_keys(pending, cur_syms, leaf_syms)
-                if lkeys:
-                    best = (leaf, lkeys, rkeys, rest)
-                    break
+                if not lkeys:
+                    continue
+                leaf_rows, leaf_st = est[id(leaf)]
+                out_rows = join_out_estimate(cur_rows, cur_st, lkeys,
+                                             leaf_rows, leaf_st, rkeys)
+                if best is None or out_rows < best[0]:
+                    best = (out_rows, leaf, lkeys, rkeys, rest, leaf_rows, leaf_st)
             if best is None:
                 raise AnalysisError("disconnected join graph (cross product) not supported")
-            leaf, lkeys, rkeys, rest = best
+            out_rows, leaf, lkeys, rkeys, rest, leaf_rows, leaf_st = best
             remaining.remove(leaf)
             # consumed conjuncts: pending minus rest
             pending = rest
-            if leaf.rows <= current.rows:
+            if leaf_rows <= cur_rows:
                 probe, build = current, leaf
                 pkeys, bkeys = lkeys, rkeys
             else:
@@ -896,8 +1005,14 @@ class Planner:
                 left_keys=pkeys, right_keys=bkeys,
                 build_unique=_derives_unique(build.node, bkeys),
             )
+            merged_cols = {}
+            for st in (cur_st, leaf_st):
+                if st is not None:
+                    merged_cols.update(st.columns)
+            cur_st = NodeStats(out_rows, merged_cols)
+            cur_rows = out_rows
             current = RelationPlan(node, probe.scope + build.scope,
-                                   rows=max(probe.rows, build.rows))
+                                   rows=out_rows)
         # apply any conjunct that is now fully covered; keep the rest as residuals
         return current.node, scope, pending
 
@@ -1098,10 +1213,6 @@ class Planner:
         for key, fc in aggs_by_key.items():
             fn = _AGG_CANON.get(fc.name.lower(), fc.name.lower())
             distinct = fc.distinct
-            if fn == "approx_distinct":
-                # exact count-distinct satisfies the approximation contract
-                # (reference would use HLL; the error here is simply 0)
-                fn, distinct = "count", True
             arg2_sym = None
             param = None
             if fc.is_star:
@@ -1148,8 +1259,14 @@ class Planner:
         seen = {s for s, _ in pre_exprs}
         pre = Project(node, pre_exprs) if pre_exprs else node
 
+        hll_aggs = [a for a in agg_specs if a.fn == "approx_distinct"]
         distinct_aggs = [a for a in agg_specs if a.distinct]
-        if distinct_aggs:
+        if hll_aggs:
+            if len(agg_specs) != 1:
+                raise AnalysisError(
+                    "approx_distinct mixed with other aggregates not supported yet")
+            agg_node = self._plan_hll(pre, group_syms, agg_specs[0], pre_exprs, node)
+        elif distinct_aggs:
             if len(agg_specs) != 1:
                 raise AnalysisError("mixed DISTINCT aggregates not supported yet")
             a = agg_specs[0]
@@ -1165,6 +1282,84 @@ class Planner:
         else:
             agg_node = Aggregate(pre, group_syms, agg_specs, step="single")
         return agg_node, repl
+
+    def _plan_hll(self, pre: PlanNode, group_syms, a: AggSpec, pre_exprs,
+                  raw_input: PlanNode) -> PlanNode:
+        """Lower approx_distinct(x) into HyperLogLog over existing plan
+        machinery (reference: ApproximateCountDistinctAggregations +
+        HyperLogLogState — but here registers ARE group-table rows, so the
+        sketch is mergeable/distributable through the ordinary partial →
+        exchange → final aggregate path with a fixed m-row footprint):
+
+          Project    reg  = __hll_reg(x)   (low bits of content hash)
+                     rank = __hll_rank(x)  (1 + clz of top hash bits)
+          Aggregate  group (keys…, reg):  r := max(rank)
+          Project    e := 2^-r
+          Aggregate  group (keys…):  c := count(r), s := sum(e)
+          Project    estimate := bias-corrected harmonic mean over m
+                     registers, with the small-range linear-counting
+                     correction (zeros = m - c).
+        """
+        from presto_tpu.expr.compile import HLL_M
+
+        if a.arg is None:
+            raise AnalysisError("approx_distinct requires an argument")
+        in_types = dict(pre.output)
+        arg_ref = InputRef(in_types[a.arg], a.arg)
+        reg = self.symbols.fresh("hll_reg")
+        rank = self.symbols.fresh("hll_rank")
+        lower = Project(pre, [(s, InputRef(t, s)) for s, t in pre.output] + [
+            (reg, Call(BIGINT, "__hll_reg", (arg_ref,))),
+            (rank, Call(BIGINT, "__hll_rank", (arg_ref,))),
+        ])
+        rmax = self.symbols.fresh("hll_r")
+        inner = Aggregate(lower, group_syms + [reg],
+                          [AggSpec(rmax, "max", rank, BIGINT)], step="single")
+        e_sym = self.symbols.fresh("hll_e")
+        inner_types = dict(inner.output)
+        mid = Project(inner, [(s, InputRef(inner_types[s], s))
+                              for s in group_syms + [rmax]] + [
+            (e_sym, Call(DOUBLE, "power",
+                         (Constant(DOUBLE, 2.0),
+                          Call(DOUBLE, "neg",
+                               (Call(DOUBLE, "cast",
+                                     (InputRef(BIGINT, rmax),)),))))),
+        ])
+        c_sym = self.symbols.fresh("hll_c")
+        s_sym = self.symbols.fresh("hll_s")
+        outer = Aggregate(mid, group_syms, [
+            AggSpec(c_sym, "count", rmax, BIGINT),
+            AggSpec(s_sym, "sum", e_sym, DOUBLE),
+        ], step="single")
+        # estimator: zeros = m - c; S = s + zeros; raw = α·m²/S;
+        # small range (raw ≤ 2.5m, zeros > 0): m·ln(m/zeros)
+        m = float(HLL_M)
+        alpha = 0.7213 / (1.0 + 1.079 / m)
+        c_ref = Call(DOUBLE, "cast", (InputRef(BIGINT, c_sym),))
+        zeros = Call(DOUBLE, "sub", (Constant(DOUBLE, m), c_ref))
+        # empty input: sum over zero rows is SQL NULL but approx_distinct
+        # must return 0 — coalesce keeps the estimator defined (all-zero
+        # registers → linear counting → m·ln(m/m) = 0)
+        s_safe = Call(DOUBLE, "coalesce",
+                      (InputRef(DOUBLE, s_sym), Constant(DOUBLE, 0.0)))
+        S = Call(DOUBLE, "add", (s_safe, zeros))
+        raw = Call(DOUBLE, "div",
+                   (Constant(DOUBLE, alpha * m * m), S))
+        small = Call(DOUBLE, "mul",
+                     (Constant(DOUBLE, m),
+                      Call(DOUBLE, "ln",
+                           (Call(DOUBLE, "div",
+                                 (Constant(DOUBLE, m), zeros)),))))
+        use_small = Call(BOOLEAN, "and", (
+            Call(BOOLEAN, "le", (raw, Constant(DOUBLE, 2.5 * m))),
+            Call(BOOLEAN, "gt", (zeros, Constant(DOUBLE, 0.0))),
+        ))
+        est = Call(BIGINT, "cast", (
+            Call(DOUBLE, "round",
+                 (Call(DOUBLE, "if", (use_small, small, raw)),)),))
+        outer_types = dict(outer.output)
+        return Project(outer, [(s, InputRef(outer_types[s], s))
+                               for s in group_syms] + [(a.symbol, est)])
 
 
 class _PendingCross(PlanNode):
@@ -1318,7 +1513,7 @@ def _agg_output_type(fn: str, arg_t: Type, is_star: bool) -> Type:
         return DOUBLE
     if fn in ("bool_and", "bool_or"):
         return BOOLEAN
-    if fn == "checksum":
+    if fn in ("checksum", "approx_distinct"):
         return BIGINT
     raise AnalysisError(f"unknown aggregate {fn}")
 
@@ -1328,5 +1523,6 @@ def plan_query(sql_or_ast, catalog: Catalog) -> QueryPlan:
     SqlQueryExecution.doAnalyzeQuery → LogicalPlanner.plan)."""
     from presto_tpu.sql.parser import parse_sql
 
-    q = sql_or_ast if isinstance(sql_or_ast, ast.Query) else parse_sql(sql_or_ast)
+    q = (sql_or_ast if isinstance(sql_or_ast, (ast.Query, ast.SetOp))
+         else parse_sql(sql_or_ast))
     return Planner(catalog).plan(q)

@@ -1,0 +1,260 @@
+"""JSON codec for plan fragments — the wire format of the control plane.
+
+Reference: the coordinator ships TaskUpdateRequest as JSON/Smile DTOs
+(server/remotetask/HttpRemoteTask.java + jackson codecs;
+InternalCommunicationConfig.java:92 binary option). The round-2 engine
+pickled fragments, which makes every secret-bearing client an RCE vector;
+this codec encodes the CLOSED plan-node vocabulary explicitly — unknown
+node/expression kinds are rejected on decode, and no arbitrary object
+construction is reachable from the wire.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from presto_tpu.expr.ir import Call, Constant, InputRef, Param, RowExpression
+from presto_tpu.plan.fragmenter import Fragment
+from presto_tpu.plan.nodes import (
+    Aggregate,
+    AggSpec,
+    Filter,
+    HashJoin,
+    Limit,
+    Output,
+    PlanNode,
+    Project,
+    RemoteSource,
+    SemiJoin,
+    SetOp,
+    Sort,
+    SortItem,
+    TableScan,
+    Window,
+    WindowFunc,
+)
+from presto_tpu.types import Type, parse_type
+
+
+class CodecError(ValueError):
+    pass
+
+
+# -- types ------------------------------------------------------------------
+
+
+def _t(t: Type) -> str:
+    return t.name
+
+
+def _untype(s: str) -> Type:
+    return parse_type(s)
+
+
+# -- expressions ------------------------------------------------------------
+
+
+def expr_to_json(e: RowExpression) -> Dict[str, Any]:
+    if isinstance(e, InputRef):
+        return {"k": "ref", "t": _t(e.type), "name": e.name}
+    if isinstance(e, Constant):
+        v = e.value
+        if v is not None and not isinstance(v, (bool, int, float, str)):
+            v = v.item() if hasattr(v, "item") else str(v)
+        return {"k": "const", "t": _t(e.type), "v": v, "raw": e.raw}
+    if isinstance(e, Call):
+        return {"k": "call", "t": _t(e.type), "fn": e.fn,
+                "args": [expr_to_json(a) for a in e.args]}
+    if isinstance(e, Param):
+        return {"k": "param", "t": _t(e.type), "name": e.name}
+    raise CodecError(f"unencodable expression {type(e).__name__}")
+
+
+def expr_from_json(d: Dict[str, Any]) -> RowExpression:
+    k = d.get("k")
+    t = _untype(d["t"])
+    if k == "ref":
+        return InputRef(t, d["name"])
+    if k == "const":
+        return Constant(t, d["v"], raw=bool(d.get("raw", False)))
+    if k == "call":
+        return Call(t, d["fn"], tuple(expr_from_json(a) for a in d["args"]))
+    if k == "param":
+        return Param(t, d["name"])
+    raise CodecError(f"unknown expression kind {k!r}")
+
+
+def _out(node_output) -> list:
+    return [[s, _t(t)] for s, t in node_output]
+
+
+def _unout(lst) -> list:
+    return [(s, _untype(t)) for s, t in lst]
+
+
+# -- plan nodes -------------------------------------------------------------
+
+
+def node_to_json(n: PlanNode) -> Dict[str, Any]:
+    if isinstance(n, TableScan):
+        return {"k": "scan", "catalog": n.catalog, "table": n.table,
+                "assignments": dict(n.assignments), "output": _out(n.output),
+                "constraints": {c: [lo, hi]
+                                for c, (lo, hi) in (n.constraints or {}).items()}}
+    if isinstance(n, Filter):
+        return {"k": "filter", "child": node_to_json(n.child),
+                "pred": expr_to_json(n.predicate)}
+    if isinstance(n, Project):
+        return {"k": "project", "child": node_to_json(n.child),
+                "exprs": [[s, expr_to_json(e)] for s, e in n.exprs]}
+    if isinstance(n, Aggregate):
+        return {"k": "agg", "child": node_to_json(n.child),
+                "keys": list(n.group_keys), "step": n.step,
+                "aggs": [{"symbol": a.symbol, "fn": a.fn, "arg": a.arg,
+                          "t": _t(a.type), "distinct": a.distinct,
+                          "arg2": a.arg2, "param": a.param}
+                         for a in n.aggs]}
+    if isinstance(n, HashJoin):
+        return {"k": "join", "kind": n.kind,
+                "left": node_to_json(n.left), "right": node_to_json(n.right),
+                "lkeys": list(n.left_keys), "rkeys": list(n.right_keys),
+                "residual": (expr_to_json(n.residual)
+                             if n.residual is not None else None),
+                "build_unique": n.build_unique}
+    if isinstance(n, SemiJoin):
+        return {"k": "semijoin", "negated": n.negated,
+                "null_aware": n.null_aware,
+                "left": node_to_json(n.left), "right": node_to_json(n.right),
+                "lkeys": list(n.left_keys), "rkeys": list(n.right_keys),
+                "residual": (expr_to_json(n.residual)
+                             if n.residual is not None else None)}
+    if isinstance(n, SetOp):
+        return {"k": "setop", "kind": n.kind, "all": n.all,
+                "left": node_to_json(n.left), "right": node_to_json(n.right),
+                "symbols": list(n.symbols), "types": [_t(t) for t in n.types]}
+    if isinstance(n, Sort):
+        return {"k": "sort", "child": node_to_json(n.child),
+                "keys": [[s.symbol, s.ascending, s.nulls_first]
+                         for s in n.keys],
+                "limit": n.limit}
+    if isinstance(n, Window):
+        return {"k": "window", "child": node_to_json(n.child),
+                "pkeys": list(n.partition_keys),
+                "okeys": [[s.symbol, s.ascending, s.nulls_first]
+                          for s in n.order_items],
+                "funcs": [{"symbol": f.symbol, "fn": f.fn, "t": _t(f.type),
+                           "arg": f.arg, "param": f.param, "frame": f.frame}
+                          for f in n.funcs]}
+    if isinstance(n, Limit):
+        return {"k": "limit", "child": node_to_json(n.child), "count": n.count}
+    if isinstance(n, Output):
+        return {"k": "output", "child": node_to_json(n.child),
+                "names": list(n.names), "symbols": list(n.symbols)}
+    if isinstance(n, RemoteSource):
+        return {"k": "remote", "fid": n.fragment_id, "output": _out(n.output)}
+    raise CodecError(f"unencodable plan node {type(n).__name__}")
+
+
+def node_from_json(d: Dict[str, Any]) -> PlanNode:
+    k = d.get("k")
+    if k == "scan":
+        return TableScan(
+            catalog=d["catalog"], table=d["table"],
+            assignments=dict(d["assignments"]), output=_unout(d["output"]),
+            constraints={c: (lo, hi)
+                         for c, (lo, hi) in (d.get("constraints") or {}).items()},
+        )
+    if k == "filter":
+        return Filter(node_from_json(d["child"]), expr_from_json(d["pred"]))
+    if k == "project":
+        return Project(node_from_json(d["child"]),
+                       [(s, expr_from_json(e)) for s, e in d["exprs"]])
+    if k == "agg":
+        return Aggregate(
+            node_from_json(d["child"]), list(d["keys"]),
+            [AggSpec(a["symbol"], a["fn"], a["arg"], _untype(a["t"]),
+                     bool(a.get("distinct", False)), a.get("arg2"),
+                     a.get("param")) for a in d["aggs"]],
+            step=d.get("step", "single"),
+        )
+    if k == "join":
+        return HashJoin(
+            kind=d["kind"], left=node_from_json(d["left"]),
+            right=node_from_json(d["right"]),
+            left_keys=list(d["lkeys"]), right_keys=list(d["rkeys"]),
+            residual=(expr_from_json(d["residual"])
+                      if d.get("residual") is not None else None),
+            build_unique=bool(d.get("build_unique", False)),
+        )
+    if k == "semijoin":
+        return SemiJoin(
+            left=node_from_json(d["left"]), right=node_from_json(d["right"]),
+            left_keys=list(d["lkeys"]), right_keys=list(d["rkeys"]),
+            negated=bool(d.get("negated", False)),
+            residual=(expr_from_json(d["residual"])
+                      if d.get("residual") is not None else None),
+            null_aware=bool(d.get("null_aware", True)),
+        )
+    if k == "setop":
+        return SetOp(d["kind"], bool(d["all"]), node_from_json(d["left"]),
+                     node_from_json(d["right"]), list(d["symbols"]),
+                     [_untype(t) for t in d["types"]])
+    if k == "sort":
+        return Sort(node_from_json(d["child"]),
+                    [SortItem(s, bool(a), nf) for s, a, nf in d["keys"]],
+                    limit=d.get("limit"))
+    if k == "window":
+        return Window(
+            node_from_json(d["child"]), list(d["pkeys"]),
+            [SortItem(s, bool(a), nf) for s, a, nf in d["okeys"]],
+            [WindowFunc(f["symbol"], f["fn"], _untype(f["t"]), f.get("arg"),
+                        f.get("param"), f.get("frame")) for f in d["funcs"]],
+        )
+    if k == "limit":
+        return Limit(node_from_json(d["child"]), int(d["count"]))
+    if k == "output":
+        return Output(node_from_json(d["child"]), list(d["names"]),
+                      list(d["symbols"]))
+    if k == "remote":
+        return RemoteSource(fragment_id=int(d["fid"]),
+                            output=_unout(d["output"]))
+    raise CodecError(f"unknown plan node kind {k!r}")
+
+
+# -- fragments + task updates ----------------------------------------------
+
+
+def fragment_to_json(f: Fragment) -> Dict[str, Any]:
+    return {"fid": f.fid, "root": node_to_json(f.root),
+            "partitioning": f.partitioning,
+            "output_partitioning": f.output_partitioning,
+            "output_keys": list(f.output_keys)}
+
+
+def fragment_from_json(d: Dict[str, Any]) -> Fragment:
+    return Fragment(
+        fid=int(d["fid"]), root=node_from_json(d["root"]),
+        partitioning=d["partitioning"],
+        output_partitioning=d["output_partitioning"],
+        output_keys=list(d.get("output_keys") or []),
+    )
+
+
+def task_update_to_json(u) -> Dict[str, Any]:
+    return {"fragment": fragment_to_json(u.fragment),
+            "task_index": u.task_index, "n_tasks": u.n_tasks,
+            "n_out_partitions": u.n_out_partitions,
+            "upstreams": {str(k): list(v) for k, v in u.upstreams.items()},
+            "config": dict(u.config)}
+
+
+def task_update_from_json(d: Dict[str, Any]):
+    from presto_tpu.server.worker import TaskUpdate
+
+    return TaskUpdate(
+        fragment=fragment_from_json(d["fragment"]),
+        task_index=int(d["task_index"]), n_tasks=int(d["n_tasks"]),
+        n_out_partitions=int(d["n_out_partitions"]),
+        upstreams={int(k): list(v) for k, v in d["upstreams"].items()},
+        config=dict(d.get("config") or {}),
+    )

@@ -30,6 +30,7 @@ from presto_tpu.plan.nodes import (
     QueryPlan,
     RemoteSource,
     SemiJoin,
+    SetOp,
     Sort,
     TableScan,
     Window,
@@ -90,7 +91,17 @@ class _Fragmenter:
         self._next = 0
         self.broadcast_threshold = broadcast_threshold_rows
         # optional row-count estimator (CBO hook): node -> Optional[float]
-        self.stats_fn = stats_fn or (lambda n: estimate_rows(n, catalog))
+        if stats_fn is None:
+            def stats_fn(n, _catalog=catalog):
+                # CBO-derived estimate (StatsCalculator analog); the legacy
+                # fixed-selectivity walk is the no-statistics fallback
+                from presto_tpu.plan.stats import derive
+
+                s = derive(n, _catalog)
+                if s is not None:
+                    return s.rows
+                return estimate_rows(n, _catalog)
+        self.stats_fn = stats_fn
 
     def cut(self, root: PlanNode, partitioning: str,
             out_part: str, keys: Optional[List[str]] = None) -> RemoteSource:
@@ -137,9 +148,15 @@ class _Fragmenter:
             build_rows = self.stats_fn(node.right)
             left, lpart = self.process(node.left)
             right, rpart = self.process(node.right)
-            if build_rows is not None and build_rows <= self.broadcast_threshold:
+            if (build_rows is not None
+                    and build_rows <= self.broadcast_threshold
+                    and node.kind != "full"):
                 # BROADCAST join (DetermineJoinDistributionType REPLICATED):
-                # build side is replicated to every probe task
+                # build side is replicated to every probe task. FULL OUTER
+                # must NOT broadcast — every task would re-emit the same
+                # unmatched build rows; hash partitioning gives each build
+                # row exactly one owner (LookupJoinOperators.fullOuterJoin
+                # is likewise partitioned-only in the reference)
                 if rpart == SINGLE and lpart == SINGLE:
                     node.left, node.right = left, right
                     return node, SINGLE
@@ -191,6 +208,22 @@ class _Fragmenter:
             partial = Limit(child, node.count)
             node.child = self.cut(partial, cpart, OUT_GATHER)
             return node, SINGLE
+        if isinstance(node, SetOp):
+            # children gather to the set-op task (UNION ALL could stream
+            # per-task; DISTINCT variants need global visibility — start
+            # with the simple correct shape for all kinds)
+            left, lpart = self.process(node.left)
+            right, rpart = self.process(node.right)
+            node.left = left if lpart == SINGLE else self.cut(left, lpart, OUT_GATHER)
+            node.right = (right if rpart == SINGLE
+                          else self.cut(right, rpart, OUT_GATHER))
+            return node, SINGLE
+        if isinstance(node, Output):
+            # nested Output (set-operation children are whole sub-plans):
+            # keep the projection wrapper, fragment through it
+            child, cpart = self.process(node.child)
+            node.child = child
+            return node, cpart
         if isinstance(node, RemoteSource):
             return node, SINGLE
         raise NotImplementedError(f"fragmenter: {type(node).__name__}")
@@ -225,6 +258,12 @@ def estimate_rows(node: PlanNode, catalog=None) -> Optional[float]:
         return estimate_rows(node.left, catalog)
     if isinstance(node, SemiJoin):
         return estimate_rows(node.left, catalog)
+    if isinstance(node, SetOp):
+        a = estimate_rows(node.left, catalog)
+        b = estimate_rows(node.right, catalog)
+        if a is None or b is None:
+            return None
+        return a + b
     return None
 
 

@@ -159,6 +159,31 @@ class SemiJoin(PlanNode):
 
 
 @dataclasses.dataclass
+class SetOp(PlanNode):
+    """UNION [ALL] / INTERSECT / EXCEPT (reference: planner/plan/UnionNode,
+    IntersectNode, ExceptNode + SetOperationNodeTranslator rewrites).
+
+    Both children produce `arity` columns; the executor renames each
+    child's output positionally onto `symbols` (types taken from the left
+    child). DISTINCT variants dedup/membership-test with NULLs-equal
+    semantics after aligning string dictionaries."""
+
+    kind: str  # 'union' | 'intersect' | 'except'
+    all: bool
+    left: PlanNode
+    right: PlanNode
+    symbols: List[str]
+    types: List[Type]
+
+    @property
+    def output(self):
+        return list(zip(self.symbols, self.types))
+
+    def children(self):
+        return [self.left, self.right]
+
+
+@dataclasses.dataclass
 class SortItem:
     symbol: str
     ascending: bool = True
@@ -270,6 +295,8 @@ def plan_to_string(node: PlanNode, indent: int = 0, node_stats=None) -> str:
     elif isinstance(node, SemiJoin):
         s = (f"{pad}SemiJoin[{'NOT ' if node.negated else ''}{node.left_keys} IN "
              f"{node.right_keys}{f'; residual={node.residual}' if node.residual else ''}]")
+    elif isinstance(node, SetOp):
+        s = f"{pad}SetOp[{node.kind}{' all' if node.all else ''}]"
     elif isinstance(node, Sort):
         keys = ", ".join(f"{k.symbol}{'' if k.ascending else ' desc'}" for k in node.keys)
         s = f"{pad}Sort[{keys}{f'; limit={node.limit}' if node.limit else ''}]"

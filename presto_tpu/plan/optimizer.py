@@ -96,7 +96,11 @@ def _push_into(node: PlanNode, conjs: List[RowExpression]) -> PlanNode:
         lpush, rpush, kept = [], [], []
         for c in conjs:
             ins = expr_inputs(c)
-            if ins <= lsyms:
+            if ins <= lsyms and node.kind != "full":
+                # probe-side push is fine for INNER and LEFT (probe rows
+                # keep their own values); NOT for FULL — the build
+                # remainder's NULL probe columns must be filtered
+                # post-join, and pre-join evaluation can't see them
                 lpush.append(c)
             elif ins <= rsyms and node.kind == "inner":
                 rpush.append(c)
@@ -280,10 +284,15 @@ def cleanup(node: PlanNode) -> PlanNode:
 
 def optimize(plan: QueryPlan) -> QueryPlan:
     """Run the pass pipeline (reference: PlanOptimizers.java:146 ordering)."""
+    from presto_tpu.plan.stats import invalidate
+
     root = plan.root
     root.child = push_filters(root.child)
     prune_columns(root, set(root.symbols))
     root.child = cleanup(root.child)
+    # builder-time stats memos are stale once filters/pruning rewrote the
+    # tree; later consumers (fragmenter, capacity planner) re-derive
+    invalidate(root)
     for sub in plan.scalar_subqueries.values():
         optimize(sub)
     return plan

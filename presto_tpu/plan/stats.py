@@ -1,0 +1,280 @@
+"""Cost-based statistics derivation — CBO v1.
+
+Reference: presto-main/.../cost/ (44 files): StatsCalculator walks the plan
+deriving PlanNodeStatsEstimate per node; FilterStatsCalculator estimates
+conjunct selectivities from column NDV/range stats; JoinStatsRule estimates
+join output as |L|·|R| / max(NDV); consumed by ReorderJoins.java:94 and
+DetermineJoinDistributionType.java:46.
+
+TPU-native shape: connectors supply ColumnStats (NDV, null fraction,
+min/max — exact for the generator connectors, footer-derived for parquet).
+`derive(node)` recursively computes (rows, per-symbol ColumnStats),
+memoized on the node. Consumers: join ordering (builder._assemble_joins),
+broadcast-vs-partitioned choice (fragmenter stats_fn), and group-table
+capacity selection (Aggregate.estimated_groups → ExecConfig.agg_capacity
+override)."""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, Optional
+
+from presto_tpu.connector import ColumnStats
+from presto_tpu.expr.ir import Call, Constant, InputRef, RowExpression
+from presto_tpu.plan.nodes import (
+    Aggregate,
+    Filter,
+    HashJoin,
+    Limit,
+    Output,
+    PlanNode,
+    Project,
+    RemoteSource,
+    SemiJoin,
+    SetOp,
+    Sort,
+    TableScan,
+    Window,
+)
+
+# fallback selectivities when column stats can't answer (the reference's
+# FilterStatsCalculator UNKNOWN_FILTER_COEFFICIENT is 0.9; we keep the
+# legacy engine defaults, which are tuned for TPC-H-ish predicates)
+UNKNOWN_FILTER_SEL = 0.25
+UNKNOWN_EQ_SEL = 0.1
+
+
+@dataclasses.dataclass
+class NodeStats:
+    rows: float
+    columns: Dict[str, ColumnStats] = dataclasses.field(default_factory=dict)
+
+    def col(self, sym: str) -> Optional[ColumnStats]:
+        return self.columns.get(sym)
+
+
+def _scalar(v) -> Optional[float]:
+    try:
+        return float(v)
+    except (TypeError, ValueError):
+        return None
+
+
+def _range_fraction(cs: ColumnStats, lo: Optional[float], hi: Optional[float]) -> Optional[float]:
+    """Fraction of the [min,max] range covered by [lo,hi] (uniform model —
+    FilterStatsCalculator's range estimate)."""
+    if cs.min_value is None or cs.max_value is None:
+        return None
+    width = cs.max_value - cs.min_value
+    if width <= 0:
+        return 1.0
+    a = cs.min_value if lo is None else max(lo, cs.min_value)
+    b = cs.max_value if hi is None else min(hi, cs.max_value)
+    if b < a:
+        return 0.0
+    return min(1.0, (b - a) / width)
+
+
+def _conjunct_selectivity(e: RowExpression, stats: NodeStats) -> float:
+    if isinstance(e, Call):
+        fn = e.fn
+        if fn == "and":
+            return (_conjunct_selectivity(e.args[0], stats)
+                    * _conjunct_selectivity(e.args[1], stats))
+        if fn == "or":
+            a = _conjunct_selectivity(e.args[0], stats)
+            b = _conjunct_selectivity(e.args[1], stats)
+            return min(1.0, a + b - a * b)
+        if fn == "not":
+            return max(0.0, 1.0 - _conjunct_selectivity(e.args[0], stats))
+        ref = next((a for a in e.args if isinstance(a, InputRef)), None)
+        const = next((a for a in e.args if isinstance(a, Constant)), None)
+        cs = stats.col(ref.name) if ref is not None else None
+        if fn == "eq" and cs is not None and cs.ndv:
+            return min(1.0, 1.0 / cs.ndv)
+        if fn == "ne" and cs is not None and cs.ndv:
+            return max(0.0, 1.0 - 1.0 / cs.ndv)
+        if fn in ("lt", "le", "gt", "ge") and cs is not None and const is not None:
+            v = _scalar(const.value)
+            if v is not None:
+                frac = (_range_fraction(cs, None, v) if fn in ("lt", "le")
+                        else _range_fraction(cs, v, None))
+                if frac is not None:
+                    return frac
+        if fn == "between" and cs is not None and len(e.args) == 3:
+            lo = _scalar(e.args[1].value) if isinstance(e.args[1], Constant) else None
+            hi = _scalar(e.args[2].value) if isinstance(e.args[2], Constant) else None
+            frac = _range_fraction(cs, lo, hi)
+            if frac is not None:
+                return frac
+        if fn == "in":
+            k = max(1, len(e.args) - 1)
+            if cs is not None and cs.ndv:
+                return min(1.0, k / cs.ndv)
+            return min(1.0, k * UNKNOWN_EQ_SEL)
+        if fn == "is_null":
+            return cs.null_fraction if cs is not None and cs.null_fraction is not None else 0.05
+        if fn == "is_not_null":
+            nf = cs.null_fraction if cs is not None and cs.null_fraction is not None else 0.05
+            return 1.0 - nf
+        if fn == "eq":
+            return UNKNOWN_EQ_SEL
+        if fn == "like":
+            return UNKNOWN_FILTER_SEL
+    return UNKNOWN_FILTER_SEL
+
+
+def filter_selectivity(pred: RowExpression, stats: NodeStats) -> float:
+    return max(1e-6, min(1.0, _conjunct_selectivity(pred, stats)))
+
+
+def _scale_ndv(cs: ColumnStats, factor: float) -> ColumnStats:
+    """NDV after keeping `factor` of rows (capped at NDV — the reference
+    caps distinct counts by output rows the same way)."""
+    ndv = cs.ndv
+    if ndv is not None and factor < 1.0:
+        # uniform-draw model: expected distinct after sampling
+        ndv = ndv * (1.0 - math.exp(-max(factor, 1e-9)))
+        ndv = max(1.0, min(cs.ndv, ndv / (1.0 - math.exp(-1.0))))
+    return ColumnStats(ndv, cs.null_fraction, cs.min_value, cs.max_value)
+
+
+def derive(node: PlanNode, catalog) -> Optional[NodeStats]:
+    """Recursive memoized stats derivation (StatsCalculator.getStats)."""
+    memo = node.__dict__.get("_node_stats", "__unset__")
+    if memo != "__unset__":
+        return memo
+    s = _derive(node, catalog)
+    node.__dict__["_node_stats"] = s
+    return s
+
+
+def invalidate(node: PlanNode):
+    node.__dict__.pop("_node_stats", None)
+    for c in node.children():
+        invalidate(c)
+
+
+def _derive(node: PlanNode, catalog) -> Optional[NodeStats]:
+    if isinstance(node, TableScan):
+        if catalog is None:
+            return None
+        try:
+            conn = catalog.connectors[node.catalog]
+            handle = conn.get_table(node.table)
+        except Exception:
+            return None
+        rows = float(handle.row_count or 0) or 1e6
+        cols = {}
+        for sym, cname in node.assignments.items():
+            try:
+                ci = handle.column(cname)
+            except KeyError:
+                continue
+            if ci.stats is not None:
+                cols[sym] = ci.stats
+            elif ci.dictionary is not None:
+                cols[sym] = ColumnStats(ndv=float(len(ci.dictionary)))
+        if handle.primary_key and len(handle.primary_key) == 1:
+            pk = handle.primary_key[0]
+            for sym, cname in node.assignments.items():
+                if cname == pk:
+                    prev = cols.get(sym) or ColumnStats()
+                    cols[sym] = dataclasses.replace(
+                        prev, ndv=rows, null_fraction=0.0)
+        # NOTE: scan `constraints` are split-pruning hints extracted from a
+        # Filter that REMAINS in the plan — scaling here too would double
+        # count the selectivity (the Filter rule above accounts for it)
+        return NodeStats(rows, cols)
+    if isinstance(node, Filter):
+        child = derive(node.child, catalog)
+        if child is None:
+            return None
+        sel = filter_selectivity(node.predicate, child)
+        return NodeStats(max(1.0, child.rows * sel),
+                         {k: _scale_ndv(v, sel) for k, v in child.columns.items()})
+    if isinstance(node, Project):
+        child = derive(node.child, catalog)
+        if child is None:
+            return None
+        cols = {}
+        for sym, e in node.exprs:
+            if isinstance(e, InputRef) and e.name in child.columns:
+                cols[sym] = child.columns[e.name]
+        return NodeStats(child.rows, cols)
+    if isinstance(node, HashJoin):
+        left = derive(node.left, catalog)
+        right = derive(node.right, catalog)
+        if left is None or right is None:
+            return None
+        ndvs = []
+        for lk, rk in zip(node.left_keys, node.right_keys):
+            lc, rc = left.col(lk), right.col(rk)
+            if lc is not None and lc.ndv:
+                ndvs.append(lc.ndv)
+            if rc is not None and rc.ndv:
+                ndvs.append(rc.ndv)
+        if ndvs:
+            out_rows = left.rows * right.rows / max(ndvs)
+        else:
+            out_rows = max(left.rows, right.rows)
+        if node.kind in ("left", "full"):
+            out_rows = max(out_rows, left.rows)
+        if node.kind == "full":
+            out_rows = out_rows + right.rows * 0.1
+        cols = dict(left.columns)
+        cols.update(right.columns)
+        return NodeStats(max(1.0, out_rows), cols)
+    if isinstance(node, SemiJoin):
+        left = derive(node.left, catalog)
+        if left is None:
+            return None
+        sel = 0.5
+        return NodeStats(max(1.0, left.rows * sel), left.columns)
+    if isinstance(node, Aggregate):
+        child = derive(node.child, catalog)
+        if child is None:
+            return None
+        if not node.group_keys:
+            return NodeStats(1.0, {})
+        prod = 1.0
+        known = True
+        for k in node.group_keys:
+            cs = child.col(k)
+            if cs is not None and cs.ndv:
+                prod *= cs.ndv
+            else:
+                known = False
+        groups = min(prod, child.rows) if known else max(1.0, child.rows * 0.1)
+        cols = {k: child.columns[k] for k in node.group_keys if k in child.columns}
+        return NodeStats(max(1.0, groups), cols)
+    if isinstance(node, SetOp):
+        left = derive(node.left, catalog)
+        right = derive(node.right, catalog)
+        if left is None or right is None:
+            return None
+        rows = left.rows + right.rows
+        if node.kind == "intersect":
+            rows = min(left.rows, right.rows)
+        elif node.kind == "except":
+            rows = left.rows
+        return NodeStats(rows, {})
+    if isinstance(node, (Sort, Window)):
+        child = derive(node.child, catalog)
+        if child is None:
+            return None
+        if isinstance(node, Sort) and node.limit is not None:
+            return NodeStats(min(float(node.limit), child.rows), child.columns)
+        return NodeStats(child.rows, child.columns)
+    if isinstance(node, Limit):
+        child = derive(node.child, catalog)
+        rows = float(node.count)
+        if child is not None:
+            rows = min(rows, child.rows)
+        return NodeStats(rows, child.columns if child else {})
+    if isinstance(node, Output):
+        return derive(node.child, catalog)
+    if isinstance(node, RemoteSource):
+        return None
+    return None

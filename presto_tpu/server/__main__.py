@@ -1,0 +1,148 @@
+"""Standalone cluster launchers:
+
+    python -m presto_tpu.server --coordinator --port 8080 \
+        --catalog tpch:sf=1 [--min-workers 2] [--secret S]
+    python -m presto_tpu.server --worker --coordinator-url http://host:8080 \
+        --catalog tpch:sf=1 [--node-id w1] [--secret S]
+
+Reference: server/PrestoServer.java:69-119 — one binary, role decided by
+config (coordinator=true/false); here by flag. Workers announce to the
+coordinator (airlift discovery analog) and serve the /v1/task data plane;
+the coordinator serves /v1/statement + introspection and schedules
+fragments. Both sides must be configured with the same catalogs (the
+reference distributes etc/catalog/*.properties the same way).
+
+Catalog specs (repeatable --catalog):
+    tpch:sf=<N>           deterministic TPC-H generator connector
+    tpcds:sf=<N>          deterministic TPC-DS generator connector
+    parquet:dir=<path>    directory of <table>.parquet files
+    memory:               empty in-memory connector
+Optionally prefix with a name: `--catalog warehouse=parquet:dir=/data`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import sys
+import time
+
+
+def build_catalog(specs):
+    from presto_tpu.connector import Catalog
+
+    cat = Catalog()
+    if not specs:
+        specs = ["tpch:sf=0.01"]
+    for i, spec in enumerate(specs):
+        name = None
+        if "=" in spec.split(":", 1)[0]:
+            name, spec = spec.split("=", 1)
+        kind, _, argstr = spec.partition(":")
+        args = {}
+        for kv in filter(None, argstr.split(",")):
+            k, _, v = kv.partition("=")
+            args[k] = v
+        if kind == "tpch":
+            from presto_tpu.catalog.tpch import TpchConnector
+
+            conn = TpchConnector(float(args.get("sf", 1.0)))
+        elif kind == "tpcds":
+            from presto_tpu.catalog.tpcds import TpcdsConnector
+
+            conn = TpcdsConnector(float(args.get("sf", 1.0)))
+        elif kind == "parquet":
+            from presto_tpu.catalog.parquet import ParquetConnector
+
+            conn = ParquetConnector(args["dir"])
+        elif kind == "memory":
+            from presto_tpu.catalog.memory import MemoryConnector
+
+            conn = MemoryConnector()
+        else:
+            raise SystemExit(f"unknown catalog kind: {kind}")
+        cat.register(name or kind, conn, default=(i == 0))
+    return cat
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(prog="python -m presto_tpu.server")
+    role = p.add_mutually_exclusive_group(required=True)
+    role.add_argument("--coordinator", action="store_true")
+    role.add_argument("--worker", action="store_true")
+    p.add_argument("--port", type=int, default=0,
+                   help="listen port (0 = ephemeral, printed on start)")
+    p.add_argument("--catalog", action="append", default=[],
+                   help="catalog spec, repeatable (see module docstring)")
+    p.add_argument("--coordinator-url", default=None,
+                   help="(worker) coordinator to announce to")
+    p.add_argument("--node-id", default=None, help="(worker) node id")
+    p.add_argument("--min-workers", type=int, default=1)
+    p.add_argument("--secret", default=None,
+                   help="shared cluster secret for task endpoints")
+    p.add_argument("--batch-rows", type=int, default=1 << 17)
+    p.add_argument("--memory-pool-bytes", type=int, default=None)
+    p.add_argument("--spill-dir", default=None)
+    p.add_argument("--platform", default=None,
+                   help="jax platform override (e.g. cpu, tpu) — the site "
+                        "config may ignore the JAX_PLATFORMS env var")
+    args = p.parse_args(argv)
+
+    if args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
+
+    catalog = build_catalog(args.catalog)
+
+    if args.coordinator:
+        from presto_tpu.exec.runtime import ExecConfig
+        from presto_tpu.server.coordinator import Coordinator
+
+        coord = Coordinator(
+            catalog, port=args.port,
+            config=ExecConfig(batch_rows=args.batch_rows,
+                              memory_pool_bytes=args.memory_pool_bytes,
+                              spill_dir=args.spill_dir),
+            min_workers=args.min_workers,
+            cluster_secret=args.secret,
+        )
+        print(f"coordinator listening on {coord.url}", flush=True)
+        stop = []
+        signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
+        try:
+            while not stop:
+                time.sleep(0.5)
+        except KeyboardInterrupt:
+            pass
+        coord.close()
+        return 0
+
+    import socket
+
+    from presto_tpu.server.worker import Worker
+
+    node_id = args.node_id or f"worker-{socket.gethostname()}-{args.port}"
+    w = Worker(
+        catalog, node_id=node_id, port=args.port,
+        coordinator_url=args.coordinator_url,
+        memory_pool_bytes=args.memory_pool_bytes,
+        spill_dir=args.spill_dir,
+        cluster_secret=args.secret,
+    )
+    print(f"worker {node_id} listening on {w.url}"
+          + (f", announcing to {args.coordinator_url}"
+             if args.coordinator_url else ""), flush=True)
+    stop = []
+    signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
+    try:
+        while not stop and w.node_state != "shut_down":
+            time.sleep(0.5)
+    except KeyboardInterrupt:
+        pass
+    w.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

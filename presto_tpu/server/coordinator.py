@@ -21,7 +21,6 @@ through the pull exchange.
 from __future__ import annotations
 
 import json
-import pickle
 import re
 import threading
 import time
@@ -234,10 +233,12 @@ class DistributedScheduler:
         try:
             # producers first (ascending fid = topological order)
             for tid, w, update in assignments:
-                body = pickle.dumps(update)
+                from presto_tpu.plan.codec import task_update_to_json
+
+                body = json.dumps(task_update_to_json(update)).encode()
                 req = urllib.request.Request(
                     f"{w.uri}/v1/task/{tid}", data=body, method="POST",
-                    headers=self._headers({"Content-Type": "application/x-pickle"}),
+                    headers=self._headers({"Content-Type": "application/json"}),
                 )
                 with urllib.request.urlopen(req, timeout=30) as r:
                     info = json.loads(r.read())

@@ -13,16 +13,15 @@ Reference surface:
 TPU-native shape: a task executes one plan fragment as a stream of
 fixed-capacity device batches (exec/runtime); the task's sink serializes
 output pages into an OutputBuffer partitioned for the consumer stage
-(hash / broadcast / gather). Fragments arrive pickled — the
-coordinator↔worker boundary is a trusted intra-cluster channel, exactly
-like the reference's Java-serialized-ish JSON/Smile plan fragments.
+(hash / broadcast / gather). Fragments arrive as JSON over the closed
+plan-node vocabulary (plan/codec.py) — the TaskUpdateRequest JSON/Smile
+codec analog; nothing on the wire can execute code.
 """
 
 from __future__ import annotations
 
 import dataclasses
 import json
-import pickle
 import re
 import threading
 import traceback
@@ -224,9 +223,10 @@ class Worker:
 
         self.catalog = catalog
         self.node_id = node_id
-        # Intra-cluster auth: task bodies arrive pickled (trusted channel like
-        # the reference's Java-deserialized plan fragments), so mutating
-        # endpoints require the shared cluster secret when one is configured.
+        # Intra-cluster auth: mutating endpoints require the shared cluster
+        # secret when one is configured; task bodies are JSON over the
+        # closed plan-node vocabulary (plan/codec.py — TaskUpdateRequest
+        # analog), so no code execution is reachable from the wire.
         self.cluster_secret = cluster_secret
         self.memory_pool = MemoryPool(memory_pool_bytes,
                                       revoke_threshold=revoke_threshold,
@@ -270,7 +270,16 @@ class Worker:
                     if not self._authorized():
                         return self._json({"error": "unauthorized"}, 403)
                     n = int(self.headers.get("Content-Length", 0))
-                    update = pickle.loads(self.rfile.read(n))
+                    from presto_tpu.plan.codec import (
+                        CodecError, task_update_from_json,
+                    )
+
+                    try:
+                        update = task_update_from_json(
+                            json.loads(self.rfile.read(n)))
+                    except (CodecError, KeyError, TypeError, ValueError) as e:
+                        return self._json({"error": f"bad task update: {e}"},
+                                          400)
                     info = worker.task_manager.update_task(m.group(1), update)
                     return self._json(info)
                 self._json({"error": "not found"}, 404)

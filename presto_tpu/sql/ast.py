@@ -200,3 +200,19 @@ class Query(Node):
     order_by: List[OrderItem] = dataclasses.field(default_factory=list)
     limit: Optional[int] = None
     ctes: List[Tuple[str, "Query"]] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class SetOp(Node):
+    """UNION [ALL] / INTERSECT / EXCEPT of two query bodies
+    (SqlBase.g4:802 queryTerm; reference planner/plan/UnionNode,
+    IntersectNode, ExceptNode). `order_by`/`limit` apply to the combined
+    result; `ctes` from an enclosing WITH scope both sides."""
+
+    kind: str  # 'union' | 'intersect' | 'except'
+    all: bool
+    left: Node  # Query | SetOp
+    right: Node
+    order_by: List[OrderItem] = dataclasses.field(default_factory=list)
+    limit: Optional[int] = None
+    ctes: List[Tuple[str, "Query"]] = dataclasses.field(default_factory=list)

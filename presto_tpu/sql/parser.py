@@ -24,6 +24,7 @@ _KEYWORDS = {
     "join", "inner", "left", "right", "full", "outer", "cross", "on",
     "distinct", "all", "asc", "desc", "nulls", "first", "last", "exists",
     "date", "interval", "day", "month", "year", "extract", "with", "union",
+    "intersect", "except",
     "substring", "for", "over", "partition", "rows", "range", "unbounded",
     "preceding", "following", "current", "row",
 }
@@ -152,9 +153,86 @@ class Parser:
                 ctes.append((name, sub))
                 if not self.accept_op(","):
                     break
-        q = self.parse_query_body()
+        q = self.parse_set_expr()
         q.ctes = ctes
         return q
+
+    def parse_set_expr(self):
+        """queryTerm (UNION [ALL|DISTINCT] | EXCEPT) queryTerm — INTERSECT
+        binds tighter (SqlBase.g4:802 precedence). A trailing ORDER BY/LIMIT
+        parsed by the rightmost body applies to the whole set operation."""
+        left = self.parse_intersect_term()
+        while True:
+            if self.accept_kw("union"):
+                kind = "union"
+            elif self.accept_kw("except"):
+                kind = "except"
+            else:
+                break
+            all_ = bool(self.accept_kw("all"))
+            if not all_:
+                self.accept_kw("distinct")
+            if kind == "except" and all_:
+                raise ParseError("EXCEPT ALL is not supported")
+            right = self.parse_intersect_term()
+            left = ast.SetOp(kind, all_, left, right)
+        if isinstance(left, ast.SetOp):
+            left.order_by, left.limit = self._steal_order_limit(left)
+            # a parenthesized rightmost operand keeps its own clauses; a
+            # trailing ORDER BY/LIMIT may still follow the set op itself
+            if not left.order_by and self.accept_kw("order"):
+                self.expect_kw("by")
+                left.order_by.append(self.parse_order_item())
+                while self.accept_op(","):
+                    left.order_by.append(self.parse_order_item())
+            if left.limit is None and self.accept_kw("limit"):
+                t = self.next()
+                if t.kind != "number":
+                    raise ParseError("LIMIT expects a number")
+                left.limit = int(t.value)
+        return left
+
+    def parse_intersect_term(self):
+        left = self.parse_query_term()
+        while self.accept_kw("intersect"):
+            if self.accept_kw("all"):
+                raise ParseError("INTERSECT ALL is not supported")
+            self.accept_kw("distinct")
+            right = self.parse_query_term()
+            left = ast.SetOp("intersect", False, left, right)
+        return left
+
+    def parse_query_term(self):
+        if (self.peek().kind == "op" and self.peek().value == "("
+                and self._peek2_is_query()):
+            self.expect_op("(")
+            q = self.parse_query()
+            self.expect_op(")")
+            q._parenthesized = True  # its ORDER BY/LIMIT is its own
+            return q
+        return self.parse_query_body()
+
+    def _peek2_is_query(self) -> bool:
+        # skip any depth of opening parens: "((select ..." is a query term
+        ahead = 1
+        t = self.peek(ahead)
+        while t.kind == "op" and t.value == "(":
+            ahead += 1
+            t = self.peek(ahead)
+        return t.kind == "keyword" and t.value in ("select", "with")
+
+    def _steal_order_limit(self, node):
+        """Move the rightmost body's ORDER BY/LIMIT up to the set op (a
+        trailing clause binds to the whole set expression — unless the body
+        was parenthesized, in which case the clause is its own)."""
+        right = node.right
+        while isinstance(right, ast.SetOp):
+            right = right.right
+        if getattr(right, "_parenthesized", False):
+            return [], None
+        order, limit = right.order_by, right.limit
+        right.order_by, right.limit = [], None
+        return order, limit
 
     def parse_query_body(self) -> ast.Query:
         self.expect_kw("select")

@@ -13,9 +13,13 @@ Design (TPU-first, not a port):
   representation the device ever touches.
 - DECIMAL(p, s) with p <= 18 is a scaled int64 ("unscaled value", like
   Presto's short decimal, spi/type/DecimalType.java); arithmetic is exact
-  int64 math with explicit rescales. p > 18 is not yet supported (reference
-  uses int128 limbs, UnscaledDecimal128Arithmetic.java) — tracked for a
-  later round as paired-int32-limb Pallas math.
+  int64 math with explicit rescales. p > 18 ("long decimal") carries a
+  second int64 limb on the Column (`Column.hi`: value = hi·2³² + lo, lo
+  canonical in [0, 2³²)) — produced by sum(decimal) aggregation states
+  and carried exactly through joins, sorts, exchanges and spill
+  (reference: UnscaledDecimal128Arithmetic.java two-long layout). General
+  long-decimal multiplication/division is not implemented; comparisons
+  and min/max fall back to combined float64.
 - DATE is int32 days since 1970-01-01 (same as Presto, spi/type/DateType).
 - TIMESTAMP is int64 microseconds since epoch.
 """

@@ -1,0 +1,138 @@
+"""Cost-based optimizer v1: stats derivation, join ordering, broadcast
+choice, and capacity pre-sizing.
+
+Reference: presto-main cost/ StatsCalculator + FilterStatsCalculator +
+JoinStatsRule; iterative/rule/ReorderJoins.java:94;
+DetermineJoinDistributionType.java:46.
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from presto_tpu.catalog.memory import MemoryConnector
+from presto_tpu.catalog.tpch import tpch_catalog
+from presto_tpu.connector import Catalog
+from presto_tpu.exec import ExecConfig, LocalRunner
+from presto_tpu.plan.stats import derive, filter_selectivity
+
+
+@pytest.fixture(scope="module")
+def tpch():
+    cat = tpch_catalog(0.05)
+    conn = cat.connectors["tpch"]
+    for t in conn.table_names():
+        conn._ensure(t)
+    return cat
+
+
+def test_scan_stats_from_connector(tpch):
+    runner = LocalRunner(tpch, ExecConfig())
+    qp = runner.plan("select l_orderkey, l_quantity from lineitem")
+    scan = qp.root.child
+    while scan.children():
+        scan = scan.children()[0]
+    st = derive(scan, tpch)
+    assert st is not None
+    assert st.rows > 200_000  # SF0.05 lineitem ~ 300k
+    qty = st.col("l_quantity")
+    assert qty is not None and qty.min_value == 1 and qty.max_value == 50
+    ok = st.col("l_orderkey")
+    assert ok is not None and ok.ndv is not None and ok.ndv > 10_000
+
+
+def test_primary_key_ndv_is_exact(tpch):
+    runner = LocalRunner(tpch, ExecConfig())
+    qp = runner.plan("select o_orderkey from orders")
+    scan = qp.root.child
+    while scan.children():
+        scan = scan.children()[0]
+    st = derive(scan, tpch)
+    handle = tpch.connectors["tpch"].get_table("orders")
+    assert st.col("o_orderkey").ndv == handle.row_count
+
+
+def test_filter_selectivity_range(tpch):
+    runner = LocalRunner(tpch, ExecConfig())
+    qp = runner.plan(
+        "select count(*) as c from lineitem where l_quantity < 13")
+    # Filter may have been folded into scan constraints; derive on the
+    # aggregate's child either way
+    agg = qp.root.child
+    while not type(agg).__name__ == "Aggregate":
+        agg = agg.children()[0]
+    st = derive(agg.children()[0], tpch)
+    total = tpch.connectors["tpch"].get_table("lineitem").row_count
+    assert st is not None
+    # quantity uniform on [1, 50] → ~24% pass
+    assert 0.1 * total < st.rows < 0.4 * total
+
+
+def test_q9_join_order_is_stats_driven(tpch):
+    """The fact table joins the FILTERED part table before the unfiltered
+    big dims — source order (part first as probe) would be wrong."""
+    runner = LocalRunner(tpch, ExecConfig())
+    plan = runner.explain("""
+select n_name, sum(l_extendedprice) as s
+from part, supplier, lineitem, partsupp, orders, nation
+where s_suppkey = l_suppkey and ps_suppkey = l_suppkey
+  and ps_partkey = l_partkey and p_partkey = l_partkey
+  and o_orderkey = l_orderkey and s_nationkey = n_nationkey
+  and p_name like '%green%'
+group by n_name
+""")
+    # the lineitem scan joins the filtered part scan in its immediate join
+    li = plan.index("TableScan[tpch.lineitem]")
+    part_join = plan.index("['l_partkey'] = ['p_partkey']")
+    assert part_join < li, plan
+    assert "Filter[like(p_name" in plan
+
+
+def test_broadcast_vs_partitioned_choice(tpch):
+    from presto_tpu.plan.builder import plan_query
+    from presto_tpu.plan.fragmenter import OUT_BROADCAST, OUT_HASH, fragment_plan
+    from presto_tpu.plan.optimizer import optimize
+
+    qp = optimize(plan_query(
+        "select n_name, count(*) as c from customer, nation "
+        "where c_nationkey = n_nationkey group by n_name", tpch))
+    d = fragment_plan(qp, tpch, broadcast_threshold_rows=1000)
+    sinks = [f.output_partitioning for f in d.fragments.values()]
+    assert OUT_BROADCAST in sinks  # nation (25 rows) broadcasts
+
+    qp2 = optimize(plan_query(
+        "select count(*) as c from lineitem, orders "
+        "where l_orderkey = o_orderkey", tpch))
+    d2 = fragment_plan(qp2, tpch, broadcast_threshold_rows=1000)
+    sinks2 = [f.output_partitioning for f in d2.fragments.values()]
+    assert OUT_BROADCAST not in sinks2  # orders way over threshold
+    assert OUT_HASH in sinks2
+
+
+def test_capacity_presizing_avoids_growth(tpch):
+    """Group-by with ~75k groups and a 1k configured capacity: stats
+    pre-size the table so results are right without growth retries."""
+    runner = LocalRunner(tpch, ExecConfig(batch_rows=1 << 14,
+                                          agg_capacity=1 << 10))
+    out = runner.run("select o_custkey, count(*) as c from orders "
+                     "group by o_custkey")
+    conn = tpch.connectors["tpch"]
+    expect = len(np.unique(conn.tables["orders"].arrays["o_custkey"]))
+    assert len(out) == expect
+
+
+def test_stats_survive_for_plain_memory_tables():
+    conn = MemoryConnector()
+    conn.add_table("t", pd.DataFrame({
+        "k": np.arange(1000), "g": np.arange(1000) % 7,
+        "x": np.where(np.arange(1000) % 10 == 0, None,
+                      np.arange(1000).astype(object)),
+    }))
+    cat = Catalog()
+    cat.register("m", conn, default=True)
+    h = conn.get_table("t")
+    ks = h.column("k").stats
+    gs = h.column("g").stats
+    xs = h.column("x").stats
+    assert ks.ndv == 1000 and gs.ndv == 7
+    assert abs(xs.null_fraction - 0.1) < 1e-9

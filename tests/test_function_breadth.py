@@ -94,9 +94,12 @@ def test_bool_and_or_count_if(runner):
     assert list(got.ci.astype(int)) == list(exp.ci)
 
 
-def test_approx_distinct_exact(runner):
+def test_approx_distinct_within_error(runner):
+    # HLL-backed since round 3 (see tests/test_sketches.py for the full
+    # sketch suite); small cardinalities use linear counting → near-exact
     got = runner.run("select approx_distinct(s) as d from t")
-    assert int(got.d[0]) == runner.df.s.nunique()
+    exact = runner.df.s.nunique()
+    assert abs(int(got.d[0]) - exact) <= max(2, int(0.05 * exact))
 
 
 def test_checksum_order_independent(runner):

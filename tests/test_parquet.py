@@ -1,0 +1,130 @@
+"""Parquet storage connector tests: write→read round-trips, chunked export
+equivalence, row-group pruning, and the host/device cache tiers.
+
+Reference: presto-orc round-trip tests (presto-orc/src/test, 63 files) and
+presto-hive pushdown tests — here the parquet layer is the storage engine.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from presto_tpu.catalog.parquet import (
+    ParquetConnector,
+    export_tpch_chunked,
+    write_table,
+)
+from presto_tpu.connector import Catalog
+from presto_tpu.exec import ExecConfig, LocalRunner
+from presto_tpu.types import BIGINT, DATE, DecimalType, VARCHAR
+from presto_tpu.dictionary import Dictionary
+
+
+@pytest.fixture(scope="module")
+def tpch_pq(tmp_path_factory):
+    d = str(tmp_path_factory.mktemp("tpch_pq"))
+    # small chunks force the multi-chunk append path at tiny scale
+    export_tpch_chunked(d, 0.01, orders_per_chunk=4_000)
+    conn = ParquetConnector(d)
+    cat = Catalog()
+    cat.register("pq", conn, default=True)
+    return LocalRunner(cat, ExecConfig(batch_rows=1 << 14,
+                                       agg_capacity=1 << 10)), conn
+
+
+def test_chunked_export_matches_memory_connector(tpch_pq):
+    """Chunked parquet and the in-memory generator agree on global
+    invariants (row counts, referential sums are chunk-provenance-specific,
+    so compare counts + key ranges)."""
+    runner, _ = tpch_pq
+    from presto_tpu.catalog.tpch import TpchGenerator
+
+    gen = TpchGenerator(0.01)
+    out = runner.run("select count(*) as c, min(o_orderkey) as lo, "
+                     "max(o_orderkey) as hi from orders")
+    assert out.c[0] == gen.n_orders
+    assert out.lo[0] == 4
+    assert out.hi[0] == gen.n_orders * 4
+
+
+def test_lineitem_orders_referential_integrity(tpch_pq):
+    runner, _ = tpch_pq
+    out = runner.run(
+        "select count(*) as c from lineitem l "
+        "join orders o on l.l_orderkey = o.o_orderkey")
+    total = runner.run("select count(*) as c from lineitem")
+    assert out.c[0] == total.c[0]  # every lineitem joins an order
+
+
+def test_decimal_round_trip_exact(tpch_pq):
+    """Unscaled int64 decimal storage survives write→read exactly."""
+    runner, _ = tpch_pq
+    out = runner.run("select sum(l_extendedprice) as s, count(*) as c "
+                     "from lineitem")
+    import decimal
+
+    assert isinstance(out.s[0], decimal.Decimal)
+    assert out.s[0] > 0 and out.c[0] > 50_000
+
+
+def test_dictionary_strings_survive(tpch_pq):
+    runner, _ = tpch_pq
+    out = runner.run("select l_returnflag as f, count(*) as c from lineitem "
+                     "group by l_returnflag order by f")
+    assert list(out.f) == ["A", "N", "R"]
+
+
+def test_row_group_pruning(tpch_pq):
+    """o_orderdate constraints prune row groups via min/max stats... the
+    tpch orderdate is uniform so prune little; use orderkey (sorted) via
+    explicit API instead."""
+    _, conn = tpch_pq
+    h = conn.get_table("orders")
+    splits = conn.splits(h, 8)
+    pruned = conn.prune_splits(h, splits, {"o_orderkey": (1, 10)})
+    assert len(pruned) < len(splits)
+    assert len(pruned) >= 1
+
+
+def test_host_and_device_caches(tpch_pq):
+    _, conn = tpch_pq
+    conn.invalidate_cache()
+    with conn._host_cache_lock:
+        conn._host_cache.clear()
+        conn._host_cache_used = 0
+    h = conn.get_table("lineitem")
+    s = conn.splits(h, 4)[0]
+    b1 = conn.read_split(s, ["l_orderkey", "l_quantity"])
+    assert conn._host_cache_used > 0
+    # device-cache hit returns the same Batch object
+    b2 = conn.read_split(s, ["l_orderkey", "l_quantity"])
+    assert b1 is b2
+    # host-cache survives device invalidation; decode is skipped
+    conn.invalidate_cache()
+    used = conn._host_cache_used
+    b3 = conn.read_split(s, ["l_orderkey", "l_quantity"])
+    assert b3 is not b1
+    assert conn._host_cache_used == used
+
+
+def test_write_table_nullable_and_dates(tmp_path):
+    d = str(tmp_path)
+    dic = Dictionary(np.array(["x", "y"]))
+    write_table(
+        os.path.join(d, "t.parquet"),
+        {"k": np.array([1, 2, 3], np.int64),
+         "d": np.array([8035, 9298, 10591], np.int64),
+         "s": np.array([0, 1, 0], np.int32),
+         "m": np.array([100, -250, 0], np.int64)},
+        {"k": BIGINT, "d": DATE, "s": VARCHAR, "m": DecimalType(10, 2)},
+        {"s": dic},
+    )
+    conn = ParquetConnector(d)
+    cat = Catalog()
+    cat.register("pq", conn, default=True)
+    r = LocalRunner(cat, ExecConfig(batch_rows=128))
+    out = r.run("select k, d, s, m from t order by k")
+    assert list(out.k) == [1, 2, 3]
+    assert list(out.s) == ["x", "y", "x"]
+    assert [str(v) for v in out.m] == ["1.00", "-2.50", "0.00"]
