@@ -101,27 +101,8 @@ def write_table(path: str, data: Dict[str, np.ndarray], types: Dict[str, Type],
                 row_group_rows: int = 1 << 20):
     """Write engine-native columns (dict codes, unscaled decimals, day ints)
     to a parquet file."""
-    arrays = []
-    fields = []
-    for name, arr in data.items():
-        t = types[name]
-        at = _sql_to_arrow(t)
-        meta = None
-        if t.is_string:
-            d = (dicts or {})[name]
-            idx = pa.array(arr.astype(np.int32), pa.int32())
-            vocab = pa.array([str(v) for v in d.values], pa.string())
-            a = pa.DictionaryArray.from_arrays(idx, vocab)
-        elif isinstance(t, DecimalType):
-            a = pa.array(arr.astype(np.int64), pa.int64())
-            meta = {_DECIMAL_META: f"{t.precision},{t.scale}".encode()}
-        elif t is DATE:
-            a = pa.array(arr.astype(np.int32), pa.int32()).cast(pa.date32())
-        else:
-            a = pa.array(arr, at)
-        arrays.append(a)
-        fields.append(pa.field(name, at, metadata=meta))
-    table = pa.Table.from_arrays(arrays, schema=pa.schema(fields))
+    arrays, schema = _to_arrow_columns(data, types, dicts or {})
+    table = pa.Table.from_arrays(arrays, schema=schema)
     pq.write_table(table, path, row_group_size=row_group_rows,
                    use_dictionary=True, compression="zstd")
 

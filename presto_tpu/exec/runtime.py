@@ -910,7 +910,15 @@ def _execute_aggregate(node: Aggregate, ctx: ExecContext) -> Iterator[Batch]:
             for b in stream:
                 dispatch(b)
                 confirm(block=False)
+                # account EVERYTHING the optimistic window pins on device:
+                # the live accumulator plus each unconfirmed checkpoint and
+                # its input batch — otherwise spill/revoke fires ~depth×
+                # too late
                 out_bytes = batch_device_bytes(state["acc"])
+                for acc_before, wb, _ in window:
+                    out_bytes += batch_device_bytes(wb)
+                    if acc_before is not None:
+                        out_bytes += batch_device_bytes(acc_before)
                 if allow_spill and can_spill and (
                     state["revoke_requested"]
                     or ctx.should_spill(out_bytes - mctx.bytes)
@@ -1011,8 +1019,17 @@ def _finalize_aggregate(node, acc, layout, key_syms, key_types, state_types, in_
             {},
         )
 
-    # assemble final outputs (avg division etc.) — one jitted pass
-    state_idx = {name: i for i, (name, _, _) in enumerate(layout)}
+    return _node_jit(
+        node, "finalize",
+        lambda: build_agg_finalizer(node, key_syms, key_types, in_types),
+    )(acc)
+
+
+def build_agg_finalizer(node, key_syms, key_types, in_types):
+    """Traceable accumulator→final-values function (avg division, variance
+    assembly, int128 limb recombination). Shared by the streaming executor
+    and the mesh executor (parallel/mesh_exec.py), which traces it inside
+    one shard_map program."""
 
     def finalize(acc: Batch):
         names, types, cols = [], [], []
@@ -1109,7 +1126,7 @@ def _finalize_aggregate(node, acc, layout, key_syms, key_types, state_types, in_
             live = live.at[0].set(True)
         return Batch(names, types, cols, live, acc.dicts)
 
-    return _node_jit(node, "finalize", lambda: finalize)(acc)
+    return finalize
 
 
 # -- joins ------------------------------------------------------------------

@@ -751,7 +751,12 @@ class Planner:
             keys = []
             for oi in q.order_by:
                 if isinstance(oi.expr, ast.Literal) and oi.expr.kind == "integer":
-                    sym = symbols[int(oi.expr.value) - 1]
+                    pos = int(oi.expr.value)
+                    if not 1 <= pos <= len(symbols):
+                        raise AnalysisError(
+                            f"ORDER BY position {pos} out of range "
+                            f"(1..{len(symbols)})")
+                    sym = symbols[pos - 1]
                 elif isinstance(oi.expr, ast.Identifier):
                     nm = oi.expr.parts[-1]
                     if nm not in name_to_sym:
@@ -903,7 +908,12 @@ class Planner:
             order_an = ExprAnalyzer(scope, self, replacements=repl)
             for oi in q.order_by:
                 if isinstance(oi.expr, ast.Literal) and oi.expr.kind == "integer":
-                    sym = select_symbols[int(oi.expr.value) - 1]
+                    pos = int(oi.expr.value)
+                    if not 1 <= pos <= len(select_symbols):
+                        raise AnalysisError(
+                            f"ORDER BY position {pos} out of range "
+                            f"(1..{len(select_symbols)})")
+                    sym = select_symbols[pos - 1]
                 else:
                     e = order_an.analyze(
                         _rewrite_aggs_to_keys(oi.expr) if (has_group or has_aggs) else oi.expr

@@ -96,6 +96,10 @@ def _conjunct_selectivity(e: RowExpression, stats: NodeStats) -> float:
         if fn == "ne" and cs is not None and cs.ndv:
             return max(0.0, 1.0 - 1.0 / cs.ndv)
         if fn in ("lt", "le", "gt", "ge") and cs is not None and const is not None:
+            # normalize to "ref OP const": a constant on the LEFT mirrors
+            # the comparison (const < ref  ≡  ref > const)
+            if len(e.args) >= 2 and isinstance(e.args[0], Constant):
+                fn = {"lt": "gt", "le": "ge", "gt": "lt", "ge": "le"}[fn]
             v = _scalar(const.value)
             if v is not None:
                 frac = (_range_fraction(cs, None, v) if fn in ("lt", "le")

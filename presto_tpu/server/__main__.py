@@ -118,11 +118,15 @@ def main(argv=None):
         coord.close()
         return 0
 
+    import os
     import socket
 
     from presto_tpu.server.worker import Worker
 
-    node_id = args.node_id or f"worker-{socket.gethostname()}-{args.port}"
+    # default id must be unique per process — the requested port is 0
+    # (ephemeral) by default and NodeManager keys announcements by node_id
+    node_id = args.node_id or (
+        f"worker-{socket.gethostname()}-{os.getpid()}")
     w = Worker(
         catalog, node_id=node_id, port=args.port,
         coordinator_url=args.coordinator_url,
