@@ -58,6 +58,53 @@ def _infer_type(arr: np.ndarray) -> Type:
     raise TypeError(f"cannot infer SQL type for {arr.dtype}")
 
 
+def _batches_to_host(batches):
+    """Device result batches → engine-native host columns for the write
+    path: {name: (values, validity|None, hi|None, Dictionary|None)}.
+    Live rows compact; padding drops."""
+    batches = list(batches)
+    if not batches:
+        return [], [], {}
+    if len(batches) > 1:
+        # codes must share one dictionary before concatenation
+        from presto_tpu.exec.runtime import _unify_batch_dicts
+
+        batches = _unify_batch_dicts(batches)
+    names = list(batches[0].names)
+    types = list(batches[0].types)
+    out = {}
+    for i, name in enumerate(names):
+        vals, valids, his = [], [], []
+        any_valid = any_hi = False
+        d = None
+        for b in batches:
+            live = np.asarray(b.live)
+            c = b.columns[i]
+            vals.append(np.asarray(c.values)[live])
+            if c.validity is not None:
+                any_valid = True
+                valids.append(np.asarray(c.validity)[live])
+            else:
+                valids.append(np.ones(int(live.sum()), bool))
+            if c.hi is not None:
+                any_hi = True
+                his.append(np.asarray(c.hi)[live])
+            else:
+                his.append(np.zeros(int(live.sum()), np.int64))
+            if name in b.dicts:
+                if d is not None and b.dicts[name] is not d:
+                    d = Dictionary.merge(d, b.dicts[name])
+                elif d is None:
+                    d = b.dicts[name]
+        out[name] = (
+            np.concatenate(vals) if vals else np.zeros(0, types[i].dtype),
+            np.concatenate(valids) if any_valid else None,
+            np.concatenate(his) if any_hi else None,
+            d,
+        )
+    return names, types, out
+
+
 class MemoryTable:
     def __init__(self, name: str, data: Dict[str, np.ndarray],
                  types: Optional[Dict[str, Type]] = None,
@@ -67,6 +114,9 @@ class MemoryTable:
         self.arrays: Dict[str, np.ndarray] = {}
         self.validity: Dict[str, Optional[np.ndarray]] = {}
         self.dicts: Dict[str, Dictionary] = {}
+        # long-decimal high limbs (value = hi·2³² + lo), present only for
+        # columns written from precision>18 results (CTAS over sums)
+        self.hi: Dict[str, Optional[np.ndarray]] = {}
         self.primary_key = primary_key
         n = None
         for col, raw in data.items():
@@ -264,6 +314,87 @@ class MemoryConnector(DeviceSplitCache, Connector):
     def splits(self, handle: TableHandle, desired: int = 1) -> List[Split]:
         return [Split(handle.name, i, desired) for i in range(desired)]
 
+    # -- write path (reference: MemoryPageSinkProvider — pages append to
+    # the in-memory table; TableFinish returns the row count) -------------
+
+    def create_table_from(self, name: str, batches: Sequence[Batch],
+                          if_not_exists: bool = False) -> int:
+        if name in self.tables:
+            if if_not_exists:
+                return 0
+            raise ValueError(f"table already exists: {name}")
+        names, types, data = _batches_to_host(batches)
+        mt = MemoryTable(name, {}, {})
+        mt.types = dict(zip(names, types))
+        rows = 0
+        for col, (vals, valid, hi, d) in data.items():
+            mt.arrays[col] = vals
+            mt.validity[col] = valid
+            mt.hi[col] = hi
+            if d is not None:
+                mt.dicts[col] = d
+            rows = len(vals)
+        mt.num_rows = rows
+        self.tables[name] = mt
+        self.invalidate_cache(name)
+        return rows
+
+    def insert_into(self, name: str, batches: Sequence[Batch]) -> int:
+        if name not in self.tables:
+            raise KeyError(f"table not found: {name}")
+        mt = self.tables[name]
+        names, types, data = _batches_to_host(batches)
+        target_cols = list(mt.arrays.keys())
+        if len(names) != len(target_cols):
+            raise ValueError(
+                f"INSERT arity mismatch: {len(names)} columns vs "
+                f"{len(target_cols)} in {name}")
+        # positional matching (standard INSERT ... SELECT semantics):
+        # the i-th source column feeds the i-th target column
+        for src, col, t in zip(names, target_cols, types):
+            if t.name != mt.types[col].name:
+                raise ValueError(
+                    f"INSERT column {col} type mismatch: {t} vs {mt.types[col]}")
+        rows = 0
+        for src, col in zip(names, target_cols):
+            vals, valid, hi, d = data[src]
+            old_n = mt.num_rows
+            if d is not None and mt.dicts.get(col) is not None and d is not mt.dicts[col]:
+                # re-encode incoming codes into the table's dictionary space
+                m = Dictionary.merge(mt.dicts[col], d)
+                if m is not mt.dicts[col]:
+                    remap_old = np.concatenate(
+                        [[-1], np.searchsorted(m.values, mt.dicts[col].values)]
+                    ).astype(np.int32)
+                    mt.arrays[col] = remap_old[mt.arrays[col] + 1]
+                    mt.dicts[col] = m
+                remap_new = np.asarray(d.map_to(m))
+                vals = remap_new[vals.astype(np.int32) + 1]
+            mt.arrays[col] = np.concatenate([mt.arrays[col], vals])
+            if valid is not None or mt.validity.get(col) is not None:
+                old_v = (mt.validity.get(col) if mt.validity.get(col) is not None
+                         else np.ones(old_n, bool))
+                new_v = valid if valid is not None else np.ones(len(vals), bool)
+                mt.validity[col] = np.concatenate([old_v, new_v])
+            if hi is not None or mt.hi.get(col) is not None:
+                old_h = (mt.hi.get(col) if mt.hi.get(col) is not None
+                         else np.zeros(old_n, np.int64))
+                new_h = hi if hi is not None else np.zeros(len(vals), np.int64)
+                mt.hi[col] = np.concatenate([old_h, new_h])
+            rows = len(vals)
+        mt.num_rows += rows
+        mt.__dict__.pop("_stats_cache", None)
+        self.invalidate_cache(name)
+        return rows
+
+    def drop_table(self, name: str, if_exists: bool = False) -> None:
+        if name not in self.tables:
+            if if_exists:
+                return
+            raise KeyError(f"table not found: {name}")
+        del self.tables[name]
+        self.invalidate_cache(name)
+
     def _read_split_uncached(self, split: Split, columns: Sequence[str],
                              capacity: Optional[int] = None) -> Batch:
         t = self.tables[split.table]
@@ -275,19 +406,29 @@ class MemoryConnector(DeviceSplitCache, Connector):
         b = Batch.from_numpy(data, types,
                              dicts={c: t.dicts[c] for c in columns if c in t.dicts},
                              capacity=capacity)
-        # apply column validity (nullable object columns)
+        # apply column validity / long-decimal high limbs
         import jax.numpy as jnp
+
+        from presto_tpu.batch import Column
 
         for c in columns:
             v = t.validity[c]
+            h = t.hi.get(c)
+            if v is None and h is None:
+                continue
+            col = b.column(c)
+            vcol = col.validity
             if v is not None:
-                col = b.column(c)
                 pad = np.zeros(b.capacity, dtype=bool)
                 pad[: hi - lo] = v[lo:hi]
-                idx = b.names.index(c)
-                cols = list(b.columns)
-                from presto_tpu.batch import Column
-
-                cols[idx] = Column(col.values, jnp.asarray(pad))
-                b = Batch(b.names, b.types, cols, b.live, b.dicts)
+                vcol = jnp.asarray(pad)
+            hcol = None
+            if h is not None:
+                hpad = np.zeros(b.capacity, dtype=np.int64)
+                hpad[: hi - lo] = h[lo:hi]
+                hcol = jnp.asarray(hpad)
+            idx = b.names.index(c)
+            cols = list(b.columns)
+            cols[idx] = Column(col.values, vcol, hcol)
+            b = Batch(b.names, b.types, cols, b.live, b.dicts)
         return b

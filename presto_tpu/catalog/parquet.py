@@ -64,9 +64,9 @@ def _arrow_to_sql(field: pa.Field) -> Type:
     if pa.types.is_date32(t):
         return DATE
     if pa.types.is_decimal(t):
-        if t.precision <= 18:
-            return DecimalType(t.precision, t.scale)
-        raise NotImplementedError("decimal precision > 18")
+        if t.precision > 38:
+            raise NotImplementedError(f"decimal precision {t.precision} > 38")
+        return DecimalType(t.precision, t.scale)
     if pa.types.is_string(t) or pa.types.is_large_string(t) or (
         pa.types.is_dictionary(t)
     ):
@@ -147,6 +147,11 @@ class _PqTable:
     dicts: Dict[str, Dictionary]
     num_rows: int
     num_row_groups: int
+    # file version at load: (mtime_ns, size). A rewrite (INSERT/CTAS
+    # replace) changes it; every process watching the same directory
+    # revalidates on access, so multi-process workers see DDL from the
+    # coordinator without an invalidation RPC
+    version: tuple = (0, 0)
 
 
 class ParquetConnector(DeviceSplitCache, Connector):
@@ -181,7 +186,25 @@ class ParquetConnector(DeviceSplitCache, Connector):
                 out.append(f[: -len(".parquet")])
         return sorted(out)
 
+    @staticmethod
+    def _file_version(path: str) -> tuple:
+        st = os.stat(path)
+        return (st.st_mtime_ns, st.st_size)
+
+    def _check_fresh(self, name: str):
+        """Drop cached metadata/pages when the backing file changed (the
+        cross-process DDL-visibility path — see _PqTable.version)."""
+        t = self._tables.get(name)
+        if t is None:
+            return
+        try:
+            if self._file_version(t.path) != t.version:
+                self._invalidate_table(name)
+        except OSError:
+            self._invalidate_table(name)
+
     def _load(self, name: str) -> _PqTable:
+        self._check_fresh(name)
         if name in self._tables:
             return self._tables[name]
         path = os.path.join(self.directory, f"{name}.parquet")
@@ -216,7 +239,8 @@ class ParquetConnector(DeviceSplitCache, Connector):
                     field.name, t, None,
                     _footer_stats(f, name_to_idx[field.name], t)))
         handle = TableHandle(self.name, name, cols, row_count=float(f.metadata.num_rows))
-        t = _PqTable(path, handle, dicts, f.metadata.num_rows, f.num_row_groups)
+        t = _PqTable(path, handle, dicts, f.metadata.num_rows, f.num_row_groups,
+                     version=self._file_version(path))
         self._tables[name] = t
         return t
 
@@ -266,6 +290,100 @@ class ParquetConnector(DeviceSplitCache, Connector):
                 keep.append(s)
         return keep
 
+    # -- write path (reference: HivePageSink writing ORC/parquet files;
+    # CTAS = CreateTableTask + TableWriter chain) -------------------------
+
+    def _invalidate_table(self, name: str):
+        self._tables.pop(name, None)
+        self.invalidate_cache(name)
+        with self._host_cache_lock:
+            path = os.path.join(self.directory, f"{name}.parquet")
+            for k in [k for k in self._host_cache if k[0] == path]:
+                _, nbytes = self._host_cache.pop(k)
+                self._host_cache_used -= nbytes
+
+    def create_table_from(self, name: str, batches, if_not_exists: bool = False) -> int:
+        from presto_tpu.catalog.memory import _batches_to_host
+
+        path = os.path.join(self.directory, f"{name}.parquet")
+        if os.path.exists(path):
+            if if_not_exists:
+                return 0
+            raise ValueError(f"table already exists: {name}")
+        names, types, data = _batches_to_host(batches)
+        plain = {c: v[0] for c, v in data.items()}
+        validity = {c: v[1] for c, v in data.items() if v[1] is not None}
+        his = {c: v[2] for c, v in data.items() if v[2] is not None}
+        dicts = {c: v[3] for c, v in data.items() if v[3] is not None}
+        arrays, schema = _to_arrow_columns(plain, dict(zip(names, types)),
+                                           dicts, validity, his)
+        tbl = pa.Table.from_arrays(arrays, schema=schema)
+        pq.write_table(tbl, path + ".tmp", row_group_size=1 << 20,
+                       use_dictionary=True, compression="zstd")
+        os.replace(path + ".tmp", path)
+        self._invalidate_table(name)
+        return int(tbl.num_rows)
+
+    def insert_into(self, name: str, batches) -> int:
+        """Append by rewrite: existing rows + new rows into a fresh file
+        (parquet files are immutable; a part-file layout is the scalable
+        successor — this keeps single-file tables correct)."""
+        path = os.path.join(self.directory, f"{name}.parquet")
+        if not os.path.exists(path):
+            raise KeyError(f"table not found: {name}")
+        from presto_tpu.catalog.memory import _batches_to_host
+
+        names, types, data = _batches_to_host(batches)
+        existing = pq.read_table(path)
+        target_names = list(existing.schema.names)
+        if len(target_names) != len(names):
+            raise ValueError(
+                f"INSERT arity mismatch: {len(names)} columns vs "
+                f"{len(target_names)} in {name}")
+        # positional matching (INSERT ... SELECT semantics): i-th source
+        # column feeds the i-th target column, logical types must agree
+        for field, t in zip(existing.schema, types):
+            et = _arrow_to_sql(field)
+            if et.name != t.name:
+                raise ValueError(
+                    f"INSERT column {field.name} type mismatch: "
+                    f"{t} vs {et}")
+        plain, validity, his, dicts = {}, {}, {}, {}
+        for src, tgt in zip(names, target_names):
+            vals, valid, hi, d = data[src]
+            plain[tgt] = vals
+            if valid is not None:
+                validity[tgt] = valid
+            if hi is not None:
+                his[tgt] = hi
+            if d is not None:
+                dicts[tgt] = d
+        arrays, schema = _to_arrow_columns(plain, dict(zip(target_names, types)),
+                                           dicts, validity, his)
+        new_tbl = pa.Table.from_arrays(arrays, schema=schema)
+        # unify schemas (dictionary value types etc.) then concatenate
+        new_tbl = new_tbl.cast(existing.schema)
+        merged = pa.concat_tables([existing, new_tbl])
+        pq.write_table(merged, path + ".tmp", row_group_size=1 << 20,
+                       use_dictionary=True, compression="zstd")
+        os.replace(path + ".tmp", path)
+        self._invalidate_table(name)
+        return int(new_tbl.num_rows)
+
+    def drop_table(self, name: str, if_exists: bool = False) -> None:
+        path = os.path.join(self.directory, f"{name}.parquet")
+        if not os.path.exists(path):
+            if if_exists:
+                return
+            raise KeyError(f"table not found: {name}")
+        os.remove(path)
+        self._invalidate_table(name)
+
+    def read_split(self, split: Split, columns: Sequence[str],
+                   capacity: Optional[int] = None) -> Batch:
+        self._check_fresh(split.table)
+        return super().read_split(split, columns, capacity)
+
     def _decoded_columns(self, t: _PqTable, rg: int, sub: int, sub_count: int,
                          columns: Sequence[str]):
         """Decode (or fetch from the host LRU) one split's engine-native
@@ -286,10 +404,12 @@ class ParquetConnector(DeviceSplitCache, Connector):
         nbytes = 0
         for name in columns:
             st = t.handle.column(name).type
-            arr, valid = _decode_column(tbl.column(name), st, t.dicts.get(name))
+            arr, valid, hi = _decode_column(tbl.column(name), st,
+                                            t.dicts.get(name))
             arr = np.ascontiguousarray(np.asarray(arr))
-            out[name] = (arr, valid)
+            out[name] = (arr, valid, hi)
             nbytes += arr.nbytes + (valid.nbytes if valid is not None else 0)
+            nbytes += hi.nbytes if hi is not None else 0
         result = (out, n)
         if nbytes <= self.host_cache_bytes:
             with self._host_cache_lock:
@@ -319,7 +439,7 @@ class ParquetConnector(DeviceSplitCache, Connector):
         live[:n] = True
         for name in columns:
             st = t.handle.column(name).type
-            arr, valid = decoded[name]
+            arr, valid, hi = decoded[name]
             buf = np.zeros(cap, dtype=st.dtype)
             buf[:n] = arr
             vcol = None
@@ -327,9 +447,14 @@ class ParquetConnector(DeviceSplitCache, Connector):
                 vb = np.zeros(cap, bool)
                 vb[:n] = valid
                 vcol = jnp.asarray(vb)
+            hcol = None
+            if hi is not None:
+                hb = np.zeros(cap, np.int64)
+                hb[:n] = hi
+                hcol = jnp.asarray(hb)
             names.append(name)
             typelist.append(st)
-            cols.append(Column(jnp.asarray(buf), vcol))
+            cols.append(Column(jnp.asarray(buf), vcol, hcol))
         return Batch(
             names, typelist, cols, jnp.asarray(live),
             {c: t.dicts[c] for c in columns if c in t.dicts},
@@ -358,16 +483,37 @@ def _decode_column(col: pa.ChunkedArray, t: Type, d: Optional[Dictionary]):
             arr = np.array([d.code_of(s) if s is not None else -1 for s in strs], np.int32)
         if valid is not None:
             arr = np.where(valid, arr, -1)
-        return arr, valid
+        return arr, valid, None
     if isinstance(t, DecimalType):
         if pa.types.is_decimal(combined.type):
+            if t.is_long:
+                # int128 unscaled values split into (hi, lo) limbs —
+                # host-side python ints, exact (CTAS-of-sums scale data)
+                import decimal as _dec
+
+                pyvals = combined.to_pylist()
+                lo = np.zeros(len(pyvals), np.int64)
+                hi = np.zeros(len(pyvals), np.int64)
+                with _dec.localcontext() as _ctx:
+                    _ctx.prec = 50
+                    for i, v in enumerate(pyvals):
+                        if v is None:
+                            continue
+                        u = int(v.scaleb(t.scale))
+                        if not (-(1 << 94) <= u < (1 << 94)):
+                            raise ValueError(
+                                f"decimal value {v} exceeds the engine's "
+                                "two-limb (hi:int64, lo:32-bit) range")
+                        lo[i] = u & 0xFFFFFFFF
+                        hi[i] = u >> 32
+                return (lo, valid, hi)
             arr = combined.cast(pa.decimal128(38, t.scale)).cast(pa.int64(), safe=False)
         else:
             arr = combined  # unscaled int64 storage
-        return arr.to_numpy(zero_copy_only=False), valid
+        return arr.to_numpy(zero_copy_only=False), valid, None
     if t is DATE:
-        return combined.cast(pa.int32()).to_numpy(zero_copy_only=False), valid
-    return combined.to_numpy(zero_copy_only=False), valid
+        return combined.cast(pa.int32()).to_numpy(zero_copy_only=False), valid, None
+    return combined.to_numpy(zero_copy_only=False), valid, None
 
 
 def export_tpch(directory: str, sf: float = 1.0):
@@ -387,24 +533,59 @@ def export_tpch(directory: str, sf: float = 1.0):
         )
 
 
-def _to_arrow_columns(data, types, dicts):
+def _to_arrow_columns(data, types, dicts, validity=None, his=None):
+    """Engine-native columns → arrow arrays. `validity` maps column name →
+    bool mask (False = SQL NULL); `his` maps name → long-decimal hi limbs
+    (written as arrow decimal128(38, s) — the only physical type that
+    preserves int128 exactness)."""
     arrays, fields = [], []
     for name, arr in data.items():
         t = types[name]
-        at = _sql_to_arrow(t)
+        valid = (validity or {}).get(name)
+        mask = None if valid is None else ~np.asarray(valid)
+        hi = (his or {}).get(name)
         meta = None
+        if isinstance(t, DecimalType) and (hi is not None or t.is_long):
+            import decimal as _dec
+
+            lo = np.asarray(arr).astype(object)
+            h = (np.zeros(len(lo), np.int64) if hi is None
+                 else np.asarray(hi)).astype(object)
+            with _dec.localcontext() as _ctx:
+                _ctx.prec = 50  # int128 values reach 39 digits; never round
+                vals = [
+                    None if (mask is not None and mask[i])
+                    else _dec.Decimal((int(h[i]) << 32) + int(lo[i])).scaleb(-t.scale)
+                    for i in range(len(lo))
+                ]
+            at = pa.decimal128(38, t.scale)
+            a = pa.array(vals, at)
+            arrays.append(a)
+            fields.append(pa.field(name, at))
+            continue
+        at = _sql_to_arrow(t)
         if t.is_string:
-            d = dicts[name]
-            idx = pa.array(np.asarray(arr).astype(np.int32), pa.int32())
+            d = dicts.get(name)
+            if d is None:
+                from presto_tpu.dictionary import Dictionary as _Dict
+
+                d = _Dict(np.array([], dtype=object))  # empty/all-NULL column
+            codes = np.asarray(arr).astype(np.int32)
+            if mask is not None:
+                # arrow dictionary arrays null via the index mask
+                idx = pa.array(np.where(mask, 0, codes), pa.int32(), mask=mask)
+            else:
+                idx = pa.array(codes, pa.int32())
             vocab = pa.array([str(v) for v in d.values], pa.string())
             a = pa.DictionaryArray.from_arrays(idx, vocab)
         elif isinstance(t, DecimalType):
-            a = pa.array(np.asarray(arr).astype(np.int64), pa.int64())
+            a = pa.array(np.asarray(arr).astype(np.int64), pa.int64(), mask=mask)
             meta = {_DECIMAL_META: f"{t.precision},{t.scale}".encode()}
         elif t is DATE:
-            a = pa.array(np.asarray(arr).astype(np.int32), pa.int32()).cast(pa.date32())
+            a = pa.array(np.asarray(arr).astype(np.int32), pa.int32(),
+                         mask=mask).cast(pa.date32())
         else:
-            a = pa.array(arr, at)
+            a = pa.array(np.asarray(arr), at, mask=mask)
         arrays.append(a)
         fields.append(pa.field(name, at, metadata=meta))
     return arrays, pa.schema(fields)

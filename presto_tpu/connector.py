@@ -84,6 +84,23 @@ class Connector:
     ) -> Batch:
         raise NotImplementedError
 
+    # -- write path (reference: ConnectorMetadata.beginCreateTable/
+    # beginInsert + ConnectorPageSink; connectors that stay read-only
+    # simply inherit the failures) --------------------------------------
+
+    def create_table_from(self, name: str, batches: Sequence[Batch],
+                          if_not_exists: bool = False) -> int:
+        raise NotImplementedError(
+            f"connector {self.name!r} does not support CREATE TABLE")
+
+    def insert_into(self, name: str, batches: Sequence[Batch]) -> int:
+        raise NotImplementedError(
+            f"connector {self.name!r} does not support INSERT")
+
+    def drop_table(self, name: str, if_exists: bool = False) -> None:
+        raise NotImplementedError(
+            f"connector {self.name!r} does not support DROP TABLE")
+
 
 class Catalog:
     """Catalog/metadata facade (reference: metadata/MetadataManager.java +
@@ -99,12 +116,17 @@ class Catalog:
         if default or self.default is None:
             self.default = name
 
-    def resolve(self, parts) -> tuple[Connector, TableHandle]:
+    def connector_for(self, parts) -> tuple[Connector, str]:
+        """Resolve a (possibly qualified) table name to (connector,
+        table_name) WITHOUT requiring the table to exist (DDL targets)."""
         if len(parts) == 1:
             cname, tname = self.default, parts[0]
         else:
             cname, tname = parts[-2], parts[-1]
         if cname not in self.connectors:
             raise KeyError(f"unknown catalog {cname}")
-        conn = self.connectors[cname]
+        return self.connectors[cname], tname
+
+    def resolve(self, parts) -> tuple[Connector, TableHandle]:
+        conn, tname = self.connector_for(parts)
         return conn, conn.get_table(tname)

@@ -17,6 +17,39 @@ from presto_tpu.plan.nodes import QueryPlan, plan_to_string
 from presto_tpu.plan.optimizer import optimize
 
 
+def execute_data_definition(stmt, catalog: Catalog, run_query_fn):
+    """CTAS / INSERT / DROP executed engine-side (reference: the ~35
+    execution/*Task.java DDL classes + the TableWriter → TableFinish
+    operator chain returning a rows-written count). `run_query_fn` executes
+    the source query AST to a result Batch — local or distributed."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from presto_tpu.batch import Batch, Column
+    from presto_tpu.sql import ast as _ast
+    from presto_tpu.types import BIGINT
+
+    def _count_batch(rows: int) -> Batch:
+        vals = np.zeros(128, np.int64)
+        vals[0] = rows
+        live = np.zeros(128, bool)
+        live[0] = True
+        return Batch(["rows"], [BIGINT],
+                     [Column(jnp.asarray(vals), None)], jnp.asarray(live), {})
+
+    conn, tname = catalog.connector_for(stmt.name)
+    if isinstance(stmt, _ast.DropTable):
+        conn.drop_table(tname, if_exists=stmt.if_exists)
+        return _count_batch(0)
+    result = run_query_fn(stmt.query)
+    if isinstance(stmt, _ast.CreateTableAs):
+        n = conn.create_table_from(tname, [result],
+                                   if_not_exists=stmt.if_not_exists)
+    else:
+        n = conn.insert_into(tname, [result])
+    return _count_batch(n)
+
+
 class LocalRunner:
     def __init__(self, catalog: Catalog, config: Optional[ExecConfig] = None):
         self.catalog = catalog
@@ -41,7 +74,24 @@ class LocalRunner:
         return plan_to_string(self.plan(sql).root)
 
     def run_batch(self, sql: str):
-        qp = self.plan(sql)
+        from presto_tpu.sql import ast as _ast
+        from presto_tpu.sql.parser import parse_sql
+
+        qp = self._plan_cache.get(sql)  # cached plans are never DDL
+        if qp is None:
+            stmt = parse_sql(sql)
+            if isinstance(stmt, (_ast.CreateTableAs, _ast.Insert,
+                                 _ast.DropTable)):
+                return execute_data_definition(stmt, self.catalog,
+                                               self._run_query_ast)
+            qp = optimize(plan_query(stmt, self.catalog))
+            if not qp.scalar_subqueries:
+                self._plan_cache[sql] = qp
+        ctx = ExecContext(self.catalog, self.config)
+        return run_plan(qp, ctx)
+
+    def _run_query_ast(self, q):
+        qp = optimize(plan_query(q, self.catalog))
         ctx = ExecContext(self.catalog, self.config)
         return run_plan(qp, ctx)
 

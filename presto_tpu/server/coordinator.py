@@ -505,6 +505,41 @@ class Coordinator:
 
         from presto_tpu.batch import Column
         from presto_tpu.exec.runtime import _JIT_COMPACT, _collect_concat
+        from presto_tpu.sql import ast as _ast
+        from presto_tpu.sql.parser import parse_sql
+
+        # cached distributed plans are never DDL — skip the parse probe
+        cached = any(k[0] == sql for k in self._dplan_cache)
+        stmt = None if cached else parse_sql(sql)
+        if isinstance(stmt, (_ast.CreateTableAs, _ast.Insert, _ast.DropTable)):
+            # DDL/DML executes coordinator-side; the source query still runs
+            # distributed (reference: DataDefinitionExecution on the
+            # coordinator + a distributed TableWriter source)
+            from presto_tpu.exec.runner import execute_data_definition
+            from presto_tpu.plan.builder import plan_query as _pq
+
+            def run_query_fn(q):
+                from presto_tpu.plan.fragmenter import fragment_plan
+                from presto_tpu.plan.optimizer import optimize as _opt
+
+                qp = _opt(_pq(q, self.catalog))
+                d = fragment_plan(qp, self.catalog,
+                                  broadcast_threshold_rows=self.broadcast_threshold_rows)
+                batches = list(self.execute_distributed(d, config))
+                merged = _collect_concat(iter(batches))
+                if merged is None:
+                    root = d.fragments[d.root_fid].root
+                    types = dict(root.output)
+                    merged = Batch(
+                        d.output_names,
+                        [types[n] for n in d.output_names],
+                        [Column(jnp.zeros(128, types[n].dtype), None)
+                         for n in d.output_names],
+                        jnp.zeros(128, bool), {},
+                    )
+                return _JIT_COMPACT(merged)
+
+            return execute_data_definition(stmt, self.catalog, run_query_fn)
 
         dplan = self.plan_distributed(sql, session)
         batches = list(self.execute_distributed(dplan, config))

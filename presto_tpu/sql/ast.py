@@ -203,6 +203,31 @@ class Query(Node):
 
 
 @dataclasses.dataclass
+class CreateTableAs(Node):
+    """CREATE TABLE [IF NOT EXISTS] name AS query (reference:
+    execution/CreateTableTask.java + the TableWriter chain)."""
+
+    name: Tuple[str, ...]
+    query: Node  # Query | SetOp
+    if_not_exists: bool = False
+
+
+@dataclasses.dataclass
+class Insert(Node):
+    """INSERT INTO name query (reference: TableWriterOperator +
+    TableFinishOperator row-count result)."""
+
+    name: Tuple[str, ...]
+    query: Node
+
+
+@dataclasses.dataclass
+class DropTable(Node):
+    name: Tuple[str, ...]
+    if_exists: bool = False
+
+
+@dataclasses.dataclass
 class SetOp(Node):
     """UNION [ALL] / INTERSECT / EXCEPT of two query bodies
     (SqlBase.g4:802 queryTerm; reference planner/plan/UnionNode,

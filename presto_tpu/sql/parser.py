@@ -27,6 +27,7 @@ _KEYWORDS = {
     "intersect", "except",
     "substring", "for", "over", "partition", "rows", "range", "unbounded",
     "preceding", "following", "current", "row",
+    "create", "insert", "drop", "table", "into", "if",
 }
 
 _TOKEN_RE = re.compile(
@@ -127,19 +128,64 @@ class Parser:
     def ident(self) -> str:
         t = self.peek()
         # allow non-reserved keywords as identifiers where unambiguous
-        if t.kind in ("ident",) or (t.kind == "keyword" and t.value in ("year", "month", "day", "date", "first", "last")):
+        if t.kind in ("ident",) or (t.kind == "keyword" and t.value in (
+                "year", "month", "day", "date", "first", "last", "if",
+                "table", "into")):
             self.next()
             return t.value
         raise ParseError(f"expected identifier, got {t!r}")
 
     # -- entry ------------------------------------------------------------
 
-    def parse_statement(self) -> ast.Query:
-        q = self.parse_query()
+    def parse_statement(self) -> ast.Node:
+        t = self.peek()
+        if t.kind == "keyword" and t.value == "create":
+            q = self._parse_create()
+        elif t.kind == "keyword" and t.value == "insert":
+            q = self._parse_insert()
+        elif t.kind == "keyword" and t.value == "drop":
+            q = self._parse_drop()
+        else:
+            q = self.parse_query()
         self.accept_op(";")
         if self.peek().kind != "eof":
             raise ParseError(f"trailing tokens at {self.peek()!r}")
         return q
+
+    def _qualified_name(self):
+        parts = [self.ident()]
+        while self.accept_op("."):
+            parts.append(self.ident())
+        return tuple(parts)
+
+    def _parse_create(self) -> ast.Node:
+        self.expect_kw("create")
+        self.expect_kw("table")
+        if_not_exists = False
+        if self.accept_kw("if"):
+            self.expect_kw("not")
+            self.expect_kw("exists")
+            if_not_exists = True
+        name = self._qualified_name()
+        self.expect_kw("as")
+        q = self.parse_query()
+        return ast.CreateTableAs(name, q, if_not_exists)
+
+    def _parse_insert(self) -> ast.Node:
+        self.expect_kw("insert")
+        self.expect_kw("into")
+        name = self._qualified_name()
+        q = self.parse_query()
+        return ast.Insert(name, q)
+
+    def _parse_drop(self) -> ast.Node:
+        self.expect_kw("drop")
+        self.expect_kw("table")
+        if_exists = False
+        if self.accept_kw("if"):
+            self.expect_kw("exists")
+            if_exists = True
+        return ast.DropTable(self._qualified_name(), if_exists)
 
     def parse_query(self) -> ast.Query:
         ctes = []
