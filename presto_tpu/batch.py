@@ -48,18 +48,35 @@ class Column:
     `hi` is the optional high limb of a long-decimal column
     (DecimalType precision > 18): value = hi * 2^32 + values, with values
     (the low limb) kept canonical in [0, 2^32). None for all other types
-    (reference: UnscaledDecimal128Arithmetic two-long layout)."""
+    (reference: UnscaledDecimal128Arithmetic two-long layout).
 
-    __slots__ = ("values", "validity", "hi")
+    Structural columns (ArrayType / MapType — spi/block/ColumnarArray.java
+    redesigned to a dense padded layout): `values` is a [capacity, W] plane
+    of element values, `sizes` is int32[capacity] (row cardinalities,
+    <= W), `evalid` an optional bool[capacity, W] element-validity plane
+    (None = every in-size element valid), and for maps `keys` holds the
+    aligned [capacity, W] key plane (map keys are non-null). `validity`
+    stays the ROW-level null mask. All None for scalar columns."""
 
-    def __init__(self, values, validity=None, hi=None):
+    __slots__ = ("values", "validity", "hi", "sizes", "evalid", "keys")
+
+    def __init__(self, values, validity=None, hi=None, sizes=None,
+                 evalid=None, keys=None):
         self.values = values
         self.validity = validity
         self.hi = hi
+        self.sizes = sizes
+        self.evalid = evalid
+        self.keys = keys
 
     @property
     def capacity(self) -> int:
         return self.values.shape[0]
+
+    @property
+    def width(self):
+        """Static element width W of a structural column (None for scalar)."""
+        return self.values.shape[1] if self.values.ndim == 2 else None
 
     def valid_mask(self):
         if self.validity is None:
@@ -67,11 +84,15 @@ class Column:
         return self.validity
 
     def gather(self, idx) -> "Column":
-        """Row gather preserving validity and the long-decimal high limb."""
+        """Row gather preserving validity, long-decimal limbs, and
+        structural planes (2D values/evalid/keys gather by row)."""
         return Column(
             self.values[idx],
             None if self.validity is None else self.validity[idx],
             None if self.hi is None else self.hi[idx],
+            None if self.sizes is None else self.sizes[idx],
+            None if self.evalid is None else self.evalid[idx],
+            None if self.keys is None else self.keys[idx],
         )
 
     def combined_f64(self):
@@ -86,12 +107,85 @@ class Column:
         return f"Column({self.values!r}, validity={self.validity!r})"
 
 
+def pad_plane_width(plane, w: int, fill=0):
+    """Widen a [n, w0] structural plane to [n, w] with `fill` padding."""
+    w0 = plane.shape[1]
+    if w0 == w:
+        return plane
+    pad = jnp.full((plane.shape[0], w - w0), fill, plane.dtype)
+    return jnp.concatenate([plane, pad], axis=1)
+
+
+def concat_columns(cols: Sequence[Column], caps: Sequence[int]) -> Column:
+    """Row-concatenate Columns preserving validity, long-decimal limbs and
+    structural planes (2D value planes align on the max width). The single
+    concatenation point for every accumulate/merge path — dropping a plane
+    here is the Column.hi-through-joins bug class."""
+    if any(c.values.ndim == 2 for c in cols):
+        w = max(c.values.shape[1] for c in cols)
+        vals = jnp.concatenate([pad_plane_width(c.values, w) for c in cols])
+        sizes = jnp.concatenate([
+            c.sizes if c.sizes is not None else jnp.zeros(cap, jnp.int32)
+            for c, cap in zip(cols, caps)
+        ])
+        if any(c.evalid is not None for c in cols):
+            evalid = jnp.concatenate([
+                pad_plane_width(
+                    c.evalid if c.evalid is not None
+                    else jnp.ones((cap, c.values.shape[1]), bool),
+                    w, False)
+                for c, cap in zip(cols, caps)
+            ])
+        else:
+            evalid = None
+        if any(c.keys is not None for c in cols):
+            kd = next(c.keys.dtype for c in cols if c.keys is not None)
+            keys = jnp.concatenate([
+                pad_plane_width(
+                    c.keys if c.keys is not None
+                    else jnp.zeros((cap, c.values.shape[1]), kd), w)
+                for c, cap in zip(cols, caps)
+            ])
+        else:
+            keys = None
+    else:
+        vals = jnp.concatenate([c.values for c in cols])
+        sizes = evalid = keys = None
+    if any(c.validity is not None for c in cols):
+        valid = jnp.concatenate([
+            c.validity if c.validity is not None else jnp.ones(cap, bool)
+            for c, cap in zip(cols, caps)
+        ])
+    else:
+        valid = None
+    if any(c.hi is not None for c in cols):
+        hi = jnp.concatenate([
+            c.hi if c.hi is not None else jnp.zeros(cap, jnp.int64)
+            for c, cap in zip(cols, caps)
+        ])
+    else:
+        hi = None
+    return Column(vals, valid, hi, sizes, evalid, keys)
+
+
+def slice_column(c: Column, cap: int) -> Column:
+    """First-cap-rows slice preserving every plane."""
+    return Column(
+        c.values[:cap],
+        None if c.validity is None else c.validity[:cap],
+        None if c.hi is None else c.hi[:cap],
+        None if c.sizes is None else c.sizes[:cap],
+        None if c.evalid is None else c.evalid[:cap],
+        None if c.keys is None else c.keys[:cap],
+    )
+
+
 def _column_flatten(c: Column):
-    return (c.values, c.validity, c.hi), None
+    return (c.values, c.validity, c.hi, c.sizes, c.evalid, c.keys), None
 
 
 def _column_unflatten(aux, children):
-    return Column(children[0], children[1], children[2])
+    return Column(*children)
 
 
 jax.tree_util.register_pytree_node(Column, _column_flatten, _column_unflatten)
@@ -162,12 +256,18 @@ class Batch:
 
     def select(self, names: Sequence[str]) -> "Batch":
         idx = [self.names.index(n) for n in names]
+        dicts = {}
+        for n in names:
+            if n in self.dicts:
+                dicts[n] = self.dicts[n]
+            if n + "#keys" in self.dicts:  # map key-plane dictionary
+                dicts[n + "#keys"] = self.dicts[n + "#keys"]
         return Batch(
             [self.names[i] for i in idx],
             [self.types[i] for i in idx],
             [self.columns[i] for i in idx],
             self.live,
-            {n: self.dicts[n] for n in names if n in self.dicts},
+            dicts,
         )
 
     def rename(self, names: Sequence[str]) -> "Batch":
@@ -176,6 +276,8 @@ class Batch:
         for old, new in zip(self.names, names):
             if old in self.dicts:
                 dicts[new] = self.dicts[old]
+            if old + "#keys" in self.dicts:  # map key-plane dictionary
+                dicts[new + "#keys"] = self.dicts[old + "#keys"]
         return Batch(names, self.types, self.columns, self.live, dicts)
 
     def with_column(self, name: str, typ: Type, col: Column, dictionary=None) -> "Batch":
@@ -209,6 +311,10 @@ class Batch:
         live = np.asarray(self.live)
         out = {}
         for name, t, c in zip(self.names, self.types, self.columns):
+            if c.sizes is not None:
+                out[name] = self._structural_to_py(name, t, c, live,
+                                                   decode_strings)
+                continue
             vals = np.asarray(c.values)[live]
             if c.hi is not None:
                 # long decimal: exact int128 value from the two limbs
@@ -244,6 +350,49 @@ class Batch:
                     arr[~valid] = None
             out[name] = arr
         return out
+
+    def _structural_to_py(self, name, t, c: Column, live, decode_strings):
+        """ARRAY column → object array of python lists; MAP → dicts."""
+        from presto_tpu.types import ArrayType, DecimalType, MapType
+
+        vals = np.asarray(c.values)[live]
+        sizes = np.asarray(c.sizes)[live]
+        evalid = None if c.evalid is None else np.asarray(c.evalid)[live]
+        rvalid = None if c.validity is None else np.asarray(c.validity)[live]
+        keys = None if c.keys is None else np.asarray(c.keys)[live]
+
+        def elem(et, x, edict):
+            if et.is_string and decode_strings and edict is not None:
+                return None if x < 0 else edict.values[x]
+            if isinstance(et, DecimalType) and decode_strings:
+                import decimal as _dec
+
+                return _dec.Decimal(int(x)).scaleb(-et.scale)
+            return x.item() if hasattr(x, "item") else x
+
+        edict = self.dicts.get(name) if decode_strings else None
+        kdict = self.dicts.get(name + "#keys") if decode_strings else None
+        rows = np.empty(len(sizes), dtype=object)
+        for i in range(len(sizes)):
+            if rvalid is not None and not rvalid[i]:
+                rows[i] = None
+                continue
+            s = int(sizes[i])
+            if isinstance(t, MapType):
+                rows[i] = {
+                    elem(t.key, keys[i, j], kdict): (
+                        elem(t.value, vals[i, j], edict)
+                        if evalid is None or evalid[i, j] else None)
+                    for j in range(s)
+                }
+            else:
+                et = t.element if isinstance(t, ArrayType) else t
+                rows[i] = [
+                    elem(et, vals[i, j], edict)
+                    if evalid is None or evalid[i, j] else None
+                    for j in range(s)
+                ]
+        return rows
 
     def to_pandas(self, decode_strings: bool = True):
         import pandas as pd

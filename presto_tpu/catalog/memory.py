@@ -19,8 +19,10 @@ from presto_tpu.types import (
     BOOLEAN,
     DATE,
     DOUBLE,
+    ArrayType,
     DecimalType,
     INTEGER,
+    MapType,
     Type,
     VARCHAR,
 )
@@ -50,6 +52,23 @@ def _infer_type(arr: np.ndarray) -> Type:
             return BIGINT
         if isinstance(first, (float, np.floating)):
             return DOUBLE
+        if isinstance(first, (list, tuple)):
+            elems = [e for v in arr if isinstance(v, (list, tuple))
+                     for e in v if e is not None]
+            if not elems:
+                et = BIGINT
+            elif isinstance(elems[0], str):
+                et = VARCHAR
+            else:
+                et = _infer_type(np.asarray(elems))
+            return ArrayType(et)
+        if isinstance(first, dict):
+            ks = [k for v in arr if isinstance(v, dict) for k in v]
+            vs = [x for v in arr if isinstance(v, dict)
+                  for x in v.values() if x is not None]
+            kt = VARCHAR if (ks and isinstance(ks[0], str)) else BIGINT
+            vt = _infer_type(np.asarray(vs)) if vs else BIGINT
+            return MapType(kt, vt)
         return VARCHAR
     if arr.dtype.kind in ("U", "S"):
         return VARCHAR
@@ -61,7 +80,9 @@ def _infer_type(arr: np.ndarray) -> Type:
 def _batches_to_host(batches):
     """Device result batches → engine-native host columns for the write
     path: {name: (values, validity|None, hi|None, Dictionary|None)}.
-    Live rows compact; padding drops."""
+    Structural (ARRAY/MAP) columns decode to object arrays of python
+    lists/dicts (re-encoded by the target table) — marker tuple
+    ("structural", object_array). Live rows compact; padding drops."""
     batches = list(batches)
     if not batches:
         return [], [], {}
@@ -74,6 +95,14 @@ def _batches_to_host(batches):
     types = list(batches[0].types)
     out = {}
     for i, name in enumerate(names):
+        if isinstance(types[i], (ArrayType, MapType)):
+            objs = [
+                b._structural_to_py(name, types[i], b.columns[i],
+                                    np.asarray(b.live), True)
+                for b in batches
+            ]
+            out[name] = ("structural", np.concatenate(objs))
+            continue
         vals, valids, his = [], [], []
         any_valid = any_hi = False
         d = None
@@ -105,6 +134,53 @@ def _batches_to_host(batches):
     return names, types, out
 
 
+def _encode_structural(col: str, arr: np.ndarray, t: Type, dicts: dict):
+    """Object array of python lists/dicts → dense padded planes:
+    (values2d, sizes, evalid|None, keys2d|None, row_validity|None).
+    String elements dictionary-encode (dicts[col], map keys under
+    col+'#keys') — the host-side mirror of the engine's structural
+    Column layout."""
+    n = len(arr)
+    rvalid = np.array([not _is_null(v) for v in arr])
+    row_validity = None if rvalid.all() else rvalid
+
+    if isinstance(t, MapType):
+        cells = [list(v.items()) if isinstance(v, dict) else [] for v in arr]
+    else:
+        cells = [list(v) if isinstance(v, (list, tuple)) else [] for v in arr]
+    sizes = np.array([len(c) for c in cells], np.int32)
+    w = int(sizes.max()) if n else 0
+
+    def encode_plane(get, et, dict_key):
+        vals = np.zeros((n, w), dtype=et.dtype)
+        evalid = np.ones((n, w), dtype=bool)
+        if et.is_string:
+            uniq = sorted({get(e) for c in cells for e in c
+                           if get(e) is not None})
+            d, _ = Dictionary.encode(np.asarray(uniq, dtype=str))
+            dicts[dict_key] = d
+        for i, c in enumerate(cells):
+            for j, e in enumerate(c):
+                v = get(e)
+                if v is None:
+                    evalid[i, j] = False
+                    continue
+                if et.is_string:
+                    vals[i, j] = dicts[dict_key].code_of(str(v))
+                elif isinstance(et, DecimalType):
+                    vals[i, j] = int(round(float(v) * 10 ** et.scale))
+                else:
+                    vals[i, j] = v
+        return vals, (None if evalid.all() else evalid)
+
+    if isinstance(t, MapType):
+        keys2d, _ = encode_plane(lambda kv: kv[0], t.key, col + "#keys")
+        vals2d, evalid = encode_plane(lambda kv: kv[1], t.value, col)
+        return vals2d, sizes, evalid, keys2d, row_validity
+    vals2d, evalid = encode_plane(lambda e: e, t.element, col)
+    return vals2d, sizes, evalid, None, row_validity
+
+
 class MemoryTable:
     def __init__(self, name: str, data: Dict[str, np.ndarray],
                  types: Optional[Dict[str, Type]] = None,
@@ -117,6 +193,9 @@ class MemoryTable:
         # long-decimal high limbs (value = hi·2³² + lo), present only for
         # columns written from precision>18 results (CTAS over sums)
         self.hi: Dict[str, Optional[np.ndarray]] = {}
+        # structural planes: col -> (sizes, evalid|None, keys2d|None);
+        # the [n, W] value plane lives in self.arrays
+        self.struct: Dict[str, tuple] = {}
         self.primary_key = primary_key
         n = None
         for col, raw in data.items():
@@ -130,9 +209,17 @@ class MemoryTable:
                 self.arrays[col] = np.ascontiguousarray(codes.astype(np.int32))
                 self.validity[col] = None
                 continue
-            arr = np.asarray(raw)
+            arr = np.asarray(raw, dtype=object) if isinstance(raw, list) else np.asarray(raw)
             n = len(arr) if n is None else n
             t = (types or {}).get(col) or _infer_type(arr)
+            if isinstance(t, (ArrayType, MapType)):
+                vals2d, sizes, evalid, keys2d, rvalid = _encode_structural(
+                    col, arr, t, self.dicts)
+                self.types[col] = t
+                self.arrays[col] = vals2d
+                self.validity[col] = rvalid
+                self.struct[col] = (sizes, evalid, keys2d)
+                continue
             valid = None
             if arr.dtype == object:
                 nulls = np.array([_is_null(v) for v in arr])
@@ -327,7 +414,17 @@ class MemoryConnector(DeviceSplitCache, Connector):
         mt = MemoryTable(name, {}, {})
         mt.types = dict(zip(names, types))
         rows = 0
-        for col, (vals, valid, hi, d) in data.items():
+        for col, payload in data.items():
+            if isinstance(payload[0], str) and payload[0] == "structural":
+                obj = payload[1]
+                vals2d, sizes, evalid, keys2d, rvalid = _encode_structural(
+                    col, obj, mt.types[col], mt.dicts)
+                mt.arrays[col] = vals2d
+                mt.validity[col] = rvalid
+                mt.struct[col] = (sizes, evalid, keys2d)
+                rows = len(obj)
+                continue
+            vals, valid, hi, d = payload
             mt.arrays[col] = vals
             mt.validity[col] = valid
             mt.hi[col] = hi
@@ -344,6 +441,10 @@ class MemoryConnector(DeviceSplitCache, Connector):
             raise KeyError(f"table not found: {name}")
         mt = self.tables[name]
         names, types, data = _batches_to_host(batches)
+        if any(isinstance(t, (ArrayType, MapType)) for t in types) or mt.struct:
+            raise NotImplementedError(
+                "INSERT INTO with ARRAY/MAP columns is not supported yet "
+                "(CTAS is)")
         target_cols = list(mt.arrays.keys())
         if len(names) != len(target_cols):
             raise ValueError(
@@ -359,7 +460,12 @@ class MemoryConnector(DeviceSplitCache, Connector):
         for src, col in zip(names, target_cols):
             vals, valid, hi, d = data[src]
             old_n = mt.num_rows
-            if d is not None and mt.dicts.get(col) is not None and d is not mt.dicts[col]:
+            if d is not None and mt.dicts.get(col) is None:
+                # string column created without a dictionary (e.g. CTAS of
+                # all-NULL varchar): adopt the incoming one so the appended
+                # codes stay decodable
+                mt.dicts[col] = d
+            elif d is not None and d is not mt.dicts[col]:
                 # re-encode incoming codes into the table's dictionary space
                 m = Dictionary.merge(mt.dicts[col], d)
                 if m is not mt.dicts[col]:
@@ -401,17 +507,23 @@ class MemoryConnector(DeviceSplitCache, Connector):
         n = t.num_rows
         lo = n * split.part // split.total
         hi = n * (split.part + 1) // split.total
-        data = {c: t.arrays[c][lo:hi] for c in columns}
+        scalar_cols = [c for c in columns if c not in t.struct]
+        data = {c: t.arrays[c][lo:hi] for c in scalar_cols}
         types = {c: t.types[c] for c in columns}
         b = Batch.from_numpy(data, types,
-                             dicts={c: t.dicts[c] for c in columns if c in t.dicts},
-                             capacity=capacity)
+                             dicts={c: t.dicts[c] for c in scalar_cols
+                                    if c in t.dicts},
+                             capacity=capacity or round_up_capacity(
+                                 max(hi - lo, 1)))
+        if len(scalar_cols) < len(columns):
+            b = self._attach_structural(b, t, columns, lo, hi)
+            b = b.select(list(columns))  # restore requested column order
         # apply column validity / long-decimal high limbs
         import jax.numpy as jnp
 
         from presto_tpu.batch import Column
 
-        for c in columns:
+        for c in [c for c in columns if c not in t.struct]:
             v = t.validity[c]
             h = t.hi.get(c)
             if v is None and h is None:
@@ -432,3 +544,57 @@ class MemoryConnector(DeviceSplitCache, Connector):
             cols[idx] = Column(col.values, vcol, hcol)
             b = Batch(b.names, b.types, cols, b.live, b.dicts)
         return b
+
+    @staticmethod
+    def _attach_structural(b: Batch, t: MemoryTable,
+                           columns: Sequence[str], lo: int, hi: int) -> Batch:
+        """Append the structural (ARRAY/MAP) columns' padded planes to a
+        batch built from the scalar columns."""
+        import jax.numpy as jnp
+
+        from presto_tpu.batch import Column
+
+        cap = b.capacity
+        n = hi - lo
+
+        def pad1(arr, dtype):
+            buf = np.zeros(cap, dtype=dtype)
+            buf[:n] = arr
+            return jnp.asarray(buf)
+
+        def pad2(arr, dtype):
+            buf = np.zeros((cap, arr.shape[1]), dtype=dtype)
+            buf[:n] = arr
+            return jnp.asarray(buf)
+
+        names = list(b.names)
+        types = list(b.types)
+        cols = list(b.columns)
+        dicts = dict(b.dicts)
+        live = b.live
+        if not any(c not in t.struct for c in columns):
+            lv = np.zeros(cap, bool)
+            lv[:n] = True
+            live = jnp.asarray(lv)
+        for c in columns:
+            if c not in t.struct:
+                continue
+            sizes, evalid, keys2d = t.struct[c]
+            vals = t.arrays[c][lo:hi]
+            rvalid = t.validity.get(c)
+            names.append(c)
+            types.append(t.types[c])
+            cols.append(Column(
+                pad2(vals, t.types[c].dtype),
+                None if rvalid is None else pad1(rvalid[lo:hi], bool),
+                None,
+                pad1(sizes[lo:hi], np.int32),
+                None if evalid is None else pad2(evalid[lo:hi], bool),
+                None if keys2d is None else pad2(
+                    keys2d[lo:hi], keys2d.dtype),
+            ))
+            if c in t.dicts:
+                dicts[c] = t.dicts[c]
+            if c + "#keys" in t.dicts:
+                dicts[c + "#keys"] = t.dicts[c + "#keys"]
+        return Batch(names, types, cols, live, dicts)

@@ -311,6 +311,12 @@ class ParquetConnector(DeviceSplitCache, Connector):
                 return 0
             raise ValueError(f"table already exists: {name}")
         names, types, data = _batches_to_host(batches)
+        from presto_tpu.types import ArrayType, MapType
+
+        if any(isinstance(t, (ArrayType, MapType)) for t in types):
+            raise NotImplementedError(
+                "parquet writer does not support ARRAY/MAP columns yet; "
+                "CTAS structural results into the memory connector")
         plain = {c: v[0] for c, v in data.items()}
         validity = {c: v[1] for c, v in data.items() if v[1] is not None}
         his = {c: v[2] for c, v in data.items() if v[2] is not None}
@@ -334,6 +340,11 @@ class ParquetConnector(DeviceSplitCache, Connector):
         from presto_tpu.catalog.memory import _batches_to_host
 
         names, types, data = _batches_to_host(batches)
+        from presto_tpu.types import ArrayType, MapType
+
+        if any(isinstance(t, (ArrayType, MapType)) for t in types):
+            raise NotImplementedError(
+                "parquet writer does not support ARRAY/MAP columns yet")
         existing = pq.read_table(path)
         target_names = list(existing.schema.names)
         if len(target_names) != len(names):

@@ -28,10 +28,17 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from presto_tpu.batch import Batch, Column, round_up_capacity
+from presto_tpu.batch import (
+    Batch,
+    Column,
+    concat_columns,
+    round_up_capacity,
+    slice_column,
+)
 from presto_tpu.connector import Catalog
 from presto_tpu.expr.compile import compile_expr, compile_predicate
 from presto_tpu.expr.ir import Constant, InputRef, substitute_params
+from presto_tpu.expr.structural import StructVal
 from presto_tpu.ops.grouping import KeyCol, StateCol, grouped_merge
 from presto_tpu.ops.join import (
     BuildTable,
@@ -61,6 +68,7 @@ from presto_tpu.plan.nodes import (
     Filter,
     HashJoin,
     Limit,
+    OneRow,
     Output,
     PlanNode,
     Project,
@@ -70,6 +78,7 @@ from presto_tpu.plan.nodes import (
     SetOp,
     Sort,
     TableScan,
+    Unnest,
     Window,
 )
 from presto_tpu.types import BIGINT, DOUBLE, DecimalType, Type
@@ -201,8 +210,22 @@ def collapse_chain(node: PlanNode) -> Tuple[PlanNode, Callable[[Batch], Batch]]:
                         cols.append(b.column(e.name))
                         if e.name in b.dicts:
                             dicts[s] = b.dicts[e.name]
+                        if e.name + "#keys" in b.dicts:
+                            dicts[s + "#keys"] = b.dicts[e.name + "#keys"]
                         continue
                     v, valid = fn(b)
+                    if isinstance(v, StructVal):
+                        # structural (ARRAY/MAP) expression result
+                        names.append(s)
+                        types.append(t)
+                        cols.append(Column(v.values, valid, sizes=v.sizes,
+                                           evalid=v.evalid, keys=v.keys))
+                        ed, kd = fn.sdicts(b)
+                        if ed is not None:
+                            dicts[s] = ed
+                        if kd is not None:
+                            dicts[s + "#keys"] = kd
+                        continue
                     v = jnp.broadcast_to(v, (b.capacity,)).astype(t.dtype)
                     names.append(s)
                     types.append(t)
@@ -302,6 +325,15 @@ def _execute_base(base: PlanNode, ctx: ExecContext) -> Iterator[Batch]:
     if isinstance(base, SetOp):
         yield from _execute_setop(base, ctx)
         return
+    if isinstance(base, Unnest):
+        yield from _execute_unnest(base, ctx)
+        return
+    if isinstance(base, OneRow):
+        cap = 128
+        live = np.zeros(cap, bool)
+        live[0] = True
+        yield Batch([], [], [], jnp.asarray(live), {})
+        return
     if isinstance(base, Sort):
         yield from _execute_sort(base, ctx)
         return
@@ -392,11 +424,106 @@ def _constraints_to_storage(scan: TableScan, handle):
     return out
 
 
+# -- unnest -----------------------------------------------------------------
+
+
+def _execute_unnest(node: Unnest, ctx: ExecContext) -> Iterator[Batch]:
+    """Expand structural columns into rows. TPU-native redesign of
+    operator/unnest/UnnestOperator.java: instead of walking per-position
+    offsets, output row (i, j) of the static [cap, W] element plane is live
+    iff j < max(sizes_src[i]); everything is broadcast + reshape, no
+    dynamic shapes (output capacity = cap * W, W = widest source plane)."""
+
+    in_stream, chain = _fused_child(node.child, ctx)
+
+    def expand(b: Batch) -> Batch:
+        b = chain(b)
+        cap = b.capacity
+        srcs = [b.column(s) for s in node.sources]
+        w = max([c.values.shape[1] for c in srcs] + [1])
+
+        counts = None
+        for c in srcs:
+            sz = c.sizes
+            if c.validity is not None:
+                sz = jnp.where(c.validity, sz, 0)
+            counts = sz if counts is None else jnp.maximum(counts, sz)
+        counts = jnp.where(b.live, counts, 0)
+        j = jnp.arange(w, dtype=jnp.int32)[None, :]
+        out_live = (j < counts[:, None]).reshape(-1)
+
+        def flat_plane(plane, width, fill):
+            """[cap, width] → [cap*w] padding columns beyond width."""
+            if width == w:
+                return plane.reshape(-1)
+            if width == 0:
+                return jnp.full(cap * w, fill, plane.dtype)
+            pad = jnp.full((cap, w - width), fill, plane.dtype)
+            return jnp.concatenate([plane, pad], axis=1).reshape(-1)
+
+        names, types, cols = [], [], []
+        dicts = {}
+        child_types = dict(node.child.output)
+        for s in node.replicate:
+            c = b.column(s)
+            cols.append(Column(
+                jnp.repeat(c.values, w, axis=0),
+                None if c.validity is None else jnp.repeat(c.validity, w),
+                None if c.hi is None else jnp.repeat(c.hi, w),
+                None if c.sizes is None else jnp.repeat(c.sizes, w),
+                None if c.evalid is None else jnp.repeat(c.evalid, w, axis=0),
+                None if c.keys is None else jnp.repeat(c.keys, w, axis=0),
+            ))
+            names.append(s)
+            types.append(child_types[s])
+            if s in b.dicts:
+                dicts[s] = b.dicts[s]
+            if s + "#keys" in b.dicts:
+                dicts[s + "#keys"] = b.dicts[s + "#keys"]
+        for src, c, syms, etypes in zip(node.sources, srcs, node.out_syms,
+                                        node.out_types):
+            cw = c.values.shape[1]
+            present = (jnp.arange(cw, dtype=jnp.int32)[None, :]
+                       < c.sizes[:, None]) if cw else jnp.zeros((cap, 0), bool)
+            evalid = present if c.evalid is None else (present & c.evalid)
+            ev_flat = flat_plane(evalid, cw, False)
+            if len(syms) == 2:  # map → (key, value)
+                cols.append(Column(flat_plane(c.keys, cw, 0),
+                                   flat_plane(present, cw, False)))
+                names.append(syms[0])
+                types.append(etypes[0])
+                if src + "#keys" in b.dicts:
+                    dicts[syms[0]] = b.dicts[src + "#keys"]
+                cols.append(Column(flat_plane(c.values, cw, 0), ev_flat))
+                names.append(syms[1])
+                types.append(etypes[1])
+                if src in b.dicts:
+                    dicts[syms[1]] = b.dicts[src]
+            else:
+                cols.append(Column(flat_plane(c.values, cw, 0), ev_flat))
+                names.append(syms[0])
+                types.append(etypes[0])
+                if src in b.dicts:
+                    dicts[syms[0]] = b.dicts[src]
+        if node.ordinality_sym:
+            ordv = jnp.broadcast_to(
+                (j + 1).astype(jnp.int64), (cap, w)).reshape(-1)
+            cols.append(Column(ordv, None))
+            names.append(node.ordinality_sym)
+            types.append(BIGINT)
+        return Batch(names, types, cols, out_live, dicts)
+
+    jfn = _node_jit(node, "expand", lambda: expand)
+    for b in in_stream:
+        yield jfn(b)
+
+
 # -- aggregation ------------------------------------------------------------
 
 _VARIANCE_FNS = {"var_samp", "var_pop", "stddev_samp", "stddev_pop"}
 _COVAR_FNS = {"covar_pop", "covar_samp", "corr"}
-_NON_DECOMPOSABLE_FNS = {"approx_percentile", "max_by", "min_by"}
+_NON_DECOMPOSABLE_FNS = {"approx_percentile", "max_by", "min_by",
+                         "array_agg"}
 
 _CHECKSUM_NULL = jnp.int64(-7046029254386353131)  # fixed NULL contribution
 
@@ -603,7 +730,9 @@ def _execute_materialized_aggregate(node: Aggregate, ctx: ExecContext) -> Iterat
     key_syms = node.group_keys
     key_types = [in_types[k] for k in key_syms]
     decomp = [a for a in node.aggs if a.fn not in _NON_DECOMPOSABLE_FNS]
-    ndec = [a for a in node.aggs if a.fn in _NON_DECOMPOSABLE_FNS]
+    ndec = [a for a in node.aggs
+            if a.fn in _NON_DECOMPOSABLE_FNS and a.fn != "array_agg"]
+    arr_aggs = [a for a in node.aggs if a.fn == "array_agg"]
     layout = _asl(decomp, in_types)
     state_types = _sts(layout, in_types)
     jchain = _node_jit(node, "mat_chain", lambda: chain)
@@ -643,8 +772,64 @@ def _execute_materialized_aggregate(node: Aggregate, ctx: ExecContext) -> Iterat
         return acc
 
     acc = _node_jit(node, "mat_compute", lambda: compute)(full)
+    if arr_aggs:
+        acc = _attach_array_aggs(acc, full, arr_aggs, key_syms)
     yield _finalize_aggregate(node, acc, layout, key_syms, key_types,
                               state_types, in_types)
+
+
+def _attach_array_aggs(acc: Batch, full: Batch, aggs, key_syms) -> Batch:
+    """array_agg: per-group element lists built host-side over the
+    materialized input (reference: ArrayAggregationFunction's grouped
+    block builders — inherently variable-width output, so it runs at the
+    single gathered task and materializes padded [groups, W] planes).
+    Element order is input order; NULL elements are kept."""
+    live = np.asarray(full.live)
+    kvals = [np.asarray(full.column(k).values)[live] for k in key_syms]
+    kvalid = [np.asarray(full.column(k).valid_mask())[live] for k in key_syms]
+    acc_live = np.asarray(acc.live)
+    gkeys = [np.asarray(acc.column(k).values) for k in key_syms]
+    gvalid = [np.asarray(acc.column(k).valid_mask()) for k in key_syms]
+    gmap = {}
+    for gi in np.nonzero(acc_live)[0]:
+        key = tuple(
+            (gv[gi].item() if gva[gi] else None)
+            for gv, gva in zip(gkeys, gvalid)
+        )
+        gmap[key] = int(gi)
+    cap = acc.capacity
+    nrows = int(live.sum())
+    row_gi = np.empty(nrows, np.int64)
+    for r in range(nrows):
+        key = tuple(
+            (kv[r].item() if kva[r] else None)
+            for kv, kva in zip(kvals, kvalid)
+        )
+        row_gi[r] = gmap[key]
+    for a in aggs:
+        c = full.column(a.arg)
+        vals = np.asarray(c.values)[live]
+        valid = np.asarray(c.valid_mask())[live]
+        sizes = np.zeros(cap, np.int32)
+        np.add.at(sizes, row_gi, 1)
+        w = max(int(sizes.max()) if cap else 0, 1)
+        plane = np.zeros((cap, w), dtype=c.values.dtype)
+        evalid = np.zeros((cap, w), bool)
+        slot = np.zeros(cap, np.int32)
+        for r in range(nrows):
+            gi = row_gi[r]
+            j = slot[gi]
+            plane[gi, j] = vals[r]
+            evalid[gi, j] = valid[r]
+            slot[gi] = j + 1
+        acc = acc.with_column(
+            a.symbol, a.type,
+            Column(jnp.asarray(plane), None,
+                   sizes=jnp.asarray(sizes),
+                   evalid=jnp.asarray(evalid)),
+            dictionary=full.dicts.get(a.arg),
+        )
+    return acc
 
 
 def _execute_aggregate(node: Aggregate, ctx: ExecContext) -> Iterator[Batch]:
@@ -909,7 +1094,11 @@ def _execute_aggregate(node: Aggregate, ctx: ExecContext) -> Iterator[Batch]:
 
             for b in stream:
                 dispatch(b)
-                confirm(block=False)
+                # while replaying spilled partitions (allow_spill=False) run
+                # synchronously: the optimistic window pins ~3× the
+                # accumulator footprint, which is exactly what the memory-
+                # constrained finalize phase cannot afford
+                confirm(block=not allow_spill)
                 # account EVERYTHING the optimistic window pins on device:
                 # the live accumulator plus each unconfirmed checkpoint and
                 # its input batch — otherwise spill/revoke fires ~depth×
@@ -999,6 +1188,15 @@ def _finalize_aggregate(node, acc, layout, key_syms, key_types, state_types, in_
             live = np.zeros(128, bool)
             live[0] = True
             for a in node.aggs:
+                from presto_tpu.types import ArrayType as _AT, MapType as _MT
+
+                if isinstance(a.type, (_AT, _MT)):
+                    cols.append(Column(
+                        jnp.zeros((128, 1), a.type.dtype),
+                        jnp.zeros(128, bool),
+                        sizes=jnp.zeros(128, jnp.int32),
+                    ))
+                    continue
                 vals = np.zeros(128, dtype=a.type.dtype)
                 if a.fn in ("count", "count_star", "count_if"):
                     cols.append(Column(jnp.asarray(vals), None))
@@ -1135,32 +1333,11 @@ def build_agg_finalizer(node, key_syms, key_types, in_types):
 def _cat_batches(bs: List[Batch]) -> Batch:
     names = bs[0].names
     types = bs[0].types
-    cols = []
-    for i in range(len(names)):
-        vals = jnp.concatenate([b.columns[i].values for b in bs])
-        if any(b.columns[i].validity is not None for b in bs):
-            valid = jnp.concatenate(
-                [
-                    b.columns[i].validity
-                    if b.columns[i].validity is not None
-                    else jnp.ones(b.capacity, bool)
-                    for b in bs
-                ]
-            )
-        else:
-            valid = None
-        if any(b.columns[i].hi is not None for b in bs):
-            hi = jnp.concatenate(
-                [
-                    b.columns[i].hi
-                    if b.columns[i].hi is not None
-                    else jnp.zeros(b.capacity, jnp.int64)
-                    for b in bs
-                ]
-            )
-        else:
-            hi = None
-        cols.append(Column(vals, valid, hi))
+    caps = [b.capacity for b in bs]
+    cols = [
+        concat_columns([b.columns[i] for b in bs], caps)
+        for i in range(len(names))
+    ]
     live = jnp.concatenate([b.live for b in bs])
     dicts = {}
     for b in bs:
@@ -1844,42 +2021,18 @@ def _execute_sort(node: Sort, ctx: ExecContext) -> Iterator[Batch]:
 
 
 def _concat2(a: Batch, b: Batch) -> Batch:
-    cols = []
-    for i in range(len(a.names)):
-        ca, cb = a.columns[i], b.columns[i]
-        vals = jnp.concatenate([ca.values, cb.values])
-        va, vb = ca.validity, cb.validity
-        if va is None and vb is None:
-            valid = None
-        else:
-            valid = jnp.concatenate(
-                [
-                    va if va is not None else jnp.ones(a.capacity, bool),
-                    vb if vb is not None else jnp.ones(b.capacity, bool),
-                ]
-            )
-        if ca.hi is None and cb.hi is None:
-            hi = None
-        else:
-            hi = jnp.concatenate(
-                [
-                    ca.hi if ca.hi is not None else jnp.zeros(a.capacity, jnp.int64),
-                    cb.hi if cb.hi is not None else jnp.zeros(b.capacity, jnp.int64),
-                ]
-            )
-        cols.append(Column(vals, valid, hi))
+    caps = [a.capacity, b.capacity]
+    cols = [
+        concat_columns([a.columns[i], b.columns[i]], caps)
+        for i in range(len(a.names))
+    ]
     dicts = dict(a.dicts)
     dicts.update(b.dicts)
     return Batch(a.names, a.types, cols, jnp.concatenate([a.live, b.live]), dicts)
 
 
 def _truncate(b: Batch, cap: int) -> Batch:
-    cols = [
-        Column(c.values[:cap],
-               None if c.validity is None else c.validity[:cap],
-               None if c.hi is None else c.hi[:cap])
-        for c in b.columns
-    ]
+    cols = [slice_column(c, cap) for c in b.columns]
     return Batch(b.names, b.types, cols, b.live[:cap], b.dicts)
 
 

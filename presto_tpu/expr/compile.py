@@ -28,10 +28,14 @@ import numpy as np
 from presto_tpu.batch import Batch
 from presto_tpu.dictionary import Dictionary
 from presto_tpu.expr.ir import Call, Constant, InputRef, RowExpression
+from presto_tpu.expr import structural as _struct
+from presto_tpu.expr.structural import StructVal
 from presto_tpu.types import (
     BOOLEAN,
     DOUBLE,
+    ArrayType,
     DecimalType,
+    MapType,
     Type,
     is_floating,
     is_integral,
@@ -339,6 +343,15 @@ class CompileContext:
             if e.fn in _STR_TO_STR:
                 nd, _, _ = self.transformed(e)
                 return nd
+            from presto_tpu.types import ArrayType as _AT, MapType as _MT
+
+            if (e.fn in ("subscript", "element_at") and e.args
+                    and isinstance(e.args[0].type, (_AT, _MT))):
+                # codes come from the structural operand's element plane
+                # (for ARRAY[...] ctors that is the merged literal+column
+                # dictionary — the plain arg walk below would return the
+                # unmerged column dict and mis-decode)
+                return _elem_dict(e.args[0], self)
             for a in e.args:
                 d = self.dict_for(a)
                 if d is not None:
@@ -363,10 +376,23 @@ class CompileContext:
 # main entry
 
 
+def _has_string_payload(t: Type) -> bool:
+    if t.is_string:
+        return True
+    if isinstance(t, ArrayType):
+        return _has_string_payload(t.element)
+    if isinstance(t, MapType):
+        return t.key.is_string or t.value.is_string
+    return False
+
+
 def string_output_dictionary(e: RowExpression) -> Dictionary | None:
-    """For a string-typed expression whose string *values* are all literals
-    (CASE tags and the like), build the output dictionary at plan time."""
-    if not e.type.is_string or isinstance(e, InputRef):
+    """For an expression whose string *values* are all literals (CASE tags,
+    ARRAY['a','b'] elements, map() keys and the like), build the
+    dictionary those literals resolve against at plan time. Non-string
+    output types still need this when structural literals appear inside
+    (element_at(map(ARRAY['a'], ...), 'a') is DOUBLE-typed)."""
+    if isinstance(e, InputRef):
         return None
     consts: list[str] = []
 
@@ -377,9 +403,9 @@ def string_output_dictionary(e: RowExpression) -> Dictionary | None:
             for i, a in enumerate(x.args):
                 # string constants in comparison/LIKE/IN positions resolve
                 # against column dictionaries, not the output dictionary
-                in_value_pos = x.fn in ("if", "coalesce", "nullif") or (
-                    value_pos and x.fn == "cast"
-                )
+                in_value_pos = x.fn in (
+                    "if", "coalesce", "nullif", "array_ctor", "repeat", "map"
+                ) or (value_pos and x.fn == "cast")
                 walk(a, in_value_pos and a.type.is_string)
 
     walk(e, True)
@@ -398,13 +424,22 @@ def compile_expr(e: RowExpression):
         ctx = CompileContext(batch, out_dict)
         return _eval(e, ctx)
 
-    fn.out_dict = out_dict
-    if out_dict is None and e.type.is_string and not isinstance(e, InputRef):
+    fn.out_dict = None
+    if isinstance(e.type, (ArrayType, MapType)) and not isinstance(e, InputRef):
+        # structural output: (element_dict, key_dict) resolved at trace time
+        def sdicts(batch: Batch):
+            return struct_dicts(e, CompileContext(batch, out_dict))
+
+        fn.sdicts = sdicts
+    if e.type.is_string and not isinstance(e, InputRef):
         # dictionary of the output column depends on the input batch's
-        # dictionaries (string transforms); resolved at trace time — batch
-        # dicts are static pytree aux, so this is jit-cache coherent
+        # dictionaries (string transforms, structural subscripts); resolved
+        # at trace time — batch dicts are static pytree aux, so this is
+        # jit-cache coherent. All-literal expressions (CASE tags) fall back
+        # to the plan-time literal dictionary.
         def dyn_dict(batch: Batch):
-            return CompileContext(batch, None).dict_for(e)
+            d = CompileContext(batch, out_dict).dict_for(e)
+            return d if d is not None else out_dict
 
         fn.dyn_dict = dyn_dict
     return fn
@@ -413,9 +448,10 @@ def compile_expr(e: RowExpression):
 def compile_predicate(e: RowExpression):
     """Return fn(batch) -> bool mask (NULL → False, like Presto filters:
     operator/project/PageFilter discards non-TRUE rows)."""
+    out_dict = string_output_dictionary(e)
 
     def fn(batch: Batch):
-        ctx = CompileContext(batch)
+        ctx = CompileContext(batch, out_dict)
         v, valid = _eval(e, ctx)
         mask = v.astype(bool)
         if valid is not None:
@@ -432,6 +468,8 @@ def compile_predicate(e: RowExpression):
 def _eval(e: RowExpression, ctx: CompileContext):
     if isinstance(e, InputRef):
         c = ctx.batch.column(e.name)
+        if c.sizes is not None:
+            return StructVal(c.values, c.sizes, c.evalid, c.keys), c.validity
         if c.hi is not None:
             # long decimal (two-limb int128): expressions compute over the
             # combined float64 unscaled value — exact below 2^53, the lossy
@@ -485,8 +523,26 @@ _CMP = {
 }
 
 
+_STRUCT_ONLY_FNS = {
+    "array_ctor", "array_position", "array_min", "array_max", "array_sum",
+    "array_average", "array_distinct", "array_sort", "slice", "sequence",
+    "repeat", "map", "map_keys", "map_values",
+}
+# polymorphic names: structural only when the first arg is ARRAY/MAP
+_STRUCT_POLY_FNS = {"cardinality", "contains", "concat", "element_at",
+                    "subscript"}
+
+
 def _eval_call(e: Call, ctx: CompileContext):
     fn = e.fn
+
+    # ---- structural (ARRAY / MAP) ---------------------------------------
+    if fn in _STRUCT_ONLY_FNS or (
+        fn in _STRUCT_POLY_FNS
+        and e.args
+        and isinstance(e.args[0].type, (ArrayType, MapType))
+    ):
+        return _eval_structural(e, ctx)
 
     # ---- comparisons (incl. dictionary-code string compares) -------------
     if fn in _CMP:
@@ -892,6 +948,200 @@ def _eval_call(e: Call, ctx: CompileContext):
         return _days_from_civil_vec(y2, m2, d2), valid
 
     raise NotImplementedError(f"scalar function not implemented: {fn}")
+
+
+# ---------------------------------------------------------------------------
+# structural (ARRAY / MAP) evaluation
+
+
+def _array_ctor_dict(e: Call, ctx: CompileContext) -> Dictionary | None:
+    """Element dictionary of ARRAY[...] over string operands: the UNION of
+    every operand column's dictionary and the literal elements — a literal
+    absent from a column dictionary must still get a real code (operand
+    codes are remapped into this union at evaluation time)."""
+    import numpy as np
+
+    d = None
+    for a in e.args:
+        if isinstance(a, Constant):
+            continue
+        ad = ctx.dict_for(a)
+        if ad is not None:
+            d = ad if d is None or d is ad else Dictionary.merge(d, ad)
+    lits = sorted({str(a.value) for a in e.args
+                   if isinstance(a, Constant) and a.value is not None})
+    if lits:
+        ld, _ = Dictionary.encode(np.asarray(lits, dtype=str))
+        d = ld if d is None else Dictionary.merge(d, ld)
+    return d
+
+
+def _elem_dict(e: RowExpression, ctx: CompileContext) -> Dictionary | None:
+    """Dictionary of a structural expression's (string) element plane."""
+    if isinstance(e, InputRef):
+        return ctx.batch.dict_of(e.name)
+    if isinstance(e, Call):
+        if e.fn == "array_ctor" and e.type.element.is_string:
+            return _array_ctor_dict(e, ctx)
+        if e.fn == "map":
+            return _elem_dict(e.args[1], ctx)
+        if e.fn == "map_keys":
+            return _key_dict(e.args[0], ctx)
+        for a in e.args:
+            if isinstance(a.type, (ArrayType, MapType)) or a.type.is_string:
+                d = _elem_dict(a, ctx) if isinstance(
+                    a.type, (ArrayType, MapType)) else ctx.dict_for(a)
+                if d is not None:
+                    return d
+    return ctx.out_dict
+
+
+def _key_dict(e: RowExpression, ctx: CompileContext) -> Dictionary | None:
+    """Dictionary of a map expression's (string) key plane."""
+    if isinstance(e, InputRef):
+        return ctx.batch.dict_of(e.name + "#keys")
+    if isinstance(e, Call):
+        if e.fn == "map":
+            return _elem_dict(e.args[0], ctx)
+        for a in e.args:
+            if isinstance(a.type, MapType):
+                d = _key_dict(a, ctx)
+                if d is not None:
+                    return d
+    return None
+
+
+def struct_dicts(e: RowExpression, ctx: CompileContext):
+    """(element_dict, key_dict) a projected structural column should carry."""
+    t = e.type
+    ed = kd = None
+    if isinstance(t, ArrayType) and t.element.is_string:
+        ed = _elem_dict(e, ctx)
+    if isinstance(t, MapType):
+        if t.value.is_string:
+            ed = _elem_dict(e, ctx)
+        if t.key.is_string:
+            kd = _key_dict(e, ctx)
+    return ed, kd
+
+
+def _eval_struct_const(a: Constant, ctx, d: Dictionary | None):
+    """A scalar constant appearing inside a structural expression; string
+    constants resolve against the element/key dictionary `d`."""
+    if a.value is None:
+        cap = ctx.batch.capacity
+        return jnp.zeros(cap, a.type.dtype), jnp.zeros(cap, bool)
+    if a.type.is_string:
+        if d is None:
+            d = ctx.out_dict
+        if d is None:
+            raise ValueError("string constant in structural expression "
+                             "without a dictionary context")
+        return jnp.asarray(d.code_of(str(a.value)), jnp.int32), None
+    return _eval_constant(a, ctx, None)
+
+
+def _eval_structural(e: Call, ctx: CompileContext):
+    fn = e.fn
+    cap = ctx.batch.capacity
+
+    def scalar_arg(a: RowExpression, d: Dictionary | None = None):
+        if isinstance(a, Constant):
+            v, valid = _eval_struct_const(a, ctx, d)
+        else:
+            v, valid = _eval(a, ctx)
+        return jnp.broadcast_to(v, (cap,)), valid
+
+    if fn == "array_ctor":
+        et = e.type.element
+        if et.is_string:
+            # unified element dictionary: operand codes remap into the
+            # union so column values and literals share one code space
+            d = _array_ctor_dict(e, ctx)
+            parts = []
+            for a in e.args:
+                if isinstance(a, Constant):
+                    v, valid = _eval_struct_const(a, ctx, d)
+                else:
+                    v, valid = _eval(a, ctx)
+                    ad = ctx.dict_for(a)
+                    if ad is not None and ad is not d:
+                        remap = jnp.asarray(ad.map_to(d))
+                        v = remap[v.astype(jnp.int32) + 1]
+                parts.append((jnp.broadcast_to(v, (cap,)), valid))
+            return _struct.array_ctor(parts, cap, et.dtype), None
+        parts = [scalar_arg(a) for a in e.args]
+        return _struct.array_ctor(parts, cap, et.dtype), None
+
+    if fn == "sequence":
+        lo = int(e.args[0].value)
+        hi = int(e.args[1].value)
+        step = int(e.args[2].value) if len(e.args) > 2 else (
+            1 if hi >= lo else -1)
+        return _struct.sequence(lo, hi, step, cap), None
+
+    if fn == "repeat":
+        n = int(e.args[1].value)
+        et = e.type.element
+        d = _elem_dict(e, ctx) if et.is_string else None
+        v, valid = scalar_arg(e.args[0], d)
+        return _struct.repeat_val(v, valid, n, cap, et.dtype), None
+
+    if fn == "map":
+        ksv, kvalid = _eval(e.args[0], ctx)
+        vsv, vvalid = _eval(e.args[1], ctx)
+        return _struct.map_from_arrays(ksv, vsv), _and_valid(kvalid, vvalid)
+
+    # remaining forms evaluate their structural operand first
+    sv, rvalid = _eval(e.args[0], ctx)
+    t0 = e.args[0].type
+
+    if fn == "cardinality":
+        return _struct.cardinality(sv, rvalid)
+    if fn in ("subscript", "element_at"):
+        if isinstance(t0, MapType):
+            d = _key_dict(e.args[0], ctx) if t0.key.is_string else None
+            kv, kvalid = scalar_arg(e.args[1], d)
+            return _struct.map_element_at(sv, kv, kvalid, rvalid)
+        iv, ivalid = scalar_arg(e.args[1])
+        return _struct.subscript(sv, iv.astype(jnp.int64), ivalid, rvalid,
+                                 null_oob=(fn == "element_at"))
+    if fn == "contains":
+        d = _elem_dict(e.args[0], ctx) if t0.element.is_string else None
+        xv, xvalid = scalar_arg(e.args[1], d)
+        return _struct.contains(sv, xv, xvalid, rvalid)
+    if fn == "array_position":
+        d = _elem_dict(e.args[0], ctx) if t0.element.is_string else None
+        xv, xvalid = scalar_arg(e.args[1], d)
+        return _struct.array_position(sv, xv, xvalid, rvalid)
+    if fn in ("array_min", "array_max"):
+        return _struct.array_minmax(sv, rvalid, fn == "array_min")
+    if fn in ("array_sum", "array_average"):
+        return _struct.array_sum(sv, rvalid, e.type.dtype,
+                                 fn == "array_average")
+    if fn == "array_sort":
+        return _struct.array_sort(sv), rvalid
+    if fn == "array_distinct":
+        return _struct.array_distinct(sv), rvalid
+    if fn == "slice":
+        sv0 = sv
+        s, svalid = scalar_arg(e.args[1])
+        ln, lvalid = scalar_arg(e.args[2])
+        out = _struct.slice_array(sv0, s.astype(jnp.int64),
+                                  ln.astype(jnp.int64))
+        return out, _and_valid(rvalid, _and_valid(svalid, lvalid))
+    if fn == "concat":
+        out, valid = sv, rvalid
+        for a in e.args[1:]:
+            asv, avalid = _eval(a, ctx)
+            out = _struct.concat_arrays(out, asv)
+            valid = _and_valid(valid, avalid)
+        return out, valid
+    if fn == "map_keys":
+        return _struct.map_keys(sv), rvalid
+    if fn == "map_values":
+        return _struct.map_values(sv), rvalid
+    raise NotImplementedError(f"structural function not implemented: {fn}")
 
 
 def _days_in_month(y, m):

@@ -1,0 +1,320 @@
+"""ARRAY / MAP expression evaluation over the dense padded layout.
+
+Reference surface: operator/scalar/ArrayFunctions + MapFunctions and the
+block-level ColumnarArray/ColumnarMap (presto-main/.../operator/scalar/,
+presto-spi/.../block/ColumnarArray.java). The reference walks
+offsets-into-flat-blocks per position; here every function is one
+vectorized op over the whole [capacity, W] plane:
+
+- an array value is StructVal(values[cap, W], sizes[cap], evalid, keys)
+  where W is the static per-batch width;
+- "present" elements are those with column index < sizes[row]; present
+  elements may still be SQL NULL via the evalid plane;
+- maps carry an aligned keys plane (map keys are non-null).
+
+Sorting/dedup inside arrays uses `jax.lax.sort` along the W axis with
+absent/null ranks as leading keys — the same scatter-free style as the
+engine's GROUP BY (ops/grouping.py).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from presto_tpu.types import (
+    ArrayType,
+    MapType,
+    Type,
+)
+
+
+class StructVal(NamedTuple):
+    """Evaluated array/map expression: the structural planes of a Column.
+    Row-level validity travels separately (like scalar evaluation)."""
+
+    values: jnp.ndarray                 # [cap, W] element values
+    sizes: jnp.ndarray                  # [cap] int32 cardinalities
+    evalid: Optional[jnp.ndarray]       # [cap, W] element validity or None
+    keys: Optional[jnp.ndarray] = None  # [cap, W] map keys or None
+
+    @property
+    def width(self) -> int:
+        return self.values.shape[1]
+
+    def present(self) -> jnp.ndarray:
+        """[cap, W] mask of in-size element slots."""
+        w = self.values.shape[1]
+        return jnp.arange(w, dtype=jnp.int32)[None, :] < self.sizes[:, None]
+
+    def element_valid(self) -> jnp.ndarray:
+        """[cap, W] mask of present AND non-null elements."""
+        p = self.present()
+        return p if self.evalid is None else (p & self.evalid)
+
+
+def pad_plane_width(plane, w: int, fill=0):
+    """Widen a [n, w0] plane to [n, w] with `fill` padding."""
+    w0 = plane.shape[1]
+    if w0 == w:
+        return plane
+    pad = jnp.full((plane.shape[0], w - w0), fill, plane.dtype)
+    return jnp.concatenate([plane, pad], axis=1)
+
+
+def _minmax_ident(dtype, want_min: bool):
+    if jnp.issubdtype(dtype, jnp.floating):
+        return jnp.array(jnp.inf if want_min else -jnp.inf, dtype)
+    if dtype == jnp.bool_:
+        return jnp.array(want_min, dtype)
+    info = jnp.iinfo(dtype)
+    return jnp.array(info.max if want_min else info.min, dtype)
+
+
+def array_ctor(parts, cap: int, dtype) -> StructVal:
+    """ARRAY[e1, .., eN]: stack N evaluated scalars into a [cap, N] plane.
+    parts: list of (values, validity|None)."""
+    if not parts:
+        return StructVal(jnp.zeros((cap, 0), dtype),
+                         jnp.zeros(cap, jnp.int32), None)
+    vals = jnp.stack(
+        [jnp.broadcast_to(v, (cap,)).astype(dtype) for v, _ in parts], axis=1)
+    if any(valid is not None for _, valid in parts):
+        evalid = jnp.stack(
+            [jnp.ones(cap, bool) if valid is None
+             else jnp.broadcast_to(valid, (cap,)) for _, valid in parts],
+            axis=1)
+    else:
+        evalid = None
+    sizes = jnp.full(cap, len(parts), jnp.int32)
+    return StructVal(vals, sizes, evalid)
+
+
+def subscript(sv: StructVal, idx, idx_valid, rvalid, *, null_oob: bool):
+    """arr[i] (1-based; negative counts from the end, element_at
+    semantics). Returns (values, validity). Out-of-bounds access yields
+    NULL (`null_oob` distinguishes element_at from [] only in spirit —
+    with no exception channel on-device, both return NULL)."""
+    sizes = sv.sizes
+    pos = jnp.where(idx >= 0, idx - 1, sizes.astype(idx.dtype) + idx)
+    in_range = (pos >= 0) & (pos < sizes.astype(pos.dtype))
+    posc = jnp.clip(pos, 0, max(sv.width - 1, 0)).astype(jnp.int32)
+    if sv.width == 0:
+        out = jnp.zeros(sizes.shape[0], sv.values.dtype)
+        return out, jnp.zeros(sizes.shape[0], bool)
+    out = jnp.take_along_axis(sv.values, posc[:, None], axis=1)[:, 0]
+    valid = in_range
+    if sv.evalid is not None:
+        ev = jnp.take_along_axis(sv.evalid, posc[:, None], axis=1)[:, 0]
+        valid = valid & ev
+    if idx_valid is not None:
+        valid = valid & idx_valid
+    if rvalid is not None:
+        valid = valid & rvalid
+    return out, valid
+
+
+def map_element_at(sv: StructVal, key, key_valid, rvalid):
+    """element_at(map, k): first matching key's value, NULL if absent."""
+    match = (sv.keys == key[:, None] if key.ndim else sv.keys == key)
+    match = match & sv.present()
+    found = jnp.any(match, axis=1)
+    j = jnp.argmax(match, axis=1).astype(jnp.int32)
+    if sv.width == 0:
+        out = jnp.zeros(sv.sizes.shape[0], sv.values.dtype)
+        return out, jnp.zeros(sv.sizes.shape[0], bool)
+    out = jnp.take_along_axis(sv.values, j[:, None], axis=1)[:, 0]
+    valid = found
+    if sv.evalid is not None:
+        ev = jnp.take_along_axis(sv.evalid, j[:, None], axis=1)[:, 0]
+        valid = valid & ev
+    if key_valid is not None:
+        valid = valid & key_valid
+    if rvalid is not None:
+        valid = valid & rvalid
+    return out, valid
+
+
+def cardinality(sv: StructVal, rvalid):
+    return sv.sizes.astype(jnp.int64), rvalid
+
+
+def contains(sv: StructVal, x, x_valid, rvalid):
+    m = (sv.values == (x[:, None] if getattr(x, "ndim", 0) else x))
+    m = m & sv.element_valid()
+    out = jnp.any(m, axis=1)
+    valid = rvalid
+    if x_valid is not None:
+        valid = x_valid if valid is None else (valid & x_valid)
+    return out, valid
+
+
+def array_position(sv: StructVal, x, x_valid, rvalid):
+    m = (sv.values == (x[:, None] if getattr(x, "ndim", 0) else x))
+    m = m & sv.element_valid()
+    found = jnp.any(m, axis=1)
+    pos = jnp.where(found, jnp.argmax(m, axis=1) + 1, 0).astype(jnp.int64)
+    valid = rvalid
+    if x_valid is not None:
+        valid = x_valid if valid is None else (valid & x_valid)
+    return pos, valid
+
+
+def array_minmax(sv: StructVal, rvalid, want_min: bool):
+    """array_min/array_max: NULL for empty arrays or arrays containing a
+    NULL element (Presto ArrayMinMaxUtils semantics)."""
+    ident = _minmax_ident(sv.values.dtype, want_min)
+    ev = sv.element_valid()
+    masked = jnp.where(ev, sv.values, ident)
+    out = jnp.min(masked, axis=1) if want_min else jnp.max(masked, axis=1)
+    has_null = jnp.any(sv.present() & ~ev, axis=1)
+    valid = (sv.sizes > 0) & ~has_null
+    if rvalid is not None:
+        valid = valid & rvalid
+    return out, valid
+
+
+def array_sum(sv: StructVal, rvalid, dtype, average: bool):
+    """array_sum/array_average over non-null elements (NULL elements are
+    skipped; all-null/empty arrays yield NULL)."""
+    ev = sv.element_valid()
+    contrib = jnp.where(ev, sv.values.astype(dtype), jnp.zeros((), dtype))
+    total = jnp.sum(contrib, axis=1)
+    n = jnp.sum(ev, axis=1)
+    if average:
+        total = total / jnp.maximum(n, 1).astype(dtype)
+    valid = n > 0
+    if rvalid is not None:
+        valid = valid & rvalid
+    return total, valid
+
+
+def concat_arrays(a: StructVal, b: StructVal) -> StructVal:
+    """a || b: out[j] = j < |a| ? a[j] : b[j - |a|]; width Wa + Wb."""
+    wa, wb = a.width, b.width
+    w = wa + wb
+    cap = a.sizes.shape[0]
+    j = jnp.arange(w, dtype=jnp.int32)[None, :]
+    sa = a.sizes[:, None]
+    from_a = j < sa
+    ja = jnp.clip(j, 0, max(wa - 1, 0))
+    jb = jnp.clip(j - sa, 0, max(wb - 1, 0))
+    def _plane(pa, pb, dtype):
+        va = jnp.take_along_axis(pa, ja, axis=1) if wa else jnp.zeros((cap, w), dtype)
+        vb = jnp.take_along_axis(pb, jb, axis=1) if wb else jnp.zeros((cap, w), dtype)
+        return jnp.where(from_a, va, vb)
+    vals = _plane(a.values, b.values.astype(a.values.dtype), a.values.dtype)
+    if a.evalid is not None or b.evalid is not None:
+        ea = a.evalid if a.evalid is not None else jnp.ones((cap, max(wa, 1)), bool)[:, :wa]
+        eb = b.evalid if b.evalid is not None else jnp.ones((cap, max(wb, 1)), bool)[:, :wb]
+        evalid = _plane(ea, eb, jnp.bool_)
+    else:
+        evalid = None
+    return StructVal(vals, a.sizes + b.sizes, evalid)
+
+
+def _sort_planes(sv: StructVal):
+    """Sort elements along W: present non-null ascending, NULL elements
+    after them, absent slots last. Returns (rank, values, evalid_sorted)."""
+    p = sv.present()
+    ev = sv.element_valid()
+    # 0 = valid element, 1 = null element, 2 = absent slot
+    rank = jnp.where(ev, 0, jnp.where(p, 1, 2)).astype(jnp.int32)
+    rank_s, vals_s = jax.lax.sort((rank, sv.values), dimension=1, num_keys=2)
+    return rank_s, vals_s
+
+
+def array_sort(sv: StructVal) -> StructVal:
+    """array_sort: ascending, NULL elements last (Presto array_sort)."""
+    rank_s, vals_s = _sort_planes(sv)
+    evalid = rank_s == 0 if sv.evalid is not None else None
+    return StructVal(vals_s, sv.sizes, evalid)
+
+
+def array_distinct(sv: StructVal) -> StructVal:
+    """array_distinct (order: sorted ascending, one NULL kept last —
+    documented deviation from the reference's first-occurrence order; SQL
+    imposes no order on array_distinct results and a sorted canonical
+    order is what the scatter-free layout produces naturally)."""
+    rank_s, vals_s = _sort_planes(sv)
+    w = sv.width
+    if w == 0:
+        return sv
+    prev_same = jnp.zeros_like(rank_s, dtype=bool).at[:, 1:].set(
+        (vals_s[:, 1:] == vals_s[:, :-1]) & (rank_s[:, 1:] == rank_s[:, :-1])
+    )
+    keep = (rank_s < 2) & ~prev_same
+    # push dropped slots to the end, preserving sorted order of the kept
+    rank2 = jnp.where(keep, rank_s, 2)
+    rank_f, vals_f = jax.lax.sort((rank2, vals_s), dimension=1, num_keys=2)
+    sizes = jnp.sum(keep, axis=1).astype(jnp.int32)
+    evalid = rank_f == 0 if sv.evalid is not None else None
+    return StructVal(vals_f, sizes, evalid)
+
+
+def slice_array(sv: StructVal, start, length) -> StructVal:
+    """slice(arr, start, length): 1-based start; negative start counts
+    from the end (Presto ArraySliceFunction). A start that falls outside
+    the array (including a negative start reaching before the first
+    element) yields an empty array — the on-device stand-in for the
+    reference's invalid-start error."""
+    sizes = sv.sizes.astype(jnp.int64)
+    s0 = jnp.where(start >= 0, start - 1, sizes + start)
+    ok = (s0 >= 0) & (start != 0) & (length >= 0)
+    w = sv.width
+    j = jnp.arange(w, dtype=jnp.int64)[None, :]
+    src = s0[:, None] + j  # front-aligned: out slot j reads src s0+j
+    in_src = (ok[:, None] & (src < sizes[:, None]) & (j < length[:, None]))
+    srcc = jnp.clip(src, 0, max(w - 1, 0)).astype(jnp.int32)
+    vals = jnp.take_along_axis(sv.values, srcc, axis=1)
+    new_sizes = jnp.sum(in_src, axis=1).astype(jnp.int32)
+    if sv.evalid is not None:
+        evalid = jnp.take_along_axis(sv.evalid, srcc, axis=1) & in_src
+    else:
+        evalid = in_src
+    return StructVal(vals, new_sizes, evalid)
+
+
+def sequence(lo: int, hi: int, step: int, cap: int) -> StructVal:
+    """sequence(lo, hi[, step]) with constant bounds (static W)."""
+    if step == 0:
+        raise ValueError("sequence step must not be zero")
+    n = max(0, (hi - lo) // step + 1) if (hi - lo) * step >= 0 else 0
+    vals = jnp.broadcast_to(
+        (lo + step * jnp.arange(n, dtype=jnp.int64))[None, :], (cap, n))
+    return StructVal(vals, jnp.full(cap, n, jnp.int32), None)
+
+
+def repeat_val(v, v_valid, n: int, cap: int, dtype) -> StructVal:
+    vals = jnp.broadcast_to(
+        jnp.broadcast_to(v, (cap,)).astype(dtype)[:, None], (cap, n))
+    evalid = None
+    if v_valid is not None:
+        evalid = jnp.broadcast_to(v_valid[:, None], (cap, n))
+    return StructVal(vals, jnp.full(cap, n, jnp.int32), evalid)
+
+
+def map_from_arrays(k: StructVal, v: StructVal) -> StructVal:
+    """map(array, array): aligned planes; sizes from the key array.
+
+    With no exception channel on-device, a cardinality mismatch cannot
+    raise like the reference's 'Key and value arrays must be the same
+    length' — instead keys beyond the value cardinality map to NULL
+    values (element validity is bounded by the value array's sizes)."""
+    w = max(k.width, v.width)
+    keys = pad_plane_width(k.values, w)
+    vals = pad_plane_width(v.values, w)
+    in_vals = v.present() if v.evalid is None else v.element_valid()
+    evalid = pad_plane_width(in_vals, w, fill=False)
+    return StructVal(vals, k.sizes, evalid, keys=keys)
+
+
+def map_keys(sv: StructVal) -> StructVal:
+    return StructVal(sv.keys, sv.sizes, None)
+
+
+def map_values(sv: StructVal) -> StructVal:
+    return StructVal(sv.values, sv.sizes, sv.evalid)

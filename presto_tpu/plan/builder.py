@@ -33,6 +33,7 @@ from presto_tpu.plan.nodes import (
     Filter,
     HashJoin,
     Limit,
+    OneRow,
     Output,
     PlanNode,
     Project,
@@ -42,6 +43,7 @@ from presto_tpu.plan.nodes import (
     Sort,
     SortItem,
     TableScan,
+    Unnest,
 )
 from presto_tpu.sql import ast
 from presto_tpu.types import (
@@ -49,8 +51,10 @@ from presto_tpu.types import (
     BOOLEAN,
     DATE,
     DOUBLE,
+    ArrayType,
     DecimalType,
     INTEGER,
+    MapType,
     TIMESTAMP,
     Type,
     VARCHAR,
@@ -182,6 +186,8 @@ _AGG_FUNCS = {
     "approx_distinct", "approx_percentile",
     # argmax family (AbstractMinMaxBy)
     "max_by", "min_by",
+    # structural (ArrayAggregationFunction — materialized single-task here)
+    "array_agg",
 }
 
 # aliases → canonical names
@@ -260,6 +266,10 @@ class ExprAnalyzer:
         if op in ("eq", "ne", "lt", "le", "gt", "ge"):
             l = self.analyze(node.left)
             r = self.analyze(node.right)
+            if isinstance(l.type, (ArrayType, MapType)) or isinstance(
+                    r.type, (ArrayType, MapType)):
+                raise AnalysisError(
+                    "comparisons on ARRAY/MAP values are not supported")
             l, r = self._align_comparable(l, r)
             return Call(BOOLEAN, op, (l, r))
         if op in ("add", "sub", "mul", "div", "mod"):
@@ -267,6 +277,8 @@ class ExprAnalyzer:
         if op == "concat":
             l = self.analyze(node.left)
             r = self.analyze(node.right)
+            if isinstance(l.type, ArrayType):
+                return self._an_structural_fn("concat", (l, r))
             # flatten nested concat so a || b || c becomes one call, and fold
             # all-constant concat to a literal
             args = []
@@ -450,6 +462,9 @@ class ExprAnalyzer:
         if name in _AGG_FUNCS:
             raise AnalysisError(f"aggregate {name}() not allowed here")
         args = tuple(self.analyze(a) for a in node.args)
+        structural = self._an_structural_fn(name, args)
+        if structural is not None:
+            return structural
         if name == "abs":
             return Call(args[0].type, "abs", args)
         if name in ("sqrt", "exp", "ln", "power", "pow"):
@@ -551,6 +566,102 @@ class ExprAnalyzer:
             return Call(DATE, "date_add_unit", args)
         raise AnalysisError(f"unknown function {name}")
 
+    def _an_structural_fn(self, name: str, args) -> Optional[RowExpression]:
+        """ARRAY/MAP function typing (spi/type/ArrayType + MapType;
+        scalar surface of operator/scalar array/map functions). Returns
+        None when `name` is not structural (or is a polymorphic name like
+        contains/concat applied to non-structural operands)."""
+        t0 = args[0].type if args else None
+
+        if name == "array_ctor":
+            et = None
+            for a in args:
+                if isinstance(a, Constant) and a.value is None:
+                    continue
+                et = a.type if et is None else common_super_type(et, a.type)
+            et = et or BIGINT
+            coerced = []
+            for a in args:
+                if isinstance(a, Constant) and a.value is None:
+                    coerced.append(Constant(et, None))
+                elif isinstance(et, DecimalType):
+                    coerced.append(self._rescale(a, et.scale))
+                elif et is DOUBLE and a.type is not DOUBLE:
+                    coerced.append(self._to_double(a))
+                else:
+                    coerced.append(a)
+            return Call(ArrayType(et), "array_ctor", tuple(coerced))
+
+        if name == "subscript":
+            if isinstance(t0, ArrayType):
+                return Call(t0.element, "subscript", args)
+            if isinstance(t0, MapType):
+                return Call(t0.value, "element_at", args)
+            raise AnalysisError(f"[] requires ARRAY or MAP, got {t0}")
+        if name == "element_at":
+            if isinstance(t0, ArrayType):
+                return Call(t0.element, "element_at", args)
+            if isinstance(t0, MapType):
+                return Call(t0.value, "element_at", args)
+            raise AnalysisError(f"element_at requires ARRAY or MAP, got {t0}")
+        if name == "cardinality":
+            if not isinstance(t0, (ArrayType, MapType)):
+                raise AnalysisError(f"cardinality requires ARRAY or MAP, got {t0}")
+            return Call(BIGINT, "cardinality", args)
+        if name == "contains" and isinstance(t0, ArrayType):
+            return Call(BOOLEAN, "contains", args)
+        if name == "array_position":
+            return Call(BIGINT, "array_position", args)
+        if name in ("array_min", "array_max"):
+            if not isinstance(t0, ArrayType):
+                raise AnalysisError(f"{name} requires ARRAY, got {t0}")
+            return Call(t0.element, name, args)
+        if name == "array_sum":
+            if not isinstance(t0, ArrayType):
+                raise AnalysisError(f"array_sum requires ARRAY, got {t0}")
+            return Call(
+                DOUBLE if is_floating(t0.element) else BIGINT, name, args)
+        if name == "array_average":
+            return Call(DOUBLE, name, args)
+        if name in ("array_distinct", "array_sort"):
+            if not isinstance(t0, ArrayType):
+                raise AnalysisError(f"{name} requires ARRAY, got {t0}")
+            return Call(t0, name, args)
+        if name == "slice" and isinstance(t0, ArrayType):
+            return Call(t0, "slice", args)
+        if name == "sequence":
+            for a in args:
+                if not isinstance(a, Constant):
+                    raise AnalysisError(
+                        "sequence bounds must be constants (static array "
+                        "width under XLA)")
+            return Call(ArrayType(BIGINT), "sequence", args)
+        if name == "repeat":
+            if not isinstance(args[1], Constant):
+                raise AnalysisError("repeat count must be a constant")
+            return Call(ArrayType(args[0].type), "repeat", args)
+        if name == "map":
+            if len(args) != 2 or not all(isinstance(a.type, ArrayType) for a in args):
+                raise AnalysisError("map() expects two ARRAY arguments")
+            return Call(MapType(args[0].type.element, args[1].type.element),
+                        "map", args)
+        if name == "map_keys":
+            if not isinstance(t0, MapType):
+                raise AnalysisError(f"map_keys requires MAP, got {t0}")
+            return Call(ArrayType(t0.key), "map_keys", args)
+        if name == "map_values":
+            if not isinstance(t0, MapType):
+                raise AnalysisError(f"map_values requires MAP, got {t0}")
+            return Call(ArrayType(t0.value), "map_values", args)
+        if name == "concat" and isinstance(t0, ArrayType):
+            out = t0
+            for a in args[1:]:
+                if not isinstance(a.type, ArrayType):
+                    raise AnalysisError("concat mixes ARRAY and non-ARRAY")
+                out = ArrayType(common_super_type(out.element, a.type.element))
+            return Call(out, "concat", args)
+        return None
+
     def _an_ScalarSubquery(self, node: ast.ScalarSubquery) -> RowExpression:
         return self.planner.plan_scalar_subquery(node.query)
 
@@ -650,9 +761,87 @@ class Planner:
             return RelationPlan(out.child, Scope(fields), rows=1e5)
         if isinstance(rel, ast.Join):
             return self.plan_join(rel)
+        if isinstance(rel, ast.UnnestRelation):
+            # top-level FROM UNNEST(ARRAY[...]): expand over one synthetic row
+            return self.plan_unnest(rel, None)
         raise AnalysisError(f"unsupported relation {type(rel).__name__}")
 
+    def plan_unnest(self, rel: ast.UnnestRelation,
+                    left: Optional[RelationPlan]) -> RelationPlan:
+        """UNNEST as a (lateral) relation: project the array/map expressions
+        onto the input, then expand (reference: RelationPlanner.visitUnnest
+        → planner/plan/UnnestNode; lateral column references resolve
+        against the left relation like the reference's implicit lateral)."""
+        if left is None:
+            child: PlanNode = OneRow()
+            scope = Scope([])
+            rows = 1.0
+        else:
+            if isinstance(left.node, _PendingCross):
+                raise AnalysisError(
+                    "UNNEST after a comma-join chain is not supported; use "
+                    "explicit CROSS JOIN ordering")
+            child, scope, rows = left.node, left.scope, left.rows
+        analyzer = ExprAnalyzer(scope, self)
+        exprs = [analyzer.analyze(a) for a in rel.exprs]
+        for e in exprs:
+            if not isinstance(e.type, (ArrayType, MapType)):
+                raise AnalysisError(
+                    f"UNNEST argument must be ARRAY or MAP, got {e.type}")
+        # project sources (keeping all existing columns)
+        proj_exprs = [(f.symbol, InputRef(f.type, f.symbol))
+                      for f in scope.fields]
+        sources = []
+        for e in exprs:
+            s = self.symbols.fresh("unnest_src")
+            proj_exprs.append((s, e))
+            sources.append(s)
+        proj = Project(child, proj_exprs)
+
+        qualifier = rel.alias or "unnest"
+        wanted = list(rel.column_names or [])
+        out_syms, out_types, new_fields = [], [], []
+
+        def take_name(default):
+            return wanted.pop(0) if wanted else default
+
+        for e, s in zip(exprs, sources):
+            if isinstance(e.type, MapType):
+                kn, vn = take_name("key"), take_name("value")
+                ks = self.symbols.fresh(kn)
+                vs = self.symbols.fresh(vn)
+                out_syms.append([ks, vs])
+                out_types.append([e.type.key, e.type.value])
+                new_fields.append(Field(qualifier, kn, ks, e.type.key))
+                new_fields.append(Field(qualifier, vn, vs, e.type.value))
+            else:
+                n = take_name("col")
+                s2 = self.symbols.fresh(n)
+                out_syms.append([s2])
+                out_types.append([e.type.element])
+                new_fields.append(Field(qualifier, n, s2, e.type.element))
+        ord_sym = None
+        if rel.ordinality:
+            n = take_name("ordinality")
+            ord_sym = self.symbols.fresh(n)
+            new_fields.append(Field(qualifier, n, ord_sym, BIGINT))
+        node = Unnest(
+            child=proj,
+            sources=sources,
+            replicate=[f.symbol for f in scope.fields],
+            out_syms=out_syms,
+            out_types=out_types,
+            ordinality_sym=ord_sym,
+        )
+        return RelationPlan(node, Scope(list(scope.fields) + new_fields),
+                            rows=rows * 4)
+
     def plan_join(self, rel: ast.Join) -> RelationPlan:
+        if isinstance(rel.right, ast.UnnestRelation):
+            if rel.kind not in ("cross", "inner") or rel.condition is not None:
+                raise AnalysisError(
+                    "UNNEST is only supported with CROSS JOIN")
+            return self.plan_unnest(rel.right, self.plan_relation(rel.left))
         # flatten pure cross-join chains into leaves for WHERE-driven ordering
         left = self.plan_relation(rel.left)
         right = self.plan_relation(rel.right)
@@ -782,14 +971,16 @@ class Planner:
             ctes[name] = sub
         self.ctes = ctes
 
-        if q.from_ is None:
-            raise AnalysisError("SELECT without FROM not supported")
-
         from presto_tpu.plan.decorrelate import decorrelate
 
         q = decorrelate(q, self.catalog, self.ctes)
 
-        rp = self.plan_relation(q.from_)
+        if q.from_ is None:
+            # SELECT <exprs> with no FROM: one synthetic row (the
+            # reference's ValuesNode single-row plan)
+            rp = RelationPlan(OneRow(), Scope([]), rows=1.0)
+        else:
+            rp = self.plan_relation(q.from_)
 
         # WHERE: analyze conjuncts; subquery predicates become semi-joins
         where_conjs_ast = split_conjuncts(q.where) if q.where is not None else []
@@ -1210,6 +1401,8 @@ class Planner:
         repl: Dict[str, Tuple[str, Type]] = {}
         for g in group_by:
             e = analyzer.analyze(g)
+            if isinstance(e.type, (ArrayType, MapType)):
+                raise AnalysisError("GROUP BY on ARRAY/MAP is not supported")
             if isinstance(e, InputRef):
                 sym = e.name
             else:
@@ -1525,6 +1718,8 @@ def _agg_output_type(fn: str, arg_t: Type, is_star: bool) -> Type:
         return BOOLEAN
     if fn in ("checksum", "approx_distinct"):
         return BIGINT
+    if fn == "array_agg":
+        return ArrayType(arg_t)
     raise AnalysisError(f"unknown aggregate {fn}")
 
 

@@ -21,6 +21,7 @@ from presto_tpu.plan.nodes import (
     Filter,
     HashJoin,
     Limit,
+    OneRow,
     Output,
     PlanNode,
     Project,
@@ -30,6 +31,7 @@ from presto_tpu.plan.nodes import (
     Sort,
     SortItem,
     TableScan,
+    Unnest,
     Window,
     WindowFunc,
 )
@@ -152,6 +154,14 @@ def node_to_json(n: PlanNode) -> Dict[str, Any]:
                 "names": list(n.names), "symbols": list(n.symbols)}
     if isinstance(n, RemoteSource):
         return {"k": "remote", "fid": n.fragment_id, "output": _out(n.output)}
+    if isinstance(n, Unnest):
+        return {"k": "unnest", "child": node_to_json(n.child),
+                "sources": list(n.sources), "replicate": list(n.replicate),
+                "out_syms": [list(s) for s in n.out_syms],
+                "out_types": [[_t(t) for t in ts] for ts in n.out_types],
+                "ordinality": n.ordinality_sym}
+    if isinstance(n, OneRow):
+        return {"k": "onerow"}
     raise CodecError(f"unencodable plan node {type(n).__name__}")
 
 
@@ -218,6 +228,16 @@ def node_from_json(d: Dict[str, Any]) -> PlanNode:
     if k == "remote":
         return RemoteSource(fragment_id=int(d["fid"]),
                             output=_unout(d["output"]))
+    if k == "unnest":
+        return Unnest(
+            child=node_from_json(d["child"]), sources=list(d["sources"]),
+            replicate=list(d["replicate"]),
+            out_syms=[list(s) for s in d["out_syms"]],
+            out_types=[[_untype(t) for t in ts] for ts in d["out_types"]],
+            ordinality_sym=d.get("ordinality"),
+        )
+    if k == "onerow":
+        return OneRow()
     raise CodecError(f"unknown plan node kind {k!r}")
 
 

@@ -226,6 +226,14 @@ class _Fragmenter:
             return node, cpart
         if isinstance(node, RemoteSource):
             return node, SINGLE
+        from presto_tpu.plan.nodes import OneRow, Unnest
+
+        if isinstance(node, Unnest):
+            # streaming row expansion: stays in its child's fragment
+            node.child, p = self.process(node.child)
+            return node, p
+        if isinstance(node, OneRow):
+            return node, SINGLE
         raise NotImplementedError(f"fragmenter: {type(node).__name__}")
 
 

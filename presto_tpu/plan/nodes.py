@@ -105,6 +105,51 @@ class Aggregate(PlanNode):
 
 
 @dataclasses.dataclass
+class OneRow(PlanNode):
+    """A single live row with no columns (reference: planner/plan
+    ValuesNode's single-row degenerate form) — the child of a top-level
+    FROM UNNEST(constant array)."""
+
+    output: List[Tuple[str, Type]] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class Unnest(PlanNode):
+    """Expand ARRAY/MAP columns into rows (operator/unnest/UnnestOperator
+    redesigned for the dense padded layout: output row j of input row i
+    exists iff j < max over sources of sizes[i] — a static [cap, W] →
+    [cap*W] reshape, no per-position offset walking).
+
+    `sources`: child symbols holding the array/map columns to expand.
+    `replicate`: child symbols carried through (repeated per element).
+    `out_syms[i]`: output symbols for sources[i] — [elem] for arrays,
+    [key, value] for maps. `ordinality_sym`: the WITH ORDINALITY column.
+    """
+
+    child: PlanNode
+    sources: List[str]
+    replicate: List[str]
+    out_syms: List[List[str]]
+    out_types: List[List[Type]]
+    ordinality_sym: Optional[str] = None
+
+    @property
+    def output(self):
+        child_types = dict(self.child.output)
+        out = [(s, child_types[s]) for s in self.replicate]
+        for syms, types in zip(self.out_syms, self.out_types):
+            out.extend(zip(syms, types))
+        if self.ordinality_sym:
+            from presto_tpu.types import BIGINT
+
+            out.append((self.ordinality_sym, BIGINT))
+        return out
+
+    def children(self):
+        return [self.child]
+
+
+@dataclasses.dataclass
 class RemoteSource(PlanNode):
     """Leaf reading pages from an upstream fragment through the exchange
     (reference: plan/RemoteSourceNode + operator/ExchangeOperator.java:35)."""

@@ -41,6 +41,7 @@ from presto_tpu.plan.nodes import (
     SemiJoin,
     Sort,
     TableScan,
+    Unnest,
     Window,
 )
 from presto_tpu.types import BOOLEAN
@@ -255,6 +256,11 @@ def prune_columns(node: PlanNode, required: Set[str]) -> PlanNode:
         return node
     if isinstance(node, Limit):
         node.child = prune_columns(node.child, required)
+        return node
+    if isinstance(node, Unnest):
+        node.replicate = [s for s in node.replicate if s in required]
+        node.child = prune_columns(
+            node.child, set(node.replicate) | set(node.sources))
         return node
     for c in node.children():
         prune_columns(c, required)

@@ -128,7 +128,7 @@ def serialize_batch(b: Batch, compress: bool = True) -> bytes:
     live = np.asarray(b.live)
     n = int(live.sum())
     header = {"n": n, "names": list(b.names), "types": [str(t) for t in b.types],
-              "validity": [], "limbs": [], "dicts": {}}
+              "validity": [], "limbs": [], "struct": [], "dicts": {}}
     buffers: List[bytes] = []
     for name, t, c in zip(b.names, b.types, b.columns):
         vals = np.asarray(c.values)[live]
@@ -145,9 +145,32 @@ def serialize_batch(b: Batch, compress: bool = True) -> bytes:
             buffers.append(np.ascontiguousarray(np.asarray(c.hi)[live]).tobytes())
         else:
             header["limbs"].append(False)
+        if c.sizes is not None:
+            # structural planes: [w, has_evalid, has_keys, keys_dtype]
+            # (values buffer above is the [n, w] element plane, row-major)
+            w = int(c.values.shape[1])
+            has_ev = c.evalid is not None
+            has_k = c.keys is not None
+            header["struct"].append(
+                [w, has_ev, has_k,
+                 str(c.keys.dtype) if has_k else None])
+            buffers.append(
+                np.ascontiguousarray(np.asarray(c.sizes)[live]).tobytes())
+            if has_ev:
+                buffers.append(_pack_bits(
+                    np.asarray(c.evalid)[live].reshape(-1)))
+            if has_k:
+                buffers.append(
+                    np.ascontiguousarray(np.asarray(c.keys)[live]).tobytes())
+        else:
+            header["struct"].append(None)
         if name in b.dicts:
             register_dictionary(b.dicts[name])
             header["dicts"][name] = [str(v) for v in b.dicts[name].values]
+        if name + "#keys" in b.dicts:
+            register_dictionary(b.dicts[name + "#keys"])
+            header["dicts"][name + "#keys"] = [
+                str(v) for v in b.dicts[name + "#keys"].values]
     payload = b"".join(buffers)
     flags = 0
     zc = _zc()
@@ -176,14 +199,21 @@ def deserialize_batch(data: bytes, capacity: Optional[int] = None,
     cols = []
     pos = 0
     limbs = header.get("limbs") or [False] * len(names)
-    for name, t, has_valid, has_hi in zip(names, types, header["validity"],
-                                          limbs):
+    structs = header.get("struct") or [None] * len(names)
+    for name, t, has_valid, has_hi, st in zip(names, types,
+                                              header["validity"], limbs,
+                                              structs):
         dt = np.dtype(str(t.dtype))
-        nb = n * dt.itemsize
-        vals = np.frombuffer(payload, dt, count=n, offset=pos)
-        pos += nb
-        buf = np.zeros(cap, dtype=dt)
-        buf[:n] = vals
+        w = st[0] if st is not None else None
+        count = n * w if w is not None else n
+        vals = np.frombuffer(payload, dt, count=count, offset=pos)
+        pos += count * dt.itemsize
+        if w is not None:
+            buf = np.zeros((cap, w), dtype=dt)
+            buf[:n] = vals.reshape(n, w)
+        else:
+            buf = np.zeros(cap, dtype=dt)
+            buf[:n] = vals
         if has_valid:
             vb = (n + 7) // 8
             valid = _unpack_bits(payload[pos:pos + vb], n)
@@ -193,15 +223,37 @@ def deserialize_batch(data: bytes, capacity: Optional[int] = None,
             valid_arr = jnp.asarray(vbuf)
         else:
             valid_arr = None
+        hi_arr = None
         if has_hi:
-            hb = n * 8
             hi = np.frombuffer(payload, np.int64, count=n, offset=pos)
-            pos += hb
+            pos += n * 8
             hbuf = np.zeros(cap, dtype=np.int64)
             hbuf[:n] = hi
-            cols.append(Column(jnp.asarray(buf), valid_arr, jnp.asarray(hbuf)))
-        else:
-            cols.append(Column(jnp.asarray(buf), valid_arr))
+            hi_arr = jnp.asarray(hbuf)
+        sizes_arr = evalid_arr = keys_arr = None
+        if st is not None:
+            _, has_ev, has_k, kdt = st
+            sizes = np.frombuffer(payload, np.int32, count=n, offset=pos)
+            pos += n * 4
+            sbuf = np.zeros(cap, np.int32)
+            sbuf[:n] = sizes
+            sizes_arr = jnp.asarray(sbuf)
+            if has_ev:
+                eb = (n * w + 7) // 8
+                ev = _unpack_bits(payload[pos:pos + eb], n * w)
+                pos += eb
+                ebuf = np.zeros((cap, w), bool)
+                ebuf[:n] = ev.reshape(n, w)
+                evalid_arr = jnp.asarray(ebuf)
+            if has_k:
+                kd = np.dtype(kdt)
+                keys = np.frombuffer(payload, kd, count=n * w, offset=pos)
+                pos += n * w * kd.itemsize
+                kbuf = np.zeros((cap, w), kd)
+                kbuf[:n] = keys.reshape(n, w)
+                keys_arr = jnp.asarray(kbuf)
+        cols.append(Column(jnp.asarray(buf), valid_arr, hi_arr,
+                           sizes_arr, evalid_arr, keys_arr))
     live = np.zeros(cap, dtype=bool)
     live[:n] = True
     dicts = {k: intern_dictionary(np.asarray(v, dtype=object))

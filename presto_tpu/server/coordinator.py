@@ -308,6 +308,7 @@ class Coordinator:
         self._lock = threading.Lock()
         # keyed by (sql, plan-affecting session property values)
         self._dplan_cache: Dict[tuple, DistributedPlan] = {}
+        self._cached_sqls: set = set()  # sqls with any cached plan (non-DDL)
         self._http = None
 
         def execute_fn(session, sql):
@@ -458,7 +459,8 @@ class Coordinator:
         workers = self.node_manager.active_nodes()
         yield from self.scheduler.execute(qid, dplan, workers, config)
 
-    def plan_distributed(self, sql: str, session=None) -> DistributedPlan:
+    def plan_distributed(self, sql: str, session=None,
+                         stmt=None) -> DistributedPlan:
         from presto_tpu.exec.runtime import ExecContext, _bind_plan_params, run_plan
         from presto_tpu.expr.ir import Constant
         from presto_tpu.plan.builder import plan_query
@@ -477,7 +479,8 @@ class Coordinator:
         hit = self._dplan_cache.get(cache_key)
         if hit is not None:
             return hit
-        qp = optimize(plan_query(sql, self.catalog))
+        qp = optimize(plan_query(stmt if stmt is not None else sql,
+                                 self.catalog))
         cacheable = not qp.scalar_subqueries
         if qp.scalar_subqueries:
             # bind uncorrelated scalar subqueries coordinator-side first
@@ -497,6 +500,7 @@ class Coordinator:
         )
         if cacheable:
             self._dplan_cache[cache_key] = dplan
+            self._cached_sqls.add(sql)
         return dplan
 
     def run_batch(self, sql: str, config: Optional[ExecConfig] = None,
@@ -509,7 +513,8 @@ class Coordinator:
         from presto_tpu.sql.parser import parse_sql
 
         # cached distributed plans are never DDL — skip the parse probe
-        cached = any(k[0] == sql for k in self._dplan_cache)
+        # (O(1) membership; the parsed stmt is reused by plan_distributed)
+        cached = sql in self._cached_sqls
         stmt = None if cached else parse_sql(sql)
         if isinstance(stmt, (_ast.CreateTableAs, _ast.Insert, _ast.DropTable)):
             # DDL/DML executes coordinator-side; the source query still runs
@@ -541,7 +546,7 @@ class Coordinator:
 
             return execute_data_definition(stmt, self.catalog, run_query_fn)
 
-        dplan = self.plan_distributed(sql, session)
+        dplan = self.plan_distributed(sql, session, stmt=stmt)
         batches = list(self.execute_distributed(dplan, config))
         merged = _collect_concat(iter(batches))
         if merged is None:

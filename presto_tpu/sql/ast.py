@@ -172,6 +172,20 @@ class Join(Node):
     condition: Optional[Node] = None
 
 
+@dataclasses.dataclass
+class UnnestRelation(Node):
+    """UNNEST(expr, ...) [WITH ORDINALITY] [AS alias (col, ...)].
+
+    As the right side of CROSS JOIN it is lateral: the expressions may
+    reference the left relation's columns (SqlBase.g4 unnest /
+    planner/plan/UnnestNode)."""
+
+    exprs: list
+    ordinality: bool = False
+    alias: Optional[str] = None
+    column_names: Optional[list] = None
+
+
 # ---------------------------------------------------------------------------
 # query
 

@@ -37,7 +37,7 @@ _TOKEN_RE = re.compile(
   | (?P<string>'(?:[^']|'')*')
   | (?P<qident>"(?:[^"]|"")*")
   | (?P<ident>[A-Za-z_][A-Za-z0-9_$]*)
-  | (?P<op><>|!=|>=|<=|\|\||[-+*/%(),.<>=;])
+  | (?P<op><>|!=|>=|<=|\|\||[-+*/%(),.<>=;\[\]])
     """,
     re.VERBOSE | re.DOTALL,
 )
@@ -395,6 +395,31 @@ class Parser:
         return rel
 
     def parse_table_primary(self) -> ast.Node:
+        if (self.peek().kind == "ident" and self.peek().value == "unnest"
+                and self.peek(1).kind == "op" and self.peek(1).value == "("):
+            self.next()
+            self.expect_op("(")
+            exprs = [self.parse_expr()]
+            while self.accept_op(","):
+                exprs.append(self.parse_expr())
+            self.expect_op(")")
+            ordinality = False
+            if self.accept_kw("with"):
+                word = self.ident()
+                if word != "ordinality":
+                    raise ParseError(f"expected ORDINALITY, got {word}")
+                ordinality = True
+            alias = cols = None
+            if self.accept_kw("as"):
+                alias = self.ident()
+            elif self.peek().kind == "ident":
+                alias = self.ident()
+            if alias is not None and self.accept_op("("):
+                cols = [self.ident()]
+                while self.accept_op(","):
+                    cols.append(self.ident())
+                self.expect_op(")")
+            return ast.UnnestRelation(exprs, ordinality, alias, cols)
         if self.accept_op("("):
             if self.peek().kind == "keyword" and self.peek().value in ("select", "with"):
                 q = self.parse_query()
@@ -513,7 +538,12 @@ class Parser:
             return ast.UnaryOp("-", self.parse_unary())
         if self.accept_op("+"):
             return self.parse_unary()
-        return self.parse_primary()
+        e = self.parse_primary()
+        while self.accept_op("["):
+            idx = self.parse_expr()
+            self.expect_op("]")
+            e = ast.FunctionCall("subscript", [e, idx])
+        return e
 
     def parse_primary(self) -> ast.Node:
         t = self.peek()
@@ -621,6 +651,16 @@ class Parser:
         # identifier or function call
         if t.kind in ("ident", "keyword"):
             name = self.ident()
+            if name == "array" and self.peek().kind == "op" and self.peek().value == "[":
+                # ARRAY[e1, .., eN] literal constructor
+                self.next()
+                items = []
+                if not (self.peek().kind == "op" and self.peek().value == "]"):
+                    items.append(self.parse_expr())
+                    while self.accept_op(","):
+                        items.append(self.parse_expr())
+                self.expect_op("]")
+                return ast.FunctionCall("array_ctor", items)
             if self.peek().kind == "op" and self.peek().value == "(":
                 self.next()
                 if self.accept_op("*"):

@@ -22,6 +22,18 @@ Design (TPU-first, not a port):
   and min/max fall back to combined float64.
 - DATE is int32 days since 1970-01-01 (same as Presto, spi/type/DateType).
 - TIMESTAMP is int64 microseconds since epoch.
+- ARRAY(T) / MAP(K, V) (spi/type/ArrayType.java, MapType.java) use a dense
+  padded layout instead of the reference's offsets-into-flat-block
+  (spi/block/ColumnarArray.java): an array column's device value is a
+  [capacity, W] plane of element values (W = static per-batch max
+  cardinality, padded to keep shapes compile-cache friendly) plus an int32
+  `sizes` vector and an element-validity plane. Rows gather through joins
+  and sorts as plain 2D row gathers, elementwise array functions vectorize
+  over the whole plane, and UNNEST is a static reshape — no ragged offsets
+  ever reach the device.
+- ROW(fields) is a planning-time type: analysis flattens row construction
+  and field access into the underlying scalar columns (spi/type/RowType
+  without a device representation of its own).
 """
 
 from __future__ import annotations
@@ -111,6 +123,72 @@ class VarcharType(Type):
         return -1  # codes are >= 0; -1 marks null even without a validity mask
 
 
+@dataclasses.dataclass(frozen=True)
+class ArrayType(Type):
+    """ARRAY(element). Device value: [capacity, W] plane of element values
+    (element dtype), with per-row `sizes` and an element-validity plane on
+    the Column. W is static per batch."""
+
+    element: Type = None  # type: ignore[assignment]
+
+    def __init__(self, element: Type):
+        object.__setattr__(self, "name", f"array({element.name})")
+        object.__setattr__(self, "element", element)
+
+    @property
+    def dtype(self):
+        return self.element.dtype
+
+
+@dataclasses.dataclass(frozen=True)
+class MapType(Type):
+    """MAP(key, value). Device value: two aligned [capacity, W] planes
+    (keys on Column.keys, values on Column.values) sharing `sizes`.
+    Map keys are non-null (Presto semantics); map values may be null via
+    the element-validity plane."""
+
+    key: Type = None  # type: ignore[assignment]
+    value: Type = None  # type: ignore[assignment]
+
+    def __init__(self, key: Type, value: Type):
+        object.__setattr__(self, "name", f"map({key.name},{value.name})")
+        object.__setattr__(self, "key", key)
+        object.__setattr__(self, "value", value)
+
+    @property
+    def dtype(self):
+        return self.value.dtype
+
+
+@dataclasses.dataclass(frozen=True)
+class RowType(Type):
+    """ROW(name type, ...). Planning-time only: analysis flattens field
+    access / row construction to the underlying columns."""
+
+    fields: tuple = ()  # tuple[(name, Type), ...]
+
+    def __init__(self, fields):
+        fields = tuple((str(n), t) for n, t in fields)
+        object.__setattr__(
+            self, "name",
+            "row(" + ", ".join(f"{n} {t.name}" for n, t in fields) + ")")
+        object.__setattr__(self, "fields", fields)
+
+    def field_type(self, name: str) -> "Type":
+        for n, t in self.fields:
+            if n == name:
+                return t
+        raise KeyError(f"row type has no field {name}")
+
+    @property
+    def dtype(self):
+        raise TypeError("ROW has no single device representation")
+
+
+def is_structural(t: Type) -> bool:
+    return isinstance(t, (ArrayType, MapType, RowType))
+
+
 BOOLEAN = _FixedType("boolean", "bool")
 TINYINT = _FixedType("tinyint", "int8")
 SMALLINT = _FixedType("smallint", "int16")
@@ -174,9 +252,38 @@ def common_super_type(a: Type, b: Type) -> Type:
     raise TypeError(f"no common type for {a} and {b}")
 
 
+def _split_top(s: str) -> list:
+    """Split on commas at paren depth 0 ("row(a bigint, b double)" safe)."""
+    parts, depth, cur = [], 0, []
+    for ch in s:
+        if ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth -= 1
+        if ch == "," and depth == 0:
+            parts.append("".join(cur))
+            cur = []
+        else:
+            cur.append(ch)
+    if cur:
+        parts.append("".join(cur))
+    return parts
+
+
 def parse_type(s: str) -> Type:
     """Parse a SQL type name (for CAST and DDL)."""
     s = s.strip().lower()
+    if s.startswith("array(") and s.endswith(")"):
+        return ArrayType(parse_type(s[6:-1]))
+    if s.startswith("map(") and s.endswith(")"):
+        k, v = _split_top(s[4:-1])
+        return MapType(parse_type(k), parse_type(v))
+    if s.startswith("row(") and s.endswith(")"):
+        fields = []
+        for part in _split_top(s[4:-1]):
+            name, _, ft = part.strip().partition(" ")
+            fields.append((name, parse_type(ft)))
+        return RowType(fields)
     simple = {
         "boolean": BOOLEAN,
         "tinyint": TINYINT,

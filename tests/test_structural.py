@@ -1,0 +1,286 @@
+"""Structural types (ARRAY / MAP) + UNNEST + array functions.
+
+Reference surface: presto-spi/.../type/ArrayType.java, MapType.java,
+operator/unnest/UnnestOperator.java, operator/scalar Array*/Map* functions,
+operator/aggregation/ArrayAggregationFunction. Oracles are hand-computed
+python values (sqlite has no arrays)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from presto_tpu.catalog.memory import MemoryConnector
+from presto_tpu.connector import Catalog
+from presto_tpu.exec import ExecConfig, LocalRunner
+from presto_tpu.types import ArrayType, BIGINT, MapType, RowType, VARCHAR, parse_type
+
+
+@pytest.fixture(scope="module")
+def runner():
+    conn = MemoryConnector()
+    conn.add_table("t", {
+        "id": np.array([1, 2, 3, 4]),
+        "arr": [[1, 2, 3], [4, 5], [], [7, None, 9]],
+        "tags": [["a", "b"], ["b"], ["c", "a"], []],
+        "m": [{"x": 1.5, "y": 2.5}, {"x": 10.0}, {}, {"z": 7.0, "x": None}],
+    })
+    conn.add_table("s", {
+        "id": np.array([1, 2, 3, 4]),
+        "name": np.array(["one", "two", "three", "four"]),
+    })
+    cat = Catalog()
+    cat.register("memory", conn, default=True)
+    return LocalRunner(cat, ExecConfig())
+
+
+def rows(runner, sql):
+    return runner.run(sql)  # LocalRunner.run returns a DataFrame
+
+
+class TestTypeParsing:
+    def test_parse(self):
+        t = parse_type("array(bigint)")
+        assert isinstance(t, ArrayType) and t.element is BIGINT
+        m = parse_type("map(varchar, array(bigint))")
+        assert isinstance(m, MapType) and isinstance(m.value, ArrayType)
+        r = parse_type("row(a bigint, b varchar)")
+        assert isinstance(r, RowType)
+        assert r.field_type("b") is VARCHAR
+
+
+class TestArrayExpressions:
+    def test_ctor_and_cardinality(self, runner):
+        df = rows(runner, "select array[1,2,3] as a, "
+                          "cardinality(array[1,2,3]) as c")
+        assert df["a"][0] == [1, 2, 3]
+        assert df["c"][0] == 3
+
+    def test_subscript(self, runner):
+        df = rows(runner, "select array[10,20,30][2] as x")
+        assert df["x"][0] == 20
+
+    def test_element_at_negative(self, runner):
+        df = rows(runner, "select element_at(array[10,20,30], -1) as x, "
+                          "element_at(array[10,20,30], 9) as y")
+        assert df["x"][0] == 30
+        assert df["y"][0] is None or pd.isna(df["y"][0])
+
+    def test_table_arrays(self, runner):
+        df = rows(runner, "select id, cardinality(arr) as c, arr[1] as h "
+                          "from t order by id")
+        assert list(df["c"]) == [3, 2, 0, 3]
+        assert df["h"][0] == 1 and df["h"][1] == 4
+        assert df["h"][2] is None or pd.isna(df["h"][2])
+
+    def test_contains_position(self, runner):
+        df = rows(runner, "select id, contains(arr, 5) as c5, "
+                          "array_position(arr, 5) as p5 from t order by id")
+        assert list(df["c5"]) == [False, True, False, False]
+        assert list(df["p5"]) == [0, 2, 0, 0]
+
+    def test_string_arrays(self, runner):
+        df = rows(runner, "select id, contains(tags, 'a') as ha, tags "
+                          "from t order by id")
+        assert list(df["ha"]) == [True, False, True, False]
+        assert df["tags"][0] == ["a", "b"]
+
+    def test_min_max_sum_avg(self, runner):
+        df = rows(runner, "select array_min(array[3,1,2]) as mn, "
+                          "array_max(array[3,1,2]) as mx, "
+                          "array_sum(array[3,1,2]) as s, "
+                          "array_average(array[3,1,3]) as av")
+        assert df["mn"][0] == 1 and df["mx"][0] == 3
+        assert df["s"][0] == 6
+        assert abs(df["av"][0] - 7 / 3) < 1e-12
+
+    def test_min_with_null_element(self, runner):
+        # arrays containing NULL yield NULL (ArrayMinMaxUtils semantics)
+        df = rows(runner, "select id, array_min(arr) as mn from t order by id")
+        assert df["mn"][0] == 1
+        assert df["mn"][3] is None or pd.isna(df["mn"][3])
+
+    def test_concat_slice(self, runner):
+        df = rows(runner, "select array[1,2] || array[3] as c, "
+                          "slice(array[1,2,3,4], 2, 2) as s")
+        assert df["c"][0] == [1, 2, 3]
+        assert df["s"][0] == [2, 3]
+
+    def test_distinct_sort(self, runner):
+        df = rows(runner, "select array_distinct(array[3,1,3,2,1]) as d, "
+                          "array_sort(array[3,1,2]) as s")
+        assert df["d"][0] == [1, 2, 3]
+        assert df["s"][0] == [1, 2, 3]
+
+    def test_sequence_repeat(self, runner):
+        df = rows(runner, "select sequence(2, 6, 2) as s, repeat(7, 3) as r")
+        assert df["s"][0] == [2, 4, 6]
+        assert df["r"][0] == [7, 7, 7]
+
+
+class TestMapExpressions:
+    def test_map_ctor_element_at(self, runner):
+        df = rows(runner,
+                  "select element_at(map(array['a','b'], array[1.5,2.5]), "
+                  "'b') as v")
+        assert df["v"][0] == 2.5
+
+    def test_table_map(self, runner):
+        df = rows(runner, "select id, cardinality(m) as c, "
+                          "element_at(m, 'x') as x from t order by id")
+        assert list(df["c"]) == [2, 1, 0, 2]
+        assert df["x"][0] == 1.5 and df["x"][1] == 10.0
+        assert df["x"][2] is None or pd.isna(df["x"][2])
+        # x is present-but-NULL in row 4
+        assert df["x"][3] is None or pd.isna(df["x"][3])
+
+    def test_map_keys_values(self, runner):
+        df = rows(runner, "select map_keys(m) as mk, map_values(m) as mv "
+                          "from t where id = 1")
+        assert sorted(df["mk"][0]) == ["x", "y"]
+        assert sorted(df["mv"][0]) == [1.5, 2.5]
+
+
+class TestUnnest:
+    def test_constant_unnest(self, runner):
+        df = rows(runner, "select x from unnest(array[10,20,30]) as u(x)")
+        assert list(df["x"]) == [10, 20, 30]
+
+    def test_with_ordinality(self, runner):
+        df = rows(runner, "select x, o from "
+                          "unnest(array[7,8]) with ordinality as u(x, o)")
+        assert list(df["x"]) == [7, 8]
+        assert list(df["o"]) == [1, 2]
+
+    def test_lateral_cross_join(self, runner):
+        df = rows(runner, "select id, e from t cross join unnest(arr) "
+                          "as u(e) order by id, e")
+        # id 3 has an empty array → no rows; NULL element of id 4 kept
+        got = [(int(i), e) for i, e in zip(df["id"], df["e"])]
+        assert (1, 1) in got and (2, 5) in got
+        assert not any(i == 3 for i, _ in got)
+        assert len(got) == 3 + 2 + 3
+
+    def test_unnest_map(self, runner):
+        df = rows(runner, "select id, k, v from t cross join unnest(m) "
+                          "as u(k, v) where id = 1 order by k")
+        assert list(df["k"]) == ["x", "y"]
+        assert list(df["v"]) == [1.5, 2.5]
+
+    def test_unnest_join_downstream(self, runner):
+        # UNNEST feeding a hash join (element joins a dimension table)
+        df = rows(runner,
+                  "select s.name, count(*) as n "
+                  "from t cross join unnest(arr) as u(e) "
+                  "join s on u.e = s.id group by s.name order by s.name")
+        # elements: [1,2,3],[4,5],[],[7,None,9] → ids 1..4 present: 1,2,3,4
+        got = dict(zip(df["name"], df["n"]))
+        assert got == {"one": 1, "two": 1, "three": 1, "four": 1}
+
+    def test_unnest_aggregate(self, runner):
+        df = rows(runner, "select sum(e) as s from t "
+                          "cross join unnest(arr) as u(e)")
+        assert df["s"][0] == 1 + 2 + 3 + 4 + 5 + 7 + 9
+
+
+class TestArrayAgg:
+    def test_global(self, runner):
+        df = rows(runner, "select array_agg(id) as a from t")
+        assert sorted(df["a"][0]) == [1, 2, 3, 4]
+
+    def test_grouped(self, runner):
+        conn = MemoryConnector()
+        conn.add_table("g", {
+            "k": np.array(["a", "a", "b", "b", "b"]),
+            "v": np.array([1, 2, 3, 4, 5]),
+        })
+        cat = Catalog()
+        cat.register("memory", conn, default=True)
+        r = LocalRunner(cat, ExecConfig())
+        df = r.run("select k, array_agg(v) as vs, count(*) as n "
+                   "from g group by k order by k")
+        assert sorted(df["vs"][0]) == [1, 2]
+        assert sorted(df["vs"][1]) == [3, 4, 5]
+        assert list(df["n"]) == [2, 3]
+
+    def test_cardinality_of_array_agg(self, runner):
+        df = rows(runner, "select cardinality(array_agg(id)) as c from t")
+        assert df["c"][0] == 4
+
+
+class TestStructuralThroughOperators:
+    def test_array_through_join(self, runner):
+        # structural planes must survive the join gather (the Column.hi
+        # regression class from round 2, now for sizes/evalid/keys)
+        df = rows(runner,
+                  "select s.name, t.arr from t join s on t.id = s.id "
+                  "where s.id = 2")
+        assert df["arr"][0] == [4, 5]
+
+    def test_array_through_sort_limit(self, runner):
+        df = rows(runner, "select id, arr from t order by id desc limit 2")
+        assert list(df["id"]) == [4, 3]
+        assert df["arr"][1] == []
+
+    def test_map_through_filter(self, runner):
+        df = rows(runner, "select m from t where element_at(m, 'x') > 2")
+        assert df["m"][0] == {"x": 10.0}
+
+
+class TestReviewRegressions:
+    """Pinned fixes from the structural-types code review."""
+
+    def test_ctas_array_roundtrip(self, runner):
+        # _batches_to_host must carry structural planes into CTAS
+        runner.run("drop table if exists ctas_arr")
+        runner.run("create table ctas_arr as "
+                   "select id, arr, tags, m from t")
+        df = rows(runner, "select id, arr, tags, cardinality(m) as cm "
+                          "from ctas_arr order by id")
+        assert df["arr"][0] == [1, 2, 3]
+        assert df["tags"][2] == ["c", "a"]
+        assert list(df["cm"]) == [2, 1, 0, 2]
+        runner.run("drop table ctas_arr")
+
+    def test_ctas_array_agg_roundtrip(self, runner):
+        runner.run("drop table if exists ctas_agg")
+        runner.run("create table ctas_agg as "
+                   "select array_agg(id) as ids from t")
+        df = rows(runner, "select cardinality(ids) as c from ctas_agg")
+        assert df["c"][0] == 4
+        runner.run("drop table ctas_agg")
+
+    def test_map_cardinality_mismatch_yields_null(self, runner):
+        # keys beyond the value cardinality -> NULL value, not garbage
+        df = rows(runner, "select element_at(map(array[1,2], array[9]), 2) "
+                          "as v, element_at(map(array[1,2], array[9]), 1) "
+                          "as w")
+        assert df["v"][0] is None or pd.isna(df["v"][0])
+        assert df["w"][0] == 9
+
+    def test_array_literal_not_in_column_dict(self, runner):
+        # literal absent from the column dictionary must keep its value
+        df = rows(runner,
+                  "select array['zzz_total', name][1] as x, "
+                  "array['zzz_total', name][2] as y from s where id = 1")
+        assert df["x"][0] == "zzz_total"
+        assert df["y"][0] == "one"
+
+    def test_slice_negative_out_of_range_empty(self, runner):
+        df = rows(runner, "select slice(array[1,2,3], -4, 3) as a, "
+                          "slice(array[1,2,3], -2, 2) as b")
+        assert df["a"][0] == []
+        assert df["b"][0] == [2, 3]
+
+
+class TestGuards:
+    def test_array_comparison_rejected(self, runner):
+        from presto_tpu.plan.builder import AnalysisError
+
+        with pytest.raises(AnalysisError):
+            runner.run("select * from t where arr = arr")
+
+    def test_group_by_array_rejected(self, runner):
+        from presto_tpu.plan.builder import AnalysisError
+
+        with pytest.raises(AnalysisError):
+            runner.run("select count(*) from t group by arr")

@@ -66,8 +66,6 @@ def test_ctas_round_trip_vs_sqlite(env):
 
 def test_insert_appends(env):
     runner, db, _ = env
-    for x in (runner, db):
-        pass
     runner.run("create table cp as select g, v from t")
     db.execute("create table cp as select g, v from t")
     runner.run("insert into cp select g + 100 as g, v from t")
