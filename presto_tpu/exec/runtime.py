@@ -522,8 +522,8 @@ def _execute_unnest(node: Unnest, ctx: ExecContext) -> Iterator[Batch]:
 
 _VARIANCE_FNS = {"var_samp", "var_pop", "stddev_samp", "stddev_pop"}
 _COVAR_FNS = {"covar_pop", "covar_samp", "corr"}
-_NON_DECOMPOSABLE_FNS = {"approx_percentile", "max_by", "min_by",
-                         "array_agg"}
+_NON_DECOMPOSABLE_FNS = {"approx_percentile", "__approx_percentile_w",
+                         "max_by", "min_by", "array_agg"}
 
 _CHECKSUM_NULL = jnp.int64(-7046029254386353131)  # fixed NULL contribution
 
@@ -659,7 +659,7 @@ def _sorted_group_agg(b: Batch, key_syms, a: AggSpec, cap: int):
     num_key_ops = len(operands)
 
     cx = b.column(a.arg)
-    if a.fn == "approx_percentile":
+    if a.fn in ("approx_percentile", "__approx_percentile_w"):
         ov = cx.valid_mask()
         sortval = jnp.where(ov, cx.values, _minmax_ident(cx.values.dtype, True))
     elif a.fn == "max_by":
@@ -691,6 +691,29 @@ def _sorted_group_agg(b: Batch, key_syms, a: AggSpec, cap: int):
                                num_segments=cap + 1)[:cap]
     valid = cntv > 0
 
+    if a.fn == "__approx_percentile_w":
+        # weighted-rank selection over sketch bucket rows: the value is the
+        # bucket minimum whose cumulative count first reaches ceil(p·total)
+        # (the final qdigest.valueAt step of the approx_percentile
+        # lowering — inputs here are ≤ occupied-bucket rows, not raw data)
+        from presto_tpu.ops.grouping import _segmented_scan
+
+        p = float(a.param)
+        wcol = b.column(a.arg2)
+        wsorted = wcol.values.astype(jnp.int64)[sperm]
+        wsorted = jnp.where(ov_sorted & (sdead == 0), wsorted, 0)
+        cum = _segmented_scan(wsorted, change, "sum")
+        totals = jax.ops.segment_sum(wsorted, seg, num_segments=cap + 1)[:cap]
+        thresh = jnp.clip(jnp.ceil(p * totals).astype(jnp.int64), 1, None)
+        row_thresh = jnp.concatenate([thresh, jnp.zeros(1, jnp.int64)])[
+            jnp.clip(seg, 0, cap)]
+        candidate = (cum >= row_thresh) & (wsorted > 0)
+        idxs = jnp.arange(n, dtype=jnp.int32)
+        pick = jnp.full(cap, n, jnp.int32).at[seg].min(
+            jnp.where(candidate, idxs, n), mode="drop")
+        rows = sperm[jnp.clip(pick, 0, n - 1)]
+        vals = cx.values[rows]
+        return vals, totals > 0
     if a.fn == "approx_percentile":
         # exact quantile: index ceil(p*n_valid)-1 of the sorted valid values
         # (NULLs sort first, valid range is [start+cnt-cntv, start+cnt))

@@ -749,6 +749,25 @@ def _eval_call(e: Call, ctx: CompileContext):
         rank = jnp.where(w == 0, 33, 32 - jnp.floor(jnp.log2(f)))
         return rank.astype(jnp.int64), avalid
 
+    # ---- quantile-sketch primitive (approx_percentile lowering) ----------
+    # __qsk_bucket(x): order-preserving quantization of x as float64 —
+    # monotone IEEE-754 integer encoding truncated to its top 24 bits
+    # (sign + exponent + 12 mantissa bits → value-space relative error
+    # ≤ 2⁻¹² per bucket). The sketch is a histogram over these buckets
+    # (reference: qdigest's value-universe compression).
+    if fn == "__qsk_bucket":
+        av, avalid = _eval_arg(e.args[0], ctx)
+        x = av.astype(jnp.float64)
+        x = jnp.where(x == 0.0, 0.0, x)  # canonicalize -0.0
+        bits = jax.lax.bitcast_convert_type(x, jnp.uint64)
+        flip = jnp.where(
+            bits >> jnp.uint64(63),
+            jnp.uint64(0xFFFFFFFFFFFFFFFF),
+            jnp.uint64(0x8000000000000000),
+        )
+        mono = bits ^ flip
+        return (mono >> jnp.uint64(40)).astype(jnp.int64), avalid
+
     # ---- cast ------------------------------------------------------------
     if fn == "cast":
         return _eval_cast(e, ctx)

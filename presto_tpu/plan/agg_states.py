@@ -24,7 +24,8 @@ from presto_tpu.types import BIGINT, DOUBLE, TINYINT, DecimalType, Type
 _VARIANCE_FNS = {"variance", "var_samp", "var_pop", "stddev", "stddev_samp",
                  "stddev_pop"}
 _COVAR_FNS = {"covar_pop", "covar_samp"}
-_NON_DECOMPOSABLE = {"approx_percentile", "max_by", "min_by", "array_agg"}
+_NON_DECOMPOSABLE = {"approx_percentile", "__approx_percentile_w",
+                     "max_by", "min_by", "array_agg"}
 
 
 def is_decomposable(aggs) -> bool:

@@ -1463,12 +1463,19 @@ class Planner:
         pre = Project(node, pre_exprs) if pre_exprs else node
 
         hll_aggs = [a for a in agg_specs if a.fn == "approx_distinct"]
+        pct_aggs = [a for a in agg_specs if a.fn == "approx_percentile"]
         distinct_aggs = [a for a in agg_specs if a.distinct]
         if hll_aggs:
             if len(agg_specs) != 1:
                 raise AnalysisError(
                     "approx_distinct mixed with other aggregates not supported yet")
             agg_node = self._plan_hll(pre, group_syms, agg_specs[0], pre_exprs, node)
+        elif (pct_aggs and len(agg_specs) == len(pct_aggs)
+              and len({a.arg for a in pct_aggs}) == 1):
+            # all aggregates are approx_percentile over one column → the
+            # mergeable quantized-histogram sketch (distributable); mixed
+            # forms fall back to the materialized exact path below
+            agg_node = self._plan_qsketch(pre, group_syms, pct_aggs)
         elif distinct_aggs:
             if len(agg_specs) != 1:
                 raise AnalysisError("mixed DISTINCT aggregates not supported yet")
@@ -1485,6 +1492,46 @@ class Planner:
         else:
             agg_node = Aggregate(pre, group_syms, agg_specs, step="single")
         return agg_node, repl
+
+    def _plan_qsketch(self, pre: PlanNode, group_syms,
+                      pct_aggs: List[AggSpec]) -> PlanNode:
+        """Lower approx_percentile(x, p) into a mergeable value-space
+        sketch (reference: ApproximateLongPercentileAggregations over
+        qdigest — here a quantized histogram over the static float64
+        universe, riding the ordinary partial → exchange → final path):
+
+          Project    qb = __qsk_bucket(x)   (order-preserving top-24-bit
+                                             quantization of the monotone
+                                             IEEE-754 encoding)
+          Aggregate  group (keys…, qb):  cnt := count(x), mn := min(x)
+                     -- decomposable: distributes and merges exactly
+          Aggregate  group (keys…):  p-quantile := __approx_percentile_w
+                     -- weighted-rank selection over ≤ occupied-bucket
+                        rows (order-dependent, runs at the gathered task
+                        like the reference's final qdigest.valueAt)
+
+        Value-space relative error ≤ 2⁻¹² per bucket (12 mantissa bits);
+        the returned value is a real data value (a bucket minimum)."""
+        a0 = pct_aggs[0]
+        in_types = dict(pre.output)
+        arg_t = in_types[a0.arg]
+        arg_ref = InputRef(arg_t, a0.arg)
+        qb = self.symbols.fresh("qsk_bucket")
+        lower = Project(pre, [(s, InputRef(t, s)) for s, t in pre.output] + [
+            (qb, Call(BIGINT, "__qsk_bucket", (arg_ref,))),
+        ])
+        cnt = self.symbols.fresh("qsk_cnt")
+        mn = self.symbols.fresh("qsk_min")
+        inner = Aggregate(lower, group_syms + [qb], [
+            AggSpec(cnt, "count", a0.arg, BIGINT),
+            AggSpec(mn, "min", a0.arg, arg_t),
+        ], step="single")
+        outer_specs = [
+            AggSpec(a.symbol, "__approx_percentile_w", mn, a.type,
+                    arg2=cnt, param=a.param)
+            for a in pct_aggs
+        ]
+        return Aggregate(inner, group_syms, outer_specs, step="single")
 
     def _plan_hll(self, pre: PlanNode, group_syms, a: AggSpec, pre_exprs,
                   raw_input: PlanNode) -> PlanNode:

@@ -93,3 +93,82 @@ def test_distributed_matches_local(env):
         assert merged == local.sort_values("g").d.tolist()
     finally:
         dist.close()
+
+
+# -- approx_percentile: quantized-histogram sketch ---------------------------
+# __qsk_bucket keeps 12 mantissa bits → value-space relative error ≤ 2^-12;
+# tests allow 0.1% (4× margin) against the exact quantile.
+PCT_ERR = 1e-3
+
+
+def test_percentile_global(env):
+    runner, vals, *_ = env
+    for p in (0.1, 0.5, 0.9, 0.99):
+        est = float(runner.run(
+            f"select approx_percentile(v, {p}) as q from t").q[0])
+        exact = float(np.quantile(vals, p, method="inverted_cdf"))
+        assert abs(est - exact) <= max(abs(exact) * PCT_ERR, 1e-9), p
+
+
+def test_percentile_grouped(env):
+    runner, vals, grp, *_ = env
+    out = runner.run(
+        "select g, approx_percentile(v, 0.5) as q from t group by g")
+    for g in range(5):
+        exact = float(np.quantile(vals[grp == g], 0.5,
+                                  method="inverted_cdf"))
+        est = float(out[out.g == g].q.iloc[0])
+        assert abs(est - exact) <= max(abs(exact) * PCT_ERR, 1e-9), g
+
+
+def test_percentile_multiple_ps_one_pass(env):
+    runner, vals, *_ = env
+    out = runner.run("select approx_percentile(v, 0.25) as a, "
+                     "approx_percentile(v, 0.75) as b from t")
+    for p, col in ((0.25, "a"), (0.75, "b")):
+        exact = float(np.quantile(vals, p, method="inverted_cdf"))
+        assert abs(float(out[col][0]) - exact) <= abs(exact) * PCT_ERR + 1e-9
+
+
+def test_percentile_negative_and_fractional():
+    conn = MemoryConnector()
+    rng = np.random.default_rng(11)
+    x = rng.normal(loc=-5.0, scale=3.0, size=50_000)
+    conn.add_table("t", pd.DataFrame({"x": x}))
+    cat = Catalog()
+    cat.register("m", conn, default=True)
+    r = LocalRunner(cat, ExecConfig(batch_rows=1 << 14))
+    for p in (0.05, 0.5, 0.95):
+        est = float(r.run(
+            f"select approx_percentile(x, {p}) as q from t").q[0])
+        exact = float(np.quantile(x, p, method="inverted_cdf"))
+        assert abs(est - exact) <= abs(exact) * PCT_ERR + 1e-6, p
+
+
+def test_percentile_distributed_matches_local(env):
+    """The bucket histogram merges exactly across workers: distributed
+    estimate == local estimate."""
+    from presto_tpu.server.coordinator import DistributedRunner
+
+    runner, *_ = env
+    sql = "select g, approx_percentile(v, 0.9) as q from t group by g"
+    local = runner.run(sql)
+    dist = DistributedRunner(runner.catalog, n_workers=2,
+                             config=ExecConfig(batch_rows=1 << 15))
+    try:
+        out = dist.run(sql)
+        assert (out.sort_values("g").q.tolist()
+                == local.sort_values("g").q.tolist())
+    finally:
+        dist.close()
+
+
+def test_percentile_mixed_with_other_aggs_still_works(env):
+    """Mixed with non-percentile aggregates falls back to the exact
+    materialized path."""
+    runner, vals, *_ = env
+    out = runner.run("select approx_percentile(v, 0.5) as q, "
+                     "count(*) as n from t")
+    exact = float(np.quantile(vals, 0.5, method="inverted_cdf"))
+    assert float(out.q[0]) == exact  # exact path
+    assert int(out.n[0]) == len(vals)
