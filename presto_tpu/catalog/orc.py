@@ -1,0 +1,297 @@
+"""ORC connector — stripe-parallel reads + CTAS writes via pyarrow.orc.
+
+Reference: presto-orc (the fork's flagship module — OrcReader,
+OrcSelectiveRecordReader.java:54, StripeReader) and presto-hive's ORC page
+sources. The reference hand-decodes ORC streams with predicate-during-
+decode (Aria); here arrow does the decode and the engine's selective
+machinery operates on the decoded batch (filter = live-mask &=, fused into
+the scan program at trace time — see exec/runtime.collapse_chain). Stripes
+map to splits exactly as row groups do for parquet; string columns decode
+straight into the table-global dictionary (codes only on device).
+
+pyarrow exposes no per-stripe column statistics, so ORC scans prune by
+engine constraints only after decode (no split elimination — the parquet
+connector remains the stats-pruning storage layout).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from collections import OrderedDict
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.orc as po
+
+from presto_tpu.batch import Batch, round_up_capacity
+from presto_tpu.catalog.memory import DeviceSplitCache, _batches_to_host
+from presto_tpu.catalog.parquet import (
+    _arrow_to_sql,
+    _decode_column,
+    _sql_to_arrow,
+    _to_arrow_columns,
+)
+from presto_tpu.connector import ColumnInfo, Connector, Split, TableHandle
+from presto_tpu.dictionary import Dictionary
+from presto_tpu.types import ArrayType, MapType
+
+
+def _undictionarize(tbl: pa.Table) -> pa.Table:
+    """ORC has no dictionary physical type in arrow's writer: cast
+    dictionary columns to their value type (ORC files still dictionary-
+    encode internally; the engine rebuilds the table-global dictionary at
+    open)."""
+    cols, fields = [], []
+    for i, field in enumerate(tbl.schema):
+        col = tbl.column(i)
+        if pa.types.is_dictionary(field.type):
+            col = col.cast(field.type.value_type)
+            field = pa.field(field.name, field.type.value_type)
+        cols.append(col)
+        fields.append(field)
+    return pa.Table.from_arrays(cols, schema=pa.schema(fields))
+
+
+class _OrcTable:
+    __slots__ = ("path", "handle", "dicts", "num_rows", "n_stripes",
+                 "version")
+
+    def __init__(self, path, handle, dicts, num_rows, n_stripes, version):
+        self.path = path
+        self.handle = handle
+        self.dicts = dicts
+        self.num_rows = num_rows
+        self.n_stripes = n_stripes
+        self.version = version
+
+
+class OrcConnector(DeviceSplitCache, Connector):
+    """Directory of <table>.orc files."""
+
+    host_cache_bytes: int = 2 << 30
+
+    def __init__(self, directory: str, name: str = "orc"):
+        self.name = name
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+        self._tables: Dict[str, _OrcTable] = {}
+        self._init_split_cache()
+        self._host_cache: "OrderedDict[tuple, tuple]" = OrderedDict()
+        self._host_cache_used = 0
+        self._host_cache_lock = threading.Lock()
+
+    def table_names(self) -> List[str]:
+        return sorted(
+            f[:-4] for f in os.listdir(self.directory) if f.endswith(".orc")
+        )
+
+    @staticmethod
+    def _file_version(path: str) -> tuple:
+        st = os.stat(path)
+        return (st.st_mtime_ns, st.st_size)
+
+    def _check_fresh(self, name: str):
+        t = self._tables.get(name)
+        if t is None:
+            return
+        path = os.path.join(self.directory, f"{name}.orc")
+        if not os.path.exists(path) or self._file_version(path) != t.version:
+            self._invalidate_table(name)
+
+    def _invalidate_table(self, name: str):
+        self._tables.pop(name, None)
+        self.invalidate_cache(name)
+        with self._host_cache_lock:
+            for k in [k for k in self._host_cache if k[0].endswith(
+                    os.sep + f"{name}.orc")]:
+                _, nbytes = self._host_cache.pop(k)
+                self._host_cache_used -= nbytes
+
+    def _load(self, name: str) -> _OrcTable:
+        self._check_fresh(name)
+        if name in self._tables:
+            return self._tables[name]
+        path = os.path.join(self.directory, f"{name}.orc")
+        if not os.path.exists(path):
+            raise KeyError(f"table not found: {name}")
+        f = po.ORCFile(path)
+        schema = f.schema
+        cols = []
+        dicts: Dict[str, Dictionary] = {}
+        for field in schema:
+            t = _arrow_to_sql(field)
+            if t.is_string:
+                # table-global dictionary: one pass over the column at open
+                vocab = set()
+                for s in range(f.nstripes):
+                    col = f.read_stripe(s, columns=[field.name]).column(
+                        field.name)
+                    arr = col.combine_chunks() if isinstance(
+                        col, pa.ChunkedArray) else col
+                    if pa.types.is_dictionary(arr.type):
+                        vocab.update(arr.dictionary.to_pylist())
+                    else:
+                        vocab.update(arr.to_pylist())
+                d = Dictionary(
+                    np.array(sorted(v for v in vocab if v is not None)))
+                dicts[field.name] = d
+                cols.append(ColumnInfo(field.name, t, d))
+            else:
+                cols.append(ColumnInfo(field.name, t, None))
+        handle = TableHandle(self.name, name, cols,
+                             row_count=float(f.nrows))
+        t = _OrcTable(path, handle, dicts, f.nrows, f.nstripes,
+                      self._file_version(path))
+        self._tables[name] = t
+        return t
+
+    def get_table(self, name: str) -> TableHandle:
+        return self._load(name).handle
+
+    def splits(self, handle: TableHandle, desired: int = 1) -> List[Split]:
+        """One split per stripe, sub-split when fewer stripes than desired
+        (mirrors the parquet connector's row-group sub-splitting)."""
+        t = self._load(handle.name)
+        n = max(t.n_stripes, 1)
+        if n >= desired or t.num_rows == 0:
+            return [Split(handle.name, (s, 0, 1), n)
+                    for s in range(t.n_stripes)] or [
+                        Split(handle.name, (0, 0, 1), 1)]
+        sub = -(-desired // n)
+        out = []
+        for s in range(n):
+            for i in range(sub):
+                out.append(Split(handle.name, (s, i, sub), n * sub))
+        return out
+
+    # -- write path (CTAS/DROP; reference: HiveWriterFactory ORC path) ----
+
+    def create_table_from(self, name: str, batches,
+                          if_not_exists: bool = False) -> int:
+        path = os.path.join(self.directory, f"{name}.orc")
+        if os.path.exists(path):
+            if if_not_exists:
+                return 0
+            raise ValueError(f"table already exists: {name}")
+        names, types, data = _batches_to_host(batches)
+        if any(isinstance(t, (ArrayType, MapType)) for t in types):
+            raise NotImplementedError(
+                "ORC writer does not support ARRAY/MAP columns yet")
+        plain = {c: v[0] for c, v in data.items()}
+        validity = {c: v[1] for c, v in data.items() if v[1] is not None}
+        his = {c: v[2] for c, v in data.items() if v[2] is not None}
+        dicts = {c: v[3] for c, v in data.items() if v[3] is not None}
+        arrays, schema = _to_arrow_columns(plain, dict(zip(names, types)),
+                                           dicts, validity, his)
+        tbl = _undictionarize(pa.Table.from_arrays(arrays, schema=schema))
+        po.write_table(tbl, path + ".tmp")
+        os.replace(path + ".tmp", path)
+        self._invalidate_table(name)
+        return int(tbl.num_rows)
+
+    def drop_table(self, name: str, if_exists: bool = False) -> None:
+        path = os.path.join(self.directory, f"{name}.orc")
+        if not os.path.exists(path):
+            if if_exists:
+                return
+            raise KeyError(f"table not found: {name}")
+        os.remove(path)
+        self._invalidate_table(name)
+
+    # -- read path --------------------------------------------------------
+
+    def read_split(self, split: Split, columns: Sequence[str],
+                   capacity: Optional[int] = None) -> Batch:
+        self._check_fresh(split.table)
+        return super().read_split(split, columns, capacity)
+
+    def _decoded_columns(self, t: _OrcTable, stripe: int, sub: int,
+                         sub_count: int, columns: Sequence[str]):
+        key = (t.path, stripe, sub, sub_count, tuple(columns))
+        with self._host_cache_lock:
+            hit = self._host_cache.get(key)
+            if hit is not None:
+                self._host_cache.move_to_end(key)
+                return hit[0]
+        f = po.ORCFile(t.path)
+        if t.n_stripes == 0:
+            tbl = f.read(columns=list(columns))
+        else:
+            tbl = f.read_stripe(stripe, columns=list(columns))
+            if not isinstance(tbl, pa.Table):
+                tbl = pa.Table.from_batches([tbl])
+        if sub_count > 1:
+            per = -(-tbl.num_rows // sub_count)
+            tbl = tbl.slice(sub * per, per)
+        n = tbl.num_rows
+        out = {}
+        nbytes = 0
+        for name in columns:
+            st = t.handle.column(name).type
+            arr, valid, hi = _decode_column(tbl.column(name), st,
+                                            t.dicts.get(name))
+            arr = np.ascontiguousarray(np.asarray(arr))
+            out[name] = (arr, valid, hi)
+            nbytes += arr.nbytes + (valid.nbytes if valid is not None else 0)
+            nbytes += hi.nbytes if hi is not None else 0
+        result = (out, n)
+        if nbytes <= self.host_cache_bytes:
+            with self._host_cache_lock:
+                if key not in self._host_cache:
+                    self._host_cache[key] = (result, nbytes)
+                    self._host_cache_used += nbytes
+                    while self._host_cache_used > self.host_cache_bytes:
+                        _, (_, freed) = self._host_cache.popitem(last=False)
+                        self._host_cache_used -= freed
+        return result
+
+    def _read_split_uncached(self, split: Split, columns: Sequence[str],
+                             capacity: Optional[int] = None) -> Batch:
+        import jax.numpy as jnp
+
+        from presto_tpu.batch import Column
+
+        t = self._load(split.table)
+        stripe, sub, sub_count = split.part
+        decoded, n = self._decoded_columns(t, stripe, sub, sub_count,
+                                           columns)
+        cap = capacity or round_up_capacity(max(n, 1))
+        names, typelist, cols = [], [], []
+        live = np.zeros(cap, bool)
+        live[:n] = True
+        for name in columns:
+            st = t.handle.column(name).type
+            arr, valid, hi = decoded[name]
+            buf = np.zeros(cap, dtype=st.dtype)
+            buf[:n] = arr
+            vcol = None
+            if valid is not None:
+                vb = np.zeros(cap, bool)
+                vb[:n] = valid
+                vcol = jnp.asarray(vb)
+            hcol = None
+            if hi is not None:
+                hb = np.zeros(cap, np.int64)
+                hb[:n] = hi
+                hcol = jnp.asarray(hb)
+            names.append(name)
+            typelist.append(st)
+            cols.append(Column(jnp.asarray(buf), vcol, hcol))
+        return Batch(
+            names, typelist, cols, jnp.asarray(live),
+            {c: t.dicts[c] for c in columns if c in t.dicts},
+        )
+
+
+def export_table_to_orc(directory: str, name: str, data, types,
+                        dicts=None) -> str:
+    """Materialize host columns as <directory>/<name>.orc (test fixture
+    helper, the dbgen→ORC-warehouse path)."""
+    os.makedirs(directory, exist_ok=True)
+    arrays, schema = _to_arrow_columns(data, types, dicts or {})
+    path = os.path.join(directory, f"{name}.orc")
+    tbl = _undictionarize(pa.Table.from_arrays(arrays, schema=schema))
+    po.write_table(tbl, path)
+    return path

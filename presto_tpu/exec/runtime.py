@@ -110,6 +110,15 @@ class ExecConfig:
     spill_partitions: int = 8
     memory_revoking_threshold: float = 0.9
     memory_revoking_target: float = 0.5
+    # background split prefetch depth: decode/stage split i+1..i+depth on a
+    # host thread while the device computes split i (the IO/compute overlap
+    # of the reference's async split loading — PageSourceProvider readers
+    # run ahead of the driver). 0 disables.
+    scan_prefetch: int = 2
+    # query-level elastic retry (the reference's RetryPolicy.QUERY): on a
+    # failed/unreachable worker the coordinator re-probes the cluster,
+    # drops dead nodes, and re-executes the whole query this many times
+    query_retry_count: int = 1
 
 
 def _node_jit(node: PlanNode, key: str, builder, **jit_kwargs):
@@ -400,9 +409,53 @@ def _scan_batches(scan: TableScan, ctx: ExecContext) -> Iterator[Batch]:
             ctx.stats[f"scan.{scan.table}.splits_pruned"] = before - len(splits)
     if ctx.n_tasks > 1:
         splits = splits[ctx.task_index::ctx.n_tasks]
-    for split in splits:
-        b = conn.read_split(split, columns, capacity=cap)
-        yield b.rename(symbols)
+    depth = ctx.config.scan_prefetch
+    if depth <= 0 or len(splits) <= 1:
+        for split in splits:
+            b = conn.read_split(split, columns, capacity=cap)
+            yield b.rename(symbols)
+        return
+    # pipelined scan: a host thread decodes/stages splits ahead of the
+    # device (bounded queue so memory stays O(depth) batches)
+    import queue as _queue
+    import threading as _threading
+
+    q: "_queue.Queue" = _queue.Queue(maxsize=depth)
+    _SENTINEL = object()
+    stop = _threading.Event()
+
+    def producer():
+        try:
+            for split in splits:
+                if stop.is_set():
+                    break
+                q.put(conn.read_split(split, columns, capacity=cap))
+            q.put(_SENTINEL)
+        except BaseException as e:  # surface read errors on the consumer
+            q.put(e)
+
+    t = _threading.Thread(target=producer, daemon=True,
+                          name="scan-prefetch")
+    t.start()
+    try:
+        while True:
+            item = q.get()
+            if item is _SENTINEL:
+                return
+            if isinstance(item, BaseException):
+                raise item
+            yield item.rename(symbols)
+    finally:
+        # early termination (LIMIT / error): stop the producer after its
+        # current read and unblock any pending put
+        stop.set()
+        while t.is_alive():
+            try:
+                item = q.get(timeout=0.1)
+                if item is _SENTINEL or isinstance(item, BaseException):
+                    break
+            except _queue.Empty:
+                continue
 
 
 def _constraints_to_storage(scan: TableScan, handle):

@@ -1471,7 +1471,8 @@ class Planner:
                     "approx_distinct mixed with other aggregates not supported yet")
             agg_node = self._plan_hll(pre, group_syms, agg_specs[0], pre_exprs, node)
         elif (pct_aggs and len(agg_specs) == len(pct_aggs)
-              and len({a.arg for a in pct_aggs}) == 1):
+              and len({a.arg for a in pct_aggs}) == 1
+              and not any(a.distinct for a in pct_aggs)):
             # all aggregates are approx_percentile over one column → the
             # mergeable quantized-histogram sketch (distributable); mixed
             # forms fall back to the materialized exact path below

@@ -36,13 +36,17 @@ from presto_tpu.plan.nodes import (
     Window,
 )
 
-SOURCE = "source"   # leaf scans; splits assigned across tasks
-HASH = "hash"       # one task per worker, rows owned by hash(keys) % n
-SINGLE = "single"   # exactly one task
+SOURCE = "source"       # leaf scans; splits assigned across tasks
+HASH = "hash"           # one task per worker, rows owned by hash(keys) % n
+SINGLE = "single"       # exactly one task
+ARBITRARY = "arbitrary"  # one task per worker, rows owned by no key
+                         # (round-robin redistributed — the reference's
+                         # FIXED_ARBITRARY_DISTRIBUTION)
 
 OUT_HASH = "hash"
 OUT_GATHER = "gather"
 OUT_BROADCAST = "broadcast"
+OUT_RR = "rr"  # page-level round robin (ArbitraryOutputBuffer analog)
 
 
 @dataclasses.dataclass
@@ -209,11 +213,17 @@ class _Fragmenter:
             node.child = self.cut(partial, cpart, OUT_GATHER)
             return node, SINGLE
         if isinstance(node, SetOp):
-            # children gather to the set-op task (UNION ALL could stream
-            # per-task; DISTINCT variants need global visibility — start
-            # with the simple correct shape for all kinds)
             left, lpart = self.process(node.left)
             right, rpart = self.process(node.right)
+            if node.kind == "union" and node.all and not (
+                    lpart == SINGLE and rpart == SINGLE):
+                # UNION ALL streams: children round-robin pages across the
+                # union fragment's tasks (FIXED_ARBITRARY distribution) —
+                # no gather bottleneck, downstream partials run per task
+                node.left = self.cut(left, lpart, OUT_RR)
+                node.right = self.cut(right, rpart, OUT_RR)
+                return node, ARBITRARY
+            # DISTINCT variants need global visibility: gather
             node.left = left if lpart == SINGLE else self.cut(left, lpart, OUT_GATHER)
             node.right = (right if rpart == SINGLE
                           else self.cut(right, rpart, OUT_GATHER))

@@ -24,6 +24,7 @@ import json
 import re
 import threading
 import time
+import urllib.error
 import urllib.request
 from typing import Dict, List, Optional
 
@@ -159,7 +160,14 @@ class ClusterSizeMonitor:
 
 
 class QueryFailed(RuntimeError):
-    pass
+    """`retryable=True` marks failures caused by worker/transport loss
+    (worth re-running on the surviving cluster); deterministic task errors
+    stay non-retryable — the reference's RetryPolicy.QUERY makes the same
+    distinction."""
+
+    def __init__(self, msg: str, retryable: bool = False):
+        super().__init__(msg)
+        self.retryable = retryable
 
 
 class DistributedScheduler:
@@ -255,7 +263,7 @@ class DistributedScheduler:
             finally:
                 client.close()
         except ExchangeFailure as e:
-            raise QueryFailed(str(e)) from e
+            raise QueryFailed(str(e), retryable=not e.task_error) from e
         finally:
             # abort on ANY early exit — including GeneratorExit when the
             # consumer abandons the stream (client disconnect / LIMIT) —
@@ -459,6 +467,53 @@ class Coordinator:
         workers = self.node_manager.active_nodes()
         yield from self.scheduler.execute(qid, dplan, workers, config)
 
+    def _reprobe_workers(self):
+        """Synchronous cluster probe before a retry: a node that fails its
+        probe is excluded IMMEDIATELY (score jump past the threshold) —
+        the background detector's decayed counter is deliberately slow for
+        flaky networks, but a retry must not re-schedule onto a node that
+        just killed the query."""
+        def probe(n):
+            try:
+                with urllib.request.urlopen(f"{n.uri}/v1/status",
+                                            timeout=3) as r:
+                    json.loads(r.read())
+                n.record_success()
+            except Exception:
+                n.failure_score = 5.0  # past NodeInfo.failed threshold
+
+        threads = [threading.Thread(target=probe, args=(n,), daemon=True)
+                   for n in list(self.node_manager.nodes.values())]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=4)
+
+    def _execute_with_retry(self, dplan: DistributedPlan,
+                            config: Optional[ExecConfig] = None) -> list:
+        """Query-level elastic retry (reference: RetryPolicy.QUERY /
+        recoverable execution's coarse form): any task failure or worker
+        transport error re-probes the cluster and re-runs the whole query
+        on the surviving nodes."""
+        retries = (config or self.config).query_retry_count
+        attempt = 0
+        while True:
+            try:
+                return list(self.execute_distributed(dplan, config))
+            except (QueryFailed, urllib.error.URLError, OSError) as e:
+                # deterministic task errors re-fail identically: don't
+                # burn a full re-execution on them
+                retryable = (e.retryable if isinstance(e, QueryFailed)
+                             else True)
+                if attempt >= retries or not retryable:
+                    raise (e if isinstance(e, QueryFailed)
+                           else QueryFailed(str(e), retryable=True))
+                attempt += 1
+                self._reprobe_workers()
+                if not self.node_manager.active_nodes():
+                    raise QueryFailed(
+                        "no active workers after failure probe") from e
+
     def plan_distributed(self, sql: str, session=None,
                          stmt=None) -> DistributedPlan:
         from presto_tpu.exec.runtime import ExecContext, _bind_plan_params, run_plan
@@ -547,7 +602,7 @@ class Coordinator:
             return execute_data_definition(stmt, self.catalog, run_query_fn)
 
         dplan = self.plan_distributed(sql, session, stmt=stmt)
-        batches = list(self.execute_distributed(dplan, config))
+        batches = self._execute_with_retry(dplan, config)
         merged = _collect_concat(iter(batches))
         if merged is None:
             root = dplan.fragments[dplan.root_fid].root

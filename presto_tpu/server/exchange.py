@@ -26,6 +26,14 @@ from presto_tpu.serde import deserialize_batch
 
 
 class ExchangeFailure(RuntimeError):
+    """`task_error=True` means the REMOTE task reported a deterministic
+    failure (its error message travels in the results header) — retrying
+    the query would hit the same error. False means a transport-level
+    failure (unreachable/partial producer), which IS worth a retry."""
+
+    def __init__(self, msg: str, task_error: bool = False):
+        super().__init__(msg)
+        self.task_error = task_error
     pass
 
 
@@ -74,7 +82,7 @@ class _LocationPuller(threading.Thread):
                     raise
                 header, pages = parse_results_payload(data)
                 if header.get("error"):
-                    raise ExchangeFailure(header["error"])
+                    raise ExchangeFailure(header["error"], task_error=True)
                 for p in pages:
                     self.out._offer(p)
                 next_token = header["next_token"]
@@ -87,7 +95,8 @@ class _LocationPuller(threading.Thread):
                 if header.get("complete"):
                     break
         except Exception as e:  # propagate to the consuming iterator
-            self.out._fail(f"{self.location}: {e}")
+            self.out._fail(f"{self.location}: {e}",
+                           getattr(e, "task_error", False))
         finally:
             self.out._done()
 
@@ -114,10 +123,11 @@ class ExchangeClient:
             except queue.Full:
                 continue
 
-    def _fail(self, msg: str):
+    def _fail(self, msg: str, task_error: bool = False):
         with self._lock:
             if self._error is None:
                 self._error = msg
+                self._error_is_task = task_error
 
     def _done(self):
         with self._lock:
@@ -130,7 +140,9 @@ class ExchangeClient:
             with self._lock:
                 if self._error is not None:
                     self.closed = True
-                    raise ExchangeFailure(self._error)
+                    raise ExchangeFailure(
+                        self._error,
+                        task_error=getattr(self, "_error_is_task", False))
                 if done >= len(self.locations) and self._queue.empty():
                     return
             try:

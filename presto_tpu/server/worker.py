@@ -39,6 +39,7 @@ from presto_tpu.plan.fragmenter import (
     OUT_BROADCAST,
     OUT_GATHER,
     OUT_HASH,
+    OUT_RR,
     Fragment,
 )
 from presto_tpu.serde import serialize_batch
@@ -133,6 +134,22 @@ class TaskExecution:
                     mask = live & (pid == p)
                     if mask.any():
                         self.buffer.enqueue(p, serialize_batch(b.with_live(mask)))
+
+            return sink
+
+        if f.output_partitioning == OUT_RR and self.update.n_out_partitions > 1:
+            n_parts = self.update.n_out_partitions
+            state = {"next": self.update.task_index}  # stagger producers
+
+            def sink(b: Batch):
+                # page-level round robin (the reference's
+                # ArbitraryOutputBuffer: any consumer may take a page;
+                # deterministic rotation here keeps tasks balanced)
+                if int(np.asarray(b.live).sum()) == 0:
+                    return
+                p = state["next"] % n_parts
+                state["next"] += 1
+                self.buffer.enqueue(p, serialize_batch(b))
 
             return sink
 
@@ -424,6 +441,11 @@ class Worker:
         threading.Thread(target=drain, daemon=True).start()
 
     def close(self):
+        # stop announcing FIRST: a closed server that keeps announcing
+        # would decay its failure score back under the exclusion threshold
+        # and re-enter scheduling rotation as a black hole
+        if self.node_state == "active":
+            self.node_state = "shut_down"
         self.task_manager.abort_all()
         self.server.shutdown()
         self.server.server_close()

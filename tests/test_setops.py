@@ -125,3 +125,29 @@ def test_full_outer_vs_manual_decomposition(engines):
                       "left join dim on a.k = dim.dk")
     anti = runner.run("select dk from dim where dk not in (select k from a)")
     assert len(full) == len(left) + len(anti)
+
+
+def test_union_all_distributed_round_robin(engines):
+    """Distributed UNION ALL redistributes pages round-robin across the
+    union fragment's tasks (FIXED_ARBITRARY / ArbitraryOutputBuffer
+    analog) instead of gathering — result must match the local engine,
+    and the plan must show rr-partitioned children."""
+    from presto_tpu.server.coordinator import DistributedRunner
+
+    runner, _ = engines
+    sql = ("select s, count(*) as n, sum(k) as sk from "
+           "(select k, s from a union all select k, s from b) u "
+           "group by s order by s")
+    local = runner.run(sql)
+    dist = DistributedRunner(runner.catalog, n_workers=2,
+                             config=ExecConfig(batch_rows=1 << 10))
+    try:
+        dplan = dist.coordinator.plan_distributed(sql)
+        parts = [f.output_partitioning for f in dplan.fragments.values()]
+        assert "rr" in parts, parts
+        got = dist.run(sql)
+        assert got.s.tolist() == local.s.tolist()
+        assert got.n.tolist() == local.n.tolist()
+        assert got.sk.tolist() == local.sk.tolist()
+    finally:
+        dist.close()
