@@ -68,6 +68,7 @@ from presto_tpu.plan.nodes import (
     Filter,
     HashJoin,
     Limit,
+    NestedLoopJoin,
     OneRow,
     Output,
     PlanNode,
@@ -327,6 +328,9 @@ def _execute_base(base: PlanNode, ctx: ExecContext) -> Iterator[Batch]:
         return
     if isinstance(base, HashJoin):
         yield from _execute_join(base, ctx)
+        return
+    if isinstance(base, NestedLoopJoin):
+        yield from _execute_nljoin(base, ctx)
         return
     if isinstance(base, SemiJoin):
         yield from _execute_semijoin(base, ctx)
@@ -1704,6 +1708,110 @@ def _join_probe(node: HashJoin, ctx: ExecContext, build_in: Optional[Batch],
             yield jnull(table, pb, exists_acc)
     if want_full:
         yield jremainder(table, bm)
+
+
+def _column_chunk(c: Column, off, size: int) -> Column:
+    """Rows [off, off+size) of every plane (traced offset, static size)."""
+    def dsl(a):
+        return jax.lax.dynamic_slice_in_dim(a, off, size, axis=0)
+
+    return Column(
+        dsl(c.values),
+        None if c.validity is None else dsl(c.validity),
+        None if c.hi is None else dsl(c.hi),
+        None if c.sizes is None else dsl(c.sizes),
+        None if c.evalid is None else dsl(c.evalid),
+        None if c.keys is None else dsl(c.keys),
+    )
+
+
+def _column_repeat(c: Column, k: int) -> Column:
+    """Each row k times (out row i*k+j = in row i)."""
+    def rep(a):
+        return jnp.repeat(a, k, axis=0)
+
+    return Column(
+        rep(c.values),
+        None if c.validity is None else rep(c.validity),
+        None if c.hi is None else rep(c.hi),
+        None if c.sizes is None else rep(c.sizes),
+        None if c.evalid is None else rep(c.evalid),
+        None if c.keys is None else rep(c.keys),
+    )
+
+
+def _column_tile(c: Column, k: int) -> Column:
+    """The whole column k times (out row i*n+j = in row j)."""
+    def tile(a):
+        reps = (k,) + (1,) * (a.ndim - 1)
+        return jnp.tile(a, reps)
+
+    return Column(
+        tile(c.values),
+        None if c.validity is None else tile(c.validity),
+        None if c.hi is None else tile(c.hi),
+        None if c.sizes is None else tile(c.sizes),
+        None if c.evalid is None else tile(c.evalid),
+        None if c.keys is None else tile(c.keys),
+    )
+
+
+def _execute_nljoin(node: NestedLoopJoin, ctx: ExecContext) -> Iterator[Batch]:
+    """Nested-loop inner join (cross product / non-equi ON). Reference:
+    NestedLoopJoinOperator.java — there per-position page crossing; here
+    each output batch is one probe batch × one fixed-size build chunk,
+    expanded by repeat/tile with the residual predicate fused into the
+    same program (static shapes: chunk size is a trace-time constant)."""
+    from presto_tpu.expr.compile import compile_predicate
+
+    probe_stream, chain = _fused_child(node.left, ctx)
+    build = _collect_concat(execute_node(node.right, ctx))
+    if build is None:
+        return
+    build = _JIT_COMPACT(build)  # live rows to the front
+    nb = build.num_live()
+    if nb == 0:
+        return
+    pred = (compile_predicate(node.residual)
+            if node.residual is not None else None)
+    lnames = [s for s, _ in node.left.output]
+    rnames = [s for s, _ in node.right.output]
+    out_names = lnames + rnames
+    out_types = [t for _, t in node.left.output] + [
+        t for _, t in node.right.output]
+
+    def chunk_size(np_cap: int) -> int:
+        # ≤512 build rows per output batch, bounded to ~2^21 output rows;
+        # powers of two dividing the (pow2) build capacity, so fixed-size
+        # dynamic slices never clamp (a clamped tail slice would re-read
+        # earlier rows and duplicate join output)
+        return min(512, max(1, (1 << 21) // max(np_cap, 1)), build.capacity)
+
+    def expand(pb: Batch, bb: Batch, off):
+        pb = chain(pb)
+        np_cap = pb.capacity
+        c = chunk_size(np_cap)
+        chunk_cols = [_column_chunk(col, off, c) for col in bb.columns]
+        chunk_live = jax.lax.dynamic_slice_in_dim(bb.live, off, c)
+        cols = [_column_repeat(col, c) for col in pb.columns] + [
+            _column_tile(col, np_cap) for col in chunk_cols
+        ]
+        live = (jnp.repeat(pb.live, c) & jnp.tile(chunk_live, np_cap))
+        dicts = dict(bb.dicts)
+        dicts.update(pb.dicts)
+        out = Batch(out_names, out_types, cols, live, dicts)
+        if pred is not None:
+            out = out.with_live(out.live & pred(out))
+        return out
+
+    # chunk size must match expand()'s: recompute identically per capacity
+    jexpand = _node_jit(node, "expand", lambda: expand)
+    for raw in probe_stream:
+        c = chunk_size(raw.capacity)
+        for off in range(0, nb, c):
+            # traced offset: one compiled program per (capacity) shape,
+            # not per chunk position
+            yield jexpand(raw, build, jnp.int32(off))
 
 
 def _execute_semijoin(node: SemiJoin, ctx: ExecContext) -> Iterator[Batch]:

@@ -33,6 +33,7 @@ from presto_tpu.plan.nodes import (
     Filter,
     HashJoin,
     Limit,
+    NestedLoopJoin,
     OneRow,
     Output,
     PlanNode,
@@ -863,7 +864,14 @@ class Planner:
         else:
             kind = rel.kind
         if not lkeys and kind != "cross":
-            raise AnalysisError("non-equi join conditions not supported yet")
+            if kind != "inner":
+                raise AnalysisError(
+                    "outer joins require at least one equi-join condition")
+            # non-equi INNER join → nested loop with the condition fused
+            # (NestedLoopJoinOperator; build = right as written)
+            node = NestedLoopJoin(left.node, right.node,
+                                  residual=combine_conjuncts(residual) or cond)
+            return RelationPlan(node, scope, rows=left.rows * right.rows)
         if kind == "left":
             # push build-side-only residuals into the build side (correct for
             # LEFT: non-matching build rows are dropped pre-join)
@@ -1190,7 +1198,28 @@ class Planner:
                 if best is None or out_rows < best[0]:
                     best = (out_rows, leaf, lkeys, rkeys, rest, leaf_rows, leaf_st)
             if best is None:
-                raise AnalysisError("disconnected join graph (cross product) not supported")
+                # disconnected join graph: cross product via nested loop
+                # against the smallest remaining leaf (ReorderJoins keeps
+                # cross products last for the same reason); conjuncts that
+                # span the two sides (non-equi) fuse as the residual
+                remaining.sort(key=lambda r: est[id(r)][0])
+                leaf = remaining.pop(0)
+                leaf_rows, leaf_st = est[id(leaf)]
+                cur_syms2 = cur_syms | {f.symbol for f in leaf.scope.fields}
+                covered = [c for c in pending if expr_inputs(c) <= cur_syms2]
+                pending = [c for c in pending if expr_inputs(c) > cur_syms2]
+                node = NestedLoopJoin(current.node, leaf.node,
+                                      residual=combine_conjuncts(covered))
+                out_rows = max(cur_rows * leaf_rows, 1.0)
+                merged_cols = {}
+                for st in (cur_st, leaf_st):
+                    if st is not None:
+                        merged_cols.update(st.columns)
+                cur_st = NodeStats(out_rows, merged_cols)
+                cur_rows = out_rows
+                current = RelationPlan(node, current.scope + leaf.scope,
+                                       rows=out_rows)
+                continue
             out_rows, leaf, lkeys, rkeys, rest, leaf_rows, leaf_st = best
             remaining.remove(leaf)
             # consumed conjuncts: pending minus rest

@@ -21,6 +21,7 @@ from presto_tpu.plan.nodes import (
     Filter,
     HashJoin,
     Limit,
+    NestedLoopJoin,
     OneRow,
     Output,
     PlanNode,
@@ -123,6 +124,11 @@ def node_to_json(n: PlanNode) -> Dict[str, Any]:
                 "residual": (expr_to_json(n.residual)
                              if n.residual is not None else None),
                 "build_unique": n.build_unique}
+    if isinstance(n, NestedLoopJoin):
+        return {"k": "nljoin",
+                "left": node_to_json(n.left), "right": node_to_json(n.right),
+                "residual": (expr_to_json(n.residual)
+                             if n.residual is not None else None)}
     if isinstance(n, SemiJoin):
         return {"k": "semijoin", "negated": n.negated,
                 "null_aware": n.null_aware,
@@ -195,6 +201,12 @@ def node_from_json(d: Dict[str, Any]) -> PlanNode:
             residual=(expr_from_json(d["residual"])
                       if d.get("residual") is not None else None),
             build_unique=bool(d.get("build_unique", False)),
+        )
+    if k == "nljoin":
+        return NestedLoopJoin(
+            left=node_from_json(d["left"]), right=node_from_json(d["right"]),
+            residual=(expr_from_json(d["residual"])
+                      if d.get("residual") is not None else None),
         )
     if k == "semijoin":
         return SemiJoin(

@@ -180,6 +180,19 @@ class _Fragmenter:
                 return node, SINGLE
             node.right = self.cut(right, rpart, OUT_BROADCAST)
             return node, lpart
+        from presto_tpu.plan.nodes import NestedLoopJoin
+
+        if isinstance(node, NestedLoopJoin):
+            # probe keeps its partitioning; the build is replicated
+            # (NestedLoopBuildOperator is broadcast-only in the reference)
+            left, lpart = self.process(node.left)
+            right, rpart = self.process(node.right)
+            node.left = left
+            if rpart == SINGLE and lpart == SINGLE:
+                node.right = right
+                return node, SINGLE
+            node.right = self.cut(right, rpart, OUT_BROADCAST)
+            return node, lpart
         if isinstance(node, Window):
             child, cpart = self.process(node.child)
             if cpart == SINGLE:

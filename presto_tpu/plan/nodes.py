@@ -178,6 +178,25 @@ class HashJoin(PlanNode):
 
 
 @dataclasses.dataclass
+class NestedLoopJoin(PlanNode):
+    """Inner join with no equi keys (pure cross product or non-equi ON
+    condition). Reference: NestedLoopJoinOperator.java + NestedLoopBuild
+    Operator (inner-only there too). Executed as probe×build-chunk
+    expansion with the residual fused (exec/runtime._execute_nljoin)."""
+
+    left: PlanNode   # probe (streamed)
+    right: PlanNode  # build (collected, broadcast in distributed plans)
+    residual: Optional[RowExpression] = None
+
+    @property
+    def output(self):
+        return list(self.left.output) + list(self.right.output)
+
+    def children(self):
+        return [self.left, self.right]
+
+
+@dataclasses.dataclass
 class SemiJoin(PlanNode):
     """left [NOT] IN (subquery) / [NOT] EXISTS — probe side filtered by
     membership (reference: HashSemiJoinOperator / SemiJoinNode). Multi-key

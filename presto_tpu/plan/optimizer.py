@@ -133,6 +133,28 @@ def _push_into(node: PlanNode, conjs: List[RowExpression]) -> PlanNode:
         node.left = push_filters(node.left)
         node.right = push_filters(node.right)
         return Filter(node, _combine(kept)) if kept else node
+    from presto_tpu.plan.nodes import NestedLoopJoin as _NLJ
+
+    if isinstance(node, _NLJ):
+        # inner semantics: single-side conjuncts push through freely
+        lsyms = {n for n, _ in node.left.output}
+        rsyms = {n for n, _ in node.right.output}
+        lpush, rpush, kept = [], [], []
+        for c in conjs:
+            ins = expr_inputs(c)
+            if ins <= lsyms:
+                lpush.append(c)
+            elif ins <= rsyms:
+                rpush.append(c)
+            else:
+                kept.append(c)
+        if lpush:
+            node.left = _push_into(node.left, lpush)
+        if rpush:
+            node.right = _push_into(node.right, rpush)
+        node.left = push_filters(node.left)
+        node.right = push_filters(node.right)
+        return Filter(node, _combine(kept)) if kept else node
     if isinstance(node, Aggregate):
         keys = set(node.group_keys)
         below, above = [], []
@@ -261,6 +283,17 @@ def prune_columns(node: PlanNode, required: Set[str]) -> PlanNode:
         node.replicate = [s for s in node.replicate if s in required]
         node.child = prune_columns(
             node.child, set(node.replicate) | set(node.sources))
+        return node
+    from presto_tpu.plan.nodes import NestedLoopJoin as _NLJ
+
+    if isinstance(node, _NLJ):
+        need = set(required)
+        if node.residual is not None:
+            need |= expr_inputs(node.residual)
+        lsyms = {n for n, _ in node.left.output}
+        rsyms = {n for n, _ in node.right.output}
+        node.left = prune_columns(node.left, need & lsyms)
+        node.right = prune_columns(node.right, need & rsyms)
         return node
     for c in node.children():
         prune_columns(c, required)
