@@ -2103,12 +2103,17 @@ def _execute_window(node: Window, ctx: ExecContext) -> Iterator[Batch]:
                 v, valid = W.ntile(wk, f.param)
             elif f.fn in ("lag", "lead", "first_value", "last_value", "nth_value"):
                 c = sb.column(f.arg)
+                bounded = f.frame is not None and f.frame.startswith("rows:")
                 if f.fn == "lag":
                     v, valid = W.lag(wk, c.values, c.validity,
                                      f.param if f.param is not None else 1)
                 elif f.fn == "lead":
                     v, valid = W.lead(wk, c.values, c.validity,
                                       f.param if f.param is not None else 1)
+                elif bounded:
+                    v, valid = W.value_over_frame(
+                        wk, f.fn, c.values, c.validity, f.frame,
+                        f.param if f.param is not None else 1)
                 elif f.fn == "first_value":
                     v, valid = W.first_value(wk, c.values, c.validity)
                 elif f.fn == "last_value":
@@ -2116,17 +2121,33 @@ def _execute_window(node: Window, ctx: ExecContext) -> Iterator[Batch]:
                 else:
                     v, valid = W.nth_value(wk, c.values, c.validity, f.param)
             elif f.fn in ("sum", "avg", "min", "max", "count"):
+                bounded = f.frame is not None and f.frame.startswith("rows:")
                 if not node.order_items:
                     frame = "whole"
                 elif f.frame == "rows_unbounded_current":
                     frame = "rows"
                 else:
                     frame = "range"
-                if f.arg is None:
+                if bounded and f.arg is None:
+                    v, valid = W.agg_window_bounded(
+                        wk, "count", jnp.zeros(sb.capacity, jnp.int64), None,
+                        f.frame, False)
+                elif f.arg is None:
                     v, valid = W.agg_window(
                         wk, "count", jnp.zeros(sb.capacity, jnp.int64), None,
                         frame, False,
                     )
+                elif bounded:
+                    c = sb.column(f.arg)
+                    vals = c.values
+                    arg_t = child_types.get(f.arg)
+                    is_float = jnp.issubdtype(vals.dtype, jnp.floating)
+                    if f.fn == "avg" and not is_float:
+                        scale = arg_t.scale if isinstance(arg_t, _Dec) else 0
+                        vals = vals.astype(jnp.float64) / (10.0 ** scale)
+                        is_float = True
+                    v, valid = W.agg_window_bounded(
+                        wk, f.fn, vals, c.validity, f.frame, is_float)
                 else:
                     c = sb.column(f.arg)
                     vals = c.values

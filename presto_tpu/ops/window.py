@@ -285,6 +285,147 @@ def agg_window(
     raise NotImplementedError(f"window aggregate {fn}")
 
 
+# ---------------------------------------------------------------------------
+# bounded ROWS frames (ROWS BETWEEN <bound> AND <bound>)
+
+
+def parse_frame_bound(tok: str):
+    """'up' | 'uf' | 'cur' | 'pN' | 'fN' → (kind, offset)."""
+    if tok in ("up", "uf", "cur"):
+        return tok, 0
+    if tok[0] == "p":
+        return "p", int(tok[1:])
+    if tok[0] == "f":
+        return "f", int(tok[1:])
+    raise ValueError(f"bad frame bound {tok!r}")
+
+
+def frame_bounds(k: WindowKeys, frame: str):
+    """'rows:<s>:<e>' → (start_idx, end_idx, nonempty) per sorted row.
+    Bounds clamp to the partition; an inverted frame is empty (SQL: the
+    aggregate over an empty frame is NULL / count 0)."""
+    _, s_tok, e_tok = frame.split(":")
+    sk, so = parse_frame_bound(s_tok)
+    ek, eo = parse_frame_bound(e_tok)
+    n = k.live.shape[0]
+    iota = jnp.arange(n)
+    seg_end = k.seg_start + jnp.maximum(k.seg_size - 1, 0)
+    start = {
+        "up": k.seg_start,
+        "cur": iota,
+        "p": iota - so,
+        "f": iota + so,
+        "uf": seg_end,
+    }[sk]
+    end = {
+        "up": k.seg_start,
+        "cur": iota,
+        "p": iota - eo,
+        "f": iota + eo,
+        "uf": seg_end,
+    }[ek]
+    nonempty = (jnp.maximum(start, k.seg_start)
+                <= jnp.minimum(end, seg_end)) & k.live
+    start_c = jnp.clip(start, k.seg_start, seg_end)
+    end_c = jnp.clip(end, k.seg_start, seg_end)
+    return start_c.astype(jnp.int32), end_c.astype(jnp.int32), nonempty
+
+
+def _range_min_table(v):
+    """Sparse table for O(1) range-min queries: levels[j][i] = min over
+    [i, i + 2^j). O(n log n) build, pure elementwise shifts — the
+    vectorized substitute for the reference's per-row frame walk."""
+    n = v.shape[0]
+    levels = [v]
+    j = 0
+    while (1 << (j + 1)) <= n:
+        prev = levels[-1]
+        half = 1 << j
+        shifted = jnp.concatenate([prev[half:], prev[-1:].repeat(half)])
+        levels.append(jnp.minimum(prev, shifted))
+        j += 1
+    return jnp.stack(levels)  # [L, n]
+
+
+def _range_min_query(table, start, end):
+    """min over [start, end] (inclusive, start<=end) via two overlapping
+    power-of-two windows."""
+    n = table.shape[1]
+    span = (end - start + 1).astype(jnp.int32)
+    # floor(log2(span)): span >= 1
+    j = (31 - jax.lax.clz(span.astype(jnp.int32))).astype(jnp.int32)
+    j = jnp.clip(j, 0, table.shape[0] - 1)
+    second = jnp.clip(end - (1 << j) + 1, 0, n - 1)
+    a = table[j, start]
+    b = table[j, second]
+    return jnp.minimum(a, b)
+
+
+def agg_window_bounded(k: WindowKeys, fn: str, values, validity,
+                       frame: str, is_float: bool):
+    """sum/avg/min/max/count over an explicit ROWS frame. Prefix-sum
+    differences for sum/count (both gather indices stay inside one
+    partition, so cross-partition terms cancel); sparse-table range
+    min/max for extremes."""
+    start, end, nonempty = frame_bounds(k, frame)
+    valid = k.live if validity is None else (k.live & validity)
+
+    def windowed_sum(x, dtype):
+        xv = jnp.where(valid, x.astype(dtype), jnp.zeros((), dtype))
+        cs = jnp.cumsum(xv)
+        lo = jnp.where(start > 0, cs[jnp.maximum(start - 1, 0)],
+                       jnp.zeros((), dtype))
+        return cs[end] - lo
+
+    cnt = windowed_sum(jnp.ones_like(k.live, dtype=jnp.int64), jnp.int64)
+    cnt = jnp.where(nonempty, cnt, 0)
+    if fn == "count":
+        return cnt, None
+    if fn in ("sum", "avg"):
+        acc_dtype = values.dtype if is_float else jnp.int64
+        s = jnp.where(nonempty, windowed_sum(values, acc_dtype),
+                      jnp.zeros((), acc_dtype))
+        out_valid = nonempty & (cnt > 0)
+        if fn == "sum":
+            return s, out_valid
+        if is_float:
+            return s / jnp.maximum(cnt, 1).astype(s.dtype), out_valid
+        av = jnp.abs(s)
+        cden = jnp.maximum(cnt, 1)
+        return jnp.sign(s) * ((av + cden // 2) // cden), out_valid
+    if fn in ("min", "max"):
+        if is_float:
+            sent = jnp.inf if fn == "min" else -jnp.inf
+        else:
+            info = jnp.iinfo(values.dtype)
+            sent = info.max if fn == "min" else info.min
+        v = jnp.where(valid, values, jnp.asarray(sent, values.dtype))
+        if fn == "max":
+            v = -v
+        table = _range_min_table(v)
+        out = _range_min_query(table, start, end)
+        if fn == "max":
+            out = -out
+        return out, nonempty & (cnt > 0)
+    raise NotImplementedError(f"bounded window aggregate {fn}")
+
+
+def value_over_frame(k: WindowKeys, fn: str, values, validity, frame: str,
+                     nth: int = 1):
+    """first_value/last_value/nth_value over an explicit ROWS frame."""
+    start, end, nonempty = frame_bounds(k, frame)
+    if fn == "first_value":
+        idx = start
+        ok = nonempty
+    elif fn == "last_value":
+        idx = end
+        ok = nonempty
+    else:
+        idx = start + (nth - 1)
+        ok = nonempty & (nth >= 1) & (idx <= end)
+    return _shift_gather(values, validity, idx, ok, k.live)
+
+
 def _segmented_cummin(v, k: WindowKeys):
     """Running minimum that resets at partition boundaries.
 

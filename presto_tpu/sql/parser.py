@@ -700,8 +700,19 @@ class Parser:
             order_by.append(self.parse_order_item())
             while self.accept_op(","):
                 order_by.append(self.parse_order_item())
-        if self.accept_kw("rows") or self.accept_kw("range"):
-            # only the default-equivalent frames are accepted
+        if self.accept_kw("rows"):
+            if self.accept_kw("between"):
+                s = self._parse_frame_bound(is_start=True)
+                self.expect_kw("and")
+                e = self._parse_frame_bound(is_start=False)
+            else:
+                # shorthand: ROWS <bound> == BETWEEN <bound> AND CURRENT ROW
+                s = self._parse_frame_bound(is_start=True)
+                e = "cur"
+            frame = ("rows_unbounded_current" if (s, e) == ("up", "cur")
+                     else f"rows:{s}:{e}")
+        elif self.accept_kw("range"):
+            # RANGE: only the default-equivalent frame is accepted
             self.expect_kw("between")
             self.expect_kw("unbounded")
             self.expect_kw("preceding")
@@ -713,6 +724,31 @@ class Parser:
         return ast.WindowFunction(
             fc.name, fc.args, partition_by, order_by, fc.is_star, frame
         )
+
+    def _parse_frame_bound(self, is_start: bool) -> str:
+        """UNBOUNDED PRECEDING|FOLLOWING, n PRECEDING|FOLLOWING,
+        CURRENT ROW → the compact frame-bound token ('up','uf','cur',
+        'pN','fN')."""
+        if self.accept_kw("unbounded"):
+            if self.accept_kw("preceding"):
+                if not is_start:
+                    raise ParseError("frame end cannot be UNBOUNDED PRECEDING")
+                return "up"
+            self.expect_kw("following")
+            if is_start:
+                raise ParseError("frame start cannot be UNBOUNDED FOLLOWING")
+            return "uf"
+        if self.accept_kw("current"):
+            self.expect_kw("row")
+            return "cur"
+        t = self.next()
+        if t.kind != "number" or not t.value.isdigit():
+            raise ParseError(f"expected frame offset, got {t.value!r}")
+        n = int(t.value)
+        if self.accept_kw("preceding"):
+            return f"p{n}"
+        self.expect_kw("following")
+        return f"f{n}"
 
     def parse_case(self) -> ast.Node:
         self.expect_kw("case")

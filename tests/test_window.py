@@ -228,3 +228,93 @@ def test_rows_frame_vs_range_frame(runner, df):
             np.sort(got[got.g == g].rs.values.astype(np.int64)),
             np.sort(e[e.g == g].rs.values),
         )
+
+
+# -- bounded ROWS frames (ROWS BETWEEN n PRECEDING AND m FOLLOWING) ----------
+# oracle: sqlite3 window frames (>= 3.25)
+
+
+@pytest.fixture(scope="module")
+def sqlite_db(df):
+    import sqlite3
+
+    db = sqlite3.connect(":memory:")
+    df.to_sql("t", db, index=False)
+    return db
+
+
+def _compare_sql(runner, db, sql, sort_cols):
+    got = runner.run(sql).sort_values(sort_cols, ignore_index=True)
+    exp = pd.read_sql_query(sql, db).sort_values(sort_cols,
+                                                 ignore_index=True)
+    assert list(got.columns) == list(exp.columns)
+    for c in got.columns:
+        if exp[c].dtype == object and not pd.api.types.is_numeric_dtype(
+                pd.to_numeric(exp[c], errors="coerce").dropna()):
+            assert got[c].tolist() == exp[c].tolist(), c
+            continue
+        try:
+            g = got[c].astype(float).fillna(np.nan)
+            e = exp[c].astype(float).fillna(np.nan)
+        except (TypeError, ValueError):
+            assert got[c].tolist() == exp[c].tolist(), c
+            continue
+        np.testing.assert_allclose(g, e, rtol=1e-9, err_msg=c)
+
+
+def test_rows_frame_preceding_following(runner, sqlite_db):
+    _compare_sql(
+        runner, sqlite_db,
+        "select k, v,"
+        " sum(v) over (order by k, v rows between 3 preceding"
+        "              and 2 following) s,"
+        " count(*) over (order by k, v rows between 3 preceding"
+        "                and 2 following) c"
+        " from t", ["k", "v", "s"])
+
+
+def test_rows_frame_partitioned_minmax(runner, sqlite_db):
+    _compare_sql(
+        runner, sqlite_db,
+        "select g, k, v,"
+        " min(v) over (partition by g order by k, v rows between 5 preceding"
+        "              and current row) mn,"
+        " max(v) over (partition by g order by k, v rows between current row"
+        "              and 4 following) mx"
+        " from t", ["g", "k", "v"])
+
+
+def test_rows_frame_avg_and_unbounded_following(runner, sqlite_db):
+    _compare_sql(
+        runner, sqlite_db,
+        "select g, k, x,"
+        " avg(x) over (partition by g order by k, x rows between 2 preceding"
+        "              and 2 following) a,"
+        " sum(x) over (partition by g order by k, x rows between current row"
+        "              and unbounded following) sf"
+        " from t", ["g", "k", "x"])
+
+
+def test_rows_frame_shorthand_and_values(runner, sqlite_db):
+    _compare_sql(
+        runner, sqlite_db,
+        "select g, k, v,"
+        " sum(v) over (partition by g order by k, v rows 4 preceding) s4,"
+        " first_value(v) over (partition by g order by k, v"
+        "   rows between 3 preceding and 1 following) fv,"
+        " last_value(v) over (partition by g order by k, v"
+        "   rows between 3 preceding and 1 following) lv"
+        " from t", ["g", "k", "v"])
+
+
+def test_rows_frame_empty_frame_is_null(runner, df):
+    # frame entirely after the partition end → NULL sum, count 0
+    got = runner.run(
+        "select g, k,"
+        " sum(v) over (partition by g order by k, v rows between"
+        "              10000 following and 10001 following) s,"
+        " count(v) over (partition by g order by k, v rows between"
+        "                10000 following and 10001 following) c"
+        " from t")
+    assert got.s.isna().all()
+    assert (got.c == 0).all()
