@@ -16,6 +16,7 @@ Catalog specs (repeatable --catalog):
     tpch:sf=<N>           deterministic TPC-H generator connector
     tpcds:sf=<N>          deterministic TPC-DS generator connector
     parquet:dir=<path>    directory of <table>.parquet files
+    orc:dir=<path>        directory of <table>.orc files
     memory:               empty in-memory connector
 Optionally prefix with a name: `--catalog warehouse=parquet:dir=/data`.
 """
@@ -55,6 +56,10 @@ def build_catalog(specs):
             from presto_tpu.catalog.parquet import ParquetConnector
 
             conn = ParquetConnector(args["dir"])
+        elif kind == "orc":
+            from presto_tpu.catalog.orc import OrcConnector
+
+            conn = OrcConnector(args["dir"])
         elif kind == "memory":
             from presto_tpu.catalog.memory import MemoryConnector
 
@@ -86,6 +91,12 @@ def main(argv=None):
     p.add_argument("--platform", default=None,
                    help="jax platform override (e.g. cpu, tpu) — the site "
                         "config may ignore the JAX_PLATFORMS env var")
+    p.add_argument("--password-file", default=None,
+                   help="(coordinator) enable BASIC auth from this file "
+                        "(lines: user:salt:sha256(salt||password))")
+    p.add_argument("--session-properties", default=None,
+                   help="(coordinator) JSON rules file of session property "
+                        "defaults matched by user/source regex")
     args = p.parse_args(argv)
 
     if args.platform:
@@ -99,6 +110,15 @@ def main(argv=None):
         from presto_tpu.exec.runtime import ExecConfig
         from presto_tpu.server.coordinator import Coordinator
 
+        authenticator = spm = None
+        if args.password_file:
+            from presto_tpu.server.security import PasswordAuthenticator
+
+            authenticator = PasswordAuthenticator(args.password_file)
+        if args.session_properties:
+            from presto_tpu.server.security import SessionPropertyManager
+
+            spm = SessionPropertyManager(args.session_properties)
         coord = Coordinator(
             catalog, port=args.port,
             config=ExecConfig(batch_rows=args.batch_rows,
@@ -106,6 +126,8 @@ def main(argv=None):
                               spill_dir=args.spill_dir),
             min_workers=args.min_workers,
             cluster_secret=args.secret,
+            authenticator=authenticator,
+            session_property_manager=spm,
         )
         print(f"coordinator listening on {coord.url}", flush=True)
         stop = []

@@ -297,7 +297,8 @@ class Coordinator:
     def __init__(self, catalog: Catalog, port: int = 0,
                  config: Optional[ExecConfig] = None, min_workers: int = 1,
                  broadcast_threshold_rows: float = 1_000_000,
-                 cluster_secret: Optional[str] = None):
+                 cluster_secret: Optional[str] = None,
+                 authenticator=None, session_property_manager=None):
         from presto_tpu.server.protocol import StatementProtocol
         from presto_tpu.server.querymanager import (
             QueryManager,
@@ -330,6 +331,8 @@ class Coordinator:
         self.protocol = StatementProtocol(
             self.query_manager, catalog, self.url,
             explain_fn=self._explain,
+            authenticator=authenticator,
+            session_property_manager=session_property_manager,
         )
         threading.Thread(target=self._http.serve_forever, daemon=True,
                          name="coordinator-http").start()
@@ -371,13 +374,32 @@ class Coordinator:
                 self.end_headers()
                 self.wfile.write(data)
 
+            def _text(self, body: str, content_type: str, code=200):
+                data = body.encode()
+                self.send_response(code)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
             def do_POST(self):
                 if self.path == "/v1/statement":
+                    from presto_tpu.server.security import AuthenticationError
+
                     n = int(self.headers.get("Content-Length", 0))
                     sql = self.rfile.read(n).decode()
                     try:
                         out, extra = coord.protocol.create(sql, self.headers)
                         return self._json(out, extra_headers=extra)
+                    except AuthenticationError as e:
+                        return self._json(
+                            {"error": {"message": str(e),
+                                       "errorName": "AUTHENTICATION_FAILED",
+                                       "errorType": "USER_ERROR"}},
+                            code=401,
+                            extra_headers={
+                                "WWW-Authenticate": 'Basic realm="presto-tpu"'
+                            })
                     except Exception as e:
                         return self._json(
                             {"error": {"message": str(e),
@@ -440,6 +462,15 @@ class Coordinator:
                         "queuedQueries": sum(1 for q in qs if q.state == "QUEUED"),
                         "totalQueries": len(qs),
                     })
+                if self.path == "/v1/metrics":
+                    from presto_tpu.server.metrics import coordinator_metrics
+
+                    return self._text(coordinator_metrics(coord),
+                                      "text/plain; version=0.0.4")
+                if self.path in ("/", "/ui", "/ui/"):
+                    from presto_tpu.server.metrics import render_ui
+
+                    return self._text(render_ui(coord), "text/html")
                 self._json({"error": "not found"}, 404)
 
             def do_DELETE(self):

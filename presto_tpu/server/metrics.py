@@ -1,0 +1,122 @@
+"""Prometheus-style metrics rendering — the JMX-export analog.
+
+Reference: the reference exposes engine internals over JMX MBeans
+(presto-jmx connector + airlift jmx http endpoints); the cloud-native
+equivalent is a /v1/metrics text exposition that scrapers ingest
+directly. Metrics are derived on demand from the same status structures
+the REST introspection serves — no separate collection machinery.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+
+def _fmt(name: str, value, labels: Dict[str, str] | None = None) -> str:
+    if labels:
+        lab = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+        return f"{name}{{{lab}}} {value}"
+    return f"{name} {value}"
+
+
+def render_metrics(rows: List[Tuple[str, str, object, Dict[str, str]]]) -> str:
+    """rows: (metric_name, help_text, value, labels). Renders one
+    exposition document with # HELP/# TYPE headers per metric family."""
+    seen = set()
+    out = []
+    for name, help_text, value, labels in rows:
+        if name not in seen:
+            seen.add(name)
+            out.append(f"# HELP {name} {help_text}")
+            out.append(f"# TYPE {name} gauge")
+        out.append(_fmt(name, value, labels))
+    return "\n".join(out) + "\n"
+
+
+def worker_metrics(worker) -> str:
+    st = worker.status()
+    mem = st.get("memory") or {}
+    lbl = {"node": st["nodeId"]}
+    rows = [
+        ("presto_tpu_worker_tasks", "registered tasks", st["tasks"], lbl),
+        ("presto_tpu_worker_running_tasks", "running tasks",
+         st["runningTasks"], lbl),
+        ("presto_tpu_worker_memory_reserved_bytes", "pool reservation",
+         mem.get("reserved", 0), lbl),
+        ("presto_tpu_worker_memory_limit_bytes", "pool limit",
+         mem.get("limit") or 0, lbl),
+        ("presto_tpu_worker_spilled_bytes_total", "bytes spilled to disk",
+         st["spilledBytes"], lbl),
+        ("presto_tpu_worker_spill_count_total", "spill events",
+         st["spillCount"], lbl),
+    ]
+    return render_metrics(rows)
+
+
+def coordinator_metrics(coordinator) -> str:
+    qm = coordinator.query_manager
+    states: Dict[str, int] = {}
+    for q in qm.queries():
+        states[q.state] = states.get(q.state, 0) + 1
+    rows = [
+        ("presto_tpu_cluster_active_workers", "workers in rotation",
+         len(coordinator.node_manager.active_nodes()), None),
+        ("presto_tpu_cluster_total_workers", "workers known to discovery",
+         len(coordinator.node_manager.nodes), None),
+    ]
+    for state, count in sorted(states.items()):
+        rows.append(("presto_tpu_queries", "queries by state", count,
+                     {"state": state}))
+    rows.append(("presto_tpu_plan_cache_entries", "cached distributed plans",
+                 len(coordinator._dplan_cache), None))
+    return render_metrics(rows)
+
+
+_UI_PAGE = """<!DOCTYPE html>
+<html><head><title>presto-tpu</title><meta http-equiv="refresh" content="5">
+<style>
+ body {{ font-family: monospace; margin: 2em; background: #111; color: #ddd; }}
+ h1 {{ color: #7ec8e3; }} h2 {{ color: #9a9; margin-top: 1.5em; }}
+ table {{ border-collapse: collapse; width: 100%; }}
+ th, td {{ text-align: left; padding: 4px 10px; border-bottom: 1px solid #333; }}
+ th {{ color: #888; }}
+ .RUNNING {{ color: #7ec8e3; }} .FINISHED {{ color: #8c8; }}
+ .FAILED {{ color: #e88; }} .QUEUED {{ color: #cc8; }}
+</style></head><body>
+<h1>presto-tpu coordinator</h1>
+<h2>cluster</h2><table>
+<tr><th>node</th><th>uri</th><th>state</th><th>failure score</th></tr>
+{nodes}
+</table>
+<h2>queries</h2><table>
+<tr><th>query id</th><th>state</th><th>elapsed (s)</th><th>sql</th></tr>
+{queries}
+</table>
+</body></html>
+"""
+
+
+def render_ui(coordinator) -> str:
+    """Minimal live cluster/query page (the web-UI analog of
+    presto-main's /ui query list) served at the coordinator root."""
+    import html
+    import time
+
+    nodes = []
+    for n in coordinator.node_manager.nodes.values():
+        nodes.append(
+            f"<tr><td>{html.escape(n.node_id)}</td>"
+            f"<td>{html.escape(n.uri)}</td><td>{n.state}</td>"
+            f"<td>{n.failure_score:.2f}</td></tr>")
+    queries = []
+    for q in sorted(coordinator.query_manager.queries(),
+                    key=lambda q: q.create_time, reverse=True)[:50]:
+        elapsed = (q.end_time or time.time()) - q.create_time
+        queries.append(
+            f'<tr><td>{html.escape(q.query_id)}</td>'
+            f'<td class="{q.state}">{q.state}</td>'
+            f"<td>{elapsed:.2f}</td>"
+            f"<td>{html.escape((q.sql or '')[:160])}</td></tr>")
+    return _UI_PAGE.format(nodes="\n".join(nodes) or "<tr><td>none</td></tr>",
+                           queries="\n".join(queries)
+                           or "<tr><td>none</td></tr>")

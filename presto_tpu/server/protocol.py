@@ -78,17 +78,32 @@ class StatementProtocol:
     """Stateless request handlers; mounted on the coordinator HTTP server."""
 
     def __init__(self, query_manager: QueryManager, catalog, base_url: str,
-                 page_rows: int = 1000, explain_fn=None):
+                 page_rows: int = 1000, explain_fn=None,
+                 authenticator=None, session_property_manager=None):
         self.qm = query_manager
         self.catalog = catalog
         self.base_url = base_url
         self.page_rows = page_rows
         self.explain_fn = explain_fn  # sql -> plan text
+        # client security (server/security.py): optional BASIC password
+        # authentication + rule-matched session property defaults
+        self.authenticator = authenticator
+        self.session_property_manager = session_property_manager
 
     # -- session from headers ---------------------------------------------
 
     def session_from_headers(self, headers) -> Session:
+        user = headers.get("X-Presto-User") or "user"
+        if self.authenticator is not None:
+            # the authenticated principal is authoritative for the user
+            user = self.authenticator.authenticate(
+                headers.get("Authorization"))
+        source = headers.get("X-Presto-Source") or ""
         props: Dict[str, Any] = {}
+        if self.session_property_manager is not None:
+            for k, v in self.session_property_manager.defaults_for(
+                    user, source).items():
+                props[k] = SYSTEM_PROPERTIES.decode(k, str(v))
         raw = headers.get("X-Presto-Session") or headers.get("X-Trino-Session")
         if raw:
             from urllib.parse import unquote
@@ -100,8 +115,8 @@ class StatementProtocol:
                         k.strip(), unquote(v.strip())
                     )
         return Session(
-            user=headers.get("X-Presto-User") or "user",
-            source=headers.get("X-Presto-Source") or "",
+            user=user,
+            source=source,
             catalog=headers.get("X-Presto-Catalog"),
             schema=headers.get("X-Presto-Schema"),
             properties=props,

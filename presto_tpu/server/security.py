@@ -1,0 +1,103 @@
+"""Client security: password authentication + rule-based session defaults.
+
+Reference modules: presto-password-authenticators (file/LDAP password
+login via the PasswordAuthenticator SPI) and presto-session-property-
+managers (FileSessionPropertyManager: JSON rules matching user/source
+regexes to session property defaults). Both are file-configured here:
+
+- password file: one `user:salt:sha256(salt || password)` line per user
+  (create entries with PasswordAuthenticator.hash_entry)
+- session property rules: JSON list of
+  {"user": regex?, "source": regex?, "sessionProperties": {...}} —
+  ALL matching rules apply in order, later rules override earlier ones,
+  and explicit client-provided properties always win.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import json
+import re
+import secrets
+from typing import Dict, List, Optional
+
+
+class AuthenticationError(Exception):
+    pass
+
+
+class PasswordAuthenticator:
+    """File-based BASIC authentication (file format above; the analog of
+    file-based PasswordAuthenticatorFactory)."""
+
+    def __init__(self, path: Optional[str] = None, entries: Optional[dict] = None):
+        self.users: Dict[str, tuple] = {}
+        if entries:
+            for user, (salt, digest) in entries.items():
+                self.users[user] = (salt, digest)
+        if path:
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line or line.startswith("#"):
+                        continue
+                    user, salt, digest = line.split(":", 2)
+                    self.users[user] = (salt, digest)
+
+    @staticmethod
+    def hash_entry(user: str, password: str) -> str:
+        """One password-file line for `user`."""
+        salt = secrets.token_hex(8)
+        digest = hashlib.sha256((salt + password).encode()).hexdigest()
+        return f"{user}:{salt}:{digest}"
+
+    def check(self, user: str, password: str) -> bool:
+        rec = self.users.get(user)
+        if rec is None:
+            return False
+        salt, digest = rec
+        cand = hashlib.sha256((salt + password).encode()).hexdigest()
+        return hmac.compare_digest(cand, digest)
+
+    def authenticate(self, authorization: Optional[str]) -> str:
+        """Authorization header → authenticated user (raises on failure)."""
+        if not authorization or not authorization.startswith("Basic "):
+            raise AuthenticationError("Basic authentication required")
+        try:
+            raw = base64.b64decode(authorization[6:]).decode()
+            user, _, password = raw.partition(":")
+        except Exception:
+            raise AuthenticationError("malformed Authorization header")
+        if not self.check(user, password):
+            raise AuthenticationError("invalid credentials")
+        return user
+
+
+class SessionPropertyManager:
+    """Rule-matched session property defaults
+    (FileSessionPropertyManager analog)."""
+
+    def __init__(self, path: Optional[str] = None,
+                 rules: Optional[List[dict]] = None):
+        if path:
+            with open(path) as f:
+                rules = json.load(f)
+        self.rules = []
+        for r in rules or []:
+            self.rules.append({
+                "user": re.compile(r["user"]) if r.get("user") else None,
+                "source": re.compile(r["source"]) if r.get("source") else None,
+                "props": dict(r.get("sessionProperties") or {}),
+            })
+
+    def defaults_for(self, user: str, source: str) -> Dict[str, str]:
+        out: Dict[str, str] = {}
+        for r in self.rules:
+            if r["user"] is not None and not r["user"].fullmatch(user or ""):
+                continue
+            if r["source"] is not None and not r["source"].fullmatch(source or ""):
+                continue
+            out.update(r["props"])
+        return out

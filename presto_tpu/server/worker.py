@@ -338,6 +338,17 @@ class Worker:
                     })
                 if self.path == "/v1/status":
                     return self._json(worker.status())
+                if self.path == "/v1/metrics":
+                    from presto_tpu.server.metrics import worker_metrics
+
+                    body = worker_metrics(worker).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "text/plain; version=0.0.4")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
                 self._json({"error": "not found"}, 404)
 
             def do_DELETE(self):

@@ -1,0 +1,163 @@
+"""Password authentication, session property defaults, metrics export,
+and the coordinator UI page.
+
+Reference modules: presto-password-authenticators,
+presto-session-property-managers (FileSessionPropertyManager), JMX
+metrics export, presto-main web UI."""
+
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from presto_tpu.catalog.memory import MemoryConnector
+from presto_tpu.connector import Catalog
+from presto_tpu.exec import ExecConfig
+from presto_tpu.server.security import (
+    AuthenticationError,
+    PasswordAuthenticator,
+    SessionPropertyManager,
+)
+
+
+def _catalog():
+    conn = MemoryConnector()
+    conn.add_table("t", pd.DataFrame({"k": np.arange(10) % 3,
+                                      "v": np.arange(10.0)}))
+    cat = Catalog()
+    cat.register("m", conn, default=True)
+    return cat
+
+
+class TestPasswordAuthenticator:
+    def test_hash_and_check(self):
+        line = PasswordAuthenticator.hash_entry("alice", "s3cret")
+        user, salt, digest = line.split(":")
+        auth = PasswordAuthenticator(entries={user: (salt, digest)})
+        assert auth.check("alice", "s3cret")
+        assert not auth.check("alice", "wrong")
+        assert not auth.check("bob", "s3cret")
+
+    def test_authenticate_header(self):
+        import base64
+
+        line = PasswordAuthenticator.hash_entry("alice", "pw")
+        u, s, d = line.split(":")
+        auth = PasswordAuthenticator(entries={u: (s, d)})
+        hdr = "Basic " + base64.b64encode(b"alice:pw").decode()
+        assert auth.authenticate(hdr) == "alice"
+        with pytest.raises(AuthenticationError):
+            auth.authenticate(None)
+        with pytest.raises(AuthenticationError):
+            auth.authenticate("Basic " + base64.b64encode(b"alice:no").decode())
+
+    def test_file_roundtrip(self, tmp_path):
+        p = tmp_path / "pw"
+        p.write_text(PasswordAuthenticator.hash_entry("u1", "a") + "\n"
+                     + "# comment\n"
+                     + PasswordAuthenticator.hash_entry("u2", "b") + "\n")
+        auth = PasswordAuthenticator(str(p))
+        assert auth.check("u1", "a") and auth.check("u2", "b")
+
+
+class TestSessionPropertyManager:
+    def test_rules_merge_in_order(self):
+        spm = SessionPropertyManager(rules=[
+            {"user": ".*", "sessionProperties": {"batch_rows": "1024"}},
+            {"user": "etl_.*", "sessionProperties": {"batch_rows": "65536",
+                                                     "spill_enabled": "false"}},
+            {"source": "dashboard",
+             "sessionProperties": {"query_max_run_time": "30"}},
+        ])
+        assert spm.defaults_for("alice", "") == {"batch_rows": "1024"}
+        got = spm.defaults_for("etl_nightly", "")
+        assert got["batch_rows"] == "65536"
+        assert got["spill_enabled"] == "false"
+        assert "query_max_run_time" in spm.defaults_for("bob", "dashboard")
+
+    def test_end_to_end_defaults_apply(self):
+        """SPM defaults reach the session; explicit headers override."""
+        from presto_tpu.server.protocol import StatementProtocol
+
+        spm = SessionPropertyManager(rules=[
+            {"user": "etl", "sessionProperties": {"batch_rows": "4096"}},
+        ])
+        proto = StatementProtocol(None, None, "http://x",
+                                  session_property_manager=spm)
+        s = proto.session_from_headers({"X-Presto-User": "etl"})
+        assert s.properties["batch_rows"] == 4096
+        s2 = proto.session_from_headers(
+            {"X-Presto-User": "etl", "X-Presto-Session": "batch_rows=8192"})
+        assert s2.properties["batch_rows"] == 8192
+
+
+@pytest.fixture()
+def cluster():
+    import secrets
+
+    from presto_tpu.server.coordinator import Coordinator
+    from presto_tpu.server.worker import Worker
+
+    line = PasswordAuthenticator.hash_entry("alice", "pw")
+    u, s, d = line.split(":")
+    auth = PasswordAuthenticator(entries={u: (s, d)})
+    secret = secrets.token_hex(8)
+    coord = Coordinator(_catalog(), min_workers=1, cluster_secret=secret,
+                        authenticator=auth)
+    w = Worker(coord.catalog, node_id="w0", coordinator_url=coord.url,
+               cluster_secret=secret)
+    try:
+        import time
+
+        deadline = time.time() + 10
+        while time.time() < deadline and not coord.node_manager.active_nodes():
+            time.sleep(0.05)
+        yield coord, w
+    finally:
+        w.close()
+        coord.close()
+
+
+class TestHttpSurface:
+    def test_statement_requires_auth(self, cluster):
+        coord, _ = cluster
+        req = urllib.request.Request(f"{coord.url}/v1/statement",
+                                     data=b"select 1 as x", method="POST")
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=10)
+        assert ei.value.code == 401
+        assert "Basic" in ei.value.headers.get("WWW-Authenticate", "")
+
+    def test_statement_with_auth(self, cluster):
+        import base64
+
+        coord, _ = cluster
+        hdr = "Basic " + base64.b64encode(b"alice:pw").decode()
+        req = urllib.request.Request(
+            f"{coord.url}/v1/statement", data=b"select 1 as x",
+            method="POST", headers={"Authorization": hdr})
+        with urllib.request.urlopen(req, timeout=30) as r:
+            out = json.loads(r.read())
+        assert "error" not in out or not out["error"]
+
+    def test_metrics_endpoints(self, cluster):
+        coord, w = cluster
+        with urllib.request.urlopen(f"{coord.url}/v1/metrics",
+                                    timeout=10) as r:
+            body = r.read().decode()
+        assert "presto_tpu_cluster_active_workers 1" in body
+        assert "# TYPE presto_tpu_cluster_active_workers gauge" in body
+        with urllib.request.urlopen(f"{w.url}/v1/metrics", timeout=10) as r:
+            wbody = r.read().decode()
+        assert 'presto_tpu_worker_tasks{node="w0"}' in wbody
+        assert "presto_tpu_worker_memory_reserved_bytes" in wbody
+
+    def test_ui_page(self, cluster):
+        coord, _ = cluster
+        with urllib.request.urlopen(f"{coord.url}/", timeout=10) as r:
+            html = r.read().decode()
+        assert "presto-tpu coordinator" in html
+        assert "w0" in html
