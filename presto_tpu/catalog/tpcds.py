@@ -200,6 +200,264 @@ class TpcdsGenerator:
             "p_channel_tv": np.array([["N", "Y"][i % 7 == 0] for i in range(n)], object),
         }
 
+    # -- remaining dimensions (spec table 3-2 fixed/scaled sizes) ---------
+
+    def time_dim(self) -> Dict[str, np.ndarray]:
+        n = 86_400  # fixed: one row per second of day
+        sec = np.arange(n, dtype=np.int64)
+        return {
+            "t_time_sk": sec,
+            "t_time": sec,
+            "t_hour": sec // 3600,
+            "t_minute": sec % 3600 // 60,
+            "t_second": sec % 60,
+            "t_am_pm": np.array([["AM", "PM"][s >= 43200] for s in
+                                 range(0, n, 1)], object),
+            "t_shift": np.array(
+                [["third", "first", "second"][min(s // 28800, 2)]
+                 for s in range(0, n, 1)], object),
+        }
+
+    @property
+    def n_warehouse(self) -> int:
+        return max(1, int(round(5 * max(self.sf, 1) ** 0.5)))
+
+    def warehouse(self) -> Dict[str, np.ndarray]:
+        n = self.n_warehouse
+        rng = self._rng(10)
+        return {
+            "w_warehouse_sk": np.arange(1, n + 1),
+            "w_warehouse_id": np.array(
+                [f"AAAAAAAA{str(i).zfill(8)}" for i in range(1, n + 1)], object),
+            "w_warehouse_name": np.array(
+                [f"warehouse#{i}" for i in range(n)], object),
+            "w_warehouse_sq_ft": rng.integers(50_000, 1_000_001, n),
+            "w_state": np.array([["TN", "CA", "TX", "NY", "OH"][i % 5]
+                                 for i in range(n)], object),
+        }
+
+    def ship_mode(self) -> Dict[str, np.ndarray]:
+        n = 20  # fixed
+        types = ["EXPRESS", "NEXT DAY", "OVERNIGHT", "REGULAR", "LIBRARY"]
+        carriers = ["UPS", "FEDEX", "AIRBORNE", "USPS", "DHL",
+                    "TBS", "ZHOU", "LATVIAN", "MSC", "ALLIANCE"]
+        return {
+            "sm_ship_mode_sk": np.arange(1, n + 1),
+            "sm_ship_mode_id": np.array(
+                [f"AAAAAAAA{str(i).zfill(8)}" for i in range(1, n + 1)], object),
+            "sm_type": np.array([types[i % 5] for i in range(n)], object),
+            "sm_carrier": np.array([carriers[i % 10] for i in range(n)],
+                                   object),
+        }
+
+    def reason(self) -> Dict[str, np.ndarray]:
+        n = max(1, int(round(35 * max(self.sf, 1) ** 0.2)))
+        descs = ["Package was damaged", "Stopped working",
+                 "Did not get it on time", "Not the product that was ordered",
+                 "Parts missing", "Does not work with a product that I have",
+                 "Gift exchange", "Did not like the color",
+                 "Did not like the model", "Did not like the make",
+                 "Did not fit", "Wrong size", "Lost my job",
+                 "Found a better price in a store", "Not working any more",
+                 "unknown"]
+        return {
+            "r_reason_sk": np.arange(1, n + 1),
+            "r_reason_id": np.array(
+                [f"AAAAAAAA{str(i).zfill(8)}" for i in range(1, n + 1)], object),
+            "r_reason_desc": np.array([descs[i % len(descs)]
+                                       for i in range(n)], object),
+        }
+
+    def call_center(self) -> Dict[str, np.ndarray]:
+        n = max(1, int(round(6 * max(self.sf, 1) ** 0.3)))
+        rng = self._rng(11)
+        return {
+            "cc_call_center_sk": np.arange(1, n + 1),
+            "cc_call_center_id": np.array(
+                [f"AAAAAAAA{str(i).zfill(8)}" for i in range(1, n + 1)], object),
+            "cc_name": np.array([f"call center {i}" for i in range(n)], object),
+            "cc_class": np.array([["small", "medium", "large"][i % 3]
+                                  for i in range(n)], object),
+            "cc_employees": rng.integers(1, 7_000_000, n),
+            "cc_manager": np.array([f"manager{i % 40}" for i in range(n)],
+                                   object),
+        }
+
+    def catalog_page(self) -> Dict[str, np.ndarray]:
+        n = max(1, int(round(11_718 * max(self.sf, 1) ** 0.3)))
+        rng = self._rng(12)
+        return {
+            "cp_catalog_page_sk": np.arange(1, n + 1),
+            "cp_catalog_page_id": np.array(
+                [f"AAAAAAAA{str(i).zfill(8)}" for i in range(1, n + 1)], object),
+            "cp_catalog_number": (np.arange(n) // 108 + 1).astype(np.int64),
+            "cp_catalog_page_number": (np.arange(n) % 108 + 1).astype(np.int64),
+            "cp_start_date_sk": _D_DATE_SK0 + rng.integers(35_000, 36_000, n),
+            "cp_type": np.array([["bi-annual", "quarterly", "monthly"][i % 3]
+                                 for i in range(n)], object),
+        }
+
+    def web_site(self) -> Dict[str, np.ndarray]:
+        n = max(1, int(round(30 * max(self.sf, 1) ** 0.25)))
+        return {
+            "web_site_sk": np.arange(1, n + 1),
+            "web_site_id": np.array(
+                [f"AAAAAAAA{str(i).zfill(8)}" for i in range(1, n + 1)], object),
+            "web_name": np.array([f"site_{i % 15}" for i in range(n)], object),
+            "web_class": np.array(["Unknown"] * n, object),
+            "web_manager": np.array([f"manager{i % 20}" for i in range(n)],
+                                    object),
+        }
+
+    def web_page(self) -> Dict[str, np.ndarray]:
+        n = max(1, int(round(60 * max(self.sf, 1) ** 0.5)))
+        rng = self._rng(13)
+        return {
+            "wp_web_page_sk": np.arange(1, n + 1),
+            "wp_web_page_id": np.array(
+                [f"AAAAAAAA{str(i).zfill(8)}" for i in range(1, n + 1)], object),
+            "wp_creation_date_sk": _D_DATE_SK0 + rng.integers(35_000, 36_500, n),
+            "wp_url": np.array(["http://www.foo.com"] * n, object),
+            "wp_type": np.array(
+                [["ad", "dynamic", "feedback", "general", "order",
+                  "protected", "welcome"][i % 7] for i in range(n)], object),
+            "wp_char_count": rng.integers(100, 8_000, n),
+        }
+
+    def inventory(self) -> Dict[str, np.ndarray]:
+        """Weekly stock per (warehouse, item). Below SF1 items are sampled
+        (deviation from the spec's full cross product — keeps small test
+        scale factors tractable; at SF>=1 every item is covered)."""
+        n_item = self.n_item if self.sf >= 1 else max(
+            1, int(self.n_item * self.sf))
+        weeks = 261  # spec: weekly snapshots over the 5-year window
+        nw = self.n_warehouse
+        rng = self._rng(14)
+        item = np.tile(np.repeat(np.arange(1, n_item + 1), nw), weeks)
+        wh = np.tile(np.arange(1, nw + 1), n_item * weeks)
+        date = np.repeat(
+            _D_DATE_SK0 + 35_795 + np.arange(weeks, dtype=np.int64) * 7,
+            n_item * nw)
+        n = item.shape[0]
+        return {
+            "inv_date_sk": date,
+            "inv_item_sk": item.astype(np.int64),
+            "inv_warehouse_sk": wh.astype(np.int64),
+            "inv_quantity_on_hand": rng.integers(0, 1_000, n),
+        }
+
+    # -- catalog / web sales channels -------------------------------------
+
+    def _channel_sales(self, prefix: str, n: int, salt: int,
+                       extra_fk: Dict[str, int]):
+        """Shared generator for catalog_sales / web_sales (the channels
+        differ in prefix and channel-specific FK columns)."""
+        rng = self._rng(salt)
+        d_lo = _D_DATE_SK0 + 35_795
+        d_hi = _D_DATE_SK0 + 37_621
+        qty = rng.integers(1, 101, n, dtype=np.int64)
+        wholesale = _money(rng, 1.0, 100.0, n)
+        list_price = wholesale + _money(rng, 0.0, 100.0, n)
+        discount = rng.integers(0, 100, n, dtype=np.int64)
+        sales_price = list_price * (100 - discount) // 100
+        ext_sales = sales_price * qty
+        ship_cost = _money(rng, 0.0, 10.0, n) * qty
+        sold_date = rng.integers(d_lo, d_hi + 1, n)
+        out = {
+            f"{prefix}_sold_date_sk": sold_date,
+            f"{prefix}_sold_time_sk": rng.integers(0, 86_400, n),
+            f"{prefix}_ship_date_sk": np.minimum(
+                sold_date + rng.integers(2, 121, n), d_hi),
+            f"{prefix}_item_sk": rng.integers(1, self.n_item + 1, n),
+            f"{prefix}_order_number": np.arange(1, n + 1),
+            f"{prefix}_quantity": qty,
+            f"{prefix}_wholesale_cost": ("raw72", wholesale),
+            f"{prefix}_list_price": ("raw72", list_price),
+            f"{prefix}_sales_price": ("raw72", sales_price),
+            f"{prefix}_ext_sales_price": ("raw72", ext_sales),
+            f"{prefix}_ext_ship_cost": ("raw72", ship_cost),
+            f"{prefix}_net_paid": ("raw72", ext_sales),
+            f"{prefix}_net_profit": ("raw72",
+                                     ext_sales - wholesale * qty),
+        }
+        for col, domain in extra_fk.items():
+            out[col] = rng.integers(1, domain + 1, n)
+        return out
+
+    def catalog_sales(self) -> Dict[str, np.ndarray]:
+        n = int(1_441_548 * self.sf)
+        return self._channel_sales("cs", max(n, 1), 15, {
+            "cs_bill_customer_sk": self.n_customer,
+            "cs_ship_customer_sk": self.n_customer,
+            "cs_call_center_sk": max(1, int(round(6 * max(self.sf, 1) ** 0.3))),
+            "cs_catalog_page_sk": max(1, int(round(11_718 * max(self.sf, 1) ** 0.3))),
+            "cs_ship_mode_sk": 20,
+            "cs_warehouse_sk": self.n_warehouse,
+            "cs_promo_sk": self.n_promo,
+        })
+
+    def catalog_returns(self) -> Dict[str, np.ndarray]:
+        sales = self._ensure_channel("cs")
+        return self._channel_returns("cs", "cr", sales, 16, {
+            "cr_reason_sk": max(1, int(round(35 * max(self.sf, 1) ** 0.2))),
+        })
+
+    def web_sales(self) -> Dict[str, np.ndarray]:
+        n = int(719_384 * self.sf)
+        return self._channel_sales("ws", max(n, 1), 17, {
+            "ws_bill_customer_sk": self.n_customer,
+            "ws_ship_customer_sk": self.n_customer,
+            "ws_web_site_sk": max(1, int(round(30 * max(self.sf, 1) ** 0.25))),
+            "ws_web_page_sk": max(1, int(round(60 * max(self.sf, 1) ** 0.5))),
+            "ws_ship_mode_sk": 20,
+            "ws_warehouse_sk": self.n_warehouse,
+            "ws_promo_sk": self.n_promo,
+        })
+
+    def web_returns(self) -> Dict[str, np.ndarray]:
+        sales = self._ensure_channel("ws")
+        return self._channel_returns("ws", "wr", sales, 18, {
+            "wr_reason_sk": max(1, int(round(35 * max(self.sf, 1) ** 0.2))),
+        })
+
+    _channel_cache: Dict[str, Dict[str, np.ndarray]] = None  # type: ignore
+
+    def _ensure_channel(self, prefix: str) -> Dict[str, np.ndarray]:
+        if self._channel_cache is None:
+            self._channel_cache = {}
+        if prefix not in self._channel_cache:
+            self._channel_cache[prefix] = (
+                self.catalog_sales() if prefix == "cs" else self.web_sales())
+        return self._channel_cache[prefix]
+
+    def _channel_returns(self, sp: str, rp: str, sales, salt: int,
+                         extra_fk: Dict[str, int]):
+        """~10% of channel sales return; item/order join keys are subsets
+        of the sales table (exact referential integrity)."""
+        rng = self._rng(salt)
+        n = sales[f"{sp}_order_number"].shape[0]
+        n_ret = max(n // 10, 1)
+        ridx = rng.choice(n, n_ret, replace=False)
+        qty = sales[f"{sp}_quantity"][ridx]
+        ret_qty = np.minimum(rng.integers(1, 101, n_ret, dtype=np.int64), qty)
+        price = sales[f"{sp}_sales_price"][1][ridx]
+        out = {
+            f"{rp}_returned_date_sk": np.minimum(
+                sales[f"{sp}_sold_date_sk"][ridx]
+                + rng.integers(1, 91, n_ret),
+                _D_DATE_SK0 + 37_621),
+            f"{rp}_item_sk": sales[f"{sp}_item_sk"][ridx],
+            f"{rp}_order_number": sales[f"{sp}_order_number"][ridx],
+            f"{rp}_return_quantity": ret_qty,
+            f"{rp}_return_amount": ("raw72", price * ret_qty),
+            f"{rp}_net_loss": ("raw72", price * ret_qty // 2),
+        }
+        out[f"{rp}_refunded_customer_sk"] = (
+            sales[f"{sp}_bill_customer_sk"][ridx])
+        for col, domain in extra_fk.items():
+            out[col] = rng.integers(1, domain + 1, n_ret)
+        return out
+
     def store_sales_and_returns(self):
         """Full-table generation (single chunk, original RNG stream)."""
         return self.store_sales_chunk(0, self.n_store_sales, _salt=7)
@@ -282,9 +540,15 @@ class TpcdsConnector(MemoryConnector):
         self.gen = TpcdsGenerator(sf)
 
     def table_names(self) -> List[str]:
-        return ["date_dim", "store", "item", "customer", "customer_address",
-                "customer_demographics", "household_demographics",
-                "income_band", "promotion", "store_sales", "store_returns"]
+        # all 24 spec tables (3 sales channels + inventory + dimensions)
+        return ["date_dim", "time_dim", "store", "item", "customer",
+                "customer_address", "customer_demographics",
+                "household_demographics", "income_band", "promotion",
+                "warehouse", "ship_mode", "reason", "call_center",
+                "catalog_page", "web_site", "web_page",
+                "store_sales", "store_returns",
+                "catalog_sales", "catalog_returns",
+                "web_sales", "web_returns", "inventory"]
 
     def _ensure(self, name: str):
         if name in self.tables:
@@ -293,6 +557,14 @@ class TpcdsConnector(MemoryConnector):
             sales, returns = self.gen.store_sales_and_returns()
             self._add("store_sales", sales)
             self._add("store_returns", returns)
+        elif name in ("catalog_sales", "catalog_returns"):
+            self._add("catalog_sales", self.gen._ensure_channel("cs"))
+            self._add("catalog_returns", self.gen.catalog_returns())
+            self.gen._channel_cache.pop("cs", None)  # release generator copy
+        elif name in ("web_sales", "web_returns"):
+            self._add("web_sales", self.gen._ensure_channel("ws"))
+            self._add("web_returns", self.gen.web_returns())
+            self.gen._channel_cache.pop("ws", None)
         elif name in self.table_names():
             self._add(name, getattr(self.gen, name)())
         else:
