@@ -580,7 +580,8 @@ def _execute_unnest(node: Unnest, ctx: ExecContext) -> Iterator[Batch]:
 _VARIANCE_FNS = {"var_samp", "var_pop", "stddev_samp", "stddev_pop"}
 _COVAR_FNS = {"covar_pop", "covar_samp", "corr"}
 _NON_DECOMPOSABLE_FNS = {"approx_percentile", "__approx_percentile_w",
-                         "max_by", "min_by", "array_agg"}
+                         "max_by", "min_by", "array_agg",
+                         "count_distinct", "sum_distinct", "avg_distinct"}
 
 _CHECKSUM_NULL = jnp.int64(-7046029254386353131)  # fixed NULL contribution
 
@@ -716,7 +717,8 @@ def _sorted_group_agg(b: Batch, key_syms, a: AggSpec, cap: int):
     num_key_ops = len(operands)
 
     cx = b.column(a.arg)
-    if a.fn in ("approx_percentile", "__approx_percentile_w"):
+    if a.fn in ("approx_percentile", "__approx_percentile_w",
+                "count_distinct", "sum_distinct", "avg_distinct"):
         ov = cx.valid_mask()
         sortval = jnp.where(ov, cx.values, _minmax_ident(cx.values.dtype, True))
     elif a.fn == "max_by":
@@ -748,6 +750,32 @@ def _sorted_group_agg(b: Batch, key_syms, a: AggSpec, cap: int):
                                num_segments=cap + 1)[:cap]
     valid = cntv > 0
 
+    if a.fn in ("count_distinct", "sum_distinct", "avg_distinct"):
+        # DISTINCT accumulators (MarkDistinct analog): after the
+        # (keys, value) sort, the first row of each equal-value run inside
+        # a segment carries the value; everything else contributes zero
+        sv = cx.values[sperm]
+        ov_sorted2 = ov[sperm] & (sdead == 0)
+        prev_same = jnp.zeros(n, bool).at[1:].set(
+            (sv[1:] == sv[:-1]) & ~change[1:])
+        first_distinct = ov_sorted2 & ~prev_same
+        dcount = jax.ops.segment_sum(
+            first_distinct.astype(jnp.int64), seg,
+            num_segments=cap + 1)[:cap]
+        if a.fn == "count_distinct":
+            return dcount, None
+        acc_dtype = (sv.dtype if jnp.issubdtype(sv.dtype, jnp.floating)
+                     else jnp.int64)
+        contrib = jnp.where(first_distinct, sv.astype(acc_dtype),
+                            jnp.zeros((), acc_dtype))
+        dsum = jax.ops.segment_sum(contrib, seg, num_segments=cap + 1)[:cap]
+        if a.fn == "sum_distinct":
+            return dsum, dcount > 0
+        scale = (b.type_of(a.arg).scale
+                 if isinstance(b.type_of(a.arg), DecimalType) else 0)
+        avg = (dsum.astype(jnp.float64) / (10.0 ** scale)
+               / jnp.maximum(dcount, 1).astype(jnp.float64))
+        return avg, dcount > 0
     if a.fn == "__approx_percentile_w":
         # weighted-rank selection over sketch bucket rows: the value is the
         # bucket minimum whose cumulative count first reaches ceil(p·total)

@@ -1507,18 +1507,41 @@ class Planner:
             # forms fall back to the materialized exact path below
             agg_node = self._plan_qsketch(pre, group_syms, pct_aggs)
         elif distinct_aggs:
-            if len(agg_specs) != 1:
-                raise AnalysisError("mixed DISTINCT aggregates not supported yet")
-            a = agg_specs[0]
-            if a.fn != "count":
-                raise AnalysisError("only COUNT(DISTINCT) supported")
-            # two-phase: dedup on (keys, arg) then count arg per keys
-            inner = Aggregate(pre, group_syms + [a.arg], [], step="single")
-            agg_node = Aggregate(
-                inner, group_syms,
-                [AggSpec(a.symbol, "count", a.arg, a.type, False)],
-                step="single",
-            )
+            if len(agg_specs) == 1 and agg_specs[0].fn == "count":
+                # sole COUNT(DISTINCT x): two-phase dedup-then-count —
+                # both phases decomposable, so it distributes
+                a = agg_specs[0]
+                inner = Aggregate(pre, group_syms + [a.arg], [], step="single")
+                agg_node = Aggregate(
+                    inner, group_syms,
+                    [AggSpec(a.symbol, "count", a.arg, a.type, False)],
+                    step="single",
+                )
+            else:
+                # mixed forms (count/sum/avg DISTINCT alongside other
+                # aggregates): rewrite each DISTINCT spec to its sorted
+                # order-dependent form — the materialized single-task path
+                # computes decomposable and sorted aggregates in one pass
+                # (reference: MarkDistinct + masked accumulators;
+                # DistinctingGroupedAccumulator)
+                rewritten = []
+                for a in agg_specs:
+                    if not a.distinct:
+                        rewritten.append(a)
+                        continue
+                    if a.fn in ("min", "max"):  # DISTINCT is a no-op
+                        rewritten.append(AggSpec(a.symbol, a.fn, a.arg,
+                                                 a.type, False))
+                        continue
+                    if a.fn not in ("count", "sum", "avg"):
+                        raise AnalysisError(
+                            f"{a.fn}(DISTINCT) not supported (count/sum/avg"
+                            " are)")
+                    rewritten.append(AggSpec(
+                        a.symbol, f"{a.fn}_distinct", a.arg, a.type, False,
+                        arg2=a.arg2, param=a.param))
+                agg_node = Aggregate(pre, group_syms, rewritten,
+                                     step="single")
         else:
             agg_node = Aggregate(pre, group_syms, agg_specs, step="single")
         return agg_node, repl

@@ -160,3 +160,56 @@ def test_date_functions(runner, df):
     np.testing.assert_array_equal(got.dm.values, exp_dm.values)
     exp_am = (ts + pd.DateOffset(months=2) - epoch).dt.days
     np.testing.assert_array_equal(got.am.values, exp_am.values)
+
+
+class TestMixedDistinctAggregates:
+    """count/sum/avg(DISTINCT x) alongside plain aggregates (MarkDistinct
+    analog via the sorted materialized path)."""
+
+    @pytest.fixture(scope="class")
+    def env(self):
+        import sqlite3
+
+        rng = np.random.default_rng(31)
+        n = 5000
+        v = np.where(rng.random(n) < 0.1, None,
+                     rng.integers(0, 40, n).astype(object))
+        df = pd.DataFrame({
+            "g": rng.integers(0, 7, n),
+            "v": v,
+            "w": rng.normal(size=n).round(2),
+        })
+        conn = MemoryConnector()
+        conn.add_table("t", df)
+        cat = Catalog()
+        cat.register("m", conn, default=True)
+        runner = LocalRunner(cat, ExecConfig(batch_rows=1 << 10))
+        db = sqlite3.connect(":memory:")
+        df.to_sql("t", db, index=False)
+        return runner, db
+
+    def _cmp(self, env, sql):
+        runner, db = env
+        got = runner.run(sql)
+        exp = pd.read_sql_query(sql, db)
+        for c in got.columns:
+            np.testing.assert_allclose(
+                got[c].astype(float), exp[c].astype(float),
+                rtol=1e-9, err_msg=c)
+
+    def test_count_distinct_with_count(self, env):
+        self._cmp(env, "select g, count(distinct v) as d, count(*) as n "
+                       "from t group by g order by g")
+
+    def test_sum_avg_distinct(self, env):
+        self._cmp(env, "select g, sum(distinct v) as s, "
+                       "avg(distinct v) as a, sum(v) as sv "
+                       "from t group by g order by g")
+
+    def test_global_mixed_distinct(self, env):
+        self._cmp(env, "select count(distinct v) as d, sum(w) as sw, "
+                       "min(distinct v) as mn from t")
+
+    def test_two_distinct_columns(self, env):
+        self._cmp(env, "select g, count(distinct v) as dv, "
+                       "count(distinct w) as dw from t group by g order by g")
