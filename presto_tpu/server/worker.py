@@ -67,16 +67,90 @@ def _jit_partition_ids(keys: tuple, n_parts: int):
     return jax.jit(lambda b: partition_ids(b, keys, n_parts))
 
 
+class TaskExecutor:
+    """Fair batch-granularity time slicing across concurrent tasks — the
+    analog of TaskExecutor.java:78 + MultilevelSplitQueue.java:41. Each
+    task thread must hold a run slot to compute its next batch; when
+    demand exceeds `slots`, free slots go to the waiting tasks with the
+    LEAST accumulated compute time (so short interactive queries are not
+    starved behind long scans). The reference time-slices at split
+    quanta; the batch boundary is this engine's natural quantum."""
+
+    def __init__(self, slots: int = 4):
+        self.slots = max(1, slots)
+        self._running = 0
+        self._cv = threading.Condition()
+        self._acc: dict = {}       # task_id -> accumulated seconds
+        self._waiting: list = []
+
+    def register(self, task_id: str) -> "TaskLease":
+        with self._cv:
+            self._acc.setdefault(task_id, 0.0)
+        return TaskLease(self, task_id)
+
+    def unregister(self, task_id: str):
+        with self._cv:
+            self._acc.pop(task_id, None)
+
+    def accumulated(self, task_id: str) -> float:
+        with self._cv:
+            return self._acc.get(task_id, 0.0)
+
+    def _acquire(self, task_id: str):
+        with self._cv:
+            self._waiting.append(task_id)
+            while True:
+                if self._running < self.slots:
+                    free = self.slots - self._running
+                    most_deserving = sorted(
+                        self._waiting, key=lambda t: self._acc.get(t, 0.0)
+                    )[:free]
+                    if task_id in most_deserving:
+                        self._waiting.remove(task_id)
+                        self._running += 1
+                        return
+                self._cv.wait(timeout=1.0)
+
+    def _release(self, task_id: str, elapsed: float):
+        with self._cv:
+            self._running -= 1
+            self._acc[task_id] = self._acc.get(task_id, 0.0) + elapsed
+            self._cv.notify_all()
+
+
+class TaskLease:
+    """Context manager: one held section = one scheduling quantum."""
+
+    def __init__(self, executor: TaskExecutor, task_id: str):
+        self.executor = executor
+        self.task_id = task_id
+        self._t0 = 0.0
+
+    def __enter__(self):
+        self.executor._acquire(self.task_id)
+        import time
+
+        self._t0 = time.monotonic()
+        return self
+
+    def __exit__(self, *exc):
+        import time
+
+        self.executor._release(self.task_id, time.monotonic() - self._t0)
+        return False
+
+
 class TaskExecution:
     """One task: fragment + splits in, pages out (SqlTaskExecution analog)."""
 
     def __init__(self, task_id: str, update: TaskUpdate, catalog: Catalog,
-                 memory_pool=None, spill_manager=None):
+                 memory_pool=None, spill_manager=None, executor=None):
         self.task_id = task_id
         self.update = update
         self.catalog = catalog
         self.memory_pool = memory_pool
         self.spill_manager = spill_manager
+        self.executor = executor
         self.state = "running"
         self.error: Optional[str] = None
         f = update.fragment
@@ -107,8 +181,29 @@ class TaskExecution:
             ctx.remote_sources = self._remote_source_factory
             f = self.update.fragment
             sink = self._make_sink(f)
-            for batch in execute_node(f.root, ctx):
-                sink(batch)
+            stream = execute_node(f.root, ctx)
+            # fair time slicing applies to LEAF fragments only: a task
+            # with remote sources can block inside next() waiting for
+            # producer pages, and holding a run slot while blocked would
+            # deadlock the slot pool (the reference's splits yield when
+            # blocked; the exchange iterator cannot)
+            gated = (self.executor is not None
+                     and not f.remote_sources())
+            if gated:
+                lease = self.executor.register(self.task_id)
+                try:
+                    while True:
+                        with lease:
+                            try:
+                                batch = next(stream)
+                            except StopIteration:
+                                break
+                            sink(batch)
+                finally:
+                    self.executor.unregister(self.task_id)
+            else:
+                for batch in stream:
+                    sink(batch)
             self.buffer.set_no_more_pages()
             self.state = "finished"
         except Exception as e:
@@ -184,7 +279,8 @@ class TaskExecution:
 class TaskManager:
     """SqlTaskManager analog: task registry keyed by task id."""
 
-    def __init__(self, catalog: Catalog, memory_pool=None, spill_manager=None):
+    def __init__(self, catalog: Catalog, memory_pool=None, spill_manager=None,
+                 run_slots: int = 4):
         from presto_tpu.memory import MemoryPool
         from presto_tpu.spiller import SpillManager
 
@@ -192,6 +288,7 @@ class TaskManager:
         self.memory_pool = memory_pool or MemoryPool(None)
         self.spill_manager = spill_manager or SpillManager()
         self.tasks: Dict[str, TaskExecution] = {}
+        self.executor = TaskExecutor(run_slots)
         self._lock = threading.Lock()
 
     def update_task(self, task_id: str, update: TaskUpdate) -> dict:
@@ -199,7 +296,8 @@ class TaskManager:
             t = self.tasks.get(task_id)
             if t is None:
                 t = TaskExecution(task_id, update, self.catalog,
-                                  self.memory_pool, self.spill_manager)
+                                  self.memory_pool, self.spill_manager,
+                                  executor=self.executor)
                 self.tasks[task_id] = t
             return t.info()
 
