@@ -25,9 +25,15 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from presto_tpu.batch import Batch
+from presto_tpu.batch import Batch, Column
 from presto_tpu.dictionary import Dictionary
-from presto_tpu.expr.ir import Call, Constant, InputRef, RowExpression
+from presto_tpu.expr.ir import (
+    Call,
+    Constant,
+    InputRef,
+    LambdaExpr,
+    RowExpression,
+)
 from presto_tpu.expr import structural as _struct
 from presto_tpu.expr.structural import StructVal
 from presto_tpu.types import (
@@ -332,12 +338,18 @@ class CompileContext:
     string-valued expressions built purely from constants (e.g. CASE WHEN ..
     THEN 'promo' ELSE 'other')."""
 
-    def __init__(self, batch: Batch, out_dict: Dictionary | None = None):
+    def __init__(self, batch: Batch, out_dict: Dictionary | None = None,
+                 extra_dicts: dict | None = None):
         self.batch = batch
         self.out_dict = out_dict
+        # lambda-parameter dictionaries (symbol -> Dictionary): params are
+        # not batch columns, but string params carry the element dict
+        self.extra_dicts = extra_dicts or {}
 
     def dict_for(self, e: RowExpression) -> Dictionary | None:
         if isinstance(e, InputRef):
+            if e.name in self.extra_dicts:
+                return self.extra_dicts[e.name]
             return self.batch.dict_of(e.name)
         if isinstance(e, Call):
             if e.fn in _STR_TO_STR:
@@ -527,6 +539,7 @@ _STRUCT_ONLY_FNS = {
     "array_ctor", "array_position", "array_min", "array_max", "array_sum",
     "array_average", "array_distinct", "array_sort", "slice", "sequence",
     "repeat", "map", "map_keys", "map_values",
+    "transform", "filter", "reduce", "any_match", "all_match", "none_match",
 }
 # polymorphic names: structural only when the first arg is ARRAY/MAP
 _STRUCT_POLY_FNS = {"cardinality", "contains", "concat", "element_at",
@@ -1006,6 +1019,16 @@ def _elem_dict(e: RowExpression, ctx: CompileContext) -> Dictionary | None:
             return _elem_dict(e.args[1], ctx)
         if e.fn == "map_keys":
             return _key_dict(e.args[0], ctx)
+        if e.fn == "transform":
+            # output element dict = the body's dict with the param bound
+            # to the input's element dict (dict transforms are dictionary-
+            # level, so no element batch is needed here)
+            le = e.args[1]
+            pdict = _elem_dict(e.args[0], ctx)
+            sub = CompileContext(
+                ctx.batch, ctx.out_dict,
+                {**ctx.extra_dicts, le.params[0][0]: pdict})
+            return sub.dict_for(le.body)
         for a in e.args:
             if isinstance(a.type, (ArrayType, MapType)) or a.type.is_string:
                 d = _elem_dict(a, ctx) if isinstance(
@@ -1111,6 +1134,9 @@ def _eval_structural(e: Call, ctx: CompileContext):
         vsv, vvalid = _eval(e.args[1], ctx)
         return _struct.map_from_arrays(ksv, vsv), _and_valid(kvalid, vvalid)
 
+    if fn == "reduce":
+        return _eval_reduce(e, ctx)
+
     # remaining forms evaluate their structural operand first
     sv, rvalid = _eval(e.args[0], ctx)
     t0 = e.args[0].type
@@ -1160,7 +1186,119 @@ def _eval_structural(e: Call, ctx: CompileContext):
         return _struct.map_keys(sv), rvalid
     if fn == "map_values":
         return _struct.map_values(sv), rvalid
+    if fn in ("transform", "filter", "any_match", "all_match", "none_match"):
+        return _eval_higher_order(e, ctx, sv, rvalid)
     raise NotImplementedError(f"structural function not implemented: {fn}")
+
+
+def _repeat_column(c, w: int):
+    """Row i of the outer batch → rows i*w..(i+1)*w-1 (lambda bodies may
+    capture outer columns). gather() replicates every plane."""
+    cap = c.values.shape[0]
+    idx = jnp.repeat(jnp.arange(cap, dtype=jnp.int32), w)
+    return c.gather(idx)
+
+
+def _element_batch(ctx: CompileContext, w: int, param_cols) -> Batch:
+    """Synthetic [cap*w]-row batch: outer columns repeated per element
+    slot + the lambda parameter columns (flattened element planes). The
+    lambda body compiles over it exactly like any row expression —
+    vectorized over every element of every row at once."""
+    b = ctx.batch
+    names = list(b.names)
+    types = list(b.types)
+    cols = [_repeat_column(c, w) for c in b.columns]
+    dicts = dict(b.dicts)
+    extra = {}
+    for sym, t, vals, valid, d in param_cols:
+        names.append(sym)
+        types.append(t)
+        cols.append(Column(vals, valid))
+        if d is not None:
+            dicts[sym] = d
+            extra[sym] = d
+    live = jnp.repeat(b.live, w)
+    eb = Batch(names, types, cols, live, dicts)
+    return eb, extra
+
+
+def _eval_higher_order(e: Call, ctx: CompileContext, sv: StructVal, rvalid):
+    """transform/filter/…_match: the lambda body evaluates once over the
+    flattened [cap*w] element plane (no per-element interpretation —
+    LambdaDefinitionExpression codegen redesigned as plane vectorization)."""
+    fn = e.fn
+    cap = ctx.batch.capacity
+    le: LambdaExpr = e.args[1]
+    (psym, pt), = le.params
+    w = sv.width
+    if w == 0:
+        if fn == "transform":
+            return StructVal(jnp.zeros((cap, 0), le.type.dtype), sv.sizes,
+                             None), rvalid
+        if fn == "filter":
+            return sv, rvalid
+        empty = jnp.zeros(cap, bool)
+        return (~empty if fn in ("all_match", "none_match") else empty), rvalid
+
+    present = sv.present()
+    evalid = sv.element_valid()
+    pdict = _elem_dict(e.args[0], ctx) if pt.is_string else None
+    eb, extra = _element_batch(
+        ctx, w,
+        [(psym, pt, sv.values.reshape(-1), evalid.reshape(-1), pdict)])
+    bctx = CompileContext(eb, ctx.out_dict, extra)
+    bv, bvalid = _eval(le.body, bctx)
+    bv = jnp.broadcast_to(bv, (cap * w,)).reshape(cap, w)
+    bvalid2 = (jnp.broadcast_to(bvalid, (cap * w,)).reshape(cap, w)
+               if bvalid is not None else None)
+
+    if fn == "transform":
+        out = StructVal(bv.astype(le.type.dtype), sv.sizes, bvalid2)
+        return out, rvalid
+    truth = bv.astype(bool)
+    if bvalid2 is not None:
+        truth = truth & bvalid2  # NULL predicate counts as not-matching
+    if fn == "filter":
+        return _struct.filter_elements(sv, truth & present), rvalid
+    if fn == "any_match":
+        return jnp.any(truth & present, axis=1), rvalid
+    if fn == "all_match":
+        return jnp.all(truth | ~present, axis=1), rvalid
+    return ~jnp.any(truth & present, axis=1), rvalid  # none_match
+
+
+def _eval_reduce(e: Call, ctx: CompileContext):
+    """reduce(arr, init, (state, x) -> ...): trace-time unrolled fold over
+    the W element slots — each step is one vectorized body evaluation over
+    all rows (W is the static plane width, typically small)."""
+    sv, rvalid = _eval(e.args[0], ctx)
+    iv, ivalid = _eval_arg(e.args[1], ctx)
+    le: LambdaExpr = e.args[2]
+    (ssym, st), (xsym, xt) = le.params
+    cap = ctx.batch.capacity
+    acc_v = jnp.broadcast_to(iv, (cap,)).astype(st.dtype)
+    acc_valid = (jnp.broadcast_to(ivalid, (cap,)) if ivalid is not None
+                 else jnp.ones(cap, bool))
+    present = sv.present()
+    evalid = sv.element_valid()
+    xdict = _elem_dict(e.args[0], ctx) if xt.is_string else None
+    for j in range(sv.width):
+        eb, extra = _element_batch(ctx, 1, [
+            (ssym, st, acc_v, acc_valid, None),
+            (xsym, xt, sv.values[:, j], evalid[:, j], xdict),
+        ])
+        bctx = CompileContext(eb, ctx.out_dict, extra)
+        bv, bvalid = _eval(le.body, bctx)
+        bv = jnp.broadcast_to(bv, (cap,)).astype(st.dtype)
+        bvalid = (jnp.broadcast_to(bvalid, (cap,))
+                  if bvalid is not None else jnp.ones(cap, bool))
+        active = present[:, j]
+        acc_v = jnp.where(active, bv, acc_v)
+        acc_valid = jnp.where(active, bvalid, acc_valid)
+    valid = acc_valid
+    if rvalid is not None:
+        valid = valid & rvalid
+    return acc_v, valid
 
 
 def _days_in_month(y, m):

@@ -72,6 +72,21 @@ class Call(RowExpression):
 
 
 @dataclasses.dataclass(frozen=True)
+class LambdaExpr(RowExpression):
+    """Typed lambda argument of a higher-order function. `params` are
+    (unique plan symbol, element Type) pairs; the body references them as
+    InputRefs. `type` is the body's type (spi/relation/
+    LambdaDefinitionExpression analog)."""
+
+    params: Tuple[Tuple[str, "Type"], ...] = ()
+    body: Optional[RowExpression] = None
+
+    def __str__(self):
+        ps = ", ".join(n for n, _ in self.params)
+        return f"({ps}) -> {self.body}"
+
+
+@dataclasses.dataclass(frozen=True)
 class Param(RowExpression):
     """Placeholder bound before compilation — carries the value of an
     uncorrelated scalar subquery (reference: SubqueryPlanner's handling of
@@ -101,6 +116,14 @@ def substitute_refs(e: RowExpression, mapping: dict) -> RowExpression:
     if isinstance(e, InputRef) and e.name in mapping:
         m = mapping[e.name]
         return m if isinstance(m, RowExpression) else InputRef(e.type, m)
+    if isinstance(e, LambdaExpr):
+        # lambda params shadow outer symbols
+        inner = {k: v for k, v in mapping.items()
+                 if k not in {n for n, _ in e.params}}
+        nb = substitute_refs(e.body, inner)
+        if nb is not e.body:
+            return LambdaExpr(e.type, e.params, nb)
+        return e
     if isinstance(e, Call):
         new_args = tuple(substitute_refs(a, mapping) for a in e.args)
         if new_args != e.args:
@@ -114,6 +137,10 @@ def expr_inputs(e: RowExpression, acc: Optional[set] = None) -> set:
         acc = set()
     if isinstance(e, InputRef):
         acc.add(e.name)
+    elif isinstance(e, LambdaExpr):
+        inner: set = set()
+        expr_inputs(e.body, inner)
+        acc |= inner - {n for n, _ in e.params}
     elif isinstance(e, Call):
         for a in e.args:
             expr_inputs(a, acc)

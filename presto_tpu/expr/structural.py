@@ -297,6 +297,24 @@ def repeat_val(v, v_valid, n: int, cap: int, dtype) -> StructVal:
     return StructVal(vals, jnp.full(cap, n, jnp.int32), evalid)
 
 
+def filter_elements(sv: StructVal, keep: jnp.ndarray) -> StructVal:
+    """Keep elements where `keep` is True, compacted to the front with
+    original order preserved: one stable sort along W by the drop flag
+    (the scatter-free analog of the reference's per-position copy)."""
+    w = sv.width
+    if w == 0:
+        return sv
+    drop = (~keep).astype(jnp.int32)
+    pos = jnp.broadcast_to(jnp.arange(w, dtype=jnp.int32)[None, :],
+                           drop.shape)
+    ev = sv.element_valid().astype(jnp.int32)
+    _, _, vals_s, ev_s = jax.lax.sort(
+        (drop, pos, sv.values, ev), dimension=1, num_keys=2)
+    sizes = jnp.sum(keep, axis=1).astype(jnp.int32)
+    present = jnp.arange(w, dtype=jnp.int32)[None, :] < sizes[:, None]
+    return StructVal(vals_s, sizes, ev_s.astype(bool) & present)
+
+
 def map_from_arrays(k: StructVal, v: StructVal) -> StructVal:
     """map(array, array): aligned planes; sizes from the key array.
 

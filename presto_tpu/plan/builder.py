@@ -121,6 +121,24 @@ class Scope:
         return Scope(self.fields + other.fields)
 
 
+class LambdaScope(Scope):
+    """Lambda parameters SHADOW same-named outer columns (SQL lambda
+    scoping) — unlike Scope concatenation, which treats duplicate names
+    as ambiguous."""
+
+    def __init__(self, params: List[Field], outer: Scope):
+        super().__init__(params + outer.fields)
+        self._params = params
+        self._outer = outer
+
+    def resolve(self, parts: Tuple[str, ...]) -> Field:
+        if len(parts) == 1:
+            for f in self._params:
+                if f.name == parts[0]:
+                    return f
+        return self._outer.resolve(parts)
+
+
 @dataclasses.dataclass
 class RelationPlan:
     node: PlanNode
@@ -462,6 +480,9 @@ class ExprAnalyzer:
         name = node.name.lower()
         if name in _AGG_FUNCS:
             raise AnalysisError(f"aggregate {name}() not allowed here")
+        if name in ("transform", "filter", "reduce", "any_match",
+                    "all_match", "none_match"):
+            return self._an_higher_order(name, node)
         args = tuple(self.analyze(a) for a in node.args)
         structural = self._an_structural_fn(name, args)
         if structural is not None:
@@ -566,6 +587,54 @@ class ExprAnalyzer:
                 return Call(DATE, "date_add_days", (args[1], args[0]))
             return Call(DATE, "date_add_unit", args)
         raise AnalysisError(f"unknown function {name}")
+
+    def _an_lambda(self, lam, param_types) -> "LambdaExpr":
+        """Analyze a lambda body with its params bound in a child scope
+        (SqlBase.g4 lambda / ExpressionAnalyzer's lambda scoping)."""
+        from presto_tpu.expr.ir import LambdaExpr
+
+        if not isinstance(lam, ast.Lambda):
+            raise AnalysisError("expected a lambda argument (x -> ...)")
+        if len(lam.params) != len(param_types):
+            raise AnalysisError(
+                f"lambda takes {len(param_types)} parameters, "
+                f"got {len(lam.params)}")
+        params = []
+        fields = []
+        for pname, pt in zip(lam.params, param_types):
+            sym = self.planner.symbols.fresh(pname)
+            params.append((sym, pt))
+            fields.append(Field("", pname, sym, pt))
+        sub = ExprAnalyzer(LambdaScope(fields, self.scope), self.planner,
+                           self.replacements)
+        body = sub.analyze(lam.body)
+        return LambdaExpr(body.type, tuple(params), body)
+
+    def _an_higher_order(self, name: str, node: ast.FunctionCall):
+        """transform/filter/reduce/…_match over arrays: the lambda body
+        vectorizes over the flattened element plane at compile time."""
+        if len(node.args) < 2:
+            raise AnalysisError(f"{name} expects an array and a lambda")
+        arr = self.analyze(node.args[0])
+        if not isinstance(arr.type, ArrayType):
+            raise AnalysisError(f"{name} requires ARRAY, got {arr.type}")
+        et = arr.type.element
+        if name == "reduce":
+            if len(node.args) != 3:
+                raise AnalysisError(
+                    "reduce(array, initial, (state, x) -> ...) expects 3 "
+                    "arguments")
+            init = self.analyze(node.args[1])
+            le = self._an_lambda(node.args[2], [init.type, et])
+            return Call(le.type, "reduce", (arr, init, le))
+        le = self._an_lambda(node.args[1], [et])
+        if name == "transform":
+            return Call(ArrayType(le.type), "transform", (arr, le))
+        if le.type is not BOOLEAN:
+            raise AnalysisError(f"{name} lambda must return boolean")
+        if name == "filter":
+            return Call(arr.type, "filter", (arr, le))
+        return Call(BOOLEAN, name, (arr, le))  # any/all/none_match
 
     def _an_structural_fn(self, name: str, args) -> Optional[RowExpression]:
         """ARRAY/MAP function typing (spi/type/ArrayType + MapType;
@@ -1119,6 +1188,11 @@ class Planner:
                     )
                     if isinstance(e, InputRef):
                         sym = e.name
+                        # ORDER BY a non-selected column: the sort key must
+                        # ride through the projection (Output drops it)
+                        if not any(s == sym for s, _ in proj_exprs) and not any(
+                                s == sym for s, _ in extra_order_exprs):
+                            extra_order_exprs.append((sym, e))
                     else:
                         sym = self.symbols.fresh("orderkey")
                         extra_order_exprs.append((sym, e))

@@ -13,7 +13,14 @@ from __future__ import annotations
 
 from typing import Any, Dict
 
-from presto_tpu.expr.ir import Call, Constant, InputRef, Param, RowExpression
+from presto_tpu.expr.ir import (
+    Call,
+    Constant,
+    InputRef,
+    LambdaExpr,
+    Param,
+    RowExpression,
+)
 from presto_tpu.plan.fragmenter import Fragment
 from presto_tpu.plan.nodes import (
     Aggregate,
@@ -70,6 +77,10 @@ def expr_to_json(e: RowExpression) -> Dict[str, Any]:
                 "args": [expr_to_json(a) for a in e.args]}
     if isinstance(e, Param):
         return {"k": "param", "t": _t(e.type), "name": e.name}
+    if isinstance(e, LambdaExpr):
+        return {"k": "lambda", "t": _t(e.type),
+                "params": [[s, _t(t)] for s, t in e.params],
+                "body": expr_to_json(e.body)}
     raise CodecError(f"unencodable expression {type(e).__name__}")
 
 
@@ -84,6 +95,10 @@ def expr_from_json(d: Dict[str, Any]) -> RowExpression:
         return Call(t, d["fn"], tuple(expr_from_json(a) for a in d["args"]))
     if k == "param":
         return Param(t, d["name"])
+    if k == "lambda":
+        return LambdaExpr(
+            t, tuple((s, _untype(ts)) for s, ts in d["params"]),
+            expr_from_json(d["body"]))
     raise CodecError(f"unknown expression kind {k!r}")
 
 

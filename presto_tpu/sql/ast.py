@@ -125,6 +125,15 @@ class WindowFunction(Node):
 
 
 @dataclasses.dataclass
+class Lambda(Node):
+    """`x -> body` / `(a, b) -> body` — argument to higher-order array
+    functions (SqlBase.g4 lambda; spi/function/LambdaDefinitionExpression)."""
+
+    params: list
+    body: Node
+
+
+@dataclasses.dataclass
 class Cast(Node):
     value: Node
     type_name: str

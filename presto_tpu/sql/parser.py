@@ -37,7 +37,7 @@ _TOKEN_RE = re.compile(
   | (?P<string>'(?:[^']|'')*')
   | (?P<qident>"(?:[^"]|"")*")
   | (?P<ident>[A-Za-z_][A-Za-z0-9_$]*)
-  | (?P<op><>|!=|>=|<=|\|\||[-+*/%(),.<>=;\[\]])
+  | (?P<op><>|!=|>=|<=|->|\|\||[-+*/%(),.<>=;\[\]])
     """,
     re.VERBOSE | re.DOTALL,
 )
@@ -443,6 +443,32 @@ class Parser:
     # -- expressions ------------------------------------------------------
 
     def parse_expr(self) -> ast.Node:
+        # lambda: `x -> body` or `(x, y) -> body` (valid only in function
+        # argument position; the analyzer rejects stray lambdas)
+        t = self.peek()
+        if (t.kind == "ident" and self.peek(1).kind == "op"
+                and self.peek(1).value == "->"):
+            name = self.ident()
+            self.next()  # ->
+            return ast.Lambda([name], self.parse_expr())
+        if (t.kind == "op" and t.value == "(" and self.peek(1).kind == "ident"
+                and self.peek(2).kind == "op"
+                and self.peek(2).value in (",", ")")):
+            # lookahead for "(a, b) ->"
+            save = self.i
+            try:
+                self.next()
+                params = [self.ident()]
+                while self.accept_op(","):
+                    params.append(self.ident())
+                if (self.accept_op(")")
+                        and self.peek().kind == "op"
+                        and self.peek().value == "->"):
+                    self.next()
+                    return ast.Lambda(params, self.parse_expr())
+            except ParseError:
+                pass
+            self.i = save
         return self.parse_or()
 
     def parse_or(self) -> ast.Node:

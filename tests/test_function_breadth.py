@@ -125,7 +125,8 @@ def test_approx_percentile(runner):
     for _, row in got.iterrows():
         vals = np.sort(df[df.g == row.g].x.values)
         k = max(int(np.ceil(0.5 * len(vals))) - 1, 0)
-        np.testing.assert_allclose(row.med, vals[k], rtol=1e-12)
+        # quantized-histogram sketch: value-space relative error <= 2^-12
+        np.testing.assert_allclose(row.med, vals[k], rtol=1e-3)
 
 
 def test_max_by_min_by(runner):

@@ -284,3 +284,65 @@ class TestGuards:
 
         with pytest.raises(AnalysisError):
             runner.run("select count(*) from t group by arr")
+
+
+class TestLambdas:
+    """Higher-order array functions: the lambda body vectorizes over the
+    flattened element plane (LambdaDefinitionExpression redesigned —
+    no per-element interpretation)."""
+
+    def test_transform(self, runner):
+        df = rows(runner, "select transform(array[1,2,3], x -> x * 10) as a")
+        assert df["a"][0] == [10, 20, 30]
+
+    def test_transform_captures_outer_column(self, runner):
+        df = rows(runner, "select id, transform(arr, x -> x + id) as a "
+                          "from t where id = 2")
+        assert df["a"][0] == [6, 7]
+
+    def test_transform_null_elements(self, runner):
+        df = rows(runner, "select transform(arr, x -> coalesce(x, 0)) as a "
+                          "from t where id = 4")
+        assert df["a"][0] == [7, 0, 9]
+
+    def test_transform_string_body(self, runner):
+        df = rows(runner, "select transform(tags, x -> upper(x)) as a "
+                          "from t where id = 1")
+        assert df["a"][0] == ["A", "B"]
+
+    def test_filter(self, runner):
+        df = rows(runner, "select filter(array[5,1,8,2], x -> x > 3) as a")
+        assert df["a"][0] == [5, 8]
+
+    def test_filter_keeps_order_and_sizes(self, runner):
+        df = rows(runner, "select id, cardinality(filter(arr, x -> x > 2)) "
+                          "as c from t order by id")
+        assert list(df["c"]) == [1, 2, 0, 2]  # NULL element not > 2
+
+    def test_reduce(self, runner):
+        df = rows(runner,
+                  "select reduce(array[1,2,3,4], 0, (s, x) -> s + x) as s, "
+                  "reduce(array[2,3], 1, (s, x) -> s * x) as p")
+        assert df["s"][0] == 10
+        assert df["p"][0] == 6
+
+    def test_match_functions(self, runner):
+        df = rows(runner,
+                  "select any_match(array[1,2,3], x -> x > 2) as a, "
+                  "all_match(array[1,2,3], x -> x > 0) as b, "
+                  "none_match(array[1,2,3], x -> x > 9) as c, "
+                  "any_match(array[1,2,3], x -> x > 9) as d")
+        assert bool(df["a"][0]) and bool(df["b"][0]) and bool(df["c"][0])
+        assert not bool(df["d"][0])
+
+    def test_lambda_param_shadows_column(self, runner):
+        # `id` as a lambda param must shadow the table column
+        df = rows(runner, "select transform(arr, id -> id * 0) as a "
+                          "from t where id = 1")
+        assert df["a"][0] == [0, 0, 0]
+
+    def test_nested_higher_order(self, runner):
+        df = rows(runner,
+                  "select reduce(filter(arr, x -> x is not null), 0, "
+                  "(s, x) -> s + x) as s from t order by id")
+        assert list(df["s"]) == [6, 9, 0, 16]
