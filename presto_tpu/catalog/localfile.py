@@ -1,0 +1,87 @@
+"""Local-file connector — CSV / JSON-lines tables.
+
+Reference: presto-local-file + presto-record-decoder (the csv/json
+RowDecoders shared by the kafka/redis connectors). A directory of
+<table>.csv / <table>.jsonl / <table>.json files serves as a schema;
+decoding happens host-side into engine-native columns (pandas does the
+parsing the reference's per-field decoders do), then batches flow
+through the device pipeline like any connector's."""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from presto_tpu.batch import Batch, round_up_capacity
+from presto_tpu.catalog.memory import DeviceSplitCache, MemoryTable, _infer_type
+from presto_tpu.connector import ColumnInfo, Connector, Split, TableHandle
+
+_EXTS = (".csv", ".jsonl", ".json")
+
+
+class LocalFileConnector(DeviceSplitCache, Connector):
+    def __init__(self, directory: str, name: str = "localfile"):
+        self.name = name
+        self.directory = directory
+        self._tables: Dict[str, MemoryTable] = {}
+        self._versions: Dict[str, tuple] = {}
+        self._lock = threading.Lock()
+        self._init_split_cache()
+
+    def _path(self, name: str) -> Optional[str]:
+        for ext in _EXTS:
+            p = os.path.join(self.directory, name + ext)
+            if os.path.exists(p):
+                return p
+        return None
+
+    def table_names(self) -> List[str]:
+        out = []
+        for f in sorted(os.listdir(self.directory)):
+            base, ext = os.path.splitext(f)
+            if ext in _EXTS:
+                out.append(base)
+        return out
+
+    def _load(self, name: str) -> MemoryTable:
+        import pandas as pd
+
+        path = self._path(name)
+        if path is None:
+            raise KeyError(f"table not found: {name}")
+        st = os.stat(path)
+        version = (st.st_mtime_ns, st.st_size)
+        with self._lock:
+            if self._versions.get(name) == version:
+                return self._tables[name]
+        if path.endswith(".csv"):
+            df = pd.read_csv(path)
+        else:
+            df = pd.read_json(path, lines=path.endswith(".jsonl"))
+        data = {c: df[c].to_numpy() for c in df.columns}
+        mt = MemoryTable(name, data)
+        with self._lock:
+            self._tables[name] = mt
+            self._versions[name] = version
+        self.invalidate_cache(name)
+        return mt
+
+    def get_table(self, name: str) -> TableHandle:
+        return self._load(name).handle(self.name)
+
+    def splits(self, handle: TableHandle, desired: int = 1) -> List[Split]:
+        return [Split(handle.name, i, desired) for i in range(desired)]
+
+    def _read_split_uncached(self, split: Split, columns: Sequence[str],
+                             capacity: Optional[int] = None) -> Batch:
+        from presto_tpu.catalog.memory import MemoryConnector
+
+        t = self._load(split.table)
+        # reuse the memory connector's split reader over the parsed table
+        shim = MemoryConnector.__new__(MemoryConnector)
+        shim.tables = {split.table: t}
+        return MemoryConnector._read_split_uncached(
+            shim, split, columns, capacity)
