@@ -265,6 +265,12 @@ def _direct_grouped_merge(
         gid = gid * ds + slot
     gid = jnp.where(live, gid, total)  # dead rows match no slot
 
+    from presto_tpu.ops import pallas_groupby as _pg
+
+    if _pg.enabled():
+        return _pallas_direct_merge(keys, states, live, num_groups_cap,
+                                    dom_slots, gid, total)
+
     # [G, n] group-membership mask, reused across all states
     eq = gid[None, :] == jnp.arange(total, dtype=jnp.int32)[:, None]
 
@@ -293,6 +299,84 @@ def _direct_grouped_merge(
     state_out = [
         _state_merge_masked(s, eq, total, num_groups_cap) for s in states
     ]
+    return key_out, state_out, out_live, n_groups
+
+
+def _decode_direct_keys(keys, dom_slots, num_groups_cap):
+    """Key columns decoded from the slot index (shared by the mask and
+    Pallas direct paths)."""
+    g = jnp.arange(num_groups_cap, dtype=jnp.int32)
+    digits = []
+    rem = g
+    for ds in reversed(dom_slots):
+        digits.append(rem % ds)
+        rem = rem // ds
+    digits.reverse()
+    key_out = []
+    for k, d, ds in zip(keys, digits, dom_slots):
+        if k.validity is not None:
+            kvd = d > 0
+            kv = jnp.where(kvd, d - 1, 0).astype(k.values.dtype)
+            key_out.append(KeyCol(kv, kvd, k.domain))
+        else:
+            key_out.append(KeyCol(d.astype(k.values.dtype), None, k.domain))
+    return key_out
+
+
+def _pallas_direct_merge(keys, states, live, num_groups_cap, dom_slots,
+                         gid, total, interpret: bool = False):
+    """Direct small-domain path on the MXU (ops/pallas_groupby): integer
+    sums (decimal money, counts) and validity counts fuse into ONE exact
+    kernel pass; float sums and min/max states keep the portable masked
+    reduction (f32 MACs cannot deliver f64 sums — see the kernel's
+    docstring)."""
+    from presto_tpu.ops import pallas_groupby as _pg
+
+    int_states, plan = [], []
+    # group occupancy ride-along: one all-ones int state
+    int_states.append(live.astype(jnp.int64))
+    for s in states:
+        valid = live if s.validity is None else (live & s.validity)
+        int_sum = (s.op in ("sum", "count_add")
+                   and not jnp.issubdtype(s.values.dtype, jnp.floating))
+        if int_sum:
+            contrib = jnp.where(valid, s.values, jnp.zeros_like(s.values))
+            main = ("int", len(int_states))
+            int_states.append(contrib.astype(jnp.int64))
+        else:
+            main = ("masked", None)
+        if int_sum and s.op != "count_add":
+            plan.append((main, len(int_states)))
+            int_states.append(valid.astype(jnp.int64))
+        else:
+            plan.append((main, None))
+    iouts = _pg.grouped_sums(gid, int_states, total, interpret=interpret)
+
+    def widen(arr, dtype):
+        return jnp.zeros(num_groups_cap, dtype).at[:total].set(
+            arr.astype(dtype))
+
+    counts = widen(iouts[0], jnp.int32)
+    out_live = counts > 0
+    n_groups = jnp.sum(out_live.astype(jnp.int32))
+    key_out = _decode_direct_keys(keys, dom_slots, num_groups_cap)
+
+    eq = None
+    state_out = []
+    for s, ((kind, idx), nv_idx) in zip(states, plan):
+        if kind == "masked":
+            if eq is None:
+                eq = (gid[None, :]
+                      == jnp.arange(total, dtype=jnp.int32)[:, None])
+            state_out.append(_state_merge_masked(s, eq, total,
+                                                 num_groups_cap))
+            continue
+        agg = widen(iouts[idx], s.values.dtype)
+        if s.op == "count_add":
+            state_out.append(StateCol(agg, None, s.op))
+            continue
+        nvalid = widen(iouts[nv_idx], jnp.int32)
+        state_out.append(StateCol(agg, nvalid > 0, s.op))
     return key_out, state_out, out_live, n_groups
 
 
