@@ -540,6 +540,7 @@ _STRUCT_ONLY_FNS = {
     "array_average", "array_distinct", "array_sort", "slice", "sequence",
     "repeat", "map", "map_keys", "map_values",
     "transform", "filter", "reduce", "any_match", "all_match", "none_match",
+    "transform_values", "map_filter",
 }
 # polymorphic names: structural only when the first arg is ARRAY/MAP
 _STRUCT_POLY_FNS = {"cardinality", "contains", "concat", "element_at",
@@ -1019,15 +1020,18 @@ def _elem_dict(e: RowExpression, ctx: CompileContext) -> Dictionary | None:
             return _elem_dict(e.args[1], ctx)
         if e.fn == "map_keys":
             return _key_dict(e.args[0], ctx)
-        if e.fn == "transform":
-            # output element dict = the body's dict with the param bound
-            # to the input's element dict (dict transforms are dictionary-
-            # level, so no element batch is needed here)
+        if e.fn in ("transform", "transform_values"):
+            # output element dict = the body's dict with the params bound
+            # to the input's element/key dicts (dict transforms are
+            # dictionary-level, so no element batch is needed here)
             le = e.args[1]
-            pdict = _elem_dict(e.args[0], ctx)
-            sub = CompileContext(
-                ctx.batch, ctx.out_dict,
-                {**ctx.extra_dicts, le.params[0][0]: pdict})
+            bound = dict(ctx.extra_dicts)
+            if e.fn == "transform":
+                bound[le.params[0][0]] = _elem_dict(e.args[0], ctx)
+            else:
+                bound[le.params[0][0]] = _key_dict(e.args[0], ctx)
+                bound[le.params[1][0]] = _elem_dict(e.args[0], ctx)
+            sub = CompileContext(ctx.batch, ctx.out_dict, bound)
             return sub.dict_for(le.body)
         for a in e.args:
             if isinstance(a.type, (ArrayType, MapType)) or a.type.is_string:
@@ -1045,6 +1049,8 @@ def _key_dict(e: RowExpression, ctx: CompileContext) -> Dictionary | None:
     if isinstance(e, Call):
         if e.fn == "map":
             return _elem_dict(e.args[0], ctx)
+        if e.fn in ("transform_values", "map_filter"):
+            return _key_dict(e.args[0], ctx)
         for a in e.args:
             if isinstance(a.type, MapType):
                 d = _key_dict(a, ctx)
@@ -1188,7 +1194,43 @@ def _eval_structural(e: Call, ctx: CompileContext):
         return _struct.map_values(sv), rvalid
     if fn in ("transform", "filter", "any_match", "all_match", "none_match"):
         return _eval_higher_order(e, ctx, sv, rvalid)
+    if fn in ("transform_values", "map_filter"):
+        return _eval_map_higher_order(e, ctx, sv, rvalid)
     raise NotImplementedError(f"structural function not implemented: {fn}")
+
+
+def _eval_map_higher_order(e: Call, ctx: CompileContext, sv: StructVal,
+                           rvalid):
+    """transform_values / map_filter: the (k, v) lambda evaluates over the
+    flattened key+value planes together."""
+    fn = e.fn
+    cap = ctx.batch.capacity
+    le: LambdaExpr = e.args[1]
+    (ksym, kt), (vsym, vt) = le.params
+    w = sv.width
+    if w == 0:
+        return sv, rvalid
+    present = sv.present()
+    evalid = sv.element_valid()
+    kdict = _key_dict(e.args[0], ctx) if kt.is_string else None
+    vdict = _elem_dict(e.args[0], ctx) if vt.is_string else None
+    eb, extra = _element_batch(ctx, w, [
+        (ksym, kt, sv.keys.reshape(-1), present.reshape(-1), kdict),
+        (vsym, vt, sv.values.reshape(-1), evalid.reshape(-1), vdict),
+    ])
+    bctx = CompileContext(eb, ctx.out_dict, extra)
+    bv, bvalid = _eval(le.body, bctx)
+    bv = jnp.broadcast_to(bv, (cap * w,)).reshape(cap, w)
+    bvalid2 = (jnp.broadcast_to(bvalid, (cap * w,)).reshape(cap, w)
+               if bvalid is not None else None)
+    if fn == "transform_values":
+        out = StructVal(bv.astype(le.type.dtype), sv.sizes, bvalid2,
+                        keys=sv.keys)
+        return out, rvalid
+    truth = bv.astype(bool)
+    if bvalid2 is not None:
+        truth = truth & bvalid2
+    return _struct.filter_elements(sv, truth & present), rvalid
 
 
 def _repeat_column(c, w: int):

@@ -300,7 +300,8 @@ def repeat_val(v, v_valid, n: int, cap: int, dtype) -> StructVal:
 def filter_elements(sv: StructVal, keep: jnp.ndarray) -> StructVal:
     """Keep elements where `keep` is True, compacted to the front with
     original order preserved: one stable sort along W by the drop flag
-    (the scatter-free analog of the reference's per-position copy)."""
+    (the scatter-free analog of the reference's per-position copy).
+    Map key planes ride the same permutation."""
     w = sv.width
     if w == 0:
         return sv
@@ -308,11 +309,16 @@ def filter_elements(sv: StructVal, keep: jnp.ndarray) -> StructVal:
     pos = jnp.broadcast_to(jnp.arange(w, dtype=jnp.int32)[None, :],
                            drop.shape)
     ev = sv.element_valid().astype(jnp.int32)
-    _, _, vals_s, ev_s = jax.lax.sort(
-        (drop, pos, sv.values, ev), dimension=1, num_keys=2)
+    operands = [drop, pos, sv.values, ev]
+    if sv.keys is not None:
+        operands.append(sv.keys)
+    out = jax.lax.sort(tuple(operands), dimension=1, num_keys=2)
+    vals_s, ev_s = out[2], out[3]
+    keys_s = out[4] if sv.keys is not None else None
     sizes = jnp.sum(keep, axis=1).astype(jnp.int32)
     present = jnp.arange(w, dtype=jnp.int32)[None, :] < sizes[:, None]
-    return StructVal(vals_s, sizes, ev_s.astype(bool) & present)
+    return StructVal(vals_s, sizes, ev_s.astype(bool) & present,
+                     keys=keys_s)
 
 
 def map_from_arrays(k: StructVal, v: StructVal) -> StructVal:

@@ -481,7 +481,8 @@ class ExprAnalyzer:
         if name in _AGG_FUNCS:
             raise AnalysisError(f"aggregate {name}() not allowed here")
         if name in ("transform", "filter", "reduce", "any_match",
-                    "all_match", "none_match"):
+                    "all_match", "none_match", "transform_values",
+                    "map_filter"):
             return self._an_higher_order(name, node)
         args = tuple(self.analyze(a) for a in node.args)
         structural = self._an_structural_fn(name, args)
@@ -616,6 +617,17 @@ class ExprAnalyzer:
         if len(node.args) < 2:
             raise AnalysisError(f"{name} expects an array and a lambda")
         arr = self.analyze(node.args[0])
+        if name in ("transform_values", "map_filter"):
+            if not isinstance(arr.type, MapType):
+                raise AnalysisError(f"{name} requires MAP, got {arr.type}")
+            le = self._an_lambda(node.args[1],
+                                 [arr.type.key, arr.type.value])
+            if name == "transform_values":
+                return Call(MapType(arr.type.key, le.type),
+                            "transform_values", (arr, le))
+            if le.type is not BOOLEAN:
+                raise AnalysisError("map_filter lambda must return boolean")
+            return Call(arr.type, "map_filter", (arr, le))
         if not isinstance(arr.type, ArrayType):
             raise AnalysisError(f"{name} requires ARRAY, got {arr.type}")
         et = arr.type.element

@@ -346,3 +346,29 @@ class TestLambdas:
                   "select reduce(filter(arr, x -> x is not null), 0, "
                   "(s, x) -> s + x) as s from t order by id")
         assert list(df["s"]) == [6, 9, 0, 16]
+
+
+class TestMapLambdas:
+    def test_transform_values(self, runner):
+        df = rows(runner,
+                  "select transform_values(map(array['a','b'], "
+                  "array[1.0, 2.0]), (k, v) -> v * 10) as m")
+        assert df["m"][0] == {"a": 10.0, "b": 20.0}
+
+    def test_map_filter(self, runner):
+        df = rows(runner,
+                  "select map_filter(map(array['a','b','c'], "
+                  "array[1, 2, 3]), (k, v) -> v > 1) as m")
+        assert df["m"][0] == {"b": 2, "c": 3}
+
+    def test_map_filter_on_key(self, runner):
+        df = rows(runner,
+                  "select map_filter(m, (k, v) -> k = 'x') as mm "
+                  "from t where id = 1")
+        assert df["mm"][0] == {"x": 1.5}
+
+    def test_transform_values_on_table_map(self, runner):
+        df = rows(runner,
+                  "select id, transform_values(m, (k, v) -> v + id) as mm "
+                  "from t where id = 2")
+        assert df["mm"][0] == {"x": 12.0}
