@@ -188,7 +188,12 @@ class DistributedScheduler:
 
     def execute(self, query_id: str, dplan: DistributedPlan,
                 workers: List[NodeInfo],
-                config: Optional[ExecConfig] = None):
+                config: Optional[ExecConfig] = None,
+                stats_out: Optional[list] = None):
+        """`stats_out`, when given, is filled with one
+        (task_id, fragment_id, task_info_dict) per task after the result
+        stream completes — the per-task stats rollup EXPLAIN ANALYZE
+        renders (QueryStats/TaskStats introspection analog)."""
         config = config or self.config
         if not workers:
             raise QueryFailed("no active workers")
@@ -262,6 +267,18 @@ class DistributedScheduler:
                 completed = True
             finally:
                 client.close()
+            if stats_out is not None:
+                for tid, w in created:
+                    try:
+                        req = urllib.request.Request(
+                            f"{w.uri}/v1/task/{tid}/status",
+                            headers=self._headers())
+                        with urllib.request.urlopen(req, timeout=10) as r:
+                            info = json.loads(r.read())
+                        fid = int(tid.rsplit(".", 2)[-2])
+                        stats_out.append((tid, fid, info))
+                    except Exception:
+                        pass
         except ExchangeFailure as e:
             raise QueryFailed(str(e), retryable=not e.task_error) from e
         finally:
@@ -340,16 +357,40 @@ class Coordinator:
 
     def _explain(self, sql: str, analyze: bool, session) -> str:
         if analyze:
-            from presto_tpu.exec.runner import LocalRunner
-
-            profile = LocalRunner(self.catalog, session.exec_config()).explain_analyze(sql)
-            return (
-                "-- single-node execution profile (distributed per-fragment "
-                "stats: see /v1/query)\n" + profile
-                + "\n\n-- distributed plan\n"
-                + self.plan_distributed(sql, session).to_string()
-            )
+            return self.explain_analyze_distributed(sql, session)
         return self.plan_distributed(sql, session).to_string()
+
+    def explain_analyze_distributed(self, sql: str, session=None) -> str:
+        """Run the query on the cluster with per-operator accounting and
+        render a per-fragment, per-task stats rollup (the QueryStats/
+        OperatorStats view of the reference's EXPLAIN ANALYZE)."""
+        import dataclasses as _dc
+
+        dplan = self.plan_distributed(sql, session)
+        cfg = _dc.replace(
+            session.exec_config() if session else self.config,
+            collect_stats=True)
+        stats: list = []
+        self.size_monitor.wait_for_minimum()
+        qid = self.next_query_id()
+        workers = self.node_manager.active_nodes()
+        for _ in self.scheduler.execute(qid, dplan, workers, cfg,
+                                        stats_out=stats):
+            pass
+        lines = [dplan.to_string(), "", "-- task execution profile --"]
+        by_fid: Dict[int, list] = {}
+        for tid, fid, info in stats:
+            by_fid.setdefault(fid, []).append((tid, info))
+        for fid in sorted(by_fid):
+            lines.append(f"fragment {fid}:")
+            for tid, info in sorted(by_fid[fid]):
+                lines.append(f"  task {tid} [{info.get('state')}]")
+                for row in info.get("stats") or []:
+                    lines.append(
+                        f"    {row['node']:<16} rows={int(row['rows']):>12,}"
+                        f" batches={int(row['batches']):>6}"
+                        f" wall={row['wall_s']:.3f}s")
+        return "\n".join(lines)
 
     # -- http -------------------------------------------------------------
 

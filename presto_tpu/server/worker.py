@@ -153,6 +153,7 @@ class TaskExecution:
         self.executor = executor
         self.state = "running"
         self.error: Optional[str] = None
+        self.stats_report: Optional[list] = None  # per-operator rows
         f = update.fragment
         self.buffer = OutputBuffer(
             update.n_out_partitions,
@@ -204,6 +205,20 @@ class TaskExecution:
             else:
                 for batch in stream:
                     sink(batch)
+            if cfg.collect_stats:
+                names = {}
+
+                def walk(n):
+                    names[id(n)] = type(n).__name__
+                    for c in n.children():
+                        walk(c)
+
+                walk(f.root)
+                self.stats_report = [
+                    {"node": names.get(nid, "?"), **st}
+                    for nid, st in ctx.node_stats.items()
+                ] + [{"node": k, "rows": v, "batches": 0, "wall_s": 0.0}
+                     for k, v in ctx.stats.items()]
             self.buffer.set_no_more_pages()
             self.state = "finished"
         except Exception as e:
@@ -268,12 +283,15 @@ class TaskExecution:
             self.buffer.abort(p)
 
     def info(self) -> dict:
-        return {
+        out = {
             "taskId": self.task_id,
             "state": self.state,
             "error": self.error,
             "bufferedBytes": self.buffer.buffered_bytes(),
         }
+        if self.stats_report is not None:
+            out["stats"] = self.stats_report
+        return out
 
 
 class TaskManager:
