@@ -152,6 +152,8 @@ class _PqTable:
     # revalidates on access, so multi-process workers see DDL from the
     # coordinator without an invalidation RPC
     version: tuple = (0, 0)
+    # flattened ROW leaves: dotted column name -> (struct column, field)
+    nested: Dict[str, tuple] = dataclasses.field(default_factory=dict)
 
 
 class ParquetConnector(DeviceSplitCache, Connector):
@@ -214,8 +216,31 @@ class ParquetConnector(DeviceSplitCache, Connector):
         schema = f.schema_arrow
         cols = []
         dicts: Dict[str, Dictionary] = {}
+        nested: Dict[str, tuple] = {}  # dotted name -> (parent, leaf)
         name_to_idx = {schema.field(i).name: i for i in range(len(schema.names))}
         for field in schema:
+            if pa.types.is_struct(field.type):
+                # ROW columns flatten to dotted leaf columns — the
+                # spi/type/RowType surface over parquet structs (analysis
+                # resolves r.f to the flattened name; see Scope.resolve)
+                for sub in field.type:
+                    leaf_name = f"{field.name}.{sub.name}"
+                    st = _arrow_to_sql(sub)
+                    nested[leaf_name] = (field.name, sub.name)
+                    if st.is_string:
+                        vocab = set()
+                        for rg in range(f.num_row_groups):
+                            col = f.read_row_group(
+                                rg, columns=[field.name]).column(0)
+                            vals = col.combine_chunks().field(sub.name)
+                            vocab.update(vals.to_pylist())
+                        d = Dictionary(np.array(
+                            sorted(v for v in vocab if v is not None)))
+                        dicts[leaf_name] = d
+                        cols.append(ColumnInfo(leaf_name, st, d))
+                    else:
+                        cols.append(ColumnInfo(leaf_name, st, None))
+                continue
             t = _arrow_to_sql(field)
             if t.is_string:
                 # global per-column dictionary: union of per-row-group
@@ -240,7 +265,7 @@ class ParquetConnector(DeviceSplitCache, Connector):
                     _footer_stats(f, name_to_idx[field.name], t)))
         handle = TableHandle(self.name, name, cols, row_count=float(f.metadata.num_rows))
         t = _PqTable(path, handle, dicts, f.metadata.num_rows, f.num_row_groups,
-                     version=self._file_version(path))
+                     version=self._file_version(path), nested=nested)
         self._tables[name] = t
         return t
 
@@ -406,7 +431,26 @@ class ParquetConnector(DeviceSplitCache, Connector):
                 self._host_cache.move_to_end(key)
                 return hit[0]
         f = pq.ParquetFile(t.path)
-        tbl = f.read_row_group(rg, columns=list(columns))
+        plain = [c for c in columns if c not in t.nested]
+        parents = sorted({t.nested[c][0] for c in columns if c in t.nested})
+        tbl = f.read_row_group(rg, columns=plain + parents)
+        if t.nested:
+            # flatten requested ROW leaves out of their struct columns
+            arrays, fields = [], []
+            for c in columns:
+                if c in t.nested:
+                    parent, leaf = t.nested[c]
+                    sc = tbl.column(parent)
+                    arr = (sc.combine_chunks() if isinstance(
+                        sc, pa.ChunkedArray) else sc)
+                    if isinstance(arr, pa.ChunkedArray):
+                        arr = arr.combine_chunks()
+                    arrays.append(arr.field(leaf))
+                    fields.append(pa.field(c, arrays[-1].type))
+                else:
+                    arrays.append(tbl.column(c))
+                    fields.append(pa.field(c, tbl.column(c).type))
+            tbl = pa.Table.from_arrays(arrays, schema=pa.schema(fields))
         if sub_count > 1:
             per = -(-tbl.num_rows // sub_count)
             tbl = tbl.slice(sub * per, per)

@@ -110,6 +110,18 @@ class Scope:
         else:
             q, n = parts[-2], parts[-1]
             matches = [f for f in self.fields if f.qualifier == q and f.name == n]
+        if not matches and len(parts) > 1:
+            # ROW field access over flattened struct leaves: `r.f` (and
+            # `t.r.f`) resolve against the dotted column name "r.f"
+            for k in range(len(parts), 1, -1):
+                dotted = ".".join(parts[-k:])
+                q = parts[-k - 1] if len(parts) > k else None
+                matches = [
+                    f for f in self.fields
+                    if f.name == dotted and (q is None or f.qualifier == q)
+                ]
+                if matches:
+                    break
         if not matches:
             raise AnalysisError(f"column not found: {'.'.join(parts)}")
         symbols = {m.symbol for m in matches}
