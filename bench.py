@@ -157,6 +157,33 @@ def _dataset(kind: str, sf: float):
     return cat
 
 
+def _dataset_ready(kind: str, sf: float) -> bool:
+    marker = "lineitem" if kind == "tpch" else "store_sales"
+    return os.path.exists(
+        os.path.join(DATA_DIR, f"{kind}_sf{sf:g}", f"{marker}.parquet"))
+
+
+def _resolve_sf(kind: str, sf: float, budget: float) -> float:
+    """Downscale a config's SF when its dataset is absent AND generating
+    it cannot fit the remaining wall budget (SF100 generation is hours;
+    the driver's bench window is not). Prefers the largest already-
+    cached dataset, else the largest affordable one."""
+    if _dataset_ready(kind, sf):
+        return sf
+    est_per_sf = 60.0  # measured ~55 s/SF for the chunked tpch exporter
+    remaining = budget - (time.time() - _T0)
+    if sf * est_per_sf < remaining * 0.5:
+        return sf
+    for cand in (10.0, 1.0, 0.1):
+        if cand >= sf:
+            continue
+        if _dataset_ready(kind, cand) or cand * est_per_sf < remaining * 0.4:
+            _log(f"{kind} sf={sf:g}: dataset absent and generation won't "
+                 f"fit the budget — downscaling to sf={cand:g}")
+            return cand
+    return 0.1
+
+
 def _bench(name, sql, kind, sf, driving_table,
            batch_rows=1 << 20, agg_capacity=1 << 10, runs=3):
     """Ensure dataset → warm up (compile + cache fill) → best-of-N timed
@@ -180,12 +207,36 @@ def _bench(name, sql, kind, sf, driving_table,
     best = min(times)
     _log(f"{name}: best {best:.3f}s of {sorted(round(t, 3) for t in times)} "
          f"({nrows} {driving_table} rows)")
-    return {"seconds": round(best, 4), "rows": nrows,
+    return {"seconds": round(best, 4), "rows": nrows, "sf": sf,
             "rows_per_sec": round(nrows / best, 1)}
+
+
+def _probe_device() -> bool:
+    """The axon TPU tunnel can wedge (observed: jax.devices() blocks
+    forever). Probe it in a SUBPROCESS with a timeout before this process
+    touches jax; on failure fall back to CPU so the driver records a
+    (clearly labeled) number instead of a bench timeout."""
+    import subprocess
+
+    try:
+        p = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; jax.devices(); print('ok')"],
+            timeout=150, capture_output=True)
+        return p.returncode == 0 and b"ok" in p.stdout
+    except subprocess.TimeoutExpired:
+        return False
 
 
 def main():
     budget = float(os.environ.get("BENCH_BUDGET_S", "2400"))
+    device_ok = _probe_device()
+    if not device_ok:
+        _log("DEVICE PROBE FAILED (axon tunnel unresponsive) — "
+             "falling back to CPU; numbers are NOT tpu numbers")
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
     sf_q9 = float(os.environ.get("BENCH_SF_Q9", "100"))
     sf_q64 = float(os.environ.get("BENCH_SF_Q64", "100"))
     wanted = os.environ.get(
@@ -194,14 +245,18 @@ def main():
 
     configs = {
         "q1_sf1": lambda: _bench("q1_sf1", Q1, "tpch", 1.0, "lineitem"),
-        "q6_sf10": lambda: _bench("q6_sf10", Q6, "tpch", 10.0, "lineitem"),
-        "q3_sf10": lambda: _bench("q3_sf10", Q3, "tpch", 10.0, "lineitem",
-                                  agg_capacity=1 << 21),
-        "q9_sf100": lambda: _bench("q9_sf100", Q9, "tpch", sf_q9, "lineitem",
-                                   agg_capacity=1 << 10, runs=2),
-        "q64_sf100": lambda: _bench("q64_sf100", Q64, "tpcds", sf_q64,
-                                    "store_sales", agg_capacity=1 << 14,
-                                    runs=2),
+        "q6_sf10": lambda: _bench(
+            "q6_sf10", Q6, "tpch", _resolve_sf("tpch", 10.0, budget),
+            "lineitem"),
+        "q3_sf10": lambda: _bench(
+            "q3_sf10", Q3, "tpch", _resolve_sf("tpch", 10.0, budget),
+            "lineitem", agg_capacity=1 << 21),
+        "q9_sf100": lambda: _bench(
+            "q9_sf100", Q9, "tpch", _resolve_sf("tpch", sf_q9, budget),
+            "lineitem", agg_capacity=1 << 10, runs=2),
+        "q64_sf100": lambda: _bench(
+            "q64_sf100", Q64, "tpcds", _resolve_sf("tpcds", sf_q64, budget),
+            "store_sales", agg_capacity=1 << 14, runs=2),
     }
 
     extra = {}
@@ -227,6 +282,8 @@ def main():
         if name in extra and "rows_per_sec" in extra[name]:
             extra[name]["vs_baseline"] = round(
                 extra[name]["rows_per_sec"] / ref, 3)
+    if not device_ok:
+        extra["device"] = "cpu-fallback (tpu tunnel unresponsive)"
     print(json.dumps({
         "metric": "tpch_q1_sf1_rows_per_sec",
         "value": value,
