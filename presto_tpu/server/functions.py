@@ -1,0 +1,61 @@
+"""SHOW FUNCTIONS catalog — the function-registry listing
+(reference: metadata/FunctionListBuilder + SHOW FUNCTIONS task).
+
+The engine's dispatch is code (plan/builder._an_FunctionCall,
+expr/compile._eval_call), so this module curates the user-visible
+surface; tests assert the listing matches what actually analyzes."""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+_SCALAR = {
+    "math": ["abs", "sqrt", "exp", "ln", "log", "log2", "log10", "power",
+             "floor", "ceil", "ceiling", "round", "truncate", "sign",
+             "mod", "pi", "e", "cbrt", "degrees", "radians", "greatest",
+             "least", "width_bucket", "is_nan", "is_finite", "is_infinite",
+             "sin", "cos", "tan", "asin", "acos", "atan", "atan2",
+             "sinh", "cosh", "tanh"],
+    "string": ["substr", "substring", "upper", "lower", "trim", "ltrim",
+               "rtrim", "reverse", "replace", "lpad", "rpad", "split_part",
+               "concat", "length", "strpos", "position", "codepoint",
+               "starts_with", "ends_with", "contains", "levenshtein_distance",
+               "hamming_distance"],
+    "regexp/json": ["regexp_like", "regexp_extract", "regexp_replace",
+                    "json_extract_scalar", "json_array_length"],
+    "date": ["year", "month", "day", "quarter", "day_of_week", "dow",
+             "day_of_year", "doy", "date_trunc", "date_diff", "date_add",
+             "from_unixtime", "to_unixtime"],
+    "conditional": ["coalesce", "nullif", "if"],
+    "bitwise": ["bitwise_and", "bitwise_or", "bitwise_xor", "bitwise_not",
+                "bitwise_left_shift", "bitwise_right_shift"],
+    "array": ["cardinality", "element_at", "contains", "array_position",
+              "array_min", "array_max", "array_sum", "array_average",
+              "array_distinct", "array_sort", "slice", "sequence",
+              "repeat", "concat"],
+    "map": ["map", "map_keys", "map_values", "element_at", "cardinality"],
+    "lambda": ["transform", "filter", "reduce", "any_match", "all_match",
+               "none_match", "transform_values", "map_filter"],
+}
+
+_AGGREGATE = ["count", "sum", "avg", "min", "max", "stddev", "stddev_pop",
+              "stddev_samp", "variance", "var_pop", "var_samp", "covar_pop",
+              "covar_samp", "corr", "geometric_mean", "bool_and", "bool_or",
+              "every", "arbitrary", "any_value", "checksum", "count_if",
+              "approx_distinct", "approx_percentile", "max_by", "min_by",
+              "array_agg"]
+
+_WINDOW = ["row_number", "rank", "dense_rank", "percent_rank", "cume_dist",
+           "ntile", "lag", "lead", "first_value", "last_value", "nth_value"]
+
+
+def list_functions() -> List[Tuple[str, str, str]]:
+    out = []
+    for kind, names in _SCALAR.items():
+        for n in sorted(set(names)):
+            out.append((n, "scalar", kind))
+    for n in sorted(_AGGREGATE):
+        out.append((n, "aggregate", ""))
+    for n in sorted(_WINDOW):
+        out.append((n, "window", ""))
+    return out

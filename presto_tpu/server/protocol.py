@@ -42,6 +42,11 @@ _SHOW_CATALOGS_RE = re.compile(r"^\s*show\s+catalogs\s*$", re.I)
 _SHOW_COLUMNS_RE = re.compile(
     r"^\s*(?:show\s+columns\s+from|describe)\s+([\w.]+)\s*$", re.I
 )
+_SHOW_FUNCTIONS_RE = re.compile(r"^\s*show\s+functions\s*$", re.I)
+_SHOW_SCHEMAS_RE = re.compile(
+    r"^\s*show\s+schemas(?:\s+from\s+([\w.]+))?\s*$", re.I)
+_SHOW_STATS_RE = re.compile(
+    r"^\s*show\s+stats\s+for\s+([\w.]+)\s*$", re.I)
 _EXPLAIN_RE = re.compile(r"^\s*explain\s+(analyze\s+)?(.+)$", re.I | re.S)
 
 
@@ -172,6 +177,40 @@ class StatementProtocol:
             r = QueryResult(
                 ["column", "type"], ["varchar", "varchar"],
                 [(c.name, str(c.type)) for c in handle.columns])
+            return self._immediate(session, sql, r), extra
+        m = _SHOW_FUNCTIONS_RE.match(sql)
+        if m:
+            from presto_tpu.server.functions import list_functions
+
+            r = QueryResult(
+                ["function", "kind", "description"], ["varchar"] * 3,
+                list_functions())
+            return self._immediate(session, sql, r), extra
+        m = _SHOW_SCHEMAS_RE.match(sql)
+        if m:
+            # single-schema connectors: one "default" schema per catalog
+            cname = m.group(1) or session.catalog or self.catalog.default
+            self.catalog.connectors[cname]  # raise on unknown catalog
+            r = QueryResult(["schema"], ["varchar"], [("default",)])
+            return self._immediate(session, sql, r), extra
+        m = _SHOW_STATS_RE.match(sql)
+        if m:
+            conn, handle = self.catalog.resolve(m.group(1).split("."))
+            rows = []
+            for c in handle.columns:
+                cs = getattr(c, "stats", None)
+                rows.append((
+                    c.name,
+                    str(cs.ndv) if cs and cs.ndv is not None else None,
+                    str(cs.null_fraction) if cs else None,
+                    str(cs.min_value) if cs and cs.min_value is not None else None,
+                    str(cs.max_value) if cs and cs.max_value is not None else None,
+                ))
+            rows.append((None, None, None, None, str(handle.row_count)))
+            r = QueryResult(
+                ["column_name", "distinct_values_count", "nulls_fraction",
+                 "low_value", "high_value"],
+                ["varchar"] * 5, rows)
             return self._immediate(session, sql, r), extra
         m = _EXPLAIN_RE.match(sql)
         if m and self.explain_fn is not None:

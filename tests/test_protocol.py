@@ -178,3 +178,24 @@ def test_query_history_endpoint(cluster):
     with urllib.request.urlopen(f"{cluster.coordinator.url}/v1/cluster") as r:
         stats = json.loads(r.read())
     assert stats["activeWorkers"] == 2
+
+
+def test_show_functions_schemas_stats(cluster):
+    """SHOW FUNCTIONS / SHOW SCHEMAS / SHOW STATS FOR metadata surface."""
+    from presto_tpu.client import execute
+
+    url = cluster.coordinator.url
+    _, rows = execute(url, "show functions")
+    names = {r[0] for r in rows}
+    for fn in ("sum", "transform", "row_number", "approx_percentile",
+               "regexp_like"):
+        assert fn in names
+
+    _, rows = execute(url, "show schemas")
+    assert [r[0] for r in rows] == ["default"]
+
+    _, rows = execute(url, "show stats for nation")
+    cols = {r[0] for r in rows}
+    assert "n_name" in cols and "n_regionkey" in cols
+    # trailing summary row carries the table row count
+    assert rows[-1][0] is None and float(rows[-1][4]) == 25.0
