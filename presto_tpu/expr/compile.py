@@ -106,6 +106,12 @@ _STR_TO_STR = {
     "substr", "upper", "lower", "trim", "ltrim", "rtrim", "replace",
     "reverse", "lpad", "rpad", "concat", "split_part",
     "regexp_extract", "regexp_replace", "json_extract_scalar",
+    # URL / hash / encoding family (operator/scalar/UrlFunctions,
+    # VarbinaryFunctions over utf-8 text) — host dictionary transforms
+    "url_extract_host", "url_extract_path", "url_extract_query",
+    "url_extract_protocol", "url_extract_fragment", "url_encode",
+    "url_decode", "md5", "sha1", "sha256", "sha512", "to_base64",
+    "from_base64", "normalize",
 }
 # string→int functions (code-indexed int lut)
 _STR_TO_INT = {"length", "strpos", "codepoint", "json_array_length",
@@ -145,6 +151,56 @@ def _str_xform_pyfn(fn: str, cargs: tuple):
         return str.upper
     if fn == "lower":
         return str.lower
+    if fn in ("url_extract_host", "url_extract_path", "url_extract_query",
+              "url_extract_protocol", "url_extract_fragment"):
+        from urllib.parse import urlparse
+
+        attr = fn[len("url_extract_"):]
+        attr = {"host": "hostname", "protocol": "scheme"}.get(attr, attr)
+
+        def url_part(s, attr=attr):
+            try:
+                v = getattr(urlparse(s), attr)
+            except ValueError:
+                return None
+            return v if v else None
+
+        return url_part
+    if fn == "url_encode":
+        from urllib.parse import quote_plus
+
+        return lambda s: quote_plus(s)
+    if fn == "url_decode":
+        from urllib.parse import unquote_plus
+
+        return lambda s: unquote_plus(s)
+    if fn in ("md5", "sha1", "sha256", "sha512"):
+        import hashlib as _hl
+
+        algo = fn
+
+        def digest(s, algo=algo):
+            return getattr(_hl, algo)(s.encode()).hexdigest()
+
+        return digest
+    if fn == "to_base64":
+        import base64 as _b64
+
+        return lambda s: _b64.b64encode(s.encode()).decode()
+    if fn == "from_base64":
+        import base64 as _b64
+
+        def fb64(s):
+            try:
+                return _b64.b64decode(s).decode("utf-8", "replace")
+            except Exception:
+                return None
+
+        return fb64
+    if fn == "normalize":
+        import unicodedata as _ud
+
+        return lambda s: _ud.normalize("NFC", s)
     if fn == "trim":
         return str.strip
     if fn == "ltrim":

@@ -525,7 +525,12 @@ class ExprAnalyzer:
         if name in ("substr", "substring"):
             return Call(VARCHAR, "substr", args)
         if name in ("upper", "lower", "trim", "ltrim", "rtrim", "reverse",
-                    "replace", "lpad", "rpad", "split_part"):
+                    "replace", "lpad", "rpad", "split_part",
+                    "url_extract_host", "url_extract_path",
+                    "url_extract_query", "url_extract_protocol",
+                    "url_extract_fragment", "url_encode", "url_decode",
+                    "md5", "sha1", "sha256", "sha512", "to_base64",
+                    "from_base64", "normalize"):
             return Call(VARCHAR, name, args)
         if name == "concat":
             if all(isinstance(a, Constant) for a in args):

@@ -96,9 +96,12 @@ def expr_from_json(d: Dict[str, Any]) -> RowExpression:
     if k == "param":
         return Param(t, d["name"])
     if k == "lambda":
-        return LambdaExpr(
-            t, tuple((s, _untype(ts)) for s, ts in d["params"]),
-            expr_from_json(d["body"]))
+        try:
+            params = tuple((s, _untype(ts)) for s, ts in d["params"])
+            body = expr_from_json(d["body"])
+        except (KeyError, TypeError, ValueError) as e:
+            raise CodecError(f"malformed lambda payload: {e}")
+        return LambdaExpr(t, params, body)
     raise CodecError(f"unknown expression kind {k!r}")
 
 

@@ -23,6 +23,11 @@ _SCALAR = {
                "hamming_distance"],
     "regexp/json": ["regexp_like", "regexp_extract", "regexp_replace",
                     "json_extract_scalar", "json_array_length"],
+    "url": ["url_extract_host", "url_extract_path", "url_extract_query",
+            "url_extract_protocol", "url_extract_fragment", "url_encode",
+            "url_decode"],
+    "binary": ["md5", "sha1", "sha256", "sha512", "to_base64",
+               "from_base64", "normalize"],
     "date": ["year", "month", "day", "quarter", "day_of_week", "dow",
              "day_of_year", "doy", "date_trunc", "date_diff", "date_add",
              "from_unixtime", "to_unixtime"],

@@ -67,6 +67,9 @@ def test_unknown_node_kind_rejected():
 
 def test_unknown_expr_kind_rejected():
     with pytest.raises(CodecError):
+        expr_from_json({"k": "pyobject", "t": "bigint", "payload": "evil"})
+    # known kind, malformed payload: still a codec error, not a crash
+    with pytest.raises(CodecError):
         expr_from_json({"k": "lambda", "t": "bigint", "body": "evil"})
 
 

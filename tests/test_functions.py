@@ -213,3 +213,51 @@ class TestMixedDistinctAggregates:
     def test_two_distinct_columns(self, env):
         self._cmp(env, "select g, count(distinct v) as dv, "
                        "count(distinct w) as dw from t group by g order by g")
+
+
+class TestUrlHashFunctions:
+    @pytest.fixture(scope="class")
+    def runner(self):
+        conn = MemoryConnector()
+        conn.add_table("u", {
+            "id": np.arange(4),
+            "url": np.array([
+                "https://example.com/a/b?x=1#frag",
+                "http://presto.io/docs",
+                "https://example.com/?q=hello%20world",
+                "not a url",
+            ]),
+            "s": np.array(["abc", "hello", "abc", ""]),
+        })
+        cat = Catalog()
+        cat.register("m", conn, default=True)
+        return LocalRunner(cat, ExecConfig())
+
+    def test_url_extract(self, runner):
+        df = runner.run(
+            "select url_extract_host(url) as h, url_extract_path(url) as p, "
+            "url_extract_protocol(url) as pr, url_extract_query(url) as q "
+            "from u order by id")
+        assert df.h[0] == "example.com" and df.h[1] == "presto.io"
+        assert df.p[0] == "/a/b" and df.p[1] == "/docs"
+        assert df.pr[0] == "https"
+        assert df.q[0] == "x=1" and df.q[2] == "q=hello%20world"
+        assert pd.isna(df.h[3])  # no host in a non-URL
+
+    def test_url_codec_roundtrip(self, runner):
+        df = runner.run("select url_decode(url_encode(s)) as r from u "
+                        "order by id")
+        assert df.r[0] == "abc" and df.r[1] == "hello"
+
+    def test_hashes_and_base64(self, runner):
+        import base64
+        import hashlib
+
+        df = runner.run("select md5(s) as m, sha256(s) as h, "
+                        "to_base64(s) as b from u order by id")
+        assert df.m[0] == hashlib.md5(b"abc").hexdigest()
+        assert df.h[1] == hashlib.sha256(b"hello").hexdigest()
+        assert df.b[0] == base64.b64encode(b"abc").decode()
+        df2 = runner.run("select from_base64(to_base64(s)) as r from u "
+                         "order by id")
+        assert df2.r[1] == "hello"
