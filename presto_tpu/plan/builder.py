@@ -858,6 +858,21 @@ class Planner:
                 for (n, s), (_, t) in zip(zip(out.names, out.symbols), out.output)
             ]
             return RelationPlan(out.child, Scope(fields), rows=1e5)
+        if isinstance(rel, ast.ValuesRelation):
+            sub = Planner(self.catalog, self.symbols, self.ctes)
+            qp = sub.plan(rel.query)
+            self.scalar_subqueries.update(sub.scalar_subqueries)
+            out = qp.root
+            names = list(rel.column_names or out.names)
+            if len(names) != len(out.symbols):
+                raise AnalysisError(
+                    f"VALUES alias declares {len(names)} columns, rows "
+                    f"have {len(out.symbols)}")
+            fields = [
+                Field(rel.alias, n, s, t)
+                for (n, s), (_, t) in zip(zip(names, out.symbols), out.output)
+            ]
+            return RelationPlan(out.child, Scope(fields), rows=4.0)
         if isinstance(rel, ast.Join):
             return self.plan_join(rel)
         if isinstance(rel, ast.UnnestRelation):

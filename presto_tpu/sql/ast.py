@@ -182,6 +182,16 @@ class Join(Node):
 
 
 @dataclasses.dataclass
+class ValuesRelation(Node):
+    """(VALUES ...) [AS alias (col, ...)] — `query` is the desugared
+    UNION-ALL-of-one-row-SELECTs body (RelationPlanner.visitValues)."""
+
+    query: Node  # Query | SetOp
+    alias: str = "values"
+    column_names: Optional[list] = None
+
+
+@dataclasses.dataclass
 class UnnestRelation(Node):
     """UNNEST(expr, ...) [WITH ORDINALITY] [AS alias (col, ...)].
 

@@ -27,7 +27,7 @@ _KEYWORDS = {
     "intersect", "except",
     "substring", "for", "over", "partition", "rows", "range", "unbounded",
     "preceding", "following", "current", "row",
-    "create", "insert", "drop", "table", "into", "if",
+    "create", "insert", "drop", "table", "into", "if", "values",
 }
 
 _TOKEN_RE = re.compile(
@@ -394,7 +394,54 @@ class Parser:
             rel = ast.Join(kind, rel, right, cond)
         return rel
 
+    def _parse_values(self) -> ast.Node:
+        """VALUES (e, ...), (e, ...) → desugared UNION ALL of FROM-less
+        SELECTs (planner/RelationPlanner.visitValues without a dedicated
+        node — each row is a one-row projection)."""
+        rows = []
+        while True:
+            if self.accept_op("("):
+                row = [self.parse_expr()]
+                while self.accept_op(","):
+                    row.append(self.parse_expr())
+                self.expect_op(")")
+            else:
+                row = [self.parse_expr()]  # VALUES 1, 2, 3 (single column)
+            rows.append(row)
+            if not self.accept_op(","):
+                break
+        arity = len(rows[0])
+        for r in rows:
+            if len(r) != arity:
+                raise ParseError(
+                    f"VALUES rows differ in arity ({arity} vs {len(r)})")
+
+        def row_query(row):
+            items = [ast.SelectItem(e, f"_col{i}")
+                     for i, e in enumerate(row)]
+            return ast.Query(select=items)
+
+        node = row_query(rows[0])
+        for r in rows[1:]:
+            node = ast.SetOp("union", True, node, row_query(r))
+        return node
+
     def parse_table_primary(self) -> ast.Node:
+        if (self.peek().kind == "keyword" and self.peek().value == "values"):
+            self.next()
+            q = self._parse_values()
+            alias = None
+            if self.accept_kw("as"):
+                alias = self.ident()
+            elif self.peek().kind == "ident":
+                alias = self.ident()
+            cols = None
+            if alias is not None and self.accept_op("("):
+                cols = [self.ident()]
+                while self.accept_op(","):
+                    cols.append(self.ident())
+                self.expect_op(")")
+            return ast.ValuesRelation(q, alias or "values", cols)
         if (self.peek().kind == "ident" and self.peek().value == "unnest"
                 and self.peek(1).kind == "op" and self.peek(1).value == "("):
             self.next()
@@ -421,6 +468,22 @@ class Parser:
                 self.expect_op(")")
             return ast.UnnestRelation(exprs, ordinality, alias, cols)
         if self.accept_op("("):
+            if self.peek().kind == "keyword" and self.peek().value == "values":
+                self.next()
+                q = self._parse_values()
+                self.expect_op(")")
+                alias = None
+                if self.accept_kw("as"):
+                    alias = self.ident()
+                elif self.peek().kind == "ident":
+                    alias = self.ident()
+                cols = None
+                if alias is not None and self.accept_op("("):
+                    cols = [self.ident()]
+                    while self.accept_op(","):
+                        cols.append(self.ident())
+                    self.expect_op(")")
+                return ast.ValuesRelation(q, alias or "values", cols)
             if self.peek().kind == "keyword" and self.peek().value in ("select", "with"):
                 q = self.parse_query()
                 self.expect_op(")")

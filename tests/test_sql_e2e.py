@@ -311,3 +311,37 @@ def test_like_escape(db):
     rr = LocalRunner(cat, ExecConfig(batch_rows=64))
     got = rr.run("select s from t where s like '100!%' escape '!'")
     assert list(got.s) == ["100%"]
+
+
+class TestValues:
+    """VALUES relations (desugared to unions of one-row projections)."""
+
+    @pytest.fixture(scope="class")
+    def r(self):
+        conn = MemoryConnector()
+        conn.add_table("t", {"k": np.arange(5), "v": np.arange(5) * 10.0})
+        cat = Catalog()
+        cat.register("m", conn, default=True)
+        return LocalRunner(cat, ExecConfig())
+
+    def test_from_values(self, r):
+        df = r.run("select * from (values (1, 'a'), (2, 'b'), (3, 'c')) "
+                   "as t(x, s) order by x")
+        assert df.x.tolist() == [1, 2, 3]
+        assert df.s.tolist() == ["a", "b", "c"]
+
+    def test_values_join(self, r):
+        df = r.run("select t.k, names.nm from t "
+                   "join (values (0, 'zero'), (2, 'two'), (4, 'four')) "
+                   "as names(kk, nm) on t.k = names.kk order by t.k")
+        assert df.k.tolist() == [0, 2, 4]
+        assert df.nm.tolist() == ["zero", "two", "four"]
+
+    def test_single_column_values(self, r):
+        df = r.run("select * from (values 5, 3, 9) as v(x) order by x")
+        assert df.x.tolist() == [3, 5, 9]
+
+    def test_values_aggregate(self, r):
+        df = r.run("select sum(x) as s, count(*) as n from "
+                   "(values (1), (2), (3)) as v(x)")
+        assert df.s[0] == 6 and df.n[0] == 3
