@@ -97,6 +97,9 @@ def main(argv=None):
     p.add_argument("--session-properties", default=None,
                    help="(coordinator) JSON rules file of session property "
                         "defaults matched by user/source regex")
+    p.add_argument("--query-event-log", default=None,
+                   help="(coordinator) append query-completion events as "
+                        "JSON lines to this file (EventListener analog)")
     args = p.parse_args(argv)
 
     if args.platform:
@@ -128,6 +131,7 @@ def main(argv=None):
             cluster_secret=args.secret,
             authenticator=authenticator,
             session_property_manager=spm,
+            query_event_log=args.query_event_log,
         )
         print(f"coordinator listening on {coord.url}", flush=True)
         stop = []

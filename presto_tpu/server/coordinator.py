@@ -315,7 +315,8 @@ class Coordinator:
                  config: Optional[ExecConfig] = None, min_workers: int = 1,
                  broadcast_threshold_rows: float = 1_000_000,
                  cluster_secret: Optional[str] = None,
-                 authenticator=None, session_property_manager=None):
+                 authenticator=None, session_property_manager=None,
+                 query_event_log: Optional[str] = None):
         from presto_tpu.server.protocol import StatementProtocol
         from presto_tpu.server.querymanager import (
             QueryManager,
@@ -342,6 +343,22 @@ class Coordinator:
             return batch_to_result(self.run_batch(sql, cfg, session))
 
         self.query_manager = QueryManager(execute_fn)
+        if query_event_log:
+            # query-completion audit stream (reference: the EventListener
+            # SPI's QueryCompletedEvent, commonly shipped to an audit log)
+            import dataclasses as _dc
+
+            self._event_log_lock = threading.Lock()
+
+            def log_event(event: str, info, path=query_event_log):
+                rec = {"event": event, "ts": time.time(),
+                       **_dc.asdict(info)}
+                line = json.dumps(rec, default=str)
+                with self._event_log_lock:
+                    with open(path, "a") as fh:
+                        fh.write(line + "\n")
+
+            self.query_manager.listeners.append(log_event)
         # bind the socket first (determines self.url), wire the protocol,
         # THEN start serving — no request can observe a half-built coordinator
         self._bind_http(port)

@@ -161,3 +161,44 @@ class TestHttpSurface:
             html = r.read().decode()
         assert "presto-tpu coordinator" in html
         assert "w0" in html
+
+
+def test_query_event_log(tmp_path):
+    """Query-completion events append to the JSONL audit stream
+    (EventListener / QueryCompletedEvent analog)."""
+    import json
+    import time
+
+    from presto_tpu.server.coordinator import Coordinator
+    from presto_tpu.server.worker import Worker
+
+    log = str(tmp_path / "events.jsonl")
+    coord = Coordinator(_catalog(), min_workers=1, query_event_log=log)
+    w = Worker(coord.catalog, node_id="w0", coordinator_url=coord.url)
+    try:
+        deadline = time.time() + 10
+        while time.time() < deadline and not coord.node_manager.active_nodes():
+            time.sleep(0.05)
+        qe = coord.query_manager.create_query(
+            coord.protocol.session_from_headers({}),
+            "select count(*) as n from t")
+        qe.wait(30)
+        deadline = time.time() + 5
+        events = []
+        while time.time() < deadline:
+            try:
+                with open(log) as fh:
+                    events = [json.loads(l) for l in fh]
+                if events:
+                    break
+            except FileNotFoundError:
+                pass
+            time.sleep(0.1)
+        assert events, "no events logged"
+        ev = events[-1]
+        assert ev["event"] == "queryCompleted"
+        assert ev["state"] in ("FINISHED", "FAILED")
+        assert "select count(*)" in ev["sql"]
+    finally:
+        w.close()
+        coord.close()
