@@ -42,6 +42,11 @@ _SHOW_CATALOGS_RE = re.compile(r"^\s*show\s+catalogs\s*$", re.I)
 _SHOW_COLUMNS_RE = re.compile(
     r"^\s*(?:show\s+columns\s+from|describe)\s+([\w.]+)\s*$", re.I
 )
+_PREPARE_RE = re.compile(r"^\s*prepare\s+(\w+)\s+from\s+(.+)$",
+                         re.I | re.S)
+_EXECUTE_RE = re.compile(r"^\s*execute\s+(\w+)(?:\s+using\s+(.+))?\s*$",
+                         re.I | re.S)
+_DEALLOCATE_RE = re.compile(r"^\s*deallocate\s+prepare\s+(\w+)\s*$", re.I)
 _SHOW_FUNCTIONS_RE = re.compile(r"^\s*show\s+functions\s*$", re.I)
 _SHOW_SCHEMAS_RE = re.compile(
     r"^\s*show\s+schemas(?:\s+from\s+([\w.]+))?\s*$", re.I)
@@ -79,6 +84,75 @@ def result_rows_json(result: QueryResult) -> List[List[Any]]:
     ]
 
 
+def _stub_placeholders(body: str) -> str:
+    """`?` outside string literals → null (parse-probe form)."""
+    out = []
+    in_str = False
+    for ch in body:
+        if ch == "'":
+            in_str = not in_str
+        if ch == "?" and not in_str:
+            out.append("null")
+        else:
+            out.append(ch)
+    return "".join(out)
+
+
+def _bind_parameters(body: str, using: str | None) -> str:
+    """Substitute `?` placeholders with EXECUTE ... USING literals.
+    The literals are parsed as expressions first (no raw-text injection:
+    anything that doesn't parse as a literal/expression list is
+    rejected), then spliced positionally outside string literals."""
+    args: list = []
+    if using:
+        from presto_tpu.sql.parser import Parser
+
+        p = Parser(f"select {using}")
+        q = p.parse_statement()
+        args = [item.expr for item in q.select]
+        # re-render each literal from its parsed form
+        from presto_tpu.sql import ast as _ast
+
+        def render(e) -> str:
+            if isinstance(e, _ast.Literal):
+                if e.value is None:
+                    return "null"
+                if e.kind == "string":
+                    return "'" + str(e.value).replace("'", "''") + "'"
+                if e.kind == "date":
+                    return f"date '{e.value}'"
+                return str(e.text if e.text is not None else e.value)
+            if isinstance(e, _ast.UnaryOp) and e.op == "-":
+                return "-" + render(e.operand)
+            raise ValueError(
+                "EXECUTE ... USING accepts literals only")
+
+        args = [render(a) for a in args]
+    out = []
+    i = 0
+    argi = 0
+    in_str = False
+    while i < len(body):
+        ch = body[i]
+        if ch == "'":
+            in_str = not in_str
+            out.append(ch)
+        elif ch == "?" and not in_str:
+            if argi >= len(args):
+                raise ValueError(
+                    f"query needs more than {len(args)} parameters")
+            out.append(args[argi])
+            argi += 1
+        else:
+            out.append(ch)
+        i += 1
+    if argi != len(args):
+        raise ValueError(
+            f"too many parameters: query has {argi} placeholders, "
+            f"USING supplies {len(args)}")
+    return "".join(out)
+
+
 class StatementProtocol:
     """Stateless request handlers; mounted on the coordinator HTTP server."""
 
@@ -94,6 +168,11 @@ class StatementProtocol:
         # authentication + rule-matched session property defaults
         self.authenticator = authenticator
         self.session_property_manager = session_property_manager
+        # prepared statements keyed by (user, name). The reference keeps
+        # them client-side in X-Presto-Prepared-Statement headers; a
+        # server-side registry serves the same PREPARE/EXECUTE surface
+        # for header-less clients.
+        self._prepared: Dict[tuple, str] = {}
 
     # -- session from headers ---------------------------------------------
 
@@ -178,6 +257,30 @@ class StatementProtocol:
                 ["column", "type"], ["varchar", "varchar"],
                 [(c.name, str(c.type)) for c in handle.columns])
             return self._immediate(session, sql, r), extra
+        m = _PREPARE_RE.match(sql)
+        if m:
+            name, body = m.group(1).lower(), m.group(2).strip()
+            from presto_tpu.sql.parser import parse_sql
+
+            # validate at prepare time with placeholders stubbed to null
+            parse_sql(_stub_placeholders(body))
+            self._prepared[(session.user, name)] = body
+            extra["X-Presto-Added-Prepare"] = name
+            return self._immediate(session, sql, QueryResult([], [], [])), extra
+        m = _DEALLOCATE_RE.match(sql)
+        if m:
+            self._prepared.pop((session.user, m.group(1).lower()), None)
+            extra["X-Presto-Deallocated-Prepare"] = m.group(1).lower()
+            return self._immediate(session, sql, QueryResult([], [], [])), extra
+        m = _EXECUTE_RE.match(sql)
+        if m:
+            name = m.group(1).lower()
+            body = self._prepared.get((session.user, name))
+            if body is None:
+                raise KeyError(f"prepared statement not found: {name}")
+            bound = _bind_parameters(body, m.group(2))
+            qe = self.qm.create_query(session, bound)
+            return self._results(qe, 0), extra
         m = _SHOW_FUNCTIONS_RE.match(sql)
         if m:
             from presto_tpu.server.functions import list_functions

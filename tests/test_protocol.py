@@ -199,3 +199,33 @@ def test_show_functions_schemas_stats(cluster):
     assert "n_name" in cols and "n_regionkey" in cols
     # trailing summary row carries the table row count
     assert rows[-1][0] is None and float(rows[-1][4]) == 25.0
+
+
+def test_prepared_statements(cluster):
+    """PREPARE / EXECUTE ... USING / DEALLOCATE PREPARE with ?
+    parameters (reference: prepared-statement protocol surface)."""
+    from presto_tpu.client import QueryError, execute
+
+    url = cluster.coordinator.url
+    execute(url, "prepare region_nations from "
+                 "select n_name from nation where n_regionkey = ? "
+                 "order by n_name")
+    _, rows = execute(url, "execute region_nations using 1")
+    assert len(rows) == 5
+    _, rows2 = execute(url, "execute region_nations using 2")
+    assert len(rows2) == 5 and rows2 != rows
+
+    # string parameter + arity errors
+    execute(url, "prepare one_nation from "
+                 "select n_regionkey from nation where n_name = ?")
+    _, r3 = execute(url, "execute one_nation using 'CANADA'")
+    assert len(r3) == 1
+
+    with pytest.raises(QueryError):
+        execute(url, "execute region_nations using 1, 2")  # too many
+    with pytest.raises(QueryError):
+        execute(url, "execute region_nations")  # too few
+
+    execute(url, "deallocate prepare region_nations")
+    with pytest.raises(QueryError):
+        execute(url, "execute region_nations using 1")
