@@ -761,6 +761,10 @@ class ExprAnalyzer:
             return Call(out, "concat", args)
         return None
 
+    def _an_Parameter(self, node: "ast.Parameter") -> RowExpression:
+        raise AnalysisError(
+            "unbound prepared-statement parameter (use EXECUTE ... USING)")
+
     def _an_ScalarSubquery(self, node: ast.ScalarSubquery) -> RowExpression:
         return self.planner.plan_scalar_subquery(node.query)
 
@@ -787,6 +791,20 @@ def _add_months_days(days: int, months: int) -> int:
 
 # ---------------------------------------------------------------------------
 # conjunct utilities
+
+
+def _resolve_limit(limit) -> Optional[int]:
+    """LIMIT is an int after parsing, or an AST node when it came from a
+    bound (or unbound) prepared-statement parameter."""
+    if limit is None or isinstance(limit, int):
+        return limit
+    if isinstance(limit, ast.Literal) and limit.kind == "integer":
+        return int(limit.value)
+    if isinstance(limit, ast.Parameter):
+        raise AnalysisError(
+            "unbound prepared-statement parameter in LIMIT "
+            "(use EXECUTE ... USING)")
+    raise AnalysisError("LIMIT must be an integer")
 
 
 def split_conjuncts(e) -> List:
@@ -1087,6 +1105,7 @@ class Planner:
     def plan(self, q) -> QueryPlan:
         if isinstance(q, ast.SetOp):
             return self.plan_setop(q)
+        q = dataclasses.replace(q, limit=_resolve_limit(q.limit))
         ctes = dict(self.ctes)
         for name, sub in q.ctes:
             ctes[name] = sub

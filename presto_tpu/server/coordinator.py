@@ -368,6 +368,11 @@ class Coordinator:
             authenticator=authenticator,
             session_property_manager=session_property_manager,
         )
+        from presto_tpu.server.querymanager import batch_to_result as _btr
+
+        self.protocol.execute_stmt_fn = (
+            lambda session, stmt: _btr(self.run_batch(
+                "", session.exec_config(), session, stmt=stmt)))
         threading.Thread(target=self._http.serve_forever, daemon=True,
                          name="coordinator-http").start()
         self.failure_detector.start()
@@ -620,12 +625,12 @@ class Coordinator:
             "PARTITIONED": 0.0,
         }.get(jdt, self.broadcast_threshold_rows)
         cache_key = (sql, jdt)
-        hit = self._dplan_cache.get(cache_key)
+        hit = self._dplan_cache.get(cache_key) if sql else None
         if hit is not None:
             return hit
         qp = optimize(plan_query(stmt if stmt is not None else sql,
                                  self.catalog))
-        cacheable = not qp.scalar_subqueries
+        cacheable = bool(sql) and not qp.scalar_subqueries
         if qp.scalar_subqueries:
             # bind uncorrelated scalar subqueries coordinator-side first
             # (the reference runs them as separate plan stages)
@@ -648,7 +653,9 @@ class Coordinator:
         return dplan
 
     def run_batch(self, sql: str, config: Optional[ExecConfig] = None,
-                  session=None) -> Batch:
+                  session=None, stmt=None) -> Batch:
+        """`stmt` overrides parsing — the bound AST of a prepared
+        statement (EXECUTE path; no SQL re-rendering)."""
         import jax.numpy as jnp
 
         from presto_tpu.batch import Column
@@ -656,10 +663,11 @@ class Coordinator:
         from presto_tpu.sql import ast as _ast
         from presto_tpu.sql.parser import parse_sql
 
-        # cached distributed plans are never DDL — skip the parse probe
-        # (O(1) membership; the parsed stmt is reused by plan_distributed)
-        cached = sql in self._cached_sqls
-        stmt = None if cached else parse_sql(sql)
+        if stmt is None:
+            # cached distributed plans are never DDL — skip the parse probe
+            # (O(1) membership; the parsed stmt is reused by plan_distributed)
+            cached = sql in self._cached_sqls
+            stmt = None if cached else parse_sql(sql)
         if isinstance(stmt, (_ast.CreateTableAs, _ast.Insert, _ast.DropTable)):
             # DDL/DML executes coordinator-side; the source query still runs
             # distributed (reference: DataDefinitionExecution on the

@@ -84,73 +84,48 @@ def result_rows_json(result: QueryResult) -> List[List[Any]]:
     ]
 
 
-def _stub_placeholders(body: str) -> str:
-    """`?` outside string literals → null (parse-probe form)."""
-    out = []
-    in_str = False
-    for ch in body:
-        if ch == "'":
-            in_str = not in_str
-        if ch == "?" and not in_str:
-            out.append("null")
-        else:
-            out.append(ch)
-    return "".join(out)
+def _parse_using_args(using: str | None) -> list:
+    """EXECUTE ... USING literal list → AST expressions (parsed by the
+    real lexer/parser; no raw-text handling anywhere). Only literal-like
+    forms are accepted — parameters carry values, not expressions over
+    the query's scope."""
+    if not using:
+        return []
+    from presto_tpu.sql import ast as _ast
+    from presto_tpu.sql.parser import Parser
+
+    q = Parser(f"select {using}").parse_statement()
+    args = [item.expr for item in q.select]
+
+    def literal_like(e) -> bool:
+        if isinstance(e, (_ast.Literal, _ast.IntervalLiteral)):
+            return True
+        if isinstance(e, _ast.UnaryOp) and e.op == "-":
+            return literal_like(e.operand)
+        if isinstance(e, _ast.Cast):
+            return literal_like(e.expr)
+        return False
+
+    for a in args:
+        if not literal_like(a):
+            raise ValueError("EXECUTE ... USING accepts literals only")
+    return args
 
 
-def _bind_parameters(body: str, using: str | None) -> str:
-    """Substitute `?` placeholders with EXECUTE ... USING literals.
-    The literals are parsed as expressions first (no raw-text injection:
-    anything that doesn't parse as a literal/expression list is
-    rejected), then spliced positionally outside string literals."""
-    args: list = []
-    if using:
-        from presto_tpu.sql.parser import Parser
+def _bind_statement(body: str, using: str | None):
+    """Parse the prepared body (the lexer knows `?`) and bind parameters
+    on the AST positionally."""
+    from presto_tpu.sql import ast as _ast
+    from presto_tpu.sql.parser import parse_sql
 
-        p = Parser(f"select {using}")
-        q = p.parse_statement()
-        args = [item.expr for item in q.select]
-        # re-render each literal from its parsed form
-        from presto_tpu.sql import ast as _ast
-
-        def render(e) -> str:
-            if isinstance(e, _ast.Literal):
-                if e.value is None:
-                    return "null"
-                if e.kind == "string":
-                    return "'" + str(e.value).replace("'", "''") + "'"
-                if e.kind == "date":
-                    return f"date '{e.value}'"
-                return str(e.text if e.text is not None else e.value)
-            if isinstance(e, _ast.UnaryOp) and e.op == "-":
-                return "-" + render(e.operand)
-            raise ValueError(
-                "EXECUTE ... USING accepts literals only")
-
-        args = [render(a) for a in args]
-    out = []
-    i = 0
-    argi = 0
-    in_str = False
-    while i < len(body):
-        ch = body[i]
-        if ch == "'":
-            in_str = not in_str
-            out.append(ch)
-        elif ch == "?" and not in_str:
-            if argi >= len(args):
-                raise ValueError(
-                    f"query needs more than {len(args)} parameters")
-            out.append(args[argi])
-            argi += 1
-        else:
-            out.append(ch)
-        i += 1
-    if argi != len(args):
+    args = _parse_using_args(using)
+    stmt = parse_sql(body)
+    bound, n_params = _ast.substitute_parameters(stmt, args)
+    if n_params != len(args):
         raise ValueError(
-            f"too many parameters: query has {argi} placeholders, "
+            f"prepared statement has {n_params} parameters, "
             f"USING supplies {len(args)}")
-    return "".join(out)
+    return bound
 
 
 class StatementProtocol:
@@ -168,11 +143,16 @@ class StatementProtocol:
         # authentication + rule-matched session property defaults
         self.authenticator = authenticator
         self.session_property_manager = session_property_manager
-        # prepared statements keyed by (user, name). The reference keeps
-        # them client-side in X-Presto-Prepared-Statement headers; a
-        # server-side registry serves the same PREPARE/EXECUTE surface
-        # for header-less clients.
+        # prepared statements keyed by (user, name) — a deliberate
+        # statefulness deviation: the reference round-trips them in
+        # X-Presto-Prepared-Statement headers; this registry serves the
+        # same PREPARE/EXECUTE surface for header-less clients, bounded
+        # per user (insertion-ordered dict → oldest evicts)
         self._prepared: Dict[tuple, str] = {}
+        self.max_prepared_per_user = 64
+        # (session, bound_stmt_ast) -> QueryResult; wired by the
+        # coordinator so EXECUTE runs the bound AST without re-rendering
+        self.execute_stmt_fn = None
 
     # -- session from headers ---------------------------------------------
 
@@ -262,9 +242,14 @@ class StatementProtocol:
             name, body = m.group(1).lower(), m.group(2).strip()
             from presto_tpu.sql.parser import parse_sql
 
-            # validate at prepare time with placeholders stubbed to null
-            parse_sql(_stub_placeholders(body))
-            self._prepared[(session.user, name)] = body
+            parse_sql(body)  # the lexer/parser know `?` — real validation
+            key = (session.user, name)
+            self._prepared.pop(key, None)
+            self._prepared[key] = body
+            # bounded per-user registry (oldest-prepared evicts)
+            mine = [k for k in self._prepared if k[0] == session.user]
+            while len(mine) > self.max_prepared_per_user:
+                self._prepared.pop(mine.pop(0), None)
             extra["X-Presto-Added-Prepare"] = name
             return self._immediate(session, sql, QueryResult([], [], [])), extra
         m = _DEALLOCATE_RE.match(sql)
@@ -278,8 +263,13 @@ class StatementProtocol:
             body = self._prepared.get((session.user, name))
             if body is None:
                 raise KeyError(f"prepared statement not found: {name}")
-            bound = _bind_parameters(body, m.group(2))
-            qe = self.qm.create_query(session, bound)
+            bound = _bind_statement(body, m.group(2))
+            if self.execute_stmt_fn is None:
+                raise RuntimeError("EXECUTE not supported on this server")
+            qe = self.qm.create_query(
+                session, sql,
+                execute_fn=lambda s, q, stmt=bound:
+                    self.execute_stmt_fn(s, stmt))
             return self._results(qe, 0), extra
         m = _SHOW_FUNCTIONS_RE.match(sql)
         if m:

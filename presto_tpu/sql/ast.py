@@ -125,6 +125,45 @@ class WindowFunction(Node):
 
 
 @dataclasses.dataclass
+class Parameter(Node):
+    """`?` prepared-statement placeholder (bound before analysis by
+    substitute_parameters; an unbound Parameter is an analysis error)."""
+
+    index: int
+
+
+def substitute_parameters(node, args: list):
+    """Replace every ast.Parameter with its positional argument AST
+    (generic dataclass walk — binding happens on the parse tree, never
+    by text splicing). Returns (new_node, n_params_seen)."""
+    seen = [0]
+
+    def walk(x):
+        if isinstance(x, Parameter):
+            seen[0] = max(seen[0], x.index + 1)
+            if x.index < len(args):
+                return args[x.index]
+            return x
+        if isinstance(x, Node):
+            changes = {}
+            for f in dataclasses.fields(x):
+                v = getattr(x, f.name)
+                nv = walk(v)
+                if nv is not v:
+                    changes[f.name] = nv
+            return dataclasses.replace(x, **changes) if changes else x
+        if isinstance(x, list):
+            out = [walk(v) for v in x]
+            return out if any(a is not b for a, b in zip(out, x)) else x
+        if isinstance(x, tuple):
+            out = tuple(walk(v) for v in x)
+            return out if any(a is not b for a, b in zip(out, x)) else x
+        return x
+
+    return walk(node), seen[0]
+
+
+@dataclasses.dataclass
 class Lambda(Node):
     """`x -> body` / `(a, b) -> body` — argument to higher-order array
     functions (SqlBase.g4 lambda; spi/function/LambdaDefinitionExpression)."""

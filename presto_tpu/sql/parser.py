@@ -37,7 +37,7 @@ _TOKEN_RE = re.compile(
   | (?P<string>'(?:[^']|'')*')
   | (?P<qident>"(?:[^"]|"")*")
   | (?P<ident>[A-Za-z_][A-Za-z0-9_$]*)
-  | (?P<op><>|!=|>=|<=|->|\|\||[-+*/%(),.<>=;\[\]])
+  | (?P<op><>|!=|>=|<=|->|\|\||[-+*/%(),.<>=;\[\]?])
     """,
     re.VERBOSE | re.DOTALL,
 )
@@ -311,9 +311,13 @@ class Parser:
         limit = None
         if self.accept_kw("limit"):
             t = self.next()
-            if t.kind != "number":
+            if t.kind == "op" and t.value == "?":
+                self._param_count = getattr(self, "_param_count", 0) + 1
+                limit = ast.Parameter(self._param_count - 1)
+            elif t.kind != "number":
                 raise ParseError("LIMIT expects a number")
-            limit = int(t.value)
+            else:
+                limit = int(t.value)
         return ast.Query(
             select=select, distinct=distinct, from_=from_, where=where,
             group_by=group_by, having=having, order_by=order_by, limit=limit,
@@ -636,6 +640,11 @@ class Parser:
 
     def parse_primary(self) -> ast.Node:
         t = self.peek()
+        if t.kind == "op" and t.value == "?":
+            # prepared-statement parameter, bound at EXECUTE time
+            self.next()
+            self._param_count = getattr(self, "_param_count", 0) + 1
+            return ast.Parameter(self._param_count - 1)
         # literals
         if t.kind == "number":
             self.next()

@@ -229,3 +229,28 @@ def test_prepared_statements(cluster):
     execute(url, "deallocate prepare region_nations")
     with pytest.raises(QueryError):
         execute(url, "execute region_nations using 1")
+
+
+def test_prepared_statement_edge_cases(cluster):
+    """Booleans bind correctly (AST-level, no text rendering), comments
+    containing ? or ' don't desync binding, and LIMIT ? works."""
+    from presto_tpu.client import execute
+
+    url = cluster.coordinator.url
+    execute(url, "prepare commented from "
+                 "select n_name from nation -- what's region ?\n"
+                 "where n_regionkey = ? order by n_name")
+    _, rows = execute(url, "execute commented using 0")
+    assert len(rows) == 5
+
+    execute(url, "prepare limited from "
+                 "select n_name from nation order by n_name limit ?")
+    _, rows = execute(url, "execute limited using 3")
+    assert len(rows) == 3
+
+    execute(url, "prepare boolean_param from "
+                 "select count(*) as c from nation where ? ")
+    _, rows = execute(url, "execute boolean_param using true")
+    assert rows[0][0] == 25
+    _, rows = execute(url, "execute boolean_param using false")
+    assert rows[0][0] == 0
