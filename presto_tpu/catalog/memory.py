@@ -501,6 +501,35 @@ class MemoryConnector(DeviceSplitCache, Connector):
         del self.tables[name]
         self.invalidate_cache(name)
 
+    def create_empty(self, name: str, cols, if_not_exists: bool = False):
+        """CREATE TABLE name (schema) — zero rows, explicit types."""
+        if name in self.tables:
+            if if_not_exists:
+                return
+            raise ValueError(f"table already exists: {name}")
+        data = {
+            c: (np.array([], dtype=object) if t.is_string
+                else np.zeros(0, dtype=t.dtype))
+            for c, t in cols
+        }
+        self.tables[name] = MemoryTable(name, data, dict(cols))
+        self.invalidate_cache(name)
+
+    def truncate_table(self, name: str):
+        mt = self.tables.get(name)
+        if mt is None:
+            raise KeyError(f"table not found: {name}")
+        cols = list(mt.types.items())
+        del self.tables[name]
+        self.create_empty(name, cols)
+
+    def replace_table_from(self, name: str, batches) -> int:
+        """DELETE-rewrite target: swap the table for the surviving rows."""
+        if name not in self.tables:
+            raise KeyError(f"table not found: {name}")
+        del self.tables[name]
+        return self.create_table_from(name, batches)
+
     def _read_split_uncached(self, split: Split, columns: Sequence[str],
                              capacity: Optional[int] = None) -> Batch:
         t = self.tables[split.table]

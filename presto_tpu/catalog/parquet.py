@@ -415,6 +415,32 @@ class ParquetConnector(DeviceSplitCache, Connector):
         os.remove(path)
         self._invalidate_table(name)
 
+    def create_empty(self, name: str, cols, if_not_exists: bool = False):
+        """CREATE TABLE name (schema): a zero-row file carrying the
+        schema (decimal SQL types ride field metadata as usual)."""
+        path = os.path.join(self.directory, f"{name}.parquet")
+        if os.path.exists(path):
+            if if_not_exists:
+                return
+            raise ValueError(f"table already exists: {name}")
+        data = {c: np.zeros(0, dtype=t.dtype) for c, t in cols}
+        arrays, schema = _to_arrow_columns(data, dict(cols), {})
+        pq.write_table(pa.Table.from_arrays(arrays, schema=schema),
+                       path + ".tmp")
+        os.replace(path + ".tmp", path)
+        self._invalidate_table(name)
+
+    def truncate_table(self, name: str):
+        t = self._load(name)
+        cols = [(c.name, c.type) for c in t.handle.columns]
+        self.drop_table(name)
+        self.create_empty(name, cols)
+
+    def replace_table_from(self, name: str, batches) -> int:
+        self._load(name)  # existence check
+        self.drop_table(name)
+        return self.create_table_from(name, batches)
+
     def read_split(self, split: Split, columns: Sequence[str],
                    capacity: Optional[int] = None) -> Batch:
         self._check_fresh(split.table)

@@ -109,6 +109,10 @@ class Catalog:
     def __init__(self):
         self.connectors: Dict[str, Connector] = {}
         self.default: Optional[str] = None
+        # engine-level views: name -> stored query AST, expanded at plan
+        # time like CTEs (reference: view definitions in connector
+        # metadata; engine-level is the deliberate simplification)
+        self.views: Dict[str, object] = {}
 
     def register(self, name: str, connector: Connector, default: bool = False):
         connector.name = name  # the registered name is authoritative

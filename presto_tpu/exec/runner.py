@@ -17,6 +17,24 @@ from presto_tpu.plan.nodes import QueryPlan, plan_to_string
 from presto_tpu.plan.optimizer import optimize
 
 
+def _ddl_nodes():
+    from presto_tpu.sql import ast as _ast
+
+    return (_ast.CreateTableAs, _ast.Insert, _ast.DropTable,
+            _ast.CreateTable, _ast.CreateView, _ast.DropView,
+            _ast.Delete, _ast.Truncate)
+
+
+_DDL_NODES = None  # populated lazily (ast import cycle safety)
+
+
+def is_ddl(stmt) -> bool:
+    global _DDL_NODES
+    if _DDL_NODES is None:
+        _DDL_NODES = _ddl_nodes()
+    return isinstance(stmt, _DDL_NODES)
+
+
 def execute_data_definition(stmt, catalog: Catalog, run_query_fn):
     """CTAS / INSERT / DROP executed engine-side (reference: the ~35
     execution/*Task.java DDL classes + the TableWriter → TableFinish
@@ -37,10 +55,49 @@ def execute_data_definition(stmt, catalog: Catalog, run_query_fn):
         return Batch(["rows"], [BIGINT],
                      [Column(jnp.asarray(vals), None)], jnp.asarray(live), {})
 
+    if isinstance(stmt, _ast.CreateView):
+        name = stmt.name[-1]
+        if name in catalog.views and not stmt.or_replace:
+            raise ValueError(f"view already exists: {name}")
+        catalog.views[name] = stmt.query
+        return _count_batch(0)
+    if isinstance(stmt, _ast.DropView):
+        if stmt.name[-1] not in catalog.views and not stmt.if_exists:
+            raise KeyError(f"view not found: {stmt.name[-1]}")
+        catalog.views.pop(stmt.name[-1], None)
+        return _count_batch(0)
+
     conn, tname = catalog.connector_for(stmt.name)
     if isinstance(stmt, _ast.DropTable):
         conn.drop_table(tname, if_exists=stmt.if_exists)
         return _count_batch(0)
+    if isinstance(stmt, _ast.CreateTable):
+        from presto_tpu.types import parse_type
+
+        cols = [(c, parse_type(t)) for c, t in stmt.columns]
+        conn.create_empty(tname, cols, if_not_exists=stmt.if_not_exists)
+        return _count_batch(0)
+    if isinstance(stmt, _ast.Truncate):
+        before = int(conn.get_table(tname).row_count or 0)
+        conn.truncate_table(tname)
+        return _count_batch(before)
+    if isinstance(stmt, _ast.Delete):
+        # rewrite: keep the rows where the predicate is NOT TRUE
+        # (DeleteNode → connector rewrite; NULL predicates keep the row)
+        before = int(conn.get_table(tname).row_count or 0)
+        if stmt.where is None:
+            conn.truncate_table(tname)
+            return _count_batch(before)
+        keep = _ast.UnaryOp("not", _ast.FunctionCall(
+            "coalesce", [stmt.where, _ast.Literal(False, "boolean")]))
+        q = _ast.Query(
+            select=[_ast.SelectItem(_ast.Star(), None)],
+            from_=_ast.Table(stmt.name), where=keep)
+        remaining = run_query_fn(q)
+        conn.replace_table_from(tname, [remaining])
+        after = int(conn.get_table(tname).row_count or 0)
+        return _count_batch(before - after)
+
     result = run_query_fn(stmt.query)
     if isinstance(stmt, _ast.CreateTableAs):
         n = conn.create_table_from(tname, [result],
@@ -80,8 +137,7 @@ class LocalRunner:
         qp = self._plan_cache.get(sql)  # cached plans are never DDL
         if qp is None:
             stmt = parse_sql(sql)
-            if isinstance(stmt, (_ast.CreateTableAs, _ast.Insert,
-                                 _ast.DropTable)):
+            if is_ddl(stmt):
                 return execute_data_definition(stmt, self.catalog,
                                                self._run_query_ast)
             qp = optimize(plan_query(stmt, self.catalog))

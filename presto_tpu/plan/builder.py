@@ -839,6 +839,19 @@ class Planner:
     def plan_relation(self, rel) -> RelationPlan:
         if isinstance(rel, ast.Table):
             name = rel.name[-1]
+            if len(rel.name) == 1 and name not in self.ctes and (
+                    name in self.catalog.views):
+                # view expansion: plan the stored query like a subquery
+                sub = Planner(self.catalog, self.symbols)
+                qp = sub.plan(self.catalog.views[name])
+                self.scalar_subqueries.update(sub.scalar_subqueries)
+                out = qp.root
+                fields = [
+                    Field(rel.alias or name, n, s, t)
+                    for (n, s), (_, t) in zip(zip(out.names, out.symbols),
+                                              out.output)
+                ]
+                return RelationPlan(out.child, Scope(fields), rows=1e5)
             if len(rel.name) == 1 and name in self.ctes:
                 sub = Planner(self.catalog, self.symbols, self.ctes)
                 qp = sub.plan(self.ctes[name])

@@ -668,7 +668,9 @@ class Coordinator:
             # (O(1) membership; the parsed stmt is reused by plan_distributed)
             cached = sql in self._cached_sqls
             stmt = None if cached else parse_sql(sql)
-        if isinstance(stmt, (_ast.CreateTableAs, _ast.Insert, _ast.DropTable)):
+        from presto_tpu.exec.runner import is_ddl
+
+        if stmt is not None and is_ddl(stmt):
             # DDL/DML executes coordinator-side; the source query still runs
             # distributed (reference: DataDefinitionExecution on the
             # coordinator + a distributed TableWriter source)

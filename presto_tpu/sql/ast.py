@@ -294,6 +294,47 @@ class Insert(Node):
 
 
 @dataclasses.dataclass
+class CreateTable(Node):
+    """CREATE TABLE name (col type, ...) — empty table with an explicit
+    schema (execution/CreateTableTask without the AS-query source)."""
+
+    name: Tuple[str, ...]
+    columns: list  # [(name, type_string)]
+    if_not_exists: bool = False
+
+
+@dataclasses.dataclass
+class CreateView(Node):
+    """CREATE [OR REPLACE] VIEW name AS query — stored-query expansion at
+    plan time (execution/CreateViewTask; views are engine-level here, not
+    connector metadata)."""
+
+    name: Tuple[str, ...]
+    query: Node
+    or_replace: bool = False
+
+
+@dataclasses.dataclass
+class DropView(Node):
+    name: Tuple[str, ...]
+    if_exists: bool = False
+
+
+@dataclasses.dataclass
+class Delete(Node):
+    """DELETE FROM name [WHERE cond] — rewrite-based (kept rows are those
+    where the predicate is not TRUE)."""
+
+    name: Tuple[str, ...]
+    where: Optional[Node] = None
+
+
+@dataclasses.dataclass
+class Truncate(Node):
+    name: Tuple[str, ...]
+
+
+@dataclasses.dataclass
 class DropTable(Node):
     name: Tuple[str, ...]
     if_exists: bool = False

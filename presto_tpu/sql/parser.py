@@ -28,6 +28,7 @@ _KEYWORDS = {
     "substring", "for", "over", "partition", "rows", "range", "unbounded",
     "preceding", "following", "current", "row",
     "create", "insert", "drop", "table", "into", "if", "values",
+    "view", "replace", "delete", "truncate",
 }
 
 _TOKEN_RE = re.compile(
@@ -145,6 +146,18 @@ class Parser:
             q = self._parse_insert()
         elif t.kind == "keyword" and t.value == "drop":
             q = self._parse_drop()
+        elif t.kind == "keyword" and t.value == "delete":
+            self.next()
+            self.expect_kw("from")
+            name = self._qualified_name()
+            where = None
+            if self.accept_kw("where"):
+                where = self.parse_expr()
+            q = ast.Delete(name, where)
+        elif t.kind == "keyword" and t.value == "truncate":
+            self.next()
+            self.expect_kw("table")
+            q = ast.Truncate(self._qualified_name())
         else:
             q = self.parse_query()
         self.accept_op(";")
@@ -160,6 +173,16 @@ class Parser:
 
     def _parse_create(self) -> ast.Node:
         self.expect_kw("create")
+        or_replace = False
+        if self.accept_kw("or"):
+            self.expect_kw("replace")
+            or_replace = True
+        if self.accept_kw("view"):
+            name = self._qualified_name()
+            self.expect_kw("as")
+            return ast.CreateView(name, self.parse_query(), or_replace)
+        if or_replace:
+            raise ParseError("CREATE OR REPLACE applies to views only")
         self.expect_kw("table")
         if_not_exists = False
         if self.accept_kw("if"):
@@ -167,6 +190,23 @@ class Parser:
             self.expect_kw("exists")
             if_not_exists = True
         name = self._qualified_name()
+        if self.accept_op("("):
+            # CREATE TABLE name (col type, ...)
+            cols = []
+            while True:
+                cname = self.ident()
+                tparts = [self.next().value]
+                if self.accept_op("("):
+                    targs = [self.next().value]
+                    while self.accept_op(","):
+                        targs.append(self.next().value)
+                    self.expect_op(")")
+                    tparts.append("(" + ",".join(targs) + ")")
+                cols.append((cname, "".join(tparts)))
+                if not self.accept_op(","):
+                    break
+            self.expect_op(")")
+            return ast.CreateTable(name, cols, if_not_exists)
         self.expect_kw("as")
         q = self.parse_query()
         return ast.CreateTableAs(name, q, if_not_exists)
@@ -180,6 +220,12 @@ class Parser:
 
     def _parse_drop(self) -> ast.Node:
         self.expect_kw("drop")
+        if self.accept_kw("view"):
+            if_exists = False
+            if self.accept_kw("if"):
+                self.expect_kw("exists")
+                if_exists = True
+            return ast.DropView(self._qualified_name(), if_exists)
         self.expect_kw("table")
         if_exists = False
         if self.accept_kw("if"):

@@ -148,3 +148,74 @@ def test_distributed_ctas(env):
         assert back.n[0] == 20
     finally:
         dist.close()
+
+
+class TestViewsAndDelete:
+    """Views, DELETE, TRUNCATE, CREATE TABLE (schema) — the wider DDL
+    surface (CreateViewTask / DeleteNode-rewrite / TruncateTableTask)."""
+
+    @pytest.fixture()
+    def r(self):
+        conn = MemoryConnector()
+        conn.add_table("t", {"g": np.arange(20) % 4,
+                             "v": np.arange(20.0)})
+        cat = Catalog()
+        cat.register("m", conn, default=True)
+        return LocalRunner(cat, ExecConfig())
+
+    def test_create_and_query_view(self, r):
+        r.run("create view big as select g, v from t where v >= 10")
+        df = r.run("select g, count(*) as n from big group by g order by g")
+        assert df.n.tolist() == [2, 2, 3, 3]
+        # views compose with further filters and joins
+        df2 = r.run("select count(*) as n from big where g = 1")
+        assert df2.n[0] == 2  # v in {13, 17}
+
+    def test_or_replace_and_drop_view(self, r):
+        r.run("create view x as select g from t")
+        with pytest.raises(Exception):
+            r.run("create view x as select v from t")
+        r.run("create or replace view x as select v from t where v < 5")
+        assert r.run("select count(*) as n from x").n[0] == 5
+        r.run("drop view x")
+        with pytest.raises(Exception):
+            r.run("select * from x")
+        r.run("drop view if exists x")  # no error
+
+    def test_delete_where(self, r):
+        out = r.run("delete from t where v < 5")
+        assert out.rows[0] == 5
+        assert r.run("select count(*) as n from t").n[0] == 15
+        # NULL predicate keeps the row: nullif(v,v) is always NULL
+        out = r.run("delete from t where nullif(v, v) > 0")
+        assert out.rows[0] == 0
+        assert r.run("select count(*) as n from t").n[0] == 15
+
+    def test_truncate_and_create_schema(self, r):
+        r.run("truncate table t")
+        assert r.run("select count(*) as n from t").n[0] == 0
+        r.run("create table fresh (a bigint, b varchar, c double)")
+        assert r.run("select count(*) as n from fresh").n[0] == 0
+        r.run("insert into fresh select g, 'x', v from t")  # empty insert
+        r2 = r.run("select count(*) as n from fresh")
+        assert r2.n[0] == 0
+        # decimal schema columns round-trip too
+        r.run("create table money (a decimal(10,2))")
+        assert r.run("select count(*) as n from money").n[0] == 0
+
+    def test_parquet_delete_truncate(self, tmp_path):
+        from presto_tpu.catalog.parquet import ParquetConnector
+
+        conn = ParquetConnector(str(tmp_path))
+        cat = Catalog()
+        cat.register("pq", conn, default=True)
+        r = LocalRunner(cat, ExecConfig())
+        r.run("create table t as select * from "
+              "(values (1, 'a'), (2, 'b'), (3, 'c')) as v(k, s)")
+        out = r.run("delete from t where k <= 2")
+        assert out.rows[0] == 2
+        assert r.run("select count(*) as n from t").n[0] == 1
+        r.run("truncate table t")
+        assert r.run("select count(*) as n from t").n[0] == 0
+        r.run("create table empty2 (x double)")
+        assert r.run("select count(*) as n from empty2").n[0] == 0
