@@ -1574,6 +1574,18 @@ class Planner:
         if having is not None:
             collect(having)
 
+        # GROUPING SETS / ROLLUP / CUBE: the full key list is the ordered
+        # union of all sets; each set plans its own aggregate below
+        grouping_sets: Optional[List[List[str]]] = None
+        set_asts: Optional[list] = None
+        if len(group_by) == 1 and isinstance(group_by[0], ast.GroupingSets):
+            set_asts = group_by[0].sets
+            seen_keys: Dict[str, ast.Node] = {}
+            for s in set_asts:
+                for g in s:
+                    seen_keys.setdefault(ast_key(g), g)
+            group_by = list(seen_keys.values())
+
         # pre-projection: group keys + agg args
         pre_exprs: List[Tuple[str, RowExpression]] = []
         group_syms: List[str] = []
@@ -1641,33 +1653,33 @@ class Planner:
         seen = {s for s, _ in pre_exprs}
         pre = Project(node, pre_exprs) if pre_exprs else node
 
-        hll_aggs = [a for a in agg_specs if a.fn == "approx_distinct"]
-        pct_aggs = [a for a in agg_specs if a.fn == "approx_percentile"]
-        distinct_aggs = [a for a in agg_specs if a.distinct]
-        if hll_aggs:
-            if len(agg_specs) != 1:
-                raise AnalysisError(
-                    "approx_distinct mixed with other aggregates not supported yet")
-            agg_node = self._plan_hll(pre, group_syms, agg_specs[0], pre_exprs, node)
-        elif (pct_aggs and len(agg_specs) == len(pct_aggs)
-              and len({a.arg for a in pct_aggs}) == 1
-              and not any(a.distinct for a in pct_aggs)):
-            # all aggregates are approx_percentile over one column → the
-            # mergeable quantized-histogram sketch (distributable); mixed
-            # forms fall back to the materialized exact path below
-            agg_node = self._plan_qsketch(pre, group_syms, pct_aggs)
-        elif distinct_aggs:
-            if len(agg_specs) == 1 and agg_specs[0].fn == "count":
-                # sole COUNT(DISTINCT x): two-phase dedup-then-count —
-                # both phases decomposable, so it distributes
-                a = agg_specs[0]
-                inner = Aggregate(pre, group_syms + [a.arg], [], step="single")
-                agg_node = Aggregate(
-                    inner, group_syms,
-                    [AggSpec(a.symbol, "count", a.arg, a.type, False)],
-                    step="single",
-                )
-            else:
+        def plan_one(gsyms: List[str], pre: PlanNode) -> PlanNode:
+            hll_aggs = [a for a in agg_specs if a.fn == "approx_distinct"]
+            pct_aggs = [a for a in agg_specs if a.fn == "approx_percentile"]
+            distinct_aggs = [a for a in agg_specs if a.distinct]
+            if hll_aggs:
+                if len(agg_specs) != 1:
+                    raise AnalysisError(
+                        "approx_distinct mixed with other aggregates not supported yet")
+                return self._plan_hll(pre, gsyms, agg_specs[0], pre_exprs, node)
+            if (pct_aggs and len(agg_specs) == len(pct_aggs)
+                    and len({a.arg for a in pct_aggs}) == 1
+                    and not any(a.distinct for a in pct_aggs)):
+                # all aggregates are approx_percentile over one column → the
+                # mergeable quantized-histogram sketch (distributable); mixed
+                # forms fall back to the materialized exact path below
+                return self._plan_qsketch(pre, gsyms, pct_aggs)
+            if distinct_aggs:
+                if len(agg_specs) == 1 and agg_specs[0].fn == "count":
+                    # sole COUNT(DISTINCT x): two-phase dedup-then-count —
+                    # both phases decomposable, so it distributes
+                    a = agg_specs[0]
+                    inner = Aggregate(pre, gsyms + [a.arg], [], step="single")
+                    return Aggregate(
+                        inner, gsyms,
+                        [AggSpec(a.symbol, "count", a.arg, a.type, False)],
+                        step="single",
+                    )
                 # mixed forms (count/sum/avg DISTINCT alongside other
                 # aggregates): rewrite each DISTINCT spec to its sorted
                 # order-dependent form — the materialized single-task path
@@ -1690,10 +1702,44 @@ class Planner:
                     rewritten.append(AggSpec(
                         a.symbol, f"{a.fn}_distinct", a.arg, a.type, False,
                         arg2=a.arg2, param=a.param))
-                agg_node = Aggregate(pre, group_syms, rewritten,
-                                     step="single")
-        else:
-            agg_node = Aggregate(pre, group_syms, agg_specs, step="single")
+                return Aggregate(pre, gsyms, rewritten, step="single")
+            return Aggregate(pre, gsyms, agg_specs, step="single")
+
+        if set_asts is None:
+            return plan_one(group_syms, pre), repl
+
+        # GROUPING SETS: one aggregate per set over the shared
+        # pre-projection, keys absent from a set pad as typed NULLs, then
+        # UNION ALL (reference: GroupIdNode + a single multi-set
+        # aggregation; the union-of-aggregates shape computes the same
+        # rows and distributes through the existing set-op machinery)
+        key_types = {s: e.type for s, e in pre_exprs if s in group_syms}
+        sym_of = {ast_key(g): s for g, s in zip(group_by, group_syms)}
+        out_syms = list(group_syms) + [a.symbol for a in agg_specs]
+        out_types = [key_types[s] for s in group_syms] + [
+            a.type for a in agg_specs]
+        import copy as _copy
+
+        branches = []
+        for i, s_ast in enumerate(set_asts):
+            gsyms = [sym_of[ast_key(g)] for g in s_ast]
+            # each branch owns its subtree: optimizer passes mutate nodes
+            # in place (pruning one branch's copy of the shared
+            # pre-projection must not strip columns another branch needs)
+            agg_i = plan_one(gsyms, pre if i == 0 else _copy.deepcopy(pre))
+            pad = []
+            for sym in group_syms:
+                if sym in gsyms:
+                    pad.append((sym, InputRef(key_types[sym], sym)))
+                else:
+                    pad.append((sym, Constant(key_types[sym], None)))
+            pad.extend((a.symbol, InputRef(a.type, a.symbol))
+                       for a in agg_specs)
+            branches.append(Project(agg_i, pad))
+        agg_node = branches[0]
+        for b in branches[1:]:
+            agg_node = SetOp("union", True, agg_node, b,
+                             list(out_syms), list(out_types))
         return agg_node, repl
 
     def _plan_qsketch(self, pre: PlanNode, group_syms,

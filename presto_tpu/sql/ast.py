@@ -249,6 +249,14 @@ class UnnestRelation(Node):
 
 
 @dataclasses.dataclass
+class GroupingSets(Node):
+    """GROUP BY GROUPING SETS / ROLLUP / CUBE, expanded to explicit key
+    sets. Appears as the sole element of Query.group_by."""
+
+    sets: list  # List[List[Node]]
+
+
+@dataclasses.dataclass
 class SelectItem(Node):
     expr: Node
     alias: Optional[str] = None

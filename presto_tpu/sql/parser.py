@@ -342,9 +342,13 @@ class Parser:
         group_by: List[ast.Node] = []
         if self.accept_kw("group"):
             self.expect_kw("by")
-            group_by.append(self.parse_expr())
-            while self.accept_op(","):
+            gs = self._try_grouping_construct()
+            if gs is not None:
+                group_by.append(gs)
+            else:
                 group_by.append(self.parse_expr())
+                while self.accept_op(","):
+                    group_by.append(self.parse_expr())
         having = None
         if self.accept_kw("having"):
             having = self.parse_expr()
@@ -368,6 +372,52 @@ class Parser:
             select=select, distinct=distinct, from_=from_, where=where,
             group_by=group_by, having=having, order_by=order_by, limit=limit,
         )
+
+    def _try_grouping_construct(self):
+        """ROLLUP(...), CUBE(...), GROUPING SETS ((..), ..) — expanded to
+        an explicit set list at parse time (SqlBase.g4 groupingElement;
+        planner/GroupIdNode is redesigned as a UNION ALL of aggregates)."""
+        t = self.peek()
+        if t.kind != "ident" or t.value not in ("rollup", "cube", "grouping"):
+            return None
+        if t.value == "grouping":
+            nt = self.peek(1)
+            if not (nt.kind == "ident" and nt.value == "sets"):
+                return None
+            self.next()
+            self.next()
+            self.expect_op("(")
+            sets = []
+            while True:
+                self.expect_op("(")
+                one = []
+                if not (self.peek().kind == "op" and self.peek().value == ")"):
+                    one.append(self.parse_expr())
+                    while self.accept_op(","):
+                        one.append(self.parse_expr())
+                self.expect_op(")")
+                sets.append(one)
+                if not self.accept_op(","):
+                    break
+            self.expect_op(")")
+            return ast.GroupingSets(sets)
+        kind = t.value
+        if not (self.peek(1).kind == "op" and self.peek(1).value == "("):
+            return None
+        self.next()
+        self.expect_op("(")
+        cols = [self.parse_expr()]
+        while self.accept_op(","):
+            cols.append(self.parse_expr())
+        self.expect_op(")")
+        if kind == "rollup":
+            sets = [cols[:i] for i in range(len(cols), -1, -1)]
+        else:  # cube: every subset, preserving column order
+            sets = []
+            n = len(cols)
+            for mask in range((1 << n) - 1, -1, -1):
+                sets.append([cols[i] for i in range(n) if mask & (1 << i)])
+        return ast.GroupingSets(sets)
 
     def parse_select_item(self) -> ast.SelectItem:
         t = self.peek()
