@@ -1561,10 +1561,14 @@ class Planner:
 
         # collect aggregates from select + having
         aggs_by_key: Dict[str, ast.FunctionCall] = {}
+        grouping_calls: Dict[str, ast.FunctionCall] = {}
 
         def collect(n):
             if isinstance(n, ast.FunctionCall) and n.name.lower() in _AGG_FUNCS:
                 aggs_by_key.setdefault("agg:" + ast_key(n), n)
+                return
+            if isinstance(n, ast.FunctionCall) and n.name.lower() == "grouping":
+                grouping_calls.setdefault(ast_key(n), n)
                 return
             for child in _ast_children(n):
                 collect(child)
@@ -1706,7 +1710,26 @@ class Planner:
             return Aggregate(pre, gsyms, agg_specs, step="single")
 
         if set_asts is None:
+            if grouping_calls:
+                raise AnalysisError(
+                    "grouping() requires GROUPING SETS / ROLLUP / CUBE")
             return plan_one(group_syms, pre), repl
+
+        # grouping(c1, ..) → per-branch constant bitmask (bit i set when
+        # ci is NOT aggregated in that branch's set — Presto semantics)
+        sym_of = {ast_key(g): s for g, s in zip(group_by, group_syms)}
+        grouping_syms: List[Tuple[str, List[str]]] = []
+        for gkey, gc in grouping_calls.items():
+            arg_syms = []
+            for a in gc.args:
+                k = ast_key(a)
+                if k not in sym_of:
+                    raise AnalysisError(
+                        "grouping() arguments must be grouping columns")
+                arg_syms.append(sym_of[k])
+            sym = self.symbols.fresh("grouping")
+            grouping_syms.append((sym, arg_syms))
+            repl[gkey] = (sym, BIGINT)
 
         # GROUPING SETS: one aggregate per set over the shared
         # pre-projection, keys absent from a set pad as typed NULLs, then
@@ -1714,10 +1737,11 @@ class Planner:
         # aggregation; the union-of-aggregates shape computes the same
         # rows and distributes through the existing set-op machinery)
         key_types = {s: e.type for s, e in pre_exprs if s in group_syms}
-        sym_of = {ast_key(g): s for g, s in zip(group_by, group_syms)}
-        out_syms = list(group_syms) + [a.symbol for a in agg_specs]
-        out_types = [key_types[s] for s in group_syms] + [
-            a.type for a in agg_specs]
+        out_syms = (list(group_syms) + [a.symbol for a in agg_specs]
+                    + [s for s, _ in grouping_syms])
+        out_types = ([key_types[s] for s in group_syms]
+                     + [a.type for a in agg_specs]
+                     + [BIGINT] * len(grouping_syms))
         import copy as _copy
 
         branches = []
@@ -1735,6 +1759,12 @@ class Planner:
                     pad.append((sym, Constant(key_types[sym], None)))
             pad.extend((a.symbol, InputRef(a.type, a.symbol))
                        for a in agg_specs)
+            for gsym, arg_syms in grouping_syms:
+                mask = 0
+                for bit, s in enumerate(arg_syms):
+                    if s not in gsyms:
+                        mask |= 1 << (len(arg_syms) - 1 - bit)
+                pad.append((gsym, Constant(BIGINT, mask)))
             branches.append(Project(agg_i, pad))
         agg_node = branches[0]
         for b in branches[1:]:

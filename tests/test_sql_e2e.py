@@ -454,3 +454,19 @@ class TestGroupingSets:
             self._cmp(got, exp)
         finally:
             dist.close()
+
+    def test_grouping_function(self, env):
+        runner, df = env
+        got = runner.run(
+            "select region, prod, grouping(region, prod) as gid, "
+            "sum(v) as s from sales group by rollup (region, prod) "
+            "order by gid, region, prod")
+        # gid 0 = both grouped; 1 = prod aggregated; 3 = both aggregated
+        gids = got.gid.tolist()
+        assert set(gids) == {0, 1, 3}
+        assert gids.count(3) == 1
+        n_pairs = df.groupby(["region", "prod"]).ngroups
+        assert gids.count(0) == n_pairs
+        assert gids.count(1) == df.region.nunique()
+        total = got[got.gid == 3].s.iloc[0]
+        assert total == df.v.sum()
