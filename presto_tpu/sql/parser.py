@@ -27,8 +27,7 @@ _KEYWORDS = {
     "intersect", "except",
     "substring", "for", "over", "partition", "rows", "range", "unbounded",
     "preceding", "following", "current", "row",
-    "create", "insert", "drop", "table", "into", "if", "values",
-    "view", "replace", "delete", "truncate",
+    "create", "insert", "drop", "table", "into", "if",
 }
 
 _TOKEN_RE = re.compile(
@@ -131,10 +130,21 @@ class Parser:
         # allow non-reserved keywords as identifiers where unambiguous
         if t.kind in ("ident",) or (t.kind == "keyword" and t.value in (
                 "year", "month", "day", "date", "first", "last", "if",
-                "table", "into")):
+                "table", "into", "view", "replace", "delete", "truncate",
+                "values")):
             self.next()
             return t.value
         raise ParseError(f"expected identifier, got {t!r}")
+
+    def accept_word(self, w: str) -> bool:
+        """Match a NON-reserved statement word (ident or keyword token) —
+        words like view/replace/delete/truncate stay usable as function
+        and column names."""
+        t = self.peek()
+        if t.kind in ("ident", "keyword") and t.value == w:
+            self.next()
+            return True
+        return False
 
     # -- entry ------------------------------------------------------------
 
@@ -146,7 +156,7 @@ class Parser:
             q = self._parse_insert()
         elif t.kind == "keyword" and t.value == "drop":
             q = self._parse_drop()
-        elif t.kind == "keyword" and t.value == "delete":
+        elif t.kind in ("keyword", "ident") and t.value == "delete":
             self.next()
             self.expect_kw("from")
             name = self._qualified_name()
@@ -154,7 +164,7 @@ class Parser:
             if self.accept_kw("where"):
                 where = self.parse_expr()
             q = ast.Delete(name, where)
-        elif t.kind == "keyword" and t.value == "truncate":
+        elif t.kind in ("keyword", "ident") and t.value == "truncate":
             self.next()
             self.expect_kw("table")
             q = ast.Truncate(self._qualified_name())
@@ -175,9 +185,10 @@ class Parser:
         self.expect_kw("create")
         or_replace = False
         if self.accept_kw("or"):
-            self.expect_kw("replace")
+            if not self.accept_word("replace"):
+                raise ParseError("expected REPLACE after CREATE OR")
             or_replace = True
-        if self.accept_kw("view"):
+        if self.accept_word("view"):
             name = self._qualified_name()
             self.expect_kw("as")
             return ast.CreateView(name, self.parse_query(), or_replace)
@@ -220,7 +231,7 @@ class Parser:
 
     def _parse_drop(self) -> ast.Node:
         self.expect_kw("drop")
-        if self.accept_kw("view"):
+        if self.accept_word("view"):
             if_exists = False
             if self.accept_kw("if"):
                 self.expect_kw("exists")
@@ -527,7 +538,10 @@ class Parser:
         return node
 
     def parse_table_primary(self) -> ast.Node:
-        if (self.peek().kind == "keyword" and self.peek().value == "values"):
+        if (self.peek().kind in ("keyword", "ident")
+                and self.peek().value == "values"
+                and self.peek(1).kind == "op"
+                and self.peek(1).value in ("(",)):
             self.next()
             q = self._parse_values()
             alias = None
@@ -568,7 +582,8 @@ class Parser:
                 self.expect_op(")")
             return ast.UnnestRelation(exprs, ordinality, alias, cols)
         if self.accept_op("("):
-            if self.peek().kind == "keyword" and self.peek().value == "values":
+            if (self.peek().kind in ("keyword", "ident")
+                    and self.peek().value == "values"):
                 self.next()
                 q = self._parse_values()
                 self.expect_op(")")
