@@ -2036,7 +2036,7 @@ def _execute_setop(node: SetOp, ctx: ExecContext) -> Iterator[Batch]:
         for b in execute_node(child, ctx):
             yield b.rename(syms)
 
-    if node.all:  # UNION ALL: pure streaming concat
+    if node.all and node.kind == "union":  # UNION ALL: streaming concat
         yield from renamed(node.left)
         yield from renamed(node.right)
         return
@@ -2057,9 +2057,20 @@ def _execute_setop(node: SetOp, ctx: ExecContext) -> Iterator[Batch]:
         return
     if rb is None:
         if node.kind == "except":
-            yield _node_jit(node, "distinct", lambda: _distinct_rows)(lb)
+            out = (lb if node.all
+                   else _node_jit(node, "distinct", lambda: _distinct_rows)(lb))
+            yield out
         return
     lb, rb = _align_setop_dicts(node, [lb, rb])
+
+    if node.all:
+        # multiset semantics (INTERSECT ALL / EXCEPT ALL): per distinct
+        # row, emit min(cl, cr) / max(cl - cr, 0) copies. Row counting on
+        # the host over the null-safe encodings, then ONE device gather of
+        # the replicated row indices (set ops are gathered single-task;
+        # the reference's row-number-marked joins serve the same shape)
+        yield _multiset_setop(node, lb, rb)
+        return
 
     def membership(lb: Batch, rb: Batch):
         ld = _distinct_rows(lb)
@@ -2074,6 +2085,40 @@ def _execute_setop(node: SetOp, ctx: ExecContext) -> Iterator[Batch]:
 
 
 # -- window -----------------------------------------------------------------
+
+
+def _multiset_setop(node: SetOp, lb: Batch, rb: Batch) -> Batch:
+    live_l = np.asarray(lb.live)
+    orig_idx = np.nonzero(live_l)[0]
+    lenc, _ = _null_safe_encode(lb)
+    renc, _ = _null_safe_encode(rb)
+
+    def rows_of(enc: Batch, live):
+        cols = [np.asarray(c.values)[live] for c in enc.columns]
+        return np.stack(cols, axis=1) if cols else np.zeros((int(live.sum()), 0))
+
+    lrows = rows_of(lenc, live_l)
+    rrows = rows_of(renc, np.asarray(rb.live))
+    uniq, first_pos, lcnt = np.unique(lrows, axis=0, return_index=True,
+                                      return_counts=True)
+    rcounts: dict = {}
+    for row in map(tuple, rrows):
+        rcounts[row] = rcounts.get(row, 0) + 1
+    reps = np.empty(len(uniq), np.int64)
+    for i, row in enumerate(map(tuple, uniq)):
+        cr = rcounts.get(row, 0)
+        reps[i] = (min(int(lcnt[i]), cr) if node.kind == "intersect"
+                   else max(int(lcnt[i]) - cr, 0))
+    out_idx = np.repeat(orig_idx[first_pos], reps)
+    n = len(out_idx)
+    cap = round_up_capacity(max(n, 1))
+    idx = np.zeros(cap, np.int32)
+    idx[:n] = out_idx
+    jidx = jnp.asarray(idx)
+    cols = [c.gather(jidx) for c in lb.columns]
+    live = np.zeros(cap, bool)
+    live[:n] = True
+    return Batch(lb.names, lb.types, cols, jnp.asarray(live), lb.dicts)
 
 
 def _execute_window(node: Window, ctx: ExecContext) -> Iterator[Batch]:

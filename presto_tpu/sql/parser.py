@@ -275,8 +275,6 @@ class Parser:
             all_ = bool(self.accept_kw("all"))
             if not all_:
                 self.accept_kw("distinct")
-            if kind == "except" and all_:
-                raise ParseError("EXCEPT ALL is not supported")
             right = self.parse_intersect_term()
             left = ast.SetOp(kind, all_, left, right)
         if isinstance(left, ast.SetOp):
@@ -298,11 +296,11 @@ class Parser:
     def parse_intersect_term(self):
         left = self.parse_query_term()
         while self.accept_kw("intersect"):
-            if self.accept_kw("all"):
-                raise ParseError("INTERSECT ALL is not supported")
-            self.accept_kw("distinct")
+            all_ = bool(self.accept_kw("all"))
+            if not all_:
+                self.accept_kw("distinct")
             right = self.parse_query_term()
-            left = ast.SetOp("intersect", False, left, right)
+            left = ast.SetOp("intersect", all_, left, right)
         return left
 
     def parse_query_term(self):

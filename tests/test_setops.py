@@ -151,3 +151,57 @@ def test_union_all_distributed_round_robin(engines):
         assert got.sk.tolist() == local.sk.tolist()
     finally:
         dist.close()
+
+
+class TestMultisetSetOps:
+    """INTERSECT ALL / EXCEPT ALL — multiset semantics (per distinct row:
+    min(cl, cr) / max(cl - cr, 0) copies). Oracle: collections.Counter."""
+
+    @pytest.fixture(scope="class")
+    def env(self):
+        rng = np.random.default_rng(13)
+        n = 2000
+        a = pd.DataFrame({"k": rng.integers(0, 30, n),
+                          "s": rng.choice(["x", "y", "z"], n)})
+        b = pd.DataFrame({"k": rng.integers(10, 40, n),
+                          "s": rng.choice(["y", "z", "w"], n)})
+        conn = MemoryConnector()
+        conn.add_table("a", a)
+        conn.add_table("b", b)
+        cat = Catalog()
+        cat.register("m", conn, default=True)
+        runner = LocalRunner(cat, ExecConfig(batch_rows=1 << 9))
+        return runner, a, b
+
+    @staticmethod
+    def _counter(df):
+        from collections import Counter
+
+        return Counter(map(tuple, df.itertuples(index=False)))
+
+    def test_intersect_all(self, env):
+        runner, a, b = env
+        got = runner.run("select k, s from a intersect all select k, s from b")
+        ca, cb = self._counter(a), self._counter(b)
+        exp = sum((min(c, cb.get(r, 0)) for r, c in ca.items()))
+        assert len(got) == exp
+        cg = self._counter(got)
+        for r, c in cg.items():
+            assert c == min(ca[r], cb.get(r, 0)), r
+
+    def test_except_all(self, env):
+        runner, a, b = env
+        got = runner.run("select k, s from a except all select k, s from b")
+        ca, cb = self._counter(a), self._counter(b)
+        cg = self._counter(got)
+        for r, c in ca.items():
+            want = max(c - cb.get(r, 0), 0)
+            assert cg.get(r, 0) == want, r
+        assert sum(cg.values()) == sum(
+            max(c - cb.get(r, 0), 0) for r, c in ca.items())
+
+    def test_except_all_empty_right(self, env):
+        runner, a, _ = env
+        got = runner.run("select k, s from a except all "
+                         "select k, s from b where 1 = 0")
+        assert len(got) == len(a)  # duplicates preserved
