@@ -31,7 +31,7 @@ _SCALAR = {
     "date": ["year", "month", "day", "quarter", "day_of_week", "dow",
              "day_of_year", "doy", "date_trunc", "date_diff", "date_add",
              "from_unixtime", "to_unixtime"],
-    "conditional": ["coalesce", "nullif", "if"],
+    "conditional": ["coalesce", "nullif", "if", "grouping"],
     "bitwise": ["bitwise_and", "bitwise_or", "bitwise_xor", "bitwise_not",
                 "bitwise_left_shift", "bitwise_right_shift"],
     "array": ["cardinality", "element_at", "contains", "array_position",
