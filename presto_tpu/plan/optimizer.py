@@ -325,10 +325,15 @@ def optimize(plan: QueryPlan) -> QueryPlan:
     """Run the pass pipeline (reference: PlanOptimizers.java:146 ordering)."""
     from presto_tpu.plan.stats import invalidate
 
+    from presto_tpu.plan.rules import IterativeOptimizer
+
     root = plan.root
     root.child = push_filters(root.child)
     prune_columns(root, set(root.symbols))
     root.child = cleanup(root.child)
+    # iterative pattern rules (merge filters/projects/limits, TopN
+    # formation) run after the big passes, to fixpoint
+    root.child = IterativeOptimizer().optimize(root.child)
     # builder-time stats memos are stale once filters/pruning rewrote the
     # tree; later consumers (fragmenter, capacity planner) re-derive
     invalidate(root)

@@ -1,0 +1,93 @@
+"""Iterative rule-engine optimizer (IterativeOptimizer + presto-matching
+pattern DSL analog): rewrites fire to fixpoint and plans stay correct."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from presto_tpu.catalog.memory import MemoryConnector
+from presto_tpu.connector import Catalog
+from presto_tpu.exec import ExecConfig, LocalRunner
+from presto_tpu.expr.ir import Call, Constant, InputRef
+from presto_tpu.plan.nodes import Filter, Limit, Project, Sort, SortItem
+from presto_tpu.plan.rules import DEFAULT_RULES, IterativeOptimizer, Pattern
+from presto_tpu.types import BIGINT, BOOLEAN
+
+
+def _scan_stub():
+    from presto_tpu.plan.nodes import TableScan
+
+    return TableScan(catalog="m", table="t",
+                     assignments={"a": "a", "b": "b"},
+                     output=[("a", BIGINT), ("b", BIGINT)])
+
+
+def test_merge_filters_and_limits():
+    pred1 = Call(BOOLEAN, "gt", (InputRef(BIGINT, "a"), Constant(BIGINT, 1)))
+    pred2 = Call(BOOLEAN, "lt", (InputRef(BIGINT, "a"), Constant(BIGINT, 9)))
+    plan = Limit(Limit(Filter(Filter(_scan_stub(), pred1), pred2), 10), 5)
+    out = IterativeOptimizer().optimize(plan)
+    assert isinstance(out, Limit) and out.count == 5
+    assert isinstance(out.child, Filter)
+    assert out.child.predicate.fn == "and"
+    assert not isinstance(out.child.child, Filter)
+
+
+def test_limit_into_sort_becomes_topn():
+    plan = Limit(Sort(_scan_stub(), [SortItem("a", True, None)]), 7)
+    out = IterativeOptimizer().optimize(plan)
+    assert isinstance(out, Sort) and out.limit == 7
+
+
+def test_collapse_projects_substitutes_once():
+    inner = Project(_scan_stub(), [
+        ("x", Call(BIGINT, "add", (InputRef(BIGINT, "a"),
+                                   Constant(BIGINT, 1)))),
+        ("b", InputRef(BIGINT, "b")),
+    ])
+    outer = Project(inner, [
+        ("y", Call(BIGINT, "mul", (InputRef(BIGINT, "x"),
+                                   Constant(BIGINT, 2)))),
+    ])
+    out = IterativeOptimizer().optimize(outer)
+    assert isinstance(out, Project)
+    assert not isinstance(out.child, Project)  # collapsed
+    (sym, e), = out.exprs
+    assert e.fn == "mul" and e.args[0].fn == "add"  # substituted inline
+
+
+def test_collapse_projects_refuses_duplication():
+    inner = Project(_scan_stub(), [
+        ("x", Call(BIGINT, "add", (InputRef(BIGINT, "a"),
+                                   Constant(BIGINT, 1)))),
+    ])
+    outer = Project(inner, [
+        ("y", Call(BIGINT, "mul", (InputRef(BIGINT, "x"),
+                                   InputRef(BIGINT, "x")))),
+    ])
+    out = IterativeOptimizer().optimize(outer)
+    # x is referenced twice: substitution would compute add twice → keep
+    assert isinstance(out.child, Project)
+
+
+def test_pattern_dsl():
+    p = Pattern.type_of(Limit).matching(lambda n: n.count > 3)
+    assert p.matches(Limit(_scan_stub(), 5))
+    assert not p.matches(Limit(_scan_stub(), 2))
+    assert not p.matches(_scan_stub())
+
+
+def test_end_to_end_results_unchanged():
+    rng = np.random.default_rng(5)
+    conn = MemoryConnector()
+    conn.add_table("t", pd.DataFrame({
+        "a": rng.integers(0, 50, 1000), "b": rng.normal(size=1000)}))
+    cat = Catalog()
+    cat.register("m", conn, default=True)
+    r = LocalRunner(cat, ExecConfig(batch_rows=128))
+    df = r.run("select a2, s from ("
+               "  select a * 2 as a2, b + 1 as s from t where a > 10"
+               ") x where a2 < 60 order by s limit 5")
+    assert len(df) == 5
+    assert (df.a2 > 20).all() and (df.a2 < 60).all()
+    assert df.s.is_monotonic_increasing
