@@ -268,6 +268,14 @@ class MemoryTable:
                 cs = ColumnStats(ndv=0.0, null_fraction=nf)
             else:
                 mn, mx = float(vals.min()), float(vals.max())
+                hist = None
+                if mx > mn and arr.ndim == 1 and np.issubdtype(
+                        arr.dtype, np.number):
+                    sample = (vals if len(vals) <= 2_000_000
+                              else vals[:: len(vals) // 1_000_000])
+                    edges = np.quantile(sample.astype(np.float64),
+                                        np.linspace(0.0, 1.0, 33))
+                    hist = tuple(float(e) for e in edges)
                 if (self.primary_key and self.primary_key == [col]):
                     ndv = float(len(vals))
                 elif len(vals) <= 2_000_000:
@@ -280,7 +288,8 @@ class MemoryTable:
                     else:
                         ndv = sndv  # value-domain-like: sample saw it all
                 cs = ColumnStats(ndv=ndv, null_fraction=nf,
-                                 min_value=mn, max_value=mx)
+                                 min_value=mn, max_value=mx,
+                                 histogram=hist)
         cache[col] = cs
         return cs
 

@@ -26,6 +26,12 @@ class ColumnStats:
     null_fraction: Optional[float] = None  # in [0, 1]
     min_value: Optional[float] = None      # numeric/date low (None: unknown)
     max_value: Optional[float] = None
+    # equi-DEPTH histogram: tuple of bin edges (quantiles); each adjacent
+    # pair holds an equal share of the rows. The reference models
+    # distributions via NDV+range only; quantile edges make range
+    # selectivities robust to skew (mass concentration moves the edges,
+    # not the per-bin counts)
+    histogram: Optional[tuple] = None
 
 
 @dataclasses.dataclass

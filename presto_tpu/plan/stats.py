@@ -62,8 +62,9 @@ def _scalar(v) -> Optional[float]:
 
 
 def _range_fraction(cs: ColumnStats, lo: Optional[float], hi: Optional[float]) -> Optional[float]:
-    """Fraction of the [min,max] range covered by [lo,hi] (uniform model —
-    FilterStatsCalculator's range estimate)."""
+    """Fraction of ROWS in [lo, hi]: histogram-weighted when the column
+    carries one (robust to skew), else the uniform [min,max] model
+    (FilterStatsCalculator's range estimate)."""
     if cs.min_value is None or cs.max_value is None:
         return None
     width = cs.max_value - cs.min_value
@@ -73,6 +74,21 @@ def _range_fraction(cs: ColumnStats, lo: Optional[float], hi: Optional[float]) -
     b = cs.max_value if hi is None else min(hi, cs.max_value)
     if b < a:
         return 0.0
+    if cs.histogram and len(cs.histogram) >= 2:
+        edges = cs.histogram  # equi-depth: each bin holds 1/nb of rows
+        nb = len(edges) - 1
+        covered = 0.0
+        for i in range(nb):
+            blo, bhi = edges[i], edges[i + 1]
+            if bhi <= blo:
+                # zero-width bin (heavy repeated value): counted fully
+                # when the point lies inside [a, b]
+                covered += 1.0 if a <= blo <= b else 0.0
+                continue
+            olo, ohi = max(a, blo), min(b, bhi)
+            if ohi > olo:
+                covered += (ohi - olo) / (bhi - blo)
+        return min(1.0, covered / nb)
     return min(1.0, (b - a) / width)
 
 
@@ -141,7 +157,10 @@ def _scale_ndv(cs: ColumnStats, factor: float) -> ColumnStats:
         # uniform-draw model: expected distinct after sampling
         ndv = ndv * (1.0 - math.exp(-max(factor, 1e-9)))
         ndv = max(1.0, min(cs.ndv, ndv / (1.0 - math.exp(-1.0))))
-    return ColumnStats(ndv, cs.null_fraction, cs.min_value, cs.max_value)
+    # equi-depth edges describe the value distribution, which filtering on
+    # OTHER columns leaves unchanged — carry them through
+    return ColumnStats(ndv, cs.null_fraction, cs.min_value, cs.max_value,
+                       histogram=cs.histogram)
 
 
 def derive(node: PlanNode, catalog) -> Optional[NodeStats]:

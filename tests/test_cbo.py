@@ -136,3 +136,34 @@ def test_stats_survive_for_plain_memory_tables():
     xs = h.column("x").stats
     assert ks.ndv == 1000 and gs.ndv == 7
     assert abs(xs.null_fraction - 0.1) < 1e-9
+
+
+def test_histogram_selectivity_handles_skew():
+    """Skewed columns: the histogram estimate tracks the real row
+    fraction where the uniform range model is far off."""
+    import numpy as np
+
+    from presto_tpu.catalog.memory import MemoryConnector
+    from presto_tpu.connector import Catalog
+    from presto_tpu.plan.stats import NodeStats, filter_selectivity
+    from presto_tpu.expr.ir import Call, Constant, InputRef
+    from presto_tpu.types import BIGINT, BOOLEAN
+
+    rng = np.random.default_rng(3)
+    # 95% of values in [0, 10], 5% spread to 1000
+    vals = np.where(rng.random(100_000) < 0.95,
+                    rng.integers(0, 10, 100_000),
+                    rng.integers(10, 1000, 100_000))
+    conn = MemoryConnector()
+    conn.add_table("t", {"v": vals})
+    cs = conn.get_table("t").column("v").stats
+    assert cs.histogram is not None and len(cs.histogram) == 33
+
+    stats = NodeStats(100_000.0, {"v": cs})
+    pred = Call(BOOLEAN, "le", (InputRef(BIGINT, "v"),
+                                Constant(BIGINT, 10)))
+    sel = filter_selectivity(pred, stats)
+    true_frac = float((vals <= 10).sum()) / len(vals)
+    # uniform model would say ~1% — histogram must land near 95%
+    assert abs(sel - true_frac) < 0.1
+    assert sel > 0.5
