@@ -558,6 +558,15 @@ class ExprAnalyzer:
             return Call(DOUBLE, "truncate", (self._to_double(args[0]),))
         if name == "mod":
             return self._arith("mod", node.args[0], node.args[1])
+        if name in ("current_date", "current_timestamp", "now"):
+            # plan-time constants (the reference fixes them per query at
+            # analysis: Session.getStartTime)
+            import time as _time
+
+            now_s = _time.time()
+            if name == "current_date":
+                return Constant(DATE, int(now_s // 86400), raw=True)
+            return Constant(TIMESTAMP, int(now_s * 1e6), raw=True)
         if name == "pi":
             return Constant(DOUBLE, 3.141592653589793, raw=True)
         if name in ("e",):

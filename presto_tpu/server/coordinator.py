@@ -377,9 +377,22 @@ class Coordinator:
                          name="coordinator-http").start()
         self.failure_detector.start()
 
-    def _explain(self, sql: str, analyze: bool, session) -> str:
+    def _explain(self, sql: str, analyze: bool, session,
+                 etype: Optional[str] = None) -> str:
         if analyze:
             return self.explain_analyze_distributed(sql, session)
+        if etype == "validate":
+            from presto_tpu.plan.builder import plan_query
+
+            plan_query(sql, self.catalog)  # raises on invalid queries
+            return "VALID"
+        if etype == "logical":
+            from presto_tpu.plan.builder import plan_query
+            from presto_tpu.plan.nodes import plan_to_string
+            from presto_tpu.plan.optimizer import optimize
+
+            return plan_to_string(optimize(plan_query(sql, self.catalog)).root)
+        # default / TYPE DISTRIBUTED
         return self.plan_distributed(sql, session).to_string()
 
     def explain_analyze_distributed(self, sql: str, session=None) -> str:

@@ -52,7 +52,9 @@ _SHOW_SCHEMAS_RE = re.compile(
     r"^\s*show\s+schemas(?:\s+from\s+([\w.]+))?\s*$", re.I)
 _SHOW_STATS_RE = re.compile(
     r"^\s*show\s+stats\s+for\s+([\w.]+)\s*$", re.I)
-_EXPLAIN_RE = re.compile(r"^\s*explain\s+(analyze\s+)?(.+)$", re.I | re.S)
+_EXPLAIN_RE = re.compile(
+    r"^\s*explain\s+(analyze\s+)?(?:\(\s*type\s+(\w+)\s*\)\s+)?(.+)$",
+    re.I | re.S)
 
 
 def _json_value(v: Any, type_name: str) -> Any:
@@ -307,7 +309,9 @@ class StatementProtocol:
             return self._immediate(session, sql, r), extra
         m = _EXPLAIN_RE.match(sql)
         if m and self.explain_fn is not None:
-            text = self.explain_fn(m.group(2), bool(m.group(1)), session)
+            etype = (m.group(2) or "").lower() or None
+            text = self.explain_fn(m.group(3), bool(m.group(1)), session,
+                                   etype)
             r = QueryResult(["Query Plan"], ["varchar"],
                             [(line,) for line in text.split("\n")])
             return self._immediate(session, sql, r), extra

@@ -858,6 +858,14 @@ class Parser:
         # identifier or function call
         if t.kind in ("ident", "keyword"):
             name = self.ident()
+            if name in ("current_date", "current_timestamp",
+                        "localtimestamp") and not (
+                    self.peek().kind == "op"
+                    and self.peek().value in ("(", ".")):
+                # niladic datetime functions (standard SQL: no parens)
+                return ast.FunctionCall(
+                    "current_timestamp" if name == "localtimestamp"
+                    else name, [])
             if name == "array" and self.peek().kind == "op" and self.peek().value == "[":
                 # ARRAY[e1, .., eN] literal constructor
                 self.next()

@@ -254,3 +254,25 @@ def test_prepared_statement_edge_cases(cluster):
     assert rows[0][0] == 25
     _, rows = execute(url, "execute boolean_param using false")
     assert rows[0][0] == 0
+
+
+def test_explain_types_and_niladic_datetime(cluster):
+    from presto_tpu.client import execute
+
+    url = cluster.coordinator.url
+    _, rows = execute(url, "explain (type validate) "
+                           "select n_name from nation")
+    assert rows[0][0] == "VALID"
+    _, rows = execute(url, "explain (type logical) "
+                           "select count(*) as c from nation")
+    text = "\n".join(r[0] for r in rows)
+    assert "Aggregate" in text and "Fragment" not in text
+    _, rows = execute(url, "explain (type distributed) "
+                           "select count(*) as c from nation")
+    assert any("Fragment" in r[0] for r in rows)
+
+    _, rows = execute(url, "select current_date as d, "
+                           "current_timestamp as ts, now() as n "
+                           "from nation limit 1")
+    d, ts, n = rows[0]
+    assert str(d).startswith("20")  # an ISO date of this century
