@@ -123,7 +123,7 @@ class LocalRunner:
         if qp is not None:
             return qp
         qp = optimize(plan_query(sql, self.catalog))
-        if not qp.scalar_subqueries:
+        if not qp.scalar_subqueries and qp.cacheable:
             self._plan_cache[sql] = qp
         return qp
 
@@ -141,7 +141,7 @@ class LocalRunner:
                 return execute_data_definition(stmt, self.catalog,
                                                self._run_query_ast)
             qp = optimize(plan_query(stmt, self.catalog))
-            if not qp.scalar_subqueries:
+            if not qp.scalar_subqueries and qp.cacheable:
                 self._plan_cache[sql] = qp
         ctx = ExecContext(self.catalog, self.config)
         return run_plan(qp, ctx)

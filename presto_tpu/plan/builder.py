@@ -76,8 +76,25 @@ class AnalysisError(Exception):
 
 
 class SymbolAllocator:
+    """Also the per-query shared scratch: nested Planners receive the same
+    allocator, so query-scoped state (the fixed start instant for niladic
+    datetime functions, the plan-volatility flag) lives here."""
+
     def __init__(self):
         self.used = set()
+        self.query_start_s: Optional[float] = None
+        self.volatile_plan = False
+
+    def query_start(self) -> float:
+        """One instant per query (Session.getStartTime): first call fixes
+        it; every niladic datetime function reads the same value. Using
+        it makes the plan non-cacheable."""
+        if self.query_start_s is None:
+            import time as _time
+
+            self.query_start_s = _time.time()
+        self.volatile_plan = True
+        return self.query_start_s
 
     def fresh(self, hint: str) -> str:
         base = hint or "expr"
@@ -559,11 +576,9 @@ class ExprAnalyzer:
         if name == "mod":
             return self._arith("mod", node.args[0], node.args[1])
         if name in ("current_date", "current_timestamp", "now"):
-            # plan-time constants (the reference fixes them per query at
-            # analysis: Session.getStartTime)
-            import time as _time
-
-            now_s = _time.time()
+            # plan-time constants, ONE instant per query
+            # (Session.getStartTime); marks the plan non-cacheable
+            now_s = self.planner.symbols.query_start()
             if name == "current_date":
                 return Constant(DATE, int(now_s // 86400), raw=True)
             return Constant(TIMESTAMP, int(now_s * 1e6), raw=True)
@@ -1120,7 +1135,8 @@ class Planner:
         elif q.limit is not None:
             node = Limit(node, q.limit)
         root = Output(node, list(lout.names), symbols)
-        return QueryPlan(root, self.scalar_subqueries)
+        return QueryPlan(root, self.scalar_subqueries,
+                         cacheable=not self.symbols.volatile_plan)
 
     # -- query ------------------------------------------------------------
 
@@ -1294,7 +1310,8 @@ class Planner:
             node = Limit(node, q.limit)
 
         root = Output(node, display_names, select_symbols)
-        return QueryPlan(root, dict(self.scalar_subqueries))
+        return QueryPlan(root, dict(self.scalar_subqueries),
+                         cacheable=not self.symbols.volatile_plan)
 
     # -- join assembly from comma-FROM + WHERE ----------------------------
 

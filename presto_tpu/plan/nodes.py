@@ -337,6 +337,9 @@ class QueryPlan:
     # uncorrelated scalar subqueries: symbol -> plan producing 1 row / 1 col;
     # the executor evaluates these first and binds them as constants
     scalar_subqueries: Dict[str, "QueryPlan"] = dataclasses.field(default_factory=dict)
+    # False when the plan baked in per-query state (now()/current_date
+    # constants): caches must not serve it to later queries
+    cacheable: bool = True
 
 
 def plan_to_string(node: PlanNode, indent: int = 0, node_stats=None) -> str:

@@ -379,7 +379,14 @@ class Coordinator:
 
     def _explain(self, sql: str, analyze: bool, session,
                  etype: Optional[str] = None) -> str:
+        if etype not in (None, "distributed", "logical", "validate"):
+            raise ValueError(
+                f"unknown EXPLAIN type {etype!r} "
+                "(supported: DISTRIBUTED, LOGICAL, VALIDATE)")
         if analyze:
+            if etype not in (None, "distributed"):
+                raise ValueError(
+                    "EXPLAIN ANALYZE only supports TYPE DISTRIBUTED")
             return self.explain_analyze_distributed(sql, session)
         if etype == "validate":
             from presto_tpu.plan.builder import plan_query
@@ -643,7 +650,7 @@ class Coordinator:
             return hit
         qp = optimize(plan_query(stmt if stmt is not None else sql,
                                  self.catalog))
-        cacheable = bool(sql) and not qp.scalar_subqueries
+        cacheable = bool(sql) and not qp.scalar_subqueries and qp.cacheable
         if qp.scalar_subqueries:
             # bind uncorrelated scalar subqueries coordinator-side first
             # (the reference runs them as separate plan stages)

@@ -44,12 +44,16 @@ _TOKEN_RE = re.compile(
 
 
 class Token:
-    __slots__ = ("kind", "value", "pos")
+    __slots__ = ("kind", "value", "pos", "quoted")
 
-    def __init__(self, kind, value, pos):
+    def __init__(self, kind, value, pos, quoted=False):
         self.kind = kind  # 'number' | 'string' | 'ident' | 'keyword' | 'op' | 'eof'
         self.value = value
         self.pos = pos
+        # "was a double-quoted identifier": quoting forces identifier
+        # interpretation (a quoted current_date is a column, never the
+        # niladic function)
+        self.quoted = quoted
 
     def __repr__(self):
         return f"Token({self.kind},{self.value!r})"
@@ -77,7 +81,8 @@ def tokenize(sql: str) -> List[Token]:
             else:
                 out.append(Token("ident", low, m.start()))
         elif m.lastgroup == "qident":
-            out.append(Token("ident", v[1:-1].replace('""', '"'), m.start()))
+            out.append(Token("ident", v[1:-1].replace('""', '"'), m.start(),
+                             quoted=True))
         elif m.lastgroup == "string":
             out.append(Token("string", v[1:-1].replace("''", "'"), m.start()))
         elif m.lastgroup == "number":
@@ -857,9 +862,10 @@ class Parser:
             return e
         # identifier or function call
         if t.kind in ("ident", "keyword"):
+            was_quoted = t.quoted
             name = self.ident()
             if name in ("current_date", "current_timestamp",
-                        "localtimestamp") and not (
+                        "localtimestamp") and not was_quoted and not (
                     self.peek().kind == "op"
                     and self.peek().value in ("(", ".")):
                 # niladic datetime functions (standard SQL: no parens)

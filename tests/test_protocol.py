@@ -276,3 +276,31 @@ def test_explain_types_and_niladic_datetime(cluster):
                            "from nation limit 1")
     d, ts, n = rows[0]
     assert str(d).startswith("20")  # an ISO date of this century
+
+
+def test_explain_and_datetime_review_fixes(cluster):
+    """Review regressions: unknown EXPLAIN types error; now() is one
+    instant per query and never served stale from the plan cache; quoted
+    identifiers are never hijacked as niladic functions."""
+    from presto_tpu.client import QueryError, execute
+
+    url = cluster.coordinator.url
+    with pytest.raises(QueryError):
+        execute(url, "explain (type io) select 1 as x from nation limit 1")
+
+    # one instant per query: equality must hold within a statement
+    _, rows = execute(url, "select (now() = current_timestamp) as same "
+                           "from nation limit 1")
+    assert rows[0][0] is True or rows[0][0] == "true"
+
+    # plan-cache staleness: two executions must observe advancing time
+    import time
+
+    _, r1 = execute(url, "select to_unixtime(now()) as t from nation limit 1")
+    time.sleep(1.1)
+    _, r2 = execute(url, "select to_unixtime(now()) as t from nation limit 1")
+    assert float(r2[0][0]) > float(r1[0][0])
+
+    # quoted identifier is a column reference, not the function
+    with pytest.raises(QueryError, match="current_date"):
+        execute(url, 'select "current_date" from nation limit 1')
