@@ -291,6 +291,21 @@ class ExprAnalyzer:
         if node.kind == "date":
             y, m, d = map(int, str(node.value).split("-"))
             return Constant(DATE, days_from_civil(y, m, d))
+        if node.kind == "timestamp":
+            s = str(node.value)
+            datepart, _, timepart = s.partition(" ")
+            y, m, d = map(int, datepart.split("-"))
+            micros = days_from_civil(y, m, d) * 86_400_000_000
+            if timepart:
+                hms, _, frac = timepart.partition(".")
+                parts = list(map(int, hms.split(":")))
+                while len(parts) < 3:
+                    parts.append(0)
+                hh, mm, ss = parts[:3]
+                micros += (hh * 3600 + mm * 60 + ss) * 1_000_000
+                if frac:
+                    micros += int(frac[:6].ljust(6, "0"))
+            return Constant(TIMESTAMP, micros, raw=True)
         raise AnalysisError(f"bad literal {node!r}")
 
     # -- operators --------------------------------------------------------

@@ -864,6 +864,11 @@ class Parser:
         if t.kind in ("ident", "keyword"):
             was_quoted = t.quoted
             name = self.ident()
+            if (name == "timestamp" and not was_quoted
+                    and self.peek().kind == "string"):
+                # TIMESTAMP 'yyyy-mm-dd[ hh:mm:ss[.ffffff]]'
+                s = self.next().value
+                return ast.Literal(s, "timestamp", s)
             if name in ("current_date", "current_timestamp",
                         "localtimestamp") and not was_quoted and not (
                     self.peek().kind == "op"

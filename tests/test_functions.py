@@ -261,3 +261,28 @@ class TestUrlHashFunctions:
         df2 = runner.run("select from_base64(to_base64(s)) as r from u "
                          "order by id")
         assert df2.r[1] == "hello"
+
+
+def test_timestamp_literals_and_comparisons():
+    conn = MemoryConnector()
+    # timestamps as int64 micros
+    base = 1_600_000_000_000_000
+    conn.add_table("e", {
+        "id": np.arange(4),
+        "ts": np.array([base, base + 3_600_000_000,
+                        base + 86_400_000_000, base + 2 * 86_400_000_000]),
+    }, {"id": BIGINT, "ts": __import__("presto_tpu.types",
+                                       fromlist=["TIMESTAMP"]).TIMESTAMP})
+    cat = Catalog()
+    cat.register("m", conn, default=True)
+    r = LocalRunner(cat, ExecConfig())
+    # base = 2020-09-13 12:26:40 UTC
+    df = r.run("select count(*) as n from e where "
+               "ts >= timestamp '2020-09-14'")
+    assert df.n[0] == 2
+    df2 = r.run("select count(*) as n from e where "
+                "ts = timestamp '2020-09-13 13:26:40'")
+    assert df2.n[0] == 1
+    df3 = r.run("select timestamp '2020-01-01 00:00:01.5' > "
+                "timestamp '2020-01-01' as b")
+    assert bool(df3.b[0])
