@@ -1,0 +1,126 @@
+"""Coordinator proxy — a thin HTTP front with coordinator failover.
+
+Reference: presto-proxy (ProxyServlet forwarding /v1/statement with
+rewritten nextUri links so clients only ever talk to the proxy). Serves
+the same purpose here: one stable address over N coordinators, health-
+checked round-robin with failover on connect errors, and response-body
+URI rewriting so paged results route back through the proxy.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import List, Optional
+
+
+_FORWARD_HEADERS = ("X-Presto-User", "X-Presto-Source", "X-Presto-Catalog",
+                    "X-Presto-Schema", "X-Presto-Session", "Authorization",
+                    "Content-Type")
+
+
+class CoordinatorProxy:
+    def __init__(self, coordinator_urls: List[str], port: int = 0):
+        self.targets = [u.rstrip("/") for u in coordinator_urls]
+        self._rr = 0
+        self._lock = threading.Lock()
+        proxy = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                pass
+
+            def _forward(self, method: str):
+                n = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(n) if n else None
+                headers = {
+                    k: v for k, v in self.headers.items()
+                    if k in _FORWARD_HEADERS
+                }
+                out, code, ctype = proxy.forward(
+                    method, self.path, body, headers)
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(out)))
+                self.end_headers()
+                self.wfile.write(out)
+
+            def do_GET(self):
+                self._forward("GET")
+
+            def do_POST(self):
+                self._forward("POST")
+
+            def do_DELETE(self):
+                self._forward("DELETE")
+
+        self._http = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        self.port = self._http.server_address[1]
+        self.url = f"http://127.0.0.1:{self.port}"
+        threading.Thread(target=self._http.serve_forever, daemon=True,
+                         name="coordinator-proxy").start()
+
+    # -- forwarding -------------------------------------------------------
+
+    def _order(self) -> List[str]:
+        with self._lock:
+            i = self._rr
+            self._rr += 1
+        return self.targets[i % len(self.targets):] + \
+            self.targets[: i % len(self.targets)]
+
+    def _rewrite(self, data: bytes, target: str) -> bytes:
+        """Point nextUri/infoUri back at the proxy so paging stays on this
+        address (ProxyResponseHandler's URI rewriting)."""
+        try:
+            doc = json.loads(data)
+        except Exception:
+            return data
+
+        def walk(x):
+            if isinstance(x, dict):
+                return {k: (v.replace(target, self.url)
+                            if isinstance(v, str) and k.lower().endswith("uri")
+                            else walk(v))
+                        for k, v in x.items()}
+            if isinstance(x, list):
+                return [walk(v) for v in x]
+            return x
+
+        return json.dumps(walk(doc)).encode()
+
+    def forward(self, method: str, path: str, body: Optional[bytes],
+                headers: dict):
+        last_err: Optional[Exception] = None
+        for target in self._order():
+            req = urllib.request.Request(
+                target + path, data=body, method=method, headers=headers)
+            try:
+                with urllib.request.urlopen(req, timeout=60) as r:
+                    data = r.read()
+                    ctype = r.headers.get("Content-Type", "application/json")
+                    if "json" in ctype:
+                        data = self._rewrite(data, target)
+                    return data, r.status, ctype
+            except urllib.error.HTTPError as e:
+                # the coordinator answered: its status IS the answer
+                data = e.read()
+                return (self._rewrite(data, target) if data else b"",
+                        e.code, e.headers.get("Content-Type",
+                                              "application/json"))
+            except Exception as e:  # connect error → fail over
+                last_err = e
+                continue
+        msg = json.dumps({"error": {
+            "message": f"no coordinator reachable: {last_err}",
+            "errorName": "PROXY_NO_TARGET", "errorType": "INTERNAL_ERROR"}})
+        return msg.encode(), 502, "application/json"
+
+    def close(self):
+        self._http.shutdown()
+        self._http.server_close()
