@@ -1531,8 +1531,65 @@ def _eval_arith(e: Call, ctx):
 
 def _eval_cast(e: Call, ctx):
     src = e.args[0]
-    v, valid = _eval_arg(src, ctx)
     st, tt = src.type, e.type
+    if st.is_string and not tt.is_string:
+        # varchar → numeric/date/boolean: parse each DICTIONARY value on
+        # the host, one device gather (codes must never be value-cast!).
+        # Unparseable values yield NULL — a documented deviation from the
+        # reference's row-level cast error (no exception channel exists on
+        # device; try(cast(..)) is therefore equivalent to cast(..))
+        d = ctx.dict_for(src)
+        if d is None:
+            raise ValueError("cast from varchar requires a dictionary")
+        import numpy as _np
+
+        from presto_tpu.types import DATE as _DATE
+
+        def parse(s: str):
+            s = s.strip()
+            if tt is _DATE:
+                y, m, dd = map(int, s.split("-"))
+                return days_from_civil(y, m, dd)
+            if tt is BOOLEAN:
+                if s.lower() in ("true", "t", "1"):
+                    return 1
+                if s.lower() in ("false", "f", "0"):
+                    return 0
+                raise ValueError(s)
+            if isinstance(tt, DecimalType):
+                import decimal as _dec
+
+                return int(_dec.Decimal(s).scaleb(tt.scale)
+                           .to_integral_value(rounding=_dec.ROUND_HALF_UP))
+            if is_floating(tt):
+                return float(s)
+            return int(float(s)) if "." in s or "e" in s.lower() else int(s)
+
+        def val_of(s):
+            try:
+                return parse(s)
+            except Exception:
+                return 0
+
+        def ok_of(s):
+            try:
+                parse(s)
+                return True
+            except Exception:
+                return False
+
+        npdt = _np.float64 if is_floating(tt) else _np.int64
+        vlut = d.int_lut(("cast_val", tt.name), val_of, dtype=npdt)
+        olut = d.int_lut(("cast_ok", tt.name), ok_of, dtype=_np.bool_)
+        codes, valid = _eval(src, ctx)
+        out = jnp.asarray(vlut)[codes + 1].astype(tt.dtype)
+        ok = jnp.asarray(olut)[codes + 1]
+        return out, ok if valid is None else (valid & ok)
+    if tt.is_string and not st.is_string:
+        raise NotImplementedError(
+            "cast to varchar from non-string types is not supported "
+            "(values would need an unbounded output dictionary)")
+    v, valid = _eval_arg(src, ctx)
     if st == tt:
         return v, valid
     sdec = isinstance(st, DecimalType)

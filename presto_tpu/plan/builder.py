@@ -542,6 +542,14 @@ class ExprAnalyzer:
                         {"ceiling": "ceil"}.get(name, name), args)
         if name == "round":
             return Call(args[0].type, "round", args)
+        if name == "try":
+            # try(expr): the reference converts row-level errors to NULL;
+            # this engine's device computations never raise and its host
+            # transforms (string casts etc.) already yield NULL on bad
+            # input — try() is the identity, kept for compatibility
+            if len(args) != 1:
+                raise AnalysisError("try() takes one argument")
+            return args[0]
         if name == "coalesce":
             t = args[0].type
             for a in args[1:]:

@@ -286,3 +286,37 @@ def test_timestamp_literals_and_comparisons():
     df3 = r.run("select timestamp '2020-01-01 00:00:01.5' > "
                 "timestamp '2020-01-01' as b")
     assert bool(df3.b[0])
+
+
+def test_varchar_casts_parse_values_not_codes():
+    """cast(varchar as x) parses dictionary VALUES host-side; unparseable
+    values yield NULL (try(cast(..)) is equivalent — documented)."""
+    conn = MemoryConnector()
+    conn.add_table("c", {
+        "s": np.array(["42", "3.5", "oops", "7", ""]),
+        "ds": np.array(["2021-01-02", "bad", "1999-12-31", "2000-02-29",
+                        "2020-06-15"]),
+        "b": np.array(["true", "FALSE", "1", "nope", "t"]),
+    })
+    cat = Catalog()
+    cat.register("m", conn, default=True)
+    r = LocalRunner(cat, ExecConfig())
+    df = r.run("select cast(s as bigint) as i, cast(s as double) as d, "
+               "try(cast(s as bigint)) as ti from c")
+    assert df.i.tolist()[0] == 42 and df.i.tolist()[3] == 7
+    assert pd.isna(df.i[2]) and pd.isna(df.i[4])
+    assert df.d[1] == 3.5
+    assert df.ti.tolist()[0] == 42 and pd.isna(df.ti[2])
+
+    df2 = r.run("select count(*) as n from c "
+                "where cast(ds as date) >= date '2020-01-01'")
+    assert df2.n[0] == 2  # bad date is NULL, not an error
+
+    df3 = r.run("select cast(b as boolean) as bb from c")
+    assert df3.bb.tolist()[0] == True  # noqa: E712
+    assert df3.bb.tolist()[1] == False  # noqa: E712
+    assert pd.isna(df3.bb[3])
+
+    # aggregate over parsed values
+    df4 = r.run("select sum(cast(s as double)) as t from c")
+    np.testing.assert_allclose(float(df4.t[0]), 42 + 3.5 + 7, rtol=1e-12)
