@@ -484,91 +484,94 @@ def _constraints_to_storage(scan: TableScan, handle):
 # -- unnest -----------------------------------------------------------------
 
 
-def _execute_unnest(node: Unnest, ctx: ExecContext) -> Iterator[Batch]:
-    """Expand structural columns into rows. TPU-native redesign of
-    operator/unnest/UnnestOperator.java: instead of walking per-position
-    offsets, output row (i, j) of the static [cap, W] element plane is live
-    iff j < max(sizes_src[i]); everything is broadcast + reshape, no
-    dynamic shapes (output capacity = cap * W, W = widest source plane)."""
+def unnest_expand(node: Unnest, b: Batch) -> Batch:
+    """Traceable core of UNNEST (shared by the streaming executor and the
+    mesh executor). TPU-native redesign of operator/unnest/
+    UnnestOperator.java: instead of walking per-position offsets, output
+    row (i, j) of the static [cap, W] element plane is live iff
+    j < max(sizes_src[i]); everything is broadcast + reshape, no dynamic
+    shapes (output capacity = cap * W, W = widest source plane)."""
+    cap = b.capacity
+    srcs = [b.column(s) for s in node.sources]
+    w = max([c.values.shape[1] for c in srcs] + [1])
 
+    counts = None
+    for c in srcs:
+        sz = c.sizes
+        if c.validity is not None:
+            sz = jnp.where(c.validity, sz, 0)
+        counts = sz if counts is None else jnp.maximum(counts, sz)
+    counts = jnp.where(b.live, counts, 0)
+    j = jnp.arange(w, dtype=jnp.int32)[None, :]
+    out_live = (j < counts[:, None]).reshape(-1)
+
+    def flat_plane(plane, width, fill):
+        """[cap, width] → [cap*w] padding columns beyond width."""
+        if width == w:
+            return plane.reshape(-1)
+        if width == 0:
+            return jnp.full(cap * w, fill, plane.dtype)
+        pad = jnp.full((cap, w - width), fill, plane.dtype)
+        return jnp.concatenate([plane, pad], axis=1).reshape(-1)
+
+    names, types, cols = [], [], []
+    dicts = {}
+    child_types = dict(node.child.output)
+    for s in node.replicate:
+        c = b.column(s)
+        cols.append(Column(
+            jnp.repeat(c.values, w, axis=0),
+            None if c.validity is None else jnp.repeat(c.validity, w),
+            None if c.hi is None else jnp.repeat(c.hi, w),
+            None if c.sizes is None else jnp.repeat(c.sizes, w),
+            None if c.evalid is None else jnp.repeat(c.evalid, w, axis=0),
+            None if c.keys is None else jnp.repeat(c.keys, w, axis=0),
+        ))
+        names.append(s)
+        types.append(child_types[s])
+        if s in b.dicts:
+            dicts[s] = b.dicts[s]
+        if s + "#keys" in b.dicts:
+            dicts[s + "#keys"] = b.dicts[s + "#keys"]
+    for src, c, syms, etypes in zip(node.sources, srcs, node.out_syms,
+                                    node.out_types):
+        cw = c.values.shape[1]
+        present = (jnp.arange(cw, dtype=jnp.int32)[None, :]
+                   < c.sizes[:, None]) if cw else jnp.zeros((cap, 0), bool)
+        evalid = present if c.evalid is None else (present & c.evalid)
+        ev_flat = flat_plane(evalid, cw, False)
+        if len(syms) == 2:  # map → (key, value)
+            cols.append(Column(flat_plane(c.keys, cw, 0),
+                               flat_plane(present, cw, False)))
+            names.append(syms[0])
+            types.append(etypes[0])
+            if src + "#keys" in b.dicts:
+                dicts[syms[0]] = b.dicts[src + "#keys"]
+            cols.append(Column(flat_plane(c.values, cw, 0), ev_flat))
+            names.append(syms[1])
+            types.append(etypes[1])
+            if src in b.dicts:
+                dicts[syms[1]] = b.dicts[src]
+        else:
+            cols.append(Column(flat_plane(c.values, cw, 0), ev_flat))
+            names.append(syms[0])
+            types.append(etypes[0])
+            if src in b.dicts:
+                dicts[syms[0]] = b.dicts[src]
+    if node.ordinality_sym:
+        ordv = jnp.broadcast_to(
+            (j + 1).astype(jnp.int64), (cap, w)).reshape(-1)
+        cols.append(Column(ordv, None))
+        names.append(node.ordinality_sym)
+        types.append(BIGINT)
+    return Batch(names, types, cols, out_live, dicts)
+
+
+def _execute_unnest(node: Unnest, ctx: ExecContext) -> Iterator[Batch]:
     in_stream, chain = _fused_child(node.child, ctx)
 
     def expand(b: Batch) -> Batch:
-        b = chain(b)
-        cap = b.capacity
-        srcs = [b.column(s) for s in node.sources]
-        w = max([c.values.shape[1] for c in srcs] + [1])
-
-        counts = None
-        for c in srcs:
-            sz = c.sizes
-            if c.validity is not None:
-                sz = jnp.where(c.validity, sz, 0)
-            counts = sz if counts is None else jnp.maximum(counts, sz)
-        counts = jnp.where(b.live, counts, 0)
-        j = jnp.arange(w, dtype=jnp.int32)[None, :]
-        out_live = (j < counts[:, None]).reshape(-1)
-
-        def flat_plane(plane, width, fill):
-            """[cap, width] → [cap*w] padding columns beyond width."""
-            if width == w:
-                return plane.reshape(-1)
-            if width == 0:
-                return jnp.full(cap * w, fill, plane.dtype)
-            pad = jnp.full((cap, w - width), fill, plane.dtype)
-            return jnp.concatenate([plane, pad], axis=1).reshape(-1)
-
-        names, types, cols = [], [], []
-        dicts = {}
-        child_types = dict(node.child.output)
-        for s in node.replicate:
-            c = b.column(s)
-            cols.append(Column(
-                jnp.repeat(c.values, w, axis=0),
-                None if c.validity is None else jnp.repeat(c.validity, w),
-                None if c.hi is None else jnp.repeat(c.hi, w),
-                None if c.sizes is None else jnp.repeat(c.sizes, w),
-                None if c.evalid is None else jnp.repeat(c.evalid, w, axis=0),
-                None if c.keys is None else jnp.repeat(c.keys, w, axis=0),
-            ))
-            names.append(s)
-            types.append(child_types[s])
-            if s in b.dicts:
-                dicts[s] = b.dicts[s]
-            if s + "#keys" in b.dicts:
-                dicts[s + "#keys"] = b.dicts[s + "#keys"]
-        for src, c, syms, etypes in zip(node.sources, srcs, node.out_syms,
-                                        node.out_types):
-            cw = c.values.shape[1]
-            present = (jnp.arange(cw, dtype=jnp.int32)[None, :]
-                       < c.sizes[:, None]) if cw else jnp.zeros((cap, 0), bool)
-            evalid = present if c.evalid is None else (present & c.evalid)
-            ev_flat = flat_plane(evalid, cw, False)
-            if len(syms) == 2:  # map → (key, value)
-                cols.append(Column(flat_plane(c.keys, cw, 0),
-                                   flat_plane(present, cw, False)))
-                names.append(syms[0])
-                types.append(etypes[0])
-                if src + "#keys" in b.dicts:
-                    dicts[syms[0]] = b.dicts[src + "#keys"]
-                cols.append(Column(flat_plane(c.values, cw, 0), ev_flat))
-                names.append(syms[1])
-                types.append(etypes[1])
-                if src in b.dicts:
-                    dicts[syms[1]] = b.dicts[src]
-            else:
-                cols.append(Column(flat_plane(c.values, cw, 0), ev_flat))
-                names.append(syms[0])
-                types.append(etypes[0])
-                if src in b.dicts:
-                    dicts[syms[0]] = b.dicts[src]
-        if node.ordinality_sym:
-            ordv = jnp.broadcast_to(
-                (j + 1).astype(jnp.int64), (cap, w)).reshape(-1)
-            cols.append(Column(ordv, None))
-            names.append(node.ordinality_sym)
-            types.append(BIGINT)
-        return Batch(names, types, cols, out_live, dicts)
+        return unnest_expand(node, chain(b))
 
     jfn = _node_jit(node, "expand", lambda: expand)
     for b in in_stream:

@@ -135,6 +135,14 @@ class MeshExecutor:
         nsplits = max(self.n_dev, -(-nrows // self.config.batch_rows))
         columns = list(scan.assignments.values())
         symbols = list(scan.assignments.keys())
+        out_types = dict(scan.output)
+        if not columns and handle.columns:
+            # COUNT(*)-style scan: stage one carrier column purely for row
+            # multiplicity (the streaming engine fabricates liveness; the
+            # mesh stager derives liveness from column data)
+            columns = [handle.columns[0].name]
+            symbols = ["__rowcount__"]
+            out_types = {"__rowcount__": handle.columns[0].type}
         splits = conn.splits(handle, nsplits)
         if sharded:
             per_dev: List[List[Batch]] = [
@@ -147,7 +155,7 @@ class MeshExecutor:
         cap = max((sum(int(np.asarray(b.live).sum()) for b in bs) or 1)
                   for bs in per_dev)
         cap = round_up_capacity(cap)
-        names, types = symbols, [dict(scan.output)[s] for s in symbols]
+        names, types = symbols, [out_types[s] for s in symbols]
         groups = len(per_dev)
         data = {}
         live = np.zeros((groups, cap), bool)
@@ -313,6 +321,28 @@ class MeshExecutor:
         if isinstance(node, Output):
             child = self._lower(node.child, fragments, staged, memo, diags)
             return child.select(node.symbols).rename(node.names)
+        from presto_tpu.plan.nodes import SetOp, Unnest
+
+        if isinstance(node, Unnest):
+            from presto_tpu.exec.runtime import unnest_expand
+
+            child = self._lower(node.child, fragments, staged, memo, diags)
+            return unnest_expand(node, child)
+        if isinstance(node, SetOp) and node.kind == "union":
+            from presto_tpu.exec.runtime import (
+                _distinct_rows,
+                _unify_batch_dicts,
+            )
+
+            left = self._lower(node.left, fragments, staged, memo, diags)
+            right = self._lower(node.right, fragments, staged, memo, diags)
+            left = left.rename(node.symbols)
+            right = right.rename(node.symbols)
+            left, right = _unify_batch_dicts([left, right])
+            merged = _trace_concat(left, right)
+            if node.all:
+                return merged
+            return _distinct_rows(merged)
         raise NotImplementedError(
             f"mesh executor: {type(node).__name__}")
 
@@ -330,6 +360,10 @@ class MeshExecutor:
             out = _all_to_all_batch(parts, self.n_dev, per_cap)
         elif f.output_partitioning in (OUT_GATHER, OUT_BROADCAST):
             out = _gather_batch(out)
+        elif f.output_partitioning == "rr":
+            # round-robin redistribution exists to balance load; on-mesh
+            # every device already holds its share — rows stay put
+            pass
         memo[fid] = out
         return out
 

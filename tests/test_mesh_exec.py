@@ -98,3 +98,23 @@ def test_semijoin(env):
     q = ("select count(*) as c from orders where o_custkey in "
          "(select c_custkey from customer where c_mktsegment = 'BUILDING')")
     _same(mx.run(q), local.run(q))
+
+
+def test_union_all_on_mesh(env):
+    """UNION ALL on-mesh: rr redistribution is the identity (every device
+    keeps its shard), the downstream aggregate runs per device."""
+    mx, local = env
+    q = ("select s, count(*) as n, sum(k) as sk from ("
+         "  select o_orderstatus as s, o_custkey as k from orders"
+         "  union all"
+         "  select o_orderpriority as s, o_orderkey as k from orders"
+         ") u group by s order by s")
+    _same(mx.run(q), local.run(q))
+
+
+def test_unnest_on_mesh(env):
+    mx, local = env
+    q = ("select e, count(*) as n from orders "
+         "cross join unnest(array[1, 2]) as u(e) "
+         "group by e order by e")
+    _same(mx.run(q), local.run(q))
