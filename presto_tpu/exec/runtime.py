@@ -2182,10 +2182,12 @@ def _execute_window(node: Window, ctx: ExecContext) -> Iterator[Batch]:
                 bounded = f.frame is not None and f.frame.startswith("rows:")
                 if f.fn == "lag":
                     v, valid = W.lag(wk, c.values, c.validity,
-                                     f.param if f.param is not None else 1)
+                                     f.param if f.param is not None else 1,
+                                     f.default)
                 elif f.fn == "lead":
                     v, valid = W.lead(wk, c.values, c.validity,
-                                      f.param if f.param is not None else 1)
+                                      f.param if f.param is not None else 1,
+                                      f.default)
                 elif bounded:
                     v, valid = W.value_over_frame(
                         wk, f.fn, c.values, c.validity, f.frame,

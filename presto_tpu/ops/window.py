@@ -160,21 +160,29 @@ def _shift_gather(values, validity, idx, ok, live):
     return v, valid
 
 
-def lag(k: WindowKeys, values, validity, offset: int = 1):
+def lag(k: WindowKeys, values, validity, offset: int = 1, default=None):
     n = values.shape[0]
     iota = jnp.arange(n)
     idx = iota - offset
     ok = idx >= k.seg_start
-    return _shift_gather(values, validity, idx, ok, k.live)
+    v, valid = _shift_gather(values, validity, idx, ok, k.live)
+    if default is not None:
+        v = jnp.where(ok, v, jnp.asarray(default, v.dtype))
+        valid = valid | (~ok & k.live)
+    return v, valid
 
 
-def lead(k: WindowKeys, values, validity, offset: int = 1):
+def lead(k: WindowKeys, values, validity, offset: int = 1, default=None):
     n = values.shape[0]
     iota = jnp.arange(n)
     idx = iota + offset
     seg_end = k.seg_start + k.seg_size - 1
     ok = idx <= seg_end
-    return _shift_gather(values, validity, idx, ok, k.live)
+    v, valid = _shift_gather(values, validity, idx, ok, k.live)
+    if default is not None:
+        v = jnp.where(ok, v, jnp.asarray(default, v.dtype))
+        valid = valid | (~ok & k.live)
+    return v, valid
 
 
 def first_value(k: WindowKeys, values, validity):

@@ -1541,6 +1541,7 @@ class Planner:
             name = w.name.lower()
             arg_sym: Optional[str] = None
             param: Optional[int] = None
+            default: Optional[object] = None
             if name in ("row_number", "rank", "dense_rank"):
                 t: Type = BIGINT
             elif name in ("percent_rank", "cume_dist"):
@@ -1552,7 +1553,14 @@ class Planner:
                 arg_sym, t = to_symbol(w.args[0])
                 param = const_int(w.args[1], f"{name} offset") if len(w.args) > 1 else 1
                 if len(w.args) > 2:
-                    raise AnalysisError(f"{name} default value not supported")
+                    de = analyzer.analyze(w.args[2])
+                    if not isinstance(de, Constant) or de.type.is_string:
+                        raise AnalysisError(
+                            f"{name} default must be a non-string literal")
+                    default = de.value
+                    if isinstance(t, DecimalType) and default is not None:
+                        # store in the column's unscaled representation
+                        default = int(round(float(default) * 10 ** t.scale))
             elif name in ("first_value", "last_value"):
                 arg_sym, t = to_symbol(w.args[0])
             elif name == "nth_value":
@@ -1577,7 +1585,8 @@ class Planner:
             if skey not in specs:
                 specs[skey] = (part_syms, order_items, [])
             specs[skey][2].append(
-                WindowFunc(wsym, name, t, arg_sym, param, frame=w.frame)
+                WindowFunc(wsym, name, t, arg_sym, param, frame=w.frame,
+                           default=default)
             )
             analyzer.replacements[key] = (wsym, t)
 

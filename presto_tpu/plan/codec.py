@@ -169,7 +169,8 @@ def node_to_json(n: PlanNode) -> Dict[str, Any]:
                 "okeys": [[s.symbol, s.ascending, s.nulls_first]
                           for s in n.order_items],
                 "funcs": [{"symbol": f.symbol, "fn": f.fn, "t": _t(f.type),
-                           "arg": f.arg, "param": f.param, "frame": f.frame}
+                           "arg": f.arg, "param": f.param, "frame": f.frame,
+                           "default": f.default}
                           for f in n.funcs]}
     if isinstance(n, Limit):
         return {"k": "limit", "child": node_to_json(n.child), "count": n.count}
@@ -248,7 +249,8 @@ def node_from_json(d: Dict[str, Any]) -> PlanNode:
             node_from_json(d["child"]), list(d["pkeys"]),
             [SortItem(s, bool(a), nf) for s, a, nf in d["okeys"]],
             [WindowFunc(f["symbol"], f["fn"], _untype(f["t"]), f.get("arg"),
-                        f.get("param"), f.get("frame")) for f in d["funcs"]],
+                        f.get("param"), f.get("frame"),
+                        default=f.get("default")) for f in d["funcs"]],
         )
     if k == "limit":
         return Limit(node_from_json(d["child"]), int(d["count"]))

@@ -268,6 +268,8 @@ class WindowFunc:
     # None = default frame (RANGE UNBOUNDED..CURRENT with ORDER BY, whole
     # partition without); "rows_unbounded_current" = explicit ROWS frame
     frame: Optional[str] = None
+    # lag/lead third argument: value when the offset leaves the partition
+    default: Optional[object] = None
 
 
 @dataclasses.dataclass

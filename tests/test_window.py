@@ -318,3 +318,12 @@ def test_rows_frame_empty_frame_is_null(runner, df):
         " from t")
     assert got.s.isna().all()
     assert (got.c == 0).all()
+
+
+def test_lag_lead_default_values(runner, sqlite_db):
+    _compare_sql(
+        runner, sqlite_db,
+        "select g, k, v,"
+        " lag(v, 1, -999) over (partition by g order by k, v) lg,"
+        " lead(v, 2, -999) over (partition by g order by k, v) ld"
+        " from t", ["g", "k", "v"])
