@@ -151,12 +151,17 @@ def ntile(k: WindowKeys, buckets: int):
 # value functions
 
 
-def _shift_gather(values, validity, idx, ok, live):
+def _shift_gather(values, validity, idx, ok, live, default=None):
+    """Gather values[idx] where `ok`; out-of-frame rows are NULL, or
+    `default` (lag/lead 3-arg form) when given."""
     n = values.shape[0]
     idx = jnp.clip(idx, 0, n - 1)
     v = values[idx]
     valid = jnp.ones(n, dtype=bool) if validity is None else validity[idx]
     valid = valid & ok & live
+    if default is not None:
+        v = jnp.where(ok, v, jnp.asarray(default, v.dtype))
+        valid = valid | (~ok & live)
     return v, valid
 
 
@@ -165,11 +170,7 @@ def lag(k: WindowKeys, values, validity, offset: int = 1, default=None):
     iota = jnp.arange(n)
     idx = iota - offset
     ok = idx >= k.seg_start
-    v, valid = _shift_gather(values, validity, idx, ok, k.live)
-    if default is not None:
-        v = jnp.where(ok, v, jnp.asarray(default, v.dtype))
-        valid = valid | (~ok & k.live)
-    return v, valid
+    return _shift_gather(values, validity, idx, ok, k.live, default)
 
 
 def lead(k: WindowKeys, values, validity, offset: int = 1, default=None):
@@ -178,11 +179,7 @@ def lead(k: WindowKeys, values, validity, offset: int = 1, default=None):
     idx = iota + offset
     seg_end = k.seg_start + k.seg_size - 1
     ok = idx <= seg_end
-    v, valid = _shift_gather(values, validity, idx, ok, k.live)
-    if default is not None:
-        v = jnp.where(ok, v, jnp.asarray(default, v.dtype))
-        valid = valid | (~ok & k.live)
-    return v, valid
+    return _shift_gather(values, validity, idx, ok, k.live, default)
 
 
 def first_value(k: WindowKeys, values, validity):

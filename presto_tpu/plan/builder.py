@@ -1554,13 +1554,37 @@ class Planner:
                 param = const_int(w.args[1], f"{name} offset") if len(w.args) > 1 else 1
                 if len(w.args) > 2:
                     de = analyzer.analyze(w.args[2])
-                    if not isinstance(de, Constant) or de.type.is_string:
+                    if not isinstance(de, Constant):
                         raise AnalysisError(
-                            f"{name} default must be a non-string literal")
-                    default = de.value
-                    if isinstance(t, DecimalType) and default is not None:
+                            f"{name} default must be a literal")
+                    if de.value is None:
+                        pass  # NULL default == no default
+                    elif t.is_string or de.type.is_string:
+                        raise AnalysisError(
+                            f"{name} default on string columns is not "
+                            "supported")
+                    elif t is BOOLEAN:
+                        if de.type is not BOOLEAN:
+                            raise AnalysisError(
+                                f"{name} default must be boolean for a "
+                                "boolean column")
+                        default = bool(de.value)
+                    elif isinstance(t, DecimalType):
                         # store in the column's unscaled representation
-                        default = int(round(float(default) * 10 ** t.scale))
+                        default = int(round(float(de.value) * 10 ** t.scale))
+                    elif is_integral(t):
+                        if float(de.value) != int(float(de.value)):
+                            raise AnalysisError(
+                                f"{name} default {de.value} does not fit "
+                                f"the {t} column (would truncate)")
+                        default = int(de.value)
+                    elif is_floating(t):
+                        default = float(de.value)
+                    elif t is DATE or t is TIMESTAMP:
+                        default = int(de.value)
+                    else:
+                        raise AnalysisError(
+                            f"{name} default unsupported for {t}")
             elif name in ("first_value", "last_value"):
                 arg_sym, t = to_symbol(w.args[0])
             elif name == "nth_value":

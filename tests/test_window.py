@@ -327,3 +327,21 @@ def test_lag_lead_default_values(runner, sqlite_db):
         " lag(v, 1, -999) over (partition by g order by k, v) lg,"
         " lead(v, 2, -999) over (partition by g order by k, v) ld"
         " from t", ["g", "k", "v"])
+
+
+def test_lag_default_type_guards(runner):
+    from presto_tpu.plan.builder import AnalysisError
+
+    # string column + any default → rejected
+    with pytest.raises(AnalysisError):
+        runner.run("select lag(g, 1, 0) over (partition by g order by k) x "
+                   "from t")
+    # fractional default on an integer column → rejected, not truncated
+    with pytest.raises(AnalysisError):
+        runner.run("select lag(k, 1, 2.5) over (partition by g order by k) x "
+                   "from t")
+    # float default on a double column works
+    df = runner.run("select g, k, x, lag(x, 1, -0.5) over "
+                    "(partition by g order by k, x) lx from t")
+    firsts = df.sort_values(["g", "k", "x"]).groupby("g").head(1)
+    assert (firsts.lx == -0.5).all()
