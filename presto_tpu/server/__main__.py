@@ -86,6 +86,8 @@ def main(argv=None):
     p.add_argument("--secret", default=None,
                    help="shared cluster secret for task endpoints")
     p.add_argument("--batch-rows", type=int, default=1 << 17)
+    p.add_argument("--run-slots", type=int, default=4,
+                   help="(worker) fair-executor run slots per worker")
     p.add_argument("--memory-pool-bytes", type=int, default=None)
     p.add_argument("--spill-dir", default=None)
     p.add_argument("--platform", default=None,
@@ -159,6 +161,7 @@ def main(argv=None):
         memory_pool_bytes=args.memory_pool_bytes,
         spill_dir=args.spill_dir,
         cluster_secret=args.secret,
+        run_slots=args.run_slots,
     )
     print(f"worker {node_id} listening on {w.url}"
           + (f", announcing to {args.coordinator_url}"

@@ -114,6 +114,11 @@ class SystemSessionProperties:
                              "Max geometric capacity growth retries", int, 24),
             PropertyMetadata("collect_stats",
                              "Per-operator stats (EXPLAIN ANALYZE)", bool, False),
+            PropertyMetadata("scan_prefetch",
+                             "Background split-prefetch depth (0 disables)",
+                             int, 2),
+            PropertyMetadata("query_retry_count",
+                             "Query-level retries on worker loss", int, 1),
             # distribution (reference: join_distribution_type:59, hash_partition_count)
             PropertyMetadata("join_distribution_type",
                              "AUTOMATIC | PARTITIONED | BROADCAST", str, "AUTOMATIC",
@@ -235,4 +240,6 @@ class Session:
             spill_enabled=self.get("spill_enabled"),
             memory_revoking_threshold=self.get("memory_revoking_threshold"),
             memory_revoking_target=self.get("memory_revoking_target"),
+            scan_prefetch=self.get("scan_prefetch"),
+            query_retry_count=self.get("query_retry_count"),
         )

@@ -350,7 +350,7 @@ class Worker:
                  memory_pool_bytes: Optional[int] = None,
                  spill_dir: Optional[str] = None,
                  revoke_threshold: float = 0.9, revoke_target: float = 0.5,
-                 cluster_secret: Optional[str] = None):
+                 cluster_secret: Optional[str] = None, run_slots: int = 4):
         from presto_tpu.memory import MemoryPool
         from presto_tpu.spiller import SpillManager
 
@@ -366,7 +366,8 @@ class Worker:
                                       revoke_target=revoke_target)
         self.spill_manager = SpillManager(spill_dir)
         self.task_manager = TaskManager(catalog, self.memory_pool,
-                                        self.spill_manager)
+                                        self.spill_manager,
+                                        run_slots=run_slots)
         self.node_state = "active"   # active | shutting_down | shut_down
         worker = self
 
