@@ -65,7 +65,21 @@ def build_catalog(specs):
 
             conn = MemoryConnector()
         else:
-            raise SystemExit(f"unknown catalog kind: {kind}")
+            # plugin connectors: any importable module exposing
+            # create_connector(**args) -> Connector (the PluginManager /
+            # ConnectorFactory SPI analog — discovery by module path
+            # instead of a plugin directory scan)
+            import importlib
+
+            try:
+                mod = importlib.import_module(kind)
+            except ImportError:
+                raise SystemExit(f"unknown catalog kind: {kind}")
+            factory = getattr(mod, "create_connector", None)
+            if factory is None:
+                raise SystemExit(
+                    f"plugin module {kind} has no create_connector()")
+            conn = factory(**args)
         cat.register(name or kind, conn, default=(i == 0))
     return cat
 

@@ -91,3 +91,28 @@ def test_cluster_introspection(cluster):
     assert [n["nodeId"] for n in nodes] == ["w1"]
     info = json.loads(urllib.request.urlopen(f"{cluster}/v1/cluster").read())
     assert info["activeWorkers"] == 1
+
+
+def test_plugin_connector_loading(tmp_path, monkeypatch):
+    """Catalog specs resolve unknown kinds as plugin modules exposing
+    create_connector(**args) (ConnectorFactory SPI analog)."""
+    import sys
+
+    plugin = tmp_path / "my_plugin.py"
+    plugin.write_text(
+        "import numpy as np\n"
+        "from presto_tpu.catalog.memory import MemoryConnector\n"
+        "def create_connector(rows='5'):\n"
+        "    c = MemoryConnector()\n"
+        "    c.add_table('p', {'x': np.arange(int(rows))})\n"
+        "    return c\n")
+    monkeypatch.syspath_prepend(str(tmp_path))
+    from presto_tpu.server.__main__ import build_catalog
+
+    cat = build_catalog(["ext=my_plugin:rows=7"])
+    assert "ext" in cat.connectors
+    from presto_tpu.exec import ExecConfig, LocalRunner
+
+    r = LocalRunner(cat, ExecConfig())
+    assert r.run("select count(*) as n from ext.p").n[0] == 7
+    sys.modules.pop("my_plugin", None)
