@@ -583,7 +583,7 @@ def _execute_unnest(node: Unnest, ctx: ExecContext) -> Iterator[Batch]:
 _VARIANCE_FNS = {"var_samp", "var_pop", "stddev_samp", "stddev_pop"}
 _COVAR_FNS = {"covar_pop", "covar_samp", "corr"}
 _NON_DECOMPOSABLE_FNS = {"approx_percentile", "__approx_percentile_w",
-                         "max_by", "min_by", "array_agg",
+                         "max_by", "min_by", "array_agg", "map_agg",
                          "count_distinct", "sum_distinct", "avg_distinct"}
 
 _CHECKSUM_NULL = jnp.int64(-7046029254386353131)  # fixed NULL contribution
@@ -842,8 +842,9 @@ def _execute_materialized_aggregate(node: Aggregate, ctx: ExecContext) -> Iterat
     key_types = [in_types[k] for k in key_syms]
     decomp = [a for a in node.aggs if a.fn not in _NON_DECOMPOSABLE_FNS]
     ndec = [a for a in node.aggs
-            if a.fn in _NON_DECOMPOSABLE_FNS and a.fn != "array_agg"]
-    arr_aggs = [a for a in node.aggs if a.fn == "array_agg"]
+            if a.fn in _NON_DECOMPOSABLE_FNS
+            and a.fn not in ("array_agg", "map_agg")]
+    arr_aggs = [a for a in node.aggs if a.fn in ("array_agg", "map_agg")]
     layout = _asl(decomp, in_types)
     state_types = _sts(layout, in_types)
     jchain = _node_jit(node, "mat_chain", lambda: chain)
@@ -918,28 +919,57 @@ def _attach_array_aggs(acc: Batch, full: Batch, aggs, key_syms) -> Batch:
         )
         row_gi[r] = gmap[key]
     for a in aggs:
+        is_map = a.fn == "map_agg"
         c = full.column(a.arg)
         vals = np.asarray(c.values)[live]
         valid = np.asarray(c.valid_mask())[live]
+        if is_map:
+            # map_agg(k, v): k drives placement (first occurrence of each
+            # key per group wins, like MapAggregation's first-write), v is
+            # the stored element
+            vc = full.column(a.arg2)
+            mvals = np.asarray(vc.values)[live]
+            mvalid = np.asarray(vc.valid_mask())[live]
         sizes = np.zeros(cap, np.int32)
         np.add.at(sizes, row_gi, 1)
         w = max(int(sizes.max()) if cap else 0, 1)
-        plane = np.zeros((cap, w), dtype=c.values.dtype)
+        plane = np.zeros(
+            (cap, w), dtype=(mvals.dtype if is_map else vals.dtype))
+        kplane = np.zeros((cap, w), dtype=vals.dtype) if is_map else None
         evalid = np.zeros((cap, w), bool)
         slot = np.zeros(cap, np.int32)
+        seen: dict = {}
         for r in range(nrows):
             gi = row_gi[r]
-            j = slot[gi]
-            plane[gi, j] = vals[r]
-            evalid[gi, j] = valid[r]
+            if is_map:
+                if not valid[r]:
+                    continue  # NULL keys are dropped
+                kk = (gi, vals[r].item())
+                if kk in seen:
+                    continue
+                seen[kk] = True
+                j = slot[gi]
+                kplane[gi, j] = vals[r]
+                plane[gi, j] = mvals[r]
+                evalid[gi, j] = mvalid[r]
+            else:
+                j = slot[gi]
+                plane[gi, j] = vals[r]
+                evalid[gi, j] = valid[r]
             slot[gi] = j + 1
+        if is_map:
+            sizes = slot  # deduped per-group entry counts
         acc = acc.with_column(
             a.symbol, a.type,
             Column(jnp.asarray(plane), None,
                    sizes=jnp.asarray(sizes),
-                   evalid=jnp.asarray(evalid)),
-            dictionary=full.dicts.get(a.arg),
+                   evalid=jnp.asarray(evalid),
+                   keys=None if kplane is None else jnp.asarray(kplane)),
+            dictionary=(full.dicts.get(a.arg2) if is_map
+                        else full.dicts.get(a.arg)),
         )
+        if is_map and a.arg in full.dicts:
+            acc.dicts[a.symbol + "#keys"] = full.dicts[a.arg]
     return acc
 
 

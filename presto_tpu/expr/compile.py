@@ -597,6 +597,8 @@ _STRUCT_ONLY_FNS = {
     "repeat", "map", "map_keys", "map_values",
     "transform", "filter", "reduce", "any_match", "all_match", "none_match",
     "transform_values", "map_filter",
+    "array_union", "array_intersect", "array_except", "arrays_overlap",
+    "map_concat",
 }
 # polymorphic names: structural only when the first arg is ARRAY/MAP
 _STRUCT_POLY_FNS = {"cardinality", "contains", "concat", "element_at",
@@ -1065,6 +1067,32 @@ def _array_ctor_dict(e: Call, ctx: CompileContext) -> Dictionary | None:
     return d
 
 
+def _setop_elem_dict(e: Call, ctx: CompileContext) -> Dictionary | None:
+    """Merged element dictionary across every operand of an array/map
+    set-style function (codes must share one space to compare)."""
+    from presto_tpu.types import ArrayType as _AT, MapType as _MT
+
+    t0 = e.args[0].type
+    elem = t0.element if isinstance(t0, _AT) else t0.value
+    if not elem.is_string:
+        return None
+    d = None
+    for a in e.args:
+        ad = _elem_dict(a, ctx)
+        if ad is not None:
+            d = ad if d is None or d is ad else Dictionary.merge(d, ad)
+    return d
+
+
+def _setop_key_dict(e: Call, ctx: CompileContext) -> Dictionary | None:
+    d = None
+    for a in e.args:
+        ad = _key_dict(a, ctx)
+        if ad is not None:
+            d = ad if d is None or d is ad else Dictionary.merge(d, ad)
+    return d
+
+
 def _elem_dict(e: RowExpression, ctx: CompileContext) -> Dictionary | None:
     """Dictionary of a structural expression's (string) element plane."""
     if isinstance(e, InputRef):
@@ -1076,6 +1104,9 @@ def _elem_dict(e: RowExpression, ctx: CompileContext) -> Dictionary | None:
             return _elem_dict(e.args[1], ctx)
         if e.fn == "map_keys":
             return _key_dict(e.args[0], ctx)
+        if e.fn in ("array_union", "array_intersect", "array_except",
+                    "map_concat"):
+            return _setop_elem_dict(e, ctx)
         if e.fn in ("transform", "transform_values"):
             # output element dict = the body's dict with the params bound
             # to the input's element/key dicts (dict transforms are
@@ -1107,6 +1138,8 @@ def _key_dict(e: RowExpression, ctx: CompileContext) -> Dictionary | None:
             return _elem_dict(e.args[0], ctx)
         if e.fn in ("transform_values", "map_filter"):
             return _key_dict(e.args[0], ctx)
+        if e.fn == "map_concat":
+            return _setop_key_dict(e, ctx)
         for a in e.args:
             if isinstance(a.type, MapType):
                 d = _key_dict(a, ctx)
@@ -1248,6 +1281,44 @@ def _eval_structural(e: Call, ctx: CompileContext):
         return _struct.map_keys(sv), rvalid
     if fn == "map_values":
         return _struct.map_values(sv), rvalid
+    if fn in ("array_union", "array_intersect", "array_except",
+              "arrays_overlap", "map_concat"):
+        t0 = e.args[0].type
+        target = _setop_elem_dict(e, ctx)
+        ktarget = (_setop_key_dict(e, ctx)
+                   if fn == "map_concat" and t0.key.is_string else None)
+
+        def aligned(arg, s):
+            if target is not None:
+                d = _elem_dict(arg, ctx)
+                if d is not None and d is not target:
+                    remap = jnp.asarray(d.map_to(target))
+                    s = s._replace(
+                        values=remap[s.values.astype(jnp.int32) + 1])
+            if ktarget is not None:
+                d = _key_dict(arg, ctx)
+                if d is not None and d is not ktarget:
+                    remap = jnp.asarray(d.map_to(ktarget))
+                    s = s._replace(
+                        keys=remap[s.keys.astype(jnp.int32) + 1])
+            return s
+
+        out, valid = aligned(e.args[0], sv), rvalid
+        for a in e.args[1:]:
+            osv, ovalid = _eval(a, ctx)
+            osv = aligned(a, osv)
+            valid = _and_valid(valid, ovalid)
+            if fn == "array_union":
+                out = _struct.array_union(out, osv)
+            elif fn == "array_intersect":
+                out = _struct.array_intersect(out, osv)
+            elif fn == "array_except":
+                out = _struct.array_except(out, osv)
+            elif fn == "map_concat":
+                out = _struct.map_concat(out, osv)
+            else:
+                return _struct.arrays_overlap(out, osv), valid
+        return out, valid
     if fn in ("transform", "filter", "any_match", "all_match", "none_match"):
         return _eval_higher_order(e, ctx, sv, rvalid)
     if fn in ("transform_values", "map_filter"):

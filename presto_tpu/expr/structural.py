@@ -297,6 +297,72 @@ def repeat_val(v, v_valid, n: int, cap: int, dtype) -> StructVal:
     return StructVal(vals, jnp.full(cap, n, jnp.int32), evalid)
 
 
+def _membership(a: StructVal, b: StructVal) -> jnp.ndarray:
+    """[cap, Wa] mask: a's element equals ANY present non-null element of
+    b (elementwise [cap, Wa, Wb] compare — widths are small statics)."""
+    if a.width == 0 or b.width == 0:
+        return jnp.zeros(a.values.shape, bool)
+    eq = a.values[:, :, None] == b.values[:, None, :]
+    eq = eq & b.element_valid()[:, None, :]
+    return jnp.any(eq, axis=2)
+
+
+def array_union(a: StructVal, b: StructVal) -> StructVal:
+    return array_distinct(concat_arrays(a, b))
+
+
+def array_intersect(a: StructVal, b: StructVal) -> StructVal:
+    keep = a.element_valid() & _membership(a, b)
+    return array_distinct(filter_elements(a, keep))
+
+
+def array_except(a: StructVal, b: StructVal) -> StructVal:
+    keep = a.element_valid() & ~_membership(a, b)
+    return array_distinct(filter_elements(a, keep))
+
+
+def arrays_overlap(a: StructVal, b: StructVal) -> jnp.ndarray:
+    return jnp.any(a.element_valid() & _membership(a, b), axis=1)
+
+
+def map_concat(a: StructVal, b: StructVal) -> StructVal:
+    """map_concat(m1, m2): m2 wins on duplicate keys. Concatenate the
+    aligned planes, then keep the LAST occurrence of each key: one stable
+    sort along W by key, runs scanned right-to-left."""
+    w = a.width + b.width
+    cap = a.sizes.shape[0]
+    if w == 0:
+        return a
+
+    def cat_plane(pa, pb, fill, dtype):
+        pa = pa if pa is not None else jnp.full((cap, a.width), fill, dtype)
+        pb = pb if pb is not None else jnp.full((cap, b.width), fill, dtype)
+        return jnp.concatenate([pa.astype(dtype), pb.astype(dtype)], axis=1)
+
+    keys = cat_plane(a.keys, b.keys, 0, a.keys.dtype)
+    vals = cat_plane(a.values, b.values, 0, a.values.dtype)
+    present = jnp.concatenate([a.present(), b.present()], axis=1)
+    evalid = jnp.concatenate([a.element_valid(), b.element_valid()], axis=1)
+    pos = jnp.broadcast_to(jnp.arange(w, dtype=jnp.int32)[None, :],
+                           (cap, w))
+    # absent slots sort last; within a key run, position ascending —
+    # the LAST position of each run is the winning (m2) entry
+    krank = jnp.where(present, jnp.int64(0), jnp.int64(1))
+    krank_s, keys_s, pos_s, vals_s, ev_s = jax.lax.sort(
+        (krank, keys.astype(jnp.int64), pos, vals, evalid.astype(jnp.int32)),
+        dimension=1, num_keys=3)
+    present_s = krank_s == 0
+    next_same = jnp.zeros((cap, w), bool).at[:, :-1].set(
+        (keys_s[:, :-1] == keys_s[:, 1:]) & present_s[:, 1:])
+    keep = present_s & ~next_same
+    # pre-filter StructVal treats every slot as present (sizes=w) so
+    # filter_elements sees the true element validity at the ORIGINAL slot
+    # positions; it recomputes sizes from `keep` after compaction
+    out = StructVal(vals_s, jnp.full(cap, w, jnp.int32),
+                    ev_s.astype(bool), keys=keys_s.astype(a.keys.dtype))
+    return filter_elements(out, keep)
+
+
 def filter_elements(sv: StructVal, keep: jnp.ndarray) -> StructVal:
     """Keep elements where `keep` is True, compacted to the front with
     original order preserved: one stable sort along W by the drop flag

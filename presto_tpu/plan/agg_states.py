@@ -25,7 +25,7 @@ _VARIANCE_FNS = {"variance", "var_samp", "var_pop", "stddev", "stddev_samp",
                  "stddev_pop"}
 _COVAR_FNS = {"covar_pop", "covar_samp"}
 _NON_DECOMPOSABLE = {"approx_percentile", "__approx_percentile_w",
-                     "max_by", "min_by", "array_agg",
+                     "max_by", "min_by", "array_agg", "map_agg",
                      "count_distinct", "sum_distinct", "avg_distinct"}
 
 

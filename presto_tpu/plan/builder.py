@@ -234,15 +234,17 @@ _AGG_FUNCS = {
     "approx_distinct", "approx_percentile",
     # argmax family (AbstractMinMaxBy)
     "max_by", "min_by",
-    # structural (ArrayAggregationFunction — materialized single-task here)
-    "array_agg",
+    # structural (ArrayAggregationFunction / MapAggregation — materialized
+    # single-task here)
+    "array_agg", "map_agg",
 }
 
 # aliases → canonical names
 _AGG_CANON = {"every": "bool_and", "any_value": "arbitrary",
               "stddev": "stddev_samp", "variance": "var_samp"}
 
-_TWO_ARG_AGGS = {"covar_pop", "covar_samp", "corr", "max_by", "min_by"}
+_TWO_ARG_AGGS = {"covar_pop", "covar_samp", "corr", "max_by", "min_by",
+                 "map_agg"}
 
 
 # ---------------------------------------------------------------------------
@@ -806,6 +808,30 @@ class ExprAnalyzer:
                     raise AnalysisError("concat mixes ARRAY and non-ARRAY")
                 out = ArrayType(common_super_type(out.element, a.type.element))
             return Call(out, "concat", args)
+        if name in ("array_union", "array_intersect", "array_except"):
+            if len(args) != 2 or not all(
+                    isinstance(a.type, ArrayType) for a in args):
+                raise AnalysisError(f"{name} expects two ARRAY arguments")
+            et = common_super_type(args[0].type.element,
+                                   args[1].type.element)
+            return Call(ArrayType(et), name, args)
+        if name == "arrays_overlap":
+            if len(args) != 2 or not all(
+                    isinstance(a.type, ArrayType) for a in args):
+                raise AnalysisError("arrays_overlap expects two ARRAYs")
+            return Call(BOOLEAN, name, args)
+        if name == "map_concat":
+            if len(args) < 2 or not all(
+                    isinstance(a.type, MapType) for a in args):
+                raise AnalysisError("map_concat expects MAP arguments")
+            t = args[0].type
+            for a in args[1:]:
+                if a.type.key.name != t.key.name:
+                    raise AnalysisError("map_concat key types differ")
+            if is_floating(t.key):
+                raise AnalysisError(
+                    "map_concat with floating-point keys is not supported")
+            return Call(t, "map_concat", args)
         return None
 
     def _an_Parameter(self, node: "ast.Parameter") -> RowExpression:
@@ -1711,6 +1737,7 @@ class Planner:
                     if len(fc.args) < 2:
                         raise AnalysisError(f"{fn} takes two arguments")
                     ae2 = analyzer.analyze(fc.args[1])
+                    arg2_t = ae2.type
                     if isinstance(ae2, InputRef):
                         arg2_sym = ae2.name
                     else:
@@ -1728,7 +1755,13 @@ class Planner:
                     param = float(pe.value)
                     if not 0.0 <= param <= 1.0:
                         raise AnalysisError("percentile must be in [0, 1]")
-            out_t = _agg_output_type(fn, arg_t, fc.is_star)
+            if fn == "map_agg":
+                if arg_t.is_string is False and is_floating(arg_t):
+                    raise AnalysisError(
+                        "map_agg with floating-point keys is not supported")
+                out_t = MapType(arg_t, arg2_t)
+            else:
+                out_t = _agg_output_type(fn, arg_t, fc.is_star)
             sym = self.symbols.fresh(fn)
             agg_specs.append(AggSpec(sym, "count_star" if fc.is_star else fn,
                                      arg_sym, out_t, distinct,

@@ -37,8 +37,10 @@ _SCALAR = {
     "array": ["cardinality", "element_at", "contains", "array_position",
               "array_min", "array_max", "array_sum", "array_average",
               "array_distinct", "array_sort", "slice", "sequence",
-              "repeat", "concat"],
-    "map": ["map", "map_keys", "map_values", "element_at", "cardinality"],
+              "repeat", "concat", "array_union", "array_intersect",
+              "array_except", "arrays_overlap"],
+    "map": ["map", "map_keys", "map_values", "element_at", "cardinality",
+            "map_concat"],
     "lambda": ["transform", "filter", "reduce", "any_match", "all_match",
                "none_match", "transform_values", "map_filter"],
 }
@@ -48,7 +50,7 @@ _AGGREGATE = ["count", "sum", "avg", "min", "max", "stddev", "stddev_pop",
               "covar_samp", "corr", "geometric_mean", "bool_and", "bool_or",
               "every", "arbitrary", "any_value", "checksum", "count_if",
               "approx_distinct", "approx_percentile", "max_by", "min_by",
-              "array_agg"]
+              "array_agg", "map_agg"]
 
 _WINDOW = ["row_number", "rank", "dense_rank", "percent_rank", "cume_dist",
            "ntile", "lag", "lead", "first_value", "last_value", "nth_value"]

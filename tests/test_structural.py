@@ -372,3 +372,51 @@ class TestMapLambdas:
                   "select id, transform_values(m, (k, v) -> v + id) as mm "
                   "from t where id = 2")
         assert df["mm"][0] == {"x": 12.0}
+
+
+class TestArraySetFunctions:
+    def test_union_intersect_except(self, runner):
+        df = rows(runner,
+                  "select array_union(array[1,2,2], array[2,3]) as u, "
+                  "array_intersect(array[1,2,3], array[2,3,4]) as i, "
+                  "array_except(array[1,2,3], array[2]) as e, "
+                  "arrays_overlap(array[1,2], array[2,9]) as o1, "
+                  "arrays_overlap(array[1,2], array[8,9]) as o2")
+        assert df.u[0] == [1, 2, 3]
+        assert df.i[0] == [2, 3]
+        assert df.e[0] == [1, 3]
+        assert bool(df.o1[0]) and not bool(df.o2[0])
+
+    def test_string_array_set_ops_cross_dictionary(self, runner):
+        # tags column dict vs literal-ctor dict: codes must align
+        df = rows(runner,
+                  "select id, array_intersect(tags, array['a', 'zzz']) as i "
+                  "from t order by id")
+        assert df.i[0] == ["a"] and df.i[1] == [] and df.i[2] == ["a"]
+
+    def test_map_concat(self, runner):
+        df = rows(runner,
+                  "select map_concat(map(array['a','b'], array[1,2]), "
+                  "map(array['b','c'], array[20,30])) as m")
+        assert df.m[0] == {"a": 1, "b": 20, "c": 30}  # right side wins
+
+    def test_map_agg(self, runner):
+        df = rows(runner,
+                  "select map_agg(name, id) as m from s")
+        assert df.m[0] == {"one": 1, "two": 2, "three": 3, "four": 4}
+
+    def test_map_agg_grouped(self, runner):
+        conn = MemoryConnector()
+        conn.add_table("kv", {
+            "g": np.array([0, 0, 1, 1, 1]),
+            "k": np.array(["x", "y", "x", "z", "x"]),
+            "v": np.array([1.0, 2.0, 3.0, 4.0, 5.0]),
+        })
+        cat = Catalog()
+        cat.register("m", conn, default=True)
+        r = LocalRunner(cat, ExecConfig())
+        df = r.run("select g, map_agg(k, v) as m from kv group by g "
+                   "order by g")
+        assert df.m[0] == {"x": 1.0, "y": 2.0}
+        # duplicate key 'x' in group 1: first occurrence wins
+        assert df.m[1] == {"x": 3.0, "z": 4.0}
