@@ -598,7 +598,7 @@ _STRUCT_ONLY_FNS = {
     "transform", "filter", "reduce", "any_match", "all_match", "none_match",
     "transform_values", "map_filter",
     "array_union", "array_intersect", "array_except", "arrays_overlap",
-    "map_concat",
+    "map_concat", "zip_with",
 }
 # polymorphic names: structural only when the first arg is ARRAY/MAP
 _STRUCT_POLY_FNS = {"cardinality", "contains", "concat", "element_at",
@@ -1232,6 +1232,9 @@ def _eval_structural(e: Call, ctx: CompileContext):
     if fn == "reduce":
         return _eval_reduce(e, ctx)
 
+    if fn == "zip_with":
+        return _eval_zip_with(e, ctx)
+
     # remaining forms evaluate their structural operand first
     sv, rvalid = _eval(e.args[0], ctx)
     t0 = e.args[0].type
@@ -1434,6 +1437,38 @@ def _eval_higher_order(e: Call, ctx: CompileContext, sv: StructVal, rvalid):
     if fn == "all_match":
         return jnp.all(truth | ~present, axis=1), rvalid
     return ~jnp.any(truth & present, axis=1), rvalid  # none_match
+
+
+def _eval_zip_with(e: Call, ctx: CompileContext):
+    """zip_with(a, b, (x, y) -> ...): planes pad to the longer array (the
+    shorter side's missing elements are NULL params — Presto's padding);
+    the lambda body evaluates once over the paired flattened planes."""
+    from presto_tpu.expr.structural import pad_plane_width
+
+    asv, avalid = _eval(e.args[0], ctx)
+    bsv, bvalid = _eval(e.args[1], ctx)
+    le: LambdaExpr = e.args[2]
+    (xsym, xt), (ysym, yt) = le.params
+    cap = ctx.batch.capacity
+    w = max(asv.width, bsv.width, 1)
+    av = pad_plane_width(asv.values, w)
+    bv = pad_plane_width(bsv.values, w)
+    aev = pad_plane_width(asv.element_valid(), w, False)
+    bev = pad_plane_width(bsv.element_valid(), w, False)
+    xdict = _elem_dict(e.args[0], ctx) if xt.is_string else None
+    ydict = _elem_dict(e.args[1], ctx) if yt.is_string else None
+    eb, extra = _element_batch(ctx, w, [
+        (xsym, xt, av.reshape(-1), aev.reshape(-1), xdict),
+        (ysym, yt, bv.reshape(-1), bev.reshape(-1), ydict),
+    ])
+    bctx = CompileContext(eb, ctx.out_dict, extra)
+    ov, ovalid = _eval(le.body, bctx)
+    ov = jnp.broadcast_to(ov, (cap * w,)).reshape(cap, w)
+    ovalid2 = (jnp.broadcast_to(ovalid, (cap * w,)).reshape(cap, w)
+               if ovalid is not None else None)
+    sizes = jnp.maximum(asv.sizes, bsv.sizes)
+    out = StructVal(ov.astype(le.type.dtype), sizes, ovalid2)
+    return out, _and_valid(avalid, bvalid)
 
 
 def _eval_reduce(e: Call, ctx: CompileContext):

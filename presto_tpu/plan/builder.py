@@ -528,7 +528,7 @@ class ExprAnalyzer:
             raise AnalysisError(f"aggregate {name}() not allowed here")
         if name in ("transform", "filter", "reduce", "any_match",
                     "all_match", "none_match", "transform_values",
-                    "map_filter"):
+                    "map_filter", "zip_with"):
             return self._an_higher_order(name, node)
         args = tuple(self.analyze(a) for a in node.args)
         structural = self._an_structural_fn(name, args)
@@ -683,6 +683,18 @@ class ExprAnalyzer:
         if len(node.args) < 2:
             raise AnalysisError(f"{name} expects an array and a lambda")
         arr = self.analyze(node.args[0])
+        if name == "zip_with":
+            if len(node.args) != 3:
+                raise AnalysisError(
+                    "zip_with(array, array, (x, y) -> ...) expects 3 "
+                    "arguments")
+            arr2 = self.analyze(node.args[1])
+            if not isinstance(arr.type, ArrayType) or not isinstance(
+                    arr2.type, ArrayType):
+                raise AnalysisError("zip_with requires two ARRAYs")
+            le = self._an_lambda(node.args[2],
+                                 [arr.type.element, arr2.type.element])
+            return Call(ArrayType(le.type), "zip_with", (arr, arr2, le))
         if name in ("transform_values", "map_filter"):
             if not isinstance(arr.type, MapType):
                 raise AnalysisError(f"{name} requires MAP, got {arr.type}")

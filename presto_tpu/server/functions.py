@@ -42,7 +42,7 @@ _SCALAR = {
     "map": ["map", "map_keys", "map_values", "element_at", "cardinality",
             "map_concat"],
     "lambda": ["transform", "filter", "reduce", "any_match", "all_match",
-               "none_match", "transform_values", "map_filter"],
+               "none_match", "transform_values", "map_filter", "zip_with"],
 }
 
 _AGGREGATE = ["count", "sum", "avg", "min", "max", "stddev", "stddev_pop",

@@ -420,3 +420,23 @@ class TestArraySetFunctions:
         assert df.m[0] == {"x": 1.0, "y": 2.0}
         # duplicate key 'x' in group 1: first occurrence wins
         assert df.m[1] == {"x": 3.0, "z": 4.0}
+
+
+class TestZipWith:
+    def test_zip_with(self, runner):
+        df = rows(runner,
+                  "select zip_with(array[1,2,3], array[10,20,30], "
+                  "(x, y) -> x + y) as z")
+        assert df.z[0] == [11, 22, 33]
+
+    def test_zip_with_uneven_pads_null(self, runner):
+        df = rows(runner,
+                  "select zip_with(array[1,2,3], array[10], "
+                  "(x, y) -> coalesce(y, 0) + x) as z")
+        assert df.z[0] == [11, 2, 3]
+
+    def test_zip_with_table_columns(self, runner):
+        df = rows(runner,
+                  "select id, zip_with(arr, arr, (x, y) -> x * y) as sq "
+                  "from t where id = 2")
+        assert df.sq[0] == [16, 25]
