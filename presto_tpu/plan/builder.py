@@ -607,6 +607,14 @@ class ExprAnalyzer:
             if name == "current_date":
                 return Constant(DATE, int(now_s // 86400), raw=True)
             return Constant(TIMESTAMP, int(now_s * 1e6), raw=True)
+        if name == "typeof":
+            if len(args) != 1:
+                raise AnalysisError("typeof() takes one argument")
+            return Constant(VARCHAR, str(args[0].type))
+        if name == "version":
+            import presto_tpu
+
+            return Constant(VARCHAR, f"presto-tpu {presto_tpu.__version__}")
         if name == "pi":
             return Constant(DOUBLE, 3.141592653589793, raw=True)
         if name in ("e",):

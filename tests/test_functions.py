@@ -320,3 +320,16 @@ def test_varchar_casts_parse_values_not_codes():
     # aggregate over parsed values
     df4 = r.run("select sum(cast(s as double)) as t from c")
     np.testing.assert_allclose(float(df4.t[0]), 42 + 3.5 + 7, rtol=1e-12)
+
+
+def test_typeof_and_version():
+    conn = MemoryConnector()
+    conn.add_table("t", {"x": np.arange(3.0)})
+    cat = Catalog()
+    cat.register("m", conn, default=True)
+    r = LocalRunner(cat, ExecConfig())
+    df = r.run("select typeof(x) as t, typeof(array[1]) as ta, "
+               "version() as v from t limit 1")
+    assert df.t[0] == "double"
+    assert df.ta[0] == "array(bigint)"
+    assert df.v[0].startswith("presto-tpu")
