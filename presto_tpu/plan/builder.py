@@ -612,6 +612,8 @@ class ExprAnalyzer:
                 raise AnalysisError("typeof() takes one argument")
             return Constant(VARCHAR, str(args[0].type))
         if name == "version":
+            if args:
+                raise AnalysisError("version() takes no arguments")
             import presto_tpu
 
             return Constant(VARCHAR, f"presto-tpu {presto_tpu.__version__}")
