@@ -1799,10 +1799,20 @@ class Planner:
             pct_aggs = [a for a in agg_specs if a.fn == "approx_percentile"]
             distinct_aggs = [a for a in agg_specs if a.distinct]
             if hll_aggs:
-                if len(agg_specs) != 1:
-                    raise AnalysisError(
-                        "approx_distinct mixed with other aggregates not supported yet")
-                return self._plan_hll(pre, gsyms, agg_specs[0], pre_exprs, node)
+                if len(agg_specs) == 1:
+                    return self._plan_hll(pre, gsyms, agg_specs[0],
+                                          pre_exprs, node)
+                # mixed with other aggregates: the HLL lowering reshapes
+                # the whole plan (registers become group rows), so fall
+                # back to EXACT count-distinct on the sorted materialized
+                # path — exactness trivially satisfies the approximation
+                # contract; only the mergeable-sketch scaling is lost
+                agg_specs_local = [
+                    (AggSpec(a.symbol, "count_distinct", a.arg, a.type,
+                             False) if a.fn == "approx_distinct" else a)
+                    for a in agg_specs
+                ]
+                return Aggregate(pre, gsyms, agg_specs_local, step="single")
             if (pct_aggs and len(agg_specs) == len(pct_aggs)
                     and len({a.arg for a in pct_aggs}) == 1
                     and not any(a.distinct for a in pct_aggs)):

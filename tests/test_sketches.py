@@ -172,3 +172,18 @@ def test_percentile_mixed_with_other_aggs_still_works(env):
     exact = float(np.quantile(vals, 0.5, method="inverted_cdf"))
     assert float(out.q[0]) == exact  # exact path
     assert int(out.n[0]) == len(vals)
+
+
+def test_approx_distinct_mixed_with_other_aggs(env):
+    """Mixed forms fall back to exact count-distinct (satisfies the
+    approximation contract; loses only sketch mergeability)."""
+    runner, vals, grp, *_ = env
+    out = runner.run("select g, approx_distinct(v) as d, count(*) as n, "
+                     "sum(v) as s from t group by g order by g")
+    import numpy as np
+
+    for g in range(5):
+        exact = len(np.unique(vals[grp == g]))
+        row = out[out.g == g]
+        assert int(row.d.iloc[0]) == exact       # exact, not estimated
+        assert int(row.n.iloc[0]) == int((grp == g).sum())
