@@ -154,6 +154,8 @@ class _PqTable:
     version: tuple = (0, 0)
     # flattened ROW leaves: dotted column name -> (struct column, field)
     nested: Dict[str, tuple] = dataclasses.field(default_factory=dict)
+    # scaled-writer part tables: virtual row-group index -> (file, rg)
+    part_map: Optional[list] = None
 
 
 class ParquetConnector(DeviceSplitCache, Connector):
@@ -186,6 +188,9 @@ class ParquetConnector(DeviceSplitCache, Connector):
         for f in os.listdir(self.directory):
             if f.endswith(".parquet"):
                 out.append(f[: -len(".parquet")])
+            elif f.endswith(".parts") and os.path.isdir(
+                    os.path.join(self.directory, f)):
+                out.append(f[: -len(".parts")])
         return sorted(out)
 
     @staticmethod
@@ -200,10 +205,135 @@ class ParquetConnector(DeviceSplitCache, Connector):
         if t is None:
             return
         try:
+            if t.part_map is not None:
+                st = os.stat(t.path)  # the parts directory
+                nparts = len([f for f in os.listdir(t.path)
+                              if f.endswith(".parquet")])
+                if (st.st_mtime_ns, nparts) != t.version:
+                    self._invalidate_table(name)
+                return
             if self._file_version(t.path) != t.version:
                 self._invalidate_table(name)
         except OSError:
             self._invalidate_table(name)
+
+    # -- scaled writers (SCALED_WRITER_DISTRIBUTION analog) ---------------
+    # A table is either one <name>.parquet file or a <name>.parts/
+    # directory of part-*.parquet files written concurrently by writer
+    # tasks; readers treat every (file, row group) as a split.
+
+    def supports_scaled_writes(self) -> bool:
+        return True
+
+    def parts_dir(self, name: str, staging: bool = False) -> str:
+        return os.path.join(self.directory,
+                            f"{name}.parts.tmp" if staging else f"{name}.parts")
+
+    def begin_scaled_create(self, name: str, if_not_exists: bool = False):
+        if self._table_exists(name):
+            if if_not_exists:
+                return False
+            raise ValueError(f"table already exists: {name}")
+        staging = self.parts_dir(name, staging=True)
+        import shutil
+
+        shutil.rmtree(staging, ignore_errors=True)
+        os.makedirs(staging)
+        return True
+
+    def write_part(self, name: str, part_id: str, batches,
+                   staging: bool = True) -> int:
+        from presto_tpu.catalog.memory import _batches_to_host
+
+        d = self.parts_dir(name, staging=staging)
+        names, types, data = _batches_to_host(batches)
+        from presto_tpu.types import ArrayType, MapType
+
+        if any(isinstance(t, (ArrayType, MapType)) for t in types):
+            raise NotImplementedError(
+                "parquet writer does not support ARRAY/MAP columns yet")
+        plain = {c: v[0] for c, v in data.items()}
+        validity = {c: v[1] for c, v in data.items() if v[1] is not None}
+        his = {c: v[2] for c, v in data.items() if v[2] is not None}
+        dicts = {c: v[3] for c, v in data.items() if v[3] is not None}
+        arrays, schema = _to_arrow_columns(plain, dict(zip(names, types)),
+                                           dicts, validity, his)
+        tbl = pa.Table.from_arrays(arrays, schema=schema)
+        path = os.path.join(d, f"part-{part_id}.parquet")
+        pq.write_table(tbl, path + ".tmp", row_group_size=1 << 20,
+                       use_dictionary=True, compression="zstd")
+        os.replace(path + ".tmp", path)
+        return int(tbl.num_rows)
+
+    def finish_scaled_create(self, name: str):
+        """Commit: staging dir renames into place atomically."""
+        os.replace(self.parts_dir(name, staging=True),
+                   self.parts_dir(name))
+        self._invalidate_table(name)
+
+    def abort_scaled_create(self, name: str):
+        import shutil
+
+        shutil.rmtree(self.parts_dir(name, staging=True),
+                      ignore_errors=True)
+
+    def _table_exists(self, name: str) -> bool:
+        return (os.path.exists(os.path.join(self.directory,
+                                            f"{name}.parquet"))
+                or os.path.isdir(self.parts_dir(name)))
+
+    def _part_files(self, name: str):
+        d = self.parts_dir(name)
+        if not os.path.isdir(d):
+            return None
+        return sorted(os.path.join(d, f) for f in os.listdir(d)
+                      if f.endswith(".parquet"))
+
+    def _load_parts(self, name: str, parts: list) -> _PqTable:
+        """Part-directory table: (file, row group) pairs become the
+        virtual row-group space; schema/dictionaries union over parts."""
+        part_map = []
+        num_rows = 0
+        schema = None
+        dicts: Dict[str, Dictionary] = {}
+        vocab: Dict[str, set] = {}
+        for p in parts:
+            f = pq.ParquetFile(p)
+            if schema is None:
+                schema = f.schema_arrow
+            num_rows += f.metadata.num_rows
+            for rg in range(f.num_row_groups):
+                part_map.append((p, rg))
+            for field in schema:
+                if _arrow_to_sql(field).is_string:
+                    col = None
+                    for rg in range(f.num_row_groups):
+                        col = f.read_row_group(rg, columns=[field.name]).column(0)
+                        for chunk in col.chunks:
+                            if pa.types.is_dictionary(chunk.type):
+                                vocab.setdefault(field.name, set()).update(
+                                    chunk.dictionary.to_pylist())
+                            else:
+                                vocab.setdefault(field.name, set()).update(
+                                    chunk.to_pylist())
+        cols = []
+        for field in schema:
+            t = _arrow_to_sql(field)
+            if t.is_string:
+                d = Dictionary(np.array(sorted(
+                    v for v in vocab.get(field.name, ()) if v is not None)))
+                dicts[field.name] = d
+                cols.append(ColumnInfo(field.name, t, d))
+            else:
+                cols.append(ColumnInfo(field.name, t, None))
+        handle = TableHandle(self.name, name, cols, row_count=float(num_rows))
+        d = self.parts_dir(name)
+        st = os.stat(d)
+        t = _PqTable(d, handle, dicts, num_rows, len(part_map),
+                     version=(st.st_mtime_ns, len(parts)),
+                     part_map=part_map)
+        self._tables[name] = t
+        return t
 
     def _load(self, name: str) -> _PqTable:
         self._check_fresh(name)
@@ -211,6 +341,9 @@ class ParquetConnector(DeviceSplitCache, Connector):
             return self._tables[name]
         path = os.path.join(self.directory, f"{name}.parquet")
         if not os.path.exists(path):
+            parts = self._part_files(name)
+            if parts:
+                return self._load_parts(name, parts)
             raise KeyError(f"table not found: {name}")
         f = pq.ParquetFile(path)
         schema = f.schema_arrow
@@ -277,9 +410,21 @@ class ParquetConnector(DeviceSplitCache, Connector):
         when the engine wants finer batches than a row group. Split.part is
         (row_group, sub_index, sub_count)."""
         t = self._load(handle.name)
-        f = pq.ParquetFile(t.path)
         target = max(1, -(-t.num_rows // max(desired, 1)))
         out = []
+        if t.part_map is not None:
+            meta_cache: Dict[str, object] = {}
+            for vrg, (fpath, rg) in enumerate(t.part_map):
+                md = meta_cache.get(fpath)
+                if md is None:
+                    md = meta_cache[fpath] = pq.ParquetFile(fpath).metadata
+                rg_rows = md.row_group(rg).num_rows
+                subs = max(1, -(-rg_rows // target))
+                for s in range(subs):
+                    out.append(Split(handle.name, (vrg, s, subs),
+                                     t.num_row_groups))
+            return out
+        f = pq.ParquetFile(t.path)
         for rg in range(t.num_row_groups):
             rg_rows = f.metadata.row_group(rg).num_rows
             subs = max(1, -(-rg_rows // target))
@@ -292,6 +437,8 @@ class ParquetConnector(DeviceSplitCache, Connector):
         """Row-group pruning with column min/max constraints (the coarse
         TupleDomain pushdown of the selective reader)."""
         t = self._load(handle.name)
+        if t.part_map is not None:
+            return list(splits)  # per-part footer pruning: not yet
         f = pq.ParquetFile(t.path)
         keep = []
         name_to_idx = {f.schema_arrow.field(i).name: i for i in range(len(f.schema_arrow.names))}
@@ -409,6 +556,13 @@ class ParquetConnector(DeviceSplitCache, Connector):
     def drop_table(self, name: str, if_exists: bool = False) -> None:
         path = os.path.join(self.directory, f"{name}.parquet")
         if not os.path.exists(path):
+            parts = self.parts_dir(name)
+            if os.path.isdir(parts):
+                import shutil
+
+                shutil.rmtree(parts)
+                self._invalidate_table(name)
+                return
             if if_exists:
                 return
             raise KeyError(f"table not found: {name}")
@@ -456,7 +610,13 @@ class ParquetConnector(DeviceSplitCache, Connector):
             if hit is not None:
                 self._host_cache.move_to_end(key)
                 return hit[0]
-        f = pq.ParquetFile(t.path)
+        if t.part_map is not None:
+            # part-directory table: the virtual row-group index resolves
+            # to (part file, row group within it)
+            fpath, rg = t.part_map[rg]
+            f = pq.ParquetFile(fpath)
+        else:
+            f = pq.ParquetFile(t.path)
         plain = [c for c in columns if c not in t.nested]
         parents = sorted({t.nested[c][0] for c in columns if c in t.nested})
         tbl = f.read_row_group(rg, columns=plain + parents)

@@ -347,6 +347,24 @@ def _execute_base(base: PlanNode, ctx: ExecContext) -> Iterator[Batch]:
         live[0] = True
         yield Batch([], [], [], jnp.asarray(live), {})
         return
+    from presto_tpu.plan.nodes import TableWriter as _TW
+
+    if isinstance(base, _TW):
+        # scaled writer: this task writes its stream as one part and
+        # emits its row count (TableWriterOperator analog)
+        conn = ctx.catalog.connectors[base.catalog]
+        batches = list(execute_node(base.child, ctx))
+        n = conn.write_part(base.table,
+                            f"{base.write_id}-{ctx.task_index:04d}",
+                            batches) if batches else 0
+        vals = np.zeros(128, np.int64)
+        vals[0] = n
+        live = np.zeros(128, bool)
+        live[0] = True
+        yield Batch(["rows"], [BIGINT],
+                    [Column(jnp.asarray(vals), None)],
+                    jnp.asarray(live), {})
+        return
     if isinstance(base, Sort):
         yield from _execute_sort(base, ctx)
         return

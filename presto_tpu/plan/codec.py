@@ -187,6 +187,12 @@ def node_to_json(n: PlanNode) -> Dict[str, Any]:
                 "ordinality": n.ordinality_sym}
     if isinstance(n, OneRow):
         return {"k": "onerow"}
+    from presto_tpu.plan.nodes import TableWriter
+
+    if isinstance(n, TableWriter):
+        return {"k": "tablewriter", "child": node_to_json(n.child),
+                "catalog": n.catalog, "table": n.table,
+                "write_id": n.write_id}
     raise CodecError(f"unencodable plan node {type(n).__name__}")
 
 
@@ -270,6 +276,11 @@ def node_from_json(d: Dict[str, Any]) -> PlanNode:
         )
     if k == "onerow":
         return OneRow()
+    if k == "tablewriter":
+        from presto_tpu.plan.nodes import TableWriter
+
+        return TableWriter(node_from_json(d["child"]), d["catalog"],
+                           d["table"], d["write_id"])
     raise CodecError(f"unknown plan node kind {k!r}")
 
 

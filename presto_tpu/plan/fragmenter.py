@@ -249,10 +249,15 @@ class _Fragmenter:
             return node, cpart
         if isinstance(node, RemoteSource):
             return node, SINGLE
-        from presto_tpu.plan.nodes import OneRow, Unnest
+        from presto_tpu.plan.nodes import OneRow, TableWriter, Unnest
 
         if isinstance(node, Unnest):
             # streaming row expansion: stays in its child's fragment
+            node.child, p = self.process(node.child)
+            return node, p
+        if isinstance(node, TableWriter):
+            # scaled writers: the writer rides its child's partitioning —
+            # every task writes its own part (SCALED_WRITER_DISTRIBUTION)
             node.child, p = self.process(node.child)
             return node, p
         if isinstance(node, OneRow):

@@ -105,6 +105,28 @@ class Aggregate(PlanNode):
 
 
 @dataclasses.dataclass
+class TableWriter(PlanNode):
+    """Scaled writes: each task writes its stream as one part of the
+    target table and emits its row count (reference: TableWriterOperator
+    + SystemPartitioningHandle.SCALED_WRITER_DISTRIBUTION; the
+    TableFinish sum happens coordinator-side over the gathered counts)."""
+
+    child: PlanNode
+    catalog: str
+    table: str
+    write_id: str  # unique per statement (part-file namespace)
+
+    @property
+    def output(self):
+        from presto_tpu.types import BIGINT
+
+        return [("rows", BIGINT)]
+
+    def children(self):
+        return [self.child]
+
+
+@dataclasses.dataclass
 class OneRow(PlanNode):
     """A single live row with no columns (reference: planner/plan
     ValuesNode's single-row degenerate form) — the child of a top-level

@@ -581,6 +581,73 @@ class Coordinator:
         workers = self.node_manager.active_nodes()
         yield from self.scheduler.execute(qid, dplan, workers, config)
 
+    def _try_scaled_write(self, stmt, config, session) -> Optional[Batch]:
+        """Scaled writers (SCALED_WRITER_DISTRIBUTION): CTAS into a
+        connector that supports part tables plans the source query
+        distributed and wraps each root task with a TableWriter — every
+        task writes its own part concurrently; counts gather and sum; the
+        staging directory commits atomically (TableFinish). Returns None
+        when the statement doesn't qualify (the gathered single-writer
+        path handles it)."""
+        import uuid
+
+        from presto_tpu.exec.runtime import _collect_concat
+        from presto_tpu.plan.builder import plan_query
+        from presto_tpu.plan.fragmenter import fragment_plan
+        from presto_tpu.plan.nodes import Output, TableWriter
+        from presto_tpu.plan.optimizer import optimize
+        from presto_tpu.sql import ast as _ast
+
+        if not isinstance(stmt, _ast.CreateTableAs):
+            return None
+        conn, tname = self.catalog.connector_for(stmt.name)
+        if not getattr(conn, "supports_scaled_writes", lambda: False)():
+            return None
+        qp = optimize(plan_query(stmt.query, self.catalog))
+        if qp.scalar_subqueries:
+            return None  # binding protocol stays on the gathered path
+        write_id = uuid.uuid4().hex[:8]
+        inner = qp.root.child
+        writer = TableWriter(inner, conn.name, tname, write_id)
+        qp.root = Output(writer, ["rows"], ["rows"])
+        if not conn.begin_scaled_create(tname,
+                                        if_not_exists=stmt.if_not_exists):
+            return self._count_batch(0)
+        try:
+            dplan = fragment_plan(
+                qp, self.catalog,
+                broadcast_threshold_rows=self.broadcast_threshold_rows)
+            # NO query-level retry here: a retry with a different worker
+            # count would leave stale parts from the first attempt in the
+            # staging dir (duplicated rows); failures abort the staging
+            batches = list(self.execute_distributed(dplan, config))
+            merged = _collect_concat(iter(batches))
+            total = 0
+            if merged is not None:
+                rows = merged.to_pydict(decode_strings=False)["rows"]
+                total = int(sum(int(v) for v in rows))
+            conn.finish_scaled_create(tname)
+        except BaseException:
+            conn.abort_scaled_create(tname)
+            raise
+        return self._count_batch(total)
+
+    @staticmethod
+    def _count_batch(rows: int) -> Batch:
+        import jax.numpy as jnp
+        import numpy as np
+
+        from presto_tpu.batch import Column
+        from presto_tpu.types import BIGINT
+
+        vals = np.zeros(128, np.int64)
+        vals[0] = rows
+        live = np.zeros(128, bool)
+        live[0] = True
+        return Batch(["rows"], [BIGINT],
+                     [Column(jnp.asarray(vals), None)],
+                     jnp.asarray(live), {})
+
     def _reprobe_workers(self):
         """Synchronous cluster probe before a retry: a node that fails its
         probe is excluded IMMEDIATELY (score jump past the threshold) —
@@ -691,6 +758,9 @@ class Coordinator:
         from presto_tpu.exec.runner import is_ddl
 
         if stmt is not None and is_ddl(stmt):
+            scaled = self._try_scaled_write(stmt, config, session)
+            if scaled is not None:
+                return scaled
             # DDL/DML executes coordinator-side; the source query still runs
             # distributed (reference: DataDefinitionExecution on the
             # coordinator + a distributed TableWriter source)
