@@ -503,11 +503,28 @@ class ParquetConnector(DeviceSplitCache, Connector):
         return int(tbl.num_rows)
 
     def insert_into(self, name: str, batches) -> int:
-        """Append by rewrite: existing rows + new rows into a fresh file
-        (parquet files are immutable; a part-file layout is the scalable
-        successor — this keeps single-file tables correct)."""
+        """Append. Part-directory tables append a NEW part (no rewrite);
+        single-file tables rewrite existing rows + new rows into a fresh
+        file (parquet files are immutable)."""
         path = os.path.join(self.directory, f"{name}.parquet")
         if not os.path.exists(path):
+            if os.path.isdir(self.parts_dir(name)):
+                import uuid
+
+                t = self._load(name)
+                # schema check against the existing handle
+                from presto_tpu.catalog.memory import _batches_to_host
+
+                names, types, _ = _batches_to_host(batches)
+                existing = [c.type.name for c in t.handle.columns]
+                if [tt.name for tt in types] != existing:
+                    raise ValueError(
+                        f"INSERT schema mismatch: {[str(t) for t in types]}"
+                        f" vs {existing}")
+                n = self.write_part(name, f"ins-{uuid.uuid4().hex[:8]}",
+                                    batches, staging=False)
+                self._invalidate_table(name)
+                return n
             raise KeyError(f"table not found: {name}")
         from presto_tpu.catalog.memory import _batches_to_host
 

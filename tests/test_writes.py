@@ -289,3 +289,28 @@ class TestScaledWriters:
             assert dist.run("select count(*) as n from pq.x").n[0] == 10
         finally:
             dist.close()
+
+    def test_insert_into_part_table_appends_part(self, tmp_path):
+        import os
+
+        from presto_tpu.server.coordinator import DistributedRunner
+
+        src = MemoryConnector()
+        src.add_table("t", pd.DataFrame({"x": np.arange(100),
+                                         "v": np.arange(100.0)}))
+        cat = Catalog()
+        cat.register("m", src, default=True)
+        cat.register("pq", ParquetConnector(str(tmp_path)))
+        dist = DistributedRunner(cat, n_workers=2,
+                                 config=ExecConfig(batch_rows=1 << 12))
+        try:
+            dist.run("create table pq.p as select x, v from t")
+            before = len(os.listdir(os.path.join(str(tmp_path), "p.parts")))
+            out = dist.run("insert into pq.p select x + 100 as x, v from t")
+            assert out.rows[0] == 100
+            after = len(os.listdir(os.path.join(str(tmp_path), "p.parts")))
+            assert after == before + 1  # appended one part, no rewrite
+            back = dist.run("select count(*) as n, max(x) as mx from pq.p")
+            assert back.n[0] == 200 and back.mx[0] == 199
+        finally:
+            dist.close()
