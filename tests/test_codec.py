@@ -89,3 +89,59 @@ def test_executed_round_trip(cat):
     got = out.to_pandas()
     assert list(got.f) == list(expected.f)
     assert list(got.c) == list(expected.c)
+
+
+def test_round3_nodes_round_trip():
+    """Every round-3 plan node crosses the JSON wire unchanged: Unnest,
+    OneRow, NestedLoopJoin, TableWriter, lambdas in expressions."""
+    from presto_tpu.expr.ir import Call, Constant, InputRef, LambdaExpr
+    from presto_tpu.plan.codec import expr_to_json, node_from_json, node_to_json
+    from presto_tpu.plan.nodes import (
+        NestedLoopJoin,
+        OneRow,
+        Project,
+        TableScan,
+        TableWriter,
+        Unnest,
+    )
+    from presto_tpu.types import ArrayType, BIGINT, BOOLEAN, DOUBLE
+
+    scan = TableScan(catalog="m", table="t",
+                     assignments={"a": "a"}, output=[("a", BIGINT)])
+    arr_t = ArrayType(BIGINT)
+    proj = Project(scan, [("a", InputRef(BIGINT, "a")),
+                          ("src", Call(arr_t, "array_ctor",
+                                       (InputRef(BIGINT, "a"),)))])
+    un = Unnest(child=proj, sources=["src"], replicate=["a"],
+                out_syms=[["e"]], out_types=[[BIGINT]],
+                ordinality_sym="o")
+    rt = node_from_json(node_to_json(un))
+    assert isinstance(rt, Unnest)
+    assert rt.sources == ["src"] and rt.ordinality_sym == "o"
+    assert rt.out_types[0][0] is not None
+    assert [s for s, _ in rt.output] == ["a", "e", "o"]
+
+    nlj = NestedLoopJoin(scan, OneRow(), residual=Call(
+        BOOLEAN, "gt", (InputRef(BIGINT, "a"), Constant(BIGINT, 3))))
+    rt2 = node_from_json(node_to_json(nlj))
+    assert isinstance(rt2, NestedLoopJoin)
+    assert isinstance(rt2.right, OneRow)
+    assert rt2.residual.fn == "gt"
+
+    tw = TableWriter(scan, "pq", "out", "abc123")
+    rt3 = node_from_json(node_to_json(tw))
+    assert isinstance(rt3, TableWriter)
+    assert (rt3.catalog, rt3.table, rt3.write_id) == ("pq", "out", "abc123")
+
+    lam = LambdaExpr(DOUBLE, (("x", BIGINT),),
+                     Call(DOUBLE, "mul", (InputRef(BIGINT, "x"),
+                                          Constant(DOUBLE, 2.0))))
+    tr = Call(ArrayType(DOUBLE), "transform",
+              (InputRef(arr_t, "src"), lam))
+    from presto_tpu.plan.codec import expr_from_json
+
+    rte = expr_from_json(expr_to_json(tr))
+    assert rte.fn == "transform"
+    assert isinstance(rte.args[1], LambdaExpr)
+    assert rte.args[1].params == (("x", BIGINT),)
+    assert rte.args[1].body.fn == "mul"
