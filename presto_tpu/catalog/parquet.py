@@ -437,14 +437,27 @@ class ParquetConnector(DeviceSplitCache, Connector):
         """Row-group pruning with column min/max constraints (the coarse
         TupleDomain pushdown of the selective reader)."""
         t = self._load(handle.name)
-        if t.part_map is not None:
-            return list(splits)  # per-part footer pruning: not yet
-        f = pq.ParquetFile(t.path)
+        files: Dict[str, object] = {}
+
+        def rg_meta(rg_idx: int):
+            if t.part_map is not None:
+                fpath, rg = t.part_map[rg_idx]
+            else:
+                fpath, rg = t.path, rg_idx
+            f = files.get(fpath)
+            if f is None:
+                f = files[fpath] = pq.ParquetFile(fpath)
+            return f, f.metadata.row_group(rg)
+
+        f0, _ = rg_meta(0) if (t.num_row_groups or t.part_map) else (None, None)
+        if f0 is None:
+            return list(splits)
         keep = []
-        name_to_idx = {f.schema_arrow.field(i).name: i for i in range(len(f.schema_arrow.names))}
+        name_to_idx = {f0.schema_arrow.field(i).name: i
+                       for i in range(len(f0.schema_arrow.names))}
         for s in splits:
             rg_idx = s.part[0] if isinstance(s.part, tuple) else s.part
-            rg = f.metadata.row_group(rg_idx)
+            _, rg = rg_meta(rg_idx)
             ok = True
             for col, (lo, hi) in min_max.items():
                 if col not in name_to_idx:

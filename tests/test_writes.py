@@ -314,3 +314,26 @@ class TestScaledWriters:
             assert back.n[0] == 200 and back.mx[0] == 199
         finally:
             dist.close()
+
+    def test_part_table_footer_pruning(self, tmp_path):
+        from presto_tpu.server.coordinator import DistributedRunner
+
+        src = MemoryConnector()
+        src.add_table("t", pd.DataFrame({"k": np.arange(10_000),
+                                         "v": np.arange(10_000.0)}))
+        cat = Catalog()
+        pqc = ParquetConnector(str(tmp_path))
+        cat.register("m", src, default=True)
+        cat.register("pq", pqc)
+        dist = DistributedRunner(cat, n_workers=2,
+                                 config=ExecConfig(batch_rows=1 << 11))
+        try:
+            dist.run("create table pq.p as select k, v from t")
+            h = pqc.get_table("p")
+            splits = pqc.splits(h, 8)
+            pruned = pqc.prune_splits(h, splits, {"k": (9_990, None)})
+            assert 0 < len(pruned) < len(splits)  # footer stats pruned parts
+            got = dist.run("select count(*) as n from pq.p where k >= 9990")
+            assert got.n[0] == 10
+        finally:
+            dist.close()
