@@ -2211,6 +2211,34 @@ def _execute_window(node: Window, ctx: ExecContext) -> Iterator[Batch]:
         ]
         wk = W.window_keys(part_cols, order_cols, sb.live)
 
+        rng_kw = {"order_vals": None}
+        if (any(f.frame and f.frame.startswith("range:") for f in node.funcs)
+                and node.order_items):
+            # RANGE value offsets: the single order key, ascending-ized
+            # (negated for DESC), kept in its NATIVE domain — int64 for
+            # integral/decimal/date keys so boundary comparisons are
+            # exact past 2^53; decimals compare unscaled with the OFFSET
+            # scaled by 10^scale instead (see range_frame_bounds)
+            oi = node.order_items[0]
+            oc = sb.column(oi.symbol)
+            ot = child_types.get(oi.symbol)
+            ov = oc.values
+            if jnp.issubdtype(ov.dtype, jnp.floating):
+                ov = ov.astype(jnp.float64)
+            else:
+                ov = ov.astype(jnp.int64)
+            if not oi.ascending:
+                ov = -ov  # NaN survives negation; the kernel masks it
+            nf = oi.nulls_first
+            if nf is None:
+                nf = not oi.ascending
+            rng_kw = {
+                "order_vals": ov,
+                "order_valid": oc.validity,
+                "nulls_first": nf,
+                "offset_scale": 10 ** ot.scale if isinstance(ot, _Dec) else 1,
+            }
+
         out = sb
         for f in node.funcs:
             if f.fn == "row_number":
@@ -2227,7 +2255,8 @@ def _execute_window(node: Window, ctx: ExecContext) -> Iterator[Batch]:
                 v, valid = W.ntile(wk, f.param)
             elif f.fn in ("lag", "lead", "first_value", "last_value", "nth_value"):
                 c = sb.column(f.arg)
-                bounded = f.frame is not None and f.frame.startswith("rows:")
+                bounded = f.frame is not None and f.frame.startswith(
+                    ("rows:", "range:"))
                 if f.fn == "lag":
                     v, valid = W.lag(wk, c.values, c.validity,
                                      f.param if f.param is not None else 1,
@@ -2239,7 +2268,7 @@ def _execute_window(node: Window, ctx: ExecContext) -> Iterator[Batch]:
                 elif bounded:
                     v, valid = W.value_over_frame(
                         wk, f.fn, c.values, c.validity, f.frame,
-                        f.param if f.param is not None else 1)
+                        f.param if f.param is not None else 1, **rng_kw)
                 elif f.fn == "first_value":
                     v, valid = W.first_value(wk, c.values, c.validity)
                 elif f.fn == "last_value":
@@ -2247,7 +2276,8 @@ def _execute_window(node: Window, ctx: ExecContext) -> Iterator[Batch]:
                 else:
                     v, valid = W.nth_value(wk, c.values, c.validity, f.param)
             elif f.fn in ("sum", "avg", "min", "max", "count"):
-                bounded = f.frame is not None and f.frame.startswith("rows:")
+                bounded = f.frame is not None and f.frame.startswith(
+                    ("rows:", "range:"))
                 if not node.order_items:
                     frame = "whole"
                 elif f.frame == "rows_unbounded_current":
@@ -2257,7 +2287,7 @@ def _execute_window(node: Window, ctx: ExecContext) -> Iterator[Batch]:
                 if bounded and f.arg is None:
                     v, valid = W.agg_window_bounded(
                         wk, "count", jnp.zeros(sb.capacity, jnp.int64), None,
-                        f.frame, False)
+                        f.frame, False, **rng_kw)
                 elif f.arg is None:
                     v, valid = W.agg_window(
                         wk, "count", jnp.zeros(sb.capacity, jnp.int64), None,
@@ -2273,7 +2303,8 @@ def _execute_window(node: Window, ctx: ExecContext) -> Iterator[Batch]:
                         vals = vals.astype(jnp.float64) / (10.0 ** scale)
                         is_float = True
                     v, valid = W.agg_window_bounded(
-                        wk, f.fn, vals, c.validity, f.frame, is_float)
+                        wk, f.fn, vals, c.validity, f.frame, is_float,
+                        **rng_kw)
                 else:
                     c = sb.column(f.arg)
                     vals = c.values

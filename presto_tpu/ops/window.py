@@ -54,6 +54,9 @@ def _change_mask(cols, live):
     for values, validity in cols:
         prev = jnp.roll(values, 1)
         diff = values != prev
+        if jnp.issubdtype(values.dtype, jnp.floating):
+            # SQL total order: NaN equals NaN for grouping/peers
+            diff = diff & ~(jnp.isnan(values) & jnp.isnan(prev))
         if validity is not None:
             pv = jnp.roll(validity, 1)
             # null vs null is "same" for partitioning/peers (SQL grouping
@@ -366,13 +369,126 @@ def _range_min_query(table, start, end):
     return jnp.minimum(a, b)
 
 
+def range_frame_bounds(k: WindowKeys, order_vals, frame: str,
+                       order_valid=None, nulls_first: bool = False,
+                       offset_scale: int = 1):
+    """'range:<s>:<e>' with VALUE offsets over ONE ascending-ized numeric
+    order key: per-row frame bounds by vectorized binary search (log n
+    elementwise gather steps — no per-row loops). order_vals are the
+    partition-sorted key values in their NATIVE domain (int64 for
+    integral/decimal/date keys — exact past 2^53 — float64 for doubles);
+    offsets are scaled by offset_scale (10^scale for decimals) so the
+    comparison happens in the exact unscaled domain. NULL keys
+    (order_valid False) and NaN keys are excluded from the searchable
+    span; their offset bounds resolve to their peer-group edges while
+    non-offset bounds (UNBOUNDED / CURRENT ROW) keep their meaning."""
+    _, s_tok, e_tok = frame.split(":")
+    sk, so = parse_frame_bound(s_tok)
+    ek, eo = parse_frame_bound(e_tok)
+    seg_end = (k.seg_start + jnp.maximum(k.seg_size - 1, 0)).astype(jnp.int32)
+    seg_start = k.seg_start.astype(jnp.int32)
+    v = order_vals
+    iters = max(1, int(k.live.shape[0] - 1).bit_length()) + 1
+
+    # NULL keys sit at one contiguous end of each partition (per
+    # nulls_first); NaN keys always sort at the tail of the non-null run
+    # (lax.sort totals NaN greatest in both directions — DESC negates,
+    # and -NaN is still NaN). Shrink the searchable span so no finite
+    # target ever absorbs either group — this also keeps genuine +inf
+    # keys distinct from NaN keys.
+    def segcount(mask):
+        c = jnp.cumsum(mask.astype(jnp.int32))
+        return c[seg_end] - c[seg_start] + mask[seg_start].astype(jnp.int32)
+
+    nan_mask = (jnp.isnan(v) & k.live
+                if v is not None and jnp.issubdtype(v.dtype, jnp.floating)
+                else None)
+    null_mask = ((~order_valid) & k.live) if order_valid is not None else None
+    lo0, hi0 = seg_start, seg_end
+    if null_mask is not None and nulls_first:
+        lo0 = jnp.minimum(seg_start + segcount(null_mask), seg_end)
+    tail = nan_mask
+    if null_mask is not None and not nulls_first:
+        tail = null_mask if tail is None else (tail | null_mask)
+    if tail is not None:
+        hi0 = jnp.maximum(seg_end - segcount(tail), seg_start)
+    # rows whose key can't anchor a value search get their PEER GROUP as
+    # the result of any offset bound (SQL: a NULL/NaN row's offset frame
+    # edge is its peers); non-offset bounds keep their normal meaning
+    over = null_mask
+    if nan_mask is not None:
+        over = nan_mask if over is None else (over | nan_mask)
+
+    def shift(delta: int):
+        """v + delta with saturation (int keys must not wrap past the
+        extremes; float +/-inf saturates on its own)."""
+        if jnp.issubdtype(v.dtype, jnp.floating):
+            return v + float(delta)
+        t = v + jnp.asarray(delta, v.dtype)
+        if delta > 0:
+            t = jnp.where(t < v, jnp.iinfo(v.dtype).max, t)
+        elif delta < 0:
+            t = jnp.where(t > v, jnp.iinfo(v.dtype).min, t)
+        return t
+
+    def lower_bound(target):
+        """Smallest index in [lo0, hi0] whose key >= target (keys ascend
+        within the partition); hi0+1 when none."""
+        lo, hi = lo0, hi0
+        for _ in range(iters):
+            mid = (lo + hi) // 2
+            ok = v[mid] >= target
+            hi = jnp.where(ok, mid, hi)
+            lo = jnp.where(ok, lo, jnp.minimum(mid + 1, hi0))
+        return jnp.where(v[hi] >= target, hi, hi0 + 1)
+
+    def upper_bound(target):
+        """Largest index in [lo0, hi0] whose key <= target; lo0-1 when
+        none."""
+        lo, hi = lo0, hi0
+        for _ in range(iters):
+            mid = (lo + hi + 1) // 2
+            ok = v[mid] <= target
+            lo = jnp.where(ok, mid, lo)
+            hi = jnp.where(ok, hi, jnp.maximum(mid - 1, lo0))
+        return jnp.where(v[lo] <= target, lo, lo0 - 1)
+
+    if sk == "up":
+        start = seg_start
+    elif sk == "cur":
+        # RANGE start at CURRENT ROW includes preceding PEERS
+        start = k.peer_start.astype(jnp.int32)
+    else:
+        start = lower_bound(shift((-so if sk == "p" else so) * offset_scale))
+        if over is not None:
+            start = jnp.where(over, k.peer_start.astype(jnp.int32), start)
+    if ek == "uf":
+        end = seg_end
+    elif ek == "cur":
+        end = k.peer_last.astype(jnp.int32)
+    else:
+        end = upper_bound(shift((eo if ek == "f" else -eo) * offset_scale))
+        if over is not None:
+            end = jnp.where(over, k.peer_last.astype(jnp.int32), end)
+    nonempty = (start <= end) & k.live
+    start = jnp.clip(start, seg_start, seg_end)
+    end = jnp.clip(end, seg_start, seg_end)
+    return start, end, nonempty
+
+
 def agg_window_bounded(k: WindowKeys, fn: str, values, validity,
-                       frame: str, is_float: bool):
-    """sum/avg/min/max/count over an explicit ROWS frame. Prefix-sum
-    differences for sum/count (both gather indices stay inside one
-    partition, so cross-partition terms cancel); sparse-table range
-    min/max for extremes."""
-    start, end, nonempty = frame_bounds(k, frame)
+                       frame: str, is_float: bool, order_vals=None,
+                       order_valid=None, nulls_first: bool = False,
+                       offset_scale: int = 1):
+    """sum/avg/min/max/count over an explicit ROWS or RANGE frame.
+    Prefix-sum differences for sum/count (both gather indices stay
+    inside one partition, so cross-partition terms cancel); sparse-table
+    range min/max for extremes."""
+    if frame.startswith("range:"):
+        start, end, nonempty = range_frame_bounds(
+            k, order_vals, frame, order_valid, nulls_first, offset_scale)
+    else:
+        start, end, nonempty = frame_bounds(k, frame)
     valid = k.live if validity is None else (k.live & validity)
 
     def windowed_sum(x, dtype):
@@ -416,9 +532,15 @@ def agg_window_bounded(k: WindowKeys, fn: str, values, validity,
 
 
 def value_over_frame(k: WindowKeys, fn: str, values, validity, frame: str,
-                     nth: int = 1):
-    """first_value/last_value/nth_value over an explicit ROWS frame."""
-    start, end, nonempty = frame_bounds(k, frame)
+                     nth: int = 1, order_vals=None, order_valid=None,
+                     nulls_first: bool = False, offset_scale: int = 1):
+    """first_value/last_value/nth_value over an explicit ROWS or RANGE
+    frame."""
+    if frame.startswith("range:"):
+        start, end, nonempty = range_frame_bounds(
+            k, order_vals, frame, order_valid, nulls_first, offset_scale)
+    else:
+        start, end, nonempty = frame_bounds(k, frame)
     if fn == "first_value":
         idx = start
         ok = nonempty

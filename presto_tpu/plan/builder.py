@@ -1582,10 +1582,38 @@ class Planner:
             if key in analyzer.replacements:
                 continue
             part_syms = [to_symbol(p)[0] for p in w.partition_by]
+            order_pairs = [to_symbol(oi.expr) for oi in w.order_by]
             order_items = [
-                SortItem(to_symbol(oi.expr)[0], oi.ascending, oi.nulls_first)
-                for oi in w.order_by
+                SortItem(sym, oi.ascending, oi.nulls_first)
+                for (sym, _), oi in zip(order_pairs, w.order_by)
             ]
+            if (w.frame and w.frame.startswith("range:")
+                    and any(b[0] in "pf" for b in w.frame.split(":")[1:])):
+                # value-offset RANGE frame: one numeric/temporal sort key
+                # (reference: WindowFrameTypeCheck in sql/analyzer)
+                if len(order_pairs) != 1:
+                    raise AnalysisError(
+                        "RANGE frame with value offsets requires exactly "
+                        "one ORDER BY key")
+                ot = order_pairs[0][1]
+                if ot is TIMESTAMP:
+                    # bare integer offsets would silently mean microseconds;
+                    # reject until INTERVAL offsets exist (cast to date)
+                    raise AnalysisError(
+                        "RANGE frame offsets over a timestamp ORDER BY key "
+                        "are not supported (cast the key to date — offsets "
+                        "are then in days)")
+                if not (is_integral(ot) or is_floating(ot)
+                        or isinstance(ot, DecimalType) or ot is DATE):
+                    raise AnalysisError(
+                        "RANGE frame offsets require a numeric or date "
+                        f"ORDER BY key (date offsets are in days), got {ot}")
+                if isinstance(ot, DecimalType) and ot.precision > 18:
+                    # two-limb int128 decimals: only the low limb reaches
+                    # the frame binary search, so comparisons would lie
+                    raise AnalysisError(
+                        "RANGE frame offsets over decimal keys wider than "
+                        "18 digits are not supported")
             name = w.name.lower()
             arg_sym: Optional[str] = None
             param: Optional[int] = None

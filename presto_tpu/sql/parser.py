@@ -934,18 +934,28 @@ class Parser:
             else:
                 # shorthand: ROWS <bound> == BETWEEN <bound> AND CURRENT ROW
                 s = self._parse_frame_bound(is_start=True)
+                if s.startswith("f"):
+                    raise ParseError(
+                        "frame shorthand bound must be UNBOUNDED PRECEDING, "
+                        "n PRECEDING or CURRENT ROW")
                 e = "cur"
             frame = ("rows_unbounded_current" if (s, e) == ("up", "cur")
                      else f"rows:{s}:{e}")
         elif self.accept_kw("range"):
-            # RANGE: only the default-equivalent frame is accepted
-            self.expect_kw("between")
-            self.expect_kw("unbounded")
-            self.expect_kw("preceding")
-            self.expect_kw("and")
-            self.expect_kw("current")
-            self.expect_kw("row")
-            frame = "rows_unbounded_current"
+            if self.accept_kw("between"):
+                s = self._parse_frame_bound(is_start=True)
+                self.expect_kw("and")
+                e = self._parse_frame_bound(is_start=False)
+            else:
+                s = self._parse_frame_bound(is_start=True)
+                if s.startswith("f"):
+                    raise ParseError(
+                        "frame shorthand bound must be UNBOUNDED PRECEDING, "
+                        "n PRECEDING or CURRENT ROW")
+                e = "cur"
+            # UNBOUNDED PRECEDING..CURRENT ROW is exactly the default
+            # frame (peer-inclusive running aggregate) — leave frame unset
+            frame = None if (s, e) == ("up", "cur") else f"range:{s}:{e}"
         self.expect_op(")")
         return ast.WindowFunction(
             fc.name, fc.args, partition_by, order_by, fc.is_star, frame

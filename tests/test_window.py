@@ -345,3 +345,253 @@ def test_lag_default_type_guards(runner):
                     "(partition by g order by k, x) lx from t")
     firsts = df.sort_values(["g", "k", "x"]).groupby("g").head(1)
     assert (firsts.lx == -0.5).all()
+
+
+# -- RANGE frames with value offsets (RANGE BETWEEN n PRECEDING ...) ----------
+# oracle: sqlite3 RANGE frames (>= 3.28)
+
+
+def test_range_frame_preceding_following(runner, sqlite_db):
+    _compare_sql(
+        runner, sqlite_db,
+        "select g, k, v,"
+        " sum(v) over (partition by g order by k range between 5 preceding"
+        "              and 3 following) s,"
+        " count(*) over (partition by g order by k range between 5 preceding"
+        "                and 3 following) c"
+        " from t", ["g", "k", "v", "s"])
+
+
+def test_range_frame_single_sided(runner, sqlite_db):
+    _compare_sql(
+        runner, sqlite_db,
+        "select g, k, v,"
+        " sum(v) over (partition by g order by k range 10 preceding) sp,"
+        " sum(v) over (partition by g order by k range between current row"
+        "              and 7 following) sf,"
+        " sum(v) over (partition by g order by k range between unbounded"
+        "              preceding and 2 following) su"
+        " from t", ["g", "k", "v", "sp"])
+
+
+def test_range_frame_desc_and_minmax(runner, sqlite_db):
+    _compare_sql(
+        runner, sqlite_db,
+        "select g, k, v,"
+        " min(v) over (partition by g order by k desc range between"
+        "              4 preceding and 4 following) mn,"
+        " max(v) over (partition by g order by k desc range between"
+        "              4 preceding and current row) mx"
+        " from t", ["g", "k", "v", "mn"])
+
+
+def test_range_frame_double_key(runner, sqlite_db):
+    _compare_sql(
+        runner, sqlite_db,
+        "select g, x,"
+        " avg(x) over (partition by g order by x range between 5 preceding"
+        "              and 5 following) a,"
+        " count(x) over (partition by g order by x range between 5 preceding"
+        "                and 5 following) c"
+        " from t", ["g", "x"])
+
+
+def test_range_frame_first_last_value(runner, sqlite_db):
+    _compare_sql(
+        runner, sqlite_db,
+        "select g, k, v,"
+        " first_value(k) over (partition by g order by k range between"
+        "   8 preceding and 8 following) fv,"
+        " last_value(k) over (partition by g order by k range between"
+        "   8 preceding and 8 following) lv"
+        " from t", ["g", "k", "v"])
+
+
+def test_range_unbounded_current_includes_peers(runner, sqlite_db):
+    """Explicit RANGE BETWEEN UNBOUNDED PRECEDING AND CURRENT ROW is the
+    default (peer-inclusive) frame, NOT a per-row ROWS frame."""
+    _compare_sql(
+        runner, sqlite_db,
+        "select g, k,"
+        " sum(v) over (partition by g order by k range between unbounded"
+        "              preceding and current row) rs"
+        " from t", ["g", "k", "rs"])
+
+
+def test_range_frame_empty_is_null(runner, df):
+    # offsets place the frame entirely beyond every key → NULL sum, count 0
+    got = runner.run(
+        "select g, k,"
+        " sum(v) over (partition by g order by k range between"
+        "              1000 following and 2000 following) s,"
+        " count(v) over (partition by g order by k range between"
+        "                1000 following and 2000 following) c"
+        " from t")
+    assert got.s.isna().all()
+    assert (got.c == 0).all()
+
+
+def test_range_frame_analysis_errors(runner):
+    from presto_tpu.plan.builder import AnalysisError
+
+    # value offsets need exactly ONE order key
+    with pytest.raises(AnalysisError):
+        runner.run("select sum(v) over (order by k, v range between"
+                   " 3 preceding and current row) s from t")
+    # ... of numeric/temporal type
+    with pytest.raises(AnalysisError):
+        runner.run("select sum(v) over (order by g range between"
+                   " 3 preceding and current row) s from t")
+
+
+def test_range_frame_nan_order_key():
+    """NaN order keys (valid doubles, not NULLs) land at the partition end
+    and peer only with other NaNs in value-offset RANGE frames."""
+    from presto_tpu.catalog.memory import MemoryConnector
+    from presto_tpu.connector import Catalog
+    from presto_tpu.exec import ExecConfig, LocalRunner
+
+    conn = MemoryConnector("mem")
+    conn.add_table("t", pd.DataFrame(
+        {"g": list("aabbab"), "k": [1, 2, 2, 5, np.nan, 9],
+         "v": [1., 2., 3., 4., 5., 6.]}))
+    cat = Catalog()
+    cat.register("mem", conn, default=True)
+    r = LocalRunner(cat, ExecConfig(batch_rows=256))
+    for direction in ("", " desc"):
+        got = r.run(
+            "select g, k, sum(v) over (partition by g order by"
+            f" k{direction} range between 1 preceding and 1 following) s"
+            " from t order by g, k")
+        assert got.s.tolist() == [3.0, 3.0, 5.0, 3.0, 4.0, 6.0], direction
+
+
+def test_range_frame_review_regressions():
+    """Round-3 review findings: offset-free RANGE frame without ORDER BY,
+    decimal boundary exactness, NULL-vs-NaN peer separation, timestamp
+    key rejection."""
+    from presto_tpu.catalog.memory import MemoryConnector
+    from presto_tpu.connector import Catalog
+    from presto_tpu.exec import ExecConfig, LocalRunner
+    from presto_tpu.plan.builder import AnalysisError
+    from presto_tpu.types import BIGINT, parse_type
+
+    conn = MemoryConnector("mem")
+    conn.add_table("t", pd.DataFrame(
+        {"i": [1, 2, 3, 4], "k": [1.0, 2.0, 0.0, 0.0], "v": [1, 2, 4, 8]}))
+    conn.add_table("d", {"k": np.array([0.10, 1.10]),
+                         "v": np.array([1, 2], np.int64)},
+                   {"k": parse_type("decimal(4,2)"), "v": BIGINT})
+    conn.add_table("ts", pd.DataFrame(
+        {"t": pd.to_datetime(["2024-01-01", "2024-01-02"]), "v": [1, 2]}))
+    cat = Catalog()
+    cat.register("mem", conn, default=True)
+    r = LocalRunner(cat, ExecConfig(batch_rows=256))
+
+    # offset-free RANGE frame needs no ORDER BY key
+    got = r.run("select sum(v) over (range between current row and"
+                " unbounded following) s from t")
+    assert got.s.tolist() == [15.0] * 4
+
+    # decimal 1.10 - 1 must include the 0.10 boundary row exactly
+    got = r.run("select k, sum(v) over (order by k range between 1 preceding"
+                " and current row) s from d").sort_values("k",
+                                                          ignore_index=True)
+    assert got.s.tolist() == [1, 3]
+
+    # valid-NaN keys and NULL keys are distinct peer groups
+    for nulls in ("nulls last", "nulls first"):
+        got = r.run(
+            "select i, sum(v) over (order by k2 " + nulls +
+            " range between 1 preceding and 1 following) s from"
+            " (select i, case when i = 4 then null"
+            "              when i = 3 then sqrt(-1.0) else k end k2, v"
+            "  from t) x").sort_values("i", ignore_index=True)
+        assert got.s.tolist() == [3, 3, 4, 8], nulls
+
+    # bare-integer offsets over timestamps would mean microseconds: reject
+    # (DATE keys are fine — offsets are days; the cast forces TIMESTAMP)
+    with pytest.raises(AnalysisError):
+        r.run("select sum(v) over (order by cast(t as timestamp) range"
+              " between 1 preceding and current row) s from ts")
+    got = r.run("select sum(v) over (order by t range between 1 preceding"
+                " and current row) s from ts")
+    assert sorted(got.s.tolist()) == [1, 3]
+
+
+def test_range_frame_null_nan_inf_edges():
+    """Second-pass review findings: per-bound NULL/NaN peer override
+    (non-offset bounds keep their meaning), NaN vs genuine +inf keys stay
+    distinct peer groups, wide decimals and shorthand FOLLOWING rejected."""
+    from presto_tpu.catalog.memory import MemoryConnector
+    from presto_tpu.connector import Catalog
+    from presto_tpu.exec import ExecConfig, LocalRunner
+    from presto_tpu.plan.builder import AnalysisError
+    from presto_tpu.sql.parser import ParseError
+    from presto_tpu.types import BIGINT, parse_type
+
+    conn = MemoryConnector("mem")
+    conn.add_table("t", pd.DataFrame(
+        {"i": [1, 2, 3, 4], "k": [1.0, 2.0, 0.0, 0.0], "v": [1, 2, 4, 8]}))
+    conn.add_table("wide", {"k": np.array([1.0, 2.0]),
+                            "v": np.array([1, 2], np.int64)},
+                   {"k": parse_type("decimal(38,2)"), "v": BIGINT})
+    cat = Catalog()
+    cat.register("mem", conn, default=True)
+    r = LocalRunner(cat, ExecConfig(batch_rows=256))
+
+    # sorted layout nulls first: [NULL(v=8), 0.0(4), 1.0(1), 2.0(2)]
+    nulled = (" from (select i, case when i = 4 then null else k end k2, v"
+              " from t) x")
+    got = r.run("select i, sum(v) over (order by k2 nulls first range"
+                " between 1 preceding and unbounded following) s"
+                + nulled).sort_values("i", ignore_index=True)
+    assert got.s.tolist() == [7, 3, 7, 15]
+    got = r.run("select i, sum(v) over (order by k2 nulls first range"
+                " between current row and unbounded following) s"
+                + nulled).sort_values("i", ignore_index=True)
+    assert got.s.tolist() == [3, 2, 7, 15]
+
+    # +inf and NaN keys are distinct single-row peer groups
+    got = r.run("select i, sum(v) over (order by k2 range between"
+                " 0 preceding and 0 following) s from"
+                " (select i, case when i = 4 then 1.0 / 0.0"
+                "              when i = 3 then sqrt(-1.0) else k end k2, v"
+                "  from t) x").sort_values("i", ignore_index=True)
+    assert got.s.tolist() == [1, 2, 4, 8]
+
+    # int128 decimals only feed their low limb to the search: reject
+    with pytest.raises(AnalysisError):
+        r.run("select sum(v) over (order by k range between 1 preceding"
+              " and current row) s from wide")
+
+    # shorthand `<frame> n FOLLOWING` is not legal SQL
+    for q in ["select sum(v) over (order by k range 3 following) s from t",
+              "select sum(v) over (order by k rows 2 following) s from t"]:
+        with pytest.raises(ParseError):
+            r.run(q)
+
+
+def test_duplicate_nan_keys_are_peers():
+    """SQL total order: NaN equals NaN for peer grouping — duplicate NaN
+    order keys share one peer group (frames, rank) instead of splitting
+    on IEEE NaN != NaN."""
+    from presto_tpu.catalog.memory import MemoryConnector
+    from presto_tpu.connector import Catalog
+    from presto_tpu.exec import ExecConfig, LocalRunner
+
+    conn = MemoryConnector("mem")
+    conn.add_table("t", pd.DataFrame(
+        {"i": [1, 2, 3, 4], "k": [1.0, 2.0, 0.0, 0.0], "v": [1, 2, 4, 8]}))
+    cat = Catalog()
+    cat.register("mem", conn, default=True)
+    r = LocalRunner(cat, ExecConfig(batch_rows=256))
+    got = r.run(
+        "select i, sum(v) over (order by k2 range between 1 preceding"
+        " and 1 following) s, rank() over (order by k2) rk,"
+        " dense_rank() over (order by k2) dr from"
+        " (select i, case when i >= 3 then sqrt(-1.0) else k end k2, v"
+        "  from t) x").sort_values("i", ignore_index=True)
+    assert got.s.tolist() == [3, 3, 12, 12]
+    assert got.rk.tolist() == [1, 2, 3, 3]
+    assert got.dr.tolist() == [1, 2, 3, 3]
