@@ -414,7 +414,11 @@ class MemoryConnector(DeviceSplitCache, Connector):
     # the in-memory table; TableFinish returns the row count) -------------
 
     def create_table_from(self, name: str, batches: Sequence[Batch],
-                          if_not_exists: bool = False) -> int:
+                          if_not_exists: bool = False,
+                          properties: Optional[dict] = None) -> int:
+        if properties:
+            raise ValueError(
+                "memory connector does not support table properties")
         if name in self.tables:
             if if_not_exists:
                 return 0

@@ -169,7 +169,11 @@ class OrcConnector(DeviceSplitCache, Connector):
     # -- write path (CTAS/DROP; reference: HiveWriterFactory ORC path) ----
 
     def create_table_from(self, name: str, batches,
-                          if_not_exists: bool = False) -> int:
+                          if_not_exists: bool = False,
+                          properties: Optional[dict] = None) -> int:
+        if properties:
+            raise ValueError(
+                "orc connector does not support table properties")
         path = os.path.join(self.directory, f"{name}.orc")
         if os.path.exists(path):
             if if_not_exists:

@@ -156,6 +156,10 @@ class _PqTable:
     nested: Dict[str, tuple] = dataclasses.field(default_factory=dict)
     # scaled-writer part tables: virtual row-group index -> (file, rg)
     part_map: Optional[list] = None
+    # hive-partitioned tables: {"pcols": [(name, Type)], "pvals": [tuple]}
+    # where pvals[i] aligns with part_map[i] (engine-native values, None
+    # for the NULL partition)
+    hive: Optional[dict] = None
 
 
 class ParquetConnector(DeviceSplitCache, Connector):
@@ -191,6 +195,9 @@ class ParquetConnector(DeviceSplitCache, Connector):
             elif f.endswith(".parts") and os.path.isdir(
                     os.path.join(self.directory, f)):
                 out.append(f[: -len(".parts")])
+            elif f.endswith(".hive") and os.path.isdir(
+                    os.path.join(self.directory, f)):
+                out.append(f[: -len(".hive")])
         return sorted(out)
 
     @staticmethod
@@ -205,6 +212,13 @@ class ParquetConnector(DeviceSplitCache, Connector):
         if t is None:
             return
         try:
+            if t.hive is not None:
+                st = os.stat(t.path)  # the partition-root directory
+                nfiles = sum(1 for _, _, fs in os.walk(t.path)
+                             for f in fs if f.endswith(".parquet"))
+                if (st.st_mtime_ns, nfiles) != t.version:
+                    self._invalidate_table(name)
+                return
             if t.part_map is not None:
                 st = os.stat(t.path)  # the parts directory
                 nparts = len([f for f in os.listdir(t.path)
@@ -280,7 +294,8 @@ class ParquetConnector(DeviceSplitCache, Connector):
     def _table_exists(self, name: str) -> bool:
         return (os.path.exists(os.path.join(self.directory,
                                             f"{name}.parquet"))
-                or os.path.isdir(self.parts_dir(name)))
+                or os.path.isdir(self.parts_dir(name))
+                or os.path.isdir(self.hive_dir(name)))
 
     def _part_files(self, name: str):
         d = self.parts_dir(name)
@@ -289,24 +304,22 @@ class ParquetConnector(DeviceSplitCache, Connector):
         return sorted(os.path.join(d, f) for f in os.listdir(d)
                       if f.endswith(".parquet"))
 
-    def _load_parts(self, name: str, parts: list) -> _PqTable:
-        """Part-directory table: (file, row group) pairs become the
-        virtual row-group space; schema/dictionaries union over parts."""
-        part_map = []
-        num_rows = 0
+    @staticmethod
+    def _scan_part_files(paths):
+        """Union schema/row-groups/string-vocab over a list of parquet
+        files (shared by the parts-directory and hive loaders)."""
         schema = None
-        dicts: Dict[str, Dictionary] = {}
+        num_rows = 0
+        rgs = []  # (path, num_row_groups)
         vocab: Dict[str, set] = {}
-        for p in parts:
+        for p in paths:
             f = pq.ParquetFile(p)
             if schema is None:
                 schema = f.schema_arrow
             num_rows += f.metadata.num_rows
-            for rg in range(f.num_row_groups):
-                part_map.append((p, rg))
+            rgs.append((p, f.num_row_groups))
             for field in schema:
                 if _arrow_to_sql(field).is_string:
-                    col = None
                     for rg in range(f.num_row_groups):
                         col = f.read_row_group(rg, columns=[field.name]).column(0)
                         for chunk in col.chunks:
@@ -316,7 +329,12 @@ class ParquetConnector(DeviceSplitCache, Connector):
                             else:
                                 vocab.setdefault(field.name, set()).update(
                                     chunk.to_pylist())
-        cols = []
+        return schema, num_rows, rgs, vocab
+
+    @staticmethod
+    def _cols_from_schema(schema, vocab):
+        """ColumnInfo + global Dictionary list from a unioned schema."""
+        cols, dicts = [], {}
         for field in schema:
             t = _arrow_to_sql(field)
             if t.is_string:
@@ -326,6 +344,14 @@ class ParquetConnector(DeviceSplitCache, Connector):
                 cols.append(ColumnInfo(field.name, t, d))
             else:
                 cols.append(ColumnInfo(field.name, t, None))
+        return cols, dicts
+
+    def _load_parts(self, name: str, parts: list) -> _PqTable:
+        """Part-directory table: (file, row group) pairs become the
+        virtual row-group space; schema/dictionaries union over parts."""
+        schema, num_rows, rgs, vocab = self._scan_part_files(parts)
+        part_map = [(p, rg) for p, n_rg in rgs for rg in range(n_rg)]
+        cols, dicts = self._cols_from_schema(schema, vocab)
         handle = TableHandle(self.name, name, cols, row_count=float(num_rows))
         d = self.parts_dir(name)
         st = os.stat(d)
@@ -335,12 +361,277 @@ class ParquetConnector(DeviceSplitCache, Connector):
         self._tables[name] = t
         return t
 
+    # -- hive-style partitioned tables (reference: presto-hive partitions:
+    # HiveTableProperties.PARTITIONED_BY_PROPERTY, HivePartitionManager
+    # partition pruning, directory layout <table>/<col>=<value>/part-*) ----
+
+    _HIVE_NULL = "__HIVE_DEFAULT_PARTITION__"
+
+    def hive_dir(self, name: str, staging: bool = False) -> str:
+        return os.path.join(self.directory,
+                            f"{name}.hive.tmp" if staging else f"{name}.hive")
+
+    @staticmethod
+    def _pval_to_path(v) -> str:
+        import urllib.parse
+
+        if v is None:
+            return ParquetConnector._HIVE_NULL
+        if isinstance(v, bool):
+            return "true" if v else "false"
+        if isinstance(v, str):
+            return urllib.parse.quote(v, safe="")
+        return str(int(v))
+
+    @staticmethod
+    def _pval_from_path(s: str, t: Type):
+        import urllib.parse
+
+        if s == ParquetConnector._HIVE_NULL:
+            return None
+        if t is BOOLEAN:
+            return s == "true"
+        if t.is_string:
+            return urllib.parse.unquote(s)
+        return int(s)
+
+    def _hive_files(self, name: str):
+        """[(fpath, pvals_by_name)] for every part file, sorted; None when
+        the table is not hive-partitioned."""
+        import json
+
+        root = self.hive_dir(name)
+        meta_path = os.path.join(root, "_meta.json")
+        if not os.path.isfile(meta_path):
+            return None
+        from presto_tpu.types import parse_type
+
+        meta = json.load(open(meta_path))
+        pcols = [(c, parse_type(ts)) for c, ts in meta["partitioned_by"]]
+        out: list = []
+        for dirpath, _dirs, files in sorted(os.walk(root)):
+            pq_files = sorted(f for f in files if f.endswith(".parquet"))
+            if not pq_files:
+                continue
+            rel = os.path.relpath(dirpath, root)
+            comps = [] if rel == "." else rel.split(os.sep)
+            if len(comps) != len(pcols):
+                continue  # stray depth: not a partition leaf
+            pvals = {}
+            for comp, (c, t) in zip(comps, pcols):
+                cname, _, raw = comp.partition("=")
+                if cname != c:
+                    raise ValueError(
+                        f"malformed partition directory {rel!r} in {name}")
+                pvals[c] = self._pval_from_path(raw, t)
+            for f in pq_files:
+                out.append((os.path.join(dirpath, f), pvals))
+        return pcols, out, meta
+
+    def _load_hive(self, name: str) -> _PqTable:
+        """Partitioned table: partition values come from directory names,
+        data columns from the files; partition columns append to the
+        schema (hive convention: partition keys are the trailing
+        columns)."""
+        root = self.hive_dir(name)
+        pcols, files, meta = self._hive_files(name)
+        schema, num_rows, rgs, vocab = self._scan_part_files(
+            [fp for fp, _ in files])
+        pvals_by_file = dict(files)
+        part_map, pvals_list = [], []
+        for fp, n_rg in rgs:
+            for rg in range(n_rg):
+                part_map.append((fp, rg))
+                pvals_list.append(tuple(pvals_by_file[fp][c]
+                                        for c, _ in pcols))
+        if schema is not None:
+            cols, dicts = self._cols_from_schema(schema, vocab)
+        else:
+            # zero-row table: the data-column schema survives in _meta.json
+            from presto_tpu.types import parse_type
+
+            cols, dicts = [], {}
+            pset = {c for c, _ in pcols}
+            for c, ts in meta.get("columns", []):
+                if c in pset:
+                    continue
+                t = parse_type(ts)
+                if t.is_string:
+                    d = Dictionary(np.array([], dtype=object))
+                    dicts[c] = d
+                    cols.append(ColumnInfo(c, t, d))
+                else:
+                    cols.append(ColumnInfo(c, t, None))
+        from presto_tpu.connector import ColumnStats
+
+        for i, (c, t) in enumerate(pcols):
+            vals = sorted({pv[i] for pv in pvals_list if pv[i] is not None})
+            if t.is_string:
+                d = Dictionary(np.array(vals, dtype=object))
+                dicts[c] = d
+                cols.append(ColumnInfo(c, t, d,
+                                       ColumnStats(ndv=float(len(vals)))))
+            else:
+                cols.append(ColumnInfo(c, t, None, ColumnStats(
+                    ndv=float(len(vals)),
+                    min_value=(float(vals[0]) if vals else None),
+                    max_value=(float(vals[-1]) if vals else None))))
+        handle = TableHandle(self.name, name, cols, row_count=float(num_rows))
+        st = os.stat(root)
+        t = _PqTable(root, handle, dicts, num_rows, len(part_map),
+                     version=(st.st_mtime_ns, len(files)),
+                     part_map=part_map,
+                     hive={"pcols": pcols, "pvals": pvals_list})
+        self._tables[name] = t
+        return t
+
+    def _hive_group_rows(self, pnames, data):
+        """Group host rows by partition tuple: [(pvals_tuple, row_idx)]
+        with engine-native values (strings decoded, None for NULL)."""
+        combined = None
+        reprs = []
+        for c in pnames:
+            vals, valid, hi, d = data[c]
+            if hi is not None:
+                raise ValueError(
+                    f"partition column {c} has an unsupported wide type")
+            is_bool = np.asarray(vals).dtype == np.bool_
+            arr = np.asarray(vals).astype(np.int64)
+            null_mark = (np.asarray(~np.asarray(valid))
+                         if valid is not None else np.zeros(len(arr), bool))
+            reprs.append((arr, null_mark, d, is_bool))
+            # group code: 0 = the NULL partition, else 1 + value ordinal
+            # (a separate null axis — a real value of -1 must not merge
+            # with NULLs)
+            _, inv = np.unique(arr, return_inverse=True)
+            code = np.where(null_mark, 0, inv + 1)
+            width = int(code.max()) + 1 if len(code) else 1
+            combined = (code if combined is None
+                        else combined * width + code)
+        u_comb, inv = np.unique(combined, return_inverse=True)
+        groups = []
+        for gi in range(len(u_comb)):
+            idx = np.nonzero(inv == gi)[0]
+            row0 = int(idx[0])
+            pvals = []
+            for arr, null_mark, d, is_bool in reprs:
+                if null_mark[row0]:
+                    pvals.append(None)
+                elif d is not None:
+                    pvals.append(str(d.decode(arr[row0:row0 + 1])[0]))
+                elif is_bool:
+                    pvals.append(bool(arr[row0]))
+                else:
+                    pvals.append(int(arr[row0]))
+            groups.append((tuple(pvals), idx))
+        return groups
+
+    def _hive_validate(self, pnames, names, types):
+        tmap = dict(zip(names, types))
+        for c in pnames:
+            if c not in tmap:
+                raise ValueError(f"partition column {c} not in table schema")
+            t = tmap[c]
+            ok = (t.is_string or t is BOOLEAN or t is DATE
+                  or (not t.is_string and t.dtype in ("int64", "int32")
+                      and not isinstance(t, DecimalType)))
+            if not ok:
+                raise ValueError(
+                    f"partition column {c} must be integer, varchar, "
+                    f"boolean or date, got {t}")
+        if list(names[-len(pnames):]) != list(pnames):
+            raise ValueError(
+                "partitioned_by columns must be the trailing table "
+                "columns (hive convention)")
+
+    def _hive_write_groups(self, root, pnames, names, types, data, groups,
+                           file_tag: str):
+        """Write one parquet file per partition group under
+        root/<c>=<v>/..., data columns only."""
+        dnames = [c for c in names if c not in set(pnames)]
+        tmap = dict(zip(names, types))
+        rows = 0
+        for pvals, idx in groups:
+            comps = [f"{c}={self._pval_to_path(v)}"
+                     for c, v in zip(pnames, pvals)]
+            d = os.path.join(root, *comps)
+            os.makedirs(d, exist_ok=True)
+            plain = {c: np.asarray(data[c][0])[idx] for c in dnames}
+            validity = {c: np.asarray(data[c][1])[idx]
+                        for c in dnames if data[c][1] is not None}
+            his = {c: np.asarray(data[c][2])[idx]
+                   for c in dnames if data[c][2] is not None}
+            dicts = {c: data[c][3] for c in dnames if data[c][3] is not None}
+            arrays, schema = _to_arrow_columns(
+                plain, {c: tmap[c] for c in dnames}, dicts, validity, his)
+            tbl = pa.Table.from_arrays(arrays, schema=schema)
+            pq.write_table(tbl, os.path.join(d, f"part-{file_tag}.parquet"),
+                           row_group_size=1 << 20, use_dictionary=True,
+                           compression="zstd")
+            rows += int(tbl.num_rows)
+        return rows
+
+    def _hive_create(self, name: str, batches, pnames,
+                     if_not_exists: bool = False) -> int:
+        import json
+        import shutil
+
+        from presto_tpu.catalog.memory import _batches_to_host
+        from presto_tpu.types import ArrayType, MapType
+
+        if self._table_exists(name):
+            if if_not_exists:
+                return 0
+            raise ValueError(f"table already exists: {name}")
+        names, types, data = _batches_to_host(batches)
+        if any(isinstance(t, (ArrayType, MapType)) for t in types):
+            raise NotImplementedError(
+                "parquet writer does not support ARRAY/MAP columns yet")
+        self._hive_validate(pnames, names, types)
+        staging = self.hive_dir(name, staging=True)
+        shutil.rmtree(staging, ignore_errors=True)
+        os.makedirs(staging)
+        groups = self._hive_group_rows(pnames, data)
+        rows = self._hive_write_groups(staging, pnames, names, types, data,
+                                       groups, "0")
+        tmap = dict(zip(names, types))
+        with open(os.path.join(staging, "_meta.json"), "w") as f:
+            json.dump({"partitioned_by": [[c, tmap[c].name] for c in pnames],
+                       # full schema: survives a zero-row CTAS (no files)
+                       "columns": [[c, tmap[c].name] for c in names]}, f)
+        os.rename(staging, self.hive_dir(name))
+        self._invalidate_table(name)
+        return rows
+
+    def _hive_insert(self, name: str, batches) -> int:
+        import uuid
+
+        from presto_tpu.catalog.memory import _batches_to_host
+
+        t = self._load(name)
+        pnames = [c for c, _ in t.hive["pcols"]]
+        names, types, data = _batches_to_host(batches)
+        existing = [(c.name, c.type.name) for c in t.handle.columns]
+        if [(c, tt.name) for c, tt in zip(names, types)] != existing:
+            raise ValueError(
+                f"INSERT schema mismatch for partitioned table {name}: "
+                f"{[(c, tt.name) for c, tt in zip(names, types)]} vs "
+                f"{existing}")
+        groups = self._hive_group_rows(pnames, data)
+        rows = self._hive_write_groups(self.hive_dir(name), pnames, names,
+                                       types, data, groups, uuid.uuid4().hex)
+        os.utime(self.hive_dir(name))  # bust _check_fresh versions
+        self._invalidate_table(name)
+        return rows
+
     def _load(self, name: str) -> _PqTable:
         self._check_fresh(name)
         if name in self._tables:
             return self._tables[name]
         path = os.path.join(self.directory, f"{name}.parquet")
         if not os.path.exists(path):
+            if os.path.isdir(self.hive_dir(name)):
+                return self._load_hive(name)
             parts = self._part_files(name)
             if parts:
                 return self._load_parts(name, parts)
@@ -455,8 +746,37 @@ class ParquetConnector(DeviceSplitCache, Connector):
         keep = []
         name_to_idx = {f0.schema_arrow.field(i).name: i
                        for i in range(len(f0.schema_arrow.names))}
+        pidx = ({c: i for i, (c, _) in enumerate(t.hive["pcols"])}
+                if t.hive is not None else {})
+
+        def partition_pruned(rg_idx) -> bool:
+            """Hive partition pruning: directory values against the
+            constraint, zero file IO (HivePartitionManager analog).
+            Constraint values arrive in the storage domain (dates as
+            datetime.date) — convert the stored engine value to match."""
+            import datetime
+
+            pvals = t.hive["pvals"][rg_idx]
+            for col, (lo, hi) in min_max.items():
+                i = pidx.get(col)
+                if i is None:
+                    continue
+                v = pvals[i]
+                if v is None:
+                    # NULL partition never matches a range constraint
+                    return lo is not None or hi is not None
+                if t.hive["pcols"][i][1] is DATE:
+                    v = datetime.date.fromordinal(719163 + int(v))
+                if lo is not None and v < lo:
+                    return True
+                if hi is not None and v > hi:
+                    return True
+            return False
+
         for s in splits:
             rg_idx = s.part[0] if isinstance(s.part, tuple) else s.part
+            if pidx and partition_pruned(rg_idx):
+                continue
             _, rg = rg_meta(rg_idx)
             ok = True
             for col, (lo, hi) in min_max.items():
@@ -482,14 +802,28 @@ class ParquetConnector(DeviceSplitCache, Connector):
         self._tables.pop(name, None)
         self.invalidate_cache(name)
         with self._host_cache_lock:
-            path = os.path.join(self.directory, f"{name}.parquet")
-            for k in [k for k in self._host_cache if k[0] == path]:
+            # t.path is the single file OR the parts/hive directory
+            paths = {os.path.join(self.directory, f"{name}.parquet"),
+                     self.parts_dir(name), self.hive_dir(name)}
+            for k in [k for k in self._host_cache if k[0] in paths]:
                 _, nbytes = self._host_cache.pop(k)
                 self._host_cache_used -= nbytes
 
-    def create_table_from(self, name: str, batches, if_not_exists: bool = False) -> int:
+    def create_table_from(self, name: str, batches, if_not_exists: bool = False,
+                          properties: Optional[dict] = None) -> int:
         from presto_tpu.catalog.memory import _batches_to_host
 
+        if properties:
+            props = dict(properties)
+            pby = props.pop("partitioned_by", None)
+            if props:
+                raise ValueError(
+                    f"unknown table properties: {sorted(props)}")
+            if pby:
+                if isinstance(pby, str):
+                    pby = [pby]
+                return self._hive_create(name, batches, list(pby),
+                                         if_not_exists=if_not_exists)
         path = os.path.join(self.directory, f"{name}.parquet")
         if os.path.exists(path):
             if if_not_exists:
@@ -521,6 +855,8 @@ class ParquetConnector(DeviceSplitCache, Connector):
         file (parquet files are immutable)."""
         path = os.path.join(self.directory, f"{name}.parquet")
         if not os.path.exists(path):
+            if os.path.isdir(self.hive_dir(name)):
+                return self._hive_insert(name, batches)
             if os.path.isdir(self.parts_dir(name)):
                 import uuid
 
@@ -586,13 +922,13 @@ class ParquetConnector(DeviceSplitCache, Connector):
     def drop_table(self, name: str, if_exists: bool = False) -> None:
         path = os.path.join(self.directory, f"{name}.parquet")
         if not os.path.exists(path):
-            parts = self.parts_dir(name)
-            if os.path.isdir(parts):
-                import shutil
+            for d in (self.parts_dir(name), self.hive_dir(name)):
+                if os.path.isdir(d):
+                    import shutil
 
-                shutil.rmtree(parts)
-                self._invalidate_table(name)
-                return
+                    shutil.rmtree(d)
+                    self._invalidate_table(name)
+                    return
             if if_exists:
                 return
             raise KeyError(f"table not found: {name}")
@@ -616,12 +952,18 @@ class ParquetConnector(DeviceSplitCache, Connector):
 
     def truncate_table(self, name: str):
         t = self._load(name)
+        if t.hive is not None:
+            raise NotImplementedError(
+                "TRUNCATE on hive-partitioned tables is not supported")
         cols = [(c.name, c.type) for c in t.handle.columns]
         self.drop_table(name)
         self.create_empty(name, cols)
 
     def replace_table_from(self, name: str, batches) -> int:
-        self._load(name)  # existence check
+        t = self._load(name)  # existence check
+        if t.hive is not None:
+            raise NotImplementedError(
+                "DELETE rewrite on hive-partitioned tables is not supported")
         self.drop_table(name)
         return self.create_table_from(name, batches)
 
@@ -640,6 +982,7 @@ class ParquetConnector(DeviceSplitCache, Connector):
             if hit is not None:
                 self._host_cache.move_to_end(key)
                 return hit[0]
+        vrg = rg
         if t.part_map is not None:
             # part-directory table: the virtual row-group index resolves
             # to (part file, row group within it)
@@ -647,7 +990,9 @@ class ParquetConnector(DeviceSplitCache, Connector):
             f = pq.ParquetFile(fpath)
         else:
             f = pq.ParquetFile(t.path)
-        plain = [c for c in columns if c not in t.nested]
+        pset = ({c for c, _ in t.hive["pcols"]} if t.hive is not None
+                else set())
+        plain = [c for c in columns if c not in t.nested and c not in pset]
         parents = sorted({t.nested[c][0] for c in columns if c in t.nested})
         tbl = f.read_row_group(rg, columns=plain + parents)
         if t.nested:
@@ -667,16 +1012,22 @@ class ParquetConnector(DeviceSplitCache, Connector):
                     arrays.append(tbl.column(c))
                     fields.append(pa.field(c, tbl.column(c).type))
             tbl = pa.Table.from_arrays(arrays, schema=pa.schema(fields))
+        rg_rows = f.metadata.row_group(rg).num_rows
         if sub_count > 1:
-            per = -(-tbl.num_rows // sub_count)
+            per = -(-rg_rows // sub_count)
             tbl = tbl.slice(sub * per, per)
-        n = tbl.num_rows
+            n = max(0, min(per, rg_rows - sub * per))
+        else:
+            n = rg_rows
         out = {}
         nbytes = 0
         for name in columns:
             st = t.handle.column(name).type
-            arr, valid, hi = _decode_column(tbl.column(name), st,
-                                            t.dicts.get(name))
+            if name in pset:
+                arr, valid, hi = self._hive_constant(t, vrg, name, st, n)
+            else:
+                arr, valid, hi = _decode_column(tbl.column(name), st,
+                                                t.dicts.get(name))
             arr = np.ascontiguousarray(np.asarray(arr))
             out[name] = (arr, valid, hi)
             nbytes += arr.nbytes + (valid.nbytes if valid is not None else 0)
@@ -691,6 +1042,19 @@ class ParquetConnector(DeviceSplitCache, Connector):
                         _, (_, freed) = self._host_cache.popitem(last=False)
                         self._host_cache_used -= freed
         return result
+
+    def _hive_constant(self, t: _PqTable, vrg: int, name: str, st: Type,
+                       n: int):
+        """Partition column for one split: a constant engine-native array
+        from the directory value (HivePartitionKey → constant block)."""
+        i = next(j for j, (c, _) in enumerate(t.hive["pcols"]) if c == name)
+        v = t.hive["pvals"][vrg][i]
+        if v is None:
+            return (np.zeros(n, dtype=st.dtype), np.zeros(n, bool), None)
+        if st.is_string:
+            code = t.dicts[name].code_of(v)
+            return (np.full(n, code, dtype=st.dtype), None, None)
+        return (np.full(n, v, dtype=st.dtype), None, None)
 
     def _read_split_uncached(self, split: Split, columns: Sequence[str],
                              capacity: Optional[int] = None) -> Batch:

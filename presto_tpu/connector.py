@@ -95,7 +95,8 @@ class Connector:
     # simply inherit the failures) --------------------------------------
 
     def create_table_from(self, name: str, batches: Sequence[Batch],
-                          if_not_exists: bool = False) -> int:
+                          if_not_exists: bool = False,
+                          properties: Optional[dict] = None) -> int:
         raise NotImplementedError(
             f"connector {self.name!r} does not support CREATE TABLE")
 

@@ -74,6 +74,9 @@ def execute_data_definition(stmt, catalog: Catalog, run_query_fn):
     if isinstance(stmt, _ast.CreateTable):
         from presto_tpu.types import parse_type
 
+        if stmt.properties:
+            raise ValueError(
+                "table properties are only supported on CREATE TABLE AS")
         cols = [(c, parse_type(t)) for c, t in stmt.columns]
         conn.create_empty(tname, cols, if_not_exists=stmt.if_not_exists)
         return _count_batch(0)
@@ -101,7 +104,8 @@ def execute_data_definition(stmt, catalog: Catalog, run_query_fn):
     result = run_query_fn(stmt.query)
     if isinstance(stmt, _ast.CreateTableAs):
         n = conn.create_table_from(tname, [result],
-                                   if_not_exists=stmt.if_not_exists)
+                                   if_not_exists=stmt.if_not_exists,
+                                   properties=stmt.properties or None)
     else:
         n = conn.insert_into(tname, [result])
     return _count_batch(n)

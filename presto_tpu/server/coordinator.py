@@ -600,6 +600,10 @@ class Coordinator:
 
         if not isinstance(stmt, _ast.CreateTableAs):
             return None
+        if stmt.properties:
+            # partitioned CTAS groups rows by partition value — the
+            # single-writer path owns that layout
+            return None
         conn, tname = self.catalog.connector_for(stmt.name)
         if not getattr(conn, "supports_scaled_writes", lambda: False)():
             return None

@@ -284,12 +284,15 @@ class Query(Node):
 
 @dataclasses.dataclass
 class CreateTableAs(Node):
-    """CREATE TABLE [IF NOT EXISTS] name AS query (reference:
-    execution/CreateTableTask.java + the TableWriter chain)."""
+    """CREATE TABLE [IF NOT EXISTS] name [WITH (props)] AS query
+    (reference: execution/CreateTableTask.java + the TableWriter chain;
+    properties e.g. partitioned_by = array['c'] as in the hive
+    connector's HiveTableProperties)."""
 
     name: Tuple[str, ...]
     query: Node  # Query | SetOp
     if_not_exists: bool = False
+    properties: dict = dataclasses.field(default_factory=dict)
 
 
 @dataclasses.dataclass
@@ -309,6 +312,7 @@ class CreateTable(Node):
     name: Tuple[str, ...]
     columns: list  # [(name, type_string)]
     if_not_exists: bool = False
+    properties: dict = dataclasses.field(default_factory=dict)
 
 
 @dataclasses.dataclass

@@ -222,10 +222,39 @@ class Parser:
                 if not self.accept_op(","):
                     break
             self.expect_op(")")
-            return ast.CreateTable(name, cols, if_not_exists)
+            props = self._parse_table_properties()
+            return ast.CreateTable(name, cols, if_not_exists, props)
+        props = self._parse_table_properties()
         self.expect_kw("as")
         q = self.parse_query()
-        return ast.CreateTableAs(name, q, if_not_exists)
+        return ast.CreateTableAs(name, q, if_not_exists, props)
+
+    def _parse_table_properties(self) -> dict:
+        """WITH (key = <literal>, ...) — hive-style table properties;
+        values are literals or ARRAY[<literals>]."""
+        if not self.accept_kw("with"):
+            return {}
+        self.expect_op("(")
+        props = {}
+
+        def literal_value(e):
+            if isinstance(e, ast.Literal):
+                return e.value
+            if (isinstance(e, ast.FunctionCall) and e.name == "array_ctor"
+                    and all(isinstance(a, ast.Literal) for a in e.args)):
+                return [a.value for a in e.args]
+            raise ParseError(
+                "table property values must be literals or arrays of "
+                "literals")
+
+        while True:
+            key = self.ident()
+            self.expect_op("=")
+            props[key] = literal_value(self.parse_expr())
+            if not self.accept_op(","):
+                break
+        self.expect_op(")")
+        return props
 
     def _parse_insert(self) -> ast.Node:
         self.expect_kw("insert")
