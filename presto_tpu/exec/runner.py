@@ -77,7 +77,13 @@ def execute_data_definition(stmt, catalog: Catalog, run_query_fn):
         if stmt.properties:
             raise ValueError(
                 "table properties are only supported on CREATE TABLE AS")
+        from presto_tpu.types import GEOMETRY
+
         cols = [(c, parse_type(t)) for c, t in stmt.columns]
+        if any(t is GEOMETRY for _, t in cols):
+            raise ValueError(
+                "GEOMETRY columns cannot be stored — keep WKT varchar and "
+                "parse with ST_GeometryFromText")
         conn.create_empty(tname, cols, if_not_exists=stmt.if_not_exists)
         return _count_batch(0)
     if isinstance(stmt, _ast.Truncate):

@@ -605,8 +605,20 @@ _STRUCT_POLY_FNS = {"cardinality", "contains", "concat", "element_at",
                     "subscript"}
 
 
+_GEO_FNS = {
+    "st_geometryfromtext", "st_point", "st_x", "st_y", "st_distance",
+    "st_contains", "st_intersects", "st_area", "st_perimeter", "st_length",
+    "st_npoints", "st_xmin", "st_xmax", "st_ymin", "st_ymax", "st_centroid",
+    "great_circle_distance",
+}
+
+
 def _eval_call(e: Call, ctx: CompileContext):
     fn = e.fn
+
+    # ---- geospatial ------------------------------------------------------
+    if fn in _GEO_FNS:
+        return _eval_geo(e, ctx)
 
     # ---- structural (ARRAY / MAP) ---------------------------------------
     if fn in _STRUCT_ONLY_FNS or (
@@ -1743,3 +1755,222 @@ def days_from_civil(y: int, m: int, d: int) -> int:
     doy = (153 * (m + (-3 if m > 2 else 9)) + 2) // 5 + d - 1
     doe = yoe * 365 + yoe // 4 - yoe // 100 + doy
     return era * 146097 + doe - 719468
+
+
+# ---------------------------------------------------------------------------
+# geospatial (expr/geo.py): WKT parses once per dictionary, row ops are
+# vectorized plane programs (reference: presto-geospatial GeoFunctions)
+
+# bounded LRUs: long-running servers compile unboundedly many plans
+from collections import OrderedDict as _OD
+
+_GEO_PLANES_CACHE: "_OD" = _OD()   # id(geoms) -> (geoms, np planes)
+_GEO_CONST_CACHE: "_OD" = _OD()    # wkt literal -> (geoms, ok) singleton
+
+
+def _geo_planes(geoms: tuple):
+    from presto_tpu.expr import geo as G
+
+    hit = _GEO_PLANES_CACHE.get(id(geoms))
+    if hit is not None and hit[0] is geoms:
+        _GEO_PLANES_CACHE.move_to_end(id(geoms))
+        return hit[1]
+    planes = G.edge_planes(geoms)
+    _GEO_PLANES_CACHE[id(geoms)] = (geoms, planes)
+    while len(_GEO_PLANES_CACHE) > 128:
+        _GEO_PLANES_CACHE.popitem(last=False)
+    return planes
+
+
+def _geo_parse_all(values):
+    """Lenient WKT parse: (geoms tuple, ok ndarray). Unparseable values
+    (incl. the '' null sentinel some connectors store) become invalid
+    rows, not query failures."""
+    from presto_tpu.expr import geo as G
+
+    parsed, ok = [], []
+    fallback = G.parse_wkt("POINT(0 0)")
+    for v in values:
+        try:
+            parsed.append(G.parse_wkt(str(v)))
+            ok.append(True)
+        except G.WktError:
+            parsed.append(fallback)
+            ok.append(False)
+    return tuple(parsed), np.asarray(ok, bool)
+
+
+def _geo_lut(gv, func, dtype=jnp.float64):
+    """geometry→scalar as a host table gathered by code."""
+    table = jnp.asarray(np.array([func(g) for g in gv.geoms]).astype(dtype))
+    return table[jnp.clip(gv.codes, 0, len(gv.geoms) - 1)]
+
+
+def _geo_points(gv):
+    """(x, y) coordinate arrays of a GeomVal; None when it holds
+    non-point geometries."""
+    from presto_tpu.expr import geo as G
+
+    if gv.kind == "points":
+        return gv.x, gv.y
+    if all(G.is_point(g) for g in gv.geoms):
+        return (_geo_lut(gv, lambda g: G.point_xy(g)[0]),
+                _geo_lut(gv, lambda g: G.point_xy(g)[1]))
+    return None
+
+
+def _eval_geom_arg(a: RowExpression, ctx):
+    """Evaluate a GEOMETRY-typed subexpression to (GeomVal, valid)."""
+    v, valid = _eval(a, ctx)
+    from presto_tpu.expr.geo import GeomVal
+
+    if not isinstance(v, GeomVal):
+        raise NotImplementedError(
+            "GEOMETRY values only flow between geospatial functions")
+    return v, valid
+
+
+def _eval_geo(e: Call, ctx: CompileContext):
+    from presto_tpu.expr import geo as G
+    from presto_tpu.expr.geo import GeomVal
+
+    fn = e.fn
+    if fn == "great_circle_distance":
+        vals = [_eval_arg(a, ctx) for a in e.args]
+        valid = None
+        for _, va in vals:
+            valid = _and_valid(valid, va)
+        lat1, lon1, lat2, lon2 = (v.astype(jnp.float64) for v, _ in vals)
+        return G.great_circle_distance(lat1, lon1, lat2, lon2), valid
+
+    if fn == "st_geometryfromtext":
+        a = e.args[0]
+        cap = ctx.batch.capacity
+        if isinstance(a, Constant):
+            key = str(a.value) if a.value is not None else None
+            if key is None:
+                geoms, ok = _geo_parse_all([""])
+            else:
+                hit = _GEO_CONST_CACHE.get(key)
+                if hit is None:
+                    hit = _geo_parse_all([key])
+                    _GEO_CONST_CACHE[key] = hit
+                    while len(_GEO_CONST_CACHE) > 256:
+                        _GEO_CONST_CACHE.popitem(last=False)
+                else:
+                    _GEO_CONST_CACHE.move_to_end(key)
+                geoms, ok = hit
+            valid = None if bool(ok[0]) else jnp.zeros(cap, bool)
+            return GeomVal("coded", jnp.zeros(cap, jnp.int32), geoms,
+                           None, None), valid
+        codes, valid = _eval(a, ctx)
+        hit = ctx.dict_for(a)
+        if hit is None:
+            raise NotImplementedError(
+                "ST_GeometryFromText needs a dictionary-encoded varchar")
+        d = hit
+        memo = d._memo.get("__geoms__")
+        if memo is None:
+            memo = _geo_parse_all(d.values)
+            d._memo["__geoms__"] = memo
+        geoms, ok = memo
+        if not geoms:
+            geoms, ok = _geo_parse_all([""])
+            return (GeomVal("coded", jnp.zeros(cap, jnp.int32), geoms,
+                            None, None), jnp.zeros(cap, bool))
+        okv = jnp.asarray(ok)[jnp.clip(codes, 0, len(geoms) - 1)]
+        okv = okv & (codes >= 0)
+        return GeomVal("coded", codes, geoms, None, None), _and_valid(
+            valid, okv)
+
+    if fn == "st_point":
+        (x, xv), (y, yv) = (_eval_arg(a, ctx) for a in e.args)
+
+        def vec(v):
+            v = v.astype(jnp.float64)
+            # literal coordinates arrive 0-d; plane gathers need [rows]
+            return (jnp.broadcast_to(v, (ctx.batch.capacity,))
+                    if jnp.ndim(v) == 0 else v)
+
+        return (GeomVal("points", None, None, vec(x), vec(y)),
+                _and_valid(xv, yv))
+
+    if fn in ("st_area", "st_perimeter", "st_length", "st_npoints",
+              "st_xmin", "st_xmax", "st_ymin", "st_ymax", "st_x", "st_y",
+              "st_centroid"):
+        gv, valid = _eval_geom_arg(e.args[0], ctx)
+        if gv.kind == "points":
+            if fn in ("st_x", "st_xmin", "st_xmax"):
+                return gv.x, valid
+            if fn in ("st_y", "st_ymin", "st_ymax"):
+                return gv.y, valid
+            if fn == "st_centroid":
+                return gv, valid
+            if fn == "st_npoints":
+                return jnp.ones_like(gv.x, dtype=jnp.int64), valid
+            return jnp.zeros_like(gv.x), valid  # area/perimeter/length
+        if fn in ("st_x", "st_y"):
+            if not all(G.is_point(g) for g in gv.geoms):
+                raise NotImplementedError(f"{fn} needs POINT geometries")
+            i = 0 if fn == "st_x" else 1
+            return _geo_lut(gv, lambda g: G.point_xy(g)[i]), valid
+        if fn == "st_centroid":
+            return (GeomVal("points", None, None,
+                            _geo_lut(gv, lambda g: G.geom_centroid(g)[0]),
+                            _geo_lut(gv, lambda g: G.geom_centroid(g)[1])),
+                    valid)
+        host = {"st_area": G.geom_area, "st_perimeter": G.geom_perimeter,
+                "st_length": G.geom_length,
+                "st_xmin": lambda g: G.geom_bbox(g)[0],
+                "st_ymin": lambda g: G.geom_bbox(g)[1],
+                "st_xmax": lambda g: G.geom_bbox(g)[2],
+                "st_ymax": lambda g: G.geom_bbox(g)[3]}
+        if fn == "st_npoints":
+            return _geo_lut(gv, G.geom_npoints, jnp.int64), valid
+        return _geo_lut(gv, host[fn]), valid
+
+    # binary geometry relations
+    ga, va = _eval_geom_arg(e.args[0], ctx)
+    gb, vb = _eval_geom_arg(e.args[1], ctx)
+    valid = _and_valid(va, vb)
+    pa, pb = _geo_points(ga), _geo_points(gb)
+
+    if fn in ("st_contains", "st_intersects"):
+        def point_in(poly, px, py):
+            # only area kinds enclose points (linestrings never do)
+            inside = G.point_in_coded(_geo_planes(poly.geoms), poly.codes,
+                                      px, py)
+            area = _geo_lut(poly, lambda g: float(G.is_area(g))) > 0
+            return inside & area
+
+        # polygon side contains / intersects a point probe (even-odd)
+        if ga.kind == "coded" and pb is not None and pa is None:
+            return point_in(ga, pb[0], pb[1]), valid
+        if (fn == "st_intersects" and gb.kind == "coded"
+                and pa is not None and pb is None):
+            return point_in(gb, pa[0], pa[1]), valid
+        if pa is not None and pb is not None:
+            eqv = (pa[0] == pb[0]) & (pa[1] == pb[1])
+            return eqv, valid
+        if fn == "st_contains" and pa is not None and pb is None:
+            # a point never contains a polygon/linestring
+            return jnp.zeros_like(pa[0], dtype=bool), valid
+        raise NotImplementedError(
+            f"{fn} between two non-point geometries is not supported")
+
+    if fn == "st_distance":
+        if pa is not None and pb is not None:
+            return jnp.hypot(pa[0] - pb[0], pa[1] - pb[1]), valid
+        poly, pt = (ga, pb) if pa is None else (gb, pa)
+        if pt is None:
+            raise NotImplementedError(
+                "ST_Distance between two non-point geometries is not "
+                "supported")
+        d = G.point_seg_distance(_geo_planes(poly.geoms), poly.codes,
+                                 pt[0], pt[1])
+        inside = G.point_in_coded(_geo_planes(poly.geoms), poly.codes,
+                                  pt[0], pt[1])
+        area = _geo_lut(poly, lambda g: float(G.is_area(g))) > 0
+        return jnp.where(inside & area, 0.0, d), valid
+
+    raise NotImplementedError(f"geospatial function {fn}")

@@ -54,6 +54,7 @@ from presto_tpu.types import (
     DOUBLE,
     ArrayType,
     DecimalType,
+    GEOMETRY,
     INTEGER,
     MapType,
     TIMESTAMP,
@@ -510,6 +511,9 @@ class ExprAnalyzer:
 
     def _an_Cast(self, node: ast.Cast) -> RowExpression:
         t = parse_type(node.type_name)
+        if t is GEOMETRY:
+            raise AnalysisError(
+                "cannot cast to GEOMETRY — use ST_GeometryFromText")
         v = self.analyze(node.value)
         if isinstance(v, Constant) and v.value is not None and node.type_name.lower() == "date":
             y, m, d = map(int, str(v.value).split("-"))
@@ -534,6 +538,9 @@ class ExprAnalyzer:
         structural = self._an_structural_fn(name, args)
         if structural is not None:
             return structural
+        geo = self._an_geo_fn(name, args)
+        if geo is not None:
+            return geo
         if name == "abs":
             return Call(args[0].type, "abs", args)
         if name in ("sqrt", "exp", "ln", "power", "pow"):
@@ -735,6 +742,79 @@ class ExprAnalyzer:
         if name == "filter":
             return Call(arr.type, "filter", (arr, le))
         return Call(BOOLEAN, name, (arr, le))  # any/all/none_match
+
+    _GEO_ALIASES = {
+        "st_geometry_from_text": "st_geometryfromtext",
+        "st_geomfromtext": "st_geometryfromtext",
+        "st_as_text": "st_astext",
+    }
+
+    def _an_geo_fn(self, name: str, args) -> Optional[RowExpression]:
+        """Geospatial functions (reference: presto-geospatial
+        GeoFunctions.java). GEOMETRY values flow only between geo
+        functions — ST_AsText is the way out, ST_GeometryFromText /
+        ST_Point the ways in."""
+        name = self._GEO_ALIASES.get(name, name)
+
+        def need(n, what):
+            if len(args) != n:
+                raise AnalysisError(f"{what} takes {n} argument(s)")
+
+        def geom(i):
+            if args[i].type is not GEOMETRY:
+                raise AnalysisError(
+                    f"{name} argument {i + 1} must be a GEOMETRY "
+                    f"(got {args[i].type})")
+
+        if name == "st_geometryfromtext":
+            need(1, name)
+            if not args[0].type.is_string:
+                raise AnalysisError(
+                    "ST_GeometryFromText takes a varchar WKT argument")
+            return Call(GEOMETRY, "st_geometryfromtext", args)
+        if name == "st_point":
+            need(2, name)
+            return Call(GEOMETRY, "st_point",
+                        tuple(self._to_double(a) for a in args))
+        if name == "st_astext":
+            need(1, name)
+            geom(0)
+            inner = args[0]
+            if isinstance(inner, Call) and inner.fn == "st_geometryfromtext":
+                return inner.args[0]  # text round-trips unchanged
+            raise AnalysisError(
+                "ST_AsText is supported only on geometries parsed from "
+                "text (derived geometries have no stored representation)")
+        if name in ("st_x", "st_y", "st_area", "st_perimeter", "st_length",
+                    "st_xmin", "st_xmax", "st_ymin", "st_ymax"):
+            need(1, name)
+            geom(0)
+            return Call(DOUBLE, name, args)
+        if name == "st_npoints":
+            need(1, name)
+            geom(0)
+            return Call(BIGINT, name, args)
+        if name == "st_centroid":
+            need(1, name)
+            geom(0)
+            return Call(GEOMETRY, name, args)
+        if name in ("st_contains", "st_intersects", "st_within"):
+            need(2, name)
+            geom(0)
+            geom(1)
+            if name == "st_within":  # within(a, b) == contains(b, a)
+                return Call(BOOLEAN, "st_contains", (args[1], args[0]))
+            return Call(BOOLEAN, name, args)
+        if name == "st_distance":
+            need(2, name)
+            geom(0)
+            geom(1)
+            return Call(DOUBLE, name, args)
+        if name == "great_circle_distance":
+            need(4, name)
+            return Call(DOUBLE, name,
+                        tuple(self._to_double(a) for a in args))
+        return None
 
     def _an_structural_fn(self, name: str, args) -> Optional[RowExpression]:
         """ARRAY/MAP function typing (spi/type/ArrayType + MapType;
@@ -1326,6 +1406,10 @@ class Planner:
         alias_map: Dict[str, Tuple[str, Type]] = {}
         for it, e in zip(select_items, select_exprs):
             name = it.alias or _derive_name(it.expr)
+            if e.type is GEOMETRY:
+                raise AnalysisError(
+                    "GEOMETRY values cannot be output directly — wrap the "
+                    "expression in ST_AsText(...)")
             if isinstance(e, InputRef) and it.alias is None:
                 sym = e.name
             else:

@@ -43,6 +43,11 @@ _SCALAR = {
             "map_concat"],
     "lambda": ["transform", "filter", "reduce", "any_match", "all_match",
                "none_match", "transform_values", "map_filter", "zip_with"],
+    "geospatial": ["st_geometryfromtext", "st_point", "st_astext", "st_x",
+                   "st_y", "st_contains", "st_within", "st_intersects",
+                   "st_distance", "st_area", "st_perimeter", "st_length",
+                   "st_npoints", "st_centroid", "st_xmin", "st_xmax",
+                   "st_ymin", "st_ymax", "great_circle_distance"],
 }
 
 _AGGREGATE = ["count", "sum", "avg", "min", "max", "stddev", "stddev_pop",

@@ -198,6 +198,9 @@ REAL = _FixedType("real", "float32")
 DOUBLE = _FixedType("double", "float64")
 DATE = _FixedType("date", "int32")
 TIMESTAMP = _FixedType("timestamp", "int64")
+# geometries live as int32 codes into per-expression parsed-WKT tables
+# (expr/geo.py); never stored in tables — ST_AsText round-trips to varchar
+GEOMETRY = _FixedType("geometry", "int32")
 VARCHAR = VarcharType()
 
 
@@ -296,6 +299,7 @@ def parse_type(s: str) -> Type:
         "double": DOUBLE,
         "date": DATE,
         "timestamp": TIMESTAMP,
+        "geometry": GEOMETRY,
         "varchar": VARCHAR,
         "string": VARCHAR,
     }
