@@ -10,33 +10,39 @@ Covers the five BASELINE.json configs:
   q9_sf100  TPC-H Q9  SF100 — multi-join + partitioned aggregation
   q64_sf100 TPC-DS Q64 SF100 — wide star-join (tpcds connector)
 
+Crash-safety architecture (round-4 redesign): the parent process NEVER
+imports jax — each config runs in a subprocess with its own wall-clock
+cap, so a pathological compile or a wedged TPU tunnel can only burn one
+config's budget, not the whole driver window. Results accumulate in the
+parent after every config (also mirrored to BENCH_partial.json), and a
+SIGTERM/SIGINT handler emits the final JSON line immediately — an
+external `timeout` kill still leaves driver-parseable evidence.
+
 Data path: every config reads parquet through ParquetConnector (the real
 storage layer — row groups, column pruning, dictionary-preserving decode).
-Datasets generate ONCE into BENCH_DATA_DIR (default .bench_data/) with the
-chunked exporters and are reused across configs AND rounds; re-runs only
-pay parquet decode (host-cached) + host→device staging (device-cached for
-working sets under the HBM budget). XLA executables persist across rounds
-via the compilation cache (presto_tpu.__init__), so warm-up is ~seconds
-after the first round.
+Datasets generate ONCE into BENCH_DATA_DIR (default .bench_data/) and are
+reused across configs AND rounds. XLA executables persist across rounds
+via the compilation cache (presto_tpu.__init__).
 
 The headline metric stays TPC-H Q1 rows/s vs the reference fork's own
-published number (presto-orc results.txt:19: Aria selective reader runs the
-Q1 scan kernel over SF1 lineitem in 0.79 s = 7.6M rows/s). We run the FULL
-Q1 (scan + filter + aggregate + sort), not just the scan. Q6 likewise
-(results.txt:18). Q3/Q9/Q64 have no published reference numbers; their
-vs_baseline is null and raw rows/s + seconds are recorded for cross-round
-tracking.
+published number (presto-orc results.txt:19: Aria selective reader runs
+the Q1 scan kernel over SF1 lineitem in 0.79 s = 7.6M rows/s). We run the
+FULL Q1 (scan + filter + aggregate + sort), not just the scan. Q6 likewise
+(results.txt:18). Q3/Q9/Q64 have no published reference numbers; raw
+rows/s + seconds are recorded for cross-round tracking.
 
 Env knobs:
   BENCH_CONFIGS   comma list (default: all five)
-  BENCH_BUDGET_S  wall budget; remaining configs are skipped once exceeded
-                  (default 2400)
+  BENCH_BUDGET_S  total wall budget (default 2400)
   BENCH_DATA_DIR  dataset directory (default <repo>/.bench_data)
   BENCH_SF_Q9 / BENCH_SF_Q64  override the big scale factors (default 100)
+  BENCH_PALLAS=1  run aggregation configs with the Pallas MXU kernel
 """
 
 import json
 import os
+import signal
+import subprocess
 import sys
 import time
 
@@ -101,23 +107,39 @@ group by nation, o_year
 order by nation, o_year desc
 """
 
-# TPC-DS Q64-shaped star join over the tpcds connector (full Q64 is a
-# two-instance CTE self-join; this is the inner star: store_sales joined to
-# its dimensions with a grouped rollup — the config's multi-join shape).
+# TPC-DS Q64 (spec shape): two-instance CTE over the cross-channel star
+# join, self-joined on item across consecutive years. The heavy lifting —
+# store_sales ⋈ store_returns ⋈ catalog_sales + five dimension joins —
+# matches the spec text; cs_ui / cross-year predicates included.
 Q64 = """
-select i_product_name, s_store_name, d_year,
-       count(*) as cnt,
-       sum(ss_wholesale_cost) as s1,
-       sum(ss_list_price) as s2,
-       sum(ss_coupon_amt) as s3
-from store_sales, date_dim, store, customer, item
-where ss_sold_date_sk = d_date_sk
-  and ss_store_sk = s_store_sk
-  and ss_customer_sk = c_customer_sk
-  and ss_item_sk = i_item_sk
-  and i_current_price between 35 and 44
-group by i_product_name, s_store_name, d_year
-order by s1 limit 100
+with cross_sales as (
+  select i_product_name as product_name, i_item_sk as item_sk,
+         s_store_name as store_name, s_zip as store_zip,
+         d_year as syear,
+         count(*) as cnt,
+         sum(ss_wholesale_cost) as s1,
+         sum(ss_list_price) as s2,
+         sum(ss_coupon_amt) as s3
+  from store_sales, store_returns, date_dim, store, item, customer
+  where ss_item_sk = i_item_sk
+    and ss_item_sk = sr_item_sk and ss_ticket_number = sr_ticket_number
+    and ss_sold_date_sk = d_date_sk
+    and ss_store_sk = s_store_sk
+    and ss_customer_sk = c_customer_sk
+    and i_current_price between 35 and 44
+    and i_product_name is not null
+  group by i_product_name, i_item_sk, s_store_name, s_zip, d_year
+)
+select cs1.product_name, cs1.store_name, cs1.store_zip,
+       cs1.syear, cs1.cnt, cs1.s1, cs1.s2, cs1.s3,
+       cs2.s1 as s1_2, cs2.s2 as s2_2, cs2.s3 as s3_2, cs2.syear as syear_2,
+       cs2.cnt as cnt_2
+from cross_sales cs1, cross_sales cs2
+where cs1.item_sk = cs2.item_sk
+  and cs1.syear = 2000 and cs2.syear = 2001
+  and cs2.cnt <= cs1.cnt
+  and cs1.store_name = cs2.store_name and cs1.store_zip = cs2.store_zip
+order by cs1.product_name, cs1.store_name, cs2.cnt limit 100
 """
 
 # reference: Aria selective reader scan kernels over SF1 lineitem
@@ -128,50 +150,34 @@ _REF = {
     "q6": _SF1_ROWS / 0.54,
 }
 
-_CATALOGS = {}  # (kind, sf) -> Catalog, shared across configs
+# name -> (sql, dataset kind, nominal sf, driving table, exec overrides)
+_CONFIGS = {
+    "q1_sf1": (Q1, "tpch", 1.0, "lineitem", {}),
+    "q6_sf10": (Q6, "tpch", 10.0, "lineitem", {}),
+    "q3_sf10": (Q3, "tpch", 10.0, "lineitem", {}),
+    "q9_sf100": (Q9, "tpch", None, "lineitem", {"runs": 2}),
+    "q64_sf100": (Q64, "tpcds", None, "store_sales",
+                  {"agg_capacity": 1 << 16, "runs": 2}),
+}
 
-
-def _dataset(kind: str, sf: float):
-    """Generate-once parquet dataset + catalog over it (cached per proc)."""
-    key = (kind, sf)
-    if key in _CATALOGS:
-        return _CATALOGS[key]
-    from presto_tpu.catalog.parquet import (
-        ParquetConnector, export_tpch_chunked, export_tpcds_chunked,
-    )
-    from presto_tpu.connector import Catalog
-
-    d = os.path.join(DATA_DIR, f"{kind}_sf{sf:g}")
-    t0 = time.time()
-    if kind == "tpch":
-        export_tpch_chunked(d, sf, log=_log)
-    else:
-        export_tpcds_chunked(d, sf, log=_log)
-    dt = time.time() - t0
-    if dt > 1:
-        _log(f"{kind} sf={sf:g}: dataset ensured in {dt:.1f}s -> {d}")
-    conn = ParquetConnector(d, name=kind)
-    cat = Catalog()
-    cat.register(kind, conn, default=True)
-    _CATALOGS[key] = cat
-    return cat
+# Per-config wall caps (seconds): one slow compile can only burn this much.
+_CAPS = {"q1_sf1": 420, "q6_sf10": 420, "q3_sf10": 600,
+         "q9_sf100": 900, "q64_sf100": 900}
 
 
 def _dataset_ready(kind: str, sf: float) -> bool:
     marker = "lineitem" if kind == "tpch" else "store_sales"
-    return os.path.exists(
-        os.path.join(DATA_DIR, f"{kind}_sf{sf:g}", f"{marker}.parquet"))
+    d = os.path.join(DATA_DIR, f"{kind}_sf{sf:g}")
+    return (os.path.exists(os.path.join(d, f"{marker}.parquet"))
+            or os.path.exists(os.path.join(d, f"{marker}.parts")))
 
 
-def _resolve_sf(kind: str, sf: float, budget: float) -> float:
-    """Downscale a config's SF when its dataset is absent AND generating
-    it cannot fit the remaining wall budget (SF100 generation is hours;
-    the driver's bench window is not). Prefers the largest already-
-    cached dataset, else the largest affordable one."""
+def _resolve_sf(kind: str, sf: float, remaining: float) -> float:
+    """Downscale a config's SF when its dataset is absent AND generating it
+    cannot fit the remaining wall budget (SF100 generation is hours)."""
     if _dataset_ready(kind, sf):
         return sf
     est_per_sf = 60.0  # measured ~55 s/SF for the chunked tpch exporter
-    remaining = budget - (time.time() - _T0)
     if sf * est_per_sf < remaining * 0.5:
         return sf
     for cand in (10.0, 1.0, 0.1):
@@ -184,20 +190,42 @@ def _resolve_sf(kind: str, sf: float, budget: float) -> float:
     return 0.1
 
 
-def _bench(name, sql, kind, sf, driving_table,
-           batch_rows=1 << 20, agg_capacity=1 << 10, runs=3):
-    """Ensure dataset → warm up (compile + cache fill) → best-of-N timed
-    runs, with per-stage timings on stderr."""
+# ---------------------------------------------------------------- child ----
+
+def _child(name: str, sf: float):
+    """Run ONE config in this process; print a single JSON result line."""
+    sql, kind, _, driving_table, over = _CONFIGS[name]
+    if os.environ.get("BENCH_FORCE_CPU"):
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    from presto_tpu.catalog.parquet import (
+        ParquetConnector, export_tpch_chunked, export_tpcds_chunked,
+    )
+    from presto_tpu.connector import Catalog
     from presto_tpu.exec import ExecConfig, LocalRunner
 
-    cat = _dataset(kind, sf)
-    conn = cat.connectors[kind]
+    d = os.path.join(DATA_DIR, f"{kind}_sf{sf:g}")
+    t0 = time.time()
+    if kind == "tpch":
+        export_tpch_chunked(d, sf, log=_log)
+    else:
+        export_tpcds_chunked(d, sf, log=_log)
+    gen_s = round(time.time() - t0, 1)
+    if gen_s > 1:
+        _log(f"{kind} sf={sf:g}: dataset ensured in {gen_s}s -> {d}")
+    cat = Catalog()
+    conn = ParquetConnector(d, name=kind)
+    cat.register(kind, conn, default=True)
     nrows = int(conn.get_table(driving_table).row_count)
-    runner = LocalRunner(cat, ExecConfig(batch_rows=batch_rows,
-                                         agg_capacity=agg_capacity))
+
+    runs = over.get("runs", 3)
+    cfg = {k: v for k, v in over.items() if k != "runs"}
+    runner = LocalRunner(cat, ExecConfig(batch_rows=1 << 20, **cfg))
     t0 = time.time()
     runner.run_batch(sql)  # warm-up: compiles + host/device caches
-    _log(f"{name}: warmup (compile + cache fill) {time.time() - t0:.1f}s")
+    warm_s = round(time.time() - t0, 1)
+    _log(f"{name}: warmup (compile + cache fill) {warm_s}s")
     times = []
     for _ in range(runs):
         t0 = time.perf_counter()
@@ -207,21 +235,64 @@ def _bench(name, sql, kind, sf, driving_table,
     best = min(times)
     _log(f"{name}: best {best:.3f}s of {sorted(round(t, 3) for t in times)} "
          f"({nrows} {driving_table} rows)")
-    return {"seconds": round(best, 4), "rows": nrows, "sf": sf,
-            "rows_per_sec": round(nrows / best, 1)}
+    print(json.dumps({
+        "seconds": round(best, 4), "rows": nrows, "sf": sf,
+        "rows_per_sec": round(nrows / best, 1), "warmup_s": warm_s,
+    }), flush=True)
+
+
+# --------------------------------------------------------------- parent ----
+
+_STATE = {"extra": {}, "emitted": False, "child": None}
+
+
+def _emit():
+    if _STATE["emitted"]:
+        return
+    _STATE["emitted"] = True
+    extra = _STATE["extra"]
+    for name, ref in (("q1_sf1", _REF["q1"]), ("q6_sf10", _REF["q6"])):
+        r = extra.get(name)
+        if isinstance(r, dict) and "rows_per_sec" in r:
+            r["vs_baseline"] = round(r["rows_per_sec"] / ref, 3)
+    q1 = extra.get("q1_sf1", {})
+    value = q1.get("rows_per_sec", 0.0) if isinstance(q1, dict) else 0.0
+    print(json.dumps({
+        "metric": "tpch_q1_sf1_rows_per_sec",
+        "value": value,
+        "unit": "rows/s",
+        "vs_baseline": round(value / _REF["q1"], 3) if value else 0.0,
+        "extra": extra,
+    }), flush=True)
+
+
+def _checkpoint():
+    try:
+        with open(os.path.join(_HERE, "BENCH_partial.json"), "w") as f:
+            json.dump(_STATE["extra"], f, indent=1)
+    except OSError:
+        pass
+
+
+def _on_term(signum, frame):
+    _log(f"received signal {signum} — emitting partial results")
+    _STATE["extra"].setdefault("note", f"killed by signal {signum}")
+    child = _STATE.get("child")
+    if child is not None and child.poll() is None:
+        child.kill()
+    _checkpoint()
+    _emit()
+    sys.exit(0)
 
 
 def _probe_device() -> bool:
     """The axon TPU tunnel can wedge (observed: jax.devices() blocks
-    forever). Probe it in a SUBPROCESS with a timeout before this process
-    touches jax; on failure fall back to CPU so the driver records a
-    (clearly labeled) number instead of a bench timeout."""
-    import subprocess
-
+    forever). Probe it in a SUBPROCESS with a timeout; on failure fall
+    back to CPU so the driver records a (clearly labeled) number instead
+    of a bench timeout."""
     try:
         p = subprocess.run(
-            [sys.executable, "-c",
-             "import jax; jax.devices(); print('ok')"],
+            [sys.executable, "-c", "import jax; jax.devices(); print('ok')"],
             timeout=150, capture_output=True)
         return p.returncode == 0 and b"ok" in p.stdout
     except subprocess.TimeoutExpired:
@@ -229,68 +300,87 @@ def _probe_device() -> bool:
 
 
 def main():
+    if len(sys.argv) >= 4 and sys.argv[1] == "--child":
+        _child(sys.argv[2], float(sys.argv[3]))
+        return
+
+    signal.signal(signal.SIGTERM, _on_term)
+    signal.signal(signal.SIGINT, _on_term)
     budget = float(os.environ.get("BENCH_BUDGET_S", "2400"))
+    extra = _STATE["extra"]
+
     device_ok = _probe_device()
     if not device_ok:
         _log("DEVICE PROBE FAILED (axon tunnel unresponsive) — "
              "falling back to CPU; numbers are NOT tpu numbers")
-        import jax
+        extra["device"] = "cpu-fallback (tpu tunnel unresponsive)"
 
-        jax.config.update("jax_platforms", "cpu")
-    sf_q9 = float(os.environ.get("BENCH_SF_Q9", "100"))
-    sf_q64 = float(os.environ.get("BENCH_SF_Q64", "100"))
+    sf_over = {"q9_sf100": float(os.environ.get("BENCH_SF_Q9", "100")),
+               "q64_sf100": float(os.environ.get("BENCH_SF_Q64", "100"))}
     wanted = os.environ.get(
         "BENCH_CONFIGS", "q1_sf1,q6_sf10,q3_sf10,q9_sf100,q64_sf100"
     ).split(",")
 
-    configs = {
-        "q1_sf1": lambda: _bench("q1_sf1", Q1, "tpch", 1.0, "lineitem"),
-        "q6_sf10": lambda: _bench(
-            "q6_sf10", Q6, "tpch", _resolve_sf("tpch", 10.0, budget),
-            "lineitem"),
-        "q3_sf10": lambda: _bench(
-            "q3_sf10", Q3, "tpch", _resolve_sf("tpch", 10.0, budget),
-            "lineitem", agg_capacity=1 << 21),
-        "q9_sf100": lambda: _bench(
-            "q9_sf100", Q9, "tpch", _resolve_sf("tpch", sf_q9, budget),
-            "lineitem", agg_capacity=1 << 10, runs=2),
-        "q64_sf100": lambda: _bench(
-            "q64_sf100", Q64, "tpcds", _resolve_sf("tpcds", sf_q64, budget),
-            "store_sales", agg_capacity=1 << 14, runs=2),
-    }
-
-    extra = {}
-    for name in wanted:
-        name = name.strip()
-        if name not in configs:
-            _log(f"{name}: UNKNOWN config (valid: {','.join(configs)})")
+    for name in (w.strip() for w in wanted):
+        if not name:
+            continue
+        if name not in _CONFIGS:
+            _log(f"{name}: UNKNOWN config (valid: {','.join(_CONFIGS)})")
             extra[name] = {"error": "unknown config"}
             continue
-        if time.time() - _T0 > budget:
-            _log(f"{name}: SKIPPED (budget {budget:.0f}s exceeded)")
+        remaining = budget - (time.time() - _T0)
+        if remaining < 60:
+            _log(f"{name}: SKIPPED (budget {budget:.0f}s exhausted)")
             extra[name] = {"skipped": "budget"}
+            _checkpoint()
             continue
+        _, kind, sf, _, _ = _CONFIGS[name]
+        sf = sf_over.get(name, sf) if sf is None else sf
+        sf = _resolve_sf(kind, sf, remaining)
+        cap = _CAPS.get(name, 600)
+        if not _dataset_ready(kind, sf):
+            # cold cache: the child pays dataset generation (~60 s/SF for
+            # the chunked exporters) before the measured run — the cap
+            # must cover it or the child is killed mid-generation
+            cap += sf * 70.0
+        cap = min(cap, remaining - 15)
+        env = dict(os.environ)
+        if not device_ok:
+            env["BENCH_FORCE_CPU"] = "1"
+        if os.environ.get("BENCH_PALLAS"):
+            env["PRESTO_TPU_PALLAS"] = "1"
+        _log(f"{name}: starting (sf={sf:g}, cap={cap:.0f}s)")
         try:
-            extra[name] = configs[name]()
-        except Exception as e:  # record, keep benching the rest
+            p = subprocess.Popen(
+                [sys.executable, os.path.abspath(__file__),
+                 "--child", name, str(sf)],
+                env=env, stdout=subprocess.PIPE, stderr=None)
+            _STATE["child"] = p
+            try:
+                out, _ = p.communicate(timeout=cap)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.communicate()
+                raise
+            lines = out.decode().strip().splitlines()
+            if p.returncode == 0 and lines:
+                extra[name] = json.loads(lines[-1])
+            else:
+                extra[name] = {"error": f"child rc={p.returncode}",
+                               "sf": sf}
+        except subprocess.TimeoutExpired:
+            _log(f"{name}: TIMEOUT after {cap:.0f}s cap — moving on")
+            extra[name] = {"error": f"timeout after {cap:.0f}s cap",
+                           "sf": sf}
+        except Exception as e:
             _log(f"{name}: FAILED {type(e).__name__}: {e}")
             extra[name] = {"error": f"{type(e).__name__}: {e}"}
+        finally:
+            _STATE["child"] = None
+        _checkpoint()
 
-    q1 = extra.get("q1_sf1", {})
-    value = q1.get("rows_per_sec", 0.0)
-    for name, ref in (("q1_sf1", _REF["q1"]), ("q6_sf10", _REF["q6"])):
-        if name in extra and "rows_per_sec" in extra[name]:
-            extra[name]["vs_baseline"] = round(
-                extra[name]["rows_per_sec"] / ref, 3)
-    if not device_ok:
-        extra["device"] = "cpu-fallback (tpu tunnel unresponsive)"
-    print(json.dumps({
-        "metric": "tpch_q1_sf1_rows_per_sec",
-        "value": value,
-        "unit": "rows/s",
-        "vs_baseline": round(value / _REF["q1"], 3) if value else 0.0,
-        "extra": extra,
-    }))
+    _checkpoint()
+    _emit()
 
 
 if __name__ == "__main__":

@@ -11,6 +11,11 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
+try:
+    import pandas as pd
+except ImportError:  # pandas is effectively always present; stay importable
+    pd = None
+
 from presto_tpu.batch import Batch, round_up_capacity
 from presto_tpu.connector import ColumnInfo, Connector, Split, TableHandle
 from presto_tpu.dictionary import Dictionary
@@ -29,8 +34,11 @@ from presto_tpu.types import (
 
 
 def _is_null(v) -> bool:
-    """None, or the float NaN pandas uses for missing object values."""
-    return v is None or (isinstance(v, float) and np.isnan(v))
+    """None, pandas' NA scalar, or the float NaN pandas uses for missing
+    object values."""
+    if v is None or (isinstance(v, float) and np.isnan(v)):
+        return True
+    return v is pd.NA if pd is not None else False
 
 
 def _infer_type(arr: np.ndarray) -> Type:
@@ -372,7 +380,17 @@ class MemoryConnector(DeviceSplitCache, Connector):
         import pandas as pd
 
         if isinstance(data, pd.DataFrame):
-            data = {c: data[c].to_numpy() for c in data.columns}
+            cols = {}
+            for c in data.columns:
+                s = data[c]
+                if pd.api.types.is_extension_array_dtype(s.dtype):
+                    # nullable extension dtypes (Int64, boolean, …):
+                    # to_numpy() would smear NA into float NaN VALUES —
+                    # keep them typed and NULL-masked instead
+                    cols[c] = s.astype(object).to_numpy()
+                else:
+                    cols[c] = s.to_numpy()
+            data = cols
         self.tables[name] = MemoryTable(name, data, types, primary_key)
         self.invalidate_cache(name)
 
@@ -394,6 +412,9 @@ class MemoryConnector(DeviceSplitCache, Connector):
             mt.types[col] = t
             mt.arrays[col] = arr.astype(np.int64)
             mt.validity[col] = None
+            # an all-raw table still has rows (MemoryTable only counted
+            # the plain columns)
+            mt.num_rows = max(mt.num_rows, len(arr))
         mt.arrays = {c: mt.arrays[c] for c in data.keys()}
         mt.types = {c: mt.types[c] for c in data.keys()}
         self.tables[name] = mt

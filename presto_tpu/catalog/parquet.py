@@ -304,31 +304,64 @@ class ParquetConnector(DeviceSplitCache, Connector):
         return sorted(os.path.join(d, f) for f in os.listdir(d)
                       if f.endswith(".parquet"))
 
-    @staticmethod
-    def _scan_part_files(paths):
+    def _scan_part_files(self, paths):
         """Union schema/row-groups/string-vocab over a list of parquet
-        files (shared by the parts-directory and hive loaders)."""
+        files (shared by the parts-directory and hive loaders).
+
+        Schema drift across parts is REJECTED (every file must match the
+        first file's arrow schema) instead of silently reading later files
+        through the first schema. Per-file vocab is cached by
+        (path, mtime), so an INSERT-triggered invalidation only scans the
+        new part files, and string columns are read dictionary-encoded so
+        the union walks unique values, not full columns."""
         schema = None
+        str_cols: list = []
         num_rows = 0
         rgs = []  # (path, num_row_groups)
         vocab: Dict[str, set] = {}
+        cache = self.__dict__.setdefault("_vocab_cache", {})
         for p in paths:
             f = pq.ParquetFile(p)
             if schema is None:
                 schema = f.schema_arrow
+                str_cols = [fl.name for fl in schema
+                            if _arrow_to_sql(fl).is_string]
+            elif not f.schema_arrow.equals(schema):
+                raise ValueError(
+                    f"schema drift in parts table: {p} has schema "
+                    f"{f.schema_arrow} != first part's {schema}")
             num_rows += f.metadata.num_rows
             rgs.append((p, f.num_row_groups))
-            for field in schema:
-                if _arrow_to_sql(field).is_string:
-                    for rg in range(f.num_row_groups):
-                        col = f.read_row_group(rg, columns=[field.name]).column(0)
-                        for chunk in col.chunks:
+            if not str_cols:
+                continue
+            ckey = (p, os.stat(p).st_mtime_ns)
+            fvocab = cache.get(ckey)
+            if fvocab is None:
+                fvocab = {c: set() for c in str_cols}
+                fd = pq.ParquetFile(p, read_dictionary=str_cols)
+                for rg in range(fd.num_row_groups):
+                    t = fd.read_row_group(rg, columns=str_cols)
+                    for c in str_cols:
+                        for chunk in t.column(c).chunks:
                             if pa.types.is_dictionary(chunk.type):
-                                vocab.setdefault(field.name, set()).update(
+                                fvocab[c].update(
                                     chunk.dictionary.to_pylist())
                             else:
-                                vocab.setdefault(field.name, set()).update(
-                                    chunk.to_pylist())
+                                fvocab[c].update(chunk.to_pylist())
+                cache[ckey] = fvocab
+            for c, vs in fvocab.items():
+                vocab.setdefault(c, set()).update(vs)
+        # evict superseded generations (same path, older mtime) and entries
+        # whose file was deleted (compaction/table rewrite) — stale vocab
+        # sets would otherwise leak for the connector's lifetime. Other
+        # tables share this cache; their live files are untouched.
+        scanned = set(paths)
+        live_keys = {(p, os.stat(p).st_mtime_ns) for p in paths
+                     if os.path.exists(p)}
+        for k in list(cache):
+            if (k[0] in scanned and k not in live_keys) \
+                    or not os.path.exists(k[0]):
+                del cache[k]
         return schema, num_rows, rgs, vocab
 
     @staticmethod

@@ -21,6 +21,7 @@ for XLA:
 from __future__ import annotations
 
 import dataclasses
+import time
 from functools import partial
 from typing import Callable, Dict, Iterator, List, Optional, Tuple
 
@@ -91,6 +92,15 @@ class ExecConfig:
 
     batch_rows: int = 1 << 17  # rows per scan batch
     agg_capacity: int = 1 << 12  # initial group-table capacity
+    # High-NDV group tables are the wrong tool on XLA: every merge step
+    # sorts (capacity + batch) rows, so a CBO-pre-sized multi-million-slot
+    # table makes each batch pay a mostly-dead mega-sort (measured: q3 SF1
+    # RUN went 68.7s -> small-cap partitioned in seconds on CPU). Above
+    # this ceiling the aggregation goes GRACE: raw input hash-partitions to
+    # spill (host-side, dynamic shapes are free there) and each partition
+    # merges independently at small capacity — the reference's
+    # SpillableHashAggregationBuilder / grouped-execution shape.
+    agg_cap_ceiling: int = 1 << 17
     # how many aggregate merge steps may be in flight before their group
     # counts are confirmed on the host. Device→host syncs on a tunneled TPU
     # cost a full round trip (~70-90 ms measured), so the driver dispatches
@@ -120,16 +130,44 @@ class ExecConfig:
     # failed/unreachable worker the coordinator re-probes the cluster,
     # drops dead nodes, and re-executes the whole query this many times
     query_retry_count: int = 1
+    # stage scheduling policy (reference: execution/scheduler/
+    # AllAtOnceExecutionPolicy vs PhasedExecutionSchedule): "phased" defers
+    # probe-side stages until their join build stages finish, cutting peak
+    # cluster memory on multi-join plans
+    execution_policy: str = "all-at-once"
+    # phased mode: how long one build phase may run before the query fails
+    phase_wait_timeout_s: float = 600.0
 
 
 def _node_jit(node: PlanNode, key: str, builder, **jit_kwargs):
     """Per-plan-node memoized jit compilation (the analog of Presto's
     codegen class cache: ExpressionCompiler's generated classes are cached
     and reused across executions of the same plan). Executing a cached
-    QueryPlan twice reuses every compiled XLA program."""
+    QueryPlan twice reuses every compiled XLA program.
+
+    Each wrapped program also tracks its compile events (count + wall
+    time, detected via jit cache-size growth across a call) in
+    node._jit_stats[key] — surfaced by EXPLAIN ANALYZE so compile latency
+    is a visible first-class cost, not folded silently into 'warmup'."""
     cache = node.__dict__.setdefault("_jit_cache", {})
     if key not in cache:
-        cache[key] = jax.jit(builder(), **jit_kwargs)
+        jfn = jax.jit(builder(), **jit_kwargs)
+        stats = node.__dict__.setdefault("_jit_stats", {}).setdefault(
+            key, {"compiles": 0, "compile_wall_s": 0.0})
+
+        def wrapped(*args, __jfn=jfn, __stats=stats, **kw):
+            try:
+                before = __jfn._cache_size()
+            except Exception:
+                return __jfn(*args, **kw)
+            t0 = time.perf_counter()
+            out = __jfn(*args, **kw)
+            if __jfn._cache_size() > before:
+                __stats["compiles"] += 1
+                __stats["compile_wall_s"] += time.perf_counter() - t0
+            return out
+
+        cache[key] = wrapped
     return cache[key]
 
 
@@ -237,6 +275,11 @@ def collapse_chain(node: PlanNode) -> Tuple[PlanNode, Callable[[Batch], Batch]]:
                             dicts[s + "#keys"] = kd
                         continue
                     v = jnp.broadcast_to(v, (b.capacity,)).astype(t.dtype)
+                    if valid is not None and getattr(valid, "ndim", 1) == 0:
+                        # scalar validity (e.g. divide-by-constant guard)
+                        # must widen with the values: downstream gathers
+                        # index it per row
+                        valid = jnp.broadcast_to(valid, (b.capacity,))
                     names.append(s)
                     types.append(t)
                     cols.append(Column(v, valid))
@@ -692,6 +735,19 @@ def _input_state(b: Batch, name: str, op: str, a: AggSpec, st: Type,
         c = b.column(a.arg)
         x = _as_double(c, in_types[a.arg])
         return StateCol(jnp.log(x), c.validity, "sum")
+    from presto_tpu.functions import registry as _freg
+
+    udf = _freg().aggregate(a.fn)
+    if udf is not None:
+        # registered UDAF: per-state elementwise input transform over the
+        # float64 argument (the addInput step of its accumulator);
+        # count_add states took the generic branch at the top
+        c = b.column(a.arg)
+        x = _as_double(c, in_types[a.arg])
+        transform = next(t for s, o, t in udf.states
+                         if a.symbol + s == name)
+        return StateCol(transform(x) if transform is not None else x,
+                        c.validity, op)
     c = b.column(a.arg)
     if c.hi is not None:
         # long-decimal input to min/max/arbitrary: combined float64 value,
@@ -920,11 +976,19 @@ def _attach_array_aggs(acc: Batch, full: Batch, aggs, key_syms) -> Batch:
     acc_live = np.asarray(acc.live)
     gkeys = [np.asarray(acc.column(k).values) for k in key_syms]
     gvalid = [np.asarray(acc.column(k).valid_mask()) for k in key_syms]
+    _NAN = object()  # canonical NaN key: NaN != NaN would miss the dict,
+    # but grouped_merge puts all NaNs in one group — match that here
+
+    def _ckey(v, ok):
+        if not ok:
+            return None
+        x = v.item()
+        return _NAN if isinstance(x, float) and x != x else x
+
     gmap = {}
     for gi in np.nonzero(acc_live)[0]:
         key = tuple(
-            (gv[gi].item() if gva[gi] else None)
-            for gv, gva in zip(gkeys, gvalid)
+            _ckey(gv[gi], gva[gi]) for gv, gva in zip(gkeys, gvalid)
         )
         gmap[key] = int(gi)
     cap = acc.capacity
@@ -932,8 +996,7 @@ def _attach_array_aggs(acc: Batch, full: Batch, aggs, key_syms) -> Batch:
     row_gi = np.empty(nrows, np.int64)
     for r in range(nrows):
         key = tuple(
-            (kv[r].item() if kva[r] else None)
-            for kv, kva in zip(kvals, kvalid)
+            _ckey(kv[r], kva[r]) for kv, kva in zip(kvals, kvalid)
         )
         row_gi[r] = gmap[key]
     for a in aggs:
@@ -989,6 +1052,22 @@ def _attach_array_aggs(acc: Batch, full: Batch, aggs, key_syms) -> Batch:
         if is_map and a.arg in full.dicts:
             acc.dicts[a.symbol + "#keys"] = full.dicts[a.arg]
     return acc
+
+
+def _registered_aggregate_fn(fn: str):
+    from presto_tpu.functions import registry
+
+    return registry().aggregate(fn)
+
+
+class _GraceOverflow(Exception):
+    """Raised when group-table growth crosses the grace ceiling: the
+    aggregation switches to hash-partitioned (grace) mode. Carries the
+    optimistic window's unmerged raw input batches."""
+
+    def __init__(self, entries):
+        super().__init__("aggregate group table crossed the grace ceiling")
+        self.entries = entries
 
 
 def _execute_aggregate(node: Aggregate, ctx: ExecContext) -> Iterator[Batch]:
@@ -1050,8 +1129,10 @@ def _execute_aggregate(node: Aggregate, ctx: ExecContext) -> Iterator[Batch]:
             states.append(StateCol(c.values, c.validity, op))
         return keys, states
 
-    def merge_step(acc: Optional[Batch], b: Batch, cap: int):
-        b = chain(b)
+    def merge_step(acc: Optional[Batch], b: Batch, cap: int,
+                   prechained: bool = False):
+        if not prechained:
+            b = chain(b)
         if acc is not None:
             # group keys from different sources (UNION ALL branches,
             # exchange pages) may be coded against different dictionaries;
@@ -1087,9 +1168,13 @@ def _execute_aggregate(node: Aggregate, ctx: ExecContext) -> Iterator[Batch]:
         types = key_types + state_types
         dicts = {k: b.dicts[k] for k in key_syms if k in b.dicts}
         # string-valued states (min/max/arbitrary) keep the arg's dictionary
+        # (final mode: the state column itself carries it post-exchange)
         for name, op, a in layout:
-            if op in ("min", "max") and a.arg in b.dicts:
-                dicts[name] = b.dicts[a.arg]
+            if op in ("min", "max"):
+                if a.arg in b.dicts:
+                    dicts[name] = b.dicts[a.arg]
+                elif name in b.dicts:
+                    dicts[name] = b.dicts[name]
         out = Batch(names, types, cols, out_live, dicts)
         return out, n_groups
 
@@ -1132,12 +1217,25 @@ def _execute_aggregate(node: Aggregate, ctx: ExecContext) -> Iterator[Batch]:
     jit_step = _node_jit(node, "step", lambda: (lambda acc, b, cap: merge_step(acc, b, cap)), static_argnums=(2,))
     jit_step0 = _node_jit(node, "step0", lambda: (lambda b, cap: merge_step(None, b, cap)), static_argnums=(1,))
     jit_accstep = _node_jit(node, "accstep", lambda: acc_merge_step, static_argnums=(2,))
+    # grace (hash-partitioned) aggregation: partition replay feeds batches
+    # that went through `chain` before spilling — merge must not re-chain
+    jit_step_raw = _node_jit(
+        node, "step_raw",
+        lambda: (lambda acc, b, cap: merge_step(acc, b, cap, prechained=True)),
+        static_argnums=(2,))
+    jit_step0_raw = _node_jit(
+        node, "step0_raw",
+        lambda: (lambda b, cap: merge_step(None, b, cap, prechained=True)),
+        static_argnums=(1,))
+    jit_chain = _node_jit(node, "chain_only", lambda: chain)
 
     from presto_tpu.memory import LocalMemoryContext, batch_device_bytes
 
     import threading as _threading
 
     cap = ctx.config.agg_capacity
+    can_spill = bool(key_syms) and ctx.config.spill_enabled
+    ceiling = max(ctx.config.agg_cap_ceiling, ctx.config.agg_capacity)
     if key_syms:
         # CBO capacity pre-sizing: a group table sized from derived NDV
         # stats skips the overflow→replay growth ladder entirely
@@ -1152,10 +1250,49 @@ def _execute_aggregate(node: Aggregate, ctx: ExecContext) -> Iterator[Batch]:
         if _st is not None and _st.rows:
             want = round_up_capacity(int(min(_st.rows * 1.25, float(1 << 23))))
             cap = max(cap, want)
-    state = {"acc": None, "spiller": None, "revoke_requested": False}
+    # Past the ceiling a fixed-capacity table stops being the right tool
+    # (every merge sorts `capacity + batch` rows, nearly all of them dead):
+    # go grace from the start — raw input hash-partitions to spill and each
+    # partition merges at small capacity (SpillableHashAggregationBuilder /
+    # grouped execution; see ExecConfig.agg_cap_ceiling).
+    grace_from_start = can_spill and cap > ceiling
+    if can_spill:
+        cap = min(cap, ceiling)
+
+    if node.step == "partial" and grace_from_start:
+        # Adaptive partial-aggregation bypass (reference: partial agg
+        # adaptivity — when NDV ≈ row count the partial merge does no
+        # reduction): emit per-row state contributions unmerged; the final
+        # step after the exchange does the one real merge, partitioned.
+        def row_states(b: Batch):
+            b = chain(b)
+            kin, sin = in_to_states(b)
+            cols = [Column(k.values, k.validity) for k in kin] + [
+                Column(s.values, s.validity if s.op != "count_add" else None)
+                for s in sin]
+            names = list(key_syms) + [name for name, _, _ in layout]
+            types = key_types + state_types
+            dicts = {k: b.dicts[k] for k in key_syms if k in b.dicts}
+            for name, op, a in layout:
+                if op in ("min", "max") and a.arg in b.dicts:
+                    dicts[name] = b.dicts[a.arg]
+            return Batch(names, types, cols, b.live, dicts)
+
+        jit_rows = _node_jit(node, "partial_passthrough", lambda: row_states)
+        for b in in_stream:
+            yield jit_rows(b)
+        return
+
+    state = {"acc": None, "spiller": None, "raw_spiller": None,
+             "revoke_requested": False}
     mctx = LocalMemoryContext(ctx.memory_pool, "aggregate")
-    can_spill = bool(key_syms) and ctx.config.spill_enabled
     owner_thread = _threading.get_ident()
+
+    def mk_raw_spiller():
+        if state["raw_spiller"] is None:
+            state["raw_spiller"] = ctx.spill_manager.partitioning_spiller(
+                key_syms, ctx.config.spill_partitions, "agg-raw")
+        return state["raw_spiller"]
 
     def do_spill() -> int:
         """Partition-spill the accumulator (SpillableHashAggregationBuilder:
@@ -1219,11 +1356,18 @@ def _execute_aggregate(node: Aggregate, ctx: ExecContext) -> Iterator[Batch]:
 
             def replay(entries, ngi):
                 """Re-merge `entries` from the first entry's checkpoint at a
-                capacity that fits `ngi` groups (synchronous — rare path)."""
+                capacity that fits `ngi` groups (synchronous — rare path).
+                Growth past the grace ceiling instead hands the unmerged
+                batches to the hash-partitioned path (_GraceOverflow) —
+                an ever-bigger table would make every later merge sort
+                millions of dead slots."""
                 nonlocal cap
                 state["acc"] = entries[0][0]
-                cap = round_up_capacity(ngi)
-                for _, b, _ in entries:
+                want2 = round_up_capacity(ngi)
+                if allow_spill and can_spill and want2 > ceiling:
+                    raise _GraceOverflow(entries)
+                cap = want2
+                for i, (_, b, _) in enumerate(entries):
                     for _ in range(ctx.config.max_growth_retries):
                         acc_before = state["acc"]
                         if acc_before is None:
@@ -1236,7 +1380,12 @@ def _execute_aggregate(node: Aggregate, ctx: ExecContext) -> Iterator[Batch]:
                             break
                         # power-of-two bucketing already gives ≤2× headroom;
                         # doubling on top would 4× the memory footprint
-                        cap = round_up_capacity(n2)
+                        want2 = round_up_capacity(n2)
+                        if allow_spill and can_spill and want2 > ceiling:
+                            # acc still holds the pre-entry checkpoint:
+                            # entries[i:] have not been merged into it
+                            raise _GraceOverflow(entries[i:])
+                        cap = want2
                     else:
                         raise RuntimeError(
                             "aggregate capacity growth exceeded retries")
@@ -1278,9 +1427,31 @@ def _execute_aggregate(node: Aggregate, ctx: ExecContext) -> Iterator[Batch]:
                     mctx.set_bytes(out_bytes)
             confirm(block=True)
 
-        absorb(in_stream, jit_step, jit_step0)
+        def grace_ingest(stream):
+            """Hash-partition chained input batches straight to spill (the
+            grace-hash build phase; host-side, so dynamic row counts are
+            free). No device merge happens until the per-partition phase."""
+            raw = mk_raw_spiller()
+            for b in stream:
+                raw.spill(jit_chain(b))
+            ctx.spill_manager.record(raw.spilled_bytes)
 
-        if state["spiller"] is None:
+        if grace_from_start:
+            grace_ingest(in_stream)
+        else:
+            try:
+                absorb(in_stream, jit_step, jit_step0)
+            except _GraceOverflow as ov:
+                # the table outgrew the ceiling mid-stream: spill the
+                # confirmed accumulator as state pages, the unmerged window
+                # + the rest of the input as raw partitions
+                do_spill()
+                raw = mk_raw_spiller()
+                for _, b, _ in ov.entries:
+                    raw.spill(jit_chain(b))
+                grace_ingest(in_stream)
+
+        if state["spiller"] is None and state["raw_spiller"] is None:
             acc = state["acc"]
             if node.step == "partial":
                 # emit raw state columns for the exchange; no finalization
@@ -1299,17 +1470,23 @@ def _execute_aggregate(node: Aggregate, ctx: ExecContext) -> Iterator[Batch]:
         do_spill()
         ctx.memory_pool.remove_revoker(revoke)
         spiller = state["spiller"]
+        raw_spiller = state["raw_spiller"]
         jit_accstep0 = _node_jit(
             node, "accstep0", lambda: (lambda b, cap: acc_merge_step(None, b, cap)),
             static_argnums=(1,),
         )
-        for p in range(spiller.n_partitions):
+        n_parts = ctx.config.spill_partitions
+        for p in range(n_parts):
             state["acc"] = None
             # each bucket holds ~1/P of the groups — shrink the table back
             # (it regrows geometrically if a bucket is skewed)
             cap = ctx.config.agg_capacity
-            absorb(spiller.read_partition(p), jit_accstep, jit_accstep0,
-                   allow_spill=False)
+            if raw_spiller is not None:
+                absorb(raw_spiller.read_partition(p), jit_step_raw,
+                       jit_step0_raw, allow_spill=False)
+            if spiller is not None:
+                absorb(spiller.read_partition(p), jit_accstep, jit_accstep0,
+                       allow_spill=False)
             acc = state["acc"]
             if acc is None:
                 continue
@@ -1319,13 +1496,18 @@ def _execute_aggregate(node: Aggregate, ctx: ExecContext) -> Iterator[Batch]:
                 yield _finalize_aggregate(node, acc, layout, key_syms,
                                           key_types, state_types, in_types)
             mctx.set_bytes(0)
-        spiller.close()
+        if spiller is not None:
+            spiller.close()
+        if raw_spiller is not None:
+            raw_spiller.close()
     finally:
         if can_spill:
             ctx.memory_pool.remove_revoker(revoke)
         mctx.set_bytes(0)
         if state["spiller"] is not None:
             state["spiller"].close()
+        if state["raw_spiller"] is not None:
+            state["raw_spiller"].close()
 
 
 def _concat_validity(a, b, cap_a, cap_b):
@@ -1469,6 +1651,19 @@ def build_agg_finalizer(node, key_syms, key_types, in_types):
             elif a.fn == "checksum":
                 c = acc.column(a.symbol)
                 cols.append(Column(c.values, None))
+            elif _registered_aggregate_fn(a.fn) is not None:
+                udf = _registered_aggregate_fn(a.fn)
+                states = {s: acc.column(a.symbol + s).values
+                          for s, _, _ in udf.states}
+                vals = udf.finalize(states)
+                cnt = next((s for s, op, _ in udf.states
+                            if op == "count_add"), None)
+                if cnt is not None:
+                    ok = acc.column(a.symbol + cnt).values > 0
+                else:
+                    first = udf.states[0][0]
+                    ok = acc.column(a.symbol + first).validity
+                cols.append(Column(vals.astype(a.type.dtype), ok))
             else:
                 # count/sum/min/max/arbitrary/count_if + materialized
                 # (approx_percentile/max_by/min_by) pass through
@@ -2178,12 +2373,18 @@ def _execute_window(node: Window, ctx: ExecContext) -> Iterator[Batch]:
     as closed-form vector ops (ops/window.py), emit one batch with the
     window columns appended (reference: WindowOperator.java:47 over a
     PagesIndex — here one lax.sort + O(n) vector passes)."""
-    from presto_tpu.ops import window as W
-    from presto_tpu.types import DOUBLE as _DOUBLE, DecimalType as _Dec
-
     acc = _collect_concat(execute_node(node.child, ctx))
     if acc is None:
         return
+    compute = build_window_compute(node)
+    yield _node_jit(node, "window", lambda: compute)(acc)
+
+
+def build_window_compute(node: Window):
+    """Traceable batch → batch window computation (shared by the streaming
+    executor and the mesh executor, which traces it inside shard_map)."""
+    from presto_tpu.ops import window as W
+    from presto_tpu.types import DecimalType as _Dec
 
     child_types = dict(node.child.output)
 
@@ -2330,7 +2531,7 @@ def _execute_window(node: Window, ctx: ExecContext) -> Iterator[Batch]:
             )
         return out
 
-    yield _node_jit(node, "window", lambda: compute)(acc)
+    return compute
 
 
 # -- sort / limit -----------------------------------------------------------

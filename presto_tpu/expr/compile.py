@@ -616,6 +616,31 @@ _GEO_FNS = {
 def _eval_call(e: Call, ctx: CompileContext):
     fn = e.fn
 
+    # ---- registered (plugin/user) scalars --------------------------------
+    # the analyzer tags them "udf:<name>" so built-ins can never be
+    # shadowed (presto_tpu/functions.py — FunctionManager analog); the
+    # lowering traces straight into the surrounding fused XLA program
+    if fn.startswith("udf:"):
+        from presto_tpu.functions import registry as _freg
+
+        udf = _freg().scalar(fn[4:])
+        if udf is None:
+            raise ValueError(f"function {fn[4:]} is no longer registered")
+        cap = ctx.batch.capacity
+        vals, valids = [], []
+        for a in e.args:
+            v, va = _eval_arg(a, ctx)
+            if getattr(v, "ndim", 1) == 0:
+                v = jnp.broadcast_to(v, (cap,))
+            vals.append(v)
+            valids.append(va)
+        agg_valid = None
+        for va in valids:
+            agg_valid = _and_valid(agg_valid, va)
+        if udf.null_propagating:
+            return udf.lower(*vals), agg_valid
+        return udf.lower(vals, valids)
+
     # ---- geospatial ------------------------------------------------------
     if fn in _GEO_FNS:
         return _eval_geo(e, ctx)
@@ -1602,6 +1627,8 @@ def _eval_arith(e: Call, ctx):
     if isinstance(out_t, DecimalType):
         # exact scaled-int64 arithmetic (reference: short-decimal paths in
         # spi/type/DecimalOperators); analyzer pre-aligned scales for add/sub
+        if e.fn == "div":
+            return _decimal_div(lv, rv, l.type, r.type, out_t, valid)
         lv = lv.astype(jnp.int64)
         rv = rv.astype(jnp.int64)
         if e.fn == "add":
@@ -1645,6 +1672,72 @@ def _eval_arith(e: Call, ctx):
         m = lv - jnp.trunc(lv / safe) * safe if is_floating(out_t) else jnp.sign(lv) * (jnp.abs(lv) % jnp.abs(safe))
         return m, _and_valid(valid, rv != 0)
     raise NotImplementedError(e.fn)
+
+
+def _two_prod(a, b):
+    """Dekker/Veltkamp exact two-product: a*b = hi + lo with hi = fl(a*b).
+    Pure f64 elementwise ops — XLA preserves FP semantics (no unsafe
+    reassociation), so the error term is exact."""
+    p = a * b
+    c = jnp.float64(134217729.0)  # 2^27 + 1 (Veltkamp splitter)
+    ac = a * c
+    ah = ac - (ac - a)
+    al = a - ah
+    bc = b * c
+    bh = bc - (bc - b)
+    bl = b - bh
+    err = ((ah * bh - p) + ah * bl + al * bh) + al * bl
+    return p, err
+
+
+def _decimal_div(lv, rv, lt, rt, out_t, valid):
+    """DECIMAL ÷ DECIMAL with Presto semantics (DecimalOperators.divide /
+    UnscaledDecimal128Arithmetic.divideRoundUp): the numerator rescales by
+    10^(s_out + s_r - s_l), the quotient rounds HALF AWAY FROM ZERO.
+
+    Exactness ladder on TPU int64/f64 lanes (reference is int128-exact to
+    38 digits):
+      1. numerator fits 18 digits → pure int64, bit-exact;
+      2. otherwise a Dekker two-product f64 path with exact-remainder
+         correction — bit-exact while the operands are f64-exact
+         (< 2^53), the rescale shift ≤ 22, and the quotient < 2^53;
+      3. beyond those bounds the result is the f64 approximation
+         (documented deviation — 16+ significant digit quotients).
+    """
+    from presto_tpu.types import DecimalType as _DT
+
+    ls = lt.scale if isinstance(lt, _DT) else 0
+    rs = rt.scale if isinstance(rt, _DT) else 0
+    lp = lt.precision if isinstance(lt, _DT) else 18
+    shift = out_t.scale + rs - ls
+    div_ok = rv != 0
+    valid = _and_valid(valid, div_ok)
+    int_in = (not jnp.issubdtype(lv.dtype, jnp.floating)
+              and not jnp.issubdtype(rv.dtype, jnp.floating))
+    if int_in and shift >= 0 and lp + shift <= 18:
+        n = lv.astype(jnp.int64) * (10 ** shift)
+        d = jnp.where(div_ok, rv.astype(jnp.int64), jnp.ones((), jnp.int64))
+        an, ad = jnp.abs(n), jnp.abs(d)
+        q = (an + ad // 2) // ad  # round half away on |·|
+        return (jnp.sign(n) * jnp.sign(d) * q).astype(jnp.int64), valid
+
+    nf = jnp.abs(lv.astype(jnp.float64))
+    da = jnp.abs(jnp.where(div_ok, rv.astype(jnp.float64), 1.0))
+    sgn = jnp.sign(lv.astype(jnp.float64)) * jnp.sign(
+        jnp.where(div_ok, rv.astype(jnp.float64), 1.0))
+    if shift < 0 or shift > 22:  # 10^shift not f64-exact: plain f64 tail
+        q = jnp.round(nf * (10.0 ** shift) / da)
+        return (sgn * q).astype(jnp.int64), valid
+    n_hi, n_lo = _two_prod(nf, jnp.float64(10.0 ** shift))
+    qa = jnp.floor(n_hi / da)
+    for _ in range(2):  # each sweep shrinks the error ~2^-52
+        p_hi, p_lo = _two_prod(qa, da)
+        r = ((n_hi - p_hi) - p_lo) + n_lo
+        qa = qa + jnp.floor(r / da)
+    p_hi, p_lo = _two_prod(qa, da)
+    r = ((n_hi - p_hi) - p_lo) + n_lo  # exact remainder in [0, da)
+    q = qa + (2.0 * r >= da)  # half away from zero on |·|
+    return (sgn * q).astype(jnp.int64), valid
 
 
 def _eval_cast(e: Call, ctx):

@@ -142,6 +142,17 @@ def cardinality(sv: StructVal, rvalid):
     return sv.sizes.astype(jnp.int64), rvalid
 
 
+def _null_if_unfound_with_nulls(found, sv: StructVal, valid):
+    """Three-valued semantics shared by contains/array_position: a miss on
+    an array that holds NULL elements is unknown, not FALSE/0 (Presto
+    ArrayContains/ArrayPosition return NULL there)."""
+    if sv.evalid is None:
+        return valid
+    has_null = jnp.any(sv.present() & ~sv.evalid, axis=1)
+    unknown = ~found & has_null
+    return ~unknown if valid is None else (valid & ~unknown)
+
+
 def contains(sv: StructVal, x, x_valid, rvalid):
     m = (sv.values == (x[:, None] if getattr(x, "ndim", 0) else x))
     m = m & sv.element_valid()
@@ -149,6 +160,7 @@ def contains(sv: StructVal, x, x_valid, rvalid):
     valid = rvalid
     if x_valid is not None:
         valid = x_valid if valid is None else (valid & x_valid)
+    valid = _null_if_unfound_with_nulls(out, sv, valid)
     return out, valid
 
 
@@ -160,6 +172,7 @@ def array_position(sv: StructVal, x, x_valid, rvalid):
     valid = rvalid
     if x_valid is not None:
         valid = x_valid if valid is None else (valid & x_valid)
+    valid = _null_if_unfound_with_nulls(found, sv, valid)
     return pos, valid
 
 

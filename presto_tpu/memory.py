@@ -94,6 +94,51 @@ class MemoryPool:
                     "limitBytes": self.limit}
 
 
+class QueryScopedPool:
+    """Per-query view over a worker's shared MemoryPool (QueryContext
+    analog): forwards reserve/free to the node pool while tracking this
+    query's own reservation, so the worker can report per-query bytes to
+    the coordinator's ClusterMemoryManager (MemoryPoolInfo's
+    queryMemoryReservations)."""
+
+    def __init__(self, pool: MemoryPool, query_id: str = ""):
+        self.pool = pool
+        self.query_id = query_id
+        self.query_reserved = 0  # this query's slice of the node pool
+        self.peak = 0
+        self._lock = threading.Lock()
+        # surface the node pool's limit/revoker machinery unchanged
+        self.limit = pool.limit
+        self.revoke_threshold = pool.revoke_threshold
+        self.revoke_target = pool.revoke_target
+
+    @property
+    def reserved(self) -> int:
+        # NODE-wide reservation: spill/revoke decisions must see pressure
+        # from every query sharing the pool, not just this one
+        return self.pool.reserved
+
+    def add_revoker(self, fn):
+        self.pool.add_revoker(fn)
+
+    def remove_revoker(self, fn):
+        self.pool.remove_revoker(fn)
+
+    def reserve(self, bytes_: int, tag: str = "") -> None:
+        self.pool.reserve(bytes_, tag or self.query_id)
+        with self._lock:
+            self.query_reserved += max(bytes_, 0)
+            self.peak = max(self.peak, self.query_reserved)
+
+    def free(self, bytes_: int) -> None:
+        self.pool.free(bytes_)
+        with self._lock:
+            self.query_reserved = max(0, self.query_reserved - max(bytes_, 0))
+
+    def info(self) -> dict:
+        return self.pool.info()
+
+
 class LocalMemoryContext:
     """One operator's accounting slot (LocalMemoryContext.java): setBytes
     semantics — the delta flows to the pool."""

@@ -12,14 +12,17 @@ XLA program executed SPMD over the mesh. The HTTP cluster
 intra-slice path where the shuffle rides ICI and the host never touches
 row data.
 
-Supported fragment shapes (the TPC-H star-join/aggregate core): scans with
-filter/project chains, partial→final aggregate splits, broadcast and
-hash-partitioned joins (unique and bounded-fanout), semi joins, gathered
-sort/topn/limit/output. Data-dependent sizes (join fanout, exchange
-partition skew, group counts) use static capacities with device-side
-overflow counters, psum-reduced and checked on the host after execution —
-the driver retries with doubled capacities on overflow (the mesh analog of
-the streaming engine's capacity-growth replay)."""
+Supported fragment shapes (the TPC-H star-join/aggregate core and beyond):
+scans with filter/project chains, partial→final aggregate splits,
+broadcast and hash-partitioned joins (unique and bounded-fanout; INNER /
+LEFT / FULL OUTER — RIGHT normalizes to LEFT at analysis), semi joins,
+window functions (one-sort closed-form kernels), UNION [ALL] /
+INTERSECT / EXCEPT, UNNEST, gathered sort/topn/limit/output.
+Data-dependent sizes (join fanout, exchange partition skew, group counts)
+use static capacities with device-side overflow counters, psum-reduced and
+checked on the host after execution — the driver retries with doubled
+capacities on overflow (the mesh analog of the streaming engine's
+capacity-growth replay)."""
 
 from __future__ import annotations
 
@@ -75,6 +78,7 @@ from presto_tpu.plan.nodes import (
     SemiJoin,
     Sort,
     TableScan,
+    Window,
 )
 from presto_tpu.exec.runtime import _sort_keys
 
@@ -227,13 +231,41 @@ class MeshExecutor:
         types = key_types + st_types
         dicts = {k: b.dicts[k] for k in key_syms if k in b.dicts}
         for name, op, a in layout:
-            if op in ("min", "max") and a.arg in b.dicts:
-                dicts[name] = b.dicts[a.arg]
+            if op in ("min", "max"):
+                if a.arg in b.dicts:
+                    dicts[name] = b.dicts[a.arg]
+                elif name in b.dicts:  # final mode: state col carries it
+                    dicts[name] = b.dicts[name]
         acc = Batch(names, types, cols, out_live, dicts)
         if node.step == "partial":
             return acc
         fin = build_agg_finalizer(node, key_syms, key_types, in_types)
         return fin(acc)
+
+    def _build_remainder(self, node: HashJoin, table, bm) -> Batch:
+        """FULL OUTER tail: build rows no probe row matched, NULL probe
+        columns (LookupJoinOperators.fullOuterJoin's lookup-outer pass).
+        Correct on-mesh because the fragmenter never broadcasts a FULL
+        join's build side (plan/fragmenter.py:157) — each device owns a
+        disjoint hash partition of the build rows."""
+        lsyms = [n for n, _ in node.left.output]
+        rsyms = [n for n, _ in node.right.output]
+        ltypes = dict(node.left.output)
+        cap = table.hashes.shape[0]
+        names, types, cols = [], [], []
+        for c in lsyms:
+            names.append(c)
+            types.append(ltypes[c])
+            cols.append(Column(jnp.zeros(cap, ltypes[c].dtype),
+                               jnp.zeros(cap, bool)))
+        for c in rsyms:
+            names.append(c)
+            types.append(table.batch.type_of(c))
+            cols.append(table.batch.column(c))
+        live = table.orig_live & ~bm
+        return Batch(names, types, cols, live,
+                     {c: table.batch.dicts[c] for c in rsyms
+                      if c in table.batch.dicts})
 
     def _lower_join(self, node: HashJoin, probe: Batch, build: Batch,
                     diags: list) -> Batch:
@@ -242,6 +274,7 @@ class MeshExecutor:
         table = build_side(build, tuple(node.right_keys))
         pba = align_probe_strings(probe, tuple(node.left_keys), table,
                                   tuple(node.right_keys))
+        build_cap = table.hashes.shape[0]
         if node.build_unique:
             idx, matched = probe_unique(table, pba, tuple(node.left_keys),
                                         tuple(node.right_keys))
@@ -257,7 +290,13 @@ class MeshExecutor:
                     valid = (c.validity if c.validity is not None
                              else jnp.ones(out.capacity, bool))
                     cols[i] = Column(c.values, valid & matched, c.hi)
-            return Batch(out.names, out.types, cols, out.live, out.dicts)
+            out = Batch(out.names, out.types, cols, out.live, out.dicts)
+            if node.kind == "full":
+                bm = (jnp.zeros(build_cap, bool)
+                      .at[idx].max(matched & probe.live, mode="drop"))
+                out = _trace_concat(out, self._build_remainder(node, table,
+                                                               bm))
+            return out
         # bounded fanout: one expansion chunk of probe_cap × fanout_budget
         lo, counts, offsets, total, _ = probe_counts(
             table, pba, tuple(node.left_keys), tuple(node.right_keys))
@@ -267,7 +306,7 @@ class MeshExecutor:
             lo, counts, offsets, 0, out_cap)
         diags.append(jnp.maximum(total - out_cap, 0))
         out = gather_join_output(probe, table, pr, bi, ol, lsyms, rsyms)
-        if node.kind == "left":
+        if node.kind in ("left", "full"):
             exists = (jnp.zeros(probe.capacity, dtype=jnp.int32)
                       .at[pr].max(ol.astype(jnp.int32), mode="drop")
                       .astype(bool))
@@ -281,7 +320,11 @@ class MeshExecutor:
                 for nme, c in zip(tail.names, tail.columns)
             ]
             tail = Batch(tail.names, tail.types, tcols, tail.live, tail.dicts)
-            return _trace_concat(out, tail)
+            out = _trace_concat(out, tail)
+        if node.kind == "full":
+            bm = (jnp.zeros(build_cap, bool)
+                  .at[bi].max(ol, mode="drop"))
+            out = _trace_concat(out, self._build_remainder(node, table, bm))
         return out
 
     def _lower(self, node: PlanNode, fragments, staged, memo, diags) -> Batch:
@@ -343,6 +386,32 @@ class MeshExecutor:
             if node.all:
                 return merged
             return _distinct_rows(merged)
+        if isinstance(node, SetOp) and node.kind in ("intersect", "except"):
+            # membership on ALL columns, then distinct — the runtime's
+            # _execute_setop shape, traced per device (inputs arrive
+            # co-partitioned: the fragmenter hash-exchanges both branches
+            # on the full column list)
+            from presto_tpu.exec.runtime import (
+                _distinct_rows,
+                _unify_batch_dicts,
+            )
+
+            left = self._lower(node.left, fragments, staged, memo, diags)
+            right = self._lower(node.right, fragments, staged, memo, diags)
+            left = left.rename(node.symbols)
+            right = right.rename(node.symbols)
+            left, right = _unify_batch_dicts([left, right])
+            keys = tuple(node.symbols)
+            table = build_side(right, keys)
+            pba = align_probe_strings(left, keys, table, keys)
+            _, matched = probe_unique(table, pba, keys, keys)
+            keep = matched if node.kind == "intersect" else ~matched
+            return _distinct_rows(left.with_live(left.live & keep))
+        if isinstance(node, Window):
+            from presto_tpu.exec.runtime import build_window_compute
+
+            child = self._lower(node.child, fragments, staged, memo, diags)
+            return build_window_compute(node)(child)
         raise NotImplementedError(
             f"mesh executor: {type(node).__name__}")
 

@@ -96,8 +96,18 @@ def agg_state_layout(aggs, in_types: Dict[str, Type]) -> List[Tuple[str, str, ob
             layout.append((a.symbol + "$cnt", "count_add", a))
             layout.append((a.symbol + "$lsum", "sum", a))
         else:
-            raise NotImplementedError(f"aggregate {a.fn}")
+            udf = _registered_aggregate(a.fn)
+            if udf is None:
+                raise NotImplementedError(f"aggregate {a.fn}")
+            for suffix, op, _transform in udf.states:
+                layout.append((a.symbol + suffix, op, a))
     return layout
+
+
+def _registered_aggregate(fn: str):
+    from presto_tpu.functions import registry
+
+    return registry().aggregate(fn)
 
 
 def sum_state_type(a, in_types: Dict[str, Type]) -> Type:
@@ -140,6 +150,9 @@ def state_types(layout, in_types: Dict[str, Type]) -> List[Type]:
             out.append(TINYINT)
         elif a.fn in _VARIANCE_FNS or a.fn in _COVAR_FNS or a.fn in (
                 "corr", "geometric_mean"):
+            out.append(DOUBLE)
+        elif _registered_aggregate(a.fn) is not None:
+            # registered UDAF states accumulate in float64 lanes
             out.append(DOUBLE)
         elif op == "sum":
             if a.fn in ("avg", "sum"):

@@ -248,6 +248,16 @@ _TWO_ARG_AGGS = {"covar_pop", "covar_samp", "corr", "max_by", "min_by",
                  "map_agg"}
 
 
+def _is_agg_fn(name: str) -> bool:
+    """Built-in aggregates plus registry-registered ones
+    (FunctionManager.resolveFunction consults registered namespaces)."""
+    if name in _AGG_FUNCS:
+        return True
+    from presto_tpu.functions import registry
+
+    return registry().aggregate(name) is not None
+
+
 # ---------------------------------------------------------------------------
 # expression analysis (AST → typed IR)
 
@@ -431,8 +441,21 @@ class ExprAnalyzer:
                     r = self._rescale(r, 0)
                 return Call(DecimalType(18, ls + rs), "mul", (l, r))
             if op == "div":
-                # deviation from Presto: decimal division evaluates in DOUBLE
-                return Call(DOUBLE, "div", (self._to_double(l), self._to_double(r)))
+                # Presto DecimalOperators.divideOperator typing: scale =
+                # max(s1, s2), precision = p1 - s1 + s2 + scale, ROUND HALF
+                # AWAY on the dropped digits. Deviation: result precision
+                # caps at 18 (short decimal) — quotients needing 19+ digits
+                # fall outside the int64 lane (compile._decimal_div).
+                ls = l.type.scale if ldec else 0
+                rs = r.type.scale if rdec else 0
+                lp = l.type.precision if ldec else 18
+                if not ldec:
+                    l = self._rescale(l, 0)
+                if not rdec:
+                    r = self._rescale(r, 0)
+                s = max(ls, rs)
+                p = max(min(lp - ls + rs + s, 18), 1)
+                return Call(DecimalType(p, s), "div", (l, r))
             if op == "mod":
                 s = max(l.type.scale if ldec else 0, r.type.scale if rdec else 0)
                 return Call(DecimalType(18, s), "mod", (self._rescale(l, s), self._rescale(r, s)))
@@ -528,7 +551,7 @@ class ExprAnalyzer:
 
     def _an_FunctionCall(self, node: ast.FunctionCall) -> RowExpression:
         name = node.name.lower()
-        if name in _AGG_FUNCS:
+        if _is_agg_fn(name):
             raise AnalysisError(f"aggregate {name}() not allowed here")
         if name in ("transform", "filter", "reduce", "any_match",
                     "all_match", "none_match", "transform_values",
@@ -670,6 +693,19 @@ class ExprAnalyzer:
             if len(args) == 2:
                 return Call(DATE, "date_add_days", (args[1], args[0]))
             return Call(DATE, "date_add_unit", args)
+        # registered (plugin/user) scalars — built-ins above take precedence
+        # (FunctionManager: global namespace resolves before plugins)
+        from presto_tpu.functions import registry as _freg
+
+        udf = _freg().scalar(name)
+        if udf is not None:
+            if udf.arity is not None and len(args) != udf.arity:
+                raise AnalysisError(
+                    f"{name}() takes {udf.arity} arguments, got {len(args)}")
+            if udf.coerce_double:
+                args = tuple(self._to_double(a) for a in args)
+            t = udf.result_type([a.type for a in args])
+            return Call(t, "udf:" + udf.name, args)
         raise AnalysisError(f"unknown function {name}")
 
     def _an_lambda(self, lam, param_types) -> "LambdaExpr":
@@ -1806,7 +1842,7 @@ class Planner:
         grouping_calls: Dict[str, ast.FunctionCall] = {}
 
         def collect(n):
-            if isinstance(n, ast.FunctionCall) and n.name.lower() in _AGG_FUNCS:
+            if isinstance(n, ast.FunctionCall) and _is_agg_fn(n.name.lower()):
                 aggs_by_key.setdefault("agg:" + ast_key(n), n)
                 return
             if isinstance(n, ast.FunctionCall) and n.name.lower() == "grouping":
@@ -2221,7 +2257,7 @@ def _derives_unique(node: PlanNode, keys: List[str]) -> bool:
 
 
 def _contains_agg(n) -> bool:
-    if isinstance(n, ast.FunctionCall) and n.name.lower() in _AGG_FUNCS:
+    if isinstance(n, ast.FunctionCall) and _is_agg_fn(n.name.lower()):
         return True
     return any(_contains_agg(c) for c in _ast_children(n))
 
@@ -2305,6 +2341,11 @@ def _agg_output_type(fn: str, arg_t: Type, is_star: bool) -> Type:
         return BIGINT
     if fn == "array_agg":
         return ArrayType(arg_t)
+    from presto_tpu.functions import registry as _freg
+
+    udf = _freg().aggregate(fn)
+    if udf is not None:
+        return udf.result_type(arg_t)
     raise AnalysisError(f"unknown aggregate {fn}")
 
 

@@ -308,7 +308,7 @@ def task_update_to_json(u) -> Dict[str, Any]:
             "task_index": u.task_index, "n_tasks": u.n_tasks,
             "n_out_partitions": u.n_out_partitions,
             "upstreams": {str(k): list(v) for k, v in u.upstreams.items()},
-            "config": dict(u.config)}
+            "config": dict(u.config), "spool": bool(u.spool)}
 
 
 def task_update_from_json(d: Dict[str, Any]):
@@ -320,4 +320,5 @@ def task_update_from_json(d: Dict[str, Any]):
         n_out_partitions=int(d["n_out_partitions"]),
         upstreams={int(k): list(v) for k, v in d["upstreams"].items()},
         config=dict(d.get("config") or {}),
+        spool=bool(d.get("spool", False)),
     )

@@ -407,6 +407,12 @@ def plan_to_string(node: PlanNode, indent: int = 0, node_stats=None) -> str:
         st = node_stats[id(node)]
         s += (f"   [rows={int(st['rows'])}, batches={int(st['batches'])}, "
               f"wall={st['wall_s']*1000:.1f}ms]")
+    jstats = getattr(node, "_jit_stats", None)
+    if node_stats is not None and jstats:
+        compiles = sum(v["compiles"] for v in jstats.values())
+        cwall = sum(v["compile_wall_s"] for v in jstats.values())
+        if compiles:
+            s += f"   [compiles={compiles}, compile_wall={cwall:.2f}s]"
     return s + "".join(
         "\n" + plan_to_string(c, indent + 1, node_stats) for c in node.children()
     )

@@ -116,12 +116,25 @@ def main(argv=None):
     p.add_argument("--query-event-log", default=None,
                    help="(coordinator) append query-completion events as "
                         "JSON lines to this file (EventListener analog)")
+    p.add_argument("--function-plugin", action="append", default=[],
+                   help="module[:attr] exposing register_functions(registry)"
+                        " — loads user scalar/aggregate functions "
+                        "(Plugin.getFunctions analog), repeatable")
+    p.add_argument("--cluster-memory-limit-bytes", type=int, default=None,
+                   help="(coordinator) cluster-wide memory ceiling for the "
+                        "low-memory killer")
     args = p.parse_args(argv)
 
     if args.platform:
         import jax
 
         jax.config.update("jax_platforms", args.platform)
+
+    if args.function_plugin:
+        from presto_tpu.functions import registry
+
+        for spec in args.function_plugin:
+            registry().load_plugin(spec)
 
     catalog = build_catalog(args.catalog)
 
@@ -148,6 +161,7 @@ def main(argv=None):
             authenticator=authenticator,
             session_property_manager=spm,
             query_event_log=args.query_event_log,
+            cluster_memory_limit_bytes=args.cluster_memory_limit_bytes,
         )
         print(f"coordinator listening on {coord.url}", flush=True)
         stop = []

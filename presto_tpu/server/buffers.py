@@ -5,26 +5,35 @@ variants + ClientBuffer: pages are buffered per downstream consumer, fetched
 by explicit token sequence numbers, retained until acknowledged, so a
 consumer can re-fetch from any token (restart-safe, exactly-once delivery —
 TaskResource.java:245-304).
+
+Spool mode (phased execution): a build-phase task's consumers are created
+in a LATER phase, so back-pressure can never drain — pages beyond the
+memory cap overflow to an unlinked temp file instead of blocking (the
+reference's spooling output buffers), and `get` reads them back
+transparently by token.
 """
 
 from __future__ import annotations
 
+import os
+import tempfile
 import threading
 from typing import List, Optional, Tuple
 
 
 class _PartitionBuffer:
-    """Token-addressed page queue for one consumer."""
+    """Token-addressed page queue for one consumer. Entries are either hot
+    bytes or ("d", offset, length) descriptors into the shared spool file."""
 
     def __init__(self):
-        self.pages: List[bytes] = []
-        self.base_token = 0          # token of pages[0]
+        self.entries: List[object] = []
+        self.base_token = 0          # token of entries[0]
         self.no_more = False
         self.aborted = False
 
     @property
     def end_token(self) -> int:
-        return self.base_token + len(self.pages)
+        return self.base_token + len(self.entries)
 
 
 class OutputBuffer:
@@ -32,35 +41,66 @@ class OutputBuffer:
 
     broadcast=True appends every page to all partitions (shared bytes —
     reference: BroadcastOutputBuffer page reference counting).
+    spool_dir, when set, disables producer blocking: overflow pages go to
+    disk (see module docstring).
     """
 
     def __init__(self, n_partitions: int, broadcast: bool = False,
-                 max_buffered_bytes: int = 256 << 20):
+                 max_buffered_bytes: int = 256 << 20,
+                 spool_dir: Optional[str] = None):
         self.n_partitions = n_partitions
         self.broadcast = broadcast
         self._parts = [_PartitionBuffer() for _ in range(n_partitions)]
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
         self._bytes = 0
+        self._spooled_bytes = 0
         self._max_bytes = max_buffered_bytes
+        self._spool_dir = spool_dir
+        self._spool_f = None  # unlinked temp file: space frees on close
         self._failed: Optional[str] = None
 
     # -- producer ---------------------------------------------------------
 
+    def _spool_page(self, page: bytes):
+        if self._spool_f is None:
+            fd, path = tempfile.mkstemp(prefix="outbuf-", suffix=".spool",
+                                        dir=self._spool_dir)
+            self._spool_f = os.fdopen(fd, "wb")
+            os.unlink(path)  # invisible; space reclaimed when fd closes
+        off = self._spool_f.tell()
+        self._spool_f.write(page)
+        self._spool_f.flush()
+        self._spooled_bytes += len(page)
+        return ("d", off, len(page))
+
+    def _read_entry(self, entry) -> bytes:
+        if isinstance(entry, bytes):
+            return entry
+        _, off, length = entry
+        return os.pread(self._spool_f.fileno(), length, off)
+
     def enqueue(self, partition: Optional[int], page: bytes):
         """Append a page; partition=None broadcasts. Blocks for back-pressure
-        when the buffer is full (OutputBufferMemoryManager's blocked future)."""
+        when the buffer is full (OutputBufferMemoryManager's blocked future)
+        — unless spooling, where overflow goes to disk instead."""
         with self._cond:
-            while self._bytes >= self._max_bytes and not self._all_aborted():
-                self._cond.wait(timeout=1.0)
+            if self._spool_dir is None:
+                while self._bytes >= self._max_bytes and not self._all_aborted():
+                    self._cond.wait(timeout=1.0)
             targets = range(self.n_partitions) if (self.broadcast or partition is None) \
                 else (partition,)
+            entry: object = page
+            if (self._spool_dir is not None
+                    and self._bytes + len(page) > self._max_bytes):
+                entry = self._spool_page(page)
             for p in targets:
                 pb = self._parts[p]
                 if pb.aborted:
                     continue
-                pb.pages.append(page)
-                self._bytes += len(page)
+                pb.entries.append(entry)
+                if isinstance(entry, bytes):
+                    self._bytes += len(page)
             self._cond.notify_all()
 
     def set_no_more_pages(self):
@@ -78,6 +118,20 @@ class OutputBuffer:
 
     def _all_aborted(self) -> bool:
         return all(pb.aborted for pb in self._parts)
+
+    def _maybe_release_spool(self):
+        # caller holds the lock; drop the spool file once no partition can
+        # ever read from it again
+        if self._spool_f is None:
+            return
+        if all(pb.aborted or (pb.no_more and not pb.entries)
+               for pb in self._parts):
+            try:
+                self._spool_f.close()
+            except OSError:
+                pass
+            self._spool_f = None
+            self._spooled_bytes = 0
 
     # -- consumer ---------------------------------------------------------
 
@@ -105,7 +159,7 @@ class OutputBuffer:
             if t < pb.base_token:
                 t = pb.base_token  # already acked past this point
             while t < pb.end_token and size < max_bytes:
-                page = pb.pages[t - pb.base_token]
+                page = self._read_entry(pb.entries[t - pb.base_token])
                 pages.append(page)
                 size += len(page)
                 t += 1
@@ -116,31 +170,40 @@ class OutputBuffer:
         """Discard pages before `token` (client acknowledged receipt)."""
         with self._cond:
             pb = self._parts[partition]
-            drop = min(max(token - pb.base_token, 0), len(pb.pages))
+            drop = min(max(token - pb.base_token, 0), len(pb.entries))
             for i in range(drop):
-                self._bytes -= len(pb.pages[i])
-            del pb.pages[:drop]
+                e = pb.entries[i]
+                if isinstance(e, bytes):
+                    self._bytes -= len(e)
+            del pb.entries[:drop]
             pb.base_token += drop
+            self._maybe_release_spool()
             self._cond.notify_all()
 
     def abort(self, partition: int):
         with self._cond:
             pb = self._parts[partition]
             pb.aborted = True
-            for p in pb.pages:
-                self._bytes -= len(p)
-            pb.pages.clear()
+            for e in pb.entries:
+                if isinstance(e, bytes):
+                    self._bytes -= len(e)
+            pb.entries.clear()
             pb.no_more = True
+            self._maybe_release_spool()
             self._cond.notify_all()
 
     def buffered_bytes(self) -> int:
         with self._lock:
             return self._bytes
 
+    def spooled_bytes(self) -> int:
+        with self._lock:
+            return self._spooled_bytes
+
     def is_finished(self) -> bool:
         with self._lock:
             return all(
-                pb.aborted or (pb.no_more and not pb.pages) for pb in self._parts
+                pb.aborted or (pb.no_more and not pb.entries) for pb in self._parts
             )
 
 

@@ -1,0 +1,171 @@
+"""Cluster memory manager + low-memory killer.
+
+Reference: memory/ClusterMemoryManager.java:92 (coordinator-side rollup of
+every worker's pool via MemoryPoolInfo), :218 (process() — when the
+cluster is out of memory, pick a victim with the configured
+LowMemoryKiller and fail it), and the killer policies
+TotalReservationLowMemoryKiller / TotalReservationOnBlockedNodesLowMemoryKiller.
+
+TPU-native shape: workers already announce their status on a heartbeat;
+the status document now carries per-query reserved bytes (HBM accounting
+is exact — fixed-capacity device arrays). The coordinator aggregates
+those reports here and, when the cluster is out of memory, fails the
+query with the largest relevant reservation with a structured
+CLUSTER_OUT_OF_MEMORY error while smaller queries keep running.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+
+class NodeMemory:
+    """One worker's last-reported memory state (MemoryPoolInfo analog)."""
+
+    __slots__ = ("reserved", "limit", "queries", "at")
+
+    def __init__(self, reserved: int, limit: Optional[int],
+                 queries: Dict[str, int], at: float):
+        self.reserved = reserved
+        self.limit = limit
+        self.queries = queries
+        self.at = at
+
+    @property
+    def blocked(self) -> bool:
+        """A node whose pool is (nearly) exhausted blocks further reserves
+        (the reference's blocked-nodes signal for the OOM killer)."""
+        return self.limit is not None and self.reserved >= 0.95 * self.limit
+
+
+class ClusterMemoryManager:
+    """Aggregates per-worker pool reports; kills the top memory hog when
+    the cluster runs out of memory (ClusterMemoryManager.process analog).
+
+    Kill policies (reference LowMemoryKiller implementations):
+      total-reservation            victim = max Σ bytes across ALL nodes
+      total-reservation-on-blocked victim = max Σ bytes across BLOCKED nodes
+    A kill fires when the cluster-wide reservation exceeds `limit_bytes`,
+    or when any worker pool is blocked (its local limit is the binding
+    constraint) — each after `kill_delay_s` of sustained pressure, so a
+    transient spike between heartbeats doesn't kill a healthy query.
+    """
+
+    def __init__(self, limit_bytes: Optional[int] = None,
+                 policy: str = "total-reservation-on-blocked",
+                 kill_delay_s: float = 1.0, stale_s: float = 30.0):
+        if policy not in ("total-reservation",
+                         "total-reservation-on-blocked", "none"):
+            raise ValueError(f"unknown low-memory killer policy {policy!r}")
+        self.limit_bytes = limit_bytes
+        self.policy = policy
+        self.kill_delay_s = kill_delay_s
+        self.stale_s = stale_s
+        self.kills = 0
+        self._nodes: Dict[str, NodeMemory] = {}
+        self._pressure_since: Optional[float] = None
+        self._lock = threading.Lock()
+
+    # -- ingest (called from the heartbeat prober) -------------------------
+
+    def update_node(self, node_id: str, status: dict):
+        mem = status.get("memory") or {}
+        with self._lock:
+            self._nodes[node_id] = NodeMemory(
+                int(mem.get("reservedBytes") or 0),
+                mem.get("limitBytes"),
+                {str(q): int(b) for q, b in
+                 (status.get("queryMemory") or {}).items()},
+                time.monotonic(),
+            )
+
+    def drop_node(self, node_id: str):
+        with self._lock:
+            self._nodes.pop(node_id, None)
+
+    # -- rollup ------------------------------------------------------------
+
+    def _fresh_nodes(self) -> Dict[str, NodeMemory]:
+        now = time.monotonic()
+        return {nid: nm for nid, nm in self._nodes.items()
+                if now - nm.at < self.stale_s}
+
+    def info(self) -> dict:
+        with self._lock:
+            nodes = self._fresh_nodes()
+            by_query: Dict[str, int] = {}
+            for nm in nodes.values():
+                for q, b in nm.queries.items():
+                    by_query[q] = by_query.get(q, 0) + b
+            return {
+                "totalReservedBytes": sum(n.reserved for n in nodes.values()),
+                "clusterLimitBytes": self.limit_bytes,
+                "blockedNodes": [nid for nid, n in nodes.items() if n.blocked],
+                "queryMemory": by_query,
+                "lowMemoryKills": self.kills,
+            }
+
+    # -- enforcement -------------------------------------------------------
+
+    def _candidates(self, nodes: Dict[str, NodeMemory],
+                    blocked_only: bool) -> list:
+        """Query ids ordered biggest-reservation-first."""
+        by_query: Dict[str, int] = {}
+        for nm in nodes.values():
+            if blocked_only and not nm.blocked:
+                continue
+            for q, b in nm.queries.items():
+                by_query[q] = by_query.get(q, 0) + b
+        return [q for q, _ in sorted(by_query.items(),
+                                     key=lambda kv: -kv[1])]
+
+    def enforce(self, query_manager) -> Optional[str]:
+        """One enforcement pass (call on the heartbeat cadence). Returns
+        the killed query id, if any."""
+        if self.policy == "none":
+            return None
+        with self._lock:
+            nodes = self._fresh_nodes()
+            total = sum(n.reserved for n in nodes.values())
+            over_cluster = (self.limit_bytes is not None
+                            and total > self.limit_bytes)
+            blocked = [nid for nid, n in nodes.items() if n.blocked]
+            under_pressure = over_cluster or bool(blocked)
+            now = time.monotonic()
+            if not under_pressure:
+                self._pressure_since = None
+                return None
+            if self._pressure_since is None:
+                self._pressure_since = now
+                return None
+            if now - self._pressure_since < self.kill_delay_s:
+                return None
+            blocked_only = (self.policy == "total-reservation-on-blocked"
+                            and bool(blocked) and not over_cluster)
+            candidates = self._candidates(nodes, blocked_only)
+            if blocked_only:
+                for q in self._candidates(nodes, blocked_only=False):
+                    if q not in candidates:
+                        candidates.append(q)
+        # kill accounting happens only on a CONFIRMED kill: a stale victim
+        # (worker still reporting a finished query) must not reset the
+        # pressure timer or count as a kill — fall through to the next hog
+        for victim in candidates:
+            try:
+                qe = query_manager.get(victim)
+            except KeyError:
+                continue
+            if qe.done:
+                continue
+            qe.fail(
+                "Query killed because the cluster is out of memory. "
+                "Please try again in a few minutes.",
+                error_type="CLUSTER_OUT_OF_MEMORY",
+            )
+            with self._lock:
+                self._pressure_since = None
+                self.kills += 1
+            return victim
+        return None

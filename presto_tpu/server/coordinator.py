@@ -104,9 +104,12 @@ class HeartbeatFailureDetector:
     decayed failure score crosses the threshold are excluded from
     scheduling (HeartbeatFailureDetector.java:360 ping loop)."""
 
-    def __init__(self, node_manager: NodeManager, interval_s: float = 2.0):
+    def __init__(self, node_manager: NodeManager, interval_s: float = 2.0,
+                 cluster_memory=None, query_manager=None):
         self.node_manager = node_manager
         self.interval_s = interval_s
+        self.cluster_memory = cluster_memory
+        self.query_manager = query_manager
         self._stop = threading.Event()
         self.thread = threading.Thread(target=self._loop, daemon=True,
                                        name="failure-detector")
@@ -122,6 +125,8 @@ class HeartbeatFailureDetector:
                 n.state = "draining"
             else:
                 n.record_success()
+            if self.cluster_memory is not None:
+                self.cluster_memory.update_node(n.node_id, status)
         except Exception:
             n.record_failure()
 
@@ -137,6 +142,13 @@ class HeartbeatFailureDetector:
                 t.start()
             for t in probes:
                 t.join(timeout=6)
+            # cluster OOM enforcement rides the heartbeat cadence
+            # (ClusterMemoryManager.process runs on its executor likewise)
+            if self.cluster_memory is not None and self.query_manager is not None:
+                try:
+                    self.cluster_memory.enforce(self.query_manager)
+                except Exception:
+                    pass
 
     def stop(self):
         self._stop.set()
@@ -170,10 +182,55 @@ class QueryFailed(RuntimeError):
         self.retryable = retryable
 
 
+def compute_phases(frags) -> Dict[int, int]:
+    """PhasedExecutionSchedule analog (execution/scheduler/
+    PhasedExecutionSchedule.java): fragments feeding a join BUILD side get
+    an earlier phase than the probe's fragment, so probe-side scans don't
+    hold memory while the build is still assembling. Streaming producers
+    (exchanges that pipeline: partial→final agg, sort inputs) share their
+    consumer's phase. Returns fid → 0-based phase (ascending start order)."""
+    from presto_tpu.plan.nodes import (
+        HashJoin,
+        NestedLoopJoin,
+        RemoteSource,
+        SemiJoin,
+    )
+
+    build_deps: Dict[int, set] = {fid: set() for fid in frags}
+    stream_deps: Dict[int, set] = {fid: set() for fid in frags}
+
+    def walk(n, fid, in_build):
+        if isinstance(n, RemoteSource):
+            (build_deps if in_build else stream_deps)[fid].add(n.fragment_id)
+            return
+        if isinstance(n, (HashJoin, SemiJoin, NestedLoopJoin)):
+            walk(n.left, fid, in_build)
+            walk(n.right, fid, True)  # build side
+            return
+        for c in n.children():
+            walk(c, fid, in_build)
+
+    for fid, f in frags.items():
+        walk(f.root, fid, False)
+    # consumers first (producers have lower fids — fragmenter numbers
+    # topologically), so each fragment's phase is final before its deps'
+    phase: Dict[int, int] = {}
+    for fid in sorted(frags, reverse=True):
+        phase.setdefault(fid, 0)
+        for dep in stream_deps[fid]:
+            phase[dep] = min(phase.get(dep, phase[fid]), phase[fid])
+        for dep in build_deps[fid]:
+            phase[dep] = min(phase.get(dep, phase[fid] - 1), phase[fid] - 1)
+    lo = min(phase.values())
+    return {fid: p - lo for fid, p in phase.items()}
+
+
 class DistributedScheduler:
     """Schedules a DistributedPlan onto workers and streams the result
-    (SqlQueryScheduler.schedule:657 analog; AllAtOnce policy — every stage
-    is started immediately, pages stream through the exchange)."""
+    (SqlQueryScheduler.schedule:657 analog). Policies
+    (SystemSessionProperties EXECUTION_POLICY): "all-at-once" starts every
+    stage immediately; "phased" creates each phase's tasks only after the
+    previous phase's (join-build) tasks finished — see compute_phases."""
 
     def __init__(self, config: Optional[ExecConfig] = None,
                  cluster_secret: Optional[str] = None):
@@ -213,8 +270,14 @@ class DistributedScheduler:
             fid: n_tasks[consumer[fid]] if fid in consumer else 1
             for fid in frags
         }
+        phased = getattr(config, "execution_policy",
+                         "all-at-once") == "phased"
+        phases = (compute_phases(frags) if phased
+                  else {fid: 0 for fid in frags})
+        last_phase = max(phases.values())
+
         task_urls: Dict[int, List[str]] = {}
-        assignments = []  # (task_id, worker, TaskUpdate)
+        assignments = []  # (task_id, worker, TaskUpdate, phase)
         for fid in sorted(frags):
             f = frags[fid]
             cnt = n_tasks[fid]
@@ -236,28 +299,44 @@ class DistributedScheduler:
                     n_out_partitions=n_out[fid],
                     upstreams=upstreams,
                     config=_config_dict(config),
+                    # a build-phase task's consumers don't exist yet:
+                    # spool its output instead of blocking on back-pressure
+                    spool=phases[fid] < last_phase,
                 )
-                assignments.append((tid, w, update))
+                assignments.append((tid, w, update, phases[fid]))
                 urls.append(f"{w.uri}/v1/task/{tid}")
             task_urls[fid] = urls
 
         created = []
         completed = False
         try:
-            # producers first (ascending fid = topological order)
-            for tid, w, update in assignments:
-                from presto_tpu.plan.codec import task_update_to_json
+            # phase by phase; within a phase producers first (ascending fid
+            # = topological order). All-at-once has exactly one phase.
+            for ph in range(last_phase + 1):
+                phase_tids = []
+                for tid, w, update, p in assignments:
+                    if p != ph:
+                        continue
+                    from presto_tpu.plan.codec import task_update_to_json
 
-                body = json.dumps(task_update_to_json(update)).encode()
-                req = urllib.request.Request(
-                    f"{w.uri}/v1/task/{tid}", data=body, method="POST",
-                    headers=self._headers({"Content-Type": "application/json"}),
-                )
-                with urllib.request.urlopen(req, timeout=30) as r:
-                    info = json.loads(r.read())
-                if info.get("state") == "failed":
-                    raise QueryFailed(info.get("error") or "task failed")
-                created.append((tid, w))
+                    body = json.dumps(task_update_to_json(update)).encode()
+                    req = urllib.request.Request(
+                        f"{w.uri}/v1/task/{tid}", data=body, method="POST",
+                        headers=self._headers(
+                            {"Content-Type": "application/json"}),
+                    )
+                    with urllib.request.urlopen(req, timeout=30) as r:
+                        info = json.loads(r.read())
+                    if info.get("state") == "failed":
+                        raise QueryFailed(info.get("error") or "task failed")
+                    created.append((tid, w))
+                    phase_tids.append((tid, w))
+                if ph < last_phase:
+                    # gate the next phase on this (build) phase finishing
+                    self._wait_finished(
+                        phase_tids,
+                        timeout_s=getattr(config, "phase_wait_timeout_s",
+                                          600.0))
             # stream the root fragment's single output buffer
             root_urls = [f"{u}/results/0" for u in task_urls[dplan.root_fid]]
             client = ExchangeClient(root_urls)
@@ -288,6 +367,39 @@ class DistributedScheduler:
             if not completed:
                 self._abort(created)
 
+    def _wait_finished(self, tasks, timeout_s: float = 600.0,
+                       poll_s: float = 0.1):
+        """Block until every (tid, worker) task reached a terminal state
+        (phased scheduling's stage-completion gate). A failed task fails
+        the query immediately."""
+        deadline = time.monotonic() + timeout_s
+        pending = list(tasks)
+        while pending:
+            still = []
+            for tid, w in pending:
+                try:
+                    req = urllib.request.Request(
+                        f"{w.uri}/v1/task/{tid}/status",
+                        headers=self._headers())
+                    with urllib.request.urlopen(req, timeout=10) as r:
+                        info = json.loads(r.read())
+                except Exception as e:
+                    raise QueryFailed(
+                        f"lost task {tid} while awaiting phase completion: "
+                        f"{e}", retryable=True) from e
+                state = info.get("state")
+                if state == "failed":
+                    raise QueryFailed(info.get("error") or f"task {tid} failed")
+                if state not in ("finished", "aborted"):
+                    still.append((tid, w))
+            pending = still
+            if pending:
+                if time.monotonic() > deadline:
+                    raise QueryFailed(
+                        f"phase did not complete within {timeout_s}s "
+                        f"({len(pending)} tasks still running)")
+                time.sleep(poll_s)
+
     def _abort(self, created):
         for tid, w in created:
             try:
@@ -316,7 +428,11 @@ class Coordinator:
                  broadcast_threshold_rows: float = 1_000_000,
                  cluster_secret: Optional[str] = None,
                  authenticator=None, session_property_manager=None,
-                 query_event_log: Optional[str] = None):
+                 query_event_log: Optional[str] = None,
+                 cluster_memory_limit_bytes: Optional[int] = None,
+                 low_memory_killer: str = "total-reservation-on-blocked",
+                 low_memory_kill_delay_s: float = 1.0):
+        from presto_tpu.server.cluster_memory import ClusterMemoryManager
         from presto_tpu.server.protocol import StatementProtocol
         from presto_tpu.server.querymanager import (
             QueryManager,
@@ -327,7 +443,11 @@ class Coordinator:
         self.config = config or ExecConfig()
         self.broadcast_threshold_rows = broadcast_threshold_rows
         self.node_manager = NodeManager()
-        self.failure_detector = HeartbeatFailureDetector(self.node_manager)
+        self.cluster_memory = ClusterMemoryManager(
+            cluster_memory_limit_bytes, policy=low_memory_killer,
+            kill_delay_s=low_memory_kill_delay_s)
+        self.failure_detector = HeartbeatFailureDetector(
+            self.node_manager, cluster_memory=self.cluster_memory)
         self.size_monitor = ClusterSizeMonitor(self.node_manager, min_workers)
         self.scheduler = DistributedScheduler(self.config,
                                               cluster_secret=cluster_secret)
@@ -343,6 +463,7 @@ class Coordinator:
             return batch_to_result(self.run_batch(sql, cfg, session))
 
         self.query_manager = QueryManager(execute_fn)
+        self.failure_detector.query_manager = self.query_manager
         if query_event_log:
             # query-completion audit stream (reference: the EventListener
             # SPI's QueryCompletedEvent, commonly shipped to an audit log)
@@ -544,6 +665,7 @@ class Coordinator:
                         "runningQueries": sum(1 for q in qs if q.state == "RUNNING"),
                         "queuedQueries": sum(1 for q in qs if q.state == "QUEUED"),
                         "totalQueries": len(qs),
+                        "memory": coord.cluster_memory.info(),
                     })
                 if self.path == "/v1/metrics":
                     from presto_tpu.server.metrics import coordinator_metrics

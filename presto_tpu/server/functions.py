@@ -70,4 +70,8 @@ def list_functions() -> List[Tuple[str, str, str]]:
         out.append((n, "aggregate", ""))
     for n in sorted(_WINDOW):
         out.append((n, "window", ""))
+    # registered (plugin/user) functions — presto_tpu/functions.py
+    from presto_tpu.functions import registry
+
+    out.extend(registry().list())
     return out

@@ -17,6 +17,35 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import List, Optional
 
 
+def _is_connect_error(e: Exception) -> bool:
+    """True only for failures that happen BEFORE the request was sent
+    (connection refused / unreachable / DNS). Read timeouts and other
+    mid-response errors return False: the statement may already be
+    executing on the coordinator, and replaying a POST /v1/statement to
+    another target would double-execute non-idempotent DML. (The
+    reference presto-proxy never replays statements across backends.)"""
+    import socket
+
+    if isinstance(e, urllib.error.URLError) and not isinstance(
+            e, urllib.error.HTTPError):
+        reason = e.reason
+        if isinstance(reason, Exception):
+            return _is_connect_error(reason)
+        return False
+    if isinstance(e, socket.gaierror):
+        return True
+    if isinstance(e, (socket.timeout, TimeoutError)):
+        return False  # can't tell connect- from read-timeout: don't replay
+    if isinstance(e, ConnectionRefusedError):
+        return True
+    if isinstance(e, OSError):
+        import errno
+
+        return e.errno in (errno.ECONNREFUSED, errno.EHOSTUNREACH,
+                           errno.ENETUNREACH, errno.EADDRNOTAVAIL)
+    return False
+
+
 _FORWARD_HEADERS = ("X-Presto-User", "X-Presto-Source", "X-Presto-Catalog",
                     "X-Presto-Schema", "X-Presto-Session", "Authorization",
                     "Content-Type")
@@ -113,9 +142,21 @@ class CoordinatorProxy:
                 return (self._rewrite(data, target) if data else b"",
                         e.code, e.headers.get("Content-Type",
                                               "application/json"))
-            except Exception as e:  # connect error → fail over
+            except Exception as e:
                 last_err = e
-                continue
+                # Fail over only when the request provably never reached a
+                # coordinator (pre-send connect error), or for idempotent
+                # methods (GET reads, DELETE cancels). A POST that timed
+                # out mid-response may already be executing — surface the
+                # error instead of re-POSTing.
+                if _is_connect_error(e) or method in ("GET", "DELETE"):
+                    continue
+                msg = json.dumps({"error": {
+                    "message": f"coordinator {target} failed mid-request: "
+                               f"{e}",
+                    "errorName": "PROXY_TARGET_ERROR",
+                    "errorType": "EXTERNAL_ERROR"}})
+                return msg.encode(), 502, "application/json"
         msg = json.dumps({"error": {
             "message": f"no coordinator reachable: {last_err}",
             "errorName": "PROXY_NO_TARGET", "errorType": "INTERNAL_ERROR"}})

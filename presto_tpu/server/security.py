@@ -46,18 +46,34 @@ class PasswordAuthenticator:
                     user, salt, digest = line.split(":", 2)
                     self.users[user] = (salt, digest)
 
-    @staticmethod
-    def hash_entry(user: str, password: str) -> str:
-        """One password-file line for `user`."""
-        salt = secrets.token_hex(8)
-        digest = hashlib.sha256((salt + password).encode()).hexdigest()
-        return f"{user}:{salt}:{digest}"
+    # PBKDF2 work factor: a leaked password file must not be brute-forceable
+    # at hash-cracking speed (the reference's file authenticator requires
+    # bcrypt or PBKDF2 and rejects fast hashes).
+    PBKDF2_ITERATIONS = 120_000
+
+    @classmethod
+    def hash_entry(cls, user: str, password: str) -> str:
+        """One password-file line for `user` (PBKDF2-HMAC-SHA256)."""
+        salt = secrets.token_hex(16)
+        dk = hashlib.pbkdf2_hmac("sha256", password.encode(),
+                                 salt.encode(), cls.PBKDF2_ITERATIONS)
+        return f"{user}:{salt}:pbkdf2:{cls.PBKDF2_ITERATIONS}:{dk.hex()}"
 
     def check(self, user: str, password: str) -> bool:
         rec = self.users.get(user)
         if rec is None:
             return False
         salt, digest = rec
+        if digest.startswith("pbkdf2:"):
+            try:
+                _, iters_s, hexdk = digest.split(":", 2)
+                iters = int(iters_s)
+            except ValueError:
+                return False
+            cand = hashlib.pbkdf2_hmac("sha256", password.encode(),
+                                       salt.encode(), iters).hex()
+            return hmac.compare_digest(cand, hexdk)
+        # legacy single-round entries still verify (rotate via hash_entry)
         cand = hashlib.sha256((salt + password).encode()).hexdigest()
         return hmac.compare_digest(cand, digest)
 

@@ -242,4 +242,5 @@ class Session:
             memory_revoking_target=self.get("memory_revoking_target"),
             scan_prefetch=self.get("scan_prefetch"),
             query_retry_count=self.get("query_retry_count"),
+            execution_policy=self.get("execution_policy"),
         )

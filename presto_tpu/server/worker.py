@@ -24,6 +24,7 @@ import dataclasses
 import json
 import re
 import threading
+import time
 import traceback
 from functools import lru_cache
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -58,6 +59,11 @@ class TaskUpdate:
     n_out_partitions: int
     upstreams: Dict[int, List[str]]  # fragment_id -> result-buffer base URLs
     config: dict = dataclasses.field(default_factory=dict)
+    # phased scheduling: build-phase tasks spool their output (no enqueue
+    # back-pressure) because their consumers are created in a LATER phase
+    # and cannot drain them yet (PhasedExecutionSchedule + the reference's
+    # spooling broadcast buffers)
+    spool: bool = False
 
 
 @lru_cache(maxsize=256)
@@ -158,7 +164,13 @@ class TaskExecution:
         self.buffer = OutputBuffer(
             update.n_out_partitions,
             broadcast=(f.output_partitioning == OUT_BROADCAST),
+            # phased build tasks spool overflow to disk: their consumers
+            # are created in a later phase, so back-pressure cannot drain
+            spool_dir=(spill_manager.dir if update.spool and spill_manager
+                       is not None else None),
         )
+        self.created_at = time.time()
+        self.finished_at: Optional[float] = None
         self._clients: List[ExchangeClient] = []
         self.thread = threading.Thread(
             target=self._run, daemon=True, name=f"task-{task_id}"
@@ -221,9 +233,11 @@ class TaskExecution:
                      for k, v in ctx.stats.items()]
             self.buffer.set_no_more_pages()
             self.state = "finished"
+            self.finished_at = time.time()
         except Exception as e:
             self.error = f"{type(e).__name__}: {e}\n{traceback.format_exc()}"
             self.state = "failed"
+            self.finished_at = time.time()
             self.buffer.fail(self.error)
         finally:
             for c in self._clients:
@@ -288,6 +302,7 @@ class TaskExecution:
             "state": self.state,
             "error": self.error,
             "bufferedBytes": self.buffer.buffered_bytes(),
+            "spooledBytes": self.buffer.spooled_bytes(),
         }
         if self.stats_report is not None:
             out["stats"] = self.stats_report
@@ -308,13 +323,42 @@ class TaskManager:
         self.tasks: Dict[str, TaskExecution] = {}
         self.executor = TaskExecutor(run_slots)
         self._lock = threading.Lock()
+        # query_id -> QueryScopedPool: per-query slice of the node pool,
+        # reported to the coordinator's ClusterMemoryManager
+        self._query_pools: Dict[str, "QueryScopedPool"] = {}
+
+    def _pool_for(self, task_id: str):
+        from presto_tpu.memory import QueryScopedPool
+
+        # task ids are "{query_id}.{fragment}.{index}" (coordinator.execute)
+        query_id = task_id.rsplit(".", 2)[0] if task_id.count(".") >= 2 \
+            else task_id
+        qp = self._query_pools.get(query_id)
+        if qp is None:
+            qp = self._query_pools[query_id] = QueryScopedPool(
+                self.memory_pool, query_id)
+        return qp
+
+    def query_memory(self) -> Dict[str, int]:
+        """Live per-query reserved bytes (stale finished queries pruned)."""
+        with self._lock:
+            active = {t.task_id.rsplit(".", 2)[0]
+                      if t.task_id.count(".") >= 2 else t.task_id
+                      for t in self.tasks.values() if t.state == "running"}
+            for qid in list(self._query_pools):
+                if (qid not in active
+                        and self._query_pools[qid].query_reserved == 0):
+                    del self._query_pools[qid]
+            return {qid: qp.query_reserved
+                    for qid, qp in self._query_pools.items()}
 
     def update_task(self, task_id: str, update: TaskUpdate) -> dict:
         with self._lock:
             t = self.tasks.get(task_id)
             if t is None:
                 t = TaskExecution(task_id, update, self.catalog,
-                                  self.memory_pool, self.spill_manager,
+                                  self._pool_for(task_id),
+                                  self.spill_manager,
                                   executor=self.executor)
                 self.tasks[task_id] = t
             return t.info()
@@ -519,6 +563,7 @@ class Worker:
             "tasks": len(tasks),
             "runningTasks": sum(1 for t in tasks.values() if t.state == "running"),
             "memory": self.memory_pool.info(),
+            "queryMemory": self.task_manager.query_memory(),
             "spilledBytes": self.spill_manager.total_spilled_bytes,
             "spillCount": self.spill_manager.spill_count,
         }

@@ -1,0 +1,190 @@
+"""Cluster memory manager + low-memory killer.
+
+Reference: memory/ClusterMemoryManager.java:92,218 (per-worker pool rollup
+on the coordinator; when the cluster is out of memory, the configured
+LowMemoryKiller picks a victim and the query fails with a structured
+error) and TotalReservationOnBlockedNodesLowMemoryKiller."""
+
+import threading
+import time
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from presto_tpu.memory import MemoryPool, QueryScopedPool
+from presto_tpu.server.cluster_memory import ClusterMemoryManager
+from presto_tpu.server.querymanager import FAILED, FINISHED, QueryManager
+from presto_tpu.server.session import Session
+
+
+def _status(reserved, limit, queries):
+    return {"memory": {"reservedBytes": reserved, "limitBytes": limit},
+            "queryMemory": queries}
+
+
+class TestKillPolicy:
+    def test_no_pressure_no_kill(self):
+        cmm = ClusterMemoryManager(limit_bytes=1000, kill_delay_s=0.0)
+        cmm.update_node("w0", _status(100, None, {"q1": 100}))
+        assert cmm.enforce(None) is None
+        assert cmm.kills == 0
+
+    def test_total_reservation_picks_biggest(self):
+        cmm = ClusterMemoryManager(limit_bytes=1000,
+                                   policy="total-reservation",
+                                   kill_delay_s=0.0)
+
+        class FakeQM:
+            class _Q:
+                done = False
+                killed = None
+
+                def fail(self, msg, error_type=""):
+                    FakeQM.victim = (msg, error_type)
+
+            def get(self, qid):
+                FakeQM.got = qid
+                return self._Q()
+
+        qm = FakeQM()
+        # q2 is the hog split across two workers (300 + 500 > q1's 600)
+        cmm.update_node("w0", _status(700, None, {"q1": 400, "q2": 300}))
+        cmm.update_node("w1", _status(700, None, {"q1": 200, "q2": 500}))
+        assert cmm.enforce(qm) is None  # first pass only arms the timer
+        assert cmm.enforce(qm) == "q2"
+        assert FakeQM.got == "q2"
+        assert "out of memory" in FakeQM.victim[0]
+        assert FakeQM.victim[1] == "CLUSTER_OUT_OF_MEMORY"
+        assert cmm.kills == 1
+
+    def test_blocked_nodes_policy_prefers_blocked(self):
+        cmm = ClusterMemoryManager(limit_bytes=None,
+                                   policy="total-reservation-on-blocked",
+                                   kill_delay_s=0.0)
+
+        class FakeQM:
+            class _Q:
+                done = False
+
+                def fail(self, msg, error_type=""):
+                    pass
+
+            def get(self, qid):
+                return self._Q()
+
+        # w0 is blocked (reserved at its limit); q_small is cluster-wide
+        # bigger but only q_big runs on the blocked node
+        cmm.update_node("w0", _status(1000, 1000, {"q_big": 900}))
+        cmm.update_node("w1", _status(500, 10_000, {"q_small": 5000}))
+        cmm.enforce(FakeQM())
+        assert cmm.enforce(FakeQM()) == "q_big"
+
+    def test_kill_delay_filters_transient_spikes(self):
+        cmm = ClusterMemoryManager(limit_bytes=100, kill_delay_s=30.0)
+        cmm.update_node("w0", _status(500, None, {"q": 500}))
+        assert cmm.enforce(None) is None  # arms
+        assert cmm.enforce(None) is None  # still inside the delay
+        # pressure clears → timer resets
+        cmm.update_node("w0", _status(10, None, {"q": 10}))
+        assert cmm.enforce(None) is None
+        assert cmm._pressure_since is None
+
+    def test_stale_nodes_ignored(self):
+        cmm = ClusterMemoryManager(limit_bytes=100, kill_delay_s=0.0,
+                                   stale_s=0.0)
+        cmm.update_node("w0", _status(500, None, {"q": 500}))
+        time.sleep(0.01)
+        assert cmm.enforce(None) is None
+        assert cmm.info()["totalReservedBytes"] == 0
+
+    def test_unknown_policy_rejected(self):
+        with pytest.raises(ValueError):
+            ClusterMemoryManager(policy="drop-tables")
+
+
+class TestQueryScopedPool:
+    def test_per_query_slices_share_node_pool(self):
+        node = MemoryPool(10_000)
+        a = QueryScopedPool(node, "qa")
+        b = QueryScopedPool(node, "qb")
+        a.reserve(4000)
+        b.reserve(1000)
+        assert node.reserved == 5000
+        assert a.query_reserved == 4000 and b.query_reserved == 1000
+        # spill decisions see NODE-wide pressure through either slice
+        assert a.reserved == 5000 and b.reserved == 5000
+        a.free(4000)
+        assert node.reserved == 1000 and a.query_reserved == 0
+
+    def test_node_limit_still_binds(self):
+        from presto_tpu.memory import ExceededMemoryLimit
+
+        node = MemoryPool(1000)
+        a = QueryScopedPool(node, "qa")
+        b = QueryScopedPool(node, "qb")
+        a.reserve(800)
+        with pytest.raises(ExceededMemoryLimit):
+            b.reserve(800)
+
+
+class TestKillerEndToEnd:
+    def test_hog_killed_small_query_survives(self):
+        """The integration shape of ClusterMemoryManager.process: a real
+        QueryManager runs a hog and a small query; worker heartbeats
+        attribute the memory; enforcement kills ONLY the hog."""
+        hog_release = threading.Event()
+
+        def execute_fn(session, sql):
+            if "hog" in sql:
+                # a query that sits on memory until killed
+                hog_release.wait(30)
+            from presto_tpu.server.querymanager import QueryResult
+
+            return QueryResult(columns=["x"], types=["bigint"], rows=[(1,)])
+
+        qm = QueryManager(execute_fn)
+        cmm = ClusterMemoryManager(limit_bytes=1_000_000, kill_delay_s=0.0)
+        try:
+            hog = qm.create_query(Session(), "select hog")
+            small = qm.create_query(Session(), "select small")
+            deadline = time.time() + 5
+            while hog.state != "RUNNING" and time.time() < deadline:
+                time.sleep(0.01)
+            # two workers report: hog holds ~2MB across the cluster
+            cmm.update_node("w0", _status(
+                1_200_000, None,
+                {hog.query_id: 1_100_000, small.query_id: 10_000}))
+            cmm.update_node("w1", _status(
+                900_000, None, {hog.query_id: 900_000}))
+            cmm.enforce(qm)  # arm
+            assert cmm.enforce(qm) == hog.query_id
+            assert hog.state == FAILED
+            assert hog.error_type == "CLUSTER_OUT_OF_MEMORY"
+            assert "out of memory" in hog.error
+            # the small query is untouched and completes
+            assert small.wait(10)
+            assert small.state == FINISHED
+        finally:
+            hog_release.set()
+            qm.close()
+
+
+def test_worker_status_reports_query_memory():
+    """Worker.status() carries per-query reserved bytes keyed by the
+    query id prefix of task ids ({query}.{fragment}.{index})."""
+    from presto_tpu.server.worker import TaskManager
+
+    tm = TaskManager.__new__(TaskManager)  # avoid HTTP plumbing
+    tm.memory_pool = MemoryPool(None)
+    tm.tasks = {}
+    tm._lock = threading.Lock()
+    tm._query_pools = {}
+    qp = tm._pool_for("20240101_000001.1.0")
+    qp2 = tm._pool_for("20240101_000001.2.3")
+    assert qp is qp2  # same query → same scoped pool
+    qp.reserve(4096)
+    assert tm.query_memory() == {"20240101_000001": 4096}
+    qp.free(4096)
+    # a query with no tasks and zero bytes is pruned from the report
+    assert tm.query_memory() == {}

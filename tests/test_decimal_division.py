@@ -1,0 +1,159 @@
+"""Exact decimal division (DecimalOperators.divide /
+UnscaledDecimal128Arithmetic.divideRoundUp semantics): result typed
+DECIMAL(p, max(s1,s2)) with ROUND HALF AWAY FROM ZERO — no silent DOUBLE
+promotion. Oracle: python's decimal module at matching context."""
+
+import decimal
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from presto_tpu.catalog.memory import MemoryConnector
+from presto_tpu.connector import Catalog
+from presto_tpu.exec import ExecConfig, LocalRunner
+from presto_tpu.types import DecimalType
+
+
+def _runner(tables):
+    conn = MemoryConnector()
+    for name, spec in tables.items():
+        conn.add_generated(name, spec)
+    cat = Catalog()
+    cat.register("m", conn, default=True)
+    return LocalRunner(cat, ExecConfig(batch_rows=1 << 12))
+
+
+def _oracle_div(a_unscaled, s1, b_unscaled, s2):
+    """round-half-away((a/10^s1) / (b/10^s2)) at scale max(s1, s2).
+    (python decimal's ROUND_HALF_UP is half-away-from-zero.)"""
+    s = max(s1, s2)
+    with decimal.localcontext() as cx:
+        cx.prec = 60
+        q = (decimal.Decimal(int(a_unscaled)).scaleb(-s1)
+             / decimal.Decimal(int(b_unscaled)).scaleb(-s2))
+        return int(q.scaleb(s).to_integral_value(
+            rounding=decimal.ROUND_HALF_UP))
+
+
+def test_result_is_decimal_typed():
+    rng = np.random.default_rng(7)
+    a = rng.integers(-10_000_00, 10_000_00, 64)
+    b = rng.integers(1, 999_99, 64)
+    r = _runner({"t": {
+        "a": ("raw_decimal", DecimalType(15, 2), a),
+        "b": ("raw_decimal", DecimalType(15, 2), b),
+    }})
+    out = r.run("select a / b as q from t")
+    assert isinstance(out.q[0], decimal.Decimal)  # not a float
+
+
+def test_short_path_exact_random():
+    rng = np.random.default_rng(11)
+    n = 5000
+    a = rng.integers(-(10 ** 15) + 1, 10 ** 15, n)
+    b = rng.integers(1, 10 ** 6, n) * rng.choice([-1, 1], n)
+    r = _runner({"t": {
+        "a": ("raw_decimal", DecimalType(15, 2), a),
+        "b": ("raw_decimal", DecimalType(15, 2), b),
+    }})
+    out = r.run("select a / b as q from t")
+    got = [int(q.scaleb(2)) for q in out.q]
+    want = [_oracle_div(int(x), 2, int(y), 2) for x, y in zip(a, b)]
+    assert got == want
+
+
+def test_half_away_rounding_ties():
+    # 1.00 / 8.00 = 0.125 → 0.13 (away); -1.00 / 8.00 → -0.13
+    r = _runner({"t": {
+        "a": ("raw_decimal", DecimalType(15, 2), np.array([100, -100, 25])),
+        "b": ("raw_decimal", DecimalType(15, 2), np.array([800, 800, 200])),
+    }})
+    out = r.run("select a / b as q from t")
+    assert [str(q) for q in out.q] == ["0.13", "-0.13", "0.13"]
+
+
+def test_mixed_scales():
+    # decimal(12,4) / decimal(15,2): scale = 4, shift = 4 + 2 - 4 = 2
+    rng = np.random.default_rng(3)
+    n = 2000
+    a = rng.integers(-(10 ** 12), 10 ** 12, n)
+    b = rng.integers(1, 10 ** 9, n) * rng.choice([-1, 1], n)
+    r = _runner({"t": {
+        "a": ("raw_decimal", DecimalType(12, 4), a),
+        "b": ("raw_decimal", DecimalType(15, 2), b),
+    }})
+    out = r.run("select a / b as q from t")
+    got = [int(q.scaleb(4)) for q in out.q]
+    want = [_oracle_div(int(x), 4, int(y), 2) for x, y in zip(a, b)]
+    assert got == want
+
+
+def test_divide_by_zero_is_null():
+    r = _runner({"t": {
+        "a": ("raw_decimal", DecimalType(15, 2), np.array([100, 200])),
+        "b": ("raw_decimal", DecimalType(15, 2), np.array([0, 100])),
+    }})
+    out = r.run("select a / b as q from t")
+    assert out.q[0] is None or pd.isna(out.q[0])
+    assert str(out.q[1]) == "2.00"
+
+
+def test_int_by_decimal_and_decimal_by_int():
+    r = _runner({"t": {
+        "a": ("raw_decimal", DecimalType(15, 2), np.array([700])),
+    }})
+    out = r.run("select a / 4 as q1, 7 / a as q2 from t")
+    assert str(out.q1[0]) == "1.75"
+    assert str(out.q2[0]) == "1.00"
+
+
+def test_money_ratio_over_aggregated_sums_exact():
+    """Q14 shape: 100.00 * sum(case ...) / sum(...) — the divisor is a
+    long-decimal aggregate; the two-product f64 path must stay exact
+    while the sums are < 2^53."""
+    rng = np.random.default_rng(5)
+    n = 100_000
+    price = rng.integers(100, 10_000_00, n)  # cents
+    promo = rng.random(n) < 0.3
+    r = _runner({"l": {
+        "price": ("raw_decimal", DecimalType(15, 2), price),
+        "promo": promo.astype(np.int64),
+    }})
+    out = r.run(
+        "select 100.00 * sum(case when promo = 1 then price else 0.00 end)"
+        " / sum(price) as pct from l")
+    num = int(price[promo].sum()) * 10000  # 100.00 → scale 2, mul adds
+    den = int(price.sum())
+    s_num = 4  # 100.00(s2) * sum(s2) → scale 4
+    want = _oracle_div(num, s_num, den, 2)
+    got = out.pct[0]
+    assert isinstance(got, decimal.Decimal)
+    assert int(got.scaleb(4)) == want
+
+
+def test_q14_matches_sqlite_oracle():
+    """Answer-level cross-check against sqlite on the same data."""
+    import sqlite3
+
+    rng = np.random.default_rng(9)
+    n = 20_000
+    price = rng.integers(100, 10_000_00, n)
+    promo = (rng.random(n) < 0.25).astype(np.int64)
+    r = _runner({"l": {
+        "price": ("raw_decimal", DecimalType(15, 2), price),
+        "promo": promo,
+    }})
+    got = r.run(
+        "select 100.00 * sum(case when promo = 1 then price else 0.00 end)"
+        " / sum(price) as pct from l").pct[0]
+    con = sqlite3.connect(":memory:")
+    con.execute("create table l (price real, promo int)")
+    con.executemany("insert into l values (?, ?)",
+                    [(p / 100.0, int(m)) for p, m in zip(price, promo)])
+    (want,) = con.execute(
+        "select 100.0 * sum(case when promo = 1 then price else 0 end)"
+        " / sum(price) from l").fetchone()
+    # engine result is DECIMAL at scale 4 (100.00·scale2 → 4; ÷ scale2
+    # keeps max-scale 4): agreement within half an ulp at that scale
+    assert abs(float(got) - want) <= 5e-5

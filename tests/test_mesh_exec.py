@@ -118,3 +118,50 @@ def test_unnest_on_mesh(env):
          "cross join unnest(array[1, 2]) as u(e) "
          "group by e order by e")
     _same(mx.run(q), local.run(q))
+
+
+def test_window_on_mesh(env):
+    """Window functions trace into the shard_map program (the gathered
+    SINGLE fragment is replicated per device; build_window_compute is the
+    same traceable kernel the streaming engine jits)."""
+    mx, local = env
+    q = ("select o_custkey, o_orderkey, "
+         "row_number() over (partition by o_custkey "
+         "order by o_totalprice desc) as rn, "
+         "sum(o_totalprice) over (partition by o_custkey) as tot "
+         "from orders where o_custkey < 50 order by o_custkey, rn")
+    _same(mx.run(q), local.run(q), float_cols=("tot",))
+
+
+def test_full_outer_join_on_mesh(env):
+    """FULL OUTER: probe-null tail + per-device build remainder (the
+    fragmenter never broadcasts a full join's build side, so each device
+    owns disjoint build rows)."""
+    mx, local = env
+    q = ("select c_custkey, count(o_orderkey) as n "
+         "from customer full outer join orders on c_custkey = o_custkey "
+         "group by c_custkey order by c_custkey")
+    g, e = mx.run(q), local.run(q)
+    assert len(g) == len(e)
+    assert list(g.n) == list(e.n)
+
+
+def test_right_outer_join_on_mesh(env):
+    """RIGHT OUTER normalizes to LEFT at analysis; rows with no match keep
+    NULL left columns."""
+    mx, local = env
+    q = ("select o_orderkey, c_name from orders "
+         "right outer join customer on o_custkey = c_custkey "
+         "where c_custkey < 100 order by c_name, o_orderkey")
+    _same(mx.run(q), local.run(q))
+
+
+def test_intersect_except_on_mesh(env):
+    mx, local = env
+    qi = ("select o_custkey as k from orders intersect "
+          "select c_custkey as k from customer where c_custkey < 500 "
+          "order by k")
+    _same(mx.run(qi), local.run(qi))
+    qe = ("select c_custkey as k from customer except "
+          "select o_custkey as k from orders order by k")
+    _same(mx.run(qe), local.run(qe))

@@ -130,6 +130,53 @@ def test_write_table_nullable_and_dates(tmp_path):
     assert [str(v) for v in out.m] == ["1.00", "-2.50", "0.00"]
 
 
+def test_parts_schema_drift_rejected(tmp_path):
+    """A parts directory whose files disagree on schema is an error, not a
+    silent misread through the first file's schema."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    import pytest
+
+    d = tmp_path / "t.parts"
+    d.mkdir()
+    pq.write_table(pa.table({"a": pa.array([1, 2], pa.int64())}),
+                   str(d / "part-0.parquet"))
+    pq.write_table(pa.table({"a": pa.array([1.5, 2.5], pa.float64())}),
+                   str(d / "part-1.parquet"))
+    conn = ParquetConnector(str(tmp_path))
+    with pytest.raises(ValueError, match="schema drift"):
+        conn.get_table("t")
+
+
+def test_parts_vocab_cache_skips_rescan(tmp_path):
+    """Per-file vocab caching: re-loading a parts table after invalidation
+    only scans files it has not seen (by path+mtime)."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    d = tmp_path / "t.parts"
+    d.mkdir()
+    t = pa.table({"s": pa.array(["x", "y", "x"])})
+    pq.write_table(t, str(d / "part-0.parquet"))
+    conn = ParquetConnector(str(tmp_path))
+    h = conn.get_table("t")
+    assert h.row_count == 3
+    cache_keys = set(conn._vocab_cache)
+    assert len(cache_keys) == 1
+    # add a part, invalidate: old file's vocab entry is reused, new added
+    pq.write_table(pa.table({"s": pa.array(["z"])}),
+                   str(d / "part-1.parquet"))
+    conn.invalidate_cache()
+    conn._tables.pop("t", None)
+    h2 = conn.get_table("t")
+    assert h2.row_count == 4
+    assert cache_keys <= set(conn._vocab_cache)
+    assert len(conn._vocab_cache) == 2
+    vocab = {v for c in h2.columns if c.dictionary is not None
+             for v in c.dictionary.values}
+    assert {"x", "y", "z"} <= vocab
+
+
 def test_struct_columns_flatten_to_row_fields(tmp_path):
     """parquet struct columns expose ROW fields as dotted leaf columns
     (spi/type/RowType over nested parquet; analysis resolves r.f)."""

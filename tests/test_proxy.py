@@ -60,6 +60,69 @@ def test_proxy_failover():
         coord.close()
 
 
+def test_proxy_does_not_replay_posts_mid_request():
+    """A coordinator that dies MID-RESPONSE (after accepting the POST) must
+    not trigger a re-POST to the next target — non-idempotent DML would
+    execute twice. Only pre-send connect errors fail over."""
+    import http.server
+    import json
+    import threading
+    import urllib.error
+    import urllib.request
+
+    hits = {"flaky": 0, "healthy": 0}
+
+    class FlakyHandler(http.server.BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_POST(self):
+            hits["flaky"] += 1
+            # accept the request, then die mid-response (no/short body)
+            self.send_response(200)
+            self.send_header("Content-Length", "100")
+            self.end_headers()
+            self.wfile.write(b'{"truncated"')
+            self.wfile.flush()
+            self.connection.close()
+
+    class HealthyHandler(http.server.BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_POST(self):
+            hits["healthy"] += 1
+            body = json.dumps({"ok": True}).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    flaky = http.server.ThreadingHTTPServer(("127.0.0.1", 0), FlakyHandler)
+    healthy = http.server.ThreadingHTTPServer(("127.0.0.1", 0), HealthyHandler)
+    for s in (flaky, healthy):
+        threading.Thread(target=s.serve_forever, daemon=True).start()
+    proxy = CoordinatorProxy([
+        f"http://127.0.0.1:{flaky.server_address[1]}",
+        f"http://127.0.0.1:{healthy.server_address[1]}"])
+    try:
+        req = urllib.request.Request(f"{proxy.url}/v1/statement",
+                                     data=b"insert into t values (1)",
+                                     method="POST")
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=30)
+        assert ei.value.code == 502
+        body = json.loads(ei.value.read())
+        assert body["error"]["errorName"] == "PROXY_TARGET_ERROR"
+        assert hits["flaky"] == 1
+        assert hits["healthy"] == 0  # the statement was NOT replayed
+    finally:
+        proxy.close()
+        flaky.shutdown()
+        healthy.shutdown()
+
+
 def test_proxy_no_targets_is_clean_error():
     import json
     import urllib.error

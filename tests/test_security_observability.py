@@ -35,17 +35,27 @@ def _catalog():
 class TestPasswordAuthenticator:
     def test_hash_and_check(self):
         line = PasswordAuthenticator.hash_entry("alice", "s3cret")
-        user, salt, digest = line.split(":")
+        user, salt, digest = line.split(":", 2)
+        assert digest.startswith("pbkdf2:")  # no fast hashes in new entries
         auth = PasswordAuthenticator(entries={user: (salt, digest)})
         assert auth.check("alice", "s3cret")
         assert not auth.check("alice", "wrong")
         assert not auth.check("bob", "s3cret")
 
+    def test_legacy_sha256_entry_still_verifies(self):
+        import hashlib
+
+        salt = "ab" * 8
+        digest = hashlib.sha256((salt + "old-pw").encode()).hexdigest()
+        auth = PasswordAuthenticator(entries={"carol": (salt, digest)})
+        assert auth.check("carol", "old-pw")
+        assert not auth.check("carol", "bad")
+
     def test_authenticate_header(self):
         import base64
 
         line = PasswordAuthenticator.hash_entry("alice", "pw")
-        u, s, d = line.split(":")
+        u, s, d = line.split(":", 2)
         auth = PasswordAuthenticator(entries={u: (s, d)})
         hdr = "Basic " + base64.b64encode(b"alice:pw").decode()
         assert auth.authenticate(hdr) == "alice"
@@ -102,7 +112,7 @@ def cluster():
     from presto_tpu.server.worker import Worker
 
     line = PasswordAuthenticator.hash_entry("alice", "pw")
-    u, s, d = line.split(":")
+    u, s, d = line.split(":", 2)
     auth = PasswordAuthenticator(entries={u: (s, d)})
     secret = secrets.token_hex(8)
     coord = Coordinator(_catalog(), min_workers=1, cluster_secret=secret,

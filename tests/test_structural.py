@@ -75,8 +75,19 @@ class TestArrayExpressions:
     def test_contains_position(self, runner):
         df = rows(runner, "select id, contains(arr, 5) as c5, "
                           "array_position(arr, 5) as p5 from t order by id")
-        assert list(df["c5"]) == [False, True, False, False]
-        assert list(df["p5"]) == [0, 2, 0, 0]
+        # row 4 ([7, NULL, 9]): not-found over an array WITH a NULL element
+        # is unknown → NULL, not FALSE/0 (Presto three-valued semantics)
+        assert list(df["c5"][:3]) == [False, True, False]
+        assert df["c5"][3] is None or pd.isna(df["c5"][3])
+        assert list(df["p5"][:3]) == [0, 2, 0]
+        assert df["p5"][3] is None or pd.isna(df["p5"][3])
+
+    def test_contains_found_with_null_element(self, runner):
+        # a HIT is still TRUE/position even when the array has NULLs
+        df = rows(runner, "select contains(arr, 7) as c7, "
+                          "array_position(arr, 9) as p9 from t where id = 4")
+        assert bool(df["c7"][0]) is True
+        assert df["p9"][0] == 3
 
     def test_string_arrays(self, runner):
         df = rows(runner, "select id, contains(tags, 'a') as ha, tags "
