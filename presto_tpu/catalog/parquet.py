@@ -19,6 +19,7 @@ device arrays.
 from __future__ import annotations
 
 import dataclasses
+import json
 import os
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -107,6 +108,49 @@ def write_table(path: str, data: Dict[str, np.ndarray], types: Dict[str, Type],
                    use_dictionary=True, compression="zstd")
 
 
+def write_bucketed_table(directory: str, name: str,
+                         data: Dict[str, np.ndarray],
+                         types: Dict[str, Type],
+                         by: Sequence[str], count: int,
+                         dicts: Optional[Dict[str, Dictionary]] = None,
+                         validity: Optional[Dict[str, np.ndarray]] = None,
+                         row_group_rows: int = 1 << 20):
+    """Write a BUCKETED table: rows hash-partition by content hash of the
+    `by` columns (np_bucket_ids — the SAME hash the spiller and colocated
+    split placement use) into `<name>.buckets/b<i>.parquet` + a
+    _bucketing.json spec. Reference: hive bucketed tables
+    (HiveBucketing.getHiveBucket + ConnectorNodePartitioningProvider) —
+    equal-bucketed joins on the bucket keys skip the shuffle."""
+    import shutil
+
+    from presto_tpu.spiller import np_bucket_ids
+
+    dicts = dicts or {}
+    validity = validity or {}
+    d = os.path.join(directory, f"{name}.buckets")
+    tmp = d + ".tmp"
+    shutil.rmtree(tmp, ignore_errors=True)
+    os.makedirs(tmp)
+    pid = np_bucket_ids(
+        [(np.asarray(data[k]), dicts.get(k), validity.get(k)) for k in by],
+        count)
+    for b in range(count):
+        mask = pid == b
+        bdata = {c: np.ascontiguousarray(np.asarray(v)[mask])
+                 for c, v in data.items()}
+        bvalid = {c: np.asarray(v)[mask] for c, v in validity.items()
+                  if v is not None}
+        arrays, schema = _to_arrow_columns(bdata, types, dicts, bvalid)
+        pq.write_table(pa.Table.from_arrays(arrays, schema=schema),
+                       os.path.join(tmp, f"b{b:05d}.parquet"),
+                       row_group_size=row_group_rows,
+                       use_dictionary=True, compression="zstd")
+    with open(os.path.join(tmp, "_bucketing.json"), "w") as f:
+        json.dump({"by": list(by), "count": int(count)}, f)
+    shutil.rmtree(d, ignore_errors=True)
+    os.replace(tmp, d)
+
+
 def _footer_stats(f: "pq.ParquetFile", col_idx: int, t: Type,
                   ndv=None) -> Optional["ColumnStats"]:
     """CBO column stats from parquet footer metadata: min/max and null
@@ -160,6 +204,10 @@ class _PqTable:
     # where pvals[i] aligns with part_map[i] (engine-native values, None
     # for the NULL partition)
     hive: Optional[dict] = None
+    # bucketed tables (ConnectorNodePartitioningProvider analog):
+    # (key column names, bucket count); bucket_map[vrg] = bucket id
+    bucketing: Optional[tuple] = None
+    bucket_map: Optional[list] = None
 
 
 class ParquetConnector(DeviceSplitCache, Connector):
@@ -295,7 +343,8 @@ class ParquetConnector(DeviceSplitCache, Connector):
         return (os.path.exists(os.path.join(self.directory,
                                             f"{name}.parquet"))
                 or os.path.isdir(self.parts_dir(name))
-                or os.path.isdir(self.hive_dir(name)))
+                or os.path.isdir(self.hive_dir(name))
+                or os.path.isdir(self.buckets_dir(name)))
 
     def _part_files(self, name: str):
         d = self.parts_dir(name)
@@ -391,6 +440,38 @@ class ParquetConnector(DeviceSplitCache, Connector):
         t = _PqTable(d, handle, dicts, num_rows, len(part_map),
                      version=(st.st_mtime_ns, len(parts)),
                      part_map=part_map)
+        self._tables[name] = t
+        return t
+
+    def buckets_dir(self, name: str) -> str:
+        return os.path.join(self.directory, f"{name}.buckets")
+
+    def _load_buckets(self, name: str) -> _PqTable:
+        """Bucketed table: bucket files in id order become the virtual
+        row-group space, each vrg tagged with its bucket (splits carry it
+        as the lifespan id). The handle exposes the bucketing spec so the
+        fragmenter can plan colocated joins."""
+        d = self.buckets_dir(name)
+        with open(os.path.join(d, "_bucketing.json")) as f:
+            spec = json.load(f)
+        count = int(spec["count"])
+        files = [os.path.join(d, f"b{b:05d}.parquet") for b in range(count)]
+        schema, num_rows, rgs, vocab = self._scan_part_files(files)
+        part_map, bucket_map = [], []
+        for b, (p, n_rg) in enumerate(rgs):
+            for rg in range(n_rg):
+                part_map.append((p, rg))
+                bucket_map.append(b)
+        cols, dicts = self._cols_from_schema(schema, vocab)
+        handle = TableHandle(self.name, name, cols,
+                             row_count=float(num_rows),
+                             bucketing=(tuple(spec["by"]), count))
+        st = os.stat(d)
+        t = _PqTable(d, handle, dicts, num_rows, len(part_map),
+                     version=(st.st_mtime_ns, count),
+                     part_map=part_map,
+                     bucketing=(tuple(spec["by"]), count),
+                     bucket_map=bucket_map)
         self._tables[name] = t
         return t
 
@@ -665,6 +746,8 @@ class ParquetConnector(DeviceSplitCache, Connector):
         if not os.path.exists(path):
             if os.path.isdir(self.hive_dir(name)):
                 return self._load_hive(name)
+            if os.path.isdir(self.buckets_dir(name)):
+                return self._load_buckets(name)
             parts = self._part_files(name)
             if parts:
                 return self._load_parts(name, parts)
@@ -744,9 +827,11 @@ class ParquetConnector(DeviceSplitCache, Connector):
                     md = meta_cache[fpath] = pq.ParquetFile(fpath).metadata
                 rg_rows = md.row_group(rg).num_rows
                 subs = max(1, -(-rg_rows // target))
+                bucket = (t.bucket_map[vrg] if t.bucket_map is not None
+                          else None)
                 for s in range(subs):
                     out.append(Split(handle.name, (vrg, s, subs),
-                                     t.num_row_groups))
+                                     t.num_row_groups, bucket=bucket))
             return out
         f = pq.ParquetFile(t.path)
         for rg in range(t.num_row_groups):

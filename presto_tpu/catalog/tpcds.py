@@ -113,6 +113,8 @@ class TpcdsGenerator:
             "s_floor_space": rng.integers(5_000_000, 10_000_001, n),
             "s_state": np.array([["TN", "CA", "TX", "NY", "OH"][i % 5] for i in range(n)], object),
             "s_market_id": rng.integers(1, 11, n),
+            "s_zip": np.array([str(35000 + (i * 97) % 60000)
+                               for i in range(n)], object),
         }
 
     def item(self) -> Dict[str, np.ndarray]:
@@ -522,6 +524,9 @@ class TpcdsGenerator:
             "sr_return_amt": ("raw72", sales_price[ridx] * ret_qty),
             "sr_store_sk": sales["ss_store_sk"][ridx],
         }
+        # drawn LAST so the pre-existing columns' RNG stream is unchanged
+        # (deterministic data must stay stable across additions)
+        sales["ss_sold_time_sk"] = rng.integers(0, 86_400, n)
         return sales, returns
 
 

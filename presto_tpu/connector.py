@@ -52,6 +52,11 @@ class TableHandle:
     # Presto via hidden bucketing metadata; here it is first-class)
     row_count: Optional[float] = None
     primary_key: Optional[List[str]] = None
+    # connector-bucketed partitioning (reference:
+    # ConnectorNodePartitioningProvider / hive bucketed tables):
+    # (key column names, bucket count) — rows are hash(keys) % count
+    # co-partitioned on disk, so equal-bucketed joins skip the shuffle
+    bucketing: Optional[tuple] = None
 
     def column(self, name: str) -> ColumnInfo:
         for c in self.columns:
@@ -63,11 +68,14 @@ class TableHandle:
 @dataclasses.dataclass
 class Split:
     """A unit of scan parallelism (spi/ConnectorSplit). `part` indexes into
-    the table's row partitioning; `total` is the partition count."""
+    the table's row partitioning; `total` is the partition count. `bucket`
+    tags splits of bucketed tables with their bucket id (lifespan) so the
+    scheduler can drive grouped execution (Lifespan.java:26-38)."""
 
     table: str
     part: int
     total: int
+    bucket: Optional[int] = None
 
 
 class Connector:

@@ -188,6 +188,11 @@ class ExecContext:
         # (SOURCE_DISTRIBUTION split placement, statically assigned)
         self.task_index: int = 0
         self.n_tasks: int = 1
+        # grouped (lifespan) execution: when set, scans of bucketed tables
+        # read ONLY this bucket's splits (Lifespan.java:26-38 — the driver
+        # group id); the colocated-join executor sweeps it over the task's
+        # assigned buckets
+        self.lifespan: Optional[int] = None
         # fragment_id -> callable returning an iterator of Batches pulled
         # from the exchange (the ExchangeOperator's client)
         self.remote_sources = None
@@ -472,7 +477,12 @@ def _scan_batches(scan: TableScan, ctx: ExecContext) -> Iterator[Batch]:
             before = len(splits)
             splits = conn.prune_splits(handle, splits, storage_bounds)
             ctx.stats[f"scan.{scan.table}.splits_pruned"] = before - len(splits)
-    if ctx.n_tasks > 1:
+    if ctx.lifespan is not None and any(
+            s.bucket is not None for s in splits):
+        # grouped execution: this pass reads one bucket only; bucket→task
+        # assignment already happened in the lifespan sweep
+        splits = [s for s in splits if s.bucket == ctx.lifespan]
+    elif ctx.n_tasks > 1:
         splits = splits[ctx.task_index::ctx.n_tasks]
     depth = ctx.config.scan_prefetch
     if depth <= 0 or len(splits) <= 1:
@@ -1754,6 +1764,22 @@ def _collect_concat(stream: Iterator[Batch]) -> Optional[Batch]:
 
 def _execute_join(node: HashJoin, ctx: ExecContext) -> Iterator[Batch]:
     from presto_tpu.memory import LocalMemoryContext, batch_device_bytes
+
+    if node.colocated and ctx.lifespan is None:
+        # grouped (lifespan) execution over a colocated bucketed join
+        # (FixedSourcePartitionedScheduler driving lifespans): this task
+        # sweeps its buckets sequentially — each pass builds from ONE
+        # bucket of the build table and probes the SAME bucket of the
+        # probe table, so peak memory is one bucket's build side, and no
+        # exchange ever moves a row. Nested colocated joins execute
+        # within the sweep (ctx.lifespan already set).
+        try:
+            for b in range(ctx.task_index, node.colocated, ctx.n_tasks):
+                ctx.lifespan = b
+                yield from _execute_join(node, ctx)
+        finally:
+            ctx.lifespan = None
+        return
 
     probe_stream, chain = _fused_child(node.left, ctx)
     build_stream = execute_node(node.right, ctx)

@@ -149,9 +149,20 @@ class MeshExecutor:
             out_types = {"__rowcount__": handle.columns[0].type}
         splits = conn.splits(handle, nsplits)
         if sharded:
+            if any(s.bucket is not None for s in splits):
+                # bucketed table: place by bucket id so colocated joins
+                # stay aligned across tables (bucket b of every table
+                # lands on device b % N)
+                per_splits = [
+                    [s for s in splits if s.bucket % self.n_dev == d]
+                    for d in range(self.n_dev)
+                ]
+            else:
+                per_splits = [splits[d::self.n_dev]
+                              for d in range(self.n_dev)]
             per_dev: List[List[Batch]] = [
-                [conn.read_split(s, columns) for s in splits[d::self.n_dev]]
-                for d in range(self.n_dev)
+                [conn.read_split(s, columns) for s in ss]
+                for ss in per_splits
             ]
         else:
             all_b = [conn.read_split(s, columns) for s in splits]

@@ -141,7 +141,8 @@ def node_to_json(n: PlanNode) -> Dict[str, Any]:
                 "lkeys": list(n.left_keys), "rkeys": list(n.right_keys),
                 "residual": (expr_to_json(n.residual)
                              if n.residual is not None else None),
-                "build_unique": n.build_unique}
+                "build_unique": n.build_unique,
+                "colocated": n.colocated}
     if isinstance(n, NestedLoopJoin):
         return {"k": "nljoin",
                 "left": node_to_json(n.left), "right": node_to_json(n.right),
@@ -226,6 +227,7 @@ def node_from_json(d: Dict[str, Any]) -> PlanNode:
             residual=(expr_from_json(d["residual"])
                       if d.get("residual") is not None else None),
             build_unique=bool(d.get("build_unique", False)),
+            colocated=int(d.get("colocated", 0)),
         )
     if k == "nljoin":
         return NestedLoopJoin(

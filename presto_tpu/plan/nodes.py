@@ -190,6 +190,11 @@ class HashJoin(PlanNode):
     residual: Optional[RowExpression] = None
     # planner hint: build side keys are unique (dimension table)
     build_unique: bool = False
+    # colocated bucketed join (ConnectorNodePartitioningProvider /
+    # grouped execution): both sides scan tables bucketed on the join
+    # keys with this bucket count — no exchange; the runtime drives the
+    # join bucket-by-bucket (lifespans). 0 = not colocated.
+    colocated: int = 0
 
     @property
     def output(self):
@@ -382,7 +387,9 @@ def plan_to_string(node: PlanNode, indent: int = 0, node_stats=None) -> str:
         aggs = ", ".join(f"{a.symbol} := {a.fn}({a.arg or '*'})" for a in node.aggs)
         s = f"{pad}Aggregate[{node.step}; keys={node.group_keys}; {aggs}]"
     elif isinstance(node, HashJoin):
-        s = f"{pad}HashJoin[{node.kind}; {node.left_keys} = {node.right_keys}{'; unique' if node.build_unique else ''}]"
+        s = (f"{pad}HashJoin[{node.kind}; {node.left_keys} = "
+             f"{node.right_keys}{'; unique' if node.build_unique else ''}"
+             f"{f'; colocated={node.colocated} buckets' if node.colocated else ''}]")
     elif isinstance(node, SemiJoin):
         s = (f"{pad}SemiJoin[{'NOT ' if node.negated else ''}{node.left_keys} IN "
              f"{node.right_keys}{f'; residual={node.residual}' if node.residual else ''}]")

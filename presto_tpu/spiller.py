@@ -71,6 +71,26 @@ def _strhash_lut(d) -> np.ndarray:
     return d.content_hash_lut()
 
 
+def np_bucket_ids(cols, n_buckets: int) -> np.ndarray:
+    """Row → bucket id over host arrays; cols is a list of
+    (values, dictionary|None, validity|None). THE canonical content hash:
+    the spiller, the bucketed-table writer, and colocated-join split
+    placement must all agree on it (the reference's
+    HiveBucketing.getHiveBucket contract), so bucket b of one table only
+    ever joins bucket b of another."""
+    n = len(cols[0][0])
+    h = np.zeros(n, dtype=np.uint64)
+    for vals, d, validity in cols:
+        v = np.asarray(vals).astype(np.int64)
+        if d is not None:
+            v = _strhash_lut(d)[v + 1]
+        if validity is not None:
+            v = np.where(np.asarray(validity), v, np.int64(-0x61c88647))
+        h = (h * np.uint64(0x9E3779B185EBCA87)) ^ v.astype(np.uint64)
+        h = h ^ (h >> np.uint64(31))
+    return (h % np.uint64(n_buckets)).astype(np.int64)
+
+
 class PartitioningSpiller:
     """Routes batch rows to P per-partition spill files by hash(keys)
     (GenericPartitioningSpiller analog).
@@ -90,18 +110,12 @@ class PartitioningSpiller:
         ]
 
     def _partition_ids(self, batch: Batch) -> np.ndarray:
-        h = np.zeros(batch.capacity, dtype=np.uint64)
-        for k in self.key_names:
-            c = batch.column(k)
-            vals = np.asarray(c.values).astype(np.int64)
-            d = batch.dicts.get(k)
-            if d is not None:
-                vals = _strhash_lut(d)[vals + 1]
-            if c.validity is not None:
-                vals = np.where(np.asarray(c.validity), vals, np.int64(-0x61c88647))
-            h = (h * np.uint64(0x9E3779B185EBCA87)) ^ vals.astype(np.uint64)
-            h = h ^ (h >> np.uint64(31))
-        return (h % np.uint64(self.n_partitions)).astype(np.int64)
+        return np_bucket_ids(
+            [(np.asarray(batch.column(k).values), batch.dicts.get(k),
+              batch.column(k).validity)
+             for k in self.key_names],
+            self.n_partitions,
+        )
 
     def spill(self, batch: Batch):
         pid = self._partition_ids(batch)

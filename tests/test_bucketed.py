@@ -1,0 +1,186 @@
+"""Bucketed tables + colocated joins + grouped (lifespan) execution.
+
+Reference: hive bucketed tables (HiveBucketing.getHiveBucket),
+ConnectorNodePartitioningProvider.java:27 (bucket→node placement),
+Lifespan.java:26-38 + FixedSourcePartitionedScheduler (bucket-by-bucket
+driver groups), PlanFragmenter.java:914 (GroupedExecutionTagger).
+
+TPU-native shape: bucket files are co-partitioned by the engine's content
+hash (the SAME hash the spiller uses), the fragmenter marks equal-bucketed
+joins colocated (no exchange), and the runtime sweeps ctx.lifespan over
+the task's buckets so peak memory is ONE bucket's build side."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from presto_tpu.catalog.parquet import ParquetConnector, write_bucketed_table
+from presto_tpu.connector import Catalog
+from presto_tpu.exec import ExecConfig, LocalRunner
+from presto_tpu.types import BIGINT, DOUBLE, VARCHAR
+
+N_FACT = 60_000
+N_DIM = 8_000
+BUCKETS = 8
+
+
+@pytest.fixture(scope="module")
+def bucketed_dir(tmp_path_factory):
+    d = str(tmp_path_factory.mktemp("bucketed"))
+    rng = np.random.default_rng(31)
+    fact_k = rng.integers(0, N_DIM, N_FACT)
+    fact_v = rng.integers(0, 1000, N_FACT)
+    write_bucketed_table(
+        d, "fact",
+        {"k": fact_k, "v": fact_v},
+        {"k": BIGINT, "v": BIGINT},
+        by=["k"], count=BUCKETS)
+    dim_k = np.arange(N_DIM)
+    dim_w = rng.normal(size=N_DIM)
+    write_bucketed_table(
+        d, "dim",
+        {"k": dim_k, "w": dim_w},
+        {"k": BIGINT, "w": DOUBLE},
+        by=["k"], count=BUCKETS)
+    # unbucketed copies for cross-checks
+    from presto_tpu.catalog.parquet import write_table
+
+    write_table(f"{d}/fact_flat.parquet", {"k": fact_k, "v": fact_v},
+                {"k": BIGINT, "v": BIGINT})
+    write_table(f"{d}/dim_flat.parquet", {"k": dim_k, "w": dim_w},
+                {"k": BIGINT, "w": DOUBLE})
+    return d
+
+
+@pytest.fixture(scope="module")
+def cat(bucketed_dir):
+    c = Catalog()
+    c.register("pq", ParquetConnector(bucketed_dir, name="pq"), default=True)
+    return c
+
+
+JOIN = ("select f.k, sum(f.v) as sv, sum(w) as sw "
+        "from fact f join dim on f.k = dim.k "
+        "group by f.k order by f.k limit 50")
+JOIN_FLAT = JOIN.replace("fact f", "fact_flat f").replace("join dim",
+                                                          "join dim_flat dim")
+
+
+def test_bucketed_scan_roundtrip(cat):
+    r = LocalRunner(cat, ExecConfig(batch_rows=1 << 12))
+    a = r.run("select count(*) as n, sum(v) as s from fact")
+    b = r.run("select count(*) as n, sum(v) as s from fact_flat")
+    assert a.n[0] == b.n[0] == N_FACT
+    assert a.s[0] == b.s[0]
+
+
+def test_handle_exposes_bucketing(cat):
+    h = cat.connectors["pq"].get_table("fact")
+    assert h.bucketing == (("k",), BUCKETS)
+    splits = cat.connectors["pq"].splits(h, 32)
+    assert {s.bucket for s in splits} == set(range(BUCKETS))
+
+
+def test_fragmenter_marks_colocated_no_exchange(cat):
+    from presto_tpu.plan.builder import plan_query
+    from presto_tpu.plan.fragmenter import fragment_plan
+    from presto_tpu.plan.nodes import RemoteSource
+    from presto_tpu.plan.optimizer import optimize
+
+    qp = optimize(plan_query(JOIN, cat))
+    d = fragment_plan(qp, cat)
+
+    def join_frag_has_remote_below_join(n):
+        from presto_tpu.plan.nodes import HashJoin
+
+        if isinstance(n, HashJoin):
+            assert n.colocated == BUCKETS
+            # neither side reaches through an exchange
+            def no_remote(x):
+                assert not isinstance(x, RemoteSource)
+                for c in x.children():
+                    no_remote(c)
+            no_remote(n.left)
+            no_remote(n.right)
+            return True
+        return any(join_frag_has_remote_below_join(c) for c in n.children())
+
+    assert any(join_frag_has_remote_below_join(f.root)
+               for f in d.fragments.values())
+
+
+def test_colocated_answers_match_flat(cat):
+    r = LocalRunner(cat, ExecConfig(batch_rows=1 << 12))
+    a = r.run(JOIN)
+    b = r.run(JOIN_FLAT)
+    assert a.k.tolist() == b.k.tolist()
+    assert a.sv.tolist() == b.sv.tolist()
+    assert all(abs(x - y) < 1e-9 for x, y in zip(a.sw, b.sw))
+
+
+def test_lifespans_bound_join_memory(cat):
+    """The done-criterion: with spilling OFF and a pool too small to hold
+    the whole build side, the colocated (lifespan) join completes while
+    the flat join fails with EXCEEDED_MEMORY_LIMIT."""
+    from presto_tpu.memory import ExceededMemoryLimit
+
+    # dim is ~8k rows × (8B + 8B) ≈ 130KB + batch padding; a 600KB pool
+    # holds ~1 bucket (16KB) + scan batches but not the whole build
+    cfg = ExecConfig(batch_rows=1 << 11, spill_enabled=False,
+                     memory_pool_bytes=600_000)
+    r = LocalRunner(cat, cfg)
+    out = r.run(JOIN)  # bucketed: one bucket in memory at a time
+    assert len(out) == 50
+    with pytest.raises(Exception) as ei:
+        LocalRunner(cat, cfg).run(JOIN_FLAT)
+    assert "memory" in str(ei.value).lower()
+
+
+def test_distributed_colocated_join(cat):
+    from presto_tpu.server.coordinator import DistributedRunner
+
+    dist = DistributedRunner(cat, n_workers=2,
+                             config=ExecConfig(batch_rows=1 << 12))
+    try:
+        a = dist.run(JOIN)
+        b = LocalRunner(cat, ExecConfig(batch_rows=1 << 12)).run(JOIN)
+        assert a.k.tolist() == b.k.tolist()
+        assert a.sv.tolist() == b.sv.tolist()
+    finally:
+        dist.close()
+
+
+def test_string_bucket_keys_hash_by_content(bucketed_dir):
+    """Two tables bucketed on a string key with DIFFERENT dictionaries
+    still co-partition (content hash, not dictionary codes)."""
+    from presto_tpu.dictionary import Dictionary
+
+    d = bucketed_dir
+    rng = np.random.default_rng(7)
+    left_names = np.array([f"user{i}" for i in range(500)], object)
+    lk = left_names[rng.integers(0, 500, 5000)]
+    ld, lcodes = Dictionary.encode(lk)
+    write_bucketed_table(
+        d, "sleft", {"name": lcodes, "x": rng.integers(0, 9, 5000)},
+        {"name": VARCHAR, "x": BIGINT}, by=["name"], count=4,
+        dicts={"name": ld})
+    # right side: a superset vocabulary → different codes for same strings
+    right_names = np.array([f"user{i}" for i in range(700)], object)
+    rd, rcodes = Dictionary.encode(right_names)
+    write_bucketed_table(
+        d, "sright", {"name": rcodes, "y": np.arange(700)},
+        {"name": VARCHAR, "y": BIGINT}, by=["name"], count=4,
+        dicts={"name": rd})
+    c = Catalog()
+    c.register("pq", ParquetConnector(d, name="pq"), default=True)
+    r = LocalRunner(c, ExecConfig(batch_rows=1 << 10))
+    got = r.run("select sum(x * y) as s from sleft l "
+                "join sright rr on l.name = rr.name")
+    # python oracle: replay the same RNG draws
+    name_to_y = {f"user{i}": i for i in range(700)}
+    rngo = np.random.default_rng(7)
+    lk_o = np.array([f"user{i}" for i in range(500)],
+                    object)[rngo.integers(0, 500, 5000)]
+    x_o = rngo.integers(0, 9, 5000)
+    want = int(sum(int(x) * name_to_y[str(nm)] for nm, x in zip(lk_o, x_o)))
+    assert int(got.s[0]) == want
