@@ -251,17 +251,32 @@ def _emit():
         return
     _STATE["emitted"] = True
     extra = _STATE["extra"]
-    for name, ref in (("q1_sf1", _REF["q1"]), ("q6_sf10", _REF["q6"])):
-        r = extra.get(name)
-        if isinstance(r, dict) and "rows_per_sec" in r:
+
+    def by_prefix(prefix, exact):
+        # results are keyed by the sf ACTUALLY run; a downscaled run lands
+        # under e.g. q1_sf0.1 — still surface it (vs_baseline only applies
+        # at the nominal sf)
+        r = extra.get(exact)
+        if isinstance(r, dict):
+            return r, True
+        for k, v in extra.items():
+            if k.startswith(prefix) and isinstance(v, dict):
+                return v, False
+        return {}, False
+
+    for prefix, exact, ref in (("q1_sf", "q1_sf1", _REF["q1"]),
+                               ("q6_sf", "q6_sf10", _REF["q6"])):
+        r, nominal = by_prefix(prefix, exact)
+        if nominal and "rows_per_sec" in r:
             r["vs_baseline"] = round(r["rows_per_sec"] / ref, 3)
-    q1 = extra.get("q1_sf1", {})
-    value = q1.get("rows_per_sec", 0.0) if isinstance(q1, dict) else 0.0
+    q1, q1_nominal = by_prefix("q1_sf", "q1_sf1")
+    value = q1.get("rows_per_sec", 0.0)
     print(json.dumps({
         "metric": "tpch_q1_sf1_rows_per_sec",
         "value": value,
         "unit": "rows/s",
-        "vs_baseline": round(value / _REF["q1"], 3) if value else 0.0,
+        "vs_baseline": (round(value / _REF["q1"], 3)
+                        if value and q1_nominal else 0.0),
         "extra": extra,
     }), flush=True)
 
@@ -337,6 +352,9 @@ def main():
         _, kind, sf, _, _ = _CONFIGS[name]
         sf = sf_over.get(name, sf) if sf is None else sf
         sf = _resolve_sf(kind, sf, remaining)
+        # the artifact key must record the sf ACTUALLY run, not the
+        # config's nominal one (env override / budget downscale)
+        label = f"{name.rsplit('_sf', 1)[0]}_sf{sf:g}"
         cap = _CAPS.get(name, 600)
         if not _dataset_ready(kind, sf):
             # cold cache: the child pays dataset generation (~60 s/SF for
@@ -364,17 +382,17 @@ def main():
                 raise
             lines = out.decode().strip().splitlines()
             if p.returncode == 0 and lines:
-                extra[name] = json.loads(lines[-1])
+                extra[label] = json.loads(lines[-1])
             else:
-                extra[name] = {"error": f"child rc={p.returncode}",
+                extra[label] = {"error": f"child rc={p.returncode}",
                                "sf": sf}
         except subprocess.TimeoutExpired:
             _log(f"{name}: TIMEOUT after {cap:.0f}s cap — moving on")
-            extra[name] = {"error": f"timeout after {cap:.0f}s cap",
+            extra[label] = {"error": f"timeout after {cap:.0f}s cap",
                            "sf": sf}
         except Exception as e:
             _log(f"{name}: FAILED {type(e).__name__}: {e}")
-            extra[name] = {"error": f"{type(e).__name__}: {e}"}
+            extra[label] = {"error": f"{type(e).__name__}: {e}"}
         finally:
             _STATE["child"] = None
         _checkpoint()

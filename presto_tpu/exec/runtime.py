@@ -110,6 +110,9 @@ class ExecConfig:
     agg_pipeline_depth: int = 3
     topn_slack: int = 4
     join_out_capacity: Optional[int] = None  # default: probe batch capacity
+    # coalesce sparse join output batches before downstream operators
+    # (MergingPageOutput analog; see _merging_output)
+    merge_sparse_output: bool = True
     max_growth_retries: int = 24
     # EXPLAIN ANALYZE: per-operator wall/rows/batches accounting (forces a
     # device sync per batch — off in production, like Presto's verbose stats)
@@ -193,6 +196,10 @@ class ExecContext:
         # group id); the colocated-join executor sweeps it over the task's
         # assigned buckets
         self.lifespan: Optional[int] = None
+        # total lifespans of the active grouped-execution sweep (None when
+        # not sweeping): lets operators size per-bucket state (a bucket
+        # holds ~1/lifespans of the groups) and run memory-tight
+        self.lifespans: Optional[int] = None
         # fragment_id -> callable returning an iterator of Batches pulled
         # from the exchange (the ExchangeOperator's client)
         self.remote_sources = None
@@ -333,12 +340,102 @@ def execute_node(node: PlanNode, ctx: ExecContext) -> Iterator[Batch]:
     stream = _execute_base(base, ctx)
     if ctx.config.collect_stats:
         stream = _instrumented(stream, base, ctx)
-    if down is None:
-        yield from stream
-    else:
+    if down is not None:
         jfn = _node_jit(node, "down", lambda: down)
-        for b in stream:
-            yield jfn(b)
+        stream = (jfn(b) for b in stream)
+    if ctx.config.merge_sparse_output and isinstance(
+            base, (HashJoin, SemiJoin, NestedLoopJoin)):
+        # selective operators emit batches at probe CAPACITY whose live
+        # occupancy can be ~1%; every downstream per-batch cost (sorts,
+        # merges, probes) is capacity-shaped, so coalesce before fanning
+        # out (reference: operator/project/MergingPageOutput.java)
+        stream = _merging_output(stream, ctx.config.batch_rows)
+    yield from stream
+
+
+def _pad_batch(b: Batch, cap: int) -> Batch:
+    """Pad rows with dead lanes up to cap (keeps capacities power-of-two
+    so downstream per-shape jit caches stay bounded)."""
+    extra = cap - b.capacity
+    if extra <= 0:
+        return b
+
+    def padp(p, fill=0):
+        if p is None:
+            return None
+        widths = [(0, extra)] + [(0, 0)] * (p.ndim - 1)
+        return jnp.pad(p, widths, constant_values=fill)
+
+    cols = [
+        Column(padp(c.values),
+               padp(c.validity, False),
+               padp(c.hi), padp(c.sizes), padp(c.evalid, False),
+               padp(c.keys))
+        for c in b.columns
+    ]
+    return Batch(b.names, b.types, cols, padp(b.live, False), b.dicts)
+
+
+def _merging_output(stream: Iterator[Batch], target_cap: int) -> Iterator[Batch]:
+    """MergingPageOutput analog: compact sparse batches (live rows to the
+    front), slice them to their power-of-two bucket, and concatenate until
+    a full batch accumulates. Dense batches pass through untouched; empty
+    batches are dropped. Costs one host sync per input batch (num_live) —
+    repaid many times over by the capacity-shaped work it removes
+    downstream on selective multi-join plans."""
+    pending: List[Batch] = []
+    pending_live = 0
+
+    def flush():
+        nonlocal pending, pending_live
+        if len(pending) == 1:
+            out = pending[0]
+        else:
+            out = _collect_concat(iter(pending))
+            # concat of mixed pow2 slices is no longer pow2 itself —
+            # re-bucket so downstream programs see a bounded shape set
+            out = _pad_batch(out, round_up_capacity(out.capacity))
+        pending, pending_live = [], 0
+        return out
+
+    def consume(b, n):
+        nonlocal pending_live
+        if n == 0:
+            return None
+        if 2 * n >= b.capacity:
+            return b  # dense: pass through (flushing pending first)
+        pending.append(_truncate(_JIT_COMPACT(b), round_up_capacity(n)))
+        pending_live += n
+        return None
+
+    # one-batch lookahead: the live count is dispatched and fetched
+    # asynchronously while the NEXT batch computes, so dense streams don't
+    # pay a blocking device→host sync per batch (same optimistic pattern
+    # as the aggregate's dispatch window)
+    window: List[Tuple[Batch, "jnp.ndarray"]] = []
+
+    def drain(block_all: bool):
+        while window and (block_all or len(window) > 1):
+            b, cnt = window.pop(0)
+            dense = consume(b, int(cnt))
+            if dense is not None:
+                if pending:
+                    yield flush()
+                yield dense
+            elif pending_live >= target_cap:
+                yield flush()
+
+    for b in stream:
+        cnt = jnp.sum(b.live)
+        try:
+            cnt.copy_to_host_async()
+        except Exception:
+            pass
+        window.append((b, cnt))
+        yield from drain(block_all=False)
+    yield from drain(block_all=True)
+    if pending:
+        yield flush()
 
 
 def _instrumented(stream: Iterator[Batch], node: PlanNode, ctx: ExecContext):
@@ -364,6 +461,11 @@ def _fused_child(node: PlanNode, ctx: ExecContext):
     stream = _execute_base(base, ctx)
     if ctx.config.collect_stats:
         stream = _instrumented(stream, base, ctx)
+    if ctx.config.merge_sparse_output and isinstance(
+            base, (HashJoin, SemiJoin, NestedLoopJoin)):
+        # breakers pull children through here, not execute_node — apply
+        # the same sparse-output coalescing before the consumer's chain
+        stream = _merging_output(stream, ctx.config.batch_rows)
     return stream, (up or (lambda b: b))
 
 
@@ -1080,8 +1182,66 @@ class _GraceOverflow(Exception):
         self.entries = entries
 
 
+def _grouped_execution_lifespans(node: Aggregate) -> int:
+    """GroupedExecutionTagger (reference PlanFragmenter.java:914): when every
+    group key traces — through streaming Filter/Project identity refs — down
+    to a colocated bucketed join whose preserved-side join keys the group
+    keys cover, every group's rows live inside ONE bucket (bucket =
+    content-hash of those keys), so the WHOLE agg-over-join pipeline can run
+    lifespan-by-lifespan: build one bucket, probe it, aggregate it, finalize
+    and RELEASE it. Returns the bucket count, or 0 when not applicable."""
+    from presto_tpu.expr.ir import InputRef
+
+    keys = set(node.group_keys)
+    if not keys:
+        return 0
+    n = node.child
+    while True:
+        if isinstance(n, Filter):
+            n = n.child
+        elif isinstance(n, Project):
+            m = dict(n.exprs)
+            mapped = set()
+            for k in keys:
+                e = m.get(k)
+                if not isinstance(e, InputRef):
+                    return 0  # computed key — can't trace to a bucket column
+                mapped.add(e.name)
+            keys = mapped
+            n = n.child
+        elif isinstance(n, HashJoin) and n.colocated:
+            # NULL-extended rows of an outer join carry NULL keys on the
+            # non-preserved side and would scatter one NULL group across
+            # buckets — only the preserved side's keys qualify (RIGHT is
+            # canonicalized to left-with-swapped-sides at plan time, so
+            # kind here is only ever inner/left/full)
+            if set(n.left_keys) <= keys and n.kind in ("inner", "left"):
+                return n.colocated
+            if set(n.right_keys) <= keys and n.kind == "inner":
+                return n.colocated
+            return 0
+        else:
+            return 0
+
+
 def _execute_aggregate(node: Aggregate, ctx: ExecContext) -> Iterator[Batch]:
     from presto_tpu.plan.agg_states import state_types as _layout_state_types
+
+    if ctx.lifespan is None:
+        ls = _grouped_execution_lifespans(node)
+        if ls:
+            # grouped execution covers the aggregation too: sweep the
+            # task's buckets with the sweep rooted HERE so each bucket's
+            # accumulator is finalized and freed before the next builds
+            try:
+                ctx.lifespans = ls
+                for b in range(ctx.task_index, ls, ctx.n_tasks):
+                    ctx.lifespan = b
+                    yield from _execute_aggregate(node, ctx)
+            finally:
+                ctx.lifespan = None
+                ctx.lifespans = None
+            return
 
     if any(a.fn in _NON_DECOMPOSABLE_FNS for a in node.aggs):
         if node.step != "single":
@@ -1258,7 +1418,12 @@ def _execute_aggregate(node: Aggregate, ctx: ExecContext) -> Iterator[Batch]:
         except Exception:
             _st = None
         if _st is not None and _st.rows:
-            want = round_up_capacity(int(min(_st.rows * 1.25, float(1 << 23))))
+            rows = _st.rows
+            if ctx.lifespans:
+                # grouped execution: one bucket holds ~1/lifespans of the
+                # groups — size the table for a bucket, not the table
+                rows = rows / ctx.lifespans
+            want = round_up_capacity(int(min(rows * 1.25, float(1 << 23))))
             cap = max(cap, want)
     # Past the ceiling a fixed-capacity table stops being the right tool
     # (every merge sorts `capacity + batch` rows, nearly all of them dead):
@@ -1412,11 +1577,11 @@ def _execute_aggregate(node: Aggregate, ctx: ExecContext) -> Iterator[Batch]:
 
             for b in stream:
                 dispatch(b)
-                # while replaying spilled partitions (allow_spill=False) run
-                # synchronously: the optimistic window pins ~3× the
-                # accumulator footprint, which is exactly what the memory-
-                # constrained finalize phase cannot afford
-                confirm(block=not allow_spill)
+                # while replaying spilled partitions (allow_spill=False) or
+                # sweeping lifespans run synchronously: the optimistic
+                # window pins ~3× the accumulator footprint, which is
+                # exactly what the memory-bounded modes cannot afford
+                confirm(block=not allow_spill or ctx.lifespans is not None)
                 # account EVERYTHING the optimistic window pins on device:
                 # the live accumulator plus each unconfirmed checkpoint and
                 # its input batch — otherwise spill/revoke fires ~depth×
@@ -2644,6 +2809,15 @@ def run_plan(qp: QueryPlan, ctx: ExecContext) -> Batch:
             t = sub_out.types[0]
             bindings[sym] = Constant(t, vals[0], raw=True)
         _bind_plan_params(qp.root, bindings)
+
+    # local grouped execution: mark bucket-colocated joins so the executor
+    # sweeps them lifespan-by-lifespan (the fragmenter does this for the
+    # distributed path); tagged once — cached plans skip the re-walk
+    if not qp.__dict__.get("_colocated_tagged"):
+        from presto_tpu.plan.fragmenter import tag_colocated_joins
+
+        tag_colocated_joins(qp.root, ctx.catalog)
+        qp.__dict__["_colocated_tagged"] = True
 
     out_node = qp.root
     batches = list(execute_node(out_node.child, ctx))

@@ -8,8 +8,8 @@ one pass, sized to the executed SQL surface:
 - scopes resolve (qualifier, column) → unique plan symbols
 - expressions lower to the typed IR with implicit coercions and exact
   decimal scale/precision rules (add/sub align scales via casts; mul adds
-  scales; div promotes to DOUBLE — a documented deviation from Presto's
-  exact decimal division)
+  scales; div is exact with Presto's result scale and HALF_UP rounding —
+  expr/compile._decimal_div)
 - aggregates are extracted and planned as pre-Project → Aggregate →
   post-Project (the reference's QueryPlanner.aggregate path)
 - comma-FROM + WHERE equi-conjuncts become a greedy size-heuristic join
@@ -1547,6 +1547,23 @@ class Planner:
         remaining = list(leaves)
         pending = list(conjs)
         est = {id(l): leaf_estimate(l, pending) for l in remaining}
+
+        # DP plan enumeration (ReorderJoins.java:94 — there a memo over
+        # MultiJoinNode partitions, here bushy DP over connected subsets)
+        # when the join graph is connected and small enough. Cost model:
+        # Σ per join (probe_rows + 2·build_rows + out_rows) — probing is a
+        # stream pass, building sorts (≈2×), output rows feed the parent.
+        # The greedy below remains the fallback (disconnected graphs, >10
+        # relations), deliberately starting from the fact table; DP instead
+        # can discover plans like (customer⋈orders)⋈lineitem where the big
+        # fact relation flows through ONE join against a pre-reduced build.
+        if 2 <= len(leaves) <= 10:
+            dp_out = self._dp_join_order(leaves, pending, est,
+                                         join_out_estimate)
+            if dp_out is not None:
+                node, pending = dp_out
+                return node, scope, pending
+
         # start from the largest relation (likely the fact table → probe side)
         remaining.sort(key=lambda r: -est[id(r)][0])
         current = remaining.pop(0)
@@ -1612,6 +1629,134 @@ class Planner:
                                    rows=out_rows)
         # apply any conjunct that is now fully covered; keep the rest as residuals
         return current.node, scope, pending
+
+    def _notnull_side(self, node: PlanNode, keys: List[str]) -> PlanNode:
+        """IS NOT NULL inference (reference: the predicate-inference half of
+        optimizations/PredicatePushDown — inner-join equi keys can't match
+        NULL, so null rows are droppable BEFORE the join). Skipped when
+        stats prove the column never null (filter would be a no-op)."""
+        from presto_tpu.plan.stats import derive
+
+        try:
+            st = derive(node, self.catalog)
+        except Exception:
+            st = None
+        types = dict(node.output)
+        conjs = []
+        for k in keys:
+            cs = st.col(k) if st is not None else None
+            if cs is not None and cs.null_fraction == 0.0:
+                continue
+            conjs.append(Call(BOOLEAN, "is_not_null",
+                              (InputRef(types[k], k),)))
+        if not conjs:
+            return node
+        return Filter(node, combine_conjuncts(conjs))
+
+    def _dp_join_order(self, leaves, conjs, est, join_out_estimate):
+        """Bushy dynamic-programming join enumeration over connected
+        subsets. Returns (root PlanNode, leftover conjuncts) or None when
+        the join graph is disconnected (caller falls back to the greedy
+        path, which handles cross products)."""
+        from presto_tpu.plan.stats import NodeStats
+
+        n = len(leaves)
+        syms = [frozenset(f.symbol for f in l.scope.fields) for l in leaves]
+        full = (1 << n) - 1
+
+        def mask_syms(mask):
+            s = set()
+            for i in range(n):
+                if mask >> i & 1:
+                    s |= syms[i]
+            return s
+
+        # connectivity over equi edges (cross-join elimination: DP only
+        # combines subsets an equi conjunct connects)
+        parent = list(range(n))
+
+        def find(x):
+            while parent[x] != x:
+                parent[x] = parent[parent[x]]
+                x = parent[x]
+            return x
+
+        for i in range(n):
+            for j in range(i + 1, n):
+                lk, _, _ = _extract_equi_keys(conjs, syms[i], syms[j])
+                if lk:
+                    parent[find(i)] = find(j)
+        if len({find(i) for i in range(n)}) != 1:
+            return None
+
+        # dp[mask] = (cost, rows, stats, repr) where repr is a leaf index
+        # or (maskA, maskB) with A the probe (larger) side
+        dp = {}
+        for i, leaf in enumerate(leaves):
+            rows, st = est[id(leaf)]
+            dp[1 << i] = (0.0, rows, st, i)
+        msyms = {1 << i: syms[i] for i in range(n)}
+
+        for mask in range(3, full + 1):
+            if mask in dp or bin(mask).count("1") < 2:
+                continue
+            best = None
+            sub = (mask - 1) & mask
+            while sub:
+                other = mask ^ sub
+                if sub < other:  # each unordered split once
+                    a, b = dp.get(sub), dp.get(other)
+                    if a is not None and b is not None:
+                        sa = msyms.get(sub)
+                        if sa is None:
+                            sa = msyms[sub] = frozenset(mask_syms(sub))
+                        sb = msyms.get(other)
+                        if sb is None:
+                            sb = msyms[other] = frozenset(mask_syms(other))
+                        lk, rk, _ = _extract_equi_keys(conjs, sa, sb)
+                        if lk:
+                            out = join_out_estimate(a[1], a[2], lk,
+                                                    b[1], b[2], rk)
+                            probe, build = max(a[1], b[1]), min(a[1], b[1])
+                            cost = (a[0] + b[0] + probe + 2.0 * build + out)
+                            if best is None or cost < best[0]:
+                                pa, pb = ((sub, other) if a[1] >= b[1]
+                                          else (other, sub))
+                                merged = {}
+                                for st in (a[2], b[2]):
+                                    if st is not None:
+                                        merged.update(st.columns)
+                                best = (cost, out,
+                                        NodeStats(out, merged), (pa, pb))
+                sub = (sub - 1) & mask
+            if best is not None:
+                dp[mask] = best
+        if full not in dp:
+            return None
+
+        pending = list(conjs)
+
+        def build_tree(mask):
+            entry = dp[mask]
+            if isinstance(entry[3], int):
+                leaf = leaves[entry[3]]
+                return leaf.node, msyms[mask]
+            pa, pb = entry[3]
+            lnode, lsyms = build_tree(pa)
+            rnode, rsyms = build_tree(pb)
+            nonlocal pending
+            lk, rk, pending = _extract_equi_keys(pending, lsyms, rsyms)
+            node = HashJoin(
+                kind="inner",
+                left=self._notnull_side(lnode, lk),
+                right=self._notnull_side(rnode, rk),
+                left_keys=lk, right_keys=rk,
+                build_unique=_derives_unique(rnode, rk),
+            )
+            return node, msyms.setdefault(mask, frozenset(mask_syms(mask)))
+
+        root, _ = build_tree(full)
+        return root, pending
 
     # -- semi joins -------------------------------------------------------
 

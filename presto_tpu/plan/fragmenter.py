@@ -89,86 +89,102 @@ class DistributedPlan:
         return "\n".join(parts)
 
 
-class _Fragmenter:
-    def _scan_bucketing(self, node):
-        """Resolve a scan-chain subtree (Filter/Project over TableScan,
-        projects restricted to pure renames) to its table's bucketing:
-        returns (symbol→bucket-position map, count) or None. Nested
-        colocated joins extend the chain: a join already marked colocated
-        with the same spec exposes its probe side's mapping."""
-        from presto_tpu.plan.nodes import (
-            Filter,
-            Project,
-            TableScan,
-        )
-        from presto_tpu.expr.ir import InputRef
+def scan_bucketing(node, catalog):
+    """Resolve a scan-chain subtree (Filter/Project over TableScan,
+    projects restricted to pure renames) to its table's bucketing:
+    returns (symbol→bucket-position map, count, n_bucket_cols) or None.
+    Nested colocated joins extend the chain: a join already marked
+    colocated with the same spec exposes its probe side's mapping."""
+    from presto_tpu.expr.ir import InputRef
 
-        rename: dict = {}
-        cur = node
-        while True:
-            if isinstance(cur, Filter):
-                cur = cur.child
-                continue
-            if isinstance(cur, Project):
-                nxt = {}
-                for sym, e in cur.exprs:
-                    if isinstance(e, InputRef):
-                        nxt[sym] = e.name
-                    # computed columns can't be bucket keys but don't
-                    # disqualify the chain
-                cur = cur.child
-                rename = {s: rename.get(c, c) for s, c in nxt.items()} \
-                    if rename else nxt
-                continue
-            break
-        if isinstance(cur, HashJoin) and cur.colocated:
-            inner = self._scan_bucketing(cur.left)
-            if inner is None:
-                return None
-            pos, count, nb = inner
-            if rename:
-                pos = {s: pos[c] for s, c in rename.items() if c in pos}
-            return (pos, count, nb) if pos else None
-        if not isinstance(cur, TableScan):
+    rename: dict = {}
+    cur = node
+    while True:
+        if isinstance(cur, Filter):
+            cur = cur.child
+            continue
+        if isinstance(cur, Project):
+            nxt = {}
+            for sym, e in cur.exprs:
+                if isinstance(e, InputRef):
+                    nxt[sym] = e.name
+                # computed columns can't be bucket keys but don't
+                # disqualify the chain
+            cur = cur.child
+            rename = {s: rename.get(c, c) for s, c in nxt.items()} \
+                if rename else nxt
+            continue
+        break
+    if isinstance(cur, HashJoin) and cur.colocated:
+        inner = scan_bucketing(cur.left, catalog)
+        if inner is None:
             return None
-        if self.catalog is None:
-            return None
-        try:
-            handle = self.catalog.connectors[cur.catalog].get_table(cur.table)
-        except Exception:
-            return None
-        if handle.bucketing is None:
-            return None
-        bcols, count = handle.bucketing
-        col_pos = {c: i for i, c in enumerate(bcols)}
-        pos = {}
-        for sym, col in cur.assignments.items():
-            if col in col_pos:
-                pos[sym] = col_pos[col]
-        if len(pos) != len(bcols):
-            return None
+        pos, count, nb = inner
         if rename:
             pos = {s: pos[c] for s, c in rename.items() if c in pos}
-        return (pos, count, len(bcols)) if pos else None
+        return (pos, count, nb) if pos else None
+    if not isinstance(cur, TableScan):
+        return None
+    if catalog is None:
+        return None
+    try:
+        handle = catalog.connectors[cur.catalog].get_table(cur.table)
+    except Exception:
+        return None
+    if handle.bucketing is None:
+        return None
+    bcols, count = handle.bucketing
+    col_pos = {c: i for i, c in enumerate(bcols)}
+    pos = {}
+    for sym, col in cur.assignments.items():
+        if col in col_pos:
+            pos[sym] = col_pos[col]
+    if len(pos) != len(bcols):
+        return None
+    if rename:
+        pos = {s: pos[c] for s, c in rename.items() if c in pos}
+    return (pos, count, len(bcols)) if pos else None
+
+
+def colocated_buckets(node, catalog) -> int:
+    """Bucket count when this join can run colocated: both sides'
+    tables bucketed with equal counts, and for EVERY bucket-key
+    position there is a join equi-pair mapping to it on BOTH sides
+    (HiveBucketing: same hash + same count ⇒ same bucket)."""
+    lb = scan_bucketing(node.left, catalog)
+    rb = scan_bucketing(node.right, catalog)
+    if lb is None or rb is None:
+        return 0
+    (lpos, lcount, lnb), (rpos, rcount, rnb) = lb, rb
+    if lcount != rcount or lnb != rnb:
+        return 0
+    covered = set()
+    for lk, rk in zip(node.left_keys, node.right_keys):
+        pl, pr = lpos.get(lk), rpos.get(rk)
+        if pl is not None and pl == pr:
+            covered.add(pl)
+    return lcount if covered == set(range(lnb)) else 0
+
+
+def tag_colocated_joins(node: PlanNode, catalog) -> None:
+    """Mark bucket-colocated joins on a plan executed WITHOUT fragmentation
+    (LocalRunner / a single-task fragment): the GroupedExecutionTagger
+    analog for local execution. Bottom-up so nested colocated joins chain.
+    The runtime's lifespan sweep (exec/runtime._execute_join /
+    _execute_aggregate) then drives these bucket-by-bucket, bounding peak
+    memory to one bucket's build side."""
+    for c in node.children():
+        tag_colocated_joins(c, catalog)
+    if isinstance(node, HashJoin) and not node.colocated:
+        node.colocated = colocated_buckets(node, catalog)
+
+
+class _Fragmenter:
+    def _scan_bucketing(self, node):
+        return scan_bucketing(node, self.catalog)
 
     def _colocated_buckets(self, node) -> int:
-        """Bucket count when this join can run colocated: both sides'
-        tables bucketed with equal counts, and for EVERY bucket-key
-        position there is a join equi-pair mapping to it on BOTH sides
-        (HiveBucketing: same hash + same count ⇒ same bucket)."""
-        lb = self._scan_bucketing(node.left)
-        rb = self._scan_bucketing(node.right)
-        if lb is None or rb is None:
-            return 0
-        (lpos, lcount, lnb), (rpos, rcount, rnb) = lb, rb
-        if lcount != rcount or lnb != rnb:
-            return 0
-        covered = set()
-        for lk, rk in zip(node.left_keys, node.right_keys):
-            pl, pr = lpos.get(lk), rpos.get(rk)
-            if pl is not None and pl == pr:
-                covered.add(pl)
-        return lcount if covered == set(range(lnb)) else 0
+        return colocated_buckets(node, self.catalog)
 
     def __init__(self, catalog, broadcast_threshold_rows: float, stats_fn=None):
         self.fragments: Dict[int, Fragment] = {}
