@@ -192,8 +192,11 @@ def _resolve_sf(kind: str, sf: float, remaining: float) -> float:
 
 # ---------------------------------------------------------------- child ----
 
-def _child(name: str, sf: float):
-    """Run ONE config in this process; print a single JSON result line."""
+def _child(name: str, sf: float, cap_s: float = 0.0):
+    """Run ONE config in this process; print a single JSON result line.
+    `cap_s` is the parent's kill deadline: once one timed run landed,
+    further runs are skipped if they might not fit — ONE number inside
+    the cap beats the best of three outside it."""
     sql, kind, _, driving_table, over = _CONFIGS[name]
     if os.environ.get("BENCH_FORCE_CPU"):
         import jax
@@ -228,6 +231,10 @@ def _child(name: str, sf: float):
     _log(f"{name}: warmup (compile + cache fill) {warm_s}s")
     times = []
     for _ in range(runs):
+        if times and cap_s and (
+                time.time() - _T0 + max(times) > cap_s * 0.85):
+            _log(f"{name}: skipping remaining runs (cap {cap_s:.0f}s)")
+            break
         t0 = time.perf_counter()
         out = runner.run_batch(sql)
         out.num_live()  # block on device completion
@@ -316,7 +323,8 @@ def _probe_device() -> bool:
 
 def main():
     if len(sys.argv) >= 4 and sys.argv[1] == "--child":
-        _child(sys.argv[2], float(sys.argv[3]))
+        _child(sys.argv[2], float(sys.argv[3]),
+               float(sys.argv[4]) if len(sys.argv) > 4 else 0.0)
         return
 
     signal.signal(signal.SIGTERM, _on_term)
@@ -371,7 +379,7 @@ def main():
         try:
             p = subprocess.Popen(
                 [sys.executable, os.path.abspath(__file__),
-                 "--child", name, str(sf)],
+                 "--child", name, str(sf), str(cap)],
                 env=env, stdout=subprocess.PIPE, stderr=None)
             _STATE["child"] = p
             try:

@@ -17,7 +17,13 @@ except ImportError:  # pandas is effectively always present; stay importable
     pd = None
 
 from presto_tpu.batch import Batch, round_up_capacity
-from presto_tpu.connector import ColumnInfo, Connector, Split, TableHandle
+from presto_tpu.connector import (
+    ColumnInfo,
+    Connector,
+    ConnectorIndex,
+    Split,
+    TableHandle,
+)
 from presto_tpu.dictionary import Dictionary
 from presto_tpu.types import (
     BIGINT,
@@ -192,8 +198,12 @@ def _encode_structural(col: str, arr: np.ndarray, t: Type, dicts: dict):
 class MemoryTable:
     def __init__(self, name: str, data: Dict[str, np.ndarray],
                  types: Optional[Dict[str, Type]] = None,
-                 primary_key: Optional[List[str]] = None):
+                 primary_key: Optional[List[str]] = None,
+                 index_keys: Optional[List[List[str]]] = None):
         self.name = name
+        # extra keyed-lookup column sets beyond the primary key
+        # (ConnectorIndex SPI; see MemoryConnector.get_index)
+        self.index_keys = [list(k) for k in (index_keys or [])]
         self.types: Dict[str, Type] = {}
         self.arrays: Dict[str, np.ndarray] = {}
         self.validity: Dict[str, Optional[np.ndarray]] = {}
@@ -376,7 +386,8 @@ class MemoryConnector(DeviceSplitCache, Connector):
         self.tables: Dict[str, MemoryTable] = {}
         self._init_split_cache()
 
-    def add_table(self, name: str, data, types=None, primary_key=None):
+    def add_table(self, name: str, data, types=None, primary_key=None,
+                  index_keys=None):
         import pandas as pd
 
         if isinstance(data, pd.DataFrame):
@@ -391,7 +402,8 @@ class MemoryConnector(DeviceSplitCache, Connector):
                 else:
                     cols[c] = s.to_numpy()
             data = cols
-        self.tables[name] = MemoryTable(name, data, types, primary_key)
+        self.tables[name] = MemoryTable(name, data, types, primary_key,
+                                        index_keys=index_keys)
         self.invalidate_cache(name)
 
     def add_generated(self, name: str, data: Dict[str, object],
@@ -427,6 +439,21 @@ class MemoryConnector(DeviceSplitCache, Connector):
         if name not in self.tables:
             raise KeyError(f"table not found: {name}")
         return self.tables[name].handle(self.name)
+
+    def get_index(self, handle, key_columns):
+        """Keyed lookup over an EXPLICITLY declared index key set
+        (reference: presto-tests IndexedTpchPlugin's fake connector
+        indexes — here real, backed by a host hash map). Deliberately NOT
+        implied by primary_key: exposing an index makes the planner prefer
+        per-batch lookups over a hash build, which only pays off on tables
+        designed for point access."""
+        t = self.tables.get(handle.name)
+        if t is None:
+            return None
+        if any(set(key_columns) == set(k)
+               for k in getattr(t, "index_keys", [])):
+            return _MemoryIndex(t, list(key_columns))
+        return None
 
     def splits(self, handle: TableHandle, desired: int = 1) -> List[Split]:
         return [Split(handle.name, i, desired) for i in range(desired)]
@@ -661,3 +688,89 @@ class MemoryConnector(DeviceSplitCache, Connector):
             if c + "#keys" in t.dicts:
                 dicts[c + "#keys"] = t.dicts[c + "#keys"]
         return Batch(names, types, cols, live, dicts)
+
+
+class _MemoryIndex(ConnectorIndex):
+    """Host hash map: decoded key tuple → row positions. The lookup
+    materializes only the matching rows as one Batch (reference:
+    operator/index/IndexLoader.java — streamed probe keys load an
+    index snapshot instead of the whole table)."""
+
+    def __init__(self, table: MemoryTable, key_columns):
+        self.t = table
+        self.keys = key_columns
+        self._map = None
+
+    def _decoded(self, col: str) -> np.ndarray:
+        arr = self.t.arrays[col]
+        d = self.t.dicts.get(col)
+        if d is not None:
+            return np.asarray(d.values, dtype=object)[arr]
+        return arr
+
+    def _ensure_map(self):
+        if self._map is not None:
+            return
+        cols = [self._decoded(c) for c in self.keys]
+        n = len(cols[0])
+        valid = np.ones(n, dtype=bool)
+        for c in self.keys:
+            v = self.t.validity.get(c)
+            if v is not None:
+                valid &= v
+        m: dict = {}
+        for i in np.nonzero(valid)[0]:
+            k = tuple(col[i] for col in cols)
+            m.setdefault(k, []).append(int(i))
+        self._map = m
+
+    def lookup(self, keys, columns, capacity=None) -> Batch:
+        self._ensure_map()
+        t = self.t
+        for c in columns:
+            if c in t.struct:
+                raise NotImplementedError(
+                    "index lookup over structural columns")
+        probe = [np.asarray(keys[c]) for c in self.keys]
+        pos: list = []
+        seen = set()
+        for row in zip(*probe):
+            k = tuple(x.item() if hasattr(x, "item") else x for x in row)
+            if k in seen:
+                continue
+            seen.add(k)
+            pos.extend(self._map.get(k, ()))
+        pos = np.asarray(sorted(pos), dtype=np.int64)
+        data = {c: t.arrays[c][pos] for c in columns}
+        cap = capacity or round_up_capacity(max(len(pos), 1))
+        b = Batch.from_numpy(
+            data, {c: t.types[c] for c in columns},
+            dicts={c: t.dicts[c] for c in columns if c in t.dicts},
+            capacity=cap)
+        if len(pos) == 0:
+            b = b.with_live(np.zeros(cap, dtype=bool))
+        import jax.numpy as jnp
+
+        from presto_tpu.batch import Column
+
+        for c in columns:
+            v = t.validity.get(c)
+            h = t.hi.get(c)
+            if v is None and h is None:
+                continue
+            col = b.column(c)
+            vcol = col.validity
+            if v is not None:
+                pad = np.zeros(b.capacity, dtype=bool)
+                pad[: len(pos)] = v[pos]
+                vcol = jnp.asarray(pad)
+            hcol = None
+            if h is not None:
+                hpad = np.zeros(b.capacity, dtype=np.int64)
+                hpad[: len(pos)] = h[pos]
+                hcol = jnp.asarray(hpad)
+            idx = b.names.index(c)
+            cols2 = list(b.columns)
+            cols2[idx] = Column(col.values, vcol, hcol)
+            b = Batch(b.names, b.types, cols2, b.live, b.dicts)
+        return b

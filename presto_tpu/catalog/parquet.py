@@ -222,6 +222,8 @@ class ParquetConnector(DeviceSplitCache, Connector):
       re-runs pay only host→device transfer."""
 
     host_cache_bytes: int = 48 << 30
+    # staging dirs untouched this long are reclaimable (SIGKILL'd writer)
+    stale_staging_s: float = 3600.0
 
     def __init__(self, directory: str, name: str = "parquet"):
         import threading
@@ -297,10 +299,36 @@ class ParquetConnector(DeviceSplitCache, Connector):
                 return False
             raise ValueError(f"table already exists: {name}")
         staging = self.parts_dir(name, staging=True)
-        import shutil
+        for attempt in (0, 1):
+            try:
+                # EXCLUSIVE create: two racing CTAS must not share a
+                # staging dir (the loser would interleave its parts into
+                # the winner's commit). mkdir is the atomic mutual-
+                # exclusion primitive — the metadata-transaction role of
+                # TransactionManager + HiveMetadata begin/finishCreate.
+                os.makedirs(staging, exist_ok=False)
+                return True
+            except FileExistsError:
+                # staleness recovery: a SIGKILL'd writer never aborts its
+                # staging — reclaim when nothing has written to it for a
+                # while, else a dead CTAS blocks the name forever
+                try:
+                    newest = max(
+                        (os.path.getmtime(os.path.join(staging, f))
+                         for f in os.listdir(staging)),
+                        default=os.path.getmtime(staging))
+                except OSError:
+                    continue  # lost a race with a finishing writer
+                import time as _time
 
-        shutil.rmtree(staging, ignore_errors=True)
-        os.makedirs(staging)
+                if attempt == 0 and _time.time() - newest > self.stale_staging_s:
+                    import shutil
+
+                    shutil.rmtree(staging, ignore_errors=True)
+                    continue
+                raise ValueError(
+                    f"table {name!r} is being created concurrently"
+                ) from None
         return True
 
     def write_part(self, name: str, part_id: str, batches,
@@ -961,9 +989,17 @@ class ParquetConnector(DeviceSplitCache, Connector):
         arrays, schema = _to_arrow_columns(plain, dict(zip(names, types)),
                                            dicts, validity, his)
         tbl = pa.Table.from_arrays(arrays, schema=schema)
-        pq.write_table(tbl, path + ".tmp", row_group_size=1 << 20,
-                       use_dictionary=True, compression="zstd")
-        os.replace(path + ".tmp", path)
+        try:
+            pq.write_table(tbl, path + ".tmp", row_group_size=1 << 20,
+                           use_dictionary=True, compression="zstd")
+            os.replace(path + ".tmp", path)
+        except BaseException:
+            # all-or-nothing: a failed write must not leave staging junk
+            try:
+                os.remove(path + ".tmp")
+            except OSError:
+                pass
+            raise
         self._invalidate_table(name)
         return int(tbl.num_rows)
 

@@ -78,8 +78,32 @@ class Split:
     bucket: Optional[int] = None
 
 
+class ConnectorIndex:
+    """Keyed-lookup capability on a table — the analog of the reference's
+    spi `ConnectorIndex` resolved through `IndexManager` and driven by
+    `operator/index/IndexLoader.java`: instead of scanning + hashing the
+    whole table, the engine feeds probe-side key values and receives only
+    the matching rows.
+
+    `lookup` takes {key column: numpy array of probe values} (deduplicated
+    by the caller; string keys arrive as decoded Python strings so the
+    index never sees dictionary codes) and returns a Batch of `columns`
+    containing every table row whose key combination appears in the
+    input."""
+
+    def lookup(self, keys: Dict[str, "np.ndarray"], columns: Sequence[str],
+               capacity: Optional[int] = None) -> Batch:
+        raise NotImplementedError
+
+
 class Connector:
     name: str = ""
+
+    def get_index(self, handle: "TableHandle",
+                  key_columns: Sequence[str]) -> Optional[ConnectorIndex]:
+        """An index over `key_columns`, or None (reference:
+        ConnectorIndexProvider.getIndex — most connectors return none)."""
+        return None
 
     def table_names(self) -> List[str]:
         raise NotImplementedError

@@ -132,7 +132,7 @@ class LocalRunner:
         qp = self._plan_cache.get(sql)
         if qp is not None:
             return qp
-        qp = optimize(plan_query(sql, self.catalog))
+        qp = optimize(plan_query(sql, self.catalog), self.catalog)
         if not qp.scalar_subqueries and qp.cacheable:
             self._plan_cache[sql] = qp
         return qp
@@ -150,14 +150,14 @@ class LocalRunner:
             if is_ddl(stmt):
                 return execute_data_definition(stmt, self.catalog,
                                                self._run_query_ast)
-            qp = optimize(plan_query(stmt, self.catalog))
+            qp = optimize(plan_query(stmt, self.catalog), self.catalog)
             if not qp.scalar_subqueries and qp.cacheable:
                 self._plan_cache[sql] = qp
         ctx = ExecContext(self.catalog, self.config)
         return run_plan(qp, ctx)
 
     def _run_query_ast(self, q):
-        qp = optimize(plan_query(q, self.catalog))
+        qp = optimize(plan_query(q, self.catalog), self.catalog)
         ctx = ExecContext(self.catalog, self.config)
         return run_plan(qp, ctx)
 

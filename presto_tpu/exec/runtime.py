@@ -68,6 +68,7 @@ from presto_tpu.plan.nodes import (
     AggSpec,
     Filter,
     HashJoin,
+    IndexJoin,
     Limit,
     NestedLoopJoin,
     OneRow,
@@ -138,6 +139,11 @@ class ExecConfig:
     # probe-side stages until their join build stages finish, cutting peak
     # cluster memory on multi-join plans
     execution_policy: str = "all-at-once"
+    # recoverable grouped execution (SystemSessionProperties.java:69): a
+    # colocated-join fragment schedules one task per lifespan (bucket) in
+    # a gated phase; a worker lost mid-phase re-runs only its unfinished
+    # buckets on survivors instead of retrying the whole query
+    recoverable_grouped_execution: bool = False
     # phased mode: how long one build phase may run before the query fails
     phase_wait_timeout_s: float = 600.0
 
@@ -344,7 +350,7 @@ def execute_node(node: PlanNode, ctx: ExecContext) -> Iterator[Batch]:
         jfn = _node_jit(node, "down", lambda: down)
         stream = (jfn(b) for b in stream)
     if ctx.config.merge_sparse_output and isinstance(
-            base, (HashJoin, SemiJoin, NestedLoopJoin)):
+            base, (HashJoin, SemiJoin, NestedLoopJoin, IndexJoin)):
         # selective operators emit batches at probe CAPACITY whose live
         # occupancy can be ~1%; every downstream per-batch cost (sorts,
         # merges, probes) is capacity-shaped, so coalesce before fanning
@@ -462,7 +468,7 @@ def _fused_child(node: PlanNode, ctx: ExecContext):
     if ctx.config.collect_stats:
         stream = _instrumented(stream, base, ctx)
     if ctx.config.merge_sparse_output and isinstance(
-            base, (HashJoin, SemiJoin, NestedLoopJoin)):
+            base, (HashJoin, SemiJoin, NestedLoopJoin, IndexJoin)):
         # breakers pull children through here, not execute_node — apply
         # the same sparse-output coalescing before the consumer's chain
         stream = _merging_output(stream, ctx.config.batch_rows)
@@ -478,6 +484,9 @@ def _execute_base(base: PlanNode, ctx: ExecContext) -> Iterator[Batch]:
         return
     if isinstance(base, HashJoin):
         yield from _execute_join(base, ctx)
+        return
+    if isinstance(base, IndexJoin):
+        yield from _execute_index_join(base, ctx)
         return
     if isinstance(base, NestedLoopJoin):
         yield from _execute_nljoin(base, ctx)
@@ -573,12 +582,23 @@ def _scan_batches(scan: TableScan, ctx: ExecContext) -> Iterator[Batch]:
         return
     cap = round_up_capacity(min(nrows, ctx.config.batch_rows) or 1)
     splits = conn.splits(handle, nsplits)
+    read_split = conn.read_split
     if scan.constraints and hasattr(conn, "prune_splits"):
         storage_bounds = _constraints_to_storage(scan, handle)
         if storage_bounds:
             before = len(splits)
             splits = conn.prune_splits(handle, splits, storage_bounds)
             ctx.stats[f"scan.{scan.table}.splits_pruned"] = before - len(splits)
+    if scan.constraints and hasattr(conn, "read_split_constrained"):
+        # full predicate pushdown: the connector evaluates the range
+        # constraints at the source (remote service / SQL WHERE) instead
+        # of just pruning splits (TupleDomain → getRows semantics)
+        bounds = _constraints_to_storage(scan, handle)
+        if bounds:
+            def read_split(split, columns, capacity=None,
+                           _b=bounds):  # noqa: E306
+                return conn.read_split_constrained(
+                    split, columns, capacity=capacity, constraints=_b)
     if ctx.lifespan is not None and any(
             s.bucket is not None for s in splits):
         # grouped execution: this pass reads one bucket only; bucket→task
@@ -589,7 +609,7 @@ def _scan_batches(scan: TableScan, ctx: ExecContext) -> Iterator[Batch]:
     depth = ctx.config.scan_prefetch
     if depth <= 0 or len(splits) <= 1:
         for split in splits:
-            b = conn.read_split(split, columns, capacity=cap)
+            b = read_split(split, columns, capacity=cap)
             yield b.rename(symbols)
         return
     # pipelined scan: a host thread decodes/stages splits ahead of the
@@ -606,7 +626,7 @@ def _scan_batches(scan: TableScan, ctx: ExecContext) -> Iterator[Batch]:
             for split in splits:
                 if stop.is_set():
                     break
-                q.put(conn.read_split(split, columns, capacity=cap))
+                q.put(read_split(split, columns, capacity=cap))
             q.put(_SENTINEL)
         except BaseException as e:  # surface read errors on the consumer
             q.put(e)
@@ -2012,6 +2032,70 @@ def _execute_join(node: HashJoin, ctx: ExecContext) -> Iterator[Batch]:
         if bspiller is not None:
             bspiller.close()
         mctx.set_bytes(0)
+
+
+def _execute_index_join(node, ctx: ExecContext) -> Iterator[Batch]:
+    """Index join (reference: operator/index/IndexLoader.java driving a
+    connector ConnectorIndex): each probe batch's live key values are fed
+    to the connector's keyed lookup; only the matching build rows come
+    back, and the regular sorted-hash probe joins them batch-wise. No
+    full-table scan, no full build — the host sync to extract keys is the
+    price (the reference pays the same in IndexLoader's key snapshots)."""
+    conn = ctx.catalog.connectors[node.catalog]
+    handle = conn.get_table(node.table)
+    idx = conn.get_index(handle, node.index_key_cols)
+    if idx is None:
+        raise RuntimeError(
+            f"connector {node.catalog!r} no longer provides an index over "
+            f"{node.index_key_cols} on {node.table!r}")
+
+    # shim HashJoin so _join_probe's machinery (and its per-node jit
+    # caches) applies unchanged: the 'right' child is a never-executed
+    # scan carrying the index-side symbols
+    shim = node.__dict__.get("_probe_shim")
+    if shim is None:
+        inv = {c: s for s, c in node.assignments.items()}
+        shim = HashJoin(
+            kind=node.kind, left=node.left,
+            right=TableScan(catalog=node.catalog, table=node.table,
+                            assignments=dict(node.assignments),
+                            output=list(node.index_output)),
+            left_keys=list(node.left_keys),
+            right_keys=[inv[c] for c in node.index_key_cols],
+            build_unique=node.build_unique,
+        )
+        node.__dict__["_probe_shim"] = shim
+
+    probe_stream, chain = _fused_child(node.left, ctx)
+    jit_chain = _node_jit(node, "index_chain", lambda: chain)
+    ident = lambda b: b  # noqa: E731 — chain applied before key extraction
+    src_cols = [node.assignments[s] for s, _ in node.index_output]
+    syms = [s for s, _ in node.index_output]
+
+    for b in probe_stream:
+        b = jit_chain(b)
+        live = np.asarray(b.live)
+        valid = live.copy()
+        key_vals = {}
+        for sym, col_name in zip(node.left_keys, node.index_key_cols):
+            c = b.column(sym)
+            if c.validity is not None:
+                valid &= np.asarray(c.validity)
+            vals = np.asarray(c.values)
+            d = b.dicts.get(sym)
+            if d is not None:
+                codes = vals.astype(np.int64)
+                safe = np.clip(codes, 0, max(len(d) - 1, 0))
+                vals = np.asarray(d.values, dtype=object)[safe]
+            key_vals[col_name] = vals
+        key_vals = {c: v[valid] for c, v in key_vals.items()}
+        looked = idx.lookup(key_vals, src_cols)
+        build = Batch(syms, [t for _, t in node.index_output],
+                      [looked.column(c) for c in src_cols], looked.live,
+                      {s: looked.dicts[c] for s, c in zip(syms, src_cols)
+                       if c in looked.dicts})
+        yield from _join_probe(shim, ctx, build, iter([b]), ident,
+                               jkey="index_")
 
 
 def _join_probe(node: HashJoin, ctx: ExecContext, build_in: Optional[Batch],

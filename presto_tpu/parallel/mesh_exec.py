@@ -466,7 +466,7 @@ class MeshExecutor:
         from presto_tpu.plan.builder import plan_query
         from presto_tpu.plan.optimizer import optimize
 
-        qp = optimize(plan_query(sql, self.catalog))
+        qp = optimize(plan_query(sql, self.catalog), self.catalog)
         dplan = fragment_plan(qp, self.catalog)
         return self.run_dplan(dplan)
 

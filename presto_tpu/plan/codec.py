@@ -27,6 +27,7 @@ from presto_tpu.plan.nodes import (
     AggSpec,
     Filter,
     HashJoin,
+    IndexJoin,
     Limit,
     NestedLoopJoin,
     OneRow,
@@ -148,6 +149,15 @@ def node_to_json(n: PlanNode) -> Dict[str, Any]:
                 "left": node_to_json(n.left), "right": node_to_json(n.right),
                 "residual": (expr_to_json(n.residual)
                              if n.residual is not None else None)}
+    if isinstance(n, IndexJoin):
+        return {"k": "indexjoin", "kind": n.kind,
+                "left": node_to_json(n.left),
+                "catalog": n.catalog, "table": n.table,
+                "lkeys": list(n.left_keys),
+                "index_key_cols": list(n.index_key_cols),
+                "assignments": dict(n.assignments),
+                "index_output": _out(n.index_output),
+                "build_unique": n.build_unique}
     if isinstance(n, SemiJoin):
         return {"k": "semijoin", "negated": n.negated,
                 "null_aware": n.null_aware,
@@ -234,6 +244,16 @@ def node_from_json(d: Dict[str, Any]) -> PlanNode:
             left=node_from_json(d["left"]), right=node_from_json(d["right"]),
             residual=(expr_from_json(d["residual"])
                       if d.get("residual") is not None else None),
+        )
+    if k == "indexjoin":
+        return IndexJoin(
+            kind=d["kind"], left=node_from_json(d["left"]),
+            catalog=d["catalog"], table=d["table"],
+            left_keys=list(d["lkeys"]),
+            index_key_cols=list(d["index_key_cols"]),
+            assignments=dict(d["assignments"]),
+            index_output=_unout(d["index_output"]),
+            build_unique=bool(d.get("build_unique", True)),
         )
     if k == "semijoin":
         return SemiJoin(

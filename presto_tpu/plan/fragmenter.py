@@ -286,7 +286,13 @@ class _Fragmenter:
                 return node, SINGLE
             node.right = self.cut(right, rpart, OUT_BROADCAST)
             return node, lpart
-        from presto_tpu.plan.nodes import NestedLoopJoin
+        from presto_tpu.plan.nodes import IndexJoin, NestedLoopJoin
+
+        if isinstance(node, IndexJoin):
+            # the index side is a connector keyed lookup, available on any
+            # worker — the probe keeps its partitioning, no exchange
+            node.left, p = self.process(node.left)
+            return node, p
 
         if isinstance(node, NestedLoopJoin):
             # probe keeps its partitioning; the build is replicated

@@ -224,6 +224,36 @@ class NestedLoopJoin(PlanNode):
 
 
 @dataclasses.dataclass
+class IndexJoin(PlanNode):
+    """Join whose build side is a connector keyed-lookup instead of a scan
+    (reference: IndexJoinNode via IndexJoinOptimizer.java + operator/index/
+    IndexLoader.java): each probe batch's key values are fed to the
+    connector index, which returns only matching rows — no full-table
+    build. Planned by plan/optimizer.make_index_joins when the connector
+    exposes an index over exactly the join keys."""
+
+    kind: str                      # inner | left
+    left: PlanNode                 # probe (streamed)
+    catalog: str                   # index-side connector/table
+    table: str
+    left_keys: List[str] = dataclasses.field(default_factory=list)
+    index_key_cols: List[str] = dataclasses.field(default_factory=list)
+    # symbol -> source column name for the index-side output (includes keys)
+    assignments: Dict[str, str] = dataclasses.field(default_factory=dict)
+    index_output: List[Tuple[str, Type]] = dataclasses.field(
+        default_factory=list)
+    # build-side keys are unique (primary-key index): single-match probe
+    build_unique: bool = True
+
+    @property
+    def output(self):
+        return list(self.left.output) + list(self.index_output)
+
+    def children(self):
+        return [self.left]
+
+
+@dataclasses.dataclass
 class SemiJoin(PlanNode):
     """left [NOT] IN (subquery) / [NOT] EXISTS — probe side filtered by
     membership (reference: HashSemiJoinOperator / SemiJoinNode). Multi-key
@@ -390,6 +420,9 @@ def plan_to_string(node: PlanNode, indent: int = 0, node_stats=None) -> str:
         s = (f"{pad}HashJoin[{node.kind}; {node.left_keys} = "
              f"{node.right_keys}{'; unique' if node.build_unique else ''}"
              f"{f'; colocated={node.colocated} buckets' if node.colocated else ''}]")
+    elif isinstance(node, IndexJoin):
+        s = (f"{pad}IndexJoin[{node.kind}; {node.left_keys} = "
+             f"{node.catalog}.{node.table}({node.index_key_cols})]")
     elif isinstance(node, SemiJoin):
         s = (f"{pad}SemiJoin[{'NOT ' if node.negated else ''}{node.left_keys} IN "
              f"{node.right_keys}{f'; residual={node.residual}' if node.residual else ''}]")

@@ -321,7 +321,44 @@ def cleanup(node: PlanNode) -> PlanNode:
     return node
 
 
-def optimize(plan: QueryPlan) -> QueryPlan:
+def make_index_joins(node: PlanNode, catalog) -> PlanNode:
+    """Rewrite HashJoins whose build side is a bare scan of a table whose
+    connector exposes a ConnectorIndex over exactly the join keys
+    (reference: IndexJoinOptimizer.java — the source side collapses into
+    an IndexSourceNode driven by probe keys)."""
+    from presto_tpu.plan.nodes import IndexJoin
+
+    for attr in ("child", "left", "right"):
+        if hasattr(node, attr):
+            setattr(node, attr, make_index_joins(getattr(node, attr), catalog))
+    if (isinstance(node, HashJoin) and node.kind in ("inner", "left")
+            and node.residual is None and not node.colocated
+            and isinstance(node.right, TableScan)):
+        scan = node.right
+        try:
+            conn = catalog.connectors[scan.catalog]
+            handle = conn.get_table(scan.table)
+        except Exception:
+            return node
+        key_cols = [scan.assignments.get(k) for k in node.right_keys]
+        if None in key_cols:
+            return node
+        if conn.get_index(handle, key_cols) is None:
+            return node
+        from presto_tpu.plan.builder import _derives_unique
+
+        return IndexJoin(
+            kind=node.kind, left=node.left,
+            catalog=scan.catalog, table=scan.table,
+            left_keys=list(node.left_keys), index_key_cols=key_cols,
+            assignments=dict(scan.assignments),
+            index_output=list(scan.output),
+            build_unique=_derives_unique(scan, node.right_keys),
+        )
+    return node
+
+
+def optimize(plan: QueryPlan, catalog=None) -> QueryPlan:
     """Run the pass pipeline (reference: PlanOptimizers.java:146 ordering)."""
     from presto_tpu.plan.stats import invalidate
 
@@ -334,9 +371,11 @@ def optimize(plan: QueryPlan) -> QueryPlan:
     # iterative pattern rules (merge filters/projects/limits, TopN
     # formation) run after the big passes, to fixpoint
     root.child = IterativeOptimizer().optimize(root.child)
+    if catalog is not None:
+        root.child = make_index_joins(root.child, catalog)
     # builder-time stats memos are stale once filters/pruning rewrote the
     # tree; later consumers (fragmenter, capacity planner) re-derive
     invalidate(root)
     for sub in plan.scalar_subqueries.values():
-        optimize(sub)
+        optimize(sub, catalog)
     return plan

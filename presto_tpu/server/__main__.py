@@ -123,6 +123,13 @@ def main(argv=None):
     p.add_argument("--cluster-memory-limit-bytes", type=int, default=None,
                    help="(coordinator) cluster-wide memory ceiling for the "
                         "low-memory killer")
+    p.add_argument("--tls-dir", default=None,
+                   help="serve HTTPS: directory holding (or receiving a "
+                        "generated self-signed) cluster-cert.pem / "
+                        "cluster-key.pem; every node passes the same dir")
+    p.add_argument("--access-control-rules", default=None,
+                   help="(coordinator) JSON file of first-match "
+                        "table/column authorization rules")
     args = p.parse_args(argv)
 
     if args.platform:
@@ -143,6 +150,15 @@ def main(argv=None):
         from presto_tpu.server.coordinator import Coordinator
 
         authenticator = spm = None
+        tls = access_control = None
+        if args.tls_dir:
+            from presto_tpu.server.tls import generate_self_signed
+
+            tls = generate_self_signed(args.tls_dir)
+        if args.access_control_rules:
+            from presto_tpu.server.security import AccessControl
+
+            access_control = AccessControl(path=args.access_control_rules)
         if args.password_file:
             from presto_tpu.server.security import PasswordAuthenticator
 
@@ -162,6 +178,7 @@ def main(argv=None):
             session_property_manager=spm,
             query_event_log=args.query_event_log,
             cluster_memory_limit_bytes=args.cluster_memory_limit_bytes,
+            access_control=access_control, tls=tls,
         )
         print(f"coordinator listening on {coord.url}", flush=True)
         stop = []
@@ -183,6 +200,11 @@ def main(argv=None):
     # (ephemeral) by default and NodeManager keys announcements by node_id
     node_id = args.node_id or (
         f"worker-{socket.gethostname()}-{os.getpid()}")
+    wtls = None
+    if args.tls_dir:
+        from presto_tpu.server.tls import generate_self_signed
+
+        wtls = generate_self_signed(args.tls_dir)
     w = Worker(
         catalog, node_id=node_id, port=args.port,
         coordinator_url=args.coordinator_url,
@@ -190,6 +212,7 @@ def main(argv=None):
         spill_dir=args.spill_dir,
         cluster_secret=args.secret,
         run_slots=args.run_slots,
+        tls=wtls,
     )
     print(f"worker {node_id} listening on {w.url}"
           + (f", announcing to {args.coordinator_url}"

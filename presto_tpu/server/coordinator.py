@@ -233,9 +233,14 @@ class DistributedScheduler:
     previous phase's (join-build) tasks finished — see compute_phases."""
 
     def __init__(self, config: Optional[ExecConfig] = None,
-                 cluster_secret: Optional[str] = None):
+                 cluster_secret: Optional[str] = None,
+                 on_worker_lost=None):
         self.config = config or ExecConfig()
         self.cluster_secret = cluster_secret
+        # notified with the NodeInfo of a worker found dead during task
+        # placement/phase waits (the coordinator excludes it from rotation
+        # immediately, like the pre-retry reprobe does)
+        self.on_worker_lost = on_worker_lost
 
     def _headers(self, extra: Optional[dict] = None) -> dict:
         h = dict(extra or {})
@@ -260,6 +265,27 @@ class DistributedScheduler:
             fid: 1 if f.partitioning == SINGLE else len(workers)
             for fid, f in frags.items()
         }
+        # Recoverable grouped execution (reference:
+        # SystemSessionProperties.java:69 recoverable_grouped_execution +
+        # StageExecutionDescriptor + FixedSourcePartitionedScheduler):
+        # a grouped SOURCE fragment (colocated bucketed join) is scheduled
+        # ONE TASK PER LIFESPAN (task_index=b, n_tasks=B sweeps exactly
+        # bucket b) in its own phase with spooled output; a worker lost
+        # mid-phase re-runs only its UNFINISHED bucket tasks on survivors —
+        # finished lifespans are never redone. Consumers launch only after
+        # the gate, so a dead producer has contributed nothing downstream.
+        grouped: Dict[int, int] = {}
+        if getattr(config, "recoverable_grouped_execution", False):
+            for fid, f in frags.items():
+                # only fully self-contained fragments qualify: one with a
+                # remote source would be forced into phase 0 BEFORE its
+                # producers (broadcast build feeding the colocated join)
+                if (f.partitioning == SOURCE and fid != dplan.root_fid
+                        and not f.remote_sources()):
+                    B = _fragment_lifespans(f.root)
+                    if B:
+                        grouped[fid] = B
+                        n_tasks[fid] = B
         # consumer fragment of each producer (tree: exactly one consumer)
         consumer: Dict[int, int] = {}
         for fid, f in frags.items():
@@ -274,69 +300,128 @@ class DistributedScheduler:
                          "all-at-once") == "phased"
         phases = (compute_phases(frags) if phased
                   else {fid: 0 for fid in frags})
+        if grouped:
+            # grouped fragments run (and gate) first; everything else keeps
+            # its relative phasing shifted after them
+            phases = {fid: (0 if fid in grouped else phases[fid] + 1)
+                      for fid in frags}
         last_phase = max(phases.values())
 
         task_urls: Dict[int, List[str]] = {}
-        assignments = []  # (task_id, worker, TaskUpdate, phase)
+        assignments = []  # (task_id, worker, fragment id, index, phase)
         for fid in sorted(frags):
-            f = frags[fid]
             cnt = n_tasks[fid]
             urls = []
             for i in range(cnt):
                 w = workers[i % len(workers)]
                 tid = f"{query_id}.{fid}.{i}"
-                upstreams = {
-                    rs.fragment_id: [
-                        f"{u}/results/{i}" for u in task_urls[rs.fragment_id]
-                    ]
-                    for rs in f.remote_sources()
-                }
-                strip_runtime_state(f.root)
-                update = TaskUpdate(
-                    fragment=f,
-                    task_index=i,
-                    n_tasks=cnt,
-                    n_out_partitions=n_out[fid],
-                    upstreams=upstreams,
-                    config=_config_dict(config),
-                    # a build-phase task's consumers don't exist yet:
-                    # spool its output instead of blocking on back-pressure
-                    spool=phases[fid] < last_phase,
-                )
-                assignments.append((tid, w, update, phases[fid]))
+                assignments.append((tid, w, fid, i, phases[fid]))
                 urls.append(f"{w.uri}/v1/task/{tid}")
             task_urls[fid] = urls
 
+        def post_task(tid, w, fid, i):
+            """Create the task on `w`, resolving upstream buffer URLs from
+            the CURRENT task_urls (rescheduled producers re-point them)."""
+            from presto_tpu.plan.codec import task_update_to_json
+
+            f = frags[fid]
+            upstreams = {
+                rs.fragment_id: [
+                    f"{u}/results/{i}" for u in task_urls[rs.fragment_id]
+                ]
+                for rs in f.remote_sources()
+            }
+            strip_runtime_state(f.root)
+            update = TaskUpdate(
+                fragment=f,
+                task_index=i,
+                n_tasks=n_tasks[fid],
+                n_out_partitions=n_out[fid],
+                upstreams=upstreams,
+                config=_config_dict(config),
+                # a build-phase task's consumers don't exist yet:
+                # spool its output instead of blocking on back-pressure
+                spool=phases[fid] < last_phase,
+            )
+            body = json.dumps(task_update_to_json(update)).encode()
+            req = urllib.request.Request(
+                f"{w.uri}/v1/task/{tid}", data=body, method="POST",
+                headers=self._headers({"Content-Type": "application/json"}),
+            )
+            with urllib.request.urlopen(req, timeout=30) as r:
+                info = json.loads(r.read())
+            if info.get("state") == "failed":
+                raise QueryFailed(info.get("error") or "task failed")
+
         created = []
+        dead: set = set()
+
+        def mark_dead(x):
+            dead.add(id(x))
+            x.record_failure()
+            if self.on_worker_lost is not None:
+                try:
+                    self.on_worker_lost(x)
+                except Exception:
+                    pass
+
+        def reschedule(tid, w, fid, i):
+            """Re-run ONE lost task on a surviving worker, walking past
+            survivors that also turn out dead."""
+            mark_dead(w)
+            attempt = int(tid.rsplit(".r", 1)[1]) + 1 if ".r" in tid else 1
+            while True:
+                survivors = [x for x in workers if id(x) not in dead]
+                if not survivors:
+                    # retryable: the query-level loop re-probes the cluster
+                    # (pruning truly-dead nodes) before giving up
+                    raise QueryFailed(
+                        "no surviving workers to re-place lost tasks on",
+                        retryable=True)
+                if attempt > len(workers):
+                    raise QueryFailed(f"task {tid} exhausted re-placement "
+                                      f"retries")
+                nw = survivors[i % len(survivors)]
+                ntid = f"{query_id}.{fid}.{i}.r{attempt}"
+                try:
+                    post_task(ntid, nw, fid, i)
+                except (urllib.error.URLError, OSError):
+                    mark_dead(nw)
+                    attempt += 1
+                    continue
+                task_urls[fid][i] = f"{nw.uri}/v1/task/{ntid}"
+                created.append((ntid, nw))
+                return ntid, nw
+
         completed = False
         try:
             # phase by phase; within a phase producers first (ascending fid
             # = topological order). All-at-once has exactly one phase.
             for ph in range(last_phase + 1):
                 phase_tids = []
-                for tid, w, update, p in assignments:
+                for tid, w, fid, i, p in assignments:
                     if p != ph:
                         continue
-                    from presto_tpu.plan.codec import task_update_to_json
-
-                    body = json.dumps(task_update_to_json(update)).encode()
-                    req = urllib.request.Request(
-                        f"{w.uri}/v1/task/{tid}", data=body, method="POST",
-                        headers=self._headers(
-                            {"Content-Type": "application/json"}),
-                    )
-                    with urllib.request.urlopen(req, timeout=30) as r:
-                        info = json.loads(r.read())
-                    if info.get("state") == "failed":
-                        raise QueryFailed(info.get("error") or "task failed")
-                    created.append((tid, w))
-                    phase_tids.append((tid, w))
+                    try:
+                        if id(w) in dead:
+                            raise urllib.error.URLError("worker known dead")
+                        post_task(tid, w, fid, i)
+                        created.append((tid, w))
+                        phase_tids.append((tid, w, fid, i))
+                    except (urllib.error.URLError, OSError):
+                        # creation-time loss: any task is re-placeable on a
+                        # survivor BEFORE its consumers wire upstreams
+                        # (producers post first — ascending fid order)
+                        ntid, nw = reschedule(tid, w, fid, i)
+                        phase_tids.append((ntid, nw, fid, i))
                 if ph < last_phase:
                     # gate the next phase on this (build) phase finishing
                     self._wait_finished(
                         phase_tids,
                         timeout_s=getattr(config, "phase_wait_timeout_s",
-                                          600.0))
+                                          600.0),
+                        on_lost=(reschedule if ph == 0 and grouped
+                                 else None))
             # stream the root fragment's single output buffer
             root_urls = [f"{u}/results/0" for u in task_urls[dplan.root_fid]]
             client = ExchangeClient(root_urls)
@@ -368,15 +453,20 @@ class DistributedScheduler:
                 self._abort(created)
 
     def _wait_finished(self, tasks, timeout_s: float = 600.0,
-                       poll_s: float = 0.1):
-        """Block until every (tid, worker) task reached a terminal state
-        (phased scheduling's stage-completion gate). A failed task fails
-        the query immediately."""
+                       poll_s: float = 0.1, on_lost=None):
+        """Block until every (tid, worker, fid, index) task reached a
+        terminal state (phased scheduling's stage-completion gate). A
+        failed task fails the query immediately. With `on_lost` (recoverable
+        grouped execution), a task whose worker stopped answering is handed
+        back — on_lost re-runs that lifespan on a survivor and returns the
+        replacement (tid, worker) to keep waiting on; deterministic task
+        FAILURES still fail the query (they would fail identically on
+        any node)."""
         deadline = time.monotonic() + timeout_s
         pending = list(tasks)
         while pending:
             still = []
-            for tid, w in pending:
+            for tid, w, fid, i in pending:
                 try:
                     req = urllib.request.Request(
                         f"{w.uri}/v1/task/{tid}/status",
@@ -384,6 +474,10 @@ class DistributedScheduler:
                     with urllib.request.urlopen(req, timeout=10) as r:
                         info = json.loads(r.read())
                 except Exception as e:
+                    if on_lost is not None:
+                        ntid, nw = on_lost(tid, w, fid, i)
+                        still.append((ntid, nw, fid, i))
+                        continue
                     raise QueryFailed(
                         f"lost task {tid} while awaiting phase completion: "
                         f"{e}", retryable=True) from e
@@ -391,7 +485,7 @@ class DistributedScheduler:
                 if state == "failed":
                     raise QueryFailed(info.get("error") or f"task {tid} failed")
                 if state not in ("finished", "aborted"):
-                    still.append((tid, w))
+                    still.append((tid, w, fid, i))
             pending = still
             if pending:
                 if time.monotonic() > deadline:
@@ -410,6 +504,20 @@ class DistributedScheduler:
                 urllib.request.urlopen(req, timeout=5).read()
             except Exception:
                 pass
+
+
+def _fragment_lifespans(node) -> int:
+    """Bucket count of a grouped (colocated-join) fragment, else 0
+    (StageExecutionDescriptor.isStageGroupedExecution analog)."""
+    from presto_tpu.plan.nodes import HashJoin
+
+    if isinstance(node, HashJoin) and node.colocated:
+        return node.colocated
+    for c in node.children():
+        b = _fragment_lifespans(c)
+        if b:
+            return b
+    return 0
 
 
 def _config_dict(cfg: ExecConfig) -> dict:
@@ -431,7 +539,8 @@ class Coordinator:
                  query_event_log: Optional[str] = None,
                  cluster_memory_limit_bytes: Optional[int] = None,
                  low_memory_killer: str = "total-reservation-on-blocked",
-                 low_memory_kill_delay_s: float = 1.0):
+                 low_memory_kill_delay_s: float = 1.0,
+                 access_control=None, tls=None):
         from presto_tpu.server.cluster_memory import ClusterMemoryManager
         from presto_tpu.server.protocol import StatementProtocol
         from presto_tpu.server.querymanager import (
@@ -442,6 +551,10 @@ class Coordinator:
         self.catalog = catalog
         self.config = config or ExecConfig()
         self.broadcast_threshold_rows = broadcast_threshold_rows
+        # column-level authorization consulted on every execution
+        # (security/AccessControlManager.java analog; None = allow all)
+        self.access_control = access_control
+        self.tls = tls
         self.node_manager = NodeManager()
         self.cluster_memory = ClusterMemoryManager(
             cluster_memory_limit_bytes, policy=low_memory_killer,
@@ -449,8 +562,9 @@ class Coordinator:
         self.failure_detector = HeartbeatFailureDetector(
             self.node_manager, cluster_memory=self.cluster_memory)
         self.size_monitor = ClusterSizeMonitor(self.node_manager, min_workers)
-        self.scheduler = DistributedScheduler(self.config,
-                                              cluster_secret=cluster_secret)
+        self.scheduler = DistributedScheduler(
+            self.config, cluster_secret=cluster_secret,
+            on_worker_lost=lambda n: self._probe_and_exclude(n))
         self._query_seq = 0
         self._lock = threading.Lock()
         # keyed by (sql, plan-affecting session property values)
@@ -519,7 +633,7 @@ class Coordinator:
             from presto_tpu.plan.nodes import plan_to_string
             from presto_tpu.plan.optimizer import optimize
 
-            return plan_to_string(optimize(plan_query(sql, self.catalog)).root)
+            return plan_to_string(optimize(plan_query(sql, self.catalog), self.catalog).root)
         # default / TYPE DISTRIBUTED
         return self.plan_distributed(sql, session).to_string()
 
@@ -686,8 +800,14 @@ class Coordinator:
                 self._json({"error": "not found"}, 404)
 
         self._http = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        if self.tls is not None:
+            from presto_tpu.server.tls import install_client_context, wrap_server
+
+            wrap_server(self._http, self.tls)
+            install_client_context(self.tls)
         self.port = self._http.server_address[1]
-        self.url = f"http://127.0.0.1:{self.port}"
+        scheme = "https" if self.tls is not None else "http"
+        self.url = f"{scheme}://127.0.0.1:{self.port}"
 
     # -- queries ----------------------------------------------------------
 
@@ -729,7 +849,8 @@ class Coordinator:
         conn, tname = self.catalog.connector_for(stmt.name)
         if not getattr(conn, "supports_scaled_writes", lambda: False)():
             return None
-        qp = optimize(plan_query(stmt.query, self.catalog))
+        qp = optimize(plan_query(stmt.query, self.catalog), self.catalog)
+        self._enforce_access([qp.root], session)
         if qp.scalar_subqueries:
             return None  # binding protocol stays on the gathered path
         write_id = uuid.uuid4().hex[:8]
@@ -773,6 +894,17 @@ class Coordinator:
         return Batch(["rows"], [BIGINT],
                      [Column(jnp.asarray(vals), None)],
                      jnp.asarray(live), {})
+
+    def _probe_and_exclude(self, n: NodeInfo):
+        """One-node version of _reprobe_workers, called when task placement
+        found the node dead: confirm with a direct probe and exclude it
+        from rotation immediately if it really is gone."""
+        try:
+            with urllib.request.urlopen(f"{n.uri}/v1/status", timeout=3) as r:
+                json.loads(r.read())
+            n.record_success()
+        except Exception:
+            n.failure_score = 5.0  # past NodeInfo.failed threshold
 
     def _reprobe_workers(self):
         """Synchronous cluster probe before a retry: a node that fails its
@@ -842,11 +974,15 @@ class Coordinator:
         if hit is not None:
             return hit
         qp = optimize(plan_query(stmt if stmt is not None else sql,
-                                 self.catalog))
+                                 self.catalog), self.catalog)
         cacheable = bool(sql) and not qp.scalar_subqueries and qp.cacheable
         if qp.scalar_subqueries:
             # bind uncorrelated scalar subqueries coordinator-side first
-            # (the reference runs them as separate plan stages)
+            # (the reference runs them as separate plan stages). They
+            # EXECUTE here, before run_batch's fragment walk can see them —
+            # authorize their scans now or a subquery smuggles denied data
+            self._enforce_access(
+                (s.root for s in qp.scalar_subqueries.values()), session)
             ctx = ExecContext(self.catalog, self.config)
             bindings = {}
             for sym, sub in qp.scalar_subqueries.items():
@@ -864,6 +1000,33 @@ class Coordinator:
             self._dplan_cache[cache_key] = dplan
             self._cached_sqls.add(sql)
         return dplan
+
+    def _enforce_access(self, roots, session) -> None:
+        """Column-level authorization over every table the (cached or
+        fresh) plan touches — enforced per EXECUTION, so plan caching
+        can't bypass a rule change (AccessControlManager.checkCanSelect
+        FromColumns analog). `roots` is an iterable of plan roots."""
+        if self.access_control is None:
+            return
+        from presto_tpu.plan.nodes import IndexJoin as _IdxJ
+        from presto_tpu.plan.nodes import TableScan as _TS
+
+        user = getattr(session, "user", None) or "user"
+
+        def walk(n):
+            if isinstance(n, _TS):
+                self.access_control.check_can_select(
+                    user, n.catalog, n.table,
+                    set(n.assignments.values()) | set(n.constraints or ()))
+            elif isinstance(n, _IdxJ):
+                self.access_control.check_can_select(
+                    user, n.catalog, n.table,
+                    set(n.assignments.values()))
+            for c in n.children():
+                walk(c)
+
+        for r in roots:
+            walk(r)
 
     def run_batch(self, sql: str, config: Optional[ExecConfig] = None,
                   session=None, stmt=None) -> Batch:
@@ -897,7 +1060,8 @@ class Coordinator:
                 from presto_tpu.plan.fragmenter import fragment_plan
                 from presto_tpu.plan.optimizer import optimize as _opt
 
-                qp = _opt(_pq(q, self.catalog))
+                qp = _opt(_pq(q, self.catalog), self.catalog)
+                self._enforce_access([qp.root], session)
                 d = fragment_plan(qp, self.catalog,
                                   broadcast_threshold_rows=self.broadcast_threshold_rows)
                 batches = list(self.execute_distributed(d, config))
@@ -917,6 +1081,8 @@ class Coordinator:
             return execute_data_definition(stmt, self.catalog, run_query_fn)
 
         dplan = self.plan_distributed(sql, session, stmt=stmt)
+        self._enforce_access(
+            (f.root for f in dplan.fragments.values()), session)
         batches = self._execute_with_retry(dplan, config)
         merged = _collect_concat(iter(batches))
         if merged is None:
@@ -950,7 +1116,8 @@ class DistributedRunner:
 
     def __init__(self, catalog: Catalog, n_workers: int = 2,
                  config: Optional[ExecConfig] = None,
-                 broadcast_threshold_rows: float = 1_000_000):
+                 broadcast_threshold_rows: float = 1_000_000,
+                 access_control=None, tls=None):
         import secrets as _secrets
 
         from presto_tpu.server.worker import Worker
@@ -962,6 +1129,7 @@ class DistributedRunner:
             catalog, config=self.config, min_workers=n_workers,
             broadcast_threshold_rows=broadcast_threshold_rows,
             cluster_secret=cluster_secret,
+            access_control=access_control, tls=tls,
         )
         self.workers = [
             Worker(catalog, node_id=f"worker-{i}",
@@ -970,7 +1138,7 @@ class DistributedRunner:
                    spill_dir=self.config.spill_dir,
                    revoke_threshold=self.config.memory_revoking_threshold,
                    revoke_target=self.config.memory_revoking_target,
-                   cluster_secret=cluster_secret)
+                   cluster_secret=cluster_secret, tls=tls)
             for i in range(n_workers)
         ]
 

@@ -355,7 +355,8 @@ class StatementProtocol:
             # user mistakes (parse/analysis/session/admission) are USER_ERROR,
             # everything else INTERNAL (reference: StandardErrorCode types)
             user_error = (qe.error_type or "").startswith(
-                ("Parse", "Analysis", "Session", "QUERY_QUEUE", "Key")
+                ("Parse", "Analysis", "Session", "QUERY_QUEUE", "Key",
+                 "AccessDenied")
             )
             out["error"] = {
                 "message": qe.error or "query failed",

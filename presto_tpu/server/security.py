@@ -117,3 +117,60 @@ class SessionPropertyManager:
                 continue
             out.update(r["props"])
         return out
+
+
+class AccessDeniedError(Exception):
+    """Structured authorization failure (reference: spi
+    AccessDeniedException — surfaced as PERMISSION_DENIED)."""
+
+
+class AccessControl:
+    """Rule-based table/column authorization (reference:
+    security/AccessControlManager.java dispatching to
+    presto-plugin-toolkit's FileBasedAccessControl table rules).
+
+    Rules are evaluated FIRST-MATCH; no matching rule denies (add a
+    catch-all to open the rest, exactly like the reference's file-based
+    connector access control):
+
+        [{"user": "analyst.*", "catalog": "pq", "table": "events",
+          "allowed_columns": ["region", "clicks"]},
+         {"user": ".*", "privileges": "all"}]
+
+    `privileges`: "all" | "none" (default "all" when the rule matches and
+    no column list restricts it). `allowed_columns` whitelists columns;
+    `denied_columns` blacklists. Regex fields default to match-anything.
+    """
+
+    def __init__(self, rules: Optional[List[dict]] = None,
+                 path: Optional[str] = None):
+        if path is not None:
+            with open(path) as f:
+                rules = json.load(f)
+        self.rules = rules or []
+
+    def _match(self, user: str, catalog: str, table: str) -> Optional[dict]:
+        for r in self.rules:
+            if re.fullmatch(r.get("user", ".*"), user) is None:
+                continue
+            if re.fullmatch(r.get("catalog", ".*"), catalog) is None:
+                continue
+            if re.fullmatch(r.get("table", ".*"), table) is None:
+                continue
+            return r
+        return None
+
+    def check_can_select(self, user: str, catalog: str, table: str,
+                         columns) -> None:
+        r = self._match(user, catalog, table)
+        if r is None or r.get("privileges") == "none":
+            raise AccessDeniedError(
+                f"Access Denied: user {user!r} cannot select from "
+                f"{catalog}.{table}")
+        allowed = r.get("allowed_columns")
+        denied = set(r.get("denied_columns") or ())
+        for c in sorted(set(columns)):
+            if (allowed is not None and c not in allowed) or c in denied:
+                raise AccessDeniedError(
+                    f"Access Denied: user {user!r} cannot select column "
+                    f"{c!r} from {catalog}.{table}")

@@ -146,6 +146,10 @@ class SystemSessionProperties:
             PropertyMetadata("optimize_plan", "Run optimizer passes", bool, True),
             PropertyMetadata("execution_policy", "all-at-once | phased", str,
                              "all-at-once"),
+            # SystemSessionProperties.java:69
+            PropertyMetadata("recoverable_grouped_execution",
+                             "Re-run only lost lifespans of colocated joins",
+                             bool, False),
         ]
 
     def names(self) -> List[str]:
@@ -243,4 +247,6 @@ class Session:
             scan_prefetch=self.get("scan_prefetch"),
             query_retry_count=self.get("query_retry_count"),
             execution_policy=self.get("execution_policy"),
+            recoverable_grouped_execution=self.get(
+                "recoverable_grouped_execution"),
         )

@@ -1,0 +1,103 @@
+"""Cluster TLS: encrypted coordinator/worker/client HTTP.
+
+Reference: the reference's internal-communication TLS
+(`InternalCommunicationConfig` https settings) and server/security's
+https connectors — here one TlsConfig wraps both the server sockets
+(`http.server` + ssl.SSLContext) and the client side (a process-wide
+urllib opener that verifies the cluster CA; every coordinator↔worker and
+worker↔worker call goes through `urllib.request.urlopen`).
+
+Self-signed bootstrap uses the `openssl` CLI (always present in the
+image) — the cert doubles as its own CA, the usual single-cluster
+deployment shape.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import ssl
+import subprocess
+import urllib.request
+from typing import Optional
+
+
+@dataclasses.dataclass
+class TlsConfig:
+    certfile: str
+    keyfile: str
+    # CA used by CLIENTS to verify servers; for self-signed deployments
+    # this is the certfile itself
+    cafile: Optional[str] = None
+
+
+def generate_self_signed(directory: str, cn: str = "127.0.0.1") -> TlsConfig:
+    """One-command cluster bootstrap: a self-signed cert valid for
+    localhost, written into `directory`. Concurrent node startups race —
+    an O_EXCL lockfile elects ONE generator; the others wait for the
+    finished pair (a torn cert/key mix would fail load_cert_chain)."""
+    import time
+
+    os.makedirs(directory, exist_ok=True)
+    cert = os.path.join(directory, "cluster-cert.pem")
+    key = os.path.join(directory, "cluster-key.pem")
+    if os.path.exists(cert) and os.path.exists(key):
+        return TlsConfig(certfile=cert, keyfile=key, cafile=cert)
+    lock = os.path.join(directory, ".tls-gen.lock")
+    try:
+        fd = os.open(lock, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+    except FileExistsError:
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            if os.path.exists(cert) and os.path.exists(key):
+                return TlsConfig(certfile=cert, keyfile=key, cafile=cert)
+            time.sleep(0.1)
+        raise RuntimeError(
+            f"timed out waiting for TLS material in {directory} "
+            f"(stale {lock}? delete it and retry)")
+    try:
+        subprocess.run(
+            ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+             "-keyout", key + ".tmp", "-out", cert + ".tmp", "-days", "7",
+             "-subj", f"/CN={cn}",
+             "-addext", "subjectAltName=DNS:localhost,IP:127.0.0.1"],
+            check=True, capture_output=True)
+        os.replace(key + ".tmp", key)
+        os.replace(cert + ".tmp", cert)
+    finally:
+        os.close(fd)
+        os.unlink(lock)
+    return TlsConfig(certfile=cert, keyfile=key, cafile=cert)
+
+
+def server_context(cfg: TlsConfig) -> ssl.SSLContext:
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    ctx.load_cert_chain(cfg.certfile, cfg.keyfile)
+    return ctx
+
+
+def client_context(cfg: TlsConfig) -> ssl.SSLContext:
+    # the cluster CA is ADDED to the system trust store, not substituted
+    # for it — connectors in the same process still reach public-CA
+    # services (RemoteServiceConnector over external https)
+    ctx = ssl.create_default_context()
+    ctx.load_verify_locations(cafile=cfg.cafile or cfg.certfile)
+    return ctx
+
+
+def install_client_context(cfg: TlsConfig) -> None:
+    """Route every `urllib.request.urlopen` in the process through an
+    opener that trusts the cluster CA. Process-global by design: a node
+    belongs to one cluster, and all intra-cluster calls share the CA."""
+    opener = urllib.request.build_opener(
+        urllib.request.HTTPSHandler(context=client_context(cfg)))
+    urllib.request.install_opener(opener)
+
+
+def wrap_server(server, cfg: Optional[TlsConfig]):
+    """Wrap an http.server socket for TLS; returns the URL scheme."""
+    if cfg is None:
+        return "http"
+    server.socket = server_context(cfg).wrap_socket(
+        server.socket, server_side=True)
+    return "https"

@@ -394,7 +394,8 @@ class Worker:
                  memory_pool_bytes: Optional[int] = None,
                  spill_dir: Optional[str] = None,
                  revoke_threshold: float = 0.9, revoke_target: float = 0.5,
-                 cluster_secret: Optional[str] = None, run_slots: int = 4):
+                 cluster_secret: Optional[str] = None, run_slots: int = 4,
+                 tls=None):
         from presto_tpu.memory import MemoryPool
         from presto_tpu.spiller import SpillManager
 
@@ -540,8 +541,14 @@ class Worker:
                 self._json({"error": "not found"}, 404)
 
         self.server = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        scheme = "http"
+        if tls is not None:
+            from presto_tpu.server.tls import install_client_context, wrap_server
+
+            scheme = wrap_server(self.server, tls)
+            install_client_context(tls)
         self.port = self.server.server_address[1]
-        self.url = f"http://127.0.0.1:{self.port}"
+        self.url = f"{scheme}://127.0.0.1:{self.port}"
         self._serve_thread = threading.Thread(
             target=self.server.serve_forever, daemon=True,
             name=f"worker-http-{self.node_id}",

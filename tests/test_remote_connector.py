@@ -1,0 +1,103 @@
+"""Remote-service connector: federate an external data service over RPC.
+
+Reference: presto-thrift-connector(-api) — an external service implements
+listTables/getTableMetadata/getSplits/getRows (continuation tokens,
+desiredColumns projection, TupleDomain pushdown); here the same four-call
+shape runs as JSON over HTTP (catalog/remote.py)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from presto_tpu.catalog.memory import MemoryConnector
+from presto_tpu.catalog.remote import RemoteServiceConnector, RemoteTableService
+from presto_tpu.connector import Catalog
+from presto_tpu.exec import ExecConfig, LocalRunner
+
+N = 8_000
+
+
+@pytest.fixture(scope="module")
+def service():
+    rng = np.random.default_rng(77)
+    orders = pd.DataFrame({
+        "order_id": np.arange(N),
+        "nation_key": rng.integers(0, 25, N),
+        "amount": rng.normal(100, 30, N).round(2),
+        "status": rng.choice(["OPEN", "SHIPPED", "DONE"], N),
+    })
+    svc = RemoteTableService({"orders": orders}, n_splits=3)
+    yield svc, orders
+    svc.close()
+
+
+@pytest.fixture()
+def cat(service):
+    svc, _ = service
+    conn = RemoteServiceConnector(svc.url, name="rs", page_rows=1024)
+    c = Catalog()
+    # a local table to federate against (the tpch nation shape)
+    mem = MemoryConnector()
+    mem.add_table("nation", pd.DataFrame({
+        "nation_key": np.arange(25),
+        "nation": [f"N{i:02d}" for i in range(25)],
+    }))
+    c.register("m", mem, default=True)
+    c.register("rs", conn)
+    return c
+
+
+def test_discovery_and_scan(cat, service):
+    svc, orders = service
+    r = LocalRunner(cat, ExecConfig(batch_rows=1 << 11))
+    got = r.run("select count(*) as n, sum(amount) as s from rs.orders")
+    assert int(got.n[0]) == N
+    assert abs(float(got.s[0]) - float(orders.amount.sum())) < 1e-6
+
+
+def test_federates_against_local_table(cat, service):
+    _, orders = service
+    r = LocalRunner(cat, ExecConfig(batch_rows=1 << 11))
+    got = r.run(
+        "select nation, sum(amount) as s from rs.orders o "
+        "join nation on o.nation_key = nation.nation_key "
+        "where status = 'SHIPPED' group by nation order by nation")
+    shipped = orders[orders.status == "SHIPPED"]
+    want = (shipped.assign(nation=[f"N{k:02d}" for k in shipped.nation_key])
+            .groupby("nation").amount.sum().sort_index())
+    assert got.nation.tolist() == list(want.index)
+    assert all(abs(a - b) < 1e-6 for a, b in zip(got.s, want.values))
+
+
+def test_projection_pushdown_reaches_service(cat, service):
+    svc, _ = service
+    r = LocalRunner(cat, ExecConfig(batch_rows=1 << 11))
+    svc.requests.clear()
+    r.run("select sum(amount) as s from rs.orders")
+    cols = {tuple(sorted(req["columns"])) for req in svc.requests}
+    assert cols == {("amount",)}  # only the projected column traveled
+
+
+def test_predicate_pushdown_reaches_service(cat, service):
+    svc, orders = service
+    r = LocalRunner(cat, ExecConfig(batch_rows=1 << 11))
+    svc.requests.clear()
+    got = r.run("select count(*) as n from rs.orders where order_id < 100")
+    assert int(got.n[0]) == 100
+    assert any(req.get("constraints", {}).get("order_id")
+               for req in svc.requests)
+
+
+def test_continuation_tokens_page_the_rows(service):
+    svc, _ = service
+    # a FRESH connector (cold split cache); page_rows=512 over ~2666-row
+    # splits forces several /rows pages per split
+    conn = RemoteServiceConnector(svc.url, name="rs", page_rows=512)
+    c = Catalog()
+    c.register("rs", conn, default=True)
+    svc.requests.clear()
+    r = LocalRunner(c, ExecConfig(batch_rows=1 << 11))
+    got = r.run("select sum(order_id) as s from orders")
+    assert int(got.s[0]) == N * (N - 1) // 2
+    tokens = [req.get("token") for req in svc.requests]
+    assert any(t for t in tokens if t)  # continuation actually used
