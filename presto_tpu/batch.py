@@ -331,6 +331,11 @@ class Batch:
                 arr = self.dicts[name].decode(
                     np.where(valid, vals, -1) if valid is not None else vals
                 )
+                if t.name == "varbinary":
+                    # user-facing bytes back out of the latin-1 bijection
+                    arr = np.array(
+                        [None if v is None else str(v).encode("latin-1")
+                         for v in arr], dtype=object)
             else:
                 from presto_tpu.types import DecimalType
 

@@ -66,6 +66,10 @@ def _infer_type(arr: np.ndarray) -> Type:
             return BIGINT
         if isinstance(first, (float, np.floating)):
             return DOUBLE
+        if isinstance(first, (bytes, bytearray)):
+            from presto_tpu.types import VARBINARY
+
+            return VARBINARY
         if isinstance(first, (list, tuple)):
             elems = [e for v in arr if isinstance(v, (list, tuple))
                      for e in v if e is not None]
@@ -245,6 +249,12 @@ class MemoryTable:
                     valid = ~nulls
                     arr = np.where(nulls, "" if t.is_string else 0, arr)
             if t.is_string:
+                if t.name == "varbinary":
+                    # bytes ride the latin-1 bijection into the dictionary
+                    arr = np.array(
+                        [v.decode("latin-1")
+                         if isinstance(v, (bytes, bytearray)) else str(v)
+                         for v in arr], dtype=object)
                 d, codes = Dictionary.encode(arr.astype(str))
                 if valid is not None:
                     codes = np.where(valid, codes, -1)

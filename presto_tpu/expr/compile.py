@@ -112,12 +112,23 @@ _STR_TO_STR = {
     "url_extract_protocol", "url_extract_fragment", "url_encode",
     "url_decode", "md5", "sha1", "sha256", "sha512", "to_base64",
     "from_base64", "normalize",
+    # JSON family (operator/scalar/JsonFunctions.java): JSON values are
+    # VARCHAR text; every function evaluates ONCE per dictionary entry
+    "json_extract", "json_array_get", "json_format", "json_parse",
+    # VARBINARY family (VarbinaryFunctions.java): bytes ride the latin-1
+    # bijection (types.VarbinaryType), so these are dictionary transforms
+    "to_hex", "from_hex", "to_utf8", "from_utf8",
+    "__vb_md5", "__vb_sha1", "__vb_sha256", "__vb_sha512", "__vb_to_base64",
 }
 # string→int functions (code-indexed int lut)
 _STR_TO_INT = {"length", "strpos", "codepoint", "json_array_length",
-               "levenshtein_distance_c", "hamming_distance_c"}
+               "json_size", "levenshtein_distance_c", "hamming_distance_c"}
+# int functions whose python fn may return None = SQL NULL (absent json
+# path / non-array input) — carried via a parallel null lut
+_STR_INT_NULLABLE = {"json_array_length", "json_size"}
 # string→bool predicate functions (bool lut, like LIKE)
-_STR_PRED = {"regexp_like", "starts_with", "ends_with", "contains"}
+_STR_PRED = {"regexp_like", "starts_with", "ends_with", "contains",
+             "json_array_contains", "is_json_scalar"}
 
 
 def _sql_substr(s: str, start: int, length: int | None) -> str:
@@ -183,6 +194,34 @@ def _str_xform_pyfn(fn: str, cargs: tuple):
             return getattr(_hl, algo)(s.encode()).hexdigest()
 
         return digest
+    if fn in ("__vb_md5", "__vb_sha1", "__vb_sha256", "__vb_sha512"):
+        import hashlib as _hl
+
+        algo = fn[5:]
+
+        def vb_digest(s, algo=algo):
+            raw = getattr(_hl, algo)(s.encode("latin-1")).digest()
+            return raw.decode("latin-1")
+
+        return vb_digest
+    if fn == "__vb_to_base64":
+        import base64 as _b64
+
+        return lambda s: _b64.b64encode(s.encode("latin-1")).decode("ascii")
+    if fn == "to_hex":
+        return lambda s: s.encode("latin-1").hex().upper()
+    if fn == "from_hex":
+        def fh(s):
+            try:
+                return bytes.fromhex(s).decode("latin-1")
+            except ValueError:
+                return None
+        return fh
+    if fn == "to_utf8":
+        return lambda s: s.encode("utf-8").decode("latin-1")
+    if fn == "from_utf8":
+        # invalid byte sequences replaced (FromUtf8Function's default)
+        return lambda s: s.encode("latin-1").decode("utf-8", "replace")
     if fn == "to_base64":
         import base64 as _b64
 
@@ -269,6 +308,43 @@ def _str_xform_pyfn(fn: str, cargs: tuple):
                 return "true" if v else "false"
             return str(v)
         return jes
+    if fn in ("json_extract", "json_array_get"):
+        import json as _json
+
+        steps = ([int(cargs[0])] if fn == "json_array_get"
+                 else _parse_json_path(str(cargs[0])))
+
+        def jex(s, steps=steps):
+            try:
+                v = _json.loads(s)
+                for st in steps:
+                    v = v[st]
+            except Exception:
+                return None
+            return _json.dumps(v, separators=(",", ":"))
+        return jex
+    if fn == "json_format":
+        import json as _json
+
+        def jfmt(s):
+            try:
+                return _json.dumps(_json.loads(s), separators=(",", ":"))
+            except Exception:
+                return None
+        return jfmt
+    if fn == "json_parse":
+        import json as _json
+
+        def jp(s):
+            try:
+                _json.loads(s)
+                return s  # JSON is VARCHAR text here; parse = validate
+            except Exception:
+                # documented deviation: the reference RAISES on malformed
+                # input, but dictionary-wide evaluation visits entries
+                # that may belong to filtered-out rows — NULL instead
+                return None
+        return jp
     raise NotImplementedError(fn)
 
 
@@ -314,9 +390,23 @@ def _str_int_pyfn(fn: str, cargs: tuple):
             try:
                 v = _json.loads(s)
             except Exception:
-                return -1
-            return len(v) if isinstance(v, list) else -1
+                return None
+            return len(v) if isinstance(v, list) else None  # NULL
         return jal
+    if fn == "json_size":
+        import json as _json
+
+        steps = _parse_json_path(str(cargs[0]))
+
+        def jsz(s, steps=steps):
+            try:
+                v = _json.loads(s)
+                for st in steps:
+                    v = v[st]
+            except Exception:
+                return None  # absent path → NULL
+            return len(v) if isinstance(v, (dict, list)) else 0
+        return jsz
     if fn == "levenshtein_distance_c":
         other = str(cargs[0])
 
@@ -351,6 +441,40 @@ def _str_pred_pyfn(fn: str, cargs: tuple):
     if fn == "contains":
         p = str(cargs[0])
         return lambda s: p in s
+    if fn == "json_array_contains":
+        import json as _json
+
+        want = cargs[0]
+
+        def jac(s, want=want):
+            try:
+                v = _json.loads(s)
+            except Exception:
+                return False
+            if not isinstance(v, list):
+                return False
+            for e in v:
+                if isinstance(e, bool) or isinstance(want, bool):
+                    if e is want:
+                        return True
+                elif isinstance(e, str) and isinstance(want, str):
+                    if e == want:
+                        return True
+                elif isinstance(e, (int, float)) and isinstance(
+                        want, (int, float)):
+                    if float(e) == float(want):
+                        return True
+            return False
+        return jac
+    if fn == "is_json_scalar":
+        import json as _json
+
+        def ijs(s):
+            try:
+                return not isinstance(_json.loads(s), (dict, list))
+            except Exception:
+                return False
+        return ijs
     raise NotImplementedError(fn)
 
 
@@ -817,7 +941,17 @@ def _eval_call(e: Call, ctx: CompileContext):
         if d is None:
             raise ValueError(f"{fn} needs a dictionary operand")
         if fn in _STR_TO_INT:
-            table = d.int_lut((fn, cargs), _str_int_pyfn(fn, cargs))
+            pyfn = _str_int_pyfn(fn, cargs)
+            if fn in _STR_INT_NULLABLE:
+                table = d.int_lut((fn, cargs, "v"),
+                                  lambda s: pyfn(s) or 0)
+                nulls = d.int_lut((fn, cargs, "null"),
+                                  lambda s: pyfn(s) is None, dtype=np.bool_)
+                codes, valid = _eval(operand, ctx)
+                notnull = ~jnp.asarray(nulls)[codes + 1]
+                valid = notnull if valid is None else valid & notnull
+                return jnp.asarray(table)[codes + 1], valid
+            table = d.int_lut((fn, cargs), pyfn)
         else:
             table = d.int_lut((fn, cargs), _str_pred_pyfn(fn, cargs),
                               dtype=np.bool_)
@@ -1002,6 +1136,18 @@ def _eval_call(e: Call, ctx: CompileContext):
         v, valid = _eval_arg(e.args[0], ctx)
         _, m, _ = _civil_from_days(v.astype(jnp.int32))
         return ((m - 1) // 3 + 1).astype(jnp.int64), valid
+    if fn in ("__time_hour", "__time_minute", "__time_second"):
+        # TIME (micros-of-day) and TIMESTAMP (micros-since-epoch) both
+        # reduce mod one day
+        v, valid = _eval_arg(e.args[0], ctx)
+        tod = jnp.mod(v.astype(jnp.int64), 86_400_000_000)
+        if fn == "__time_hour":
+            out = tod // 3_600_000_000
+        elif fn == "__time_minute":
+            out = (tod // 60_000_000) % 60
+        else:
+            out = (tod // 1_000_000) % 60
+        return out, valid
     if fn == "day_of_week":
         # ISO: 1 = Monday … 7 = Sunday; epoch day 0 (1970-01-01) is Thursday
         v, valid = _eval_arg(e.args[0], ctx)

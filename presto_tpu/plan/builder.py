@@ -57,8 +57,10 @@ from presto_tpu.types import (
     GEOMETRY,
     INTEGER,
     MapType,
+    TIME,
     TIMESTAMP,
     Type,
+    VARBINARY,
     VARCHAR,
     common_super_type,
     is_floating,
@@ -304,6 +306,16 @@ class ExprAnalyzer:
         if node.kind == "date":
             y, m, d = map(int, str(node.value).split("-"))
             return Constant(DATE, days_from_civil(y, m, d))
+        if node.kind == "time":
+            hms, _, frac = str(node.value).partition(".")
+            parts = list(map(int, hms.split(":")))
+            while len(parts) < 3:
+                parts.append(0)
+            hh, mm, ss = parts[:3]
+            micros = (hh * 3600 + mm * 60 + ss) * 1_000_000
+            if frac:
+                micros += int(frac[:6].ljust(6, "0"))
+            return Constant(TIME, micros, raw=True)
         if node.kind == "timestamp":
             s = str(node.value)
             datepart, _, timepart = s.partition(" ")
@@ -545,6 +557,14 @@ class ExprAnalyzer:
 
     def _an_Extract(self, node: ast.Extract) -> RowExpression:
         v = self.analyze(node.value)
+        if node.field in ("hour", "minute", "second"):
+            if v.type not in (TIME, TIMESTAMP):
+                raise AnalysisError(
+                    f"extract({node.field}) expects time or timestamp, "
+                    f"got {v.type}")
+            # TIME is micros-of-day; TIMESTAMP micros-since-epoch — the
+            # mod-day lowering serves both
+            return Call(BIGINT, "__time_" + node.field, (v,))
         if node.field not in ("year", "month", "day"):
             raise AnalysisError(f"extract({node.field}) unsupported")
         return Call(BIGINT, node.field, (v,))
@@ -596,6 +616,21 @@ class ExprAnalyzer:
         # string functions (dictionary transforms / luts — expr/compile.py)
         if name in ("substr", "substring"):
             return Call(VARCHAR, "substr", args)
+        if (name in ("md5", "sha1", "sha256", "sha512", "to_base64")
+                and args and args[0].type.name == "varbinary"):
+            # VarbinaryFunctions.java: digests of BYTES return varbinary
+            # (to_base64 returns varchar); the varchar overloads below
+            # hash utf-8 text and return hex — a convenience extension
+            out_t = VARCHAR if name == "to_base64" else VARBINARY
+            return Call(out_t, "__vb_" + name, args)
+        if name in ("to_hex", "from_hex", "to_utf8", "from_utf8"):
+            if name in ("to_hex", "from_utf8") and (
+                    not args or args[0].type.name != "varbinary"):
+                # varbinary-only signatures (VarbinaryFunctions.java);
+                # arbitrary varchar text need not fit the latin-1 byte map
+                raise AnalysisError(f"{name}() expects varbinary")
+            out_t = VARCHAR if name in ("to_hex", "from_utf8") else VARBINARY
+            return Call(out_t, name, args)
         if name in ("upper", "lower", "trim", "ltrim", "rtrim", "reverse",
                     "replace", "lpad", "rpad", "split_part",
                     "url_extract_host", "url_extract_path",
@@ -675,12 +710,19 @@ class ExprAnalyzer:
             return Call(TIMESTAMP, name, args)
         if name == "to_unixtime":
             return Call(DOUBLE, name, args)
+        if name in ("hour", "minute", "second") and args and args[0].type in (
+                TIME, TIMESTAMP):
+            return Call(BIGINT, "__time_" + name, args)
         if name == "width_bucket":
             return Call(BIGINT, name, args)
-        if name in ("regexp_extract", "regexp_replace", "json_extract_scalar"):
+        if name in ("regexp_extract", "regexp_replace", "json_extract_scalar",
+                    "json_extract", "json_array_get", "json_format",
+                    "json_parse"):
             return Call(VARCHAR, name, args)
-        if name == "json_array_length":
+        if name in ("json_array_length", "json_size"):
             return Call(BIGINT, name, args)
+        if name in ("json_array_contains", "is_json_scalar"):
+            return Call(BOOLEAN, name, args)
         if name in ("levenshtein_distance", "hamming_distance"):
             # second operand must be a plan-time constant (dictionary lut)
             return Call(BIGINT, name + "_c", (args[0], args[1]))
@@ -1286,8 +1328,8 @@ class Planner:
                 and not lt.is_string and not rt.is_string
                 and not isinstance(lt, DecimalType)
                 and not isinstance(rt, DecimalType)
-                and lt.name not in ("date", "timestamp")
-                and rt.name not in ("date", "timestamp")
+                and lt.name not in ("date", "timestamp", "time")
+                and rt.name not in ("date", "timestamp", "time")
             )
             if not same:
                 raise AnalysisError(

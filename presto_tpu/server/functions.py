@@ -22,12 +22,15 @@ _SCALAR = {
                "starts_with", "ends_with", "contains", "levenshtein_distance",
                "hamming_distance"],
     "regexp/json": ["regexp_like", "regexp_extract", "regexp_replace",
-                    "json_extract_scalar", "json_array_length"],
+                    "json_extract_scalar", "json_extract", "json_array_get",
+                    "json_array_length", "json_size", "json_format",
+                    "json_parse", "json_array_contains", "is_json_scalar"],
     "url": ["url_extract_host", "url_extract_path", "url_extract_query",
             "url_extract_protocol", "url_extract_fragment", "url_encode",
             "url_decode"],
     "binary": ["md5", "sha1", "sha256", "sha512", "to_base64",
-               "from_base64", "normalize"],
+               "from_base64", "normalize", "to_hex", "from_hex",
+               "to_utf8", "from_utf8"],
     "date": ["year", "month", "day", "quarter", "day_of_week", "dow",
              "day_of_year", "doy", "date_trunc", "date_diff", "date_add",
              "from_unixtime", "to_unixtime"],

@@ -898,6 +898,11 @@ class Parser:
                 # TIMESTAMP 'yyyy-mm-dd[ hh:mm:ss[.ffffff]]'
                 s = self.next().value
                 return ast.Literal(s, "timestamp", s)
+            if (name == "time" and not was_quoted
+                    and self.peek().kind == "string"):
+                # TIME 'hh:mm:ss[.ffffff]'
+                s = self.next().value
+                return ast.Literal(s, "time", s)
             if name in ("current_date", "current_timestamp",
                         "localtimestamp") and not was_quoted and not (
                     self.peek().kind == "op"

@@ -123,6 +123,17 @@ class VarcharType(Type):
         return -1  # codes are >= 0; -1 marks null even without a validity mask
 
 
+class VarbinaryType(VarcharType):
+    """Byte strings, stored through the SAME dictionary machinery as
+    VARCHAR via the latin-1 bijection (bytes 0x00-0xFF ↔ U+0000-U+00FF):
+    lexicographic order on the mapped text IS byte order, equality is
+    byte equality, and `length` is the byte count. Reference:
+    spi/type/VarbinaryType + operator/scalar/VarbinaryFunctions."""
+
+    def __init__(self):
+        object.__setattr__(self, "name", "varbinary")
+
+
 @dataclasses.dataclass(frozen=True)
 class ArrayType(Type):
     """ARRAY(element). Device value: [capacity, W] plane of element values
@@ -198,10 +209,14 @@ REAL = _FixedType("real", "float32")
 DOUBLE = _FixedType("double", "float64")
 DATE = _FixedType("date", "int32")
 TIMESTAMP = _FixedType("timestamp", "int64")
+# TIME: microseconds since midnight (the reference's TIME w/o time zone;
+# spi/type/TimeType — millis there, micros here matching TIMESTAMP)
+TIME = _FixedType("time", "int64")
 # geometries live as int32 codes into per-expression parsed-WKT tables
 # (expr/geo.py); never stored in tables — ST_AsText round-trips to varchar
 GEOMETRY = _FixedType("geometry", "int32")
 VARCHAR = VarcharType()
+VARBINARY = VarbinaryType()
 
 
 _NUMERIC_RANK = {
@@ -298,10 +313,12 @@ def parse_type(s: str) -> Type:
         "float": REAL,
         "double": DOUBLE,
         "date": DATE,
+        "time": TIME,
         "timestamp": TIMESTAMP,
         "geometry": GEOMETRY,
         "varchar": VARCHAR,
         "string": VARCHAR,
+        "varbinary": VARBINARY,
     }
     if s in simple:
         return simple[s]

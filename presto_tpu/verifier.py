@@ -1,0 +1,158 @@
+"""Verifier — replay a query corpus on two engines and compare checksums.
+
+Reference: presto-verifier (`verifier/framework/` + `checksum/`): replays
+production queries against a control and a test cluster and compares
+result checksums, tolerating float reassociation and row order. Here the
+two "clusters" are any pair of engines exposing `run_batch(sql)` — the
+canonical pairing is LocalRunner (control) vs DistributedRunner or
+MeshExecutor (test), which is exactly the cross-check the engine needs:
+same SQL through the streaming single-device path and through
+fragmenter → exchanges → workers.
+
+Checksums are ORDER-INSENSITIVE (sum of row hashes mod 2^64) unless the
+query's top level is an ORDER BY, in which case row order is part of the
+contract and a position-sensitive hash is used. Floats are canonicalized
+to 9 significant digits before hashing (the reference's relative-error
+tolerance for reaggregated doubles); decimals compare exactly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import time
+from typing import List, Optional
+
+from presto_tpu.dictionary import fnv64
+
+_MASK = (1 << 64) - 1
+
+
+def _canon(v) -> str:
+    if v is None:
+        return "\0"
+    if isinstance(v, bool):
+        return "t" if v else "f"
+    if isinstance(v, float):
+        if v != v:
+            return "nan"
+        if math.isinf(v):
+            return "inf" if v > 0 else "-inf"
+        return f"{v:.9g}"
+    return str(v)
+
+
+def result_checksum(batch, order_sensitive: bool = False) -> dict:
+    """Per-result checksum: row count + combined row-hash + per-column
+    null counts (the reference's ChecksumValidator computes comparable
+    column-level aggregates)."""
+    d = batch.to_pydict()
+    cols = list(d)
+    rows = len(d[cols[0]]) if cols else 0
+    total = 0
+    for i in range(rows):
+        rh = fnv64("|".join(_canon(d[c][i]) for c in cols))
+        if order_sensitive:
+            rh = (rh * (i + 0x9E3779B97F4A7C15)) & _MASK
+        total = (total + rh) & _MASK
+    nulls = {c: sum(1 for v in d[c] if v is None or v != v) for c in cols}
+    return {"rows": rows, "hash": total, "nulls": nulls,
+            "columns": cols}
+
+
+@dataclasses.dataclass
+class VerifyOutcome:
+    name: str
+    sql: str
+    status: str          # matched | mismatched | control_failed | test_failed
+    detail: str = ""
+    control_s: float = 0.0
+    test_s: float = 0.0
+
+    @property
+    def ok(self) -> bool:
+        return self.status == "matched"
+
+
+class Verifier:
+    """control/test pairing of any two engines with `run_batch(sql)`."""
+
+    def __init__(self, control, test):
+        self.control = control
+        self.test = test
+
+    @staticmethod
+    def _order_sensitive(sql: str) -> bool:
+        """Top-level ORDER BY ⇒ row order is part of the result contract.
+        Scan with paren-depth tracking (and string-literal skipping): an
+        `order by` at depth 0 imposes order; one inside parens (subquery /
+        function args / window spec) does not."""
+        import re as _re
+
+        s = sql.lower()
+        depth = 0
+        i = 0
+        found = False
+        while i < len(s):
+            ch = s[i]
+            if ch == "'":
+                j = s.find("'", i + 1)
+                i = len(s) if j == -1 else j + 1
+                continue
+            if ch == "(":
+                depth += 1
+            elif ch == ")":
+                depth = max(0, depth - 1)
+            elif depth == 0 and s.startswith("order", i) and \
+                    _re.match(r"order\s+by\b", s[i:]):
+                found = True
+            i += 1
+        return found
+
+    def verify(self, sql: str, name: Optional[str] = None) -> VerifyOutcome:
+        name = name or sql.strip().split("\n")[0][:60]
+        t0 = time.perf_counter()
+        try:
+            control = self.control.run_batch(sql)
+        except Exception as e:
+            return VerifyOutcome(name, sql, "control_failed",
+                                 f"{type(e).__name__}: {e}")
+        c_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        try:
+            test = self.test.run_batch(sql)
+        except Exception as e:
+            return VerifyOutcome(name, sql, "test_failed",
+                                 f"{type(e).__name__}: {e}", c_s)
+        t_s = time.perf_counter() - t0
+        osens = self._order_sensitive(sql)
+        cc = result_checksum(control, osens)
+        tc = result_checksum(test, osens)
+        if cc == tc:
+            return VerifyOutcome(name, sql, "matched", "", c_s, t_s)
+        diffs = []
+        for k in ("rows", "hash", "nulls", "columns"):
+            if cc[k] != tc[k]:
+                diffs.append(f"{k}: control={cc[k]} test={tc[k]}")
+        return VerifyOutcome(name, sql, "mismatched", "; ".join(diffs),
+                             c_s, t_s)
+
+    def run_suite(self, queries) -> List[VerifyOutcome]:
+        """`queries`: iterable of sql strings or (name, sql) pairs."""
+        out = []
+        for q in queries:
+            name, sql = q if isinstance(q, tuple) else (None, q)
+            out.append(self.verify(sql, name))
+        return out
+
+
+def report(outcomes: List[VerifyOutcome]) -> str:
+    lines = []
+    n_ok = sum(1 for o in outcomes if o.ok)
+    lines.append(f"{n_ok}/{len(outcomes)} matched")
+    for o in outcomes:
+        mark = "OK " if o.ok else o.status.upper()
+        lines.append(f"  [{mark}] {o.name}  "
+                     f"(control {o.control_s:.2f}s, test {o.test_s:.2f}s)"
+                     + (f"  {o.detail}" if o.detail else ""))
+    return "\n".join(lines)

@@ -214,12 +214,44 @@ def test_json_functions(runner):
 
     assert norm(got.a) == ["1", "2", None]
     assert norm(got.c) == ["hi", None, None]
-    assert list(got.n.astype(int)) == [3, 0, -1]
+    # non-array input → NULL (JsonFunctions.jsonArrayLength semantics)
+    n = [None if v is None or v != v else int(v) for v in got.n]
+    assert n == [3, 0, None]
     cnt = runner.run("""
         select count(json_extract_scalar(js, '$.b.c')) as c,
                count_if(json_extract_scalar(js, '$.a') is null) as n_null
         from j""")
     assert int(cnt.c[0]) == 1 and int(cnt.n_null[0]) == 1
+
+
+def test_json_family(runner):
+    """json_extract / json_array_get / json_size / json_format /
+    json_parse / json_array_contains / is_json_scalar
+    (operator/scalar/JsonFunctions.java)."""
+    got = runner.run("""
+        select json_extract(js, '$.b') as b,
+               json_array_get(ja, 0) as a0,
+               json_array_get(ja, -1) as al,
+               json_size(js, '$.arr') as nsz,
+               json_format(json_parse(ja)) as fmt,
+               json_array_contains(ja, 2) as has2,
+               is_json_scalar(ja) as scal
+        from j""")
+
+    def norm(col):
+        return [v if isinstance(v, str) else None for v in col]
+
+    assert norm(got.b) == ['{"c":"hi"}', None, None]
+    assert norm(got.a0) == ["1", None, None]
+    assert norm(got.al) == ["3", None, None]
+    nsz = [None if v is None or v != v else int(v) for v in got.nsz]
+    assert nsz == [3, 0, None]  # [] has size 0; malformed json → NULL
+    assert norm(got.fmt) == ["[1,2,3]", "[]", '{"x":1}']
+    assert [bool(v) for v in got.has2] == [True, False, False]
+    assert [bool(v) for v in got.scal] == [False, False, False]
+    one = runner.run(
+        "select is_json_scalar(json_extract(js, '$.a')) as s from j limit 1")
+    assert bool(one.s[0])
 
 
 def test_unixtime_roundtrip(runner):

@@ -1,0 +1,98 @@
+"""TIME and VARBINARY types.
+
+Reference: spi/type/TimeType (time-of-day), spi/type/VarbinaryType +
+operator/scalar/VarbinaryFunctions.java. TPU-native shape: TIME is int64
+microseconds-of-day (plain device arithmetic); VARBINARY rides the
+latin-1 bijection through the VARCHAR dictionary machinery, so byte
+equality/order/length need no new kernels."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from presto_tpu.catalog.memory import MemoryConnector
+from presto_tpu.connector import Catalog
+from presto_tpu.exec import ExecConfig, LocalRunner
+
+
+@pytest.fixture(scope="module")
+def runner():
+    conn = MemoryConnector()
+    conn.add_table("shifts", pd.DataFrame({
+        "worker": ["a", "b", "c", "d"],
+        # micros of day: 08:30:00, 12:00:00, 23:59:59, 00:15:30
+        "start": np.array([30600, 43200, 86399, 930], np.int64) * 1_000_000,
+    }), types={"start": __import__("presto_tpu.types",
+                                   fromlist=["TIME"]).TIME})
+    conn.add_table("blobs", pd.DataFrame({
+        "k": [1, 2, 3],
+        "data": [b"hello", b"\x00\xff\x10", b"caf\xc3\xa9"],
+    }))
+    cat = Catalog()
+    cat.register("m", conn, default=True)
+    return LocalRunner(cat, ExecConfig(batch_rows=256))
+
+
+def test_time_literals_compare_and_extract(runner):
+    got = runner.run("""
+        select worker, hour(start) as h, minute(start) as m,
+               extract(second from start) as s
+        from shifts where start >= time '08:30:00'
+        order by start""")
+    assert got.worker.tolist() == ["a", "b", "c"]
+    assert got.h.tolist() == [8, 12, 23]
+    assert got.m.tolist() == [30, 0, 59]
+    assert got.s.tolist() == [0, 0, 59]
+
+
+def test_time_fractional_literal_and_minmax(runner):
+    got = runner.run("select min(start) as lo, max(start) as hi from shifts "
+                     "where start < time '12:00:00.000001'")
+    assert int(got.lo[0]) == 930 * 1_000_000
+    assert int(got.hi[0]) == 43200 * 1_000_000
+
+
+def test_varbinary_roundtrip_and_length(runner):
+    got = runner.run("select k, data, length(data) as n from blobs order by k")
+    assert got.data.tolist() == [b"hello", b"\x00\xff\x10", b"caf\xc3\xa9"]
+    assert got.n.tolist() == [5, 3, 5]  # BYTE count, not codepoints
+
+
+def test_hex_utf8_conversions(runner):
+    got = runner.run("""
+        select to_hex(data) as hx,
+               from_utf8(data) as s,
+               to_hex(from_hex(to_hex(data))) as rt
+        from blobs order by k""")
+    assert got.hx.tolist() == ["68656C6C6F", "00FF10", "636166C3A9"]
+    assert got.s.tolist() == ["hello", "\x00�\x10", "café"]
+    assert got.rt.tolist() == got.hx.tolist()
+
+
+def test_binary_digest(runner):
+    import hashlib
+
+    got = runner.run("select to_hex(sha256(data)) as d from blobs "
+                     "where k = 1")
+    want = hashlib.sha256(b"hello").hexdigest().upper()
+    assert got.d[0] == want
+    # varchar overload still returns lowercase hex TEXT (extension)
+    got2 = runner.run("select sha256(worker) as d from shifts "
+                      "where worker = 'a'")
+    assert got2.d[0] == hashlib.sha256(b"a").hexdigest()
+
+
+def test_varbinary_group_and_join(runner):
+    """Bytes behave as first-class values through group-by and joins."""
+    got = runner.run("""
+        select b1.data as d, count(*) as c
+        from blobs b1 join blobs b2 on b1.data = b2.data
+        group by b1.data order by c desc, d""")
+    assert len(got) == 3
+    assert got.c.tolist() == [1, 1, 1]
+
+
+def test_to_utf8(runner):
+    got = runner.run("select to_hex(to_utf8(worker)) as h from shifts "
+                     "where worker = 'a'")
+    assert got.h[0] == "61"
