@@ -777,6 +777,7 @@ _VARIANCE_FNS = {"var_samp", "var_pop", "stddev_samp", "stddev_pop"}
 _COVAR_FNS = {"covar_pop", "covar_samp", "corr"}
 _NON_DECOMPOSABLE_FNS = {"approx_percentile", "__approx_percentile_w",
                          "max_by", "min_by", "array_agg", "map_agg",
+                         "numeric_histogram",
                          "count_distinct", "sum_distinct", "avg_distinct"}
 
 _CHECKSUM_NULL = jnp.int64(-7046029254386353131)  # fixed NULL contribution
@@ -1049,8 +1050,9 @@ def _execute_materialized_aggregate(node: Aggregate, ctx: ExecContext) -> Iterat
     decomp = [a for a in node.aggs if a.fn not in _NON_DECOMPOSABLE_FNS]
     ndec = [a for a in node.aggs
             if a.fn in _NON_DECOMPOSABLE_FNS
-            and a.fn not in ("array_agg", "map_agg")]
-    arr_aggs = [a for a in node.aggs if a.fn in ("array_agg", "map_agg")]
+            and a.fn not in ("array_agg", "map_agg", "numeric_histogram")]
+    arr_aggs = [a for a in node.aggs
+                if a.fn in ("array_agg", "map_agg", "numeric_histogram")]
     layout = _asl(decomp, in_types)
     state_types = _sts(layout, in_types)
     jchain = _node_jit(node, "mat_chain", lambda: chain)
@@ -1096,6 +1098,59 @@ def _execute_materialized_aggregate(node: Aggregate, ctx: ExecContext) -> Iterat
                               state_types, in_types)
 
 
+def _attach_numeric_histogram(acc: Batch, full: Batch, a, row_gi,
+                              live) -> Batch:
+    """numeric_histogram(buckets, x) → map<double,double> per group
+    (reference: NumericHistogramAggregation over aggregation/NumericHistogram
+    — streaming nearest-centroid merging). Materialized form: per group,
+    start from the distinct (value, count) pairs and merge the CLOSEST
+    adjacent pair (weighted mean, summed count) until ≤ buckets remain —
+    the same fixed-size centroid invariant, computed over the gathered
+    input."""
+    b = int(a.param)
+    c = full.column(a.arg)
+    vals = np.asarray(c.values)[live].astype(np.float64)
+    valid = np.asarray(c.valid_mask())[live]
+    cap = acc.capacity
+    per_group: Dict[int, list] = {}
+    for r in np.nonzero(valid)[0]:
+        per_group.setdefault(int(row_gi[r]), []).append(vals[r])
+
+    hists = {}
+    w = 1
+    for gi, xs in per_group.items():
+        u, cnt = np.unique(np.asarray(xs), return_counts=True)
+        u = u.astype(np.float64)
+        cnt = cnt.astype(np.float64)
+        while len(u) > b:
+            gaps = np.diff(u)
+            i = int(np.argmin(gaps))
+            tot = cnt[i] + cnt[i + 1]
+            merged = (u[i] * cnt[i] + u[i + 1] * cnt[i + 1]) / tot
+            u = np.concatenate([u[:i], [merged], u[i + 2:]])
+            cnt = np.concatenate([cnt[:i], [tot], cnt[i + 2:]])
+        hists[gi] = (u, cnt)
+        w = max(w, len(u))
+
+    keys2d = np.zeros((cap, w), np.float64)
+    plane = np.zeros((cap, w), np.float64)
+    sizes = np.zeros(cap, np.int32)
+    # a group whose inputs were all NULL yields SQL NULL, not an empty
+    # map (NumericHistogramAggregation's no-input-accumulated contract)
+    validity = np.zeros(cap, bool)
+    for gi, (u, cnt) in hists.items():
+        keys2d[gi, :len(u)] = u
+        plane[gi, :len(u)] = cnt
+        sizes[gi] = len(u)
+        validity[gi] = True
+    return acc.with_column(
+        a.symbol, a.type,
+        Column(jnp.asarray(plane), jnp.asarray(validity),
+               sizes=jnp.asarray(sizes),
+               evalid=None,
+               keys=jnp.asarray(keys2d)))
+
+
 def _attach_array_aggs(acc: Batch, full: Batch, aggs, key_syms) -> Batch:
     """array_agg: per-group element lists built host-side over the
     materialized input (reference: ArrayAggregationFunction's grouped
@@ -1132,6 +1187,9 @@ def _attach_array_aggs(acc: Batch, full: Batch, aggs, key_syms) -> Batch:
         )
         row_gi[r] = gmap[key]
     for a in aggs:
+        if a.fn == "numeric_histogram":
+            acc = _attach_numeric_histogram(acc, full, a, row_gi, live)
+            continue
         is_map = a.fn == "map_agg"
         c = full.column(a.arg)
         vals = np.asarray(c.values)[live]

@@ -943,10 +943,17 @@ def _eval_call(e: Call, ctx: CompileContext):
         if fn in _STR_TO_INT:
             pyfn = _str_int_pyfn(fn, cargs)
             if fn in _STR_INT_NULLABLE:
+                memo: dict = {}  # one parse per entry, not one per lut
+
+                def pf(s, _m=memo, _f=pyfn):
+                    if s not in _m:
+                        _m[s] = _f(s)
+                    return _m[s]
+
                 table = d.int_lut((fn, cargs, "v"),
-                                  lambda s: pyfn(s) or 0)
+                                  lambda s: pf(s) or 0)
                 nulls = d.int_lut((fn, cargs, "null"),
-                                  lambda s: pyfn(s) is None, dtype=np.bool_)
+                                  lambda s: pf(s) is None, dtype=np.bool_)
                 codes, valid = _eval(operand, ctx)
                 notnull = ~jnp.asarray(nulls)[codes + 1]
                 valid = notnull if valid is None else valid & notnull

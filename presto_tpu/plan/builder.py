@@ -234,7 +234,7 @@ _AGG_FUNCS = {
     "count_if",
     # approx family (ApproximateCountDistinct / ApproximateLongPercentile —
     # here computed exactly, which satisfies the approximation contract)
-    "approx_distinct", "approx_percentile",
+    "approx_distinct", "approx_percentile", "numeric_histogram",
     # argmax family (AbstractMinMaxBy)
     "max_by", "min_by",
     # structural (ArrayAggregationFunction / MapAggregation — materialized
@@ -624,12 +624,16 @@ class ExprAnalyzer:
             out_t = VARCHAR if name == "to_base64" else VARBINARY
             return Call(out_t, "__vb_" + name, args)
         if name in ("to_hex", "from_hex", "to_utf8", "from_utf8"):
-            if name in ("to_hex", "from_utf8") and (
-                    not args or args[0].type.name != "varbinary"):
-                # varbinary-only signatures (VarbinaryFunctions.java);
-                # arbitrary varchar text need not fit the latin-1 byte map
-                raise AnalysisError(f"{name}() expects varbinary")
-            out_t = VARCHAR if name in ("to_hex", "from_utf8") else VARBINARY
+            want_vb = name in ("to_hex", "from_utf8")
+            got_vb = bool(args) and args[0].type.name == "varbinary"
+            if want_vb != got_vb:
+                # exact signatures (VarbinaryFunctions.java): to_hex /
+                # from_utf8 take varbinary; from_hex / to_utf8 take
+                # varchar — silently re-encoding would corrupt bytes
+                raise AnalysisError(
+                    f"{name}() expects "
+                    f"{'varbinary' if want_vb else 'varchar'}")
+            out_t = VARCHAR if want_vb else VARBINARY
             return Call(out_t, name, args)
         if name in ("upper", "lower", "trim", "ltrim", "rtrim", "reverse",
                     "replace", "lpad", "rpad", "split_part",
@@ -2082,7 +2086,26 @@ class Planner:
                 arg_sym = None
                 arg_t = BIGINT
             else:
-                ae = analyzer.analyze(fc.args[0])
+                if fn == "numeric_histogram":
+                    # numeric_histogram(buckets, x) — buckets is the
+                    # leading CONSTANT (NumericHistogramAggregation)
+                    if len(fc.args) != 2:
+                        raise AnalysisError(
+                            "numeric_histogram(buckets, x) takes two "
+                            "arguments")
+                    be = analyzer.analyze(fc.args[0])
+                    from presto_tpu.expr.ir import Constant as _Const
+
+                    if not isinstance(be, _Const) or be.value is None:
+                        raise AnalysisError(
+                            "numeric_histogram bucket count must be a "
+                            "constant")
+                    param = float(int(be.value))
+                    if param < 2:
+                        raise AnalysisError("bucket count must be >= 2")
+                    ae = analyzer._to_double(analyzer.analyze(fc.args[1]))
+                else:
+                    ae = analyzer.analyze(fc.args[0])
                 if isinstance(ae, InputRef):
                     arg_sym = ae.name
                 else:
@@ -2117,6 +2140,8 @@ class Planner:
                     raise AnalysisError(
                         "map_agg with floating-point keys is not supported")
                 out_t = MapType(arg_t, arg2_t)
+            elif fn == "numeric_histogram":
+                out_t = MapType(DOUBLE, DOUBLE)
             else:
                 out_t = _agg_output_type(fn, arg_t, fc.is_star)
             sym = self.symbols.fresh(fn)

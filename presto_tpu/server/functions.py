@@ -58,7 +58,7 @@ _AGGREGATE = ["count", "sum", "avg", "min", "max", "stddev", "stddev_pop",
               "covar_samp", "corr", "geometric_mean", "bool_and", "bool_or",
               "every", "arbitrary", "any_value", "checksum", "count_if",
               "approx_distinct", "approx_percentile", "max_by", "min_by",
-              "array_agg", "map_agg"]
+              "array_agg", "map_agg", "numeric_histogram"]
 
 _WINDOW = ["row_number", "rank", "dense_rank", "percent_rank", "cume_dist",
            "ntile", "lag", "lead", "first_value", "last_value", "nth_value"]

@@ -9,11 +9,13 @@ MeshExecutor (test), which is exactly the cross-check the engine needs:
 same SQL through the streaming single-device path and through
 fragmenter → exchanges → workers.
 
-Checksums are ORDER-INSENSITIVE (sum of row hashes mod 2^64) unless the
-query's top level is an ORDER BY, in which case row order is part of the
-contract and a position-sensitive hash is used. Floats are canonicalized
-to 9 significant digits before hashing (the reference's relative-error
-tolerance for reaggregated doubles); decimals compare exactly.
+Checksums are ORDER-INSENSITIVE (sum of row hashes mod 2^64) — rows with
+equal sort keys have no defined order even under ORDER BY, so the
+verifier, like the reference, compares row MULTISETS. Floats (incl.
+np.float32/64) canonicalize to 9 significant digits before hashing (the
+reference's relative-error tolerance for reaggregated doubles); decimals
+compare exactly; MAP/ARRAY values canonicalize recursively with sorted
+map keys.
 """
 
 from __future__ import annotations
@@ -33,12 +35,29 @@ def _canon(v) -> str:
         return "\0"
     if isinstance(v, bool):
         return "t" if v else "f"
-    if isinstance(v, float):
+    try:
+        import numpy as _np
+
+        _floats = (float, _np.floating)
+        _ints = (int, _np.integer)
+    except ImportError:  # pragma: no cover
+        _floats, _ints = float, int
+    if isinstance(v, _floats):
+        v = float(v)
         if v != v:
             return "nan"
         if math.isinf(v):
             return "inf" if v > 0 else "-inf"
         return f"{v:.9g}"
+    if isinstance(v, _ints):
+        return str(int(v))
+    if isinstance(v, dict):
+        # MAP results: insertion order is engine-dependent — sort by
+        # canonical key, canonicalize values recursively
+        items = sorted((_canon(k), _canon(x)) for k, x in v.items())
+        return "{" + ",".join(f"{k}:{x}" for k, x in items) + "}"
+    if isinstance(v, (list, tuple)):
+        return "[" + ",".join(_canon(x) for x in v) + "]"
     return str(v)
 
 
@@ -81,34 +100,6 @@ class Verifier:
         self.control = control
         self.test = test
 
-    @staticmethod
-    def _order_sensitive(sql: str) -> bool:
-        """Top-level ORDER BY ⇒ row order is part of the result contract.
-        Scan with paren-depth tracking (and string-literal skipping): an
-        `order by` at depth 0 imposes order; one inside parens (subquery /
-        function args / window spec) does not."""
-        import re as _re
-
-        s = sql.lower()
-        depth = 0
-        i = 0
-        found = False
-        while i < len(s):
-            ch = s[i]
-            if ch == "'":
-                j = s.find("'", i + 1)
-                i = len(s) if j == -1 else j + 1
-                continue
-            if ch == "(":
-                depth += 1
-            elif ch == ")":
-                depth = max(0, depth - 1)
-            elif depth == 0 and s.startswith("order", i) and \
-                    _re.match(r"order\s+by\b", s[i:]):
-                found = True
-            i += 1
-        return found
-
     def verify(self, sql: str, name: Optional[str] = None) -> VerifyOutcome:
         name = name or sql.strip().split("\n")[0][:60]
         t0 = time.perf_counter()
@@ -125,9 +116,13 @@ class Verifier:
             return VerifyOutcome(name, sql, "test_failed",
                                  f"{type(e).__name__}: {e}", c_s)
         t_s = time.perf_counter() - t0
-        osens = self._order_sensitive(sql)
-        cc = result_checksum(control, osens)
-        tc = result_checksum(test, osens)
+        # checksums are ALWAYS order-insensitive, like the reference
+        # verifier: rows with equal sort keys have no defined order, so a
+        # position-mixed hash would flag legitimate tie reorderings.
+        # (result_checksum's order_sensitive mode remains available for
+        # callers that control tie-freedom.)
+        cc = result_checksum(control)
+        tc = result_checksum(test)
         if cc == tc:
             return VerifyOutcome(name, sql, "matched", "", c_s, t_s)
         diffs = []

@@ -123,3 +123,63 @@ def test_grace_with_nulls_and_global(cat):
     assert a.c.tolist() == b.c.tolist()
     assert [x if x is None or not pd.isna(x) else None for x in a.s.tolist()] \
         == [x if x is None or not pd.isna(x) else None for x in b.s.tolist()]
+
+
+# ---- grace × memory-pool interplay (the branches that interact:
+# spill on/off, grace bypass, revocation, small pools) ------------------
+
+def test_grace_under_tight_pool(cat):
+    """Grace-from-start WITH a small memory pool: partition replay's
+    absorb runs with allow_spill=False and must stay inside the pool
+    (accounting was only exercised pool-less before)."""
+    base = _baseline(cat)
+    r = LocalRunner(cat, ExecConfig(
+        batch_rows=1 << 11, agg_capacity=1 << 8, agg_cap_ceiling=1 << 9,
+        memory_pool_bytes=24_000_000, spill_partitions=16))
+    _check(r.run(SQL), base)
+
+
+def test_midstream_overflow_with_pool_and_revocation(cat):
+    """The in-memory table grows, crosses the revoke threshold (spilling
+    state pages), THEN outgrows the ceiling mid-stream (raw grace
+    handoff): both spillers finalize bucket-wise into one answer."""
+    base = _baseline(cat)
+    r = LocalRunner(cat, ExecConfig(
+        batch_rows=1 << 11, agg_capacity=1 << 7, agg_cap_ceiling=1 << 12,
+        memory_pool_bytes=16_000_000,
+        memory_revoking_threshold=0.5, memory_revoking_target=0.2))
+    _check(r.run(SQL), base)
+
+
+def test_grace_disabled_when_spill_off(cat):
+    """spill_enabled=False forbids the grace path: the table must grow in
+    memory instead and still answer correctly (growth-ladder replay)."""
+    base = _baseline(cat)
+    r = LocalRunner(cat, ExecConfig(
+        batch_rows=1 << 11, agg_capacity=1 << 7, agg_cap_ceiling=1 << 9,
+        spill_enabled=False))
+    _check(r.run(SQL), base)
+
+
+def test_tiny_pool_without_spill_fails_cleanly(cat):
+    """No spill + a pool too small for the group table: a clean
+    ExceededMemoryLimit, not a wrong answer or a hang."""
+    r = LocalRunner(cat, ExecConfig(
+        batch_rows=1 << 11, agg_capacity=1 << 7, spill_enabled=False,
+        memory_pool_bytes=400_000))
+    with pytest.raises(Exception, match="memory"):
+        r.run(SQL)
+
+
+def test_grace_distributed_with_pool(cat):
+    """Distributed partial-passthrough + final grace merge under
+    per-worker pools: worker-shared accounting with revokers must not
+    corrupt across the exchange."""
+    from presto_tpu.server.coordinator import DistributedRunner
+
+    base = _baseline(cat)
+    cfg = ExecConfig(batch_rows=1 << 11, agg_capacity=1 << 8,
+                     agg_cap_ceiling=1 << 10,
+                     memory_pool_bytes=32_000_000)
+    with DistributedRunner(cat, n_workers=2, config=cfg) as dist:
+        _check(dist.run(SQL), base)
