@@ -21,6 +21,15 @@ def main():
     ap.add_argument("--cpu", action="store_true")
     ap.add_argument("--sf", type=float, default=0.01)
     args = ap.parse_args()
+    if not args.cpu:
+        # the device tunnel can wedge indefinitely — reuse bench.py's
+        # subprocess probe (one timeout policy for demo and bench) and
+        # fall back to CPU instead of hanging the demo
+        from bench import _probe_device
+
+        if not _probe_device():
+            print("device probe failed — falling back to CPU")
+            args.cpu = True
     if args.cpu:
         import jax
 
