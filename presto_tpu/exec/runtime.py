@@ -2936,21 +2936,26 @@ def _truncate(b: Batch, cap: int) -> Batch:
 # plan entry
 
 
+def bind_scalar_subqueries(qp: QueryPlan, ctx: ExecContext) -> None:
+    """Execute the plan's uncorrelated scalar subqueries (each gathers to
+    one value via the local streaming engine) and bind them as Constants —
+    shared by run_plan, the coordinator and the mesh executor so the
+    0-row/multi-row semantics can never diverge between engines."""
+    if not qp.scalar_subqueries:
+        return
+    bindings = {}
+    for sym, sub in qp.scalar_subqueries.items():
+        sub_out = run_plan(sub, ctx)
+        vals = sub_out.to_pydict(decode_strings=False)[sub_out.names[0]]
+        if len(vals) != 1:
+            raise RuntimeError(f"scalar subquery returned {len(vals)} rows")
+        bindings[sym] = Constant(sub_out.types[0], vals[0], raw=True)
+    _bind_plan_params(qp.root, bindings)
+
+
 def run_plan(qp: QueryPlan, ctx: ExecContext) -> Batch:
     """Execute a QueryPlan to a single host-collectable Batch."""
-    # bind uncorrelated scalar subqueries first
-    if qp.scalar_subqueries:
-        bindings = {}
-        for sym, sub in qp.scalar_subqueries.items():
-            sub_out = run_plan(sub, ctx)
-            d = sub_out.to_pydict(decode_strings=False)
-            colname = sub_out.names[0]
-            vals = d[colname]
-            if len(vals) != 1:
-                raise RuntimeError(f"scalar subquery returned {len(vals)} rows")
-            t = sub_out.types[0]
-            bindings[sym] = Constant(t, vals[0], raw=True)
-        _bind_plan_params(qp.root, bindings)
+    bind_scalar_subqueries(qp, ctx)
 
     # local grouped execution: mark bucket-colocated joins so the executor
     # sweeps them lifespan-by-lifespan (the fragmenter does this for the

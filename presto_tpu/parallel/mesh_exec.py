@@ -278,6 +278,19 @@ class MeshExecutor:
                      {c: table.batch.dicts[c] for c in rsyms
                       if c in table.batch.dicts})
 
+    def _expand_pairs(self, probe: Batch, table, pba, lkeys, rkeys,
+                      diags: list):
+        """Bounded-fanout pair expansion with overflow accounting — shared
+        by joins and residual semijoins so the capacity formula and the
+        MeshOverflow diag protocol can't diverge."""
+        lo, counts, offsets, total, _ = probe_counts(table, pba, lkeys,
+                                                     rkeys)
+        out_cap = probe.capacity * self.fanout_budget * self._cap_boost
+        pr, bi, ol = probe_expand(table, pba, lkeys, rkeys,
+                                  lo, counts, offsets, 0, out_cap)
+        diags.append(jnp.maximum(total - out_cap, 0))
+        return pr, bi, ol
+
     def _lower_join(self, node: HashJoin, probe: Batch, build: Batch,
                     diags: list) -> Batch:
         lsyms = [n for n, _ in node.left.output]
@@ -309,13 +322,9 @@ class MeshExecutor:
                                                                bm))
             return out
         # bounded fanout: one expansion chunk of probe_cap × fanout_budget
-        lo, counts, offsets, total, _ = probe_counts(
-            table, pba, tuple(node.left_keys), tuple(node.right_keys))
-        out_cap = probe.capacity * self.fanout_budget * self._cap_boost
-        pr, bi, ol = probe_expand(
-            table, pba, tuple(node.left_keys), tuple(node.right_keys),
-            lo, counts, offsets, 0, out_cap)
-        diags.append(jnp.maximum(total - out_cap, 0))
+        pr, bi, ol = self._expand_pairs(
+            probe, table, pba, tuple(node.left_keys),
+            tuple(node.right_keys), diags)
         out = gather_join_output(probe, table, pr, bi, ol, lsyms, rsyms)
         if node.kind in ("left", "full"):
             exists = (jnp.zeros(probe.capacity, dtype=jnp.int32)
@@ -359,12 +368,42 @@ class MeshExecutor:
         if isinstance(node, SemiJoin):
             probe = self._lower(node.left, fragments, staged, memo, diags)
             build = self._lower(node.right, fragments, staged, memo, diags)
-            table = build_side(build, tuple(node.right_keys))
-            pba = align_probe_strings(probe, tuple(node.left_keys), table,
-                                      tuple(node.right_keys))
-            _, matched = probe_unique(table, pba, tuple(node.left_keys),
-                                      tuple(node.right_keys))
-            keep = ~matched if node.negated else matched
+            lkeys, rkeys = tuple(node.left_keys), tuple(node.right_keys)
+            table = build_side(build, rkeys)
+            pba = align_probe_strings(probe, lkeys, table, rkeys)
+            if node.residual is None:
+                _, matched = probe_unique(table, pba, lkeys, rkeys)
+            else:
+                # correlated EXISTS with non-equi conjuncts (Q21 shape):
+                # bounded pair expansion + residual + per-probe-row ANY —
+                # the mesh form of _execute_semijoin's residual path
+                from presto_tpu.expr.compile import compile_predicate
+
+                lsyms = [n for n, _ in node.left.output]
+                rsyms = [n for n, _ in node.right.output]
+                pred = compile_predicate(node.residual)
+                pr, bi, ol = self._expand_pairs(probe, table, pba,
+                                                lkeys, rkeys, diags)
+                pair = gather_join_output(probe, table, pr, bi, ol,
+                                          lsyms, rsyms)
+                ok = pred(pair) & pair.live
+                matched = (jnp.zeros(probe.capacity, dtype=jnp.int32)
+                           .at[pr].max(ok.astype(jnp.int32), mode="drop")
+                           .astype(bool))
+            if node.negated:
+                keep = ~matched
+                if node.null_aware and node.residual is None:
+                    # NOT IN three-valued logic (same as the local
+                    # engine): a NULL probe key against a non-empty set
+                    # is NULL → row filtered
+                    key_valid = jnp.ones(probe.capacity, bool)
+                    for lk in lkeys:
+                        kv = probe.column(lk).validity
+                        if kv is not None:
+                            key_valid = key_valid & kv
+                    keep = keep & (key_valid | (table.n_rows == 0))
+            else:
+                keep = matched
             return probe.with_live(probe.live & keep)
         if isinstance(node, Sort):
             child = self._lower(node.child, fragments, staged, memo, diags)
@@ -467,6 +506,16 @@ class MeshExecutor:
         from presto_tpu.plan.optimizer import optimize
 
         qp = optimize(plan_query(sql, self.catalog), self.catalog)
+        if qp.scalar_subqueries:
+            # bind uncorrelated scalar subqueries before fragmenting (they
+            # gather to one value; the local streaming engine computes
+            # them host-side — shared helper with run_plan/coordinator)
+            from presto_tpu.exec.runtime import (
+                ExecContext,
+                bind_scalar_subqueries,
+            )
+
+            bind_scalar_subqueries(qp, ExecContext(self.catalog, self.config))
         dplan = fragment_plan(qp, self.catalog)
         return self.run_dplan(dplan)
 

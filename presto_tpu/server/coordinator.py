@@ -955,8 +955,7 @@ class Coordinator:
 
     def plan_distributed(self, sql: str, session=None,
                          stmt=None) -> DistributedPlan:
-        from presto_tpu.exec.runtime import ExecContext, _bind_plan_params, run_plan
-        from presto_tpu.expr.ir import Constant
+        from presto_tpu.exec.runtime import ExecContext
         from presto_tpu.plan.builder import plan_query
         from presto_tpu.plan.fragmenter import fragment_plan
         from presto_tpu.plan.optimizer import optimize
@@ -981,17 +980,11 @@ class Coordinator:
             # (the reference runs them as separate plan stages). They
             # EXECUTE here, before run_batch's fragment walk can see them —
             # authorize their scans now or a subquery smuggles denied data
+            from presto_tpu.exec.runtime import bind_scalar_subqueries
+
             self._enforce_access(
                 (s.root for s in qp.scalar_subqueries.values()), session)
-            ctx = ExecContext(self.catalog, self.config)
-            bindings = {}
-            for sym, sub in qp.scalar_subqueries.items():
-                sub_out = run_plan(sub, ctx)
-                vals = sub_out.to_pydict(decode_strings=False)[sub_out.names[0]]
-                if len(vals) != 1:
-                    raise RuntimeError(f"scalar subquery returned {len(vals)} rows")
-                bindings[sym] = Constant(sub_out.types[0], vals[0], raw=True)
-            _bind_plan_params(qp.root, bindings)
+            bind_scalar_subqueries(qp, ExecContext(self.catalog, self.config))
         dplan = fragment_plan(
             qp, self.catalog,
             broadcast_threshold_rows=threshold,

@@ -165,3 +165,30 @@ def test_intersect_except_on_mesh(env):
     qe = ("select c_custkey as k from customer except "
           "select o_custkey as k from orders order by k")
     _same(mx.run(qe), local.run(qe))
+
+
+def test_residual_semijoin_on_mesh(env):
+    """Correlated EXISTS / NOT EXISTS with non-equi residuals (Q21 shape):
+    the mesh pairs, evaluates the residual and ANY-reduces per probe row —
+    previously the residual was silently ignored."""
+    mx, local = env
+    q = ("select count(*) as c from lineitem l1 "
+         "where l1.l_receiptdate > l1.l_commitdate "
+         "and exists (select * from lineitem l2 "
+         "            where l2.l_orderkey = l1.l_orderkey "
+         "              and l2.l_suppkey <> l1.l_suppkey) "
+         "and not exists (select * from lineitem l3 "
+         "                where l3.l_orderkey = l1.l_orderkey "
+         "                  and l3.l_suppkey <> l1.l_suppkey "
+         "                  and l3.l_receiptdate > l3.l_commitdate)")
+    _same(mx.run(q), local.run(q))
+
+
+def test_scalar_subquery_param_on_mesh(env):
+    """Uncorrelated scalar subqueries bind coordinator-side before
+    fragmenting (Q11/Q15/Q22 shape) — previously unbound Params reached
+    the mesh compiler."""
+    mx, local = env
+    q = ("select count(*) as c from orders "
+         "where o_totalprice > (select avg(o_totalprice) from orders)")
+    _same(mx.run(q), local.run(q))
