@@ -336,6 +336,15 @@ class Batch:
                     arr = np.array(
                         [None if v is None else str(v).encode("latin-1")
                          for v in arr], dtype=object)
+                elif t.name in ("ipaddress", "ipprefix"):
+                    # canonical-byte entries render as address text
+                    from presto_tpu.expr import ip as _ip
+
+                    fmt = (_ip.format_address if t.name == "ipaddress"
+                           else _ip.format_prefix)
+                    arr = np.array(
+                        [None if v is None else fmt(str(v)) for v in arr],
+                        dtype=object)
             else:
                 from presto_tpu.types import DecimalType
 

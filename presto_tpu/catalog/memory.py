@@ -255,7 +255,42 @@ class MemoryTable:
                         [v.decode("latin-1")
                          if isinstance(v, (bytes, bytearray)) else str(v)
                          for v in arr], dtype=object)
-                d, codes = Dictionary.encode(arr.astype(str))
+                elif t.name in ("ipaddress", "ipprefix"):
+                    # ingest text (or 4/16 raw bytes) as canonical entries;
+                    # null slots were masked above and stay ""
+                    from presto_tpu.expr import ip as _ip
+
+                    def _canon(v, _pfx=(t.name == "ipprefix")):
+                        if v == "":
+                            return ""
+                        if isinstance(v, (bytes, bytearray)):
+                            if _pfx:
+                                # 17-byte canonical form only (16-byte
+                                # address bytes carry no prefix length)
+                                e = v.decode("latin-1")
+                                s = e if _ip.format_prefix(e) else None
+                            else:
+                                s = _ip.address_from_bytes(
+                                    v.decode("latin-1"))
+                        elif _pfx:
+                            s = _ip.parse_prefix(str(v))
+                        else:
+                            s = _ip.parse_address(str(v))
+                        if s is None:
+                            raise ValueError(f"invalid {t.name}: {v!r}")
+                        return s
+
+                    arr = np.array([_canon(v) for v in arr], dtype=object)
+                # canonical-byte types may carry trailing NULs — keep
+                # object dtype into encode (dictionary.safe_str_array).
+                # Plain varchar keeps the C-level astype(str) fast path:
+                # a per-element NUL scan on multi-million-row ingest
+                # would be pure overhead there
+                nul_risky = t.name in ("varbinary", "ipaddress",
+                                       "ipprefix", "tdigest(double)")
+                d, codes = Dictionary.encode(
+                    arr if arr.dtype == object and nul_risky
+                    else arr.astype(str))
                 if valid is not None:
                     codes = np.where(valid, codes, -1)
                 self.dicts[col] = d

@@ -21,6 +21,25 @@ from __future__ import annotations
 import numpy as np
 
 
+def safe_str_array(values) -> np.ndarray:
+    """Strings → numpy array WITHOUT the U-dtype trailing-NUL trap.
+
+    numpy fixed-width unicode silently drops trailing NUL characters at
+    conversion (np.asarray(['ab\\x00']) == 'ab'), which would collapse
+    distinct VARBINARY / IPADDRESS canonical-byte entries onto one code.
+    Entries that end with NUL keep object dtype (Python-string compares:
+    O(|dict|) host work only — per-row device paths see codes either way)."""
+    if not isinstance(values, np.ndarray):
+        # a plain list would go straight to U dtype (NULs already lost)
+        values = np.asarray(values, dtype=object)
+    arr = np.asarray(values)
+    if arr.dtype.kind == "O":
+        if any(isinstance(v, str) and v.endswith("\x00") for v in arr.flat):
+            return np.asarray([str(v) for v in arr.flat], dtype=object)
+        return arr.astype(str)
+    return arr
+
+
 def fnv64(s: str) -> int:
     """Deterministic 64-bit FNV-1a over utf-8 (process- and
     dictionary-independent, unlike Python's randomized hash())."""
@@ -44,7 +63,7 @@ class Dictionary:
     @staticmethod
     def encode(strings) -> tuple["Dictionary", np.ndarray]:
         """Build a dictionary from raw strings; return (dict, int32 codes)."""
-        arr = np.asarray(strings)
+        arr = safe_str_array(strings)
         uniq, codes = np.unique(arr, return_inverse=True)
         return Dictionary(uniq), codes.astype(np.int32)
 
@@ -117,7 +136,9 @@ class Dictionary:
         notnull = [i for i, o in enumerate(outs) if o is not None]
         if notnull:
             uniq, inv = np.unique(
-                np.asarray([str(outs[i]) for i in notnull]), return_inverse=True
+                safe_str_array(np.asarray(
+                    [str(outs[i]) for i in notnull], dtype=object)),
+                return_inverse=True,
             )
             body[notnull] = inv.astype(np.int32)
         else:

@@ -146,6 +146,13 @@ class ExecConfig:
     recoverable_grouped_execution: bool = False
     # phased mode: how long one build phase may run before the query fails
     phase_wait_timeout_s: float = 600.0
+    # coordinator-side split placement with rendezvous-hash soft affinity
+    # (reference: scheduler/NodeScheduler + SimpleNodeSelector and the
+    # SOFT_AFFINITY NodeSelectionStrategy): a split lands on the same
+    # worker across queries, so the worker's device split cache turns
+    # placement stability into real scan locality. Off → static
+    # task_index::n_tasks striding.
+    split_affinity: bool = True
 
 
 def _node_jit(node: PlanNode, key: str, builder, **jit_kwargs):
@@ -197,6 +204,14 @@ class ExecContext:
         # (SOURCE_DISTRIBUTION split placement, statically assigned)
         self.task_index: int = 0
         self.n_tasks: int = 1
+        # coordinator-assigned split ordinals per table (soft-affinity
+        # placement — scheduler/NodeScheduler analog). None → static
+        # task_index::n_tasks striding; ordinals index the connector's
+        # deterministic unpruned split enumeration; split_counts carries
+        # the coordinator's enumeration size per table (mismatch at scan
+        # time = the table changed underneath the plan → loud failure)
+        self.split_assignment: Optional[Dict[str, List[int]]] = None
+        self.split_counts: Optional[Dict[str, int]] = None
         # grouped (lifespan) execution: when set, scans of bucketed tables
         # read ONLY this bucket's splits (Lifespan.java:26-38 — the driver
         # group id); the colocated-join executor sweeps it over the task's
@@ -583,6 +598,26 @@ def _scan_batches(scan: TableScan, ctx: ExecContext) -> Iterator[Batch]:
     cap = round_up_capacity(min(nrows, ctx.config.batch_rows) or 1)
     splits = conn.splits(handle, nsplits)
     read_split = conn.read_split
+    assigned = (ctx.split_assignment or {}).get(scan.table)
+    if ctx.lifespan is not None and any(
+            s.bucket is not None for s in splits):
+        # grouped execution: this pass reads one bucket only; bucket→task
+        # assignment already happened in the lifespan sweep
+        splits = [s for s in splits if s.bucket == ctx.lifespan]
+    elif assigned is not None:
+        # coordinator soft-affinity placement: ordinals index the
+        # UNPRUNED enumeration (both sides enumerate deterministically).
+        # A count mismatch means the table changed between planning and
+        # scan — silently proceeding would drop (or double-read) splits
+        expected = (ctx.split_counts or {}).get(scan.table)
+        if expected is not None and expected != len(splits):
+            raise RuntimeError(
+                f"split enumeration for {scan.table} changed underneath "
+                f"the plan (coordinator saw {expected}, scan sees "
+                f"{len(splits)}) — retry the query")
+        splits = [splits[i] for i in assigned if i < len(splits)]
+    elif ctx.n_tasks > 1:
+        splits = splits[ctx.task_index::ctx.n_tasks]
     if scan.constraints and hasattr(conn, "prune_splits"):
         storage_bounds = _constraints_to_storage(scan, handle)
         if storage_bounds:
@@ -599,13 +634,6 @@ def _scan_batches(scan: TableScan, ctx: ExecContext) -> Iterator[Batch]:
                            _b=bounds):  # noqa: E306
                 return conn.read_split_constrained(
                     split, columns, capacity=capacity, constraints=_b)
-    if ctx.lifespan is not None and any(
-            s.bucket is not None for s in splits):
-        # grouped execution: this pass reads one bucket only; bucket→task
-        # assignment already happened in the lifespan sweep
-        splits = [s for s in splits if s.bucket == ctx.lifespan]
-    elif ctx.n_tasks > 1:
-        splits = splits[ctx.task_index::ctx.n_tasks]
     depth = ctx.config.scan_prefetch
     if depth <= 0 or len(splits) <= 1:
         for split in splits:
@@ -777,7 +805,7 @@ _VARIANCE_FNS = {"var_samp", "var_pop", "stddev_samp", "stddev_pop"}
 _COVAR_FNS = {"covar_pop", "covar_samp", "corr"}
 _NON_DECOMPOSABLE_FNS = {"approx_percentile", "__approx_percentile_w",
                          "max_by", "min_by", "array_agg", "map_agg",
-                         "numeric_histogram",
+                         "numeric_histogram", "tdigest_agg", "merge",
                          "count_distinct", "sum_distinct", "avg_distinct"}
 
 _CHECKSUM_NULL = jnp.int64(-7046029254386353131)  # fixed NULL contribution
@@ -1048,11 +1076,11 @@ def _execute_materialized_aggregate(node: Aggregate, ctx: ExecContext) -> Iterat
     key_syms = node.group_keys
     key_types = [in_types[k] for k in key_syms]
     decomp = [a for a in node.aggs if a.fn not in _NON_DECOMPOSABLE_FNS]
+    _HOST_AGGS = ("array_agg", "map_agg", "numeric_histogram",
+                  "tdigest_agg", "merge")
     ndec = [a for a in node.aggs
-            if a.fn in _NON_DECOMPOSABLE_FNS
-            and a.fn not in ("array_agg", "map_agg", "numeric_histogram")]
-    arr_aggs = [a for a in node.aggs
-                if a.fn in ("array_agg", "map_agg", "numeric_histogram")]
+            if a.fn in _NON_DECOMPOSABLE_FNS and a.fn not in _HOST_AGGS]
+    arr_aggs = [a for a in node.aggs if a.fn in _HOST_AGGS]
     layout = _asl(decomp, in_types)
     state_types = _sts(layout, in_types)
     jchain = _node_jit(node, "mat_chain", lambda: chain)
@@ -1151,6 +1179,53 @@ def _attach_numeric_histogram(acc: Batch, full: Batch, a, row_gi,
                keys=jnp.asarray(keys2d)))
 
 
+def _attach_tdigest(acc: Batch, full: Batch, a, row_gi, live) -> Batch:
+    """tdigest_agg(x[, w][, compression]) / merge(tdigest) → one digest
+    entry per group (expr/tdigest.py). Runs at the gathered single task
+    like the other host aggregates; the output column is a fresh
+    dictionary of serialized digests (reference:
+    TDigestAggregationFunction / MergeTDigestAggregation)."""
+    from presto_tpu.dictionary import Dictionary
+    from presto_tpu.expr import tdigest as _td
+
+    cap = acc.capacity
+    c = full.column(a.arg)
+    valid = np.asarray(c.valid_mask())[live]
+    is_merge = a.fn == "merge"
+    if is_merge:
+        entries = full.dicts[a.arg].decode(np.asarray(c.values)[live])
+    else:
+        vals = np.asarray(c.values)[live].astype(np.float64)
+        if a.arg2 is not None:
+            wc = full.column(a.arg2)
+            wvals = np.asarray(wc.values)[live].astype(np.float64)
+            valid = valid & np.asarray(wc.valid_mask())[live]
+        else:
+            wvals = None
+    per_group: Dict[int, list] = {}
+    for r in np.nonzero(valid)[0]:
+        per_group.setdefault(int(row_gi[r]), []).append(int(r))
+    compression = float(a.param) if a.param else _td.DEFAULT_COMPRESSION
+    out_entries = np.full(cap, "", dtype=object)
+    validity = np.zeros(cap, bool)
+    for gi, rows in per_group.items():
+        if is_merge:
+            e = _td.merge([entries[r] for r in rows
+                           if entries[r] is not None])
+        else:
+            e = _td.build(vals[rows],
+                          None if wvals is None else wvals[rows],
+                          compression)
+        if e is not None:
+            out_entries[gi] = e
+            validity[gi] = True
+    d, codes = Dictionary.encode(out_entries)
+    return acc.with_column(
+        a.symbol, a.type,
+        Column(jnp.asarray(codes.astype(np.int32)), jnp.asarray(validity)),
+        dictionary=d)
+
+
 def _attach_array_aggs(acc: Batch, full: Batch, aggs, key_syms) -> Batch:
     """array_agg: per-group element lists built host-side over the
     materialized input (reference: ArrayAggregationFunction's grouped
@@ -1189,6 +1264,9 @@ def _attach_array_aggs(acc: Batch, full: Batch, aggs, key_syms) -> Batch:
     for a in aggs:
         if a.fn == "numeric_histogram":
             acc = _attach_numeric_histogram(acc, full, a, row_gi, live)
+            continue
+        if a.fn in ("tdigest_agg", "merge"):
+            acc = _attach_tdigest(acc, full, a, row_gi, live)
             continue
         is_map = a.fn == "map_agg"
         c = full.column(a.arg)

@@ -119,7 +119,18 @@ _STR_TO_STR = {
     # bijection (types.VarbinaryType), so these are dictionary transforms
     "to_hex", "from_hex", "to_utf8", "from_utf8",
     "__vb_md5", "__vb_sha1", "__vb_sha256", "__vb_sha512", "__vb_to_base64",
+    # IPADDRESS/IPPREFIX family (expr/ip.py): canonical-byte dictionary
+    # entries, so casts and prefix math are dictionary transforms too
+    "__to_ipaddress", "__vb_to_ipaddress", "__ip_to_varchar",
+    "__ip_to_bytes", "__to_ipprefix", "__ipprefix_to_varchar",
+    "__addr_to_ipprefix", "__ipprefix_to_addr",
+    "ip_prefix", "ip_subnet_min", "ip_subnet_max",
+    # TDIGEST entries (expr/tdigest.py)
+    "scale_tdigest",
 }
+# string→double functions over dictionary entries (float lut + null lut):
+# the TDIGEST scalar family (expr/tdigest.py)
+_STR_TO_FLOAT = {"value_at_quantile", "quantile_at_value", "trimmed_mean"}
 # string→int functions (code-indexed int lut)
 _STR_TO_INT = {"length", "strpos", "codepoint", "json_array_length",
                "json_size", "levenshtein_distance_c", "hamming_distance_c"}
@@ -128,7 +139,8 @@ _STR_TO_INT = {"length", "strpos", "codepoint", "json_array_length",
 _STR_INT_NULLABLE = {"json_array_length", "json_size"}
 # string→bool predicate functions (bool lut, like LIKE)
 _STR_PRED = {"regexp_like", "starts_with", "ends_with", "contains",
-             "json_array_contains", "is_json_scalar"}
+             "json_array_contains", "is_json_scalar",
+             "__is_subnet_of_c", "__prefix_contains_c"}
 
 
 def _sql_substr(s: str, start: int, length: int | None) -> str:
@@ -240,6 +252,41 @@ def _str_xform_pyfn(fn: str, cargs: tuple):
         import unicodedata as _ud
 
         return lambda s: _ud.normalize("NFC", s)
+    if fn in ("__to_ipaddress", "__vb_to_ipaddress", "__ip_to_varchar",
+              "__to_ipprefix", "__ipprefix_to_varchar", "__ip_to_bytes",
+              "__addr_to_ipprefix", "__ipprefix_to_addr",
+              "ip_prefix", "ip_subnet_min", "ip_subnet_max"):
+        from presto_tpu.expr import ip as _ip
+
+        if fn == "ip_prefix":
+            bits = int(cargs[0])
+            return lambda s, _b=bits: _ip.ip_prefix(s, _b)
+        if fn == "__addr_to_ipprefix":
+            # full-length prefix: /32 for v4-mapped entries, /128 for v6
+            def full_pfx(s):
+                b = s.encode("latin-1")
+                if len(b) != 16:
+                    return None
+                v4 = b[:12] == bytes(10) + b"\xff\xff"
+                return _ip.ip_prefix(s, 32 if v4 else 128)
+
+            return full_pfx
+        if fn == "__ipprefix_to_addr":
+            return lambda s: s[:16] if len(s) == 17 else None
+        if fn == "__ip_to_bytes":
+            return lambda s: s  # entries ARE the 16 bytes (latin-1)
+        return {"__to_ipaddress": _ip.parse_address,
+                "__vb_to_ipaddress": _ip.address_from_bytes,
+                "__ip_to_varchar": _ip.format_address,
+                "__to_ipprefix": _ip.parse_prefix,
+                "__ipprefix_to_varchar": _ip.format_prefix,
+                "ip_subnet_min": _ip.subnet_min,
+                "ip_subnet_max": _ip.subnet_max}[fn]
+    if fn == "scale_tdigest":
+        from presto_tpu.expr import tdigest as _td
+
+        factor = float(cargs[0])
+        return lambda s, _f=factor: _td.scale(s, _f)
     if fn == "trim":
         return str.strip
     if fn == "ltrim":
@@ -428,6 +475,20 @@ def _str_int_pyfn(fn: str, cargs: tuple):
     raise NotImplementedError(fn)
 
 
+def _str_float_pyfn(fn: str, cargs: tuple):
+    """TDIGEST scalar family: digest entry → double (None = SQL NULL)."""
+    from presto_tpu.expr import tdigest as _td
+
+    if fn == "value_at_quantile":
+        q = float(cargs[0])
+        return lambda s, _q=q: _td.value_at_quantile(s, _q)
+    if fn == "quantile_at_value":
+        v = float(cargs[0])
+        return lambda s, _v=v: _td.quantile_at_value(s, _v)
+    lo, hi = float(cargs[0]), float(cargs[1])
+    return lambda s, _lo=lo, _hi=hi: _td.trimmed_mean(s, _lo, _hi)
+
+
 def _str_pred_pyfn(fn: str, cargs: tuple):
     if fn == "regexp_like":
         rx = re.compile(str(cargs[0]))
@@ -466,6 +527,20 @@ def _str_pred_pyfn(fn: str, cargs: tuple):
                         return True
             return False
         return jac
+    if fn == "__is_subnet_of_c":
+        # is_subnet_of(<constant prefix>, column): cargs[0] is the
+        # canonical 17-byte prefix entry (builder folds the text form)
+        from presto_tpu.expr import ip as _ip
+
+        pfx = str(cargs[0])
+        return lambda s, _p=pfx: _ip.is_subnet_of(_p, s)
+    if fn == "__prefix_contains_c":
+        # is_subnet_of(column, <constant address/prefix>): the operand is
+        # the prefix column, the constant the contained value
+        from presto_tpu.expr import ip as _ip
+
+        inner = str(cargs[0])
+        return lambda s, _i=inner: _ip.is_subnet_of(s, _i)
     if fn == "is_json_scalar":
         import json as _json
 
@@ -605,7 +680,10 @@ def string_output_dictionary(e: RowExpression) -> Dictionary | None:
         return None
     import numpy as np
 
-    return Dictionary(np.unique(np.asarray(consts)))
+    from presto_tpu.dictionary import safe_str_array
+
+    return Dictionary(np.unique(safe_str_array(
+        np.asarray(consts, dtype=object))))
 
 
 def compile_expr(e: RowExpression):
@@ -935,6 +1013,30 @@ def _eval_call(e: Call, ctx: CompileContext):
             nullable = out >= 0
             valid = nullable if valid is None else (valid & nullable)
         return out, valid
+    if fn in _STR_TO_FLOAT:
+        # digest entry → double, with a parallel null lut (invalid digest
+        # or out-of-domain argument → SQL NULL)
+        operand, cargs = _xform_parts(e)
+        d = ctx.dict_for(operand)
+        if d is None:
+            raise ValueError(f"{fn} needs a dictionary operand")
+        pyfn = _str_float_pyfn(fn, cargs)
+        fmemo: dict = {}
+
+        def ff(s, _m=fmemo, _f=pyfn):
+            if s not in _m:
+                _m[s] = _f(s)
+            return _m[s]
+
+        table = d.int_lut((fn, cargs, "v"),
+                          lambda s: ff(s) if ff(s) is not None else 0.0,
+                          dtype=np.float64)
+        nulls = d.int_lut((fn, cargs, "null"),
+                          lambda s: ff(s) is None, dtype=np.bool_)
+        codes, valid = _eval(operand, ctx)
+        notnull = ~jnp.asarray(nulls)[codes + 1]
+        valid = notnull if valid is None else valid & notnull
+        return jnp.asarray(table)[codes + 1], valid
     if fn in _STR_TO_INT or fn in _STR_PRED:
         operand, cargs = _xform_parts(e)
         d = ctx.dict_for(operand)
@@ -1252,7 +1354,9 @@ def _array_ctor_dict(e: Call, ctx: CompileContext) -> Dictionary | None:
     lits = sorted({str(a.value) for a in e.args
                    if isinstance(a, Constant) and a.value is not None})
     if lits:
-        ld, _ = Dictionary.encode(np.asarray(lits, dtype=str))
+        # object dtype: dtype=str would drop trailing NULs of canonical
+        # VARBINARY/IPADDRESS entries (dictionary.safe_str_array)
+        ld, _ = Dictionary.encode(np.asarray(lits, dtype=object))
         d = ld if d is None else Dictionary.merge(d, ld)
     return d
 

@@ -26,7 +26,7 @@ _VARIANCE_FNS = {"variance", "var_samp", "var_pop", "stddev", "stddev_samp",
 _COVAR_FNS = {"covar_pop", "covar_samp"}
 _NON_DECOMPOSABLE = {"approx_percentile", "__approx_percentile_w",
                      "max_by", "min_by", "array_agg", "map_agg",
-                     "numeric_histogram",
+                     "numeric_histogram", "tdigest_agg", "merge",
                      "count_distinct", "sum_distinct", "avg_distinct"}
 
 

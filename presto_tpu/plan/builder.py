@@ -56,7 +56,12 @@ from presto_tpu.types import (
     DecimalType,
     GEOMETRY,
     INTEGER,
+    IPADDRESS,
+    IPPREFIX,
+    IpAddressType,
+    IpPrefixType,
     MapType,
+    TDIGEST,
     TIME,
     TIMESTAMP,
     Type,
@@ -72,6 +77,55 @@ from presto_tpu.types import (
 
 class AnalysisError(Exception):
     pass
+
+
+def _fold_string_call(e):
+    """Constant-fold dictionary-transform string functions whose operand
+    and arguments are all plan-time constants (to_hex(<literal bytes>),
+    upper('x'), …). Without this, such calls reach the compiler with no
+    dictionary to transform (reference: these fold in the interpreter,
+    ExpressionInterpreter.java)."""
+    if not isinstance(e, Call) or not e.args:
+        return e
+    if not all(isinstance(a, Constant) for a in e.args):
+        return e
+    from presto_tpu.expr.compile import (
+        _STR_INT_NULLABLE,
+        _STR_PRED,
+        _STR_TO_INT,
+        _STR_TO_STR,
+        _str_int_pyfn,
+        _str_pred_pyfn,
+        _str_xform_pyfn,
+        _xform_parts,
+    )
+
+    fn = e.fn
+    if fn not in _STR_TO_STR and fn not in _STR_TO_INT and fn not in _STR_PRED:
+        return e
+    try:
+        operand, cargs = _xform_parts(e)
+    except NotImplementedError:
+        # all-constant concat never reaches here (folded at analysis);
+        # other shapes _xform_parts can't split stay runtime calls
+        return e
+    value = operand.value
+    if value is None:
+        return Constant(e.type, None)
+    if isinstance(value, (bytes, bytearray)):
+        value = value.decode("latin-1")
+    try:
+        if fn in _STR_TO_STR:
+            out = _str_xform_pyfn(fn, cargs)(str(value))
+        elif fn in _STR_TO_INT:
+            out = _str_int_pyfn(fn, cargs)(str(value))
+            if out is not None and fn not in _STR_INT_NULLABLE:
+                out = int(out)
+        else:
+            out = bool(_str_pred_pyfn(fn, cargs)(str(value)))
+    except Exception:
+        return e  # leave malformed folds to runtime NULL semantics
+    return Constant(e.type, out)
 
 
 # ---------------------------------------------------------------------------
@@ -235,6 +289,8 @@ _AGG_FUNCS = {
     # approx family (ApproximateCountDistinct / ApproximateLongPercentile —
     # here computed exactly, which satisfies the approximation contract)
     "approx_distinct", "approx_percentile", "numeric_histogram",
+    # sketches as values (TDigestAggregationFunction, MergeAggregation)
+    "tdigest_agg", "merge",
     # argmax family (AbstractMinMaxBy)
     "max_by", "min_by",
     # structural (ArrayAggregationFunction / MapAggregation — materialized
@@ -279,7 +335,7 @@ class ExprAnalyzer:
         m = getattr(self, f"_an_{type(node).__name__}", None)
         if m is None:
             raise AnalysisError(f"unsupported expression: {type(node).__name__}")
-        return m(node)
+        return _fold_string_call(m(node))
 
     # -- leaves -----------------------------------------------------------
 
@@ -383,6 +439,23 @@ class ExprAnalyzer:
         raise AnalysisError(f"unknown operator {op}")
 
     def _align_comparable(self, l: RowExpression, r: RowExpression):
+        ip_types = (IpAddressType, IpPrefixType)
+        if (isinstance(l.type, ip_types) or isinstance(r.type, ip_types)) \
+                and l.type != r.type:
+            # '10.0.0.1' = ip_col: fold the text constant to the canonical
+            # entry so it resolves against the ip dictionary. Anything
+            # else (ipaddress vs ipprefix, ip vs varchar column) is a
+            # type error — byte-comparing 16- against 17-byte entries
+            # would be silently always-false
+            tgt = l.type if isinstance(l.type, ip_types) else r.type
+            if isinstance(l, Constant) and l.type is VARCHAR:
+                l = self._ip_cast(l, tgt)
+            elif isinstance(r, Constant) and r.type is VARCHAR:
+                r = self._ip_cast(r, tgt)
+            else:
+                raise AnalysisError(
+                    f"cannot compare {l.type} with {r.type}")
+            return l, r
         if l.type.is_string or r.type.is_string:
             return l, r
         if isinstance(l.type, DecimalType) or isinstance(r.type, DecimalType):
@@ -553,7 +626,187 @@ class ExprAnalyzer:
         if isinstance(v, Constant) and v.value is not None and node.type_name.lower() == "date":
             y, m, d = map(int, str(v.value).split("-"))
             return Constant(DATE, days_from_civil(y, m, d))
+        ip_types = (IpAddressType, IpPrefixType)
+        if isinstance(t, ip_types) or isinstance(v.type, ip_types):
+            return self._ip_cast(v, t)
         return Call(t, "cast", (v,))
+
+    def _ip_cast(self, v: RowExpression, t: Type) -> RowExpression:
+        """IPADDRESS/IPPREFIX casts are dictionary transforms between
+        canonical-byte entries and text/bytes (expr/ip.py; reference
+        IpAddressOperators.java / IpPrefixOperators.java). Routed here so
+        the generic cast path never passes codes through un-re-encoded."""
+        if v.type == t:
+            return v
+        fn = {
+            ("varchar", "ipaddress"): "__to_ipaddress",
+            ("varbinary", "ipaddress"): "__vb_to_ipaddress",
+            ("ipaddress", "varchar"): "__ip_to_varchar",
+            ("ipaddress", "varbinary"): "__ip_to_bytes",
+            ("ipaddress", "ipprefix"): "__addr_to_ipprefix",
+            ("varchar", "ipprefix"): "__to_ipprefix",
+            ("ipprefix", "varchar"): "__ipprefix_to_varchar",
+            ("ipprefix", "ipaddress"): "__ipprefix_to_addr",
+        }.get((v.type.name, t.name))
+        if fn is None:
+            raise AnalysisError(f"cannot cast {v.type} to {t}")
+        if isinstance(v, Constant):
+            if v.value is None:
+                return Constant(t, None)
+            from presto_tpu.expr.compile import _str_xform_pyfn
+
+            raw = (v.value.decode("latin-1")
+                   if isinstance(v.value, (bytes, bytearray))
+                   else str(v.value))
+            out = _str_xform_pyfn(fn, ())(raw)
+            if out is None:
+                raise AnalysisError(f"invalid {t.name}: {v.value!r}")
+            return Constant(t, out)
+        return Call(t, fn, (v,))
+
+    def _an_ip_fn(self, name: str, args) -> RowExpression:
+        """IP function family (reference operator/scalar/
+        IpPrefixFunctions.java). Operands ride dictionary transforms, so
+        every non-operand argument must be a plan-time constant."""
+        from presto_tpu.expr import ip as _ip
+
+        def coerce(a, want_prefix=False):
+            # bare text constants are a convenience the reference gets via
+            # implicit varchar→ipaddress coercion
+            if isinstance(a, Constant) and a.type is VARCHAR and a.value is not None:
+                t = IPPREFIX if (want_prefix or "/" in str(a.value)) else IPADDRESS
+                return self._ip_cast(a, t)
+            return a
+
+        if name == "ip_prefix":
+            if len(args) != 2:
+                raise AnalysisError("ip_prefix(ip, prefix_bits) takes 2 arguments")
+            a, bits = args
+            if not (isinstance(bits, Constant) and is_integral(bits.type)):
+                raise AnalysisError(
+                    "ip_prefix: prefix length must be a constant integer")
+            if a.type.name not in ("ipaddress", "varchar"):
+                raise AnalysisError(f"ip_prefix expects ipaddress, got {a.type}")
+            if isinstance(a, Constant):
+                if a.value is None or bits.value is None:
+                    return Constant(IPPREFIX, None)
+                a = coerce(a)
+                out = _ip.ip_prefix(str(a.value), int(bits.value))
+                if out is None:
+                    raise AnalysisError(
+                        f"ip_prefix: invalid prefix length {bits.value}")
+                return Constant(IPPREFIX, out)
+            if a.type is VARCHAR:
+                # parse text explicitly — ip_prefix itself takes canonical
+                # entries only (a 16-char address TEXT is not 16 bytes)
+                a = Call(IPADDRESS, "__to_ipaddress", (a,))
+            return Call(IPPREFIX, "ip_prefix", (a, bits))
+        if name in ("ip_subnet_min", "ip_subnet_max", "ip_subnet_range"):
+            if len(args) != 1:
+                raise AnalysisError(f"{name}(prefix) takes 1 argument")
+            p = coerce(args[0], want_prefix=True)
+            if not isinstance(p.type, IpPrefixType):
+                raise AnalysisError(f"{name} expects ipprefix, got {p.type}")
+            if name == "ip_subnet_range":
+                mn = self._an_ip_fn("ip_subnet_min", (p,))
+                mx = self._an_ip_fn("ip_subnet_max", (p,))
+                return self._an_structural_fn("array_ctor", (mn, mx))
+            if isinstance(p, Constant):
+                if p.value is None:
+                    return Constant(IPADDRESS, None)
+                fn = _ip.subnet_min if name == "ip_subnet_min" else _ip.subnet_max
+                return Constant(IPADDRESS, fn(str(p.value)))
+            return Call(IPADDRESS, name, (p,))
+        # is_subnet_of(prefix, address-or-prefix)
+        if len(args) != 2:
+            raise AnalysisError("is_subnet_of(prefix, ip) takes 2 arguments")
+        p, x = coerce(args[0], want_prefix=True), coerce(args[1])
+        if not isinstance(p.type, IpPrefixType):
+            raise AnalysisError(f"is_subnet_of expects ipprefix, got {p.type}")
+        if not isinstance(x.type, (IpAddressType, IpPrefixType)):
+            raise AnalysisError(
+                f"is_subnet_of expects ipaddress or ipprefix, got {x.type}")
+        if isinstance(p, Constant) and isinstance(x, Constant):
+            if p.value is None or x.value is None:
+                return Constant(BOOLEAN, None)
+            return Constant(BOOLEAN,
+                            _ip.is_subnet_of(str(p.value), str(x.value)))
+        if isinstance(p, Constant):
+            if p.value is None:
+                return Constant(BOOLEAN, None)
+            return Call(BOOLEAN, "__is_subnet_of_c",
+                        (x, Constant(VARCHAR, str(p.value))))
+        if isinstance(x, Constant):
+            if x.value is None:
+                return Constant(BOOLEAN, None)
+            return Call(BOOLEAN, "__prefix_contains_c",
+                        (p, Constant(VARCHAR, str(x.value))))
+        raise AnalysisError(
+            "is_subnet_of needs a constant prefix or a constant operand "
+            "(two-column containment would need a cross-dictionary product)")
+
+    def _an_tdigest_fn(self, name: str, args) -> RowExpression:
+        """TDIGEST scalar family (reference operator/scalar/
+        TDigestFunctions.java). Digests are dictionary entries, so these
+        evaluate once per distinct digest; the non-digest arguments must
+        be plan-time constants."""
+        if not args or args[0].type.name != "tdigest(double)":
+            got = args[0].type if args else "no arguments"
+            raise AnalysisError(f"{name} expects a tdigest, got {got}")
+        td = args[0]
+
+        def const_num(a, what):
+            if not isinstance(a, Constant) or not is_numeric(a.type):
+                raise AnalysisError(f"{name}: {what} must be a numeric constant")
+            if a.value is None:
+                raise AnalysisError(f"{name}: {what} must not be NULL")
+            return float(a.value)
+
+        if name == "value_at_quantile":
+            if len(args) != 2:
+                raise AnalysisError("value_at_quantile(tdigest, q)")
+            q = const_num(args[1], "quantile")
+            if not 0.0 <= q <= 1.0:
+                raise AnalysisError("quantile must be in [0, 1]")
+            return Call(DOUBLE, "value_at_quantile",
+                        (td, Constant(DOUBLE, q)))
+        if name == "values_at_quantiles":
+            if len(args) != 2:
+                raise AnalysisError("values_at_quantiles(tdigest, qs)")
+            arr = args[1]
+            if not (isinstance(arr, Call) and arr.fn == "array_ctor"
+                    and all(isinstance(x, Constant)
+                            and x.value is not None for x in arr.args)):
+                raise AnalysisError(
+                    "values_at_quantiles requires a constant array of "
+                    "non-null quantiles")
+            calls = tuple(
+                self._an_tdigest_fn("value_at_quantile",
+                                    (td, Constant(DOUBLE, float(x.value))))
+                for x in arr.args)
+            return self._an_structural_fn("array_ctor", calls)
+        if name == "quantile_at_value":
+            if len(args) != 2:
+                raise AnalysisError("quantile_at_value(tdigest, x)")
+            v = const_num(args[1], "value")
+            return Call(DOUBLE, "quantile_at_value",
+                        (td, Constant(DOUBLE, v)))
+        if name == "trimmed_mean":
+            if len(args) != 3:
+                raise AnalysisError("trimmed_mean(tdigest, lo, hi)")
+            lo = const_num(args[1], "low quantile")
+            hi = const_num(args[2], "high quantile")
+            if not 0.0 <= lo <= hi <= 1.0:
+                raise AnalysisError("quantile bounds must satisfy 0<=lo<=hi<=1")
+            return Call(DOUBLE, "trimmed_mean",
+                        (td, Constant(DOUBLE, lo), Constant(DOUBLE, hi)))
+        # scale_tdigest
+        if len(args) != 2:
+            raise AnalysisError("scale_tdigest(tdigest, factor)")
+        f = const_num(args[1], "scale factor")
+        if f <= 0:
+            raise AnalysisError("scale factor must be positive")
+        return Call(TDIGEST, "scale_tdigest", (td, Constant(DOUBLE, f)))
 
     def _an_Extract(self, node: ast.Extract) -> RowExpression:
         v = self.analyze(node.value)
@@ -635,6 +888,12 @@ class ExprAnalyzer:
                     f"{'varbinary' if want_vb else 'varchar'}")
             out_t = VARCHAR if want_vb else VARBINARY
             return Call(out_t, name, args)
+        if name in ("ip_prefix", "ip_subnet_min", "ip_subnet_max",
+                    "ip_subnet_range", "is_subnet_of"):
+            return self._an_ip_fn(name, args)
+        if name in ("value_at_quantile", "values_at_quantiles",
+                    "quantile_at_value", "trimmed_mean", "scale_tdigest"):
+            return self._an_tdigest_fn(name, args)
         if name in ("upper", "lower", "trim", "ltrim", "rtrim", "reverse",
                     "replace", "lpad", "rpad", "split_part",
                     "url_extract_host", "url_extract_path",
@@ -2104,6 +2363,32 @@ class Planner:
                     if param < 2:
                         raise AnalysisError("bucket count must be >= 2")
                     ae = analyzer._to_double(analyzer.analyze(fc.args[1]))
+                elif fn == "tdigest_agg":
+                    # tdigest_agg(x[, w][, compression]) — weight is a
+                    # column, compression a constant (reference:
+                    # TDigestAggregationFunction signatures)
+                    if not 1 <= len(fc.args) <= 3:
+                        raise AnalysisError(
+                            "tdigest_agg(x[, w][, compression]) takes "
+                            "1-3 arguments")
+                    ae = analyzer._to_double(analyzer.analyze(fc.args[0]))
+                    if len(fc.args) == 3:
+                        from presto_tpu.expr.ir import Constant as _Const
+
+                        ce = analyzer.analyze(fc.args[2])
+                        if not isinstance(ce, _Const) or ce.value is None:
+                            raise AnalysisError(
+                                "tdigest_agg compression must be a constant")
+                        param = float(ce.value)
+                        if param < 10:
+                            raise AnalysisError("compression must be >= 10")
+                elif fn == "merge":
+                    if len(fc.args) != 1:
+                        raise AnalysisError("merge(tdigest) takes one argument")
+                    ae = analyzer.analyze(fc.args[0])
+                    if ae.type.name != "tdigest(double)":
+                        raise AnalysisError(
+                            f"merge expects tdigest, got {ae.type}")
                 else:
                     ae = analyzer.analyze(fc.args[0])
                 if isinstance(ae, InputRef):
@@ -2118,6 +2403,14 @@ class Planner:
                         raise AnalysisError(f"{fn} takes two arguments")
                     ae2 = analyzer.analyze(fc.args[1])
                     arg2_t = ae2.type
+                    if isinstance(ae2, InputRef):
+                        arg2_sym = ae2.name
+                    else:
+                        arg2_sym = self.symbols.fresh(f"{fn}_arg2")
+                    if not any(s == arg2_sym for s, _ in pre_exprs):
+                        pre_exprs.append((arg2_sym, ae2))
+                elif fn == "tdigest_agg" and len(fc.args) >= 2:
+                    ae2 = analyzer._to_double(analyzer.analyze(fc.args[1]))
                     if isinstance(ae2, InputRef):
                         arg2_sym = ae2.name
                     else:
@@ -2142,6 +2435,8 @@ class Planner:
                 out_t = MapType(arg_t, arg2_t)
             elif fn == "numeric_histogram":
                 out_t = MapType(DOUBLE, DOUBLE)
+            elif fn in ("tdigest_agg", "merge"):
+                out_t = TDIGEST
             else:
                 out_t = _agg_output_type(fn, arg_t, fc.is_star)
             sym = self.symbols.fresh(fn)

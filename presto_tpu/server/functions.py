@@ -31,6 +31,10 @@ _SCALAR = {
     "binary": ["md5", "sha1", "sha256", "sha512", "to_base64",
                "from_base64", "normalize", "to_hex", "from_hex",
                "to_utf8", "from_utf8"],
+    "ip": ["ip_prefix", "ip_subnet_min", "ip_subnet_max", "ip_subnet_range",
+           "is_subnet_of"],
+    "tdigest": ["value_at_quantile", "values_at_quantiles",
+                "quantile_at_value", "trimmed_mean", "scale_tdigest"],
     "date": ["year", "month", "day", "quarter", "day_of_week", "dow",
              "day_of_year", "doy", "date_trunc", "date_diff", "date_add",
              "from_unixtime", "to_unixtime"],
@@ -58,7 +62,8 @@ _AGGREGATE = ["count", "sum", "avg", "min", "max", "stddev", "stddev_pop",
               "covar_samp", "corr", "geometric_mean", "bool_and", "bool_or",
               "every", "arbitrary", "any_value", "checksum", "count_if",
               "approx_distinct", "approx_percentile", "max_by", "min_by",
-              "array_agg", "map_agg", "numeric_histogram"]
+              "array_agg", "map_agg", "numeric_histogram", "tdigest_agg",
+              "merge"]
 
 _WINDOW = ["row_number", "rank", "dense_rank", "percent_rank", "cume_dist",
            "ntile", "lag", "lead", "first_value", "last_value", "nth_value"]

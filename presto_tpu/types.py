@@ -134,6 +134,37 @@ class VarbinaryType(VarcharType):
         object.__setattr__(self, "name", "varbinary")
 
 
+class IpAddressType(VarcharType):
+    """IPADDRESS: dictionary-encoded like VARCHAR, but the dictionary
+    entry is the canonical 16-byte IPv6 form (IPv4 → v4-mapped ::ffff:…)
+    through the latin-1 bijection. Byte order on the canonical form IS
+    address order, so comparisons / grouping / joins / sorts ride the
+    order-preserving code machinery unchanged. Reference:
+    presto-main/.../type/IpAddressType.java (16-byte Slice value)."""
+
+    def __init__(self):
+        object.__setattr__(self, "name", "ipaddress")
+
+
+class IpPrefixType(VarcharType):
+    """IPPREFIX: canonical 16-byte network address + one prefix-length
+    byte; byte order gives the reference's (address, length) ordering.
+    Reference: presto-main/.../type/IpPrefixType.java."""
+
+    def __init__(self):
+        object.__setattr__(self, "name", "ipprefix")
+
+
+class TDigestType(VarcharType):
+    """TDIGEST(DOUBLE): a serialized centroid-list sketch stored as a
+    dictionary entry (expr/tdigest.py) — digests travel as int32 codes
+    and scalar functions over them evaluate once per distinct digest.
+    Reference: presto-main/.../type/TDigestType.java (Slice-backed)."""
+
+    def __init__(self):
+        object.__setattr__(self, "name", "tdigest(double)")
+
+
 @dataclasses.dataclass(frozen=True)
 class ArrayType(Type):
     """ARRAY(element). Device value: [capacity, W] plane of element values
@@ -217,6 +248,9 @@ TIME = _FixedType("time", "int64")
 GEOMETRY = _FixedType("geometry", "int32")
 VARCHAR = VarcharType()
 VARBINARY = VarbinaryType()
+IPADDRESS = IpAddressType()
+IPPREFIX = IpPrefixType()
+TDIGEST = TDigestType()
 
 
 _NUMERIC_RANK = {
@@ -319,6 +353,10 @@ def parse_type(s: str) -> Type:
         "varchar": VARCHAR,
         "string": VARCHAR,
         "varbinary": VARBINARY,
+        "ipaddress": IPADDRESS,
+        "ipprefix": IPPREFIX,
+        "tdigest": TDIGEST,
+        "tdigest(double)": TDIGEST,
     }
     if s in simple:
         return simple[s]

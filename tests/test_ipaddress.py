@@ -1,0 +1,213 @@
+"""IPADDRESS / IPPREFIX types and the IP function family.
+
+Reference behavior: presto-main/.../type/IpAddressType.java,
+IpAddressOperators.java, operator/scalar/IpPrefixFunctions.java
+(canonicalization, v4-mapped storage, prefix math). Representation here
+is canonical-byte dictionary entries (presto_tpu/expr/ip.py).
+"""
+
+import pytest
+
+from presto_tpu.catalog.memory import MemoryConnector
+from presto_tpu.connector import Catalog
+from presto_tpu.exec import ExecConfig, LocalRunner
+from presto_tpu.types import BIGINT, IPADDRESS, IPPREFIX, VARCHAR
+
+
+def _runner(tables):
+    conn = MemoryConnector("mem")
+    for name, (arrays, types) in tables.items():
+        conn.add_table(name, arrays, types)
+    cat = Catalog()
+    cat.register("mem", conn, default=True)
+    return LocalRunner(cat, ExecConfig(batch_rows=64))
+
+
+@pytest.fixture(scope="module")
+def runner():
+    return _runner({
+        "ips": ({"ip": ["10.0.0.1", "::ffff:10.0.0.1", "10.0.0.2",
+                        "10.0.255.255", "10.1.0.0", "2001:db8::1", None]},
+                {"ip": IPADDRESS}),
+        "raw": ({"s": ["1.2.3.4", "not-an-ip", "999.1.1.1"]},
+                {"s": VARCHAR}),
+        "hits": ({"ip": ["1.1.1.1", "::ffff:1.1.1.1", "8.8.8.8"],
+                  "n": [1, 10, 100]},
+                 {"ip": IPADDRESS, "n": BIGINT}),
+        "nets": ({"net": ["10.0.0.0/8", "10.0.0.0/16", "192.168.0.0/16",
+                          "9.0.0.0/8"]},
+                 {"net": IPPREFIX}),
+    })
+
+
+def _rows(df):
+    return list(df.itertuples(index=False, name=None))
+
+
+def test_cast_varchar_roundtrip(runner):
+    df = runner.run(
+        "SELECT CAST(CAST('192.168.0.1' AS ipaddress) AS varchar) v")
+    assert _rows(df) == [("192.168.0.1",)]
+
+
+def test_v4_mapped_v6_canonicalizes_to_v4_text(runner):
+    # ::ffff:1.2.3.4 IS 1.2.3.4 (reference stores both as the same
+    # 16-byte value and formats as the dotted quad)
+    df = runner.run(
+        "SELECT CAST(CAST('::ffff:1.2.3.4' AS ipaddress) AS varchar) v")
+    assert _rows(df) == [("1.2.3.4",)]
+
+
+def test_v6_compresses(runner):
+    df = runner.run(
+        "SELECT CAST(CAST('2001:0db8:0000:0000:0000:0000:0000:0001' "
+        "AS ipaddress) AS varchar) v")
+    assert _rows(df) == [("2001:db8::1",)]
+
+
+def test_equality_across_text_forms(runner):
+    df = runner.run(
+        "SELECT count(*) c FROM ips WHERE ip = CAST('10.0.0.1' AS ipaddress)")
+    assert _rows(df) == [(2,)]
+
+
+def test_varchar_constant_coerces_in_comparison(runner):
+    df = runner.run("SELECT count(*) c FROM ips WHERE ip = '10.0.0.2'")
+    assert _rows(df) == [(1,)]
+
+
+def test_order_is_address_order(runner):
+    # byte order of the canonical form = address order; v4 sorts
+    # numerically ('9.x' < '10.x' would fail as text) and below v6
+    df = _runner({
+        "t": ({"ip": ["10.0.0.10", "9.255.255.255", "10.0.0.2",
+                      "2001:db8::1"]}, {"ip": IPADDRESS}),
+    }).run("SELECT CAST(ip AS varchar) v FROM t ORDER BY ip")
+    assert list(df["v"]) == [
+        "9.255.255.255", "10.0.0.2", "10.0.0.10", "2001:db8::1"]
+
+
+def test_group_by_ipaddress(runner):
+    df = runner.run(
+        "SELECT CAST(ip AS varchar) v, sum(n) s FROM hits GROUP BY ip "
+        "ORDER BY 2")
+    assert _rows(df) == [("1.1.1.1", 11), ("8.8.8.8", 100)]
+
+
+def test_invalid_cast_yields_null(runner):
+    df = runner.run(
+        "SELECT CAST(CAST(s AS ipaddress) AS varchar) v FROM raw ORDER BY s")
+    assert list(df["v"])[0] == "1.2.3.4"
+    assert df["v"].isna().tolist() == [False, True, True]
+
+
+def test_cast_varbinary_to_ipaddress():
+    from presto_tpu.types import VARBINARY
+
+    df = _runner({
+        "bins": ({"b": [bytes([1, 2, 3, 4]),
+                        bytes.fromhex("20010db8" + "0" * 22 + "01")]},
+                 {"b": VARBINARY}),
+    }).run("SELECT CAST(CAST(b AS ipaddress) AS varchar) v FROM bins "
+           "ORDER BY 1")
+    assert list(df["v"]) == ["1.2.3.4", "2001:db8::1"]
+
+
+def test_cast_ipaddress_to_varbinary(runner):
+    df = runner.run(
+        "SELECT to_hex(CAST(CAST('1.2.3.4' AS ipaddress) AS varbinary)) h")
+    assert _rows(df) == [("00000000000000000000FFFF01020304",)]
+
+
+def test_ip_prefix_masks_to_network(runner):
+    # reference IpPrefixFunctions example: /9 of 192.168.255.255
+    df = runner.run(
+        "SELECT CAST(ip_prefix(CAST('192.168.255.255' AS ipaddress), 9) "
+        "AS varchar) v")
+    assert _rows(df) == [("192.128.0.0/9",)]
+
+
+def test_ip_prefix_on_column(runner):
+    df = _runner({
+        "t": ({"ip": ["10.1.2.3", "10.1.200.9", "172.16.5.5"]},
+              {"ip": IPADDRESS}),
+    }).run("SELECT CAST(ip_prefix(ip, 16) AS varchar) v, count(*) c "
+           "FROM t GROUP BY 1 ORDER BY 1")
+    assert _rows(df) == [("10.1.0.0/16", 2), ("172.16.0.0/16", 1)]
+
+
+def test_ipprefix_cast_canonicalizes(runner):
+    df = runner.run(
+        "SELECT CAST(CAST('192.168.255.255/9' AS ipprefix) AS varchar) v")
+    assert _rows(df) == [("192.128.0.0/9",)]
+
+
+def test_subnet_min_max(runner):
+    df = runner.run(
+        "SELECT CAST(ip_subnet_min(CAST('192.64.1.1/9' AS ipprefix)) "
+        "AS varchar) a, "
+        "CAST(ip_subnet_max(CAST('192.64.1.1/9' AS ipprefix)) AS varchar) b")
+    assert _rows(df) == [("192.0.0.0", "192.127.255.255")]
+
+
+def test_ip_subnet_range(runner):
+    df = runner.run(
+        "SELECT CAST(r[1] AS varchar) a, CAST(r[2] AS varchar) b FROM ("
+        "SELECT ip_subnet_range(CAST('10.1.1.0/24' AS ipprefix)) AS r) t")
+    assert _rows(df) == [("10.1.1.0", "10.1.1.255")]
+
+
+def test_is_subnet_of_constant_prefix(runner):
+    df = runner.run(
+        "SELECT count(*) c FROM ips "
+        "WHERE is_subnet_of(CAST('10.0.0.0/16' AS ipprefix), ip)")
+    # 10.0.0.1 (twice), 10.0.0.2, 10.0.255.255 — not 10.1.0.0 / v6 / NULL
+    assert _rows(df) == [(4,)]
+
+
+def test_is_subnet_of_prefix_column(runner):
+    df = runner.run(
+        "SELECT CAST(net AS varchar) v FROM nets "
+        "WHERE is_subnet_of(net, CAST('10.0.1.1' AS ipaddress)) "
+        "ORDER BY net")
+    assert list(df["v"]) == ["10.0.0.0/8", "10.0.0.0/16"]
+
+
+def test_is_subnet_of_prefix_in_prefix(runner):
+    df = runner.run(
+        "SELECT is_subnet_of(CAST('10.0.0.0/8' AS ipprefix), "
+        "CAST('10.1.0.0/16' AS ipprefix)) a, "
+        "is_subnet_of(CAST('10.1.0.0/16' AS ipprefix), "
+        "CAST('10.0.0.0/8' AS ipprefix)) b")
+    assert _rows(df) == [(True, False)]
+
+
+def test_mixed_family_is_disjoint(runner):
+    df = runner.run(
+        "SELECT is_subnet_of(CAST('0.0.0.0/0' AS ipprefix), "
+        "CAST('2001:db8::1' AS ipaddress)) v")
+    assert _rows(df) == [(False,)]
+
+
+def test_ip_join_by_address(runner):
+    # equal addresses in DIFFERENT text forms must join (content, not code)
+    df = _runner({
+        "a": ({"ip": ["1.2.3.4", "5.6.7.8"], "tag": ["x", "y"]},
+              {"ip": IPADDRESS, "tag": VARCHAR}),
+        "b": ({"ip": ["::ffff:1.2.3.4", "9.9.9.9"], "n": [7, 8]},
+              {"ip": IPADDRESS, "n": BIGINT}),
+    }).run("SELECT a.tag t, b.n n FROM a JOIN b ON a.ip = b.ip")
+    assert _rows(df) == [("x", 7)]
+
+
+def test_ipprefix_order(runner):
+    # (address, length) ordering — shorter prefix of the same network first
+    df = runner.run(
+        "SELECT CAST(net AS varchar) v FROM nets ORDER BY net")
+    assert list(df["v"]) == [
+        "9.0.0.0/8", "10.0.0.0/8", "10.0.0.0/16", "192.168.0.0/16"]
+
+
+def test_distinct_and_null_handling(runner):
+    df = runner.run("SELECT count(DISTINCT ip) c FROM ips")
+    assert _rows(df) == [(5,)]  # 7 rows: one dup pair, one NULL

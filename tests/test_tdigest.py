@@ -1,0 +1,245 @@
+"""TDIGEST type, tdigest_agg / merge aggregates, and the scalar family.
+
+Reference: presto-main/.../tdigest/TDigest.java,
+operator/aggregation/TDigestAggregationFunction,
+operator/scalar/TDigestFunctions.java. Accuracy contract: the t-digest
+k₁ scale function concentrates centroids at the tails, so extreme
+quantiles are tight; mid quantiles are within ~1% rank error at the
+default compression of 100.
+"""
+
+import numpy as np
+import pytest
+
+from presto_tpu.catalog.memory import MemoryConnector
+from presto_tpu.connector import Catalog
+from presto_tpu.exec import ExecConfig, LocalRunner
+from presto_tpu.expr import tdigest as td
+from presto_tpu.types import BIGINT, DOUBLE, VARCHAR
+
+
+# ---------------------------------------------------------------------------
+# unit level (expr/tdigest.py)
+
+
+def test_build_and_quantiles_accuracy():
+    rng = np.random.default_rng(3)
+    x = rng.lognormal(0.0, 2.0, 50_000)  # heavy-tailed
+    e = td.build(x)
+    for q in (0.01, 0.25, 0.5, 0.75, 0.99, 0.999):
+        got = td.value_at_quantile(e, q)
+        # rank error: where does the estimate actually sit?
+        rank = (x <= got).mean()
+        assert abs(rank - q) < 0.01, (q, got, rank)
+
+
+def test_extremes_are_exact():
+    x = np.asarray([5.0, 1.0, 9.0, 3.3])
+    e = td.build(x)
+    assert td.value_at_quantile(e, 0.0) == 1.0
+    assert td.value_at_quantile(e, 1.0) == 9.0
+
+
+def test_centroid_count_bounded():
+    x = np.random.default_rng(0).normal(0, 1, 100_000)
+    e = td.build(x, compression=100)
+    _, _, _, _, means, _ = td.deserialize(e)
+    assert len(means) <= 101
+
+
+def test_serialization_roundtrip_exact():
+    x = np.random.default_rng(1).normal(0, 1, 1000)
+    e = td.build(x)
+    p = td.deserialize(e)
+    e2 = td.serialize(*p[:4], p[4], p[5])
+    assert e == e2
+
+
+def test_merge_matches_single_build_accuracy():
+    rng = np.random.default_rng(7)
+    parts = [rng.normal(0, 1, 20_000) for _ in range(4)]
+    whole = np.concatenate(parts)
+    merged = td.merge([td.build(p) for p in parts])
+    for q in (0.05, 0.5, 0.95):
+        got = td.value_at_quantile(merged, q)
+        rank = (whole <= got).mean()
+        assert abs(rank - q) < 0.015
+
+
+def test_quantile_at_value_inverse():
+    x = np.random.default_rng(9).uniform(0, 100, 30_000)
+    e = td.build(x)
+    for v in (10.0, 50.0, 90.0):
+        q = td.quantile_at_value(e, v)
+        assert abs(q - v / 100.0) < 0.01
+    assert td.quantile_at_value(e, -1.0) == 0.0
+    assert td.quantile_at_value(e, 1000.0) == 1.0
+
+
+def test_weighted_build():
+    # weight w ≡ w copies of the value. Centroid mass spreads around the
+    # mean in t-digest cdf interpolation, so the rank of 5.0 lands well
+    # above the unweighted ~0.47 but below the exact 0.9
+    e = td.build([1.0, 10.0], weights=[9.0, 1.0])
+    assert td.value_at_quantile(e, 0.5) < 2.0
+    q = td.quantile_at_value(e, 5.0)
+    assert 0.6 <= q <= 0.95
+
+
+def test_scale_preserves_quantiles():
+    x = np.random.default_rng(2).normal(0, 1, 10_000)
+    e = td.build(x)
+    s = td.scale(e, 4.0)
+    assert td.deserialize(s)[1] == pytest.approx(4.0 * len(x))
+    assert td.value_at_quantile(s, 0.5) == td.value_at_quantile(e, 0.5)
+
+
+def test_trimmed_mean():
+    x = np.concatenate([np.random.default_rng(4).normal(50, 1, 10_000),
+                        [1e9]])  # one wild outlier
+    e = td.build(x)
+    tm = td.trimmed_mean(e, 0.05, 0.95)
+    assert abs(tm - 50.0) < 0.5
+    assert td.trimmed_mean(e, 0.3, 0.3) is None
+
+
+# ---------------------------------------------------------------------------
+# SQL level
+
+
+@pytest.fixture(scope="module")
+def runner():
+    rng = np.random.default_rng(11)
+    n = 20_000
+    g = rng.integers(0, 3, n)
+    x = rng.normal(100.0 * (g + 1), 10.0, n)
+    nulls = rng.random(n) < 0.1
+    xv = np.where(nulls, None, x.astype(object))
+    conn = MemoryConnector("mem")
+    conn.add_table("t", {"g": g, "x": xv, "w": np.ones(n)},
+                   {"g": BIGINT, "x": DOUBLE, "w": DOUBLE})
+    cat = Catalog()
+    cat.register("mem", conn, default=True)
+    return LocalRunner(cat, ExecConfig(batch_rows=4096)), g, x, nulls
+
+
+def test_sql_tdigest_agg_global(runner):
+    r, g, x, nulls = runner
+    df = r.run("SELECT value_at_quantile(tdigest_agg(x), 0.5) m FROM t")
+    exp = np.median(x[~nulls])
+    assert abs(df["m"][0] - exp) < 2.0
+
+
+def test_sql_tdigest_agg_grouped(runner):
+    r, g, x, nulls = runner
+    df = r.run(
+        "SELECT g, value_at_quantile(tdigest_agg(x), 0.9) q FROM t "
+        "GROUP BY g ORDER BY g")
+    for gi in range(3):
+        exp = np.quantile(x[(g == gi) & ~nulls], 0.9)
+        assert abs(df["q"][gi] - exp) < 3.0
+
+
+def test_sql_values_at_quantiles(runner):
+    r, g, x, nulls = runner
+    df = r.run(
+        "SELECT values_at_quantiles(tdigest_agg(x), ARRAY[0.25, 0.75]) v "
+        "FROM t")
+    got = df["v"][0]
+    exp = np.quantile(x[~nulls], [0.25, 0.75])
+    assert abs(got[0] - exp[0]) < 3.0 and abs(got[1] - exp[1]) < 3.0
+
+
+def test_sql_quantile_at_value_and_trimmed_mean(runner):
+    r, g, x, nulls = runner
+    df = r.run(
+        "SELECT quantile_at_value(tdigest_agg(x), 200.0) q, "
+        "trimmed_mean(tdigest_agg(x), 0.1, 0.9) tm FROM t")
+    exp_q = (x[~nulls] <= 200.0).mean()
+    assert abs(df["q"][0] - exp_q) < 0.02
+    lo, hi = np.quantile(x[~nulls], [0.1, 0.9])
+    xs = x[~nulls]
+    exp_tm = xs[(xs >= lo) & (xs <= hi)].mean()
+    assert abs(df["tm"][0] - exp_tm) < 3.0
+
+
+def test_sql_merge_of_stored_digests(runner):
+    r, g, x, nulls = runner
+    # CTAS-persist per-group digests, then merge them back into one
+    r.run("CREATE TABLE mem.digests AS "
+          "SELECT g, tdigest_agg(x) d FROM t GROUP BY g")
+    df = r.run(
+        "SELECT value_at_quantile(merge(d), 0.5) m FROM mem.digests")
+    exp = np.median(x[~nulls])
+    assert abs(df["m"][0] - exp) < 4.0
+
+
+def test_sql_scale_tdigest(runner):
+    r, g, x, nulls = runner
+    df = r.run(
+        "SELECT value_at_quantile(scale_tdigest(tdigest_agg(x), 2.0), 0.5) a,"
+        " value_at_quantile(tdigest_agg(x), 0.5) b FROM t")
+    assert df["a"][0] == pytest.approx(df["b"][0])
+
+
+def test_sql_weighted_tdigest_agg():
+    conn = MemoryConnector("mem")
+    conn.add_table("wt", {"x": [1.0, 10.0], "w": [9.0, 1.0]},
+                   {"x": DOUBLE, "w": DOUBLE})
+    cat = Catalog()
+    cat.register("mem", conn, default=True)
+    r = LocalRunner(cat, ExecConfig(batch_rows=64))
+    df = r.run("SELECT quantile_at_value(tdigest_agg(x, w), 5.0) q FROM wt")
+    assert 0.6 <= df["q"][0] <= 0.95
+
+
+def test_sql_all_null_group_is_null():
+    conn = MemoryConnector("mem")
+    conn.add_table("nt", {"g": [1, 1, 2], "x": [None, None, 3.0]},
+                   {"g": BIGINT, "x": DOUBLE})
+    cat = Catalog()
+    cat.register("mem", conn, default=True)
+    r = LocalRunner(cat, ExecConfig(batch_rows=64))
+    df = r.run("SELECT g, value_at_quantile(tdigest_agg(x), 0.5) q "
+               "FROM nt GROUP BY g ORDER BY g")
+    import pandas as pd
+
+    assert pd.isna(df["q"][0])
+    assert df["q"][1] == 3.0
+
+
+def test_sql_type_errors(runner):
+    r = runner[0]
+    from presto_tpu.plan.builder import AnalysisError
+
+    with pytest.raises(AnalysisError):
+        r.run("SELECT value_at_quantile(x, 0.5) FROM t")
+    with pytest.raises(AnalysisError):
+        r.run("SELECT merge(x) FROM t")
+    with pytest.raises(AnalysisError):
+        r.run("SELECT value_at_quantile(tdigest_agg(x), 1.5) FROM t")
+
+
+def test_sql_distributed_gather():
+    """tdigest_agg is non-decomposable: the fragmenter must gather input
+    to a single task and produce the same digest as the local path."""
+    import jax
+
+    if jax.default_backend() != "cpu":  # pragma: no cover
+        pytest.skip("cpu-only harness")
+    import pandas as pd
+
+    from presto_tpu.server.coordinator import DistributedRunner
+
+    rng = np.random.default_rng(13)
+    x = rng.normal(0, 1, 5000)
+    conn = MemoryConnector("mem")
+    conn.add_table("t", pd.DataFrame({"x": x}))
+    cat = Catalog()
+    cat.register("mem", conn, default=True)
+    r = DistributedRunner(cat, n_workers=2, config=ExecConfig(batch_rows=512))
+    try:
+        df = r.run("SELECT value_at_quantile(tdigest_agg(x), 0.5) m FROM t")
+        assert abs(df["m"][0] - np.median(x)) < 0.1
+    finally:
+        r.close()
