@@ -326,11 +326,17 @@ def fragment_from_json(d: Dict[str, Any]) -> Fragment:
 
 
 def task_update_to_json(u) -> Dict[str, Any]:
-    return {"fragment": fragment_to_json(u.fragment),
-            "task_index": u.task_index, "n_tasks": u.n_tasks,
-            "n_out_partitions": u.n_out_partitions,
-            "upstreams": {str(k): list(v) for k, v in u.upstreams.items()},
-            "config": dict(u.config), "spool": bool(u.spool)}
+    out = {"fragment": fragment_to_json(u.fragment),
+           "task_index": u.task_index, "n_tasks": u.n_tasks,
+           "n_out_partitions": u.n_out_partitions,
+           "upstreams": {str(k): list(v) for k, v in u.upstreams.items()},
+           "config": dict(u.config), "spool": bool(u.spool)}
+    if u.split_assignment is not None:
+        out["split_assignment"] = {
+            t: list(map(int, idxs)) for t, idxs in u.split_assignment.items()}
+    if u.split_counts is not None:
+        out["split_counts"] = {t: int(n) for t, n in u.split_counts.items()}
+    return out
 
 
 def task_update_from_json(d: Dict[str, Any]):
@@ -343,4 +349,11 @@ def task_update_from_json(d: Dict[str, Any]):
         upstreams={int(k): list(v) for k, v in d["upstreams"].items()},
         config=dict(d.get("config") or {}),
         spool=bool(d.get("spool", False)),
+        split_assignment=(
+            {t: [int(i) for i in idxs]
+             for t, idxs in d["split_assignment"].items()}
+            if d.get("split_assignment") is not None else None),
+        split_counts=(
+            {t: int(n) for t, n in d["split_counts"].items()}
+            if d.get("split_counts") is not None else None),
     )

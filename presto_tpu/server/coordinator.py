@@ -225,6 +225,54 @@ def compute_phases(frags) -> Dict[int, int]:
     return {fid: p - lo for fid, p in phase.items()}
 
 
+def _fragment_scans(root) -> list:
+    """All TableScan nodes of a fragment (split-placement candidates)."""
+    from presto_tpu.plan.nodes import TableScan
+
+    out = []
+
+    def walk(n):
+        if isinstance(n, TableScan):
+            out.append(n)
+        for c in n.children():
+            walk(c)
+
+    walk(root)
+    return out
+
+
+def _affinity_assign(table: str, n_splits: int,
+                     worker_keys: List[str]) -> List[List[int]]:
+    """Rendezvous-hash split placement with a balance cap (reference:
+    scheduler/NodeScheduler.java + SimpleNodeSelector and the
+    SOFT_AFFINITY NodeSelectionStrategy of connector split sources).
+
+    Each split ranks every worker by fnv64(table:ordinal:worker) and
+    lands on its best-ranked worker that still has capacity
+    (cap = ⌈splits/workers⌉, the maxSplitsPerNode analog). The mapping is
+    deterministic across queries AND coordinator restarts, so a worker
+    keeps seeing the same splits — its device split cache turns that
+    stability into scan locality. When a worker joins/leaves, only the
+    splits hashed to it move (rendezvous minimal-disruption property)."""
+    from presto_tpu.dictionary import fnv64
+
+    k = len(worker_keys)
+    cap = -(-n_splits // k) if n_splits else 0
+    counts = [0] * k
+    out: List[List[int]] = [[] for _ in range(k)]
+    for j in range(n_splits):
+        ranked = sorted(
+            range(k),
+            key=lambda w: fnv64(f"{table}:{j}:{worker_keys[w]}"),
+            reverse=True)
+        for w in ranked:
+            if counts[w] < cap:
+                out[w].append(j)
+                counts[w] += 1
+                break
+    return out
+
+
 class DistributedScheduler:
     """Schedules a DistributedPlan onto workers and streams the result
     (SqlQueryScheduler.schedule:657 analog). Policies
@@ -234,13 +282,17 @@ class DistributedScheduler:
 
     def __init__(self, config: Optional[ExecConfig] = None,
                  cluster_secret: Optional[str] = None,
-                 on_worker_lost=None):
+                 on_worker_lost=None, catalog=None):
         self.config = config or ExecConfig()
         self.cluster_secret = cluster_secret
         # notified with the NodeInfo of a worker found dead during task
         # placement/phase waits (the coordinator excludes it from rotation
         # immediately, like the pre-retry reprobe does)
         self.on_worker_lost = on_worker_lost
+        # catalog access enables coordinator-side split placement
+        # (soft-affinity scheduling); without it tasks fall back to the
+        # static task_index::n_tasks striding
+        self.catalog = catalog
 
     def _headers(self, extra: Optional[dict] = None) -> dict:
         h = dict(extra or {})
@@ -296,6 +348,38 @@ class DistributedScheduler:
             fid: n_tasks[consumer[fid]] if fid in consumer else 1
             for fid in frags
         }
+        # soft-affinity split placement (NodeScheduler analog): for each
+        # single-scan SOURCE fragment, enumerate the connector's splits
+        # HERE and pin each ordinal to a worker by rendezvous hash. A
+        # rescheduled task keeps its index → its ordinals, so coverage
+        # survives worker loss. Multi-scan fragments (colocated bucket
+        # joins) keep aligned task_index striding.
+        # fid → per-task (ordinals-by-table, enumeration-count-by-table)
+        split_assignments: Dict[int, List[tuple]] = {}
+        if self.catalog is not None and getattr(config, "split_affinity",
+                                                True):
+            wkeys = [w.uri for w in workers]
+            for fid, f in frags.items():
+                if f.partitioning != SOURCE or fid in grouped:
+                    continue
+                scans = _fragment_scans(f.root)
+                if len(scans) != 1:
+                    continue
+                scan = scans[0]
+                try:
+                    conn = self.catalog.connectors[scan.catalog]
+                    handle = conn.get_table(scan.table)
+                    nrows = int(handle.row_count or 0)
+                    nsplits = max(1, -(-nrows // config.batch_rows))
+                    n = len(conn.splits(handle, nsplits))
+                except Exception:
+                    continue  # non-enumerable here → static striding
+                per_worker = _affinity_assign(scan.table, n, wkeys)
+                split_assignments[fid] = [
+                    ({scan.table: per_worker[i % len(workers)]},
+                     {scan.table: n})
+                    for i in range(n_tasks[fid])
+                ]
         phased = getattr(config, "execution_policy",
                          "all-at-once") == "phased"
         phases = (compute_phases(frags) if phased
@@ -332,6 +416,7 @@ class DistributedScheduler:
                 for rs in f.remote_sources()
             }
             strip_runtime_state(f.root)
+            sa = split_assignments.get(fid)
             update = TaskUpdate(
                 fragment=f,
                 task_index=i,
@@ -342,6 +427,8 @@ class DistributedScheduler:
                 # a build-phase task's consumers don't exist yet:
                 # spool its output instead of blocking on back-pressure
                 spool=phases[fid] < last_phase,
+                split_assignment=None if sa is None else sa[i][0],
+                split_counts=None if sa is None else sa[i][1],
             )
             body = json.dumps(task_update_to_json(update)).encode()
             req = urllib.request.Request(
@@ -564,7 +651,8 @@ class Coordinator:
         self.size_monitor = ClusterSizeMonitor(self.node_manager, min_workers)
         self.scheduler = DistributedScheduler(
             self.config, cluster_secret=cluster_secret,
-            on_worker_lost=lambda n: self._probe_and_exclude(n))
+            on_worker_lost=lambda n: self._probe_and_exclude(n),
+            catalog=catalog)
         self._query_seq = 0
         self._lock = threading.Lock()
         # keyed by (sql, plan-affecting session property values)

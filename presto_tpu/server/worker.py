@@ -64,6 +64,12 @@ class TaskUpdate:
     # and cannot drain them yet (PhasedExecutionSchedule + the reference's
     # spooling broadcast buffers)
     spool: bool = False
+    # coordinator-assigned split ordinals per table (soft-affinity
+    # placement; None → static task_index::n_tasks striding), with the
+    # coordinator's enumeration count so a drifted table (concurrent
+    # INSERT) fails loudly instead of silently dropping splits
+    split_assignment: Optional[Dict[str, List[int]]] = None
+    split_counts: Optional[Dict[str, int]] = None
 
 
 @lru_cache(maxsize=256)
@@ -191,6 +197,8 @@ class TaskExecution:
                               spill_manager=self.spill_manager)
             ctx.task_index = self.update.task_index
             ctx.n_tasks = self.update.n_tasks
+            ctx.split_assignment = self.update.split_assignment
+            ctx.split_counts = self.update.split_counts
             ctx.remote_sources = self._remote_source_factory
             f = self.update.fragment
             sink = self._make_sink(f)
