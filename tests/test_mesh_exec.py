@@ -192,3 +192,26 @@ def test_scalar_subquery_param_on_mesh(env):
     q = ("select count(*) as c from orders "
          "where o_totalprice > (select avg(o_totalprice) from orders)")
     _same(mx.run(q), local.run(q))
+
+
+def test_not_in_nulls_on_mesh(env):
+    """NOT IN three-valued logic on the mesh path: a NULL anywhere in the
+    subquery's values makes NOT IN yield no row (unless the probe key is
+    NULL too — then UNKNOWN), and an EMPTY subquery keeps every row.
+    Cross-checked against the local engine on both shapes."""
+    mx, local = env
+    # non-empty subquery WITH a NULL-able derivation: nullif plants NULLs
+    q1 = ("select count(*) as c from orders "
+          "where o_custkey not in "
+          "(select nullif(c_custkey, 3) from customer)")
+    _same(mx.run(q1), local.run(q1))
+    # empty subquery: NOT IN over the empty set is TRUE for every row
+    q2 = ("select count(*) as c from orders "
+          "where o_custkey not in "
+          "(select c_custkey from customer where c_custkey < 0)")
+    _same(mx.run(q2), local.run(q2))
+    # no NULLs, plain anti-join semantics
+    q3 = ("select count(*) as c from orders "
+          "where o_custkey not in "
+          "(select c_custkey from customer where c_nationkey = 5)")
+    _same(mx.run(q3), local.run(q3))
