@@ -300,6 +300,16 @@ class MemoryTable:
             elif isinstance(t, DecimalType):
                 if np.issubdtype(arr.dtype, np.floating):
                     arr = np.round(arr.astype(np.float64) * 10 ** t.scale).astype(np.int64)
+                elif arr.dtype == object:
+                    # list ingest arrives as object: scale each value
+                    # exactly (astype(int64) would TRUNCATE floats first)
+                    import decimal as _dec
+
+                    arr = np.array(
+                        [int(_dec.Decimal(str(v)).scaleb(t.scale)
+                             .to_integral_value(
+                                 rounding=_dec.ROUND_HALF_UP))
+                         for v in arr], dtype=np.int64)
                 else:
                     arr = arr.astype(np.int64) * 10 ** t.scale
             self.types[col] = t

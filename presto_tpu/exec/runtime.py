@@ -521,6 +521,11 @@ def _execute_base(base: PlanNode, ctx: ExecContext) -> Iterator[Batch]:
         live[0] = True
         yield Batch([], [], [], jnp.asarray(live), {})
         return
+    from presto_tpu.plan.nodes import HostProject as _HP
+
+    if isinstance(base, _HP):
+        yield from _execute_host_project(base, ctx)
+        return
     from presto_tpu.plan.nodes import TableWriter as _TW
 
     if isinstance(base, _TW):
@@ -806,6 +811,7 @@ _COVAR_FNS = {"covar_pop", "covar_samp", "corr"}
 _NON_DECOMPOSABLE_FNS = {"approx_percentile", "__approx_percentile_w",
                          "max_by", "min_by", "array_agg", "map_agg",
                          "numeric_histogram", "tdigest_agg", "merge",
+                         "approx_set",
                          "count_distinct", "sum_distinct", "avg_distinct"}
 
 _CHECKSUM_NULL = jnp.int64(-7046029254386353131)  # fixed NULL contribution
@@ -1077,7 +1083,7 @@ def _execute_materialized_aggregate(node: Aggregate, ctx: ExecContext) -> Iterat
     key_types = [in_types[k] for k in key_syms]
     decomp = [a for a in node.aggs if a.fn not in _NON_DECOMPOSABLE_FNS]
     _HOST_AGGS = ("array_agg", "map_agg", "numeric_histogram",
-                  "tdigest_agg", "merge")
+                  "tdigest_agg", "merge", "approx_set")
     ndec = [a for a in node.aggs
             if a.fn in _NON_DECOMPOSABLE_FNS and a.fn not in _HOST_AGGS]
     arr_aggs = [a for a in node.aggs if a.fn in _HOST_AGGS]
@@ -1179,43 +1185,103 @@ def _attach_numeric_histogram(acc: Batch, full: Batch, a, row_gi,
                keys=jnp.asarray(keys2d)))
 
 
-def _attach_tdigest(acc: Batch, full: Batch, a, row_gi, live) -> Batch:
-    """tdigest_agg(x[, w][, compression]) / merge(tdigest) → one digest
-    entry per group (expr/tdigest.py). Runs at the gathered single task
-    like the other host aggregates; the output column is a fresh
-    dictionary of serialized digests (reference:
-    TDigestAggregationFunction / MergeTDigestAggregation)."""
+def _host_format_value(kind: str, param, t, v) -> str:
+    """One distinct value → its text (HostProject formatting kernels).
+    varchar_cast mirrors the reference's cast-to-varchar renderings;
+    date_format uses the MySQL format vocabulary."""
+    import datetime as _d
+
+    if kind == "date_format":
+        from presto_tpu.expr.compile import mysql_format_to_strptime
+
+        fmt = mysql_format_to_strptime(str(param))
+        if t.name == "date":
+            dt = _d.datetime(1970, 1, 1) + _d.timedelta(days=int(v))
+        else:
+            dt = _d.datetime(1970, 1, 1) + _d.timedelta(microseconds=int(v))
+        return dt.strftime(fmt)
+    # varchar_cast
+    if t.name == "boolean":
+        return "true" if v else "false"
+    if t.name == "date":
+        return str(_d.date(1970, 1, 1) + _d.timedelta(days=int(v)))
+    if t.name in ("timestamp", "time"):
+        if t.name == "time":
+            dt = _d.datetime(1970, 1, 1) + _d.timedelta(microseconds=int(v))
+            out = dt.strftime("%H:%M:%S.%f")[:-3]
+        else:
+            dt = _d.datetime(1970, 1, 1) + _d.timedelta(microseconds=int(v))
+            out = dt.strftime("%Y-%m-%d %H:%M:%S.%f")[:-3]
+        return out
+    if isinstance(t, DecimalType):
+        import decimal as _dec
+
+        return str(_dec.Decimal(int(v)).scaleb(-t.scale))
+    if t.name == "real":
+        # numpy's shortest float32 repr — float(v) would widen to float64
+        # and print garbage mantissa digits ('1.100000023841858')
+        return str(np.float32(v))
+    if t.name == "double":
+        return str(float(v))
+    return str(int(v))
+
+
+def _execute_host_project(node, ctx: ExecContext) -> Iterator[Batch]:
+    """HostProject: string-producing scalars (cast-to-varchar,
+    date_format) evaluated on the host at the root, once per DISTINCT
+    input value per batch, re-encoded as a fresh dictionary column
+    (plan/nodes.HostProject)."""
     from presto_tpu.dictionary import Dictionary
-    from presto_tpu.expr import tdigest as _td
+    from presto_tpu.types import VARCHAR as _VC
+
+    in_types = dict(node.child.output)
+    for b in execute_node(node.child, ctx):
+        for sym, kind, in_sym, param in node.items:
+            t = in_types[in_sym]
+            c = b.column(in_sym)
+            vals = np.asarray(c.values)
+            if c.hi is not None:
+                # long decimal: exact int128 from the two limbs
+                his = np.asarray(c.hi)
+                vals = np.array(
+                    [(int(h) << 32) + int(lo) for h, lo in zip(his, vals)],
+                    dtype=object)
+            live = np.asarray(b.live)
+            valid = np.asarray(c.valid_mask()) & live
+            # format once per distinct value; dead/null lanes format a 0
+            # placeholder that the validity mask hides
+            safe = np.where(valid, vals, np.zeros((), dtype=vals.dtype)
+                            if vals.dtype != object else 0)
+            uniq, inv = np.unique(safe, return_inverse=True)
+            strs = np.asarray(
+                [_host_format_value(kind, param, t, u) for u in uniq],
+                dtype=object)
+            d, ucodes = Dictionary.encode(strs)
+            row_codes = ucodes[inv].astype(np.int32)
+            row_codes = np.where(valid, row_codes, -1)
+            b = b.with_column(
+                sym, _VC,
+                Column(jnp.asarray(row_codes), jnp.asarray(valid)),
+                dictionary=d)
+        yield b
+
+
+def _attach_sketch(acc: Batch, full: Batch, a, row_gi, live, valid,
+                   group_fn) -> Batch:
+    """Shared scaffolding for sketch-valued host aggregates (tdigest,
+    HyperLogLog): gather valid row indices per group, compute ONE
+    serialized entry per group (`group_fn(rows) -> entry | None`; None =
+    SQL NULL), and attach the result as a fresh dictionary column."""
+    from presto_tpu.dictionary import Dictionary
 
     cap = acc.capacity
-    c = full.column(a.arg)
-    valid = np.asarray(c.valid_mask())[live]
-    is_merge = a.fn == "merge"
-    if is_merge:
-        entries = full.dicts[a.arg].decode(np.asarray(c.values)[live])
-    else:
-        vals = np.asarray(c.values)[live].astype(np.float64)
-        if a.arg2 is not None:
-            wc = full.column(a.arg2)
-            wvals = np.asarray(wc.values)[live].astype(np.float64)
-            valid = valid & np.asarray(wc.valid_mask())[live]
-        else:
-            wvals = None
     per_group: Dict[int, list] = {}
     for r in np.nonzero(valid)[0]:
         per_group.setdefault(int(row_gi[r]), []).append(int(r))
-    compression = float(a.param) if a.param else _td.DEFAULT_COMPRESSION
     out_entries = np.full(cap, "", dtype=object)
     validity = np.zeros(cap, bool)
     for gi, rows in per_group.items():
-        if is_merge:
-            e = _td.merge([entries[r] for r in rows
-                           if entries[r] is not None])
-        else:
-            e = _td.build(vals[rows],
-                          None if wvals is None else wvals[rows],
-                          compression)
+        e = group_fn(rows)
         if e is not None:
             out_entries[gi] = e
             validity[gi] = True
@@ -1224,6 +1290,67 @@ def _attach_tdigest(acc: Batch, full: Batch, a, row_gi, live) -> Batch:
         a.symbol, a.type,
         Column(jnp.asarray(codes.astype(np.int32)), jnp.asarray(validity)),
         dictionary=d)
+
+
+def _attach_tdigest(acc: Batch, full: Batch, a, row_gi, live) -> Batch:
+    """tdigest_agg(x[, w][, compression]) / merge(tdigest) → one digest
+    entry per group (expr/tdigest.py). Runs at the gathered single task
+    like the other host aggregates (reference:
+    TDigestAggregationFunction / MergeTDigestAggregation)."""
+    from presto_tpu.expr import tdigest as _td
+
+    c = full.column(a.arg)
+    valid = np.asarray(c.valid_mask())[live]
+    if a.fn == "merge":
+        entries = full.dicts[a.arg].decode(np.asarray(c.values)[live])
+
+        def group_fn(rows):
+            return _td.merge([entries[r] for r in rows
+                              if entries[r] is not None])
+    else:
+        vals = np.asarray(c.values)[live].astype(np.float64)
+        if a.arg2 is not None:
+            wc = full.column(a.arg2)
+            wvals = np.asarray(wc.values)[live].astype(np.float64)
+            valid = valid & np.asarray(wc.valid_mask())[live]
+        else:
+            wvals = None
+        compression = float(a.param) if a.param else _td.DEFAULT_COMPRESSION
+
+        def group_fn(rows):
+            return _td.build(vals[rows],
+                             None if wvals is None else wvals[rows],
+                             compression)
+    return _attach_sketch(acc, full, a, row_gi, live, valid, group_fn)
+
+
+def _attach_hll(acc: Batch, full: Batch, a, row_gi, live) -> Batch:
+    """approx_set(x) / merge(hyperloglog) → one sketch entry per group
+    (expr/hll.py). The hash pipeline matches the approx_distinct device
+    lowering exactly (content hash for strings, canonical bit pattern
+    for doubles), so cardinality(approx_set(x)) == approx_distinct(x).
+    Reference: ApproximateSetAggregation / MergeHyperLogLogAggregation."""
+    from presto_tpu.expr import hll as _hll
+
+    c = full.column(a.arg)
+    valid = np.asarray(c.valid_mask())[live]
+    if a.fn == "merge":
+        entries = full.dicts[a.arg].decode(np.asarray(c.values)[live])
+
+        def group_fn(rows):
+            return _hll.merge([entries[r] for r in rows
+                               if entries[r] is not None])
+    else:
+        vals = np.asarray(c.values)[live]
+        hashes = None
+        if a.arg in full.dicts:
+            lut = np.asarray(full.dicts[a.arg].content_hash_lut())
+            hashes = lut[vals.astype(np.int64) + 1]
+        reg, rank = _hll.regs_and_ranks(vals, hashes)
+
+        def group_fn(rows):
+            return _hll.build(reg[rows], rank[rows])
+    return _attach_sketch(acc, full, a, row_gi, live, valid, group_fn)
 
 
 def _attach_array_aggs(acc: Batch, full: Batch, aggs, key_syms) -> Batch:
@@ -1264,6 +1391,11 @@ def _attach_array_aggs(acc: Batch, full: Batch, aggs, key_syms) -> Batch:
     for a in aggs:
         if a.fn == "numeric_histogram":
             acc = _attach_numeric_histogram(acc, full, a, row_gi, live)
+            continue
+        if a.fn == "approx_set" or (
+                a.fn == "merge"
+                and full.type_of(a.arg).name == "hyperloglog"):
+            acc = _attach_hll(acc, full, a, row_gi, live)
             continue
         if a.fn in ("tdigest_agg", "merge"):
             acc = _attach_tdigest(acc, full, a, row_gi, live)

@@ -133,10 +133,44 @@ _STR_TO_STR = {
 _STR_TO_FLOAT = {"value_at_quantile", "quantile_at_value", "trimmed_mean"}
 # string→int functions (code-indexed int lut)
 _STR_TO_INT = {"length", "strpos", "codepoint", "json_array_length",
-               "json_size", "levenshtein_distance_c", "hamming_distance_c"}
+               "json_size", "levenshtein_distance_c", "hamming_distance_c",
+               "__hll_cardinality", "bit_length", "__vb_bit_length",
+               "date_parse", "from_iso8601_date", "from_iso8601_timestamp"}
 # int functions whose python fn may return None = SQL NULL (absent json
 # path / non-array input) — carried via a parallel null lut
-_STR_INT_NULLABLE = {"json_array_length", "json_size"}
+_STR_INT_NULLABLE = {"json_array_length", "json_size", "__hll_cardinality",
+                     "date_parse", "from_iso8601_date",
+                     "from_iso8601_timestamp"}
+
+# MySQL date format specifiers → strptime (DateTimeFunctions.java's
+# date_parse uses the MySQL vocabulary, not JodaTime's)
+_MYSQL_FMT = {"Y": "%Y", "y": "%y", "m": "%m", "c": "%m", "d": "%d",
+              "e": "%d", "H": "%H", "k": "%H", "h": "%I", "I": "%I",
+              "l": "%I", "i": "%M", "s": "%S", "S": "%S", "f": "%f",
+              "p": "%p", "M": "%B", "b": "%b", "a": "%a", "W": "%A",
+              "j": "%j", "T": "%H:%M:%S", "r": "%I:%M:%S %p", "%": "%%"}
+
+
+def mysql_format_to_strptime(fmt: str) -> str:
+    """Translate a MySQL date format to strptime; unsupported specifiers
+    raise ValueError (the builder surfaces it as an AnalysisError)."""
+    out = []
+    i = 0
+    while i < len(fmt):
+        ch = fmt[i]
+        if ch == "%":
+            if i + 1 >= len(fmt):
+                raise ValueError("trailing % in date format")
+            spec = fmt[i + 1]
+            if spec not in _MYSQL_FMT:
+                raise ValueError(f"unsupported date format specifier %{spec}")
+            out.append(_MYSQL_FMT[spec])
+            i += 2
+        else:
+            # strptime treats bare % as special; everything else literal
+            out.append(ch)
+            i += 1
+    return "".join(out)
 # string→bool predicate functions (bool lut, like LIKE)
 _STR_PRED = {"regexp_like", "starts_with", "ends_with", "contains",
              "json_array_contains", "is_json_scalar",
@@ -454,6 +488,62 @@ def _str_int_pyfn(fn: str, cargs: tuple):
                 return None  # absent path → NULL
             return len(v) if isinstance(v, (dict, list)) else 0
         return jsz
+    if fn == "__hll_cardinality":
+        from presto_tpu.expr.hll import cardinality as _hll_card
+
+        return _hll_card
+    if fn == "bit_length":
+        return lambda s: 8 * len(s.encode("utf-8"))
+    if fn == "__vb_bit_length":
+        return lambda s: 8 * len(s)  # latin-1 bijection: 1 char = 1 byte
+    if fn == "date_parse":
+        from datetime import datetime as _dt
+
+        raw_fmt = str(cargs[0])
+        pyfmt = mysql_format_to_strptime(raw_fmt)
+        # strptime defaults missing fields to 1900-01-01; the reference
+        # defaults to the 1970 epoch — patch the year when the format
+        # carries no year directive (month/day already default to 1)
+        has_year = any(f"%{c}" in raw_fmt for c in "Yy")
+        epoch = _dt(1970, 1, 1)
+
+        def dparse(s, _fmt=pyfmt, _ep=epoch, _hy=has_year):
+            try:
+                dt = _dt.strptime(s, _fmt)
+            except ValueError:
+                return None  # unparseable → NULL (documented deviation)
+            if not _hy:
+                dt = dt.replace(year=1970)
+            td = dt - _ep
+            return (td.days * 86_400_000_000 + td.seconds * 1_000_000
+                    + td.microseconds)
+
+        return dparse
+    if fn == "from_iso8601_date":
+        import datetime as _d
+
+        def iso_date(s):
+            try:
+                return _d.date.fromisoformat(s.strip()).toordinal() - 719163
+            except ValueError:
+                return None
+
+        return iso_date
+    if fn == "from_iso8601_timestamp":
+        import datetime as _d
+
+        def iso_ts(s):
+            try:
+                dt = _d.datetime.fromisoformat(s.strip().replace("Z", "+00:00"))
+            except ValueError:
+                return None
+            if dt.tzinfo is not None:
+                dt = dt.astimezone(_d.timezone.utc).replace(tzinfo=None)
+            td = dt - _d.datetime(1970, 1, 1)
+            return (td.days * 86_400_000_000 + td.seconds * 1_000_000
+                    + td.microseconds)
+
+        return iso_ts
     if fn == "levenshtein_distance_c":
         other = str(cargs[0])
 
@@ -800,7 +890,7 @@ _STRUCT_ONLY_FNS = {
     "transform", "filter", "reduce", "any_match", "all_match", "none_match",
     "transform_values", "map_filter",
     "array_union", "array_intersect", "array_except", "arrays_overlap",
-    "map_concat", "zip_with",
+    "map_concat", "zip_with", "split", "regexp_split", "array_remove",
 }
 # polymorphic names: structural only when the first arg is ARRAY/MAP
 _STRUCT_POLY_FNS = {"cardinality", "contains", "concat", "element_at",
@@ -1059,7 +1149,8 @@ def _eval_call(e: Call, ctx: CompileContext):
                 codes, valid = _eval(operand, ctx)
                 notnull = ~jnp.asarray(nulls)[codes + 1]
                 valid = notnull if valid is None else valid & notnull
-                return jnp.asarray(table)[codes + 1], valid
+                # e.type drives the device dtype (DATE luts are int32)
+                return jnp.asarray(table)[codes + 1].astype(e.type.dtype), valid
             table = d.int_lut((fn, cargs), pyfn)
         else:
             table = d.int_lut((fn, cargs), _str_pred_pyfn(fn, cargs),
@@ -1119,6 +1210,11 @@ def _eval_call(e: Call, ctx: CompileContext):
         )
         mono = bits ^ flip
         return (mono >> jnp.uint64(40)).astype(jnp.int64), avalid
+
+    if fn == "__host_date_format":
+        raise NotImplementedError(
+            "date_format is supported in the top-level SELECT list only "
+            "(it is a host finishing projection)")
 
     # ---- cast ------------------------------------------------------------
     if fn == "cast":
@@ -1237,13 +1333,21 @@ def _eval_call(e: Call, ctx: CompileContext):
         return bucket, valid
 
     # ---- date ------------------------------------------------------------
+    def _as_days(a, v):
+        # TIMESTAMP operands (micros since epoch) reduce to civil days;
+        # DATE is already days
+        if a.type.name == "timestamp":
+            return jnp.floor_divide(v.astype(jnp.int64),
+                                    86_400_000_000).astype(jnp.int32)
+        return v.astype(jnp.int32)
+
     if fn in ("year", "month", "day"):
         v, valid = _eval_arg(e.args[0], ctx)
-        y, m, d = _civil_from_days(v.astype(jnp.int32))
+        y, m, d = _civil_from_days(_as_days(e.args[0], v))
         return {"year": y, "month": m, "day": d}[fn].astype(jnp.int64), valid
     if fn == "quarter":
         v, valid = _eval_arg(e.args[0], ctx)
-        _, m, _ = _civil_from_days(v.astype(jnp.int32))
+        _, m, _ = _civil_from_days(_as_days(e.args[0], v))
         return ((m - 1) // 3 + 1).astype(jnp.int64), valid
     if fn in ("__time_hour", "__time_minute", "__time_second"):
         # TIME (micros-of-day) and TIMESTAMP (micros-since-epoch) both
@@ -1260,10 +1364,11 @@ def _eval_call(e: Call, ctx: CompileContext):
     if fn == "day_of_week":
         # ISO: 1 = Monday … 7 = Sunday; epoch day 0 (1970-01-01) is Thursday
         v, valid = _eval_arg(e.args[0], ctx)
-        return (jnp.mod(v.astype(jnp.int64) + 3, 7) + 1), valid
+        return (jnp.mod(_as_days(e.args[0], v).astype(jnp.int64) + 3, 7)
+                + 1), valid
     if fn == "day_of_year":
         v, valid = _eval_arg(e.args[0], ctx)
-        days = v.astype(jnp.int32)
+        days = _as_days(e.args[0], v)
         y, _, _ = _civil_from_days(days)
         return (days - _days_from_civil_vec(y, 1, 1) + 1).astype(jnp.int64), valid
     if fn == "date_add_days":
@@ -1387,6 +1492,51 @@ def _setop_key_dict(e: Call, ctx: CompileContext) -> Dictionary | None:
     return d
 
 
+def regexp_split_pieces(pattern: str):
+    """Splitter matching the reference: capture groups in the pattern
+    must NOT leak into the result (Python re.split interleaves them at
+    positions that are not multiples of groups+1)."""
+    rx = re.compile(pattern)
+    if not rx.groups:
+        return rx.split
+    step = rx.groups + 1
+    return lambda s, _rx=rx, _st=step: _rx.split(s)[::_st]
+
+
+def _split_tables(d: Dictionary, fn: str, cargs: tuple):
+    """split/regexp_split over a dictionary: per-entry piece lists →
+    (element_dict, [len+1, W] code plane, [len+1] sizes), row 0 = NULL.
+    Memoized on the dictionary like transform()."""
+    key = ("__split", fn, cargs)
+    hit = d._memo.get(key)
+    if hit is not None:
+        return hit
+    if fn == "split":
+        delim = str(cargs[0])
+        limit = int(cargs[1]) if len(cargs) > 1 else None
+        # SQL limit = max array size; the last element takes the rest
+        splitter = (lambda s: s.split(delim) if limit is None
+                    else s.split(delim, limit - 1))
+    else:
+        splitter = regexp_split_pieces(str(cargs[0]))
+    pieces = [splitter(str(v)) for v in d.values]
+    from presto_tpu.dictionary import safe_str_array
+
+    uniq = sorted({p for ps in pieces for p in ps}) or [""]
+    ed = Dictionary(np.unique(safe_str_array(
+        np.asarray(uniq, dtype=object))))
+    w = max((len(ps) for ps in pieces), default=1) or 1
+    n = len(d.values)
+    plane = np.zeros((n + 1, w), np.int32)
+    sizes = np.zeros(n + 1, np.int32)
+    for i, ps in enumerate(pieces):
+        sizes[i + 1] = len(ps)
+        for j, p in enumerate(ps):
+            plane[i + 1, j] = ed.code_of(p)
+    d._memo[key] = (ed, plane, sizes)
+    return ed, plane, sizes
+
+
 def _elem_dict(e: RowExpression, ctx: CompileContext) -> Dictionary | None:
     """Dictionary of a structural expression's (string) element plane."""
     if isinstance(e, InputRef):
@@ -1394,6 +1544,12 @@ def _elem_dict(e: RowExpression, ctx: CompileContext) -> Dictionary | None:
     if isinstance(e, Call):
         if e.fn == "array_ctor" and e.type.element.is_string:
             return _array_ctor_dict(e, ctx)
+        if e.fn in ("split", "regexp_split"):
+            operand, cargs = _xform_parts(e)
+            d = ctx.dict_for(operand)
+            return None if d is None else _split_tables(d, e.fn, cargs)[0]
+        if e.fn == "array_remove":
+            return _elem_dict(e.args[0], ctx)
         if e.fn == "map":
             return _elem_dict(e.args[1], ctx)
         if e.fn == "map_keys":
@@ -1503,6 +1659,40 @@ def _eval_structural(e: Call, ctx: CompileContext):
             return _struct.array_ctor(parts, cap, et.dtype), None
         parts = [scalar_arg(a) for a in e.args]
         return _struct.array_ctor(parts, cap, et.dtype), None
+
+    if fn in ("split", "regexp_split"):
+        # per-dictionary-entry expansion (StringFunctions.split): pieces
+        # and sizes are host tables over the operand dictionary; rows get
+        # them via one 2D gather, so the device never sees text
+        operand, cargs = _xform_parts(e)
+        d = ctx.dict_for(operand)
+        if d is None:
+            raise ValueError(f"{fn} needs a dictionary operand")
+        _, plane, sizes = _split_tables(d, fn, cargs)
+        codes, valid = _eval(operand, ctx)
+        return _struct.StructVal(
+            jnp.asarray(plane)[codes.astype(jnp.int32) + 1],
+            jnp.asarray(sizes)[codes.astype(jnp.int32) + 1], None), valid
+
+    if fn == "array_remove":
+        sv0, rvalid0 = _eval(e.args[0], ctx)
+        d = (_elem_dict(e.args[0], ctx)
+             if e.args[0].type.element.is_string else None)
+        xv, xvalid = scalar_arg(e.args[1], d)
+        # equality only counts for present, non-null elements; NULL
+        # elements are retained (unknown ≠ element, Presto semantics).
+        # Mixed numeric widths compare in float64 (truncating 1.5 to an
+        # int element dtype would remove the WRONG elements)
+        xb = jnp.broadcast_to(xv, (cap,))
+        if xb.dtype != sv0.values.dtype:
+            equal = (sv0.values.astype(jnp.float64)
+                     == xb.astype(jnp.float64)[:, None])
+        else:
+            equal = sv0.values == xb[:, None]
+        keep = sv0.present() & ~(equal & sv0.element_valid())
+        out = _struct.filter_elements(sv0, keep)
+        # NULL element argument → NULL result (ArrayRemoveFunction)
+        return out, _and_valid(rvalid0, xvalid)
 
     if fn == "sequence":
         lo = int(e.args[0].value)
@@ -1997,6 +2187,54 @@ def _decimal_div(lv, rv, lt, rt, out_t, valid):
     return (sgn * q).astype(jnp.int64), valid
 
 
+def parse_string_to(tt, s: str):
+    """SQL text → the internal value of type `tt`, or None when
+    unparseable (shared by varchar-cast LUTs and constant folding)."""
+    from presto_tpu.types import DATE as _DATE
+
+    def _time_micros(txt: str) -> int:
+        hms, _, frac = txt.partition(".")
+        parts = list(map(int, hms.split(":")))
+        while len(parts) < 3:
+            parts.append(0)
+        hh, mm, ss = parts[:3]
+        micros = (hh * 3600 + mm * 60 + ss) * 1_000_000
+        if frac:
+            micros += int(frac[:6].ljust(6, "0"))
+        return micros
+
+    try:
+        s = s.strip()
+        if tt is _DATE:
+            y, m, dd = map(int, s.split("-"))
+            return days_from_civil(y, m, dd)
+        if tt.name == "timestamp":
+            datepart, _, timepart = s.partition(" ")
+            y, m, dd = map(int, datepart.split("-"))
+            micros = days_from_civil(y, m, dd) * 86_400_000_000
+            if timepart:
+                micros += _time_micros(timepart)
+            return micros
+        if tt.name == "time":
+            return _time_micros(s)
+        if tt is BOOLEAN:
+            if s.lower() in ("true", "t", "1"):
+                return 1
+            if s.lower() in ("false", "f", "0"):
+                return 0
+            return None
+        if isinstance(tt, DecimalType):
+            import decimal as _dec
+
+            return int(_dec.Decimal(s).scaleb(tt.scale)
+                       .to_integral_value(rounding=_dec.ROUND_HALF_UP))
+        if is_floating(tt):
+            return float(s)
+        return int(float(s)) if "." in s or "e" in s.lower() else int(s)
+    except Exception:
+        return None
+
+
 def _eval_cast(e: Call, ctx):
     src = e.args[0]
     st, tt = src.type, e.type
@@ -2011,40 +2249,12 @@ def _eval_cast(e: Call, ctx):
             raise ValueError("cast from varchar requires a dictionary")
         import numpy as _np
 
-        from presto_tpu.types import DATE as _DATE
-
-        def parse(s: str):
-            s = s.strip()
-            if tt is _DATE:
-                y, m, dd = map(int, s.split("-"))
-                return days_from_civil(y, m, dd)
-            if tt is BOOLEAN:
-                if s.lower() in ("true", "t", "1"):
-                    return 1
-                if s.lower() in ("false", "f", "0"):
-                    return 0
-                raise ValueError(s)
-            if isinstance(tt, DecimalType):
-                import decimal as _dec
-
-                return int(_dec.Decimal(s).scaleb(tt.scale)
-                           .to_integral_value(rounding=_dec.ROUND_HALF_UP))
-            if is_floating(tt):
-                return float(s)
-            return int(float(s)) if "." in s or "e" in s.lower() else int(s)
-
         def val_of(s):
-            try:
-                return parse(s)
-            except Exception:
-                return 0
+            v = parse_string_to(tt, s)
+            return 0 if v is None else v
 
         def ok_of(s):
-            try:
-                parse(s)
-                return True
-            except Exception:
-                return False
+            return parse_string_to(tt, s) is not None
 
         npdt = _np.float64 if is_floating(tt) else _np.int64
         vlut = d.int_lut(("cast_val", tt.name), val_of, dtype=npdt)

@@ -27,6 +27,7 @@ _COVAR_FNS = {"covar_pop", "covar_samp"}
 _NON_DECOMPOSABLE = {"approx_percentile", "__approx_percentile_w",
                      "max_by", "min_by", "array_agg", "map_agg",
                      "numeric_histogram", "tdigest_agg", "merge",
+                     "approx_set",
                      "count_distinct", "sum_distinct", "avg_distinct"}
 
 

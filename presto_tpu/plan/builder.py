@@ -289,8 +289,9 @@ _AGG_FUNCS = {
     # approx family (ApproximateCountDistinct / ApproximateLongPercentile —
     # here computed exactly, which satisfies the approximation contract)
     "approx_distinct", "approx_percentile", "numeric_histogram",
-    # sketches as values (TDigestAggregationFunction, MergeAggregation)
-    "tdigest_agg", "merge",
+    # sketches as values (TDigestAggregationFunction,
+    # ApproximateSetAggregation, MergeAggregation)
+    "tdigest_agg", "merge", "approx_set",
     # argmax family (AbstractMinMaxBy)
     "max_by", "min_by",
     # structural (ArrayAggregationFunction / MapAggregation — materialized
@@ -623,12 +624,20 @@ class ExprAnalyzer:
             raise AnalysisError(
                 "cannot cast to GEOMETRY — use ST_GeometryFromText")
         v = self.analyze(node.value)
-        if isinstance(v, Constant) and v.value is not None and node.type_name.lower() == "date":
-            y, m, d = map(int, str(v.value).split("-"))
-            return Constant(DATE, days_from_civil(y, m, d))
         ip_types = (IpAddressType, IpPrefixType)
         if isinstance(t, ip_types) or isinstance(v.type, ip_types):
             return self._ip_cast(v, t)
+        if (isinstance(v, Constant) and v.type.is_string
+                and not t.is_string and not isinstance(t, (ArrayType,
+                                                           MapType))):
+            # constant text → value folds at plan time (there is no
+            # dictionary to LUT over); unparseable folds to NULL, the
+            # engine's documented row-level-cast deviation
+            if v.value is None:
+                return Constant(t, None)
+            from presto_tpu.expr.compile import parse_string_to
+
+            return Constant(t, parse_string_to(t, str(v.value)))
         return Call(t, "cast", (v,))
 
     def _ip_cast(self, v: RowExpression, t: Type) -> RowExpression:
@@ -894,6 +903,13 @@ class ExprAnalyzer:
         if name in ("value_at_quantile", "values_at_quantiles",
                     "quantile_at_value", "trimmed_mean", "scale_tdigest"):
             return self._an_tdigest_fn(name, args)
+        if name == "empty_approx_set":
+            if args:
+                raise AnalysisError("empty_approx_set() takes no arguments")
+            from presto_tpu.expr.hll import empty as _hll_empty
+            from presto_tpu.types import HYPERLOGLOG
+
+            return Constant(HYPERLOGLOG, _hll_empty())
         if name in ("upper", "lower", "trim", "ltrim", "rtrim", "reverse",
                     "replace", "lpad", "rpad", "split_part",
                     "url_extract_host", "url_extract_path",
@@ -910,6 +926,84 @@ class ExprAnalyzer:
             return Call(VARCHAR, "concat", args)
         if name in ("length", "strpos", "position", "codepoint"):
             return Call(BIGINT, {"position": "strpos"}.get(name, name), args)
+        if name == "bit_length":
+            if len(args) != 1 or not args[0].type.is_string:
+                raise AnalysisError("bit_length expects a string argument")
+            vb = args[0].type.name == "varbinary"
+            return Call(BIGINT, "__vb_bit_length" if vb else "bit_length",
+                        args)
+        if name == "date_parse":
+            # date_parse(string, format) — MySQL format vocabulary
+            # (DateTimeFunctions.java); format must be a constant
+            if len(args) != 2:
+                raise AnalysisError("date_parse(string, format)")
+            if not (isinstance(args[1], Constant)
+                    and args[1].type.is_string and args[1].value is not None):
+                raise AnalysisError("date_parse format must be a constant string")
+            from presto_tpu.expr.compile import mysql_format_to_strptime
+
+            try:
+                mysql_format_to_strptime(str(args[1].value))
+            except ValueError as ex:
+                raise AnalysisError(f"date_parse: {ex}")
+            return Call(TIMESTAMP, "date_parse", args)
+        if name == "date_format":
+            # date_format(ts, fmt) → varchar: a HOST finishing projection
+            # (unbounded output domain — no dictionary to transform); the
+            # planner accepts it in the top-level SELECT list only
+            if len(args) != 2:
+                raise AnalysisError("date_format(timestamp, format)")
+            if args[0].type.name not in ("timestamp", "date"):
+                raise AnalysisError(
+                    f"date_format expects timestamp or date, got {args[0].type}")
+            if not (isinstance(args[1], Constant)
+                    and args[1].type.is_string and args[1].value is not None):
+                raise AnalysisError("date_format format must be a constant string")
+            from presto_tpu.expr.compile import mysql_format_to_strptime
+
+            try:
+                mysql_format_to_strptime(str(args[1].value))
+            except ValueError as ex:
+                raise AnalysisError(f"date_format: {ex}")
+            return Call(VARCHAR, "__host_date_format", args)
+        if name in ("from_iso8601_date", "from_iso8601_timestamp"):
+            if len(args) != 1 or not args[0].type.is_string:
+                raise AnalysisError(f"{name} expects a string argument")
+            out_t = DATE if name == "from_iso8601_date" else TIMESTAMP
+            return Call(out_t, name, args)
+        if name in ("split", "regexp_split"):
+            # split(s, delim[, limit]) / regexp_split(s, pattern) →
+            # array(varchar): per-dictionary-entry expansion applied as a
+            # 2D gather (StringFunctions.split / RegexpFunctions)
+            if not 2 <= len(args) <= (3 if name == "split" else 2):
+                raise AnalysisError(f"{name}: wrong argument count")
+            if not args[0].type.is_string:
+                raise AnalysisError(f"{name} expects a string argument")
+            if not (isinstance(args[1], Constant) and args[1].value not in
+                    (None, "")):
+                raise AnalysisError(
+                    f"{name}: delimiter must be a non-empty constant")
+            if len(args) == 3 and not (isinstance(args[2], Constant)
+                                       and is_integral(args[2].type)
+                                       and (args[2].value or 0) >= 1):
+                raise AnalysisError("split: limit must be a positive constant")
+            if isinstance(args[0], Constant):
+                # constant operand: fold to an array constructor (there is
+                # no dictionary to expand at runtime)
+                if args[0].value is None:
+                    return Constant(ArrayType(VARCHAR), None)
+                s = str(args[0].value)
+                if name == "split":
+                    lim = (int(args[2].value) - 1 if len(args) == 3 else -1)
+                    pieces = s.split(str(args[1].value), lim)
+                else:
+                    from presto_tpu.expr.compile import regexp_split_pieces
+
+                    pieces = regexp_split_pieces(str(args[1].value))(s)
+                return self._an_structural_fn(
+                    "array_ctor",
+                    tuple(Constant(VARCHAR, p) for p in pieces))
+            return Call(ArrayType(VARCHAR), name, args)
         if name in ("regexp_like", "starts_with", "ends_with", "contains"):
             return Call(BOOLEAN, name, args)
         # math
@@ -1196,6 +1290,10 @@ class ExprAnalyzer:
                 return Call(t0.value, "element_at", args)
             raise AnalysisError(f"element_at requires ARRAY or MAP, got {t0}")
         if name == "cardinality":
+            if t0.name == "hyperloglog":
+                # HyperLogLogFunctions.cardinality: the sketch estimate,
+                # evaluated once per distinct sketch entry
+                return Call(BIGINT, "__hll_cardinality", args)
             if not isinstance(t0, (ArrayType, MapType)):
                 raise AnalysisError(f"cardinality requires ARRAY or MAP, got {t0}")
             return Call(BIGINT, "cardinality", args)
@@ -1203,6 +1301,17 @@ class ExprAnalyzer:
             return Call(BOOLEAN, "contains", args)
         if name == "array_position":
             return Call(BIGINT, "array_position", args)
+        if name == "array_remove":
+            if not isinstance(t0, ArrayType):
+                raise AnalysisError(f"array_remove requires ARRAY, got {t0}")
+            if len(args) != 2:
+                raise AnalysisError("array_remove(array, element)")
+            et, xt = t0.element, args[1].type
+            if not ((is_numeric(et) and is_numeric(xt))
+                    or (et.is_string and xt.is_string) or et == xt):
+                raise AnalysisError(
+                    f"array_remove: cannot match {xt} against array({et})")
+            return Call(t0, "array_remove", args)
         if name in ("array_min", "array_max"):
             if not isinstance(t0, ArrayType):
                 raise AnalysisError(f"{name} requires ARRAY, got {t0}")
@@ -1745,12 +1854,41 @@ class Planner:
         display_names: List[str] = []
         select_symbols: List[str] = []
         alias_map: Dict[str, Tuple[str, Type]] = {}
+        host_items: List[tuple] = []  # HostProject finishing items
+        host_syms: set = set()
+        # (symbol, type) per SELECT item, aligned with select_items — the
+        # ORDER BY resolver must not zip proj_exprs (host items don't
+        # always add a projection)
+        select_sym_types: List[Tuple[str, Type]] = []
         for it, e in zip(select_items, select_exprs):
             name = it.alias or _derive_name(it.expr)
             if e.type is GEOMETRY:
                 raise AnalysisError(
                     "GEOMETRY values cannot be output directly — wrap the "
                     "expression in ST_AsText(...)")
+            hs = _host_split(e)
+            if hs is not None:
+                # string-producing host function (cast-to-varchar /
+                # date_format): its DEVICE input rides the projection; the
+                # formatting happens in a HostProject above the root
+                inner, kind, param = hs
+                if isinstance(inner, InputRef):
+                    in_sym = inner.name
+                else:
+                    in_sym = self.symbols.fresh("hostin")
+                if not any(s == in_sym for s, _ in proj_exprs):
+                    proj_exprs.append((in_sym, inner))
+                sym = self.symbols.fresh(it.alias or name)
+                host_items.append((sym, kind, in_sym, param))
+                host_syms.add(sym)
+                display_names.append(name)
+                select_symbols.append(sym)
+                select_sym_types.append((sym, VARCHAR))
+                if it.alias:
+                    # ORDER BY <alias> must bind here (and then fail the
+                    # host-sym check), not to a same-named table column
+                    alias_map[f"id:{it.alias}"] = (sym, VARCHAR)
+                continue
             if isinstance(e, InputRef) and it.alias is None:
                 sym = e.name
             else:
@@ -1758,6 +1896,7 @@ class Planner:
             proj_exprs.append((sym, e))
             display_names.append(name)
             select_symbols.append(sym)
+            select_sym_types.append((sym, e.type))
             if it.alias:
                 alias_map[f"id:{it.alias}"] = (sym, e.type)
 
@@ -1768,8 +1907,9 @@ class Planner:
             repl = dict(getattr(analyzer, "replacements", {}))
             repl.update(alias_map)
             # select expressions themselves are available as symbols
-            for (sym, e), it in zip(proj_exprs, select_items):
-                repl.setdefault(ast_key(it.expr), (sym, e.type))
+            # (aligned per select item — proj_exprs may not be)
+            for (sym, ty), it in zip(select_sym_types, select_items):
+                repl.setdefault(ast_key(it.expr), (sym, ty))
             order_an = ExprAnalyzer(scope, self, replacements=repl)
             for oi in q.order_by:
                 if isinstance(oi.expr, ast.Literal) and oi.expr.kind == "integer":
@@ -1779,18 +1919,33 @@ class Planner:
                             f"ORDER BY position {pos} out of range "
                             f"(1..{len(select_symbols)})")
                     sym = select_symbols[pos - 1]
+                    if sym in host_syms:
+                        raise AnalysisError(
+                            "ORDER BY on a host-computed expression "
+                            "(cast to varchar / date_format) is not "
+                            "supported — order by the underlying value")
                 else:
                     e = order_an.analyze(
                         _rewrite_aggs_to_keys(oi.expr) if (has_group or has_aggs) else oi.expr
                     )
                     if isinstance(e, InputRef):
                         sym = e.name
+                        if sym in host_syms:
+                            raise AnalysisError(
+                                "ORDER BY on a host-computed expression "
+                                "(cast to varchar / date_format) is not "
+                                "supported — order by the underlying value")
                         # ORDER BY a non-selected column: the sort key must
                         # ride through the projection (Output drops it)
                         if not any(s == sym for s, _ in proj_exprs) and not any(
                                 s == sym for s, _ in extra_order_exprs):
                             extra_order_exprs.append((sym, e))
                     else:
+                        if _host_split(e) is not None:
+                            raise AnalysisError(
+                                "ORDER BY on a host-computed expression "
+                                "(cast to varchar / date_format) is not "
+                                "supported — order by the underlying value")
                         sym = self.symbols.fresh("orderkey")
                         extra_order_exprs.append((sym, e))
                 sort_items.append(SortItem(sym, oi.ascending, oi.nulls_first))
@@ -1798,12 +1953,21 @@ class Planner:
         node = Project(node, proj_exprs + extra_order_exprs)
 
         if q.distinct:
+            if host_items:
+                raise AnalysisError(
+                    "SELECT DISTINCT over host-computed expressions "
+                    "(cast to varchar / date_format) is not supported")
             node = Aggregate(node, [s for s, _ in proj_exprs], [], step="single")
 
         if sort_items:
             node = Sort(node, sort_items, limit=q.limit)
         elif q.limit is not None:
             node = Limit(node, q.limit)
+
+        if host_items:
+            from presto_tpu.plan.nodes import HostProject
+
+            node = HostProject(node, host_items)
 
         root = Output(node, display_names, select_symbols)
         return QueryPlan(root, dict(self.scalar_subqueries),
@@ -2384,11 +2548,13 @@ class Planner:
                             raise AnalysisError("compression must be >= 10")
                 elif fn == "merge":
                     if len(fc.args) != 1:
-                        raise AnalysisError("merge(tdigest) takes one argument")
+                        raise AnalysisError("merge(sketch) takes one argument")
                     ae = analyzer.analyze(fc.args[0])
-                    if ae.type.name != "tdigest(double)":
+                    if ae.type.name not in ("tdigest(double)",
+                                            "hyperloglog"):
                         raise AnalysisError(
-                            f"merge expects tdigest, got {ae.type}")
+                            f"merge expects tdigest or hyperloglog, "
+                            f"got {ae.type}")
                 else:
                     ae = analyzer.analyze(fc.args[0])
                 if isinstance(ae, InputRef):
@@ -2435,8 +2601,14 @@ class Planner:
                 out_t = MapType(arg_t, arg2_t)
             elif fn == "numeric_histogram":
                 out_t = MapType(DOUBLE, DOUBLE)
-            elif fn in ("tdigest_agg", "merge"):
+            elif fn == "tdigest_agg":
                 out_t = TDIGEST
+            elif fn == "approx_set":
+                from presto_tpu.types import HYPERLOGLOG
+
+                out_t = HYPERLOGLOG
+            elif fn == "merge":
+                out_t = arg_t  # tdigest or hyperloglog, checked above
             else:
                 out_t = _agg_output_type(fn, arg_t, fc.is_star)
             sym = self.symbols.fresh(fn)
@@ -2691,6 +2863,22 @@ class Planner:
         outer_types = dict(outer.output)
         return Project(outer, [(s, InputRef(outer_types[s], s))
                                for s in group_syms] + [(a.symbol, est)])
+
+
+def _host_split(e: RowExpression):
+    """Top-level host-only call → (device_input_expr, kind, param), else
+    None. These produce strings over unbounded value domains, so they
+    cannot be dictionary transforms; the planner runs them in a
+    HostProject at the query root (plan/nodes.HostProject)."""
+    if not isinstance(e, Call):
+        return None
+    if (e.fn == "cast" and e.type is VARCHAR and e.args
+            and not e.args[0].type.is_string
+            and not isinstance(e.args[0].type, (ArrayType, MapType))):
+        return e.args[0], "varchar_cast", None
+    if e.fn == "__host_date_format":
+        return e.args[0], "date_format", str(e.args[1].value)
+    return None
 
 
 class _PendingCross(PlanNode):

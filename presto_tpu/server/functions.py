@@ -20,8 +20,9 @@ _SCALAR = {
                "rtrim", "reverse", "replace", "lpad", "rpad", "split_part",
                "concat", "length", "strpos", "position", "codepoint",
                "starts_with", "ends_with", "contains", "levenshtein_distance",
-               "hamming_distance"],
+               "hamming_distance", "split", "bit_length"],
     "regexp/json": ["regexp_like", "regexp_extract", "regexp_replace",
+                    "regexp_split",
                     "json_extract_scalar", "json_extract", "json_array_get",
                     "json_array_length", "json_size", "json_format",
                     "json_parse", "json_array_contains", "is_json_scalar"],
@@ -35,9 +36,11 @@ _SCALAR = {
            "is_subnet_of"],
     "tdigest": ["value_at_quantile", "values_at_quantiles",
                 "quantile_at_value", "trimmed_mean", "scale_tdigest"],
+    "hyperloglog": ["cardinality", "empty_approx_set"],
     "date": ["year", "month", "day", "quarter", "day_of_week", "dow",
              "day_of_year", "doy", "date_trunc", "date_diff", "date_add",
-             "from_unixtime", "to_unixtime"],
+             "from_unixtime", "to_unixtime", "date_parse",
+             "from_iso8601_date", "from_iso8601_timestamp"],
     "conditional": ["coalesce", "nullif", "if", "grouping"],
     "bitwise": ["bitwise_and", "bitwise_or", "bitwise_xor", "bitwise_not",
                 "bitwise_left_shift", "bitwise_right_shift"],
@@ -45,7 +48,7 @@ _SCALAR = {
               "array_min", "array_max", "array_sum", "array_average",
               "array_distinct", "array_sort", "slice", "sequence",
               "repeat", "concat", "array_union", "array_intersect",
-              "array_except", "arrays_overlap"],
+              "array_except", "arrays_overlap", "array_remove"],
     "map": ["map", "map_keys", "map_values", "element_at", "cardinality",
             "map_concat"],
     "lambda": ["transform", "filter", "reduce", "any_match", "all_match",
@@ -63,7 +66,7 @@ _AGGREGATE = ["count", "sum", "avg", "min", "max", "stddev", "stddev_pop",
               "every", "arbitrary", "any_value", "checksum", "count_if",
               "approx_distinct", "approx_percentile", "max_by", "min_by",
               "array_agg", "map_agg", "numeric_histogram", "tdigest_agg",
-              "merge"]
+              "merge", "approx_set"]
 
 _WINDOW = ["row_number", "rank", "dense_rank", "percent_rank", "cume_dist",
            "ntile", "lag", "lead", "first_value", "last_value", "nth_value"]

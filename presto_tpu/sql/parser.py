@@ -828,20 +828,7 @@ class Parser:
                 return self.parse_case()
             if kw == "cast":
                 self.next()
-                self.expect_op("(")
-                e = self.parse_expr()
-                self.expect_kw("as")
-                # type name: ident or keyword ('date'), optional (p[,s])
-                tt = self.next()
-                type_name = tt.value
-                if self.accept_op("("):
-                    args = [self.next().value]
-                    while self.accept_op(","):
-                        args.append(self.next().value)
-                    self.expect_op(")")
-                    type_name += "(" + ",".join(args) + ")"
-                self.expect_op(")")
-                return ast.Cast(e, type_name)
+                return self._parse_cast_body()
             if kw == "extract":
                 self.next()
                 self.expect_op("(")
@@ -893,6 +880,13 @@ class Parser:
         if t.kind in ("ident", "keyword"):
             was_quoted = t.quoted
             name = self.ident()
+            if (name == "try_cast" and not was_quoted
+                    and self.peek().kind == "op"
+                    and self.peek().value == "("):
+                # TRY_CAST(x AS t) ≡ CAST: device casts already yield
+                # NULL on unparseable input (the engine's documented
+                # row-level-error deviation), which IS try semantics
+                return self._parse_cast_body()
             if (name == "timestamp" and not was_quoted
                     and self.peek().kind == "string"):
                 # TIMESTAMP 'yyyy-mm-dd[ hh:mm:ss[.ffffff]]'
@@ -1019,6 +1013,23 @@ class Parser:
             return f"p{n}"
         self.expect_kw("following")
         return f"f{n}"
+
+    def _parse_cast_body(self) -> ast.Node:
+        """`( expr AS typename )` — shared by CAST and TRY_CAST."""
+        self.expect_op("(")
+        e = self.parse_expr()
+        self.expect_kw("as")
+        # type name: ident or keyword ('date'), optional (p[,s])
+        tt = self.next()
+        type_name = tt.value
+        if self.accept_op("("):
+            args = [self.next().value]
+            while self.accept_op(","):
+                args.append(self.next().value)
+            self.expect_op(")")
+            type_name += "(" + ",".join(args) + ")"
+        self.expect_op(")")
+        return ast.Cast(e, type_name)
 
     def parse_case(self) -> ast.Node:
         self.expect_kw("case")

