@@ -198,12 +198,16 @@ def node_to_json(n: PlanNode) -> Dict[str, Any]:
                 "ordinality": n.ordinality_sym}
     if isinstance(n, OneRow):
         return {"k": "onerow"}
-    from presto_tpu.plan.nodes import TableWriter
+    from presto_tpu.plan.nodes import HostProject, TableWriter
 
     if isinstance(n, TableWriter):
         return {"k": "tablewriter", "child": node_to_json(n.child),
                 "catalog": n.catalog, "table": n.table,
                 "write_id": n.write_id}
+    if isinstance(n, HostProject):
+        return {"k": "hostproject", "child": node_to_json(n.child),
+                "items": [[sym, kind, in_sym, param]
+                          for sym, kind, in_sym, param in n.items]}
     raise CodecError(f"unencodable plan node {type(n).__name__}")
 
 
@@ -303,6 +307,13 @@ def node_from_json(d: Dict[str, Any]) -> PlanNode:
 
         return TableWriter(node_from_json(d["child"]), d["catalog"],
                            d["table"], d["write_id"])
+    if k == "hostproject":
+        from presto_tpu.plan.nodes import HostProject
+
+        return HostProject(
+            node_from_json(d["child"]),
+            [(sym, kind, in_sym, param)
+             for sym, kind, in_sym, param in d["items"]])
     raise CodecError(f"unknown plan node kind {k!r}")
 
 

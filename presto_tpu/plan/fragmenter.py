@@ -374,6 +374,17 @@ class _Fragmenter:
             return node, p
         if isinstance(node, OneRow):
             return node, SINGLE
+        from presto_tpu.plan.nodes import HostProject
+
+        if isinstance(node, HostProject):
+            # host finishing projection: runs where the rows materialize —
+            # the single root task
+            child, cpart = self.process(node.child)
+            if cpart == SINGLE:
+                node.child = child
+                return node, SINGLE
+            node.child = self.cut(child, cpart, OUT_GATHER)
+            return node, SINGLE
         raise NotImplementedError(f"fragmenter: {type(node).__name__}")
 
 

@@ -376,6 +376,33 @@ class Limit(PlanNode):
 
 
 @dataclasses.dataclass
+class HostProject(PlanNode):
+    """Host-side finishing projection at the query root: string-PRODUCING
+    functions over unbounded value domains (CAST(numeric AS varchar),
+    date_format) cannot be dictionary transforms — there is no input
+    dictionary to expand. They run on the host over the (gathered) final
+    rows instead, formatting per distinct value and re-encoding
+    (reference: these are ordinary scalars in the row-at-a-time JVM
+    engine; here they are the one projection class the device cannot
+    express, so it executes where the rows already materialize)."""
+
+    child: PlanNode
+    # (out_symbol, kind, in_symbol, param): kind ∈ {"varchar_cast",
+    # "date_format"}; param is the constant format for date_format
+    items: List[tuple]
+
+    @property
+    def output(self):
+        from presto_tpu.types import VARCHAR
+
+        return list(self.child.output) + [
+            (sym, VARCHAR) for sym, _, _, _ in self.items]
+
+    def children(self):
+        return [self.child]
+
+
+@dataclasses.dataclass
 class Output(PlanNode):
     child: PlanNode
     names: List[str]  # user-facing column names

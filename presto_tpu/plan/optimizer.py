@@ -279,6 +279,14 @@ def prune_columns(node: PlanNode, required: Set[str]) -> PlanNode:
     if isinstance(node, Limit):
         node.child = prune_columns(node.child, required)
         return node
+    from presto_tpu.plan.nodes import HostProject as _HP
+
+    if isinstance(node, _HP):
+        # host outputs resolve to their device inputs below this node
+        need = (required - {s for s, _, _, _ in node.items}) | {
+            in_s for _, _, in_s, _ in node.items}
+        node.child = prune_columns(node.child, need)
+        return node
     if isinstance(node, Unnest):
         node.replicate = [s for s in node.replicate if s in required]
         node.child = prune_columns(

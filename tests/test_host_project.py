@@ -1,0 +1,116 @@
+"""HostProject: cast-to-varchar and date_format as a host finishing
+projection at the query root (plan/nodes.HostProject).
+
+These produce strings over unbounded value domains — no dictionary to
+transform — so they run where rows materialize: the single root task.
+Reference: ordinary scalar casts / MySQL-format date_format in the
+row-at-a-time JVM engine.
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from presto_tpu.catalog.memory import MemoryConnector
+from presto_tpu.connector import Catalog
+from presto_tpu.exec import ExecConfig, LocalRunner
+from presto_tpu.plan.builder import AnalysisError
+from presto_tpu.types import BIGINT, BOOLEAN, DATE, DOUBLE, DecimalType
+
+
+@pytest.fixture(scope="module")
+def runner():
+    conn = MemoryConnector("mem")
+    conn.add_table("t", {
+        "k": [1, 2, 3, None],
+        "d": [18690, 18720, 18690, 18750],        # 2021-03-04, 04-03, ...
+        "amt": [1.5, -2.25, 100.0, 0.07],
+        "x": [0.5, -1.25, 3.0, 2.5],
+        "b": [True, False, True, False],
+    }, {"k": BIGINT, "d": DATE, "amt": DecimalType(10, 2), "x": DOUBLE,
+        "b": BOOLEAN})
+    cat = Catalog()
+    cat.register("mem", conn, default=True)
+    return LocalRunner(cat, ExecConfig(batch_rows=64))
+
+
+def test_cast_types_to_varchar(runner):
+    df = runner.run(
+        "SELECT CAST(k AS varchar) ks, CAST(d AS varchar) ds, "
+        "CAST(amt AS varchar) amts, CAST(x AS varchar) xs, "
+        "CAST(b AS varchar) bs FROM t")
+    assert df["ks"][0] == "1" and pd.isna(df["ks"][3])  # NULL stays NULL
+    assert df["ds"][0] == "2021-03-04"
+    assert df["amts"].tolist() == ["1.50", "-2.25", "100.00", "0.07"]
+    assert df["xs"][1] == "-1.25"
+    assert df["bs"].tolist() == ["true", "false", "true", "false"]
+
+
+def test_date_format(runner):
+    df = runner.run("SELECT date_format(d, '%Y/%m/%d') f FROM t")
+    assert df["f"][0] == "2021/03/04"
+    df2 = runner.run("SELECT date_format(d, '%d %M %Y') f FROM t")
+    assert df2["f"][0] == "04 March 2021"
+
+
+def test_over_aggregate(runner):
+    df = runner.run(
+        "SELECT date_format(d, '%Y-%m') ym, CAST(sum(amt) AS varchar) s "
+        "FROM t GROUP BY d ORDER BY d")
+    assert df["ym"].tolist() == ["2021-03", "2021-04", "2021-05"]
+    assert df["s"][0] == "101.50"
+
+
+def test_after_limit_and_order(runner):
+    df = runner.run(
+        "SELECT CAST(amt AS varchar) s FROM t ORDER BY amt DESC LIMIT 2")
+    assert df["s"].tolist() == ["100.00", "1.50"]
+
+
+def test_cast_timestamp_to_varchar(runner):
+    df = runner.run(
+        "SELECT CAST(TIMESTAMP '2021-03-04 05:06:07.25' AS varchar) v")
+    assert df["v"][0] == "2021-03-04 05:06:07.250"
+
+
+def test_errors(runner):
+    with pytest.raises(AnalysisError):
+        runner.run("SELECT DISTINCT CAST(k AS varchar) FROM t")
+    with pytest.raises(AnalysisError):
+        runner.run("SELECT CAST(k AS varchar) v FROM t ORDER BY 1")
+    with pytest.raises(Exception):
+        # host functions outside the top-level SELECT list
+        runner.run("SELECT 1 FROM t WHERE date_format(d, '%Y') = '2021'")
+
+
+def test_distributed_host_project():
+    from presto_tpu.server.coordinator import DistributedRunner
+
+    conn = MemoryConnector("mem")
+    rng = np.random.default_rng(31)
+    conn.add_table("t", pd.DataFrame({
+        "d": rng.integers(18000, 19000, 5000),
+        "v": rng.normal(0, 1, 5000)}))
+    cat = Catalog()
+    cat.register("mem", conn, default=True)
+    r = DistributedRunner(cat, n_workers=2, config=ExecConfig(batch_rows=512))
+    try:
+        df = r.run("SELECT CAST(count(*) AS varchar) c FROM t")
+        assert df["c"][0] == "5000"
+    finally:
+        r.close()
+
+
+def test_decimal_list_ingest_exact():
+    # regression: list ingest (object arrays) must scale floats exactly,
+    # not truncate through astype(int64)
+    conn = MemoryConnector("mem")
+    conn.add_table("t", {"amt": [1.5, -2.25]}, {"amt": DecimalType(10, 2)})
+    cat = Catalog()
+    cat.register("mem", conn, default=True)
+    r = LocalRunner(cat, ExecConfig(batch_rows=64))
+    import decimal
+
+    df = r.run("SELECT amt FROM t ORDER BY amt")
+    assert df["amt"].tolist() == [decimal.Decimal("-2.25"),
+                                  decimal.Decimal("1.50")]
