@@ -2265,8 +2265,10 @@ def _eval_cast(e: Call, ctx):
         return out, ok if valid is None else (valid & ok)
     if tt.is_string and not st.is_string:
         raise NotImplementedError(
-            "cast to varchar from non-string types is not supported "
-            "(values would need an unbounded output dictionary)")
+            "cast to varchar from non-string types is supported in the "
+            "top-level SELECT list only (it runs as a HostProject "
+            "finishing projection — no input dictionary exists to "
+            "transform on the device)")
     v, valid = _eval_arg(src, ctx)
     if st == tt:
         return v, valid

@@ -150,6 +150,10 @@ class SystemSessionProperties:
             PropertyMetadata("recoverable_grouped_execution",
                              "Re-run only lost lifespans of colocated joins",
                              bool, False),
+            # scheduler/NodeScheduler soft-affinity placement
+            PropertyMetadata("split_affinity",
+                             "Rendezvous-hash split→worker placement",
+                             bool, True),
         ]
 
     def names(self) -> List[str]:
@@ -249,4 +253,5 @@ class Session:
             execution_policy=self.get("execution_policy"),
             recoverable_grouped_execution=self.get(
                 "recoverable_grouped_execution"),
+            split_affinity=self.get("split_affinity"),
         )

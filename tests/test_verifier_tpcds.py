@@ -1,0 +1,34 @@
+"""Verifier sweep over the TPC-DS corpus: LocalRunner (control) vs a
+2-worker DistributedRunner (test), order-insensitive checksums.
+
+Extends the TPC-H sweep (tests/test_verifier.py) to the second
+benchmark family — every query of tests/test_tpcds_answers.Q replays on
+both engines (reference: presto-verifier's two-cluster replay over
+arbitrary corpora)."""
+
+import pytest
+
+from presto_tpu.catalog.tpcds import tpcds_catalog
+from presto_tpu.exec import ExecConfig, LocalRunner
+from presto_tpu.server.coordinator import DistributedRunner
+from presto_tpu.verifier import Verifier, report
+
+from tests.test_tpcds_answers import Q
+
+
+@pytest.fixture(scope="module")
+def engines():
+    cat = tpcds_catalog(0.005)
+    cfg = ExecConfig(batch_rows=1 << 13, agg_capacity=1 << 12)
+    control = LocalRunner(cat, cfg)
+    test = DistributedRunner(cat, n_workers=2, config=cfg)
+    yield control, test
+    test.close()
+
+
+def test_tpcds_corpus_matches(engines):
+    control, test = engines
+    v = Verifier(control, test)
+    outcomes = v.run_suite(list(Q.items()))
+    rep = report(outcomes)
+    assert all(o.ok for o in outcomes), rep
