@@ -65,6 +65,20 @@ def _relation_columns(rel, catalog: Catalog, ctes: Dict[str, ast.Query]) -> Set[
     return set()
 
 
+def _relation_names(rel) -> Set[str]:
+    """Relation aliases/names visible from a FROM tree — the qualifiers
+    an identifier may carry to resolve INSIDE the subquery."""
+    if rel is None:
+        return set()
+    if isinstance(rel, ast.Table):
+        return {rel.alias or rel.name[-1]}
+    if isinstance(rel, ast.SubqueryRelation):
+        return {rel.alias} if rel.alias else set()
+    if isinstance(rel, ast.Join):
+        return _relation_names(rel.left) | _relation_names(rel.right)
+    return set()
+
+
 def _split_conjuncts(e) -> List:
     if isinstance(e, ast.BinaryOp) and e.op == "and":
         return _split_conjuncts(e.left) + _split_conjuncts(e.right)
@@ -127,6 +141,17 @@ def _find_correlation(
     if sub.where is None:
         return None
     inner_cols = _relation_columns(sub.from_, catalog, ctes)
+    inner_rels = _relation_names(sub.from_)
+
+    def is_inner(ident: ast.Identifier) -> bool:
+        # unqualified: resolves against the subquery's columns;
+        # qualified: the qualifier must name a subquery relation —
+        # `t1.k` stays an OUTER ref even when the inner table also has
+        # a column `k`
+        if len(ident.parts) == 1:
+            return ident.parts[0] in inner_cols
+        return ident.parts[0] in inner_rels
+
     conjs = _split_conjuncts(sub.where)
     pairs: List[Tuple[ast.Identifier, ast.Identifier]] = []
     rest = []
@@ -137,8 +162,8 @@ def _find_correlation(
             and isinstance(c.left, ast.Identifier)
             and isinstance(c.right, ast.Identifier)
         ):
-            l_in = c.left.parts[-1] in inner_cols and len(c.left.parts) == 1
-            r_in = c.right.parts[-1] in inner_cols and len(c.right.parts) == 1
+            l_in = is_inner(c.left)
+            r_in = is_inner(c.right)
             if l_in and not r_in:
                 pairs.append((c.right, c.left))
                 continue
@@ -152,9 +177,8 @@ def _find_correlation(
     outer_refs = set()
 
     def scan(n):
-        if isinstance(n, ast.Identifier) and len(n.parts) == 1:
-            if n.parts[0] not in inner_cols:
-                outer_refs.add(n.parts[0])
+        if isinstance(n, ast.Identifier) and not is_inner(n):
+            outer_refs.add(".".join(n.parts))
         for ch in _children(n):
             scan(ch)
 
@@ -193,15 +217,39 @@ class Decorrelator:
         for c in conjs:
             expanded.extend(_factor_or(c))
         conjs = expanded
+        self._mode = "cross"
         out = []
         for c in conjs:
             out.append(self._rewrite_conjunct(c))
-        # graft derived tables as cross joins + WHERE equi-conjuncts so the
-        # planner's comma-join assembly orders them with everything else
-        for dt, cond in self._pending:
-            q.from_ = ast.Join("cross", q.from_, dt, None)
-            out.append(cond)
+        # graft derived tables: plain aggregates become cross joins +
+        # WHERE equi-conjuncts (the planner's comma-join assembly orders
+        # them with everything else); count-like ones must LEFT-join with
+        # the condition in ON (a WHERE conjunct would re-drop the
+        # null-extended row whose true count is 0)
+        for kind, dt, cond in self._pending:
+            if kind == "left":
+                q.from_ = ast.Join("left", q.from_, dt, cond)
+            else:
+                q.from_ = ast.Join("cross", q.from_, dt, None)
+                out.append(cond)
+        self._pending = []
         q.where = _combine(out)
+
+    def rewrite_select(self, q: ast.Query) -> None:
+        """Correlated scalar-aggregate subqueries in the SELECT list:
+        LEFT-JOIN the grouped derived table (a missing group must yield
+        NULL, not drop the outer row — the semantic difference from the
+        WHERE-position rewrite; reference:
+        TransformCorrelatedScalarAggregationToJoin)."""
+        if q.from_ is None:
+            return
+        self._mode = "left"
+        self._pending = []
+        for it in q.select:
+            it.expr = self._rewrite_scalar(it.expr)
+        for _, dt, cond in self._pending:
+            q.from_ = ast.Join("left", q.from_, dt, cond)
+        self._pending = []
 
     _pending: List
 
@@ -228,6 +276,15 @@ class Decorrelator:
                 or not _contains_agg(sub.select[0].expr)
             ):
                 return e
+            # count over an empty group is 0, not NULL: bare count()
+            # rewrites with a coalesce + LEFT join; count buried in an
+            # expression (count(*)+1) has no join-side compensation —
+            # leave it to fail loudly rather than answer wrongly
+            expr0 = sub.select[0].expr
+            is_count = (isinstance(expr0, ast.FunctionCall)
+                        and expr0.name.lower() in ("count", "count_if"))
+            if not is_count and _contains_count(expr0):
+                return e
             corr = _find_correlation(sub, self.catalog, self.ctes)
             if corr is None:
                 return e  # uncorrelated: handled as a Param at plan time
@@ -249,20 +306,59 @@ class Decorrelator:
                 ast.BinaryOp("eq", ast.Identifier((alias, f"__ck{i}")), outer)
                 for i, (outer, _) in enumerate(pairs)
             ])
-            self._pending.append((dt, cond))
-            return ast.Identifier((alias, "__agg"))
+            self._pending.append(
+                ("left" if is_count else self._mode, dt, cond))
+            ident = ast.Identifier((alias, "__agg"))
+            if is_count:
+                return ast.FunctionCall(
+                    "coalesce", [ident, ast.Literal(0, "integer", "0")])
+            return ident
         if isinstance(e, ast.BinaryOp):
             e.left = self._rewrite_scalar(e.left)
             e.right = self._rewrite_scalar(e.right)
         if isinstance(e, ast.UnaryOp):
             e.operand = self._rewrite_scalar(e.operand)
+        if isinstance(e, ast.FunctionCall):
+            e.args = [self._rewrite_scalar(a) for a in e.args]
+        if isinstance(e, ast.Cast):
+            e.value = self._rewrite_scalar(e.value)
+        if isinstance(e, ast.Case):
+            if e.operand is not None:
+                e.operand = self._rewrite_scalar(e.operand)
+            e.whens = [(self._rewrite_scalar(w), self._rewrite_scalar(t))
+                       for w, t in e.whens]
+            if e.default is not None:
+                e.default = self._rewrite_scalar(e.default)
+        if isinstance(e, ast.Between):
+            e.value = self._rewrite_scalar(e.value)
+            e.low = self._rewrite_scalar(e.low)
+            e.high = self._rewrite_scalar(e.high)
+        if isinstance(e, ast.IsNull):
+            e.value = self._rewrite_scalar(e.value)
+        if isinstance(e, ast.InList):
+            e.value = self._rewrite_scalar(e.value)
+            e.items = [self._rewrite_scalar(x) for x in e.items]
         return e
 
 
+def _contains_count(n) -> bool:
+    if isinstance(n, ast.FunctionCall) and n.name.lower() in ("count",
+                                                              "count_if"):
+        return True
+    return any(_contains_count(c) for c in _children(n))
+
+
 def decorrelate(q: ast.Query, catalog: Catalog, ctes: Dict[str, ast.Query]) -> ast.Query:
+    import copy
+
+    # the rewrites mutate expressions and FROM trees in place; a CTE body
+    # is re-planned per reference from the SAME stored AST, so rewrite a
+    # private deep copy (the reference rewrites immutable plan trees)
+    q = copy.deepcopy(q)
     d = Decorrelator(catalog, dict(ctes))
     for name, sub in q.ctes:
         d.ctes[name] = sub
     d._pending = []
     d.rewrite_where(q)
+    d.rewrite_select(q)
     return q
