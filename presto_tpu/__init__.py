@@ -32,9 +32,33 @@ jax.config.update("jax_enable_x64", True)
 # (reference analog: Presto's generated-class caches are per-JVM; XLA's
 # serialized executables survive restarts). Opt out / relocate via
 # PRESTO_TPU_COMPILE_CACHE ("" disables).
+#
+# The directory is keyed by a CPU-capability fingerprint: XLA:CPU AOT
+# executables bake in the COMPILING host's feature set, and loading one
+# on a host without those features SIGSEGVs/SIGILLs (observed: a cache
+# written on an amx-avx512 box crashed the whole test suite after the
+# machine changed between rounds).
+
+
+def _machine_tag() -> str:
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith("flags"):
+                    import hashlib
+
+                    return hashlib.sha1(line.encode()).hexdigest()[:10]
+    except OSError:
+        pass
+    import platform
+
+    return platform.machine()
+
+
 _cache_dir = _os.environ.get(
     "PRESTO_TPU_COMPILE_CACHE",
-    _os.path.join(_os.path.expanduser("~"), ".cache", "presto_tpu_xla"),
+    _os.path.join(_os.path.expanduser("~"), ".cache",
+                  f"presto_tpu_xla_{_machine_tag()}"),
 )
 if _cache_dir:
     jax.config.update("jax_compilation_cache_dir", _cache_dir)
