@@ -65,6 +65,66 @@ if _cache_dir:
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
 
+    # XLA:CPU executable (de)serialization SEGFAULTS on this host/jaxlib
+    # (reproduced three times: twice in put_executable_and_time — once
+    # even under a process-wide lock, ruling out a pure thread race —
+    # and once in the deserialize path; always on the big multi-operand
+    # sort programs the engine compiles). The persistent cache therefore
+    # BYPASSES the cpu backend: callers see a plain miss and compile
+    # in-process (the per-process jit caches still dedupe), while TPU —
+    # where 10-50 s compiles make the cache worth having — keeps it,
+    # serialized through one lock. Best-effort: silently skipped if
+    # jax's internals move.
+    try:
+        import threading as _threading
+
+        from jax._src import compilation_cache as _cc
+
+        _cc_lock = _threading.Lock()
+        _orig_cc_get = _cc.get_executable_and_time
+        _orig_cc_put = _cc.put_executable_and_time
+
+        def _cc_platform(a, k):
+            for x in list(a) + list(k.values()):
+                p = getattr(x, "platform", None)
+                if isinstance(p, str):
+                    return p
+            return None
+
+        def _guarded_cc_get(*a, **k):
+            if _cc_platform(a, k) == "cpu":
+                return None, None  # plain miss: compile in-process
+            with _cc_lock:
+                return _orig_cc_get(*a, **k)
+
+        def _guarded_cc_put(*a, **k):
+            if _cc_platform(a, k) == "cpu":
+                return None
+            with _cc_lock:
+                return _orig_cc_put(*a, **k)
+
+        _cc.get_executable_and_time = _guarded_cc_get
+        _cc.put_executable_and_time = _guarded_cc_put
+
+        # CONCURRENT XLA:CPU compiles from multiple threads also
+        # segfault on this host (reproduced in backend_compile_and_load
+        # once the cache paths were bypassed; the same programs compile
+        # fine serially — e.g. every warm-cache suite run). Serialize
+        # compilation through the same lock: concurrent compiles only
+        # ever happen in the in-process multi-worker cluster, where the
+        # per-process jit caches already dedupe most of them.
+        from jax._src import compiler as _compiler
+
+        _orig_bcl = _compiler.backend_compile_and_load
+
+        def _locked_bcl(*a, **k):
+            with _cc_lock:
+                return _orig_bcl(*a, **k)
+
+        _compiler.backend_compile_and_load = _locked_bcl
+    except Exception:  # pragma: no cover
+        pass
+
 __version__ = "0.1.0"
 
 from presto_tpu.types import (  # noqa: E402
