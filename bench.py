@@ -7,8 +7,12 @@ Covers the five BASELINE.json configs:
   q1_sf1    TPC-H Q1  SF1   — hash aggregation over lineitem
   q6_sf10   TPC-H Q6  SF10  — scan-filter-aggregate
   q3_sf10   TPC-H Q3  SF10  — 3-way join
-  q9_sf100  TPC-H Q9  SF100 — multi-join + partitioned aggregation
-  q64_sf100 TPC-DS Q64 SF100 — wide star-join (tpcds connector)
+  q9        TPC-H Q9  — multi-join + partitioned aggregation
+            (scale from BENCH_SF_Q9, default 100; may budget-downscale)
+  q64       TPC-DS Q64 — wide star-join (tpcds connector; BENCH_SF_Q64)
+
+Result keys record the sf that ACTUALLY ran (e.g. q9_sf10) and every
+record carries "sf_actual" — no config key may claim a scale it didn't run.
 
 Crash-safety architecture (round-4 redesign): the parent process NEVER
 imports jax — each config runs in a subprocess with its own wall-clock
@@ -150,19 +154,26 @@ _REF = {
     "q6": _SF1_ROWS / 0.54,
 }
 
-# name -> (sql, dataset kind, nominal sf, driving table, exec overrides)
+# name -> (sql, dataset kind, nominal sf, driving table, exec overrides).
+# q9/q64 carry NO sf in their key: their scale comes from BENCH_SF_Q9/Q64
+# with budget-driven downscaling, and a key like "q9_sf100" that silently
+# ran SF10 poisoned cross-round comparisons. Every result record carries
+# "sf_actual" — the scale that really ran.
 _CONFIGS = {
     "q1_sf1": (Q1, "tpch", 1.0, "lineitem", {}),
     "q6_sf10": (Q6, "tpch", 10.0, "lineitem", {}),
     "q3_sf10": (Q3, "tpch", 10.0, "lineitem", {}),
-    "q9_sf100": (Q9, "tpch", None, "lineitem", {"runs": 2}),
-    "q64_sf100": (Q64, "tpcds", None, "store_sales",
-                  {"agg_capacity": 1 << 16, "runs": 2}),
+    "q9": (Q9, "tpch", None, "lineitem", {"runs": 2}),
+    "q64": (Q64, "tpcds", None, "store_sales",
+            {"agg_capacity": 1 << 16, "runs": 2}),
 }
+
+# legacy config names (pre-rename BENCH_CONFIGS env values keep working)
+_ALIASES = {"q9_sf100": "q9", "q64_sf100": "q64"}
 
 # Per-config wall caps (seconds): one slow compile can only burn this much.
 _CAPS = {"q1_sf1": 420, "q6_sf10": 420, "q3_sf10": 600,
-         "q9_sf100": 900, "q64_sf100": 900}
+         "q9": 900, "q64": 900}
 
 
 def _dataset_ready(kind: str, sf: float) -> bool:
@@ -243,7 +254,7 @@ def _child(name: str, sf: float, cap_s: float = 0.0):
     _log(f"{name}: best {best:.3f}s of {sorted(round(t, 3) for t in times)} "
          f"({nrows} {driving_table} rows)")
     print(json.dumps({
-        "seconds": round(best, 4), "rows": nrows, "sf": sf,
+        "seconds": round(best, 4), "rows": nrows, "sf": sf, "sf_actual": sf,
         "rows_per_sec": round(nrows / best, 1), "warmup_s": warm_s,
     }), flush=True)
 
@@ -338,15 +349,16 @@ def main():
              "falling back to CPU; numbers are NOT tpu numbers")
         extra["device"] = "cpu-fallback (tpu tunnel unresponsive)"
 
-    sf_over = {"q9_sf100": float(os.environ.get("BENCH_SF_Q9", "100")),
-               "q64_sf100": float(os.environ.get("BENCH_SF_Q64", "100"))}
+    sf_over = {"q9": float(os.environ.get("BENCH_SF_Q9", "100")),
+               "q64": float(os.environ.get("BENCH_SF_Q64", "100"))}
     wanted = os.environ.get(
-        "BENCH_CONFIGS", "q1_sf1,q6_sf10,q3_sf10,q9_sf100,q64_sf100"
+        "BENCH_CONFIGS", "q1_sf1,q6_sf10,q3_sf10,q9,q64"
     ).split(",")
 
     for name in (w.strip() for w in wanted):
         if not name:
             continue
+        name = _ALIASES.get(name, name)
         if name not in _CONFIGS:
             _log(f"{name}: UNKNOWN config (valid: {','.join(_CONFIGS)})")
             extra[name] = {"error": "unknown config"}
@@ -390,17 +402,20 @@ def main():
                 raise
             lines = out.decode().strip().splitlines()
             if p.returncode == 0 and lines:
-                extra[label] = json.loads(lines[-1])
+                rec = json.loads(lines[-1])
+                rec.setdefault("sf_actual", sf)
+                extra[label] = rec
             else:
                 extra[label] = {"error": f"child rc={p.returncode}",
-                               "sf": sf}
+                               "sf": sf, "sf_actual": sf}
         except subprocess.TimeoutExpired:
             _log(f"{name}: TIMEOUT after {cap:.0f}s cap — moving on")
             extra[label] = {"error": f"timeout after {cap:.0f}s cap",
-                           "sf": sf}
+                           "sf": sf, "sf_actual": sf}
         except Exception as e:
             _log(f"{name}: FAILED {type(e).__name__}: {e}")
-            extra[label] = {"error": f"{type(e).__name__}: {e}"}
+            extra[label] = {"error": f"{type(e).__name__}: {e}",
+                           "sf": sf, "sf_actual": sf}
         finally:
             _STATE["child"] = None
         _checkpoint()

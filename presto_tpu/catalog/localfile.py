@@ -75,6 +75,49 @@ class LocalFileConnector(DeviceSplitCache, Connector):
     def splits(self, handle: TableHandle, desired: int = 1) -> List[Split]:
         return [Split(handle.name, i, desired) for i in range(desired)]
 
+    def split_stats(self, handle: TableHandle, split: Split):
+        """Storage-domain min/max over this split's row range (splits are
+        contiguous slices of the parsed file) — constrained scans over
+        sorted CSV/JSONL data skip whole slices via the generic
+        prune_splits, the same elimination the file formats get from
+        footer/sidecar stats."""
+        import datetime
+
+        from presto_tpu.scan.pruning import SplitStats
+
+        t = self._load(split.table)
+        n = next((len(a) for a in t.arrays.values()), 0)
+        lo = n * split.part // split.total
+        hi = n * (split.part + 1) // split.total
+        cols = {}
+        for name, arr in t.arrays.items():
+            if name in t.struct or t.hi.get(name) is not None:
+                continue
+            ty = t.types[name]
+            sl = arr[lo:hi]
+            valid = t.validity.get(name)
+            nulls = int((~valid[lo:hi]).sum()) if valid is not None else 0
+            if valid is not None:
+                sl = sl[valid[lo:hi]]
+            if ty.is_string:
+                sl = sl[sl >= 0]  # -1 codes are NULLs
+            if not len(sl):
+                cols[name] = (None, None, nulls)
+                continue
+            mn, mx = sl.min(), sl.max()
+            if ty.is_string:
+                d = t.dicts.get(name)
+                if d is None:
+                    continue
+                mn, mx = str(d.values[mn]), str(d.values[mx])
+            elif ty.name == "date":
+                mn = datetime.date.fromordinal(719163 + int(mn))
+                mx = datetime.date.fromordinal(719163 + int(mx))
+            else:
+                mn, mx = mn.item(), mx.item()
+            cols[name] = (mn, mx, nulls)
+        return SplitStats(max(hi - lo, 0), cols)
+
     def _read_split_uncached(self, split: Split, columns: Sequence[str],
                              capacity: Optional[int] = None) -> Batch:
         from presto_tpu.catalog.memory import MemoryConnector

@@ -9,9 +9,17 @@ the scan program at trace time — see exec/runtime.collapse_chain). Stripes
 map to splits exactly as row groups do for parquet; string columns decode
 straight into the table-global dictionary (codes only on device).
 
-pyarrow exposes no per-stripe column statistics, so ORC scans prune by
-engine constraints only after decode (no split elimination — the parquet
-connector remains the stats-pruning storage layout).
+pyarrow exposes no per-stripe column statistics, so the writer persists a
+sidecar stats file next to each table at CTAS/export time:
+`<table>.orc.stats.json` = {"version", "file_size", "num_rows",
+"stripes": [{"num_rows", "columns": {col: {"min", "max", "null_count",
+"kind"?}}}]} (dates ride ISO strings with a "kind": "date" tag; see
+scan/pruning.py). `split_stats` serves those per-stripe bounds to the
+generic `prune_splits`, so constrained scans eliminate stripes without
+opening them — the stripe-skipping half of the Aria selective reader —
+and `read_split_selective` runs the value-filter cascade during decode.
+A stale or missing sidecar (file_size mismatch after an out-of-band
+rewrite) degrades to unpruned scans, never to wrong results.
 """
 
 from __future__ import annotations
@@ -81,6 +89,8 @@ class OrcConnector(DeviceSplitCache, Connector):
         self._host_cache: "OrderedDict[tuple, tuple]" = OrderedDict()
         self._host_cache_used = 0
         self._host_cache_lock = threading.Lock()
+        # (path, version) -> per-stripe SplitStats list | None
+        self._sidecar_cache: Dict[tuple, object] = {}
 
     def table_names(self) -> List[str]:
         return sorted(
@@ -192,6 +202,7 @@ class OrcConnector(DeviceSplitCache, Connector):
         tbl = _undictionarize(pa.Table.from_arrays(arrays, schema=schema))
         po.write_table(tbl, path + ".tmp")
         os.replace(path + ".tmp", path)
+        _write_sidecar(path)
         self._invalidate_table(name)
         return int(tbl.num_rows)
 
@@ -202,6 +213,10 @@ class OrcConnector(DeviceSplitCache, Connector):
                 return
             raise KeyError(f"table not found: {name}")
         os.remove(path)
+        from presto_tpu.scan.pruning import sidecar_path
+
+        if os.path.exists(sidecar_path(path)):
+            os.remove(sidecar_path(path))
         self._invalidate_table(name)
 
     # -- read path --------------------------------------------------------
@@ -210,6 +225,49 @@ class OrcConnector(DeviceSplitCache, Connector):
                    capacity: Optional[int] = None) -> Batch:
         self._check_fresh(split.table)
         return super().read_split(split, columns, capacity)
+
+    def _stripe_stats(self, t: _OrcTable):
+        """Sidecar-backed per-stripe SplitStats list (None = no usable
+        sidecar), cached per (path, file version)."""
+        from presto_tpu.scan.pruning import load_orc_sidecar
+
+        key = (t.path, t.version)
+        if key not in self._sidecar_cache:
+            while len(self._sidecar_cache) > 64:
+                self._sidecar_cache.pop(next(iter(self._sidecar_cache)))
+            self._sidecar_cache[key] = load_orc_sidecar(t.path)
+        return self._sidecar_cache[key]
+
+    def split_stats(self, handle: TableHandle, split: Split):
+        t = self._load(handle.name)
+        stats = self._stripe_stats(t)
+        if not stats:
+            return None
+        stripe = split.part[0] if isinstance(split.part, tuple) else split.part
+        if stripe >= len(stats):
+            return None
+        # sub-splits of one stripe share its bounds (a superset — still a
+        # correct pruning witness)
+        return stats[stripe]
+
+    def read_split_selective(self, split: Split, columns: Sequence[str],
+                             filters, capacity: Optional[int] = None,
+                             adaptive=None, counters=None) -> Batch:
+        """Predicate-during-decode over one stripe (see
+        scan/selective.py); bypasses the device split cache like the
+        parquet selective path."""
+        from presto_tpu.scan.selective import selective_read
+
+        self._check_fresh(split.table)
+        t = self._load(split.table)
+        stripe, sub, sub_count = split.part
+
+        def _decode(cols):
+            return self._decoded_columns(t, stripe, sub, sub_count, cols)
+
+        return selective_read(_decode, t.handle, columns, filters,
+                              capacity=capacity, dicts=t.dicts,
+                              adaptive=adaptive, counters=counters)
 
     def _decoded_columns(self, t: _OrcTable, stripe: int, sub: int,
                          sub_count: int, columns: Sequence[str]):
@@ -289,13 +347,31 @@ class OrcConnector(DeviceSplitCache, Connector):
         )
 
 
+def _write_sidecar(path: str) -> None:
+    """Best-effort stripe-stats sidecar: a stats failure must never fail
+    the write itself (the scan degrades to unpruned, not to an error)."""
+    from presto_tpu.scan.pruning import write_orc_sidecar
+
+    try:
+        write_orc_sidecar(path)
+    except Exception:
+        pass
+
+
 def export_table_to_orc(directory: str, name: str, data, types,
-                        dicts=None) -> str:
+                        dicts=None, stripe_size: Optional[int] = None,
+                        validity=None) -> str:
     """Materialize host columns as <directory>/<name>.orc (test fixture
-    helper, the dbgen→ORC-warehouse path)."""
+    helper, the dbgen→ORC-warehouse path). `stripe_size` (bytes) forces
+    small multi-stripe files so split-elimination paths are testable at
+    fixture scale; `validity` maps column → bool mask (False = NULL)."""
     os.makedirs(directory, exist_ok=True)
-    arrays, schema = _to_arrow_columns(data, types, dicts or {})
+    arrays, schema = _to_arrow_columns(data, types, dicts or {}, validity)
     path = os.path.join(directory, f"{name}.orc")
     tbl = _undictionarize(pa.Table.from_arrays(arrays, schema=schema))
-    po.write_table(tbl, path)
+    if stripe_size:
+        po.write_table(tbl, path, stripe_size=stripe_size)
+    else:
+        po.write_table(tbl, path)
+    _write_sidecar(path)
     return path

@@ -99,10 +99,11 @@ def _sql_to_arrow(t: Type):
 
 def write_table(path: str, data: Dict[str, np.ndarray], types: Dict[str, Type],
                 dicts: Optional[Dict[str, Dictionary]] = None,
-                row_group_rows: int = 1 << 20):
+                row_group_rows: int = 1 << 20,
+                validity: Optional[Dict[str, np.ndarray]] = None):
     """Write engine-native columns (dict codes, unscaled decimals, day ints)
-    to a parquet file."""
-    arrays, schema = _to_arrow_columns(data, types, dicts or {})
+    to a parquet file. `validity` maps column → bool mask (False = NULL)."""
+    arrays, schema = _to_arrow_columns(data, types, dicts or {}, validity)
     table = pa.Table.from_arrays(arrays, schema=schema)
     pq.write_table(table, path, row_group_size=row_group_rows,
                    use_dictionary=True, compression="zstd")
@@ -931,15 +932,68 @@ class ParquetConnector(DeviceSplitCache, Connector):
                 st = rg.column(name_to_idx[col]).statistics
                 if st is None or not st.has_min_max:
                     continue
-                if lo is not None and st.max is not None and st.max < lo:
-                    ok = False
-                    break
-                if hi is not None and st.min is not None and st.min > hi:
-                    ok = False
-                    break
+                try:
+                    if lo is not None and st.max is not None and st.max < lo:
+                        ok = False
+                        break
+                    if hi is not None and st.min is not None and st.min > hi:
+                        ok = False
+                        break
+                except TypeError:
+                    # constraint/statistic domain mismatch (e.g. a string
+                    # bound against numeric stats) — keep the split
+                    continue
             if ok:
                 keep.append(s)
         return keep
+
+    def split_stats(self, handle: TableHandle, split: Split):
+        """Row-group statistics as a storage-domain SplitStats (the
+        generic face of the footer stats `prune_splits` reads natively —
+        used by tests and cross-connector tooling)."""
+        from presto_tpu.scan.pruning import SplitStats
+
+        t = self._load(handle.name)
+        rg_idx = split.part[0] if isinstance(split.part, tuple) else split.part
+        if t.part_map is not None:
+            fpath, rg = t.part_map[rg_idx]
+        elif t.num_row_groups:
+            fpath, rg = t.path, rg_idx
+        else:
+            return None
+        md = pq.ParquetFile(fpath).metadata.row_group(rg)
+        cols = {}
+        for i in range(md.num_columns):
+            cmeta = md.column(i)
+            st = cmeta.statistics
+            if st is None:
+                continue
+            mn, mx = ((st.min, st.max) if st.has_min_max else (None, None))
+            cols[cmeta.path_in_schema] = (mn, mx, st.null_count)
+        return SplitStats(md.num_rows, cols)
+
+    def read_split_selective(self, split: Split, columns: Sequence[str],
+                             filters, capacity: Optional[int] = None,
+                             adaptive=None, counters=None) -> Batch:
+        """Predicate-during-decode read: filter columns decode first, the
+        cascade shrinks the selection vector, payload columns decode (and
+        upload) only for survivors. Bypasses the device split cache —
+        output depends on the filter set, like read_split_constrained."""
+        from presto_tpu.scan.selective import selective_read
+
+        self._check_fresh(split.table)
+        t = self._load(split.table)
+        if isinstance(split.part, tuple):
+            rg, sub, sub_count = split.part
+        else:
+            rg, sub, sub_count = split.part, 0, 1
+
+        def _decode(cols):
+            return self._decoded_columns(t, rg, sub, sub_count, cols)
+
+        return selective_read(_decode, t.handle, columns, filters,
+                              capacity=capacity, dicts=t.dicts,
+                              adaptive=adaptive, counters=counters)
 
     # -- write path (reference: HivePageSink writing ORC/parquet files;
     # CTAS = CreateTableTask + TableWriter chain) -------------------------

@@ -127,6 +127,9 @@ class LocalRunner:
         # Plans with scalar subqueries mutate during param binding → not
         # cacheable.
         self._plan_cache = {}
+        # ExecContext.stats of the most recent run (scan pruning/selective
+        # counters and friends) — the local analog of query-info stats
+        self.last_stats: dict = {}
 
     def plan(self, sql: str) -> QueryPlan:
         qp = self._plan_cache.get(sql)
@@ -154,12 +157,16 @@ class LocalRunner:
             if not qp.scalar_subqueries and qp.cacheable:
                 self._plan_cache[sql] = qp
         ctx = ExecContext(self.catalog, self.config)
-        return run_plan(qp, ctx)
+        out = run_plan(qp, ctx)
+        self.last_stats = ctx.stats
+        return out
 
     def _run_query_ast(self, q):
         qp = optimize(plan_query(q, self.catalog), self.catalog)
         ctx = ExecContext(self.catalog, self.config)
-        return run_plan(qp, ctx)
+        out = run_plan(qp, ctx)
+        self.last_stats = ctx.stats
+        return out
 
     def run(self, sql: str):
         """Execute and return a pandas DataFrame (host materialization)."""

@@ -125,6 +125,11 @@ class ExecConfig:
     spill_partitions: int = 8
     memory_revoking_threshold: float = 0.9
     memory_revoking_target: float = 0.5
+    # Aria selective scan (scan/ package): constrained scans on connectors
+    # with a read_split_selective path filter rows DURING host decode and
+    # upload only survivors. Off → decode-everything + device-side filter
+    # (the pre-Aria shape; also the oracle for bit-identical-result tests)
+    selective_scan: bool = True
     # background split prefetch depth: decode/stage split i+1..i+depth on a
     # host thread while the device computes split i (the IO/compute overlap
     # of the reference's async split loading — PageSourceProvider readers
@@ -626,9 +631,12 @@ def _scan_batches(scan: TableScan, ctx: ExecContext) -> Iterator[Batch]:
     if scan.constraints and hasattr(conn, "prune_splits"):
         storage_bounds = _constraints_to_storage(scan, handle)
         if storage_bounds:
+            from presto_tpu.scan import metrics as _scan_metrics
+
             before = len(splits)
             splits = conn.prune_splits(handle, splits, storage_bounds)
             ctx.stats[f"scan.{scan.table}.splits_pruned"] = before - len(splits)
+            _scan_metrics.record("splits_pruned", before - len(splits))
     if scan.constraints and hasattr(conn, "read_split_constrained"):
         # full predicate pushdown: the connector evaluates the range
         # constraints at the source (remote service / SQL WHERE) instead
@@ -639,6 +647,34 @@ def _scan_batches(scan: TableScan, ctx: ExecContext) -> Iterator[Batch]:
                            _b=bounds):  # noqa: E306
                 return conn.read_split_constrained(
                     split, columns, capacity=capacity, constraints=_b)
+    if (scan.constraints and ctx.config.selective_scan
+            and hasattr(conn, "read_split_selective")):
+        # Aria selective scan: compile the constraints into host value
+        # filters (scan/filters.py) and read each split through the
+        # predicate-during-decode path — filter columns decode first, the
+        # cascade shrinks a selection vector in adaptive order, payload
+        # columns decode/upload only for survivors. The exact device
+        # filter above the scan still runs (host filters are conservative
+        # supersets), so results never depend on this layer.
+        from presto_tpu.scan import metrics as _scan_metrics
+        from presto_tpu.scan.adaptive import AdaptiveFilterOrder
+        from presto_tpu.scan.filters import filters_from_constraints
+
+        filters = filters_from_constraints(scan.constraints, handle)
+        if filters:
+            adaptive = AdaptiveFilterOrder()
+            _prefix = f"scan.{scan.table}"
+
+            def _count(name, delta, _p=_prefix):
+                key = f"{_p}.{name}"
+                ctx.stats[key] = ctx.stats.get(key, 0) + delta
+                _scan_metrics.record(name, delta)
+
+            def read_split(split, columns, capacity=None,  # noqa: E306
+                           _f=filters, _a=adaptive):
+                return conn.read_split_selective(
+                    split, columns, _f, capacity=capacity, adaptive=_a,
+                    counters=_count)
     depth = ctx.config.scan_prefetch
     if depth <= 0 or len(splits) <= 1:
         for split in splits:

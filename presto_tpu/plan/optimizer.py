@@ -197,7 +197,11 @@ def _derive_scan_constraints(scan: TableScan, conjs: List[RowExpression]):
             ref, const, op = b, a, flip[c.fn]
         else:
             continue
-        if ref.name not in sym_to_col or const.type.is_string:
+        if ref.name not in sym_to_col:
+            continue
+        if const.type.is_string and not isinstance(const.value, str):
+            # string bounds feed dictionary-code filters downstream; only
+            # plain python-str constants have a well-defined order there
             continue
         col = sym_to_col[ref.name]
         lo, hi = scan.constraints.get(col, (None, None))

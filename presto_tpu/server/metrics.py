@@ -50,6 +50,9 @@ def worker_metrics(worker) -> str:
         ("presto_tpu_worker_spill_count_total", "spill events",
          st["spillCount"], lbl),
     ]
+    from presto_tpu.scan import metrics as scan_metrics
+
+    rows.extend(scan_metrics.metric_rows(lbl))
     return render_metrics(rows)
 
 
@@ -69,6 +72,9 @@ def coordinator_metrics(coordinator) -> str:
                      {"state": state}))
     rows.append(("presto_tpu_plan_cache_entries", "cached distributed plans",
                  len(coordinator._dplan_cache), None))
+    from presto_tpu.scan import metrics as scan_metrics
+
+    rows.extend(scan_metrics.metric_rows(None))
     return render_metrics(rows)
 
 

@@ -164,6 +164,13 @@ class TestHttpSurface:
             wbody = r.read().decode()
         assert 'presto_tpu_worker_tasks{node="w0"}' in wbody
         assert "presto_tpu_worker_memory_reserved_bytes" in wbody
+        # selective-scan counters are always exposed (0 until a
+        # constrained scan runs) on BOTH planes
+        for fam in ("presto_tpu_scan_splits_pruned_total",
+                    "presto_tpu_scan_rows_predecode_filtered_total",
+                    "presto_tpu_scan_bytes_skipped_total"):
+            assert fam in body, fam
+            assert f'{fam}{{node="w0"}}' in wbody, fam
 
     def test_ui_page(self, cluster):
         coord, _ = cluster
