@@ -7,6 +7,55 @@
 set -o pipefail
 cd "$(dirname "$0")"
 
+# Observability smoke: boot an in-process coordinator + worker, run one
+# query, scrape BOTH /v1/metrics planes, and lint each scrape with the
+# exposition validator (obs/exposition.py) — an invalid exposition document
+# breaks scrapers long before any test notices.
+echo "== observability smoke: metrics exposition lint =="
+env JAX_PLATFORMS=cpu python - <<'PYEOF'
+import sys
+import urllib.request
+
+import numpy as np
+import pandas as pd
+
+from presto_tpu.catalog.memory import MemoryConnector
+from presto_tpu.connector import Catalog
+from presto_tpu.obs.exposition import lint_exposition
+from presto_tpu.server.coordinator import DistributedRunner
+
+conn = MemoryConnector()
+conn.add_table("t", pd.DataFrame({"k": np.arange(100) % 5,
+                                  "v": np.arange(100.0)}))
+cat = Catalog()
+cat.register("m", conn, default=True)
+failed = False
+with DistributedRunner(cat, n_workers=1) as dr:
+    dr.run("select k, sum(v) as s from t group by k")
+    for name, url in [("coordinator", dr.coordinator.url),
+                      ("worker", dr.workers[0].url)]:
+        with urllib.request.urlopen(f"{url}/v1/metrics", timeout=10) as r:
+            body = r.read().decode()
+        errs = lint_exposition(body)
+        hists = sum(1 for ln in body.splitlines()
+                    if ln.startswith("# TYPE") and ln.endswith(" histogram"))
+        print(f"{name}: {len(body.splitlines())} lines, "
+              f"{hists} histogram families, {len(errs)} lint errors")
+        for e in errs:
+            print(f"  {name}: {e}", file=sys.stderr)
+            failed = True
+        if hists < 4:
+            print(f"  {name}: expected >= 4 histogram families",
+                  file=sys.stderr)
+            failed = True
+sys.exit(1 if failed else 0)
+PYEOF
+rc=$?
+if [ "$rc" -ne 0 ]; then
+  echo "observability smoke FAILED (exit $rc)"
+  exit "$rc"
+fi
+
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
   --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly \

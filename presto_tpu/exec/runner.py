@@ -130,6 +130,18 @@ class LocalRunner:
         # ExecContext.stats of the most recent run (scan pruning/selective
         # counters and friends) — the local analog of query-info stats
         self.last_stats: dict = {}
+        # Tracer of the most recent run (config.tracing) — the local analog
+        # of the coordinator's /v1/query/{id}/trace
+        self.last_trace = None
+
+    def _new_ctx(self, cfg: Optional[ExecConfig] = None) -> ExecContext:
+        from presto_tpu.obs import trace as _obs_trace
+
+        ctx = ExecContext(self.catalog, cfg or self.config)
+        if getattr(ctx.config, "tracing", True):
+            ctx.tracer = _obs_trace.Tracer()
+            self.last_trace = ctx.tracer
+        return ctx
 
     def plan(self, sql: str) -> QueryPlan:
         qp = self._plan_cache.get(sql)
@@ -156,14 +168,14 @@ class LocalRunner:
             qp = optimize(plan_query(stmt, self.catalog), self.catalog)
             if not qp.scalar_subqueries and qp.cacheable:
                 self._plan_cache[sql] = qp
-        ctx = ExecContext(self.catalog, self.config)
+        ctx = self._new_ctx()
         out = run_plan(qp, ctx)
         self.last_stats = ctx.stats
         return out
 
     def _run_query_ast(self, q):
         qp = optimize(plan_query(q, self.catalog), self.catalog)
-        ctx = ExecContext(self.catalog, self.config)
+        ctx = self._new_ctx()
         out = run_plan(qp, ctx)
         self.last_stats = ctx.stats
         return out
@@ -179,6 +191,6 @@ class LocalRunner:
 
         qp = self.plan(sql)
         cfg = _dc.replace(self.config, collect_stats=True)
-        ctx = ExecContext(self.catalog, cfg)
+        ctx = self._new_ctx(cfg)
         run_plan(qp, ctx)
         return plan_to_string(qp.root, node_stats=ctx.node_stats)

@@ -38,6 +38,7 @@ from presto_tpu.batch import (
 )
 from presto_tpu.connector import Catalog
 from presto_tpu.expr.compile import compile_expr, compile_predicate
+from presto_tpu.obs import trace as _obs_trace
 from presto_tpu.expr.ir import Constant, InputRef, substitute_params
 from presto_tpu.expr.structural import StructVal
 from presto_tpu.ops.grouping import KeyCol, StateCol, grouped_merge
@@ -118,6 +119,11 @@ class ExecConfig:
     # EXPLAIN ANALYZE: per-operator wall/rows/batches accounting (forces a
     # device sync per batch — off in production, like Presto's verbose stats)
     collect_stats: bool = False
+    # query-lifecycle span tracing (obs/trace.py): operator, compile,
+    # host_decode, device_transfer, exchange_wait spans. Cheap enough to
+    # stay on (no per-batch device sync); False makes every span site a
+    # single attribute check on the NOOP tracer
+    tracing: bool = True
     # memory + spill (reference: MemoryPool / spiller; None = unlimited)
     memory_pool_bytes: Optional[int] = None
     spill_enabled: bool = True
@@ -176,16 +182,23 @@ def _node_jit(node: PlanNode, key: str, builder, **jit_kwargs):
         stats = node.__dict__.setdefault("_jit_stats", {}).setdefault(
             key, {"compiles": 0, "compile_wall_s": 0.0})
 
-        def wrapped(*args, __jfn=jfn, __stats=stats, **kw):
+        def wrapped(*args, __jfn=jfn, __stats=stats,
+                    __node=type(node).__name__, __key=key, **kw):
             try:
                 before = __jfn._cache_size()
             except Exception:
                 return __jfn(*args, **kw)
             t0 = time.perf_counter()
+            w0 = time.time()
             out = __jfn(*args, **kw)
             if __jfn._cache_size() > before:
+                dt = time.perf_counter() - t0
                 __stats["compiles"] += 1
-                __stats["compile_wall_s"] += time.perf_counter() - t0
+                __stats["compile_wall_s"] += dt
+                tr = _obs_trace.current()
+                if tr.enabled:
+                    tr.record("compile", "compile", w0, w0 + dt,
+                              node=__node, key=__key)
             return out
 
         cache[key] = wrapped
@@ -202,8 +215,12 @@ class ExecContext:
         self.config = config
         self.stats: Dict[str, float] = {}
         # per-plan-node OperatorStats analog (keyed by id(node)):
-        # {"rows": ..., "batches": ..., "wall_s": ...}
+        # {"rows": ..., "batches": ..., "wall_s": ..., "bytes": ...}
         self.node_stats: Dict[int, Dict[str, float]] = {}
+        # span tracer (obs/trace.py). NOOP unless a server plane (worker
+        # task / coordinator run) or the LocalRunner installs a real one —
+        # config.tracing only matters where a tracer gets installed
+        self.tracer = _obs_trace.NOOP
         # distributed task context (set by the worker; None for LocalRunner):
         # this task reads splits[task_index::n_tasks] of every scanned table
         # (SOURCE_DISTRIBUTION split placement, statically assigned)
@@ -246,13 +263,14 @@ class ExecContext:
         return (pool.reserved + projected_delta_bytes
                 > pool.limit * pool.revoke_threshold)
 
-    def record(self, node, rows: int, wall_s: float):
+    def record(self, node, rows: int, wall_s: float, bytes_: int = 0):
         s = self.node_stats.setdefault(
-            id(node), {"rows": 0, "batches": 0, "wall_s": 0.0}
+            id(node), {"rows": 0, "batches": 0, "wall_s": 0.0, "bytes": 0}
         )
         s["rows"] += rows
         s["batches"] += 1
         s["wall_s"] += wall_s
+        s["bytes"] += bytes_
 
 
 # ---------------------------------------------------------------------------
@@ -366,6 +384,8 @@ def execute_node(node: PlanNode, ctx: ExecContext) -> Iterator[Batch]:
     stream = _execute_base(base, ctx)
     if ctx.config.collect_stats:
         stream = _instrumented(stream, base, ctx)
+    if ctx.tracer.enabled:
+        stream = _traced(stream, base, ctx)
     if down is not None:
         jfn = _node_jit(node, "down", lambda: down)
         stream = (jfn(b) for b in stream)
@@ -469,6 +489,8 @@ def _instrumented(stream: Iterator[Batch], node: PlanNode, ctx: ExecContext):
     addInput/getOutput into OperatorStats, Driver.java:277)."""
     import time as _time
 
+    from presto_tpu.memory import batch_device_bytes
+
     while True:
         t0 = _time.perf_counter()
         try:
@@ -476,8 +498,41 @@ def _instrumented(stream: Iterator[Batch], node: PlanNode, ctx: ExecContext):
         except StopIteration:
             return
         rows = int(jnp.sum(b.live))  # forces device sync
-        ctx.record(node, rows, _time.perf_counter() - t0)
+        ctx.record(node, rows, _time.perf_counter() - t0,
+                   bytes_=batch_device_bytes(b))
         yield b
+
+
+def _traced(stream: Iterator[Batch], node: PlanNode, ctx: ExecContext):
+    """Span wrapper: one aggregate `operator` span per plan node (total
+    span = first pull to exhaustion; busy_s = time actually spent inside
+    next()), plus a kernel-wall histogram observation per batch. No device
+    syncs — this stays on in production, unlike _instrumented."""
+    import time as _time
+
+    from presto_tpu.obs import metrics as _obs_metrics
+
+    tracer = ctx.tracer
+    parent = tracer.current_parent()
+    start = _time.time()
+    busy = 0.0
+    batches = 0
+    try:
+        while True:
+            t0 = _time.perf_counter()
+            try:
+                b = next(stream)
+            except StopIteration:
+                return
+            dt = _time.perf_counter() - t0
+            busy += dt
+            batches += 1
+            _obs_metrics.BATCH_KERNEL_WALL.observe(dt, plane="worker")
+            yield b
+    finally:
+        tracer.record(type(node).__name__, "operator", start, _time.time(),
+                      parent_id=parent, busy_s=round(busy, 6),
+                      batches=batches)
 
 
 def _fused_child(node: PlanNode, ctx: ExecContext):
@@ -487,6 +542,8 @@ def _fused_child(node: PlanNode, ctx: ExecContext):
     stream = _execute_base(base, ctx)
     if ctx.config.collect_stats:
         stream = _instrumented(stream, base, ctx)
+    if ctx.tracer.enabled:
+        stream = _traced(stream, base, ctx)
     if ctx.config.merge_sparse_output and isinstance(
             base, (HashJoin, SemiJoin, NestedLoopJoin, IndexJoin)):
         # breakers pull children through here, not execute_node — apply
@@ -675,6 +732,28 @@ def _scan_batches(scan: TableScan, ctx: ExecContext) -> Iterator[Batch]:
                 return conn.read_split_selective(
                     split, columns, _f, capacity=capacity, adaptive=_a,
                     counters=_count)
+    if ctx.tracer.enabled:
+        # host_decode / device_transfer sub-spans per split. The parent is
+        # captured HERE (the consumer thread, under the task/query span) —
+        # the prefetch producer thread has no span stack of its own.
+        _tracer = ctx.tracer
+        _scan_parent = _tracer.current_parent()
+        _inner_read = read_split
+
+        def read_split(split, columns, capacity=None,  # noqa: E306
+                       _rs=_inner_read):
+            w0 = time.time()
+            b = _rs(split, columns, capacity=capacity)
+            w1 = time.time()
+            _tracer.record("host_decode", "host_decode", w0, w1,
+                           parent_id=_scan_parent, table=scan.table)
+            # upload dispatch only — never block on device readiness here:
+            # a sync per split would serialize the prefetch pipeline the
+            # engine is built around (collect_stats is the opt-in sync path)
+            _tracer.record("device_transfer", "device_transfer", w1,
+                           time.time(), parent_id=_scan_parent,
+                           table=scan.table)
+            return b
     depth = ctx.config.scan_prefetch
     if depth <= 0 or len(splits) <= 1:
         for split in splits:
@@ -692,10 +771,13 @@ def _scan_batches(scan: TableScan, ctx: ExecContext) -> Iterator[Batch]:
 
     def producer():
         try:
-            for split in splits:
-                if stop.is_set():
-                    break
-                q.put(read_split(split, columns, capacity=cap))
+            # the producer thread carries the query's tracer so span sites
+            # below the connector (selective cascade) keep recording
+            with _obs_trace.use(ctx.tracer):
+                for split in splits:
+                    if stop.is_set():
+                        break
+                    q.put(read_split(split, columns, capacity=cap))
             q.put(_SENTINEL)
         except BaseException as e:  # surface read errors on the consumer
             q.put(e)
@@ -3201,6 +3283,11 @@ def bind_scalar_subqueries(qp: QueryPlan, ctx: ExecContext) -> None:
 
 def run_plan(qp: QueryPlan, ctx: ExecContext) -> Batch:
     """Execute a QueryPlan to a single host-collectable Batch."""
+    with _obs_trace.use(ctx.tracer), ctx.tracer.span("query", "query"):
+        return _run_plan_inner(qp, ctx)
+
+
+def _run_plan_inner(qp: QueryPlan, ctx: ExecContext) -> Batch:
     bind_scalar_subqueries(qp, ctx)
 
     # local grouped execution: mark bucket-colocated joins so the executor

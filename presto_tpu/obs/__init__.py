@@ -1,0 +1,5 @@
+"""Observability plane: span tracing (trace), histogram metric families
+(metrics), exposition-format lint (exposition), and query-event sinks
+(events)."""
+
+from presto_tpu.obs import trace  # noqa: F401

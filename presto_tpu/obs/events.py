@@ -1,0 +1,59 @@
+"""Query-event listener sinks.
+
+The QueryManager fires `(event, QueryInfo)` listeners (the EventListener
+SPI's QueryCompletedEvent analog). This module's SlowQueryLogger is the
+standard sink: a structured JSONL stream of completed queries over a
+latency threshold, each record carrying the top-k most expensive spans
+inline so a slow query is diagnosable from the log alone — no trace
+endpoint round trip.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import List, Optional
+
+
+class SlowQueryLogger:
+    """Append one JSONL record per completed query whose wall time crossed
+    `threshold_s` (0.0 = log every completion)."""
+
+    def __init__(self, path: str, threshold_s: float = 0.0, top_k: int = 5):
+        self.path = path
+        self.threshold_s = threshold_s
+        self.top_k = top_k
+        self._lock = threading.Lock()
+
+    def log(self, info, spans: Optional[list] = None) -> None:
+        """`info` is a querymanager.QueryInfo; `spans` the query's trace
+        spans (obs.trace.Span), when tracing captured any."""
+        elapsed = max(0.0, (info.end_time or time.time()) - info.create_time)
+        if elapsed < self.threshold_s:
+            return
+        top: List[dict] = []
+        if spans:
+            closed = [s for s in spans if s.end is not None]
+            closed.sort(key=lambda s: s.duration_s, reverse=True)
+            for s in closed[:self.top_k]:
+                d = {"name": s.name, "kind": s.kind,
+                     "durationS": round(s.duration_s, 6)}
+                if s.attrs:
+                    d["attrs"] = s.attrs
+                top.append(d)
+        rec = {
+            "event": "queryCompleted",
+            "ts": time.time(),
+            "queryId": info.query_id,
+            "state": info.state,
+            "user": info.user,
+            "sql": info.sql,
+            "elapsedS": round(elapsed, 6),
+            "error": info.error,
+            "topSpans": top,
+        }
+        line = json.dumps(rec, default=str)
+        with self._lock:
+            with open(self.path, "a") as fh:
+                fh.write(line + "\n")

@@ -470,12 +470,24 @@ def plan_to_string(node: PlanNode, indent: int = 0, node_stats=None) -> str:
         s = f"{pad}Output[{', '.join(node.names)}]"
     else:
         s = f"{pad}{type(node).__name__}"
+    jstats = getattr(node, "_jit_stats", None)
     if node_stats and id(node) in node_stats:
         st = node_stats[id(node)]
         s += (f"   [rows={int(st['rows'])}, batches={int(st['batches'])}, "
-              f"wall={st['wall_s']*1000:.1f}ms]")
-    jstats = getattr(node, "_jit_stats", None)
-    if node_stats is not None and jstats:
+              f"wall={st['wall_s']*1000:.1f}ms")
+        if st.get("bytes"):
+            s += f", bytes={int(st['bytes'])}"
+        compiles = sum(v["compiles"] for v in jstats.values()) if jstats \
+            else 0
+        if compiles:
+            # split the measured wall into compile vs execute: recompiles
+            # (capacity growth, new batch shapes) show up HERE, not as
+            # mysteriously slow operators
+            cwall = sum(v["compile_wall_s"] for v in jstats.values())
+            s += (f", compiles={compiles}, compile={cwall:.2f}s, "
+                  f"execute={max(0.0, st['wall_s'] - cwall):.2f}s")
+        s += "]"
+    elif node_stats is not None and jstats:
         compiles = sum(v["compiles"] for v in jstats.values())
         cwall = sum(v["compile_wall_s"] for v in jstats.values())
         if compiles:

@@ -44,9 +44,15 @@ def reset() -> None:
 
 
 def metric_rows(labels: Optional[Dict[str, str]] = None,
-                ) -> List[Tuple[str, str, object, Optional[Dict[str, str]]]]:
+                ) -> List[Tuple[str, str, object, Optional[Dict[str, str]],
+                                str]]:
     """Rows for server.metrics.render_metrics — always present (0 when the
-    selective path never ran) so scrapers see stable families."""
+    selective path never ran) so scrapers see stable families. These are
+    PROCESS-wide monotonic counters: callers embedding them on an endpoint
+    must label which plane is exposing them (the server metrics module
+    adds plane=worker / plane=coordinator) or a single-process deployment
+    scraped on both planes double-counts."""
     snap = snapshot()
-    return [(f"presto_tpu_scan_{k}_total", _HELP[k], snap[k], labels)
+    return [(f"presto_tpu_scan_{k}_total", _HELP[k], snap[k], labels,
+             "counter")
             for k in COUNTER_NAMES]

@@ -52,6 +52,10 @@ def selective_read(
 
     from presto_tpu.batch import Column
 
+    from presto_tpu.obs import trace as _obs_trace
+
+    tracer = _obs_trace.current()
+    cascade_w0 = time.time() if tracer.enabled else 0.0
     filter_cols = list(filters)
     order = adaptive.order(filter_cols) if adaptive is not None else filter_cols
     decoded_f, n = decode(tuple(filter_cols))
@@ -69,6 +73,11 @@ def selective_read(
             adaptive.update(col, rows_in, len(sel),
                             time.perf_counter() - t0)
     m = len(sel)
+    if tracer.enabled:
+        # filter-decode + cascade wall, before any payload materializes
+        tracer.record("scan_filter_cascade", "host_decode", cascade_w0,
+                      time.time(), table=getattr(handle, "name", "?"),
+                      rows_in=int(n), rows_out=int(m))
     if counters is not None and n > m:
         counters("rows_predecode_filtered", n - m)
         counters("bytes_skipped", (n - m) * _bytes_per_row(handle, columns))

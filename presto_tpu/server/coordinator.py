@@ -31,6 +31,7 @@ from typing import Dict, List, Optional
 from presto_tpu.batch import Batch
 from presto_tpu.connector import Catalog
 from presto_tpu.exec.runtime import ExecConfig
+from presto_tpu.obs import trace as _obs_trace
 from presto_tpu.plan.fragmenter import (
     HASH,
     OUT_BROADCAST,
@@ -303,12 +304,21 @@ class DistributedScheduler:
     def execute(self, query_id: str, dplan: DistributedPlan,
                 workers: List[NodeInfo],
                 config: Optional[ExecConfig] = None,
-                stats_out: Optional[list] = None):
+                stats_out: Optional[list] = None,
+                tracer=None):
         """`stats_out`, when given, is filled with one
         (task_id, fragment_id, task_info_dict) per task after the result
         stream completes — the per-task stats rollup EXPLAIN ANALYZE
-        renders (QueryStats/TaskStats introspection analog)."""
+        renders (QueryStats/TaskStats introspection analog).
+
+        `tracer` (obs.trace.Tracer) makes every task-create POST carry the
+        query's trace token; after the stream completes the scheduler pulls
+        each task's span dump and stitches query → stage → task."""
         config = config or self.config
+        tracer = tracer or _obs_trace.NOOP
+        trace_parent = tracer.current_parent()
+        trace_hdrs = ({_obs_trace.TRACE_HEADER: tracer.token(trace_parent)}
+                      if tracer.enabled else {})
         if not workers:
             raise QueryFailed("no active workers")
         frags = dplan.fragments
@@ -433,7 +443,8 @@ class DistributedScheduler:
             body = json.dumps(task_update_to_json(update)).encode()
             req = urllib.request.Request(
                 f"{w.uri}/v1/task/{tid}", data=body, method="POST",
-                headers=self._headers({"Content-Type": "application/json"}),
+                headers=self._headers({"Content-Type": "application/json",
+                                       **trace_hdrs}),
             )
             with urllib.request.urlopen(req, timeout=30) as r:
                 info = json.loads(r.read())
@@ -508,28 +519,54 @@ class DistributedScheduler:
                         timeout_s=getattr(config, "phase_wait_timeout_s",
                                           600.0),
                         on_lost=(reschedule if ph == 0 and grouped
-                                 else None))
+                                 else None),
+                        extra_headers=trace_hdrs)
             # stream the root fragment's single output buffer
             root_urls = [f"{u}/results/0" for u in task_urls[dplan.root_fid]]
             client = ExchangeClient(root_urls)
+            if tracer.enabled:
+                from presto_tpu.obs import metrics as _obs_metrics
+            stream_w0 = time.time()
+            waited = 0.0
             try:
-                for b in client.batches():
+                it = client.batches()
+                while True:
+                    w0 = time.monotonic()
+                    try:
+                        b = next(it)
+                    except StopIteration:
+                        break
+                    dt = time.monotonic() - w0
+                    waited += dt
+                    if tracer.enabled:
+                        _obs_metrics.EXCHANGE_WAIT.observe(
+                            dt, plane="coordinator")
                     yield b
                 completed = True
             finally:
                 client.close()
+                if tracer.enabled:
+                    tracer.record("exchange_wait", "exchange_wait",
+                                  stream_w0, time.time(),
+                                  parent_id=trace_parent,
+                                  fragment=dplan.root_fid,
+                                  wait_s=round(waited, 6))
             if stats_out is not None:
                 for tid, w in created:
                     try:
                         req = urllib.request.Request(
                             f"{w.uri}/v1/task/{tid}/status",
-                            headers=self._headers())
+                            headers=self._headers(trace_hdrs))
                         with urllib.request.urlopen(req, timeout=10) as r:
                             info = json.loads(r.read())
-                        fid = int(tid.rsplit(".", 2)[-2])
+                        m = _TID_RE.match(tid)
+                        fid = int(m.group(2)) if m else -1
                         stats_out.append((tid, fid, info))
                     except Exception:
                         pass
+            if tracer.enabled:
+                self._collect_task_traces(tracer, created, trace_parent,
+                                          trace_hdrs)
         except ExchangeFailure as e:
             raise QueryFailed(str(e), retryable=not e.task_error) from e
         finally:
@@ -539,8 +576,46 @@ class DistributedScheduler:
             if not completed:
                 self._abort(created)
 
+    def _collect_task_traces(self, tracer, created, trace_parent,
+                             extra_headers):
+        """Stitch the distributed trace: pull every created task's span
+        dump (GET /v1/task/{id}/trace), group by fragment, synthesize one
+        `stage` span per fragment (the envelope of its tasks' spans) hung
+        off the query root, and re-parent each worker task root onto its
+        stage span. Unreachable workers just leave a hole — the trace is
+        best-effort by design."""
+        by_fid: Dict[int, list] = {}
+        for tid, w in created:
+            m = _TID_RE.match(tid)
+            fid = int(m.group(2)) if m else -1
+            try:
+                req = urllib.request.Request(
+                    f"{w.uri}/v1/task/{tid}/trace",
+                    headers=self._headers(extra_headers))
+                with urllib.request.urlopen(req, timeout=10) as r:
+                    doc = json.loads(r.read())
+            except Exception:
+                continue
+            if doc.get("spans"):
+                by_fid.setdefault(fid, []).append(doc)
+        for fid in sorted(by_fid):
+            docs = by_fid[fid]
+            starts = [s["start"] for d in docs for s in d["spans"]]
+            ends = [(s["end"] if s["end"] is not None else s["start"])
+                    for d in docs for s in d["spans"]]
+            stage = tracer.record(
+                f"stage-{fid}", "stage", min(starts), max(ends),
+                parent_id=trace_parent, fragment=fid, tasks=len(docs))
+            for d in docs:
+                parent_map = {}
+                root = d.get("rootSpanId")
+                if root:
+                    parent_map[root] = stage.span_id
+                tracer.absorb(d["spans"], parent_map)
+
     def _wait_finished(self, tasks, timeout_s: float = 600.0,
-                       poll_s: float = 0.1, on_lost=None):
+                       poll_s: float = 0.1, on_lost=None,
+                       extra_headers: Optional[dict] = None):
         """Block until every (tid, worker, fid, index) task reached a
         terminal state (phased scheduling's stage-completion gate). A
         failed task fails the query immediately. With `on_lost` (recoverable
@@ -557,7 +632,7 @@ class DistributedScheduler:
                 try:
                     req = urllib.request.Request(
                         f"{w.uri}/v1/task/{tid}/status",
-                        headers=self._headers())
+                        headers=self._headers(extra_headers))
                     with urllib.request.urlopen(req, timeout=10) as r:
                         info = json.loads(r.read())
                 except Exception as e:
@@ -591,6 +666,11 @@ class DistributedScheduler:
                 urllib.request.urlopen(req, timeout=5).read()
             except Exception:
                 pass
+
+
+# task ids are "{query_id}.{fragment}.{index}" with an optional ".r{n}"
+# retry suffix (reschedule) — rsplit misparses retried ids, this doesn't
+_TID_RE = re.compile(r"^(.+)\.(\d+)\.(\d+)(?:\.r\d+)?$")
 
 
 def _fragment_lifespans(node) -> int:
@@ -627,7 +707,9 @@ class Coordinator:
                  cluster_memory_limit_bytes: Optional[int] = None,
                  low_memory_killer: str = "total-reservation-on-blocked",
                  low_memory_kill_delay_s: float = 1.0,
-                 access_control=None, tls=None):
+                 access_control=None, tls=None,
+                 slow_query_log: Optional[str] = None,
+                 slow_query_threshold_s: float = 0.0):
         from presto_tpu.server.cluster_memory import ClusterMemoryManager
         from presto_tpu.server.protocol import StatementProtocol
         from presto_tpu.server.querymanager import (
@@ -666,6 +748,37 @@ class Coordinator:
 
         self.query_manager = QueryManager(execute_fn)
         self.failure_detector.query_manager = self.query_manager
+        # query-id → Tracer; /v1/query/{id}/trace and the UI drill-down
+        # resolve from here (scheduler attempt ids alias to the same trace)
+        self.trace_registry = _obs_trace.TraceRegistry()
+
+        def _record_latency(event: str, info):
+            if event != "queryCompleted":
+                return
+            try:
+                from presto_tpu.obs import metrics as _obs_metrics
+
+                _obs_metrics.QUERY_LATENCY.observe(
+                    max(0.0, (info.end_time or time.time())
+                        - info.create_time),
+                    plane="coordinator", state=info.state)
+            except Exception:
+                pass
+
+        self.query_manager.listeners.append(_record_latency)
+        if slow_query_log:
+            from presto_tpu.obs.events import SlowQueryLogger
+
+            slow = SlowQueryLogger(slow_query_log,
+                                   threshold_s=slow_query_threshold_s)
+
+            def _log_slow(event: str, info, _s=slow):
+                if event != "queryCompleted":
+                    return
+                tr = self.trace_registry.get(info.query_id)
+                _s.log(info, tr.spans() if tr is not None else None)
+
+            self.query_manager.listeners.append(_log_slow)
         if query_event_log:
             # query-completion audit stream (reference: the EventListener
             # SPI's QueryCompletedEvent, commonly shipped to an audit log)
@@ -739,9 +852,17 @@ class Coordinator:
         self.size_monitor.wait_for_minimum()
         qid = self.next_query_id()
         workers = self.node_manager.active_nodes()
-        for _ in self.scheduler.execute(qid, dplan, workers, cfg,
-                                        stats_out=stats):
-            pass
+        tracer = _obs_trace.NOOP
+        if getattr(cfg, "tracing", True):
+            tracer = _obs_trace.Tracer(
+                trace_id=getattr(session, "query_id", "") or None)
+            self.trace_registry.register(tracer)
+            self.trace_registry.alias(qid, tracer.trace_id)
+        with _obs_trace.use(tracer), tracer.span("query", "query",
+                                                 sql=sql[:200]):
+            for _ in self.scheduler.execute(qid, dplan, workers, cfg,
+                                            stats_out=stats, tracer=tracer):
+                pass
         lines = [dplan.to_string(), "", "-- task execution profile --"]
         by_fid: Dict[int, list] = {}
         for tid, fid, info in stats:
@@ -751,10 +872,21 @@ class Coordinator:
             for tid, info in sorted(by_fid[fid]):
                 lines.append(f"  task {tid} [{info.get('state')}]")
                 for row in info.get("stats") or []:
-                    lines.append(
+                    line = (
                         f"    {row['node']:<16} rows={int(row['rows']):>12,}"
                         f" batches={int(row['batches']):>6}"
                         f" wall={row['wall_s']:.3f}s")
+                    if row.get("bytes"):
+                        line += f" bytes={int(row['bytes']):,}"
+                    if "compiles" in row:
+                        # compile wall comes out of the operator's measured
+                        # wall: the split shows where the time actually went
+                        cw = float(row.get("compile_wall_s") or 0.0)
+                        line += (f" compiles={int(row['compiles'])}"
+                                 f" compile={cw:.3f}s"
+                                 f" execute="
+                                 f"{max(0.0, row['wall_s'] - cw):.3f}s")
+                    lines.append(line)
         return "\n".join(lines)
 
     # -- http -------------------------------------------------------------
@@ -834,6 +966,21 @@ class Coordinator:
                         )
                     except KeyError:
                         return self._json({"error": "unknown query"}, 404)
+                m = re.match(r"^/v1/query/([^/]+)/trace$", self.path)
+                if m:
+                    tr = coord.trace_registry.get(m.group(1))
+                    if tr is None:
+                        return self._json({"error": "no trace for query"},
+                                          404)
+                    return self._json(tr.to_json())
+                m = re.match(r"^/ui/query/([^/]+)$", self.path)
+                if m:
+                    from presto_tpu.server.metrics import render_query_page
+
+                    page = render_query_page(coord, m.group(1))
+                    if page is None:
+                        return self._json({"error": "unknown query"}, 404)
+                    return self._text(page, "text/html")
                 m = re.match(r"^/v1/query/([^/]+)$", self.path)
                 if m:
                     try:
@@ -905,11 +1052,19 @@ class Coordinator:
             return f"q{self._query_seq}"
 
     def execute_distributed(self, dplan: DistributedPlan,
-                            config: Optional[ExecConfig] = None):
+                            config: Optional[ExecConfig] = None,
+                            tracer=None):
         self.size_monitor.wait_for_minimum()
         qid = self.next_query_id()
         workers = self.node_manager.active_nodes()
-        yield from self.scheduler.execute(qid, dplan, workers, config)
+        if tracer is None:
+            tracer = _obs_trace.current()
+        if tracer.enabled:
+            # task ids embed this scheduler attempt id — make it resolve
+            # to the query's trace too
+            self.trace_registry.alias(qid, tracer.trace_id)
+        yield from self.scheduler.execute(qid, dplan, workers, config,
+                                          tracer=tracer)
 
     def _try_scaled_write(self, stmt, config, session) -> Optional[Batch]:
         """Scaled writers (SCALED_WRITER_DISTRIBUTION): CTAS into a
@@ -1113,6 +1268,22 @@ class Coordinator:
                   session=None, stmt=None) -> Batch:
         """`stmt` overrides parsing — the bound AST of a prepared
         statement (EXECUTE path; no SQL re-rendering)."""
+        cfg = config or self.config
+        if not getattr(cfg, "tracing", True):
+            return self._run_batch_inner(sql, config, session, stmt)
+        # trace id = the session query id when there is one, so
+        # /v1/query/{id}/trace resolves directly; the root span covers
+        # planning + scheduling + result merge (≥95% of query wall)
+        tracer = _obs_trace.Tracer(
+            trace_id=getattr(session, "query_id", "") or None)
+        self.trace_registry.register(tracer)
+        with _obs_trace.use(tracer), tracer.span(
+                "query", "query", sql=(sql or "")[:200],
+                user=getattr(session, "user", None) or "user"):
+            return self._run_batch_inner(sql, config, session, stmt)
+
+    def _run_batch_inner(self, sql: str, config: Optional[ExecConfig] = None,
+                         session=None, stmt=None) -> Batch:
         import jax.numpy as jnp
 
         from presto_tpu.batch import Column

@@ -5,30 +5,59 @@ Reference: the reference exposes engine internals over JMX MBeans
 equivalent is a /v1/metrics text exposition that scrapers ingest
 directly. Metrics are derived on demand from the same status structures
 the REST introspection serves — no separate collection machinery.
+
+Exposition rules honored here (text format 0.0.4): HELP/TYPE once per
+family, label values escaped (backslash, quote, newline), counter
+families typed `counter`, and the histogram families from
+presto_tpu.obs.metrics appended per plane so the in-process cluster
+(coordinator + workers sharing one process) never double-exposes a
+series.
 """
 
 from __future__ import annotations
 
-from typing import Dict, List, Tuple
+from typing import Dict, List, Optional, Tuple
+
+_ESCAPES = [("\\", "\\\\"), ('"', '\\"'), ("\n", "\\n")]
+
+
+def _escape_label(value: object) -> str:
+    s = str(value)
+    for raw, esc in _ESCAPES:
+        s = s.replace(raw, esc)
+    return s
 
 
 def _fmt(name: str, value, labels: Dict[str, str] | None = None) -> str:
     if labels:
-        lab = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+        lab = ",".join(f'{k}="{_escape_label(v)}"'
+                       for k, v in sorted(labels.items()))
         return f"{name}{{{lab}}} {value}"
     return f"{name} {value}"
 
 
-def render_metrics(rows: List[Tuple[str, str, object, Dict[str, str]]]) -> str:
-    """rows: (metric_name, help_text, value, labels). Renders one
-    exposition document with # HELP/# TYPE headers per metric family."""
+def _row_type(name: str, explicit: Optional[str]) -> str:
+    if explicit:
+        return explicit
+    # Prometheus naming convention: monotonic totals end in _total
+    return "counter" if name.endswith("_total") else "gauge"
+
+
+def render_metrics(rows: List[Tuple]) -> str:
+    """rows: (metric_name, help_text, value, labels[, type]). Renders one
+    exposition document with # HELP/# TYPE headers emitted once per
+    metric family. The optional fifth element names the family type
+    ("counter" / "gauge" / ...); absent, `*_total` names render as
+    counters and everything else as gauges."""
     seen = set()
     out = []
-    for name, help_text, value, labels in rows:
+    for row in rows:
+        name, help_text, value, labels = row[0], row[1], row[2], row[3]
+        mtype = _row_type(name, row[4] if len(row) > 4 else None)
         if name not in seen:
             seen.add(name)
             out.append(f"# HELP {name} {help_text}")
-            out.append(f"# TYPE {name} gauge")
+            out.append(f"# TYPE {name} {mtype}")
         out.append(_fmt(name, value, labels))
     return "\n".join(out) + "\n"
 
@@ -50,10 +79,14 @@ def worker_metrics(worker) -> str:
         ("presto_tpu_worker_spill_count_total", "spill events",
          st["spillCount"], lbl),
     ]
+    from presto_tpu.obs import metrics as obs_metrics
     from presto_tpu.scan import metrics as scan_metrics
 
-    rows.extend(scan_metrics.metric_rows(lbl))
-    return render_metrics(rows)
+    # scan counters are process-wide; the plane label keeps the worker and
+    # coordinator expositions of a shared-process cluster distinguishable
+    # (sum over planes double-counts — filter on one)
+    rows.extend(scan_metrics.metric_rows({**lbl, "plane": "worker"}))
+    return render_metrics(rows) + obs_metrics.render_histograms("worker")
 
 
 def coordinator_metrics(coordinator) -> str:
@@ -72,10 +105,12 @@ def coordinator_metrics(coordinator) -> str:
                      {"state": state}))
     rows.append(("presto_tpu_plan_cache_entries", "cached distributed plans",
                  len(coordinator._dplan_cache), None))
+    from presto_tpu.obs import metrics as obs_metrics
     from presto_tpu.scan import metrics as scan_metrics
 
-    rows.extend(scan_metrics.metric_rows(None))
-    return render_metrics(rows)
+    rows.extend(scan_metrics.metric_rows({"plane": "coordinator"}))
+    return (render_metrics(rows)
+            + obs_metrics.render_histograms("coordinator"))
 
 
 _UI_PAGE = """<!DOCTYPE html>
@@ -86,6 +121,7 @@ _UI_PAGE = """<!DOCTYPE html>
  table {{ border-collapse: collapse; width: 100%; }}
  th, td {{ text-align: left; padding: 4px 10px; border-bottom: 1px solid #333; }}
  th {{ color: #888; }}
+ a {{ color: #7ec8e3; }}
  .RUNNING {{ color: #7ec8e3; }} .FINISHED {{ color: #8c8; }}
  .FAILED {{ color: #e88; }} .QUEUED {{ color: #cc8; }}
 </style></head><body>
@@ -118,11 +154,101 @@ def render_ui(coordinator) -> str:
     for q in sorted(coordinator.query_manager.queries(),
                     key=lambda q: q.create_time, reverse=True)[:50]:
         elapsed = (q.end_time or time.time()) - q.create_time
+        qid = html.escape(q.query_id)
         queries.append(
-            f'<tr><td>{html.escape(q.query_id)}</td>'
+            f'<tr><td><a href="/ui/query/{qid}">{qid}</a></td>'
             f'<td class="{q.state}">{q.state}</td>'
             f"<td>{elapsed:.2f}</td>"
             f"<td>{html.escape((q.sql or '')[:160])}</td></tr>")
     return _UI_PAGE.format(nodes="\n".join(nodes) or "<tr><td>none</td></tr>",
                            queries="\n".join(queries)
                            or "<tr><td>none</td></tr>")
+
+
+_QUERY_PAGE = """<!DOCTYPE html>
+<html><head><title>presto-tpu query {qid}</title>
+<style>
+ body {{ font-family: monospace; margin: 2em; background: #111; color: #ddd; }}
+ h1 {{ color: #7ec8e3; }} h2 {{ color: #9a9; margin-top: 1.5em; }}
+ table {{ border-collapse: collapse; width: 100%; }}
+ th, td {{ text-align: left; padding: 3px 10px; border-bottom: 1px solid #333; }}
+ th {{ color: #888; }}
+ a {{ color: #7ec8e3; }}
+ pre {{ background: #1a1a1a; padding: 1em; overflow-x: auto; }}
+ .bar {{ background: #2a6; height: 10px; display: inline-block; }}
+</style></head><body>
+<a href="/ui">&larr; queries</a>
+<h1>query {qid}</h1>
+<table>
+<tr><th>state</th><td>{state}</td></tr>
+<tr><th>elapsed</th><td>{elapsed}</td></tr>
+<tr><th>user</th><td>{user}</td></tr>
+</table>
+<h2>sql</h2><pre>{sql}</pre>
+<h2>trace spans</h2>
+{trace}
+<p><a href="/v1/query/{qid}/trace">raw trace JSON</a></p>
+</body></html>
+"""
+
+
+def _render_span_rows(tree: list, total_s: float, depth: int = 0,
+                      out: Optional[list] = None) -> list:
+    import html as _html
+
+    if out is None:
+        out = []
+    for node in tree:
+        dur = node.get("durationS") or 0.0
+        pct = (dur / total_s * 100.0) if total_s > 0 else 0.0
+        width = max(1, int(pct * 2))
+        attrs = node.get("attrs") or {}
+        attr_s = " ".join(f"{k}={v}" for k, v in sorted(attrs.items()))
+        indent = "&nbsp;" * (2 * depth)
+        out.append(
+            f"<tr><td>{indent}{_html.escape(node['name'])}</td>"
+            f"<td>{_html.escape(node.get('kind', ''))}</td>"
+            f"<td>{dur:.4f}</td>"
+            f'<td><span class="bar" style="width:{width}px"></span>'
+            f" {pct:.1f}%</td>"
+            f"<td>{_html.escape(attr_s[:120])}</td></tr>")
+        _render_span_rows(node.get("children") or [], total_s, depth + 1, out)
+    return out
+
+
+def render_query_page(coordinator, query_id: str) -> Optional[str]:
+    """Per-query drill-down: state + sql + nested span table with
+    percent-of-query bars. None when the query id is unknown."""
+    import html
+    import time
+
+    q = None
+    for cand in coordinator.query_manager.queries():
+        if cand.query_id == query_id:
+            q = cand
+            break
+    tracer = coordinator.trace_registry.get(query_id)
+    if q is None and tracer is None:
+        return None
+    if q is not None:
+        state, user, sql = q.state, q.user, q.sql or ""
+        elapsed = f"{(q.end_time or time.time()) - q.create_time:.3f}s"
+    else:
+        state, user, sql, elapsed = "?", "?", "", "?"
+    trace_html = "<p>no trace recorded</p>"
+    if tracer is not None:
+        doc = tracer.to_json()
+        tree = doc.get("tree") or []
+        total = max((n.get("durationS") or 0.0) for n in tree) if tree else 0.0
+        rows = _render_span_rows(tree, total)
+        if rows:
+            trace_html = (
+                "<table><tr><th>span</th><th>kind</th><th>wall (s)</th>"
+                "<th>% of query</th><th>attrs</th></tr>"
+                + "\n".join(rows) + "</table>")
+    return _QUERY_PAGE.format(qid=html.escape(query_id),
+                              state=html.escape(str(state)),
+                              elapsed=html.escape(elapsed),
+                              user=html.escape(str(user)),
+                              sql=html.escape(sql),
+                              trace=trace_html)

@@ -343,6 +343,7 @@ class StatementProtocol:
         out: dict = {
             "id": qe.query_id,
             "infoUri": f"{self.base_url}/v1/query/{qe.query_id}",
+            "traceUri": f"{self.base_url}/v1/query/{qe.query_id}/trace",
             "stats": {
                 "state": qe.state,
                 "queued": qe.state == "QUEUED",

@@ -137,6 +137,8 @@ class QueryExecution:
             create_time=self.create_time,
             end_time=self.end_time,
             error=self.error,
+            stats={"elapsed_s": round(
+                (self.end_time or time.time()) - self.create_time, 6)},
         )
 
 

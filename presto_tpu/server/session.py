@@ -114,6 +114,9 @@ class SystemSessionProperties:
                              "Max geometric capacity growth retries", int, 24),
             PropertyMetadata("collect_stats",
                              "Per-operator stats (EXPLAIN ANALYZE)", bool, False),
+            PropertyMetadata("tracing",
+                             "Record query-lifecycle spans "
+                             "(/v1/query/{id}/trace)", bool, True),
             PropertyMetadata("scan_prefetch",
                              "Background split-prefetch depth (0 disables)",
                              int, 2),
@@ -244,6 +247,7 @@ class Session:
             join_out_capacity=self.get("join_out_capacity"),
             max_growth_retries=self.get("max_growth_retries"),
             collect_stats=self.get("collect_stats"),
+            tracing=self.get("tracing"),
             memory_pool_bytes=(qmax * (1 << 20)) if qmax else None,
             spill_enabled=self.get("spill_enabled"),
             memory_revoking_threshold=self.get("memory_revoking_threshold"),

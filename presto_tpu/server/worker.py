@@ -35,6 +35,7 @@ import numpy as np
 from presto_tpu.batch import Batch
 from presto_tpu.connector import Catalog
 from presto_tpu.exec.runtime import ExecConfig, ExecContext, execute_node
+from presto_tpu.obs import trace as _obs_trace
 from presto_tpu.ops.partition import partition_ids
 from presto_tpu.plan.fragmenter import (
     OUT_BROADCAST,
@@ -156,13 +157,25 @@ class TaskExecution:
     """One task: fragment + splits in, pages out (SqlTaskExecution analog)."""
 
     def __init__(self, task_id: str, update: TaskUpdate, catalog: Catalog,
-                 memory_pool=None, spill_manager=None, executor=None):
+                 memory_pool=None, spill_manager=None, executor=None,
+                 trace_token: Optional[str] = None, node_id: str = ""):
         self.task_id = task_id
         self.update = update
         self.catalog = catalog
         self.memory_pool = memory_pool
         self.spill_manager = spill_manager
         self.executor = executor
+        self.node_id = node_id
+        # trace token travels in the X-Presto-Tpu-Trace header, NOT the
+        # TaskUpdate body — the codec vocabulary stays closed. Each task
+        # records into its own tracer; the coordinator pulls the dump via
+        # GET /v1/task/{id}/trace and stitches the query tree.
+        self.tracer = _obs_trace.NOOP
+        self._trace_parent: Optional[str] = None
+        if trace_token and update.config.get("tracing", True):
+            trace_id, parent = _obs_trace.parse_token(trace_token)
+            self.tracer = _obs_trace.Tracer(trace_id=trace_id)
+            self._trace_parent = parent
         self.state = "running"
         self.error: Optional[str] = None
         self.stats_report: Optional[list] = None  # per-operator rows
@@ -187,58 +200,53 @@ class TaskExecution:
         urls = self.update.upstreams[fragment_id]
         client = ExchangeClient(urls)
         self._clients.append(client)
-        return client.batches()
+        if not self.tracer.enabled:
+            return client.batches()
+        return self._traced_exchange(client, fragment_id)
+
+    def _traced_exchange(self, client: ExchangeClient, fragment_id: int):
+        """Exchange pull with consumer-blocked time accounted: each next()
+        wall goes to the exchange-wait histogram, and one exchange_wait
+        span records the stream envelope with total blocked seconds."""
+        from presto_tpu.obs import metrics as _obs_metrics
+
+        it = client.batches()
+        parent = self.tracer.current_parent()
+        start = time.time()
+        waited = 0.0
+        try:
+            while True:
+                w0 = time.perf_counter()
+                try:
+                    b = next(it)
+                except StopIteration:
+                    break
+                dt = time.perf_counter() - w0
+                waited += dt
+                _obs_metrics.EXCHANGE_WAIT.observe(dt, plane="worker")
+                yield b
+        finally:
+            self.tracer.record("exchange_wait", "exchange_wait", start,
+                               time.time(), parent_id=parent,
+                               fragment=fragment_id,
+                               wait_s=round(waited, 6))
 
     def _run(self):
         try:
             cfg = ExecConfig(**self.update.config)
-            ctx = ExecContext(self.catalog, cfg,
-                              memory_pool=self.memory_pool,
-                              spill_manager=self.spill_manager)
-            ctx.task_index = self.update.task_index
-            ctx.n_tasks = self.update.n_tasks
-            ctx.split_assignment = self.update.split_assignment
-            ctx.split_counts = self.update.split_counts
-            ctx.remote_sources = self._remote_source_factory
-            f = self.update.fragment
-            sink = self._make_sink(f)
-            stream = execute_node(f.root, ctx)
-            # fair time slicing applies to LEAF fragments only: a task
-            # with remote sources can block inside next() waiting for
-            # producer pages, and holding a run slot while blocked would
-            # deadlock the slot pool (the reference's splits yield when
-            # blocked; the exchange iterator cannot)
-            gated = (self.executor is not None
-                     and not f.remote_sources())
-            if gated:
-                lease = self.executor.register(self.task_id)
-                try:
-                    while True:
-                        with lease:
-                            try:
-                                batch = next(stream)
-                            except StopIteration:
-                                break
-                            sink(batch)
-                finally:
-                    self.executor.unregister(self.task_id)
+            if self.tracer.enabled:
+                from presto_tpu.obs import metrics as _obs_metrics
+
+                # created_at → first execution work = schedule delay
+                _obs_metrics.TASK_SCHEDULE_DELAY.observe(
+                    max(0.0, time.time() - self.created_at),
+                    plane="worker", node=self.node_id)
+                with _obs_trace.use(self.tracer), self.tracer.span(
+                        "task", "task", parent_id=self._trace_parent,
+                        task_id=self.task_id, node=self.node_id):
+                    self._run_inner(cfg)
             else:
-                for batch in stream:
-                    sink(batch)
-            if cfg.collect_stats:
-                names = {}
-
-                def walk(n):
-                    names[id(n)] = type(n).__name__
-                    for c in n.children():
-                        walk(c)
-
-                walk(f.root)
-                self.stats_report = [
-                    {"node": names.get(nid, "?"), **st}
-                    for nid, st in ctx.node_stats.items()
-                ] + [{"node": k, "rows": v, "batches": 0, "wall_s": 0.0}
-                     for k, v in ctx.stats.items()]
+                self._run_inner(cfg)
             self.buffer.set_no_more_pages()
             self.state = "finished"
             self.finished_at = time.time()
@@ -250,6 +258,72 @@ class TaskExecution:
         finally:
             for c in self._clients:
                 c.close()
+
+    def _run_inner(self, cfg: ExecConfig):
+        ctx = ExecContext(self.catalog, cfg,
+                          memory_pool=self.memory_pool,
+                          spill_manager=self.spill_manager)
+        ctx.tracer = self.tracer
+        ctx.task_index = self.update.task_index
+        ctx.n_tasks = self.update.n_tasks
+        ctx.split_assignment = self.update.split_assignment
+        ctx.split_counts = self.update.split_counts
+        ctx.remote_sources = self._remote_source_factory
+        f = self.update.fragment
+        sink = self._make_sink(f)
+        stream = execute_node(f.root, ctx)
+        # fair time slicing applies to LEAF fragments only: a task
+        # with remote sources can block inside next() waiting for
+        # producer pages, and holding a run slot while blocked would
+        # deadlock the slot pool (the reference's splits yield when
+        # blocked; the exchange iterator cannot)
+        gated = (self.executor is not None
+                 and not f.remote_sources())
+        if gated:
+            lease = self.executor.register(self.task_id)
+            try:
+                while True:
+                    with lease:
+                        try:
+                            batch = next(stream)
+                        except StopIteration:
+                            break
+                        sink(batch)
+            finally:
+                self.executor.unregister(self.task_id)
+        else:
+            for batch in stream:
+                sink(batch)
+        if cfg.collect_stats:
+            names = {}
+            jstats = {}
+
+            def walk(n):
+                names[id(n)] = type(n).__name__
+                js = getattr(n, "_jit_stats", None)
+                if js:
+                    jstats[id(n)] = js
+                for c in n.children():
+                    walk(c)
+
+            walk(f.root)
+            rows = []
+            for nid, st in ctx.node_stats.items():
+                row = {"node": names.get(nid, "?"), **st}
+                js = jstats.get(nid)
+                if js:
+                    # per-jit-key compile events, summed for the operator:
+                    # lets EXPLAIN ANALYZE split wall into compile vs
+                    # execute per node
+                    row["compiles"] = sum(v.get("compiles", 0)
+                                          for v in js.values())
+                    row["compile_wall_s"] = round(
+                        sum(v.get("compile_wall_s", 0.0)
+                            for v in js.values()), 6)
+                rows.append(row)
+            rows += [{"node": k, "rows": v, "batches": 0, "wall_s": 0.0}
+                     for k, v in ctx.stats.items()]
+            self.stats_report = rows
 
     def _make_sink(self, f: Fragment):
         if f.output_partitioning == OUT_HASH and self.update.n_out_partitions > 1:
@@ -321,11 +395,12 @@ class TaskManager:
     """SqlTaskManager analog: task registry keyed by task id."""
 
     def __init__(self, catalog: Catalog, memory_pool=None, spill_manager=None,
-                 run_slots: int = 4):
+                 run_slots: int = 4, node_id: str = ""):
         from presto_tpu.memory import MemoryPool
         from presto_tpu.spiller import SpillManager
 
         self.catalog = catalog
+        self.node_id = node_id
         self.memory_pool = memory_pool or MemoryPool(None)
         self.spill_manager = spill_manager or SpillManager()
         self.tasks: Dict[str, TaskExecution] = {}
@@ -360,14 +435,17 @@ class TaskManager:
             return {qid: qp.query_reserved
                     for qid, qp in self._query_pools.items()}
 
-    def update_task(self, task_id: str, update: TaskUpdate) -> dict:
+    def update_task(self, task_id: str, update: TaskUpdate,
+                    trace_token: Optional[str] = None) -> dict:
         with self._lock:
             t = self.tasks.get(task_id)
             if t is None:
                 t = TaskExecution(task_id, update, self.catalog,
                                   self._pool_for(task_id),
                                   self.spill_manager,
-                                  executor=self.executor)
+                                  executor=self.executor,
+                                  trace_token=trace_token,
+                                  node_id=self.node_id)
                 self.tasks[task_id] = t
             return t.info()
 
@@ -392,6 +470,7 @@ _RESULTS_RE = re.compile(r"^/v1/task/([^/]+)/results/(\d+)/(\d+)$")
 _ACK_RE = re.compile(r"^/v1/task/([^/]+)/results/(\d+)/(\d+)/ack$")
 _BUFFER_RE = re.compile(r"^/v1/task/([^/]+)/results/(\d+)$")
 _STATUS_RE = re.compile(r"^/v1/task/([^/]+)/status$")
+_TRACE_RE = re.compile(r"^/v1/task/([^/]+)/trace$")
 
 
 class Worker:
@@ -420,7 +499,8 @@ class Worker:
         self.spill_manager = SpillManager(spill_dir)
         self.task_manager = TaskManager(catalog, self.memory_pool,
                                         self.spill_manager,
-                                        run_slots=run_slots)
+                                        run_slots=run_slots,
+                                        node_id=node_id)
         self.node_state = "active"   # active | shutting_down | shut_down
         worker = self
 
@@ -467,7 +547,9 @@ class Worker:
                     except (CodecError, KeyError, TypeError, ValueError) as e:
                         return self._json({"error": f"bad task update: {e}"},
                                           400)
-                    info = worker.task_manager.update_task(m.group(1), update)
+                    info = worker.task_manager.update_task(
+                        m.group(1), update,
+                        trace_token=self.headers.get(_obs_trace.TRACE_HEADER))
                     return self._json(info)
                 self._json({"error": "not found"}, 404)
 
@@ -499,6 +581,12 @@ class Worker:
                     if t is None:
                         return self._json({"error": "no such task"}, 404)
                     return self._json(t.info())
+                m = _TRACE_RE.match(self.path)
+                if m:
+                    t = worker.task_manager.get(m.group(1))
+                    if t is None:
+                        return self._json({"error": "no such task"}, 404)
+                    return self._json(t.tracer.to_json())
                 if self.path == "/v1/info":
                     return self._json({
                         "nodeId": worker.node_id,

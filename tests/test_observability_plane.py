@@ -1,0 +1,419 @@
+"""Observability plane: span tracer + trace-token propagation across the
+in-process cluster, histogram metric families, exposition-format lint,
+and the slow-query event sink.
+
+Reference modules: airlift trace-token propagation, DistributionStat /
+TimeStat metrics export, the EventListener SPI's QueryCompletedEvent."""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from presto_tpu.catalog.memory import MemoryConnector
+from presto_tpu.connector import Catalog
+from presto_tpu.exec import ExecConfig
+from presto_tpu.obs import metrics as obs_metrics
+from presto_tpu.obs import trace as obs_trace
+from presto_tpu.obs.events import SlowQueryLogger
+from presto_tpu.obs.exposition import lint_exposition
+from presto_tpu.server.metrics import _fmt, render_metrics
+
+
+def _catalog():
+    conn = MemoryConnector()
+    rng = np.random.default_rng(7)
+    conn.add_table("t", pd.DataFrame({"k": np.arange(400) % 7,
+                                      "v": rng.normal(size=400)}))
+    cat = Catalog()
+    cat.register("m", conn, default=True)
+    return cat
+
+
+# -- metrics plane (unit) --------------------------------------------------
+
+
+class TestHistograms:
+    def test_log_buckets_shape(self):
+        b = obs_metrics.log_buckets(0.01, 600.0)
+        assert b == sorted(b)
+        assert len(b) == len(set(b))
+        assert b[0] == 0.01
+        assert all(x > 0 for x in b)
+        # last finite bound sits within one ratio step of hi (the +Inf
+        # bucket covers the tail)
+        assert b[-1] <= 600.0
+        assert b[-1] >= 600.0 / (10.0 ** (1.0 / 3.0)) * 0.99
+
+    def test_observe_render_and_plane_filter(self):
+        h = obs_metrics.Histogram("test_obs_hist_seconds", "unit-test family",
+                                  obs_metrics.log_buckets(0.001, 10.0))
+        for v in (0.002, 0.002, 5.0):
+            h.observe(v, plane="worker")
+        h.observe(0.1, plane="coordinator")
+        snap = h.snapshot("worker")
+        assert len(snap) == 1
+        (_, s), = snap.items()
+        assert s["count"] == 3
+        doc = "\n".join(h.render("worker")) + "\n"
+        assert lint_exposition(doc) == []
+        assert 'le="+Inf"' in doc
+        assert "test_obs_hist_seconds_count" in doc
+        # the coordinator observation never leaks into the worker plane
+        assert 'plane="coordinator"' not in doc
+
+    def test_empty_plane_renders_zeroed_family(self):
+        h = obs_metrics.Histogram("test_obs_empty_seconds", "x",
+                                  obs_metrics.log_buckets(0.001, 1.0))
+        doc = "\n".join(h.render("worker")) + "\n"
+        assert lint_exposition(doc) == []
+        assert 'test_obs_empty_seconds_count{plane="worker"} 0' in doc
+
+    def test_builtin_families_exist(self):
+        names = {h.name for h in obs_metrics.ALL_HISTOGRAMS}
+        assert len(names) >= 4
+        doc = obs_metrics.render_histograms("coordinator")
+        assert lint_exposition(doc) == []
+
+
+class TestExpositionFormat:
+    def test_label_escaping_roundtrip(self):
+        line = _fmt("m", 1, {"q": 'a"b\\c\nd'})
+        assert '\\"' in line and "\\\\" in line and "\\n" in line
+        doc = "# HELP m x\n# TYPE m gauge\n" + line + "\n"
+        assert lint_exposition(doc) == []
+
+    def test_render_metrics_types_and_headers_once(self):
+        doc = render_metrics([
+            ("m_total", "monotone", 3, None),
+            ("g", "by label", 1.5, {"a": "b"}),
+            ("g", "by label", 2.5, {"a": "c"}),
+            ("x", "explicit type wins", 7, None, "counter"),
+        ])
+        assert "# TYPE m_total counter" in doc
+        assert "# TYPE g gauge" in doc
+        assert doc.count("# TYPE g gauge") == 1
+        assert doc.count("# HELP g") == 1
+        assert "# TYPE x counter" in doc
+        assert lint_exposition(doc) == []
+
+    def test_lint_catches_duplicate_type(self):
+        errs = lint_exposition("# TYPE m gauge\n# TYPE m gauge\nm 1\n")
+        assert any("duplicate TYPE" in e for e in errs)
+
+    def test_lint_catches_type_after_samples(self):
+        errs = lint_exposition("# HELP m x\nm 1\n# TYPE m gauge\n")
+        assert any("after its samples" in e for e in errs)
+
+    def test_lint_catches_missing_type(self):
+        errs = lint_exposition("m 1\n")
+        assert any("no # TYPE" in e for e in errs)
+
+    def test_lint_catches_bad_escape(self):
+        errs = lint_exposition(
+            '# HELP m x\n# TYPE m gauge\nm{a="b\\x"} 1\n')
+        assert any("invalid escape" in e for e in errs)
+
+    def test_lint_catches_histogram_defects(self):
+        base = "# HELP h x\n# TYPE h histogram\n"
+        errs = lint_exposition(
+            base + 'h_bucket{le="1"} 1\nh_sum 1\nh_count 1\n')
+        assert any("+Inf" in e for e in errs)
+        errs = lint_exposition(
+            base + 'h_bucket{le="1"} 5\nh_bucket{le="2"} 3\n'
+            'h_bucket{le="+Inf"} 5\nh_sum 1\nh_count 5\n')
+        assert any("monotone" in e for e in errs)
+        errs = lint_exposition(
+            base + 'h_bucket{le="+Inf"} 2\nh_sum 1\nh_count 3\n')
+        assert any("_count" in e for e in errs)
+        errs = lint_exposition(base + "h 1\n")
+        assert any("invalid for histogram" in e for e in errs)
+
+    def test_lint_catches_non_numeric_value(self):
+        errs = lint_exposition("# HELP m x\n# TYPE m gauge\nm bogus\n")
+        assert any("non-numeric" in e for e in errs)
+
+    def test_cli(self, tmp_path):
+        from presto_tpu.obs import exposition
+
+        good = tmp_path / "good.prom"
+        good.write_text("# HELP m x\n# TYPE m gauge\nm 1\n")
+        assert exposition.main([str(good)]) == 0
+        bad = tmp_path / "bad.prom"
+        bad.write_text("m 1\n")
+        assert exposition.main([str(bad)]) == 1
+
+
+# -- tracer (unit) ---------------------------------------------------------
+
+
+class TestTracer:
+    def test_span_nesting_and_record_parenting(self):
+        tr = obs_trace.Tracer()
+        with tr.span("query", "query") as root:
+            assert tr.root_id == root.span_id
+            with tr.span("child", "operator") as ch:
+                assert ch.parent_id == root.span_id
+                sp = tr.record("compile", "compile", 1.0, 2.0)
+                assert sp.parent_id == ch.span_id
+        # spans append on close: inner-first
+        assert [s.name for s in tr.spans()] == ["compile", "child", "query"]
+        # off-stack records (producer threads) parent to the root
+        late = tr.record("late", "operator", 1.0, 2.0)
+        assert late.parent_id == tr.root_id
+
+    def test_token_roundtrip(self):
+        tr = obs_trace.Tracer(trace_id="t_x")
+        with tr.span("query", "query") as root:
+            tok = tr.token()
+            assert obs_trace.parse_token(tok) == ("t_x", root.span_id)
+        assert obs_trace.parse_token(
+            obs_trace.format_token("t", None)) == ("t", None)
+
+    def test_absorb_reparents_worker_dump(self):
+        coord = obs_trace.Tracer(trace_id="T")
+        with coord.span("query", "query"):
+            stage = coord.record("stage-0", "stage", 0.0, 1.0)
+        worker = obs_trace.Tracer(trace_id="T")
+        with worker.span("task", "task"):
+            worker.record("op", "operator", 0.0, 0.5)
+        dump = worker.to_json()
+        coord.absorb(dump["spans"], {dump["rootSpanId"]: stage.span_id})
+        by_id = {s.span_id: s for s in coord.spans()}
+        assert by_id[dump["rootSpanId"]].parent_id == stage.span_id
+        tree = obs_trace.build_tree(coord.spans())
+        assert len(tree) == 1  # one stitched root: the query span
+
+    def test_max_spans_drops_and_counts(self):
+        tr = obs_trace.Tracer(max_spans=2)
+        for i in range(3):
+            tr.record(f"s{i}", "operator", 0.0, 1.0)
+        assert len(tr.spans()) == 2
+        assert tr.dropped == 1
+        assert tr.to_json()["dropped"] == 1
+
+    def test_noop_tracer(self):
+        n = obs_trace.NOOP
+        assert n.enabled is False
+        with n.span("a", "b") as sp:
+            assert sp.duration_s == 0.0
+        assert n.record("a", "b", 0, 1).span_id is None
+        assert n.to_json()["spans"] == []
+        assert n.token() == ""
+
+    def test_thread_local_use(self):
+        tr = obs_trace.Tracer()
+        with obs_trace.use(tr):
+            assert obs_trace.current() is tr
+            with obs_trace.use(obs_trace.NOOP):
+                assert obs_trace.current() is obs_trace.NOOP
+            assert obs_trace.current() is tr
+        assert obs_trace.current() is obs_trace.NOOP
+
+    def test_registry_alias_get_latest_eviction(self):
+        reg = obs_trace.TraceRegistry(max_traces=2)
+        t1, t2, t3 = (obs_trace.Tracer() for _ in range(3))
+        reg.register(t1, "a1")
+        reg.register(t2)
+        reg.register(t3)  # evicts t1 and its alias
+        assert reg.get(t1.trace_id) is None
+        assert reg.get("a1") is None
+        assert reg.get(t2.trace_id) is t2
+        assert reg.latest() is t3
+        reg.alias("x", "never-registered")  # ignored, not an error
+        assert reg.get("x") is None
+        reg.alias("y", t3.trace_id)
+        assert reg.get("y") is t3
+
+
+# -- slow-query sink (unit) ------------------------------------------------
+
+
+def _qinfo(qid="q1", elapsed=1.0):
+    from presto_tpu.server.querymanager import QueryInfo
+
+    now = 1000.0
+    return QueryInfo(query_id=qid, sql="select 1", state="FINISHED",
+                     user="u", resource_group=None, create_time=now,
+                     end_time=now + elapsed)
+
+
+def test_slow_query_logger_threshold_and_topk(tmp_path):
+    p = str(tmp_path / "slow.jsonl")
+    lg = SlowQueryLogger(p, threshold_s=0.5, top_k=2)
+    lg.log(_qinfo(elapsed=0.1))  # below threshold: not logged
+    spans = [obs_trace.Span(f"s{i}", None, f"op{i}", "operator",
+                            0.0, float(i))
+             for i in range(1, 5)]
+    lg.log(_qinfo(qid="q2", elapsed=2.0), spans)
+    with open(p) as fh:
+        recs = [json.loads(line) for line in fh]
+    assert len(recs) == 1
+    rec = recs[0]
+    assert rec["event"] == "queryCompleted"
+    assert rec["queryId"] == "q2"
+    assert rec["elapsedS"] == 2.0
+    # top-k most expensive spans, most expensive first
+    assert [t["name"] for t in rec["topSpans"]] == ["op4", "op3"]
+
+
+# -- cluster integration ---------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    from presto_tpu.server.coordinator import DistributedRunner
+
+    with DistributedRunner(_catalog(), n_workers=2) as dr:
+        yield dr
+
+
+class TestClusterTracing:
+    def test_trace_token_propagation_and_stitching(self, cluster):
+        coord = cluster.coordinator
+        session = coord.protocol.session_from_headers({})
+        qe = coord.query_manager.create_query(
+            session, "select k, sum(v) as s from t group by k")
+        assert qe.wait(60)
+        assert qe.state == "FINISHED", qe.error
+        with urllib.request.urlopen(
+                f"{coord.url}/v1/query/{qe.query_id}/trace", timeout=10) as r:
+            doc = json.loads(r.read())
+        assert doc["traceId"] == qe.query_id
+        spans = doc["spans"]
+        by_kind = {}
+        for s in spans:
+            by_kind.setdefault(s["kind"], []).append(s)
+        # worker task spans traveled back over the token header and got
+        # stitched under synthesized stage spans under the query root
+        assert "query" in by_kind and "stage" in by_kind \
+            and "task" in by_kind
+        root = next(s for s in spans if s["spanId"] == doc["rootSpanId"])
+        assert root["name"] == "query"
+        stage_ids = {s["spanId"] for s in by_kind["stage"]}
+        for st in by_kind["stage"]:
+            assert st["parentId"] == doc["rootSpanId"]
+        for t in by_kind["task"]:
+            assert t["parentId"] in stage_ids
+            assert (t.get("attrs") or {}).get("node", "").startswith(
+                "worker-")
+        # the root span covers >= 95% of the whole trace envelope
+        starts = [s["start"] for s in spans]
+        ends = [s["end"] for s in spans if s["end"] is not None]
+        envelope = max(ends) - min(starts)
+        assert envelope >= 0.0
+        assert root["durationS"] >= 0.95 * envelope
+        # one nested tree rooted at the query span
+        assert len(doc["tree"]) == 1
+        assert doc["tree"][0]["spanId"] == doc["rootSpanId"]
+
+    def test_statement_results_carry_trace_uri(self, cluster):
+        req = urllib.request.Request(
+            f"{cluster.coordinator.url}/v1/statement",
+            data=b"select 1 as x", method="POST")
+        with urllib.request.urlopen(req, timeout=30) as r:
+            out = json.loads(r.read())
+        assert "traceUri" in out
+        assert "/trace" in out["traceUri"]
+
+    def test_explain_analyze_compile_execute_split(self, cluster):
+        out = cluster.coordinator.explain_analyze_distributed(
+            "select k, avg(v) as a, max(v) as mx from t "
+            "group by k having max(v) > -1e9")
+        assert "-- task execution profile --" in out
+        assert "wall=" in out
+        # a first execution jit-compiles at least one node: the profile
+        # splits per-operator wall into compile vs execute
+        assert "compile=" in out and "execute=" in out
+
+    def test_tracing_disabled_is_noop(self, cluster):
+        import dataclasses as dc
+
+        coord = cluster.coordinator
+        before = coord.trace_registry.latest()
+        cfg = dc.replace(cluster.config, tracing=False)
+        coord.run_batch("select min(v) as x from t", cfg)
+        assert coord.trace_registry.latest() is before
+
+    def test_metrics_exposition_lint_both_planes(self, cluster):
+        cluster.run("select count(*) as n from t")  # ensure observations
+        urls = ([("coordinator", cluster.coordinator.url)]
+                + [(w.node_id, w.url) for w in cluster.workers])
+        for name, u in urls:
+            with urllib.request.urlopen(f"{u}/v1/metrics", timeout=10) as r:
+                body = r.read().decode()
+            assert lint_exposition(body) == [], (name, lint_exposition(body))
+            hist_fams = [line for line in body.splitlines()
+                         if line.startswith("# TYPE")
+                         and line.endswith(" histogram")]
+            assert len(hist_fams) >= 4, name
+
+    def test_ui_query_drilldown_page(self, cluster):
+        coord = cluster.coordinator
+        session = coord.protocol.session_from_headers({})
+        qe = coord.query_manager.create_query(
+            session, "select max(v) as mx from t")
+        assert qe.wait(60)
+        with urllib.request.urlopen(
+                f"{coord.url}/ui/query/{qe.query_id}", timeout=10) as r:
+            html = r.read().decode()
+        assert qe.query_id in html
+        assert "query" in html  # root span row renders
+        # unknown query id 404s
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(f"{coord.url}/ui/query/nope", timeout=10)
+        assert ei.value.code == 404
+
+
+def test_slow_query_log_end_to_end(tmp_path):
+    from presto_tpu.server.coordinator import Coordinator
+    from presto_tpu.server.worker import Worker
+
+    log = str(tmp_path / "slow.jsonl")
+    cat = _catalog()
+    coord = Coordinator(cat, min_workers=1, slow_query_log=log)
+    w = Worker(cat, node_id="w0", coordinator_url=coord.url)
+    try:
+        deadline = time.time() + 10
+        while time.time() < deadline and not coord.node_manager.active_nodes():
+            time.sleep(0.05)
+        qe = coord.query_manager.create_query(
+            coord.protocol.session_from_headers({}),
+            "select sum(v) as s from t")
+        assert qe.wait(60)
+        assert qe.state == "FINISHED", qe.error
+        with open(log) as fh:
+            recs = [json.loads(line) for line in fh]
+        assert recs
+        rec = recs[-1]
+        assert rec["queryId"] == qe.query_id
+        assert rec["state"] == "FINISHED"
+        # the trace's top spans ride along inline
+        assert rec["topSpans"]
+        assert all("durationS" in t for t in rec["topSpans"])
+    finally:
+        w.close()
+        coord.close()
+
+
+def test_local_runner_trace_and_disable():
+    from presto_tpu.exec.runner import LocalRunner
+
+    cat = _catalog()
+    r = LocalRunner(cat)
+    r.run("select k, sum(v) as s from t group by k")
+    tr = r.last_trace
+    assert tr is not None
+    kinds = {s.kind for s in tr.spans()}
+    assert "query" in kinds
+    assert "operator" in kinds
+    root = next(s for s in tr.spans() if s.span_id == tr.root_id)
+    assert root.name == "query"
+    # tracing off: NOOP end to end, nothing recorded
+    r2 = LocalRunner(cat, ExecConfig(tracing=False))
+    r2.run("select count(*) as n from t")
+    assert r2.last_trace is None

@@ -165,12 +165,14 @@ class TestHttpSurface:
         assert 'presto_tpu_worker_tasks{node="w0"}' in wbody
         assert "presto_tpu_worker_memory_reserved_bytes" in wbody
         # selective-scan counters are always exposed (0 until a
-        # constrained scan runs) on BOTH planes
+        # constrained scan runs) on BOTH planes, with a plane label so a
+        # shared-process deployment never double-counts them
         for fam in ("presto_tpu_scan_splits_pruned_total",
                     "presto_tpu_scan_rows_predecode_filtered_total",
                     "presto_tpu_scan_bytes_skipped_total"):
-            assert fam in body, fam
-            assert f'{fam}{{node="w0"}}' in wbody, fam
+            assert f'{fam}{{plane="coordinator"}}' in body, fam
+            assert f"# TYPE {fam} counter" in body, fam
+            assert f'{fam}{{node="w0",plane="worker"}}' in wbody, fam
 
     def test_ui_page(self, cluster):
         coord, _ = cluster
