@@ -95,6 +95,18 @@ group by l_orderkey, o_orderdate, o_shippriority
 order by revenue desc, o_orderdate limit 10
 """
 
+# q3-shaped probe/build microbench: the lineitem→orders join + group-by
+# that dominates q3, without the customer dimension — isolates the
+# pipeline-breaker cost the radix partitioning targets
+JOIN_SF1 = """
+select o_orderpriority, count(*) as c,
+       sum(l_extendedprice * (1 - l_discount)) as revenue
+from lineitem join orders on l_orderkey = o_orderkey
+where o_orderdate < date '1995-03-15' and l_shipdate > date '1995-03-15'
+group by o_orderpriority
+order by o_orderpriority
+"""
+
 Q9 = """
 select nation, o_year, sum(amount) as sum_profit
 from (
@@ -163,6 +175,8 @@ _CONFIGS = {
     "q1_sf1": (Q1, "tpch", 1.0, "lineitem", {}),
     "q6_sf10": (Q6, "tpch", 10.0, "lineitem", {}),
     "q3_sf10": (Q3, "tpch", 10.0, "lineitem", {}),
+    "join_sf1": (JOIN_SF1, "tpch", 1.0, "lineitem",
+                 {"radix_partitions": 8}),
     "q9": (Q9, "tpch", None, "lineitem", {"runs": 2}),
     "q64": (Q64, "tpcds", None, "store_sales",
             {"agg_capacity": 1 << 16, "runs": 2}),
@@ -172,7 +186,7 @@ _CONFIGS = {
 _ALIASES = {"q9_sf100": "q9", "q64_sf100": "q64"}
 
 # Per-config wall caps (seconds): one slow compile can only burn this much.
-_CAPS = {"q1_sf1": 420, "q6_sf10": 420, "q3_sf10": 600,
+_CAPS = {"q1_sf1": 420, "q6_sf10": 420, "q3_sf10": 600, "join_sf1": 420,
          "q9": 900, "q64": 900}
 
 
@@ -352,7 +366,7 @@ def main():
     sf_over = {"q9": float(os.environ.get("BENCH_SF_Q9", "100")),
                "q64": float(os.environ.get("BENCH_SF_Q64", "100"))}
     wanted = os.environ.get(
-        "BENCH_CONFIGS", "q1_sf1,q6_sf10,q3_sf10,q9,q64"
+        "BENCH_CONFIGS", "q1_sf1,q6_sf10,q3_sf10,join_sf1,q9,q64"
     ).split(",")
 
     for name in (w.strip() for w in wanted):

@@ -56,6 +56,42 @@ if [ "$rc" -ne 0 ]; then
   exit "$rc"
 fi
 
+# Radix-partitioned join smoke: the partitioned breakers (including the
+# forced hybrid-spill path) must return exactly the unpartitioned result.
+echo "== radix smoke: partitioned join/group-by equals unpartitioned =="
+env JAX_PLATFORMS=cpu python - <<'PYEOF'
+import numpy as np
+import pandas as pd
+
+from presto_tpu.catalog.memory import MemoryConnector
+from presto_tpu.connector import Catalog
+from presto_tpu.exec import ExecConfig, LocalRunner
+
+rng = np.random.default_rng(0)
+conn = MemoryConnector()
+conn.add_table("b", pd.DataFrame({"id": rng.integers(0, 300, 500),
+                                  "tag": rng.integers(0, 9, 500)}))
+conn.add_table("p", pd.DataFrame({"fk": rng.integers(0, 400, 3000),
+                                  "v": rng.normal(size=3000)}))
+cat = Catalog()
+cat.register("m", conn, default=True)
+sql = ("select p.fk, count(*) as c, sum(p.v) as s, max(b.tag) as t "
+       "from p join b on p.fk = b.id group by p.fk order by p.fk")
+exp = LocalRunner(cat, ExecConfig()).run(sql)
+for kw in ({"radix_partitions": 4},
+           {"radix_partitions": 4, "join_spill_budget_bytes": 1}):
+    got = LocalRunner(cat, ExecConfig(**kw)).run(sql)
+    pd.testing.assert_frame_equal(got.reset_index(drop=True),
+                                  exp.reset_index(drop=True),
+                                  check_dtype=False)
+    print(f"radix smoke OK {kw}")
+PYEOF
+rc=$?
+if [ "$rc" -ne 0 ]; then
+  echo "radix smoke FAILED (exit $rc)"
+  exit "$rc"
+fi
+
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
   --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly \

@@ -164,6 +164,19 @@ class ExecConfig:
     # placement stability into real scan locality. Off → static
     # task_index::n_tasks striding.
     split_affinity: bool = True
+    # within-worker radix partitioning for pipeline breakers (ops/radix.py):
+    # joins and keyed aggregations split both sides by the top bits of the
+    # content hash and run each partition's build/probe (or group merge) at
+    # a small bounded capacity — the same handful of compiled shapes
+    # regardless of input size. Must be a power of two; 0/1 = off (the
+    # classic single-table path).
+    radix_partitions: int = 0
+    # hybrid spill: a radix partition whose build side exceeds this byte
+    # budget serializes its batches to host spill (serde page format) and is
+    # processed after the in-memory partitions. None = never (partitions
+    # stay resident); the reference analog is the dynamic hybrid hash
+    # join's per-partition memory budget.
+    join_spill_budget_bytes: Optional[int] = None
 
 
 def _node_jit(node: PlanNode, key: str, builder, **jit_kwargs):
@@ -1864,6 +1877,160 @@ def _execute_aggregate(node: Aggregate, ctx: ExecContext) -> Iterator[Batch]:
             yield jit_rows(b)
         return
 
+    # Radix only pays when the group table is genuinely large: when the
+    # CBO presize fits the base capacity the accumulator already has one
+    # small bounded shape, and splitting every input batch by group key
+    # would be pure overhead. A spill budget engages it regardless —
+    # bounding device residency is the point then, not shapes.
+    if (key_syms and ctx.config.radix_partitions > 1
+            and (ctx.config.join_spill_budget_bytes is not None
+                 or cap > ctx.config.agg_capacity)):
+        # Radix-partitioned group-by (ops/radix.py): chained input splits
+        # by the top hash bits, each partition merges into its OWN small
+        # accumulator with the prechained step closures — P bounded group
+        # tables instead of one query-size-dependent one. Per input batch,
+        # every partition's merge dispatches before any confirms, so the
+        # growth-check sync overlaps the other partitions' device work
+        # (the full optimistic window would pin P×depth checkpoints of
+        # device state for little extra gain). Partitions whose accumulator
+        # exceeds join_spill_budget_bytes hybrid-spill: the confirmed
+        # state pages plus all later raw sub-batches go to host files and
+        # replay one-at-a-time at the end.
+        import os as _os
+
+        from presto_tpu.memory import batch_device_bytes as _bdb
+        from presto_tpu.obs import metrics as _obs_metrics
+        from presto_tpu.scan import metrics as _scan_metrics
+        from presto_tpu.spiller import SpillFile
+
+        P = ctx.config.radix_partitions
+        budget = ctx.config.join_spill_budget_bytes
+        split = _radix_splitter(node, ctx, key_syms, P, "agg_")
+        jit_accstep0 = _node_jit(
+            node, "accstep0",
+            lambda: (lambda b, c: acc_merge_step(None, b, c)),
+            static_argnums=(1,))
+        # CBO pre-sizing applies per partition: each holds ~1/P of the
+        # estimated groups, and the pow2 ladder steps are shared across
+        # partitions so one compile serves all P
+        start_cap = max(ctx.config.agg_capacity,
+                        round_up_capacity(max(cap // P, 1)))
+        caps = [start_cap] * P
+        accs: List[Optional[Batch]] = [None] * P
+        rrows = [0] * P
+        afiles: Dict[int, SpillFile] = {}  # spilled accumulator state pages
+        rfiles: Dict[int, SpillFile] = {}  # spilled raw (chained) input
+
+        def _stat(key, delta):
+            ctx.stats[key] = ctx.stats.get(key, 0) + delta
+
+        _stat("radix.agg_engaged", 1)
+
+        def merge_into(p, sub, step_fn, step0_fn, first=None):
+            for attempt in range(ctx.config.max_growth_retries):
+                if first is not None and attempt == 0:
+                    out, ng = first
+                elif accs[p] is None:
+                    out, ng = step0_fn(sub, caps[p])
+                else:
+                    out, ng = step_fn(accs[p], sub, caps[p])
+                n2 = int(ng)
+                if n2 <= caps[p]:
+                    accs[p] = out
+                    return
+                # acc unchanged on overflow: retry same inputs bigger
+                caps[p] = round_up_capacity(n2)
+            raise RuntimeError("aggregate capacity growth exceeded retries")
+
+        def _emit(acc):
+            if node.step == "partial":
+                return acc
+            return _finalize_aggregate(node, acc, layout, key_syms,
+                                       key_types, state_types, in_types)
+
+        try:
+            for raw_b in in_stream:
+                rid = _radix_tag(raw_b, P, key_syms)
+                if rid is not None:
+                    ub = jit_chain(_untag_batch(raw_b))
+                    # num_live stays a device scalar — summed lazily so the
+                    # aligned fast path adds no sync of its own
+                    subs = [(rid, ub, ub.num_live())]
+                    _stat("radix.aligned_batches", 1)
+                    _scan_metrics.record("radix_aligned_batches", 1)
+                else:
+                    subs = split(jit_chain(_untag_batch(raw_b)))
+                pend = []
+                for p, sub, n in subs:
+                    rrows[p] = rrows[p] + n
+                    if p in rfiles:
+                        rfiles[p].append(sub)
+                        continue
+                    # dispatch wave: split() yields each partition at most
+                    # once per batch, so all merges are independent
+                    if accs[p] is None:
+                        first = jit_step0_raw(sub, caps[p])
+                    else:
+                        first = jit_step_raw(accs[p], sub, caps[p])
+                    pend.append((p, sub, first))
+                for p, sub, first in pend:
+                    merge_into(p, sub, jit_step_raw, jit_step0_raw, first)
+                    if budget is not None and _bdb(accs[p]) > budget:
+                        af = SpillFile(_os.path.join(
+                            ctx.spill_manager.dir,
+                            f"radix-agg-acc-p{p}-{id(node)}.bin"))
+                        af.append(accs[p])
+                        afiles[p] = af
+                        rfiles[p] = SpillFile(_os.path.join(
+                            ctx.spill_manager.dir,
+                            f"radix-agg-raw-p{p}-{id(node)}.bin"))
+                        accs[p] = None
+                        caps[p] = start_cap
+                        _stat("radix.partitions_spilled", 1)
+                        _scan_metrics.record("radix_partitions_spilled", 1)
+            rrows = [int(r) for r in rrows]
+            for p in range(P):
+                if rrows[p]:
+                    _obs_metrics.RADIX_PARTITION_ROWS.observe(
+                        rrows[p], plane="worker", side="group")
+                if p in rfiles or accs[p] is None:
+                    continue
+                yield _emit(accs[p])
+                accs[p] = None
+            # hybrid-spilled partitions, one resident at a time
+            for p in sorted(rfiles):
+                t0 = time.time()
+                accs[p] = None
+                caps[p] = start_cap
+                for sub in rfiles[p].read():
+                    merge_into(p, sub, jit_step_raw, jit_step0_raw)
+                for sub in afiles[p].read():
+                    merge_into(p, sub, jit_accstep, jit_accstep0)
+                if ctx.tracer.enabled:
+                    ctx.tracer.record("radix_spill_replay",
+                                      "radix_spill_replay", t0, time.time(),
+                                      partition=p, rows=rrows[p])
+                if accs[p] is not None:
+                    yield _emit(accs[p])
+                    accs[p] = None
+        finally:
+            spilled = (sum(f.bytes for f in afiles.values())
+                       + sum(f.bytes for f in rfiles.values()))
+            if spilled:
+                _stat("radix.spill_bytes", spilled)
+                _scan_metrics.record("radix_spill_bytes", spilled)
+                ctx.spill_manager.record(spilled)
+            for f in afiles.values():
+                f.close()
+            for f in rfiles.values():
+                f.close()
+        return
+
+    # An aligned exchange may still stamp pages with radix tags (the sink
+    # can't see the CBO gate above) — strip them before anything jits.
+    if ctx.config.radix_partitions > 1:
+        in_stream = (_untag_batch(b) for b in in_stream)
+
     state = {"acc": None, "spiller": None, "raw_spiller": None,
              "revoke_requested": False}
     mctx = LocalMemoryContext(ctx.memory_pool, "aggregate")
@@ -2333,6 +2500,259 @@ def _collect_concat(stream: Iterator[Batch]) -> Optional[Batch]:
     return _JIT_CAT(_unify_batch_dicts(batches))
 
 
+# ---------------------------------------------------------------------------
+# radix-partitioned breakers (ops/radix.py drivers)
+
+
+def _radix_tag(b: Batch, num_partitions: int, key_names) -> Optional[int]:
+    """Radix id if `b` arrived partition-aligned from an OUT_HASH sink with
+    a compatible decomposition (same partition count, same key symbols),
+    else None — the consumer then re-partitions on device as usual."""
+    tag = getattr(b, "radix", None)
+    if tag is None:
+        return None
+    r, total, keys = tag
+    if int(total) == num_partitions and tuple(keys) == tuple(key_names):
+        return int(r)
+    return None
+
+
+def _untag_batch(b: Batch) -> Batch:
+    """Plain Batch from a (possibly) tagged one. Tagged batches are a
+    serde-level subclass that is NOT pytree-registered — they must never
+    reach a jitted function."""
+    if type(b) is Batch:
+        return b
+    return Batch(b.names, b.types, b.columns, b.live, b.dicts)
+
+
+def _radix_splitter(node: PlanNode, ctx: ExecContext, key_names, P: int,
+                    jkey: str):
+    """Per-node split driver: batch → iterator of (partition, sub-batch,
+    live rows). One jitted stable sort by radix id per input capacity, a
+    P-element count transfer to the host, then one jitted window gather
+    per occupied partition — shapes keyed only by (capacity, pow2 bucket).
+    """
+    from presto_tpu.ops.radix import radix_perm, radix_window_perm
+
+    keys = tuple(key_names)
+    jsort = _node_jit(node, jkey + "radix_perm",
+                      lambda: (lambda b: radix_perm(b, keys, P)))
+    jwin = _node_jit(node, jkey + "radix_window", lambda: radix_window_perm,
+                     static_argnames=("bucket",))
+    tr = ctx.tracer
+
+    def split(b: Batch):
+        t0 = time.time()
+        sperm, counts = jsort(b)
+        cnts = np.asarray(counts)  # the host-side slicing boundary
+        starts = np.concatenate([[0], np.cumsum(cnts)])
+        if tr.enabled:
+            tr.record("radix_split", "radix_split", t0, time.time(),
+                      partitions=int((cnts > 0).sum()), rows=int(cnts.sum()))
+        for p in range(P):
+            n = int(cnts[p])
+            if n == 0:
+                continue
+            bucket = round_up_capacity(n)
+            yield p, jwin(b, sperm, np.int32(starts[p]), np.int32(n),
+                          bucket=bucket), n
+
+    return split
+
+
+def _host_concat(batches: List[Batch]) -> Optional[Batch]:
+    """Live rows of many fixed-capacity batches packed into ONE batch of
+    pow2 capacity, assembled on the host. The radix join uses this to turn
+    a partition's sub-batch list into its build input: a device-side
+    concat would compile one program per (cap_1..cap_k) combination —
+    exactly the shape storm radix exists to avoid — while the host pays
+    one round trip on the (smaller) build side."""
+    batches = [b for b in batches if b is not None]
+    if not batches:
+        return None
+    batches = _unify_batch_dicts(batches)
+    first = batches[0]
+    sel = [np.flatnonzero(np.asarray(b.live)) for b in batches]
+    total = int(sum(len(s) for s in sel))
+    cap = round_up_capacity(total)
+
+    def stack(planes, fill, width=None):
+        """Concatenate the live rows of one plane across batches; `fill`
+        synthesizes it for batches where it is None (same defaults as
+        concat_columns); 2D planes align on `width`."""
+        if all(p is None for p in planes):
+            return None
+        parts = []
+        for p, s in zip(planes, sel):
+            a = fill(len(s)) if p is None else np.asarray(p)[s]
+            if width is not None and a.ndim == 2 and a.shape[1] < width:
+                a = np.concatenate(
+                    [a, np.zeros((a.shape[0], width - a.shape[1]), a.dtype)],
+                    axis=1)
+            parts.append(a)
+        out = np.concatenate(parts, axis=0)
+        pad = np.zeros((cap - total,) + out.shape[1:], out.dtype)
+        return jnp.asarray(np.concatenate([out, pad], axis=0))
+
+    cols = []
+    for i in range(len(first.names)):
+        cs = [b.columns[i] for b in batches]
+        twod = any(c.values.ndim == 2 for c in cs)
+        w = max(c.values.shape[1] for c in cs) if twod else None
+        vals = stack([c.values for c in cs], None, w)
+        valid = stack([c.validity for c in cs],
+                      lambda n: np.ones(n, bool))
+        hi = stack([c.hi for c in cs], lambda n: np.zeros(n, np.int64))
+        sizes = stack([c.sizes for c in cs], lambda n: np.zeros(n, np.int32))
+        evalid = stack([c.evalid for c in cs],
+                       lambda n: np.ones((n, w), bool), w)
+        kd = next((np.asarray(c.keys).dtype for c in cs
+                   if c.keys is not None), None)
+        keys = stack([c.keys for c in cs],
+                     lambda n: np.zeros((n, w), kd), w)
+        cols.append(Column(vals, valid, hi, sizes, evalid, keys))
+    live = np.zeros(cap, bool)
+    live[:total] = True
+    dicts = {}
+    for b in batches:
+        dicts.update(b.dicts)
+    return Batch(first.names, first.types, cols, jnp.asarray(live), dicts)
+
+
+def _radix_join(node: HashJoin, ctx: ExecContext,
+                probe_stream: Iterator[Batch],
+                build_stream: Iterator[Batch], chain) -> Iterator[Batch]:
+    """Radix-partitioned hash join: both sides split by the top bits of
+    the content hash (ops/radix.py), each partition built + probed at a
+    small bounded capacity by its own _JoinProber. Partitions whose build
+    side exceeds `join_spill_budget_bytes` hybrid-spill: their batches go
+    to host spill files (serde page format) and are joined one-at-a-time
+    after the in-memory partitions, so an oversized build degrades to disk
+    instead of recompiling at ever-larger capacities."""
+    import os
+
+    from presto_tpu.memory import batch_device_bytes
+    from presto_tpu.obs import metrics as _obs_metrics
+    from presto_tpu.scan import metrics as _scan_metrics
+    from presto_tpu.spiller import SpillFile
+
+    cfg = ctx.config
+    P = cfg.radix_partitions
+    budget = cfg.join_spill_budget_bytes
+    tr = ctx.tracer
+    split_b = _radix_splitter(node, ctx, node.right_keys, P, "radixb_")
+    split_p = _radix_splitter(node, ctx, node.left_keys, P, "radixp_")
+
+    def _stat(key, delta):
+        ctx.stats[key] = ctx.stats.get(key, 0) + delta
+
+    def _spill_path(tag, p):
+        return os.path.join(ctx.spill_manager.dir,
+                            f"radix-{tag}-p{p}-{id(node)}.bin")
+
+    parts: List[List[Batch]] = [[] for _ in range(P)]
+    pbytes = [0] * P
+    prows = [0] * P
+    bfiles: Dict[int, "SpillFile"] = {}
+    pfiles: Dict[int, "SpillFile"] = {}
+    try:
+        for b in build_stream:
+            rid = _radix_tag(b, P, node.right_keys)
+            if rid is not None:
+                ub = _untag_batch(b)
+                # num_live stays a device scalar — summed lazily so the
+                # aligned fast path adds no per-page sync
+                subs = [(rid, ub, ub.num_live())]
+                _stat("radix.aligned_batches", 1)
+                _scan_metrics.record("radix_aligned_batches", 1)
+            else:
+                subs = split_b(_untag_batch(b))
+            for p, sub, n in subs:
+                prows[p] = prows[p] + n
+                if p in bfiles:
+                    bfiles[p].append(sub)
+                    continue
+                parts[p].append(sub)
+                pbytes[p] += batch_device_bytes(sub)
+                if budget is not None and pbytes[p] > budget:
+                    f = SpillFile(_spill_path("join-build", p))
+                    for bb in parts[p]:
+                        f.append(bb)
+                    parts[p] = []
+                    pbytes[p] = 0
+                    bfiles[p] = f
+                    _stat("radix.partitions_spilled", 1)
+                    _scan_metrics.record("radix_partitions_spilled", 1)
+        prows = [int(r) for r in prows]
+        for p in range(P):
+            if prows[p]:
+                _obs_metrics.RADIX_PARTITION_ROWS.observe(
+                    prows[p], plane="worker", side="build")
+
+        ident = lambda bb: bb  # noqa: E731 — chain applied before the split
+        probers: Dict[int, _JoinProber] = {}
+        for p in range(P):
+            if p in bfiles:
+                continue
+            build_in = _host_concat(parts[p])
+            parts[p] = []
+            probers[p] = _JoinProber(node, ctx, build_in, ident,
+                                     jkey="radix_", fanout_scan=16)
+
+        jchain = _node_jit(node, "radix_pchain", lambda: chain)
+        for raw in probe_stream:
+            rid = _radix_tag(raw, P, node.left_keys)
+            if rid is not None:
+                _stat("radix.aligned_batches", 1)
+                _scan_metrics.record("radix_aligned_batches", 1)
+                subs = [(rid, jchain(_untag_batch(raw)), 0)]
+            else:
+                subs = split_p(jchain(_untag_batch(raw)))
+            # dispatch wave: start every partition of this batch before
+            # syncing any, so the P per-partition count round trips to the
+            # host overlap instead of serializing
+            pend = []
+            for p, sub, _n in subs:
+                if p in bfiles:
+                    f = pfiles.get(p)
+                    if f is None:
+                        f = pfiles[p] = SpillFile(_spill_path("join-probe", p))
+                    f.append(sub)
+                else:
+                    pend.append((p, probers[p].probe_start(sub)))
+            for p, st in pend:
+                yield from probers[p].probe_finish(st)
+        for p in sorted(probers):
+            yield from probers[p].tail()
+
+        # hybrid-spilled partitions, one resident at a time
+        for p in sorted(bfiles):
+            t0 = time.time()
+            build_in = _host_concat(list(bfiles[p].read()))
+            prober = _JoinProber(node, ctx, build_in, ident,
+                                 jkey="radix_", fanout_scan=16)
+            pf = pfiles.get(p)
+            if pf is not None:
+                for sub in pf.read():
+                    yield from prober.probe_batch(sub)
+            yield from prober.tail()
+            if tr.enabled:
+                tr.record("radix_spill_replay", "radix_spill_replay", t0,
+                          time.time(), partition=p, rows=prows[p])
+    finally:
+        spilled = (sum(f.bytes for f in bfiles.values())
+                   + sum(f.bytes for f in pfiles.values()))
+        if spilled:
+            _stat("radix.spill_bytes", spilled)
+            _scan_metrics.record("radix_spill_bytes", spilled)
+            ctx.spill_manager.record(spilled)
+        for f in bfiles.values():
+            f.close()
+        for f in pfiles.values():
+            f.close()
+
+
 def _execute_join(node: HashJoin, ctx: ExecContext) -> Iterator[Batch]:
     from presto_tpu.memory import LocalMemoryContext, batch_device_bytes
 
@@ -2354,6 +2774,10 @@ def _execute_join(node: HashJoin, ctx: ExecContext) -> Iterator[Batch]:
 
     probe_stream, chain = _fused_child(node.left, ctx)
     build_stream = execute_node(node.right, ctx)
+
+    if ctx.config.radix_partitions > 1:
+        yield from _radix_join(node, ctx, probe_stream, build_stream, chain)
+        return
 
     # Collect the build side with memory accounting; crossing the revoke
     # threshold switches to the partitioned-spill path (HashBuilderOperator's
@@ -2484,165 +2908,232 @@ def _execute_index_join(node, ctx: ExecContext) -> Iterator[Batch]:
                                jkey="index_")
 
 
-def _join_probe(node: HashJoin, ctx: ExecContext, build_in: Optional[Batch],
-                probe_stream: Iterator[Batch], chain,
-                jkey: str = "") -> Iterator[Batch]:
-    # jkey prefixes the per-node jit-cache keys: the spilled path probes with
-    # an identity chain and must not reuse closures compiled with the real one
-    lsyms = [n for n, _ in node.left.output]
-    rsyms = [n for n, _ in node.right.output]
+class _JoinProber:
+    """One build table, probed incrementally.
 
-    if build_in is None:
-        if node.kind == "inner":
+    The body of the classic `_join_probe` split into (construct,
+    probe_batch, tail) so the radix driver can hold P probers at once and
+    feed each its per-partition probe sub-batches as they arrive — a
+    probe stream can only be consumed once, so probing cannot restart per
+    partition. `probe_batch` yields the matches for one probe batch
+    (LEFT/FULL null-extension included); `tail` yields the FULL OUTER
+    build remainder.
+    """
+
+    def __init__(self, node: HashJoin, ctx: ExecContext,
+                 build_in: Optional[Batch], chain, jkey: str = "",
+                 fanout_scan: int = 8):
+        # jkey prefixes the per-node jit-cache keys: the spilled/radix paths
+        # probe with an identity chain and must not reuse closures compiled
+        # with the real one
+        self.node, self.ctx = node, ctx
+        lsyms = self.lsyms = [n for n, _ in node.left.output]
+        rsyms = self.rsyms = [n for n, _ in node.right.output]
+        self.overflow_rows = 0
+        self.empty = build_in is None and node.kind == "inner"
+        if self.empty:
             return  # empty build side: no output
-        build_in = Batch(
-            rsyms,
-            [t for _, t in node.right.output],
-            [Column(jnp.zeros(128, t.dtype), None) for _, t in node.right.output],
-            jnp.zeros(128, bool),
-            {},
+        if build_in is None:
+            build_in = Batch(
+                rsyms,
+                [t for _, t in node.right.output],
+                [Column(jnp.zeros(128, t.dtype), None) for _, t in node.right.output],
+                jnp.zeros(128, bool),
+                {},
+            )
+
+        table = _node_jit(node, "build", lambda: build_side, static_argnames=("key_names",))(
+            build_in, tuple(node.right_keys)
+        )
+        self.table = table
+
+        self.want_full = node.kind == "full"
+        build_cap = int(table.hashes.shape[0])
+        self.bm = jnp.zeros(build_cap, bool) if self.want_full else None
+
+        def build_remainder_fn(t: BuildTable, bm):
+            """FULL OUTER tail: build rows no probe row matched, with NULL
+            probe columns (reference: LookupJoinOperators.fullOuterJoin's
+            lookup-outer positions pass)."""
+            ltypes = dict(node.left.output)
+            names, types, cols = [], [], []
+            cap = t.hashes.shape[0]
+            for c in lsyms:
+                names.append(c)
+                types.append(ltypes[c])
+                cols.append(Column(jnp.zeros(cap, ltypes[c].dtype),
+                                   jnp.zeros(cap, bool)))
+            for c in rsyms:
+                names.append(c)
+                types.append(t.batch.type_of(c))
+                cols.append(t.batch.column(c))
+            # orig_live, not batch.live: NULL-key build rows were live-killed
+            # for matching but a FULL JOIN must still emit them unmatched
+            live = t.orig_live & ~bm
+            return Batch(names, types, cols, live,
+                         {c: t.batch.dicts[c] for c in rsyms if c in t.batch.dicts})
+
+        self.jremainder = _node_jit(node, jkey + "full_tail",
+                                    lambda: build_remainder_fn)
+
+        if node.build_unique:
+
+            def probe_fn(table: BuildTable, pb: Batch, bm):
+                pb = chain(pb)
+                pba = align_probe_strings(pb, tuple(node.left_keys), table, tuple(node.right_keys))
+                idx, matched = probe_unique(table, pba, tuple(node.left_keys), tuple(node.right_keys))
+                out = gather_join_output(
+                    pb, table, jnp.arange(pb.capacity, dtype=jnp.int32), idx,
+                    pb.live, lsyms, rsyms,
+                )
+                if bm is not None:
+                    bm = bm.at[idx].max(matched & pb.live, mode="drop")
+                if node.kind == "inner":
+                    return out.with_live(out.live & matched), bm
+                # left/full outer: keep probe rows; null out build columns
+                # where unmatched
+                cols = list(out.columns)
+                for i, nme in enumerate(out.names):
+                    if nme in rsyms:
+                        c = cols[i]
+                        valid = c.validity if c.validity is not None else jnp.ones(out.capacity, bool)
+                        cols[i] = Column(c.values, valid & matched, c.hi)
+                return Batch(out.names, out.types, cols, out.live, out.dicts), bm
+
+            self.jfn = _node_jit(node, jkey + "probe", lambda: probe_fn)
+            return
+
+        # general fanout join (inner / left): counts pass + chunked
+        # expansion. LEFT semantics: track verified per-probe existence
+        # across chunks and emit the NULL-extended non-matching probe rows
+        # at the end (the role of LookupJoinOperators.probeOuterJoin in the
+        # reference).
+        # `t` is an argument, not a closure capture: the jit cache entry is
+        # shared across probers with the same jkey (the radix path keeps P
+        # of them), so a captured table would bake the first prober's build
+        # side into the compiled program as a constant
+        def chain_align(t, pb):
+            pb = chain(pb)
+            pba = align_probe_strings(pb, tuple(node.left_keys), t, tuple(node.right_keys))
+            return pb, pba
+
+        self.chain_j = _node_jit(node, jkey + "chain_align", lambda: chain_align)
+        # the fanout window is part of the compiled closure: a non-default
+        # scan width (the radix path probes with a wider one) keys its own
+        # cache entry
+        ckey = "counts" if fanout_scan == 8 else f"counts{fanout_scan}"
+        self.counts_fn = _node_jit(
+            node, ckey,
+            lambda: lambda t, pba: probe_counts(
+                t, pba, tuple(node.left_keys), tuple(node.right_keys),
+                max_fanout_scan=fanout_scan,
+            ),
         )
 
-    table = _node_jit(node, "build", lambda: build_side, static_argnames=("key_names",))(
-        build_in, tuple(node.right_keys)
-    )
-
-    want_full = node.kind == "full"
-    build_cap = int(table.hashes.shape[0])
-
-    def build_remainder_fn(t: BuildTable, bm):
-        """FULL OUTER tail: build rows no probe row matched, with NULL
-        probe columns (reference: LookupJoinOperators.fullOuterJoin's
-        lookup-outer positions pass)."""
-        ltypes = dict(node.left.output)
-        names, types, cols = [], [], []
-        cap = t.hashes.shape[0]
-        for c in lsyms:
-            names.append(c)
-            types.append(ltypes[c])
-            cols.append(Column(jnp.zeros(cap, ltypes[c].dtype),
-                               jnp.zeros(cap, bool)))
-        for c in rsyms:
-            names.append(c)
-            types.append(t.batch.type_of(c))
-            cols.append(t.batch.column(c))
-        # orig_live, not batch.live: NULL-key build rows were live-killed
-        # for matching but a FULL JOIN must still emit them unmatched
-        live = t.orig_live & ~bm
-        return Batch(names, types, cols, live,
-                     {c: t.batch.dicts[c] for c in rsyms if c in t.batch.dicts})
-
-    jremainder = _node_jit(node, jkey + "full_tail", lambda: build_remainder_fn)
-
-    if node.build_unique:
-
-        def probe_fn(table: BuildTable, pb: Batch, bm):
-            pb = chain(pb)
-            pba = align_probe_strings(pb, tuple(node.left_keys), table, tuple(node.right_keys))
-            idx, matched = probe_unique(table, pba, tuple(node.left_keys), tuple(node.right_keys))
-            out = gather_join_output(
-                pb, table, jnp.arange(pb.capacity, dtype=jnp.int32), idx,
-                pb.live, lsyms, rsyms,
+        def expand_fn(t, pb, pba, lo, counts, offsets, base, out_cap, bm):
+            pr, bi, ol = probe_expand(
+                t, pba, tuple(node.left_keys), tuple(node.right_keys),
+                lo, counts, offsets, base, out_cap,
+            )
+            out = gather_join_output(pb, t, pr, bi, ol, lsyms, rsyms)
+            exists = (
+                jnp.zeros(pb.capacity, dtype=jnp.int32)
+                .at[pr]
+                .max(ol.astype(jnp.int32), mode="drop")
+                .astype(bool)
             )
             if bm is not None:
-                bm = bm.at[idx].max(matched & pb.live, mode="drop")
-            if node.kind == "inner":
-                return out.with_live(out.live & matched), bm
-            # left/full outer: keep probe rows; null out build columns where
-            # unmatched
+                bm = bm.at[bi].max(ol, mode="drop")
+            return out, exists, bm
+
+        def null_extend_fn(t, pb, exists):
+            # unmatched probe rows with NULL build columns
+            zero_idx = jnp.zeros(pb.capacity, dtype=jnp.int32)
+            out = gather_join_output(
+                pb, t, jnp.arange(pb.capacity, dtype=jnp.int32), zero_idx,
+                pb.live & ~exists, lsyms, rsyms,
+            )
             cols = list(out.columns)
             for i, nme in enumerate(out.names):
                 if nme in rsyms:
-                    c = cols[i]
-                    valid = c.validity if c.validity is not None else jnp.ones(out.capacity, bool)
-                    cols[i] = Column(c.values, valid & matched, c.hi)
-            return Batch(out.names, out.types, cols, out.live, out.dicts), bm
+                    cols[i] = Column(cols[i].values, jnp.zeros(out.capacity, bool),
+                                     cols[i].hi)
+            return Batch(out.names, out.types, cols, out.live, out.dicts)
 
-        jfn = _node_jit(node, jkey + "probe", lambda: probe_fn)
-        bm = jnp.zeros(build_cap, bool) if want_full else None
-        for pb in probe_stream:
-            out, bm = jfn(table, pb, bm)
-            yield out
-        if want_full:
-            yield jremainder(table, bm)
-        return
+        self.jexpand = _node_jit(node, "expand", lambda: expand_fn,
+                                 static_argnames=("out_cap",))
+        self.jnull = _node_jit(node, "null_extend", lambda: null_extend_fn)
 
-    # general fanout join (inner / left): counts pass + chunked expansion.
-    # LEFT semantics: track verified per-probe existence across chunks and
-    # emit the NULL-extended non-matching probe rows at the end (the role of
-    # LookupJoinOperators.probeOuterJoin in the reference).
-    def chain_align(pb):
-        pb = chain(pb)
-        pba = align_probe_strings(pb, tuple(node.left_keys), table, tuple(node.right_keys))
-        return pb, pba
-
-    chain_j = _node_jit(node, jkey + "chain_align", lambda: chain_align)
-    counts_fn = _node_jit(
-        node, "counts",
-        lambda: lambda t, pba: probe_counts(
-            t, pba, tuple(node.left_keys), tuple(node.right_keys)
-        ),
-    )
-
-    def expand_fn(t, pb, pba, lo, counts, offsets, base, out_cap, bm):
-        pr, bi, ol = probe_expand(
-            t, pba, tuple(node.left_keys), tuple(node.right_keys),
-            lo, counts, offsets, base, out_cap,
-        )
-        out = gather_join_output(pb, t, pr, bi, ol, lsyms, rsyms)
-        exists = (
-            jnp.zeros(pb.capacity, dtype=jnp.int32)
-            .at[pr]
-            .max(ol.astype(jnp.int32), mode="drop")
-            .astype(bool)
-        )
-        if bm is not None:
-            bm = bm.at[bi].max(ol, mode="drop")
-        return out, exists, bm
-
-    def null_extend_fn(t, pb, exists):
-        # unmatched probe rows with NULL build columns
-        zero_idx = jnp.zeros(pb.capacity, dtype=jnp.int32)
-        out = gather_join_output(
-            pb, t, jnp.arange(pb.capacity, dtype=jnp.int32), zero_idx,
-            pb.live & ~exists, lsyms, rsyms,
-        )
-        cols = list(out.columns)
-        for i, nme in enumerate(out.names):
-            if nme in rsyms:
-                cols[i] = Column(cols[i].values, jnp.zeros(out.capacity, bool),
-                                 cols[i].hi)
-        return Batch(out.names, out.types, cols, out.live, out.dicts)
-
-    jexpand = _node_jit(node, "expand", lambda: expand_fn, static_argnames=("out_cap",))
-    jnull = _node_jit(node, "null_extend", lambda: null_extend_fn)
-    bm = jnp.zeros(build_cap, bool) if want_full else None
-    for pb_raw in probe_stream:
-        pb, pba = chain_j(pb_raw)
-        lo, counts, offsets, total, _ = counts_fn(table, pba)
-        # dispatch chunk 0 unconditionally while `total` travels to the
-        # host (it is usually the only chunk) — the host round trip
-        # overlaps chunk-0 execution and downstream dispatch
+    def probe_start(self, pb_raw: Batch):
+        """Dispatch phase of one probe batch: everything up to (not
+        including) the host sync on `total`. Chunk 0 is dispatched
+        unconditionally while `total` travels to the host (it is usually
+        the only chunk). The radix driver starts ALL partitions of a batch
+        before finishing any, so the P count round trips overlap instead
+        of serializing."""
+        if self.empty:
+            return None
+        node, table = self.node, self.table
+        if node.build_unique:
+            out, self.bm = self.jfn(table, pb_raw, self.bm)
+            return ("u", out)
+        pb, pba = self.chain_j(table, pb_raw)
+        lo, counts, offsets, total, _, ovf = self.counts_fn(table, pba)
         try:
             total.copy_to_host_async()
+            ovf.copy_to_host_async()
         except Exception:
             pass
-        out_cap = ctx.config.join_out_capacity or pb.capacity
-        out, exists_acc, bm = jexpand(table, pb, pba, lo, counts, offsets, 0,
-                                      out_cap, bm)
+        out_cap = self.ctx.config.join_out_capacity or pb.capacity
+        out, exists_acc, self.bm = self.jexpand(
+            table, pb, pba, lo, counts, offsets, 0, out_cap, self.bm)
+        return ("g", pb, pba, lo, counts, offsets, total, ovf, out_cap,
+                out, exists_acc)
+
+    def probe_finish(self, st) -> Iterator[Batch]:
+        if st is None:
+            return
+        node, table = self.node, self.table
+        if st[0] == "u":
+            yield st[1]
+            return
+        (_, pb, pba, lo, counts, offsets, total, ovf, out_cap, out,
+         exists_acc) = st
         yield out
         tot = int(total)
         base = out_cap
         while base < tot:
-            out, exists, bm = jexpand(table, pb, pba, lo, counts, offsets,
-                                      base, out_cap, bm)
+            out, exists, self.bm = self.jexpand(
+                table, pb, pba, lo, counts, offsets, base, out_cap, self.bm)
             exists_acc = exists_acc | exists
             yield out
             base += out_cap
+        ovn = int(ovf)
+        if ovn:
+            from presto_tpu.scan import metrics as _scan_metrics
+
+            self.overflow_rows += ovn
+            key = "join.fanout_overflow_rows"
+            self.ctx.stats[key] = self.ctx.stats.get(key, 0) + ovn
+            _scan_metrics.record("join_fanout_overflow_rows", ovn)
         if node.kind in ("left", "full"):
-            yield jnull(table, pb, exists_acc)
-    if want_full:
-        yield jremainder(table, bm)
+            yield self.jnull(table, pb, exists_acc)
+
+    def probe_batch(self, pb_raw: Batch) -> Iterator[Batch]:
+        yield from self.probe_finish(self.probe_start(pb_raw))
+
+    def tail(self) -> Iterator[Batch]:
+        if not self.empty and self.want_full:
+            yield self.jremainder(self.table, self.bm)
+
+
+def _join_probe(node: HashJoin, ctx: ExecContext, build_in: Optional[Batch],
+                probe_stream: Iterator[Batch], chain,
+                jkey: str = "") -> Iterator[Batch]:
+    prober = _JoinProber(node, ctx, build_in, chain, jkey=jkey)
+    for pb in probe_stream:
+        yield from prober.probe_batch(pb)
+    yield from prober.tail()
 
 
 def _column_chunk(c: Column, off, size: int) -> Column:
@@ -2841,7 +3332,7 @@ def _execute_semijoin(node: SemiJoin, ctx: ExecContext) -> Iterator[Batch]:
     jexists = _node_jit(node, "exists", lambda: exists_fn, static_argnames=("out_cap",))
     for pb_raw in probe_stream:
         pb, pba = chain_j(pb_raw)
-        lo, counts, offsets, total, _ = counts_fn(table, pba)
+        lo, counts, offsets, total, _, _ovf = counts_fn(table, pba)
         # chunk 0 dispatches while `total` travels to the host (see
         # _join_probe — same round-trip overlap)
         try:

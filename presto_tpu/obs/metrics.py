@@ -120,9 +120,15 @@ EXCHANGE_WAIT = Histogram(
     "presto_tpu_exchange_wait_seconds",
     "time a consumer spent blocked waiting on a pull-exchange page",
     log_buckets(0.0001, 60.0))
+RADIX_PARTITION_ROWS = Histogram(
+    "presto_tpu_radix_partition_rows",
+    "rows per radix partition at a partitioned breaker (skew shows as a "
+    "wide spread)",
+    log_buckets(1.0, 1e8))
 
 ALL_HISTOGRAMS: Tuple[Histogram, ...] = (
-    QUERY_LATENCY, TASK_SCHEDULE_DELAY, BATCH_KERNEL_WALL, EXCHANGE_WAIT)
+    QUERY_LATENCY, TASK_SCHEDULE_DELAY, BATCH_KERNEL_WALL, EXCHANGE_WAIT,
+    RADIX_PARTITION_ROWS)
 
 
 def render_histograms(plane: str) -> str:

@@ -173,7 +173,11 @@ def probe_counts(
     `max_fanout_scan` candidates vectorized; ranges wider than that fall
     back to counting hash matches (superset — rows are still verified and
     masked at expand time, so correctness holds; only capacity estimation
-    widens).
+    widens). The number of probe rows that hit this widening is returned
+    as `overflow` so drivers can surface it as a counter instead of the
+    estimate silently inflating output capacity.
+
+    Returns (lo int32[cap], counts, offsets, total, live, overflow).
     """
     _, lo, hi, live = _probe_ranges(table, probe, probe_keys)
     width = hi - lo
@@ -183,11 +187,13 @@ def probe_counts(
         idx = jnp.clip(lo + j, 0, cap - 1).astype(jnp.int32)
         ok = (j < width) & _keys_equal(table, idx, probe, probe_keys, build_keys)
         counts = counts + ok.astype(jnp.int64)
+    widened = live & (width > max_fanout_scan)
     counts = jnp.where(width > max_fanout_scan, width, counts)
     counts = jnp.where(live, counts, 0)
     offsets = jnp.cumsum(counts) - counts  # exclusive prefix sum
     total = jnp.sum(counts)
-    return lo.astype(jnp.int32), counts, offsets, total, live
+    overflow = jnp.sum(widened.astype(jnp.int64))
+    return lo.astype(jnp.int32), counts, offsets, total, live, overflow
 
 
 def probe_expand(

@@ -27,8 +27,8 @@ from presto_tpu.batch import Batch, Column
 from presto_tpu.ops.hashing import hash_columns
 
 
-def partition_ids(batch: Batch, key_names: Sequence[str], num_partitions: int):
-    """Row → partition id by hash(keys).
+def partition_hash(batch: Batch, key_names: Sequence[str]) -> jnp.ndarray:
+    """Content-equality 63-bit hash of the key columns (int64, non-negative).
 
     String keys are remapped through the dictionary's content-hash LUT
     before hashing: partitioning must agree on the string VALUE, not the
@@ -37,6 +37,10 @@ def partition_ids(batch: Batch, key_names: Sequence[str], num_partitions: int):
     InterpretedHashGenerator hashes value bytes). The LUT is a trace-time
     constant — batch dicts are static pytree aux, so each dictionary keys
     its own compiled program.
+
+    Both the exchange (`h % num_partitions`) and the within-worker radix
+    partitioner (top bits, ops/radix.py) derive from this same hash so a
+    sink that already routed by it can tag pages with their radix id.
     """
     vals, valids = [], []
     for k in key_names:
@@ -48,7 +52,12 @@ def partition_ids(batch: Batch, key_names: Sequence[str], num_partitions: int):
             v = jnp.take(lut, v.astype(jnp.int32) + 1, mode="clip")
         vals.append(v)
         valids.append(c.validity)
-    h = hash_columns(vals, valids)
+    return hash_columns(vals, valids)
+
+
+def partition_ids(batch: Batch, key_names: Sequence[str], num_partitions: int):
+    """Row → partition id by hash(keys) mod num_partitions."""
+    h = partition_hash(batch, key_names)
     return (h % num_partitions).astype(jnp.int32)
 
 

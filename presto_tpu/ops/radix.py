@@ -1,0 +1,134 @@
+"""Within-worker radix partitioning for pipeline breakers.
+
+Reference: the partitioned-hash-join literature (Design Trade-offs for a
+Robust Dynamic Hybrid Hash Join, arXiv:2112.02480; Global Hash Tables
+Strike Back!, arXiv:2505.04153) — split both sides of a breaker by a few
+high bits of the join hash so every per-partition build/probe (or
+group-by merge) runs at a small fixed capacity. On XLA that bounds the
+set of compiled program shapes: instead of one giant sort/searchsorted
+over a query-size-dependent capacity, P independent kernels over the
+same handful of power-of-two buckets.
+
+TPU-native design: scatter-free. Routing is `lax.sort` by partition id
+(stable, so row order within a partition is preserved), partition
+extents come from a segment-sum pulled to the host (a P-element
+transfer), and per-partition sub-batches are gathered out of the sorted
+batch by a `start + iota(bucket)` window gather whose bucket size is a
+static power of two — the only shape-keying quantities are
+(input capacity, bucket), both from small closed sets.
+
+Partition id = TOP bits of the shared 63-bit content hash
+(ops/partition.py:partition_hash). The exchange routes by `hash %
+n_out`; using the high bits here keeps the two decompositions
+independent, so radix refines an already hash-partitioned stream instead
+of degenerating to one resident partition per task. The same ids are
+reused by the partition-aligned exchange sink (server/worker.py): a page
+tagged with its radix id skips the sort entirely on the consumer side.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from presto_tpu.batch import Batch
+from presto_tpu.ops.partition import partition_hash
+
+_HASH_BITS = 63  # hash_columns masks the sign bit
+
+
+def radix_bits(num_partitions: int) -> int:
+    """log2(P); P must be a power of two."""
+    if num_partitions <= 0 or num_partitions & (num_partitions - 1):
+        raise ValueError(
+            f"radix partition count must be a power of two, got "
+            f"{num_partitions}")
+    return num_partitions.bit_length() - 1
+
+
+def radix_ids(batch: Batch, key_names: Sequence[str],
+              num_partitions: int) -> jnp.ndarray:
+    """Row → radix partition id: top `log2(P)` bits of the content hash."""
+    bits = radix_bits(num_partitions)
+    if bits == 0:
+        return jnp.zeros(batch.capacity, dtype=jnp.int32)
+    h = partition_hash(batch, key_names)
+    return jnp.right_shift(h, _HASH_BITS - bits).astype(jnp.int32)
+
+
+def radix_sort(batch: Batch, key_names: Sequence[str],
+               num_partitions: int) -> Tuple[Batch, jnp.ndarray]:
+    """Stable-sort rows by radix id, dead rows last.
+
+    Returns (sorted batch — its live mask marks exactly the routed rows,
+    in partition order — and per-partition live counts int32[P]). Meant
+    to be jitted once per (plan node, input capacity).
+    """
+    n = batch.capacity
+    pid = radix_ids(batch, key_names, num_partitions)
+    pid = jnp.where(batch.live, pid, num_partitions)  # dead rows sink
+    perm = jnp.arange(n, dtype=jnp.int32)
+    spid, sperm = jax.lax.sort([pid, perm], num_keys=1, is_stable=True)
+    cols = [c.gather(sperm) for c in batch.columns]
+    out = Batch(batch.names, batch.types, cols, spid < num_partitions,
+                batch.dicts)
+    counts = jax.ops.segment_sum(
+        jnp.ones(n, jnp.int32), spid, num_segments=num_partitions + 1
+    )[:num_partitions]
+    return out, counts
+
+
+def radix_perm(batch: Batch, key_names: Sequence[str],
+               num_partitions: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Stable argsort by radix id WITHOUT materializing the sorted batch.
+
+    Returns (sperm int32[capacity] — row indices in partition order, dead
+    rows last — and per-partition live counts int32[P]). The runtime
+    splitter pairs this with `radix_window_perm`, which gathers each
+    window's columns straight out of the ORIGINAL batch through the
+    permutation — every payload byte moves once (in its window) instead
+    of twice (sorted copy + window copy); the sort itself only touches
+    two int32 planes.
+    """
+    n = batch.capacity
+    pid = radix_ids(batch, key_names, num_partitions)
+    pid = jnp.where(batch.live, pid, num_partitions)  # dead rows sink
+    perm = jnp.arange(n, dtype=jnp.int32)
+    spid, sperm = jax.lax.sort([pid, perm], num_keys=1, is_stable=True)
+    counts = jax.ops.segment_sum(
+        jnp.ones(n, jnp.int32), spid, num_segments=num_partitions + 1
+    )[:num_partitions]
+    return sperm, counts
+
+
+def radix_window_perm(batch: Batch, perm, start, count,
+                      bucket: int) -> Batch:
+    """`radix_window` through a `radix_perm` permutation: gather `bucket`
+    rows whose partition-order ranks begin at (traced) `start` directly
+    from the unsorted batch. Same clamp-and-mask contract as
+    `radix_window`; `bucket` is the only static shape key."""
+    cap = batch.capacity
+    lane = jnp.arange(bucket, dtype=jnp.int32)
+    idx = perm[jnp.clip(start.astype(jnp.int32) + lane, 0, cap - 1)]
+    cols = [c.gather(idx) for c in batch.columns]
+    live = lane < count.astype(jnp.int32)
+    return Batch(batch.names, batch.types, cols, live, batch.dicts)
+
+
+def radix_window(sorted_batch: Batch, start, count, bucket: int) -> Batch:
+    """Gather `bucket` rows beginning at (traced) `start` out of a sorted
+    batch; rows at rank >= `count` are marked dead.
+
+    A gather (not dynamic_slice) so out-of-range lanes clamp harmlessly —
+    they are masked dead by `count` regardless of what they read. `bucket`
+    is static: jit once per (input capacity, bucket).
+    """
+    cap = sorted_batch.capacity
+    lane = jnp.arange(bucket, dtype=jnp.int32)
+    idx = jnp.clip(start.astype(jnp.int32) + lane, 0, cap - 1)
+    cols = [c.gather(idx) for c in sorted_batch.columns]
+    live = lane < count.astype(jnp.int32)
+    return Batch(sorted_batch.names, sorted_batch.types, cols, live,
+                 sorted_batch.dicts)

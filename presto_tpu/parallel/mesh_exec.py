@@ -283,8 +283,8 @@ class MeshExecutor:
         """Bounded-fanout pair expansion with overflow accounting — shared
         by joins and residual semijoins so the capacity formula and the
         MeshOverflow diag protocol can't diverge."""
-        lo, counts, offsets, total, _ = probe_counts(table, pba, lkeys,
-                                                     rkeys)
+        lo, counts, offsets, total, _, _ovf = probe_counts(table, pba, lkeys,
+                                                           rkeys)
         out_cap = probe.capacity * self.fanout_budget * self._cap_boost
         pr, bi, ol = probe_expand(table, pba, lkeys, rkeys,
                                   lo, counts, offsets, 0, out_cap)

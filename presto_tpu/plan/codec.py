@@ -324,7 +324,8 @@ def fragment_to_json(f: Fragment) -> Dict[str, Any]:
     return {"fid": f.fid, "root": node_to_json(f.root),
             "partitioning": f.partitioning,
             "output_partitioning": f.output_partitioning,
-            "output_keys": list(f.output_keys)}
+            "output_keys": list(f.output_keys),
+            "radix_align": bool(f.radix_align)}
 
 
 def fragment_from_json(d: Dict[str, Any]) -> Fragment:
@@ -333,6 +334,7 @@ def fragment_from_json(d: Dict[str, Any]) -> Fragment:
         partitioning=d["partitioning"],
         output_partitioning=d["output_partitioning"],
         output_keys=list(d.get("output_keys") or []),
+        radix_align=bool(d.get("radix_align") or False),
     )
 
 

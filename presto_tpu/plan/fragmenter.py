@@ -56,6 +56,10 @@ class Fragment:
     partitioning: str              # SOURCE | HASH | SINGLE
     output_partitioning: str       # OUT_HASH | OUT_GATHER | OUT_BROADCAST
     output_keys: List[str] = dataclasses.field(default_factory=list)
+    # the consumer breaker radix-partitions on output_keys (join/agg): the
+    # sink may additionally tag each page with its radix id so the consumer
+    # skips the device re-partition sort (partition-aligned exchange)
+    radix_align: bool = False
 
     def remote_sources(self) -> List[RemoteSource]:
         out = []
@@ -205,11 +209,13 @@ class _Fragmenter:
         self.stats_fn = stats_fn
 
     def cut(self, root: PlanNode, partitioning: str,
-            out_part: str, keys: Optional[List[str]] = None) -> RemoteSource:
+            out_part: str, keys: Optional[List[str]] = None,
+            radix_align: bool = False) -> RemoteSource:
         fid = self._next
         self._next += 1
         self.fragments[fid] = Fragment(fid, root, partitioning, out_part,
-                                       list(keys or []))
+                                       list(keys or []),
+                                       radix_align=radix_align)
         return RemoteSource(fid, list(root.output))
 
     # returns (node-in-current-fragment, partitioning of current fragment)
@@ -237,7 +243,8 @@ class _Fragmenter:
                 return node, SINGLE
             partial = Aggregate(child, node.group_keys, node.aggs, step="partial")
             if node.group_keys:
-                rs = self.cut(partial, cpart, OUT_HASH, node.group_keys)
+                rs = self.cut(partial, cpart, OUT_HASH, node.group_keys,
+                              radix_align=True)
                 final = Aggregate(rs, node.group_keys, node.aggs, step="final")
                 return final, HASH
             rs = self.cut(partial, cpart, OUT_GATHER)
@@ -274,8 +281,10 @@ class _Fragmenter:
                 node.right = self.cut(right, rpart, OUT_BROADCAST)
                 return node, lpart
             # PARTITIONED join: co-locate both sides by hash(join keys)
-            node.left = self.cut(left, lpart, OUT_HASH, node.left_keys)
-            node.right = self.cut(right, rpart, OUT_HASH, node.right_keys)
+            node.left = self.cut(left, lpart, OUT_HASH, node.left_keys,
+                                 radix_align=True)
+            node.right = self.cut(right, rpart, OUT_HASH, node.right_keys,
+                                  radix_align=True)
             return node, HASH
         if isinstance(node, SemiJoin):
             left, lpart = self.process(node.left)

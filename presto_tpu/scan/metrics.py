@@ -10,7 +10,13 @@ from __future__ import annotations
 import threading
 from typing import Dict, List, Optional, Tuple
 
-COUNTER_NAMES = ("splits_pruned", "rows_predecode_filtered", "bytes_skipped")
+COUNTER_NAMES = (
+    "splits_pruned", "rows_predecode_filtered", "bytes_skipped",
+    # exec-side counters exposed on the same plane-labeled family
+    # (radix-partitioned breakers + join fanout estimation, PR 3)
+    "join_fanout_overflow_rows", "radix_partitions_spilled",
+    "radix_spill_bytes", "radix_aligned_batches",
+)
 
 _HELP = {
     "splits_pruned": "splits eliminated by min/max split statistics",
@@ -18,6 +24,17 @@ _HELP = {
         "rows dropped by host value filters before device upload",
     "bytes_skipped":
         "payload bytes never uploaded thanks to predicate-during-decode",
+    "join_fanout_overflow_rows":
+        "probe rows whose candidate range exceeded max_fanout_scan so the "
+        "count pass fell back to the hash-match superset",
+    "radix_partitions_spilled":
+        "radix partitions whose build side exceeded join_spill_budget_bytes "
+        "and were processed from host spill",
+    "radix_spill_bytes":
+        "bytes written to host spill files by radix-partitioned breakers",
+    "radix_aligned_batches":
+        "exchange pages consumed with a radix tag, skipping the device "
+        "re-partition sort",
 }
 
 _lock = threading.Lock()

@@ -27,6 +27,25 @@ from presto_tpu.types import Type, parse_type
 _MAGIC = b"PTP1"
 _FLAG_ZSTD = 1
 
+# dictionaries at or under this many values are always inlined on the wire:
+# the ref+fetch round trip costs more than the payload
+_DICT_INLINE_MAX = 64
+
+
+class TaggedBatch(Batch):
+    """A deserialized page carrying its producer's radix partition id.
+
+    Serde-level only: consumers that radix-partition check `radix` via
+    getattr and strip to a plain Batch before any jitted code — the pytree
+    registration is type-exact, so this subclass must never reach jit.
+    `radix` is (partition_id, num_partitions, key_names)."""
+
+    __slots__ = ("radix",)
+
+    def __init__(self, names, types, columns, live, dicts, radix):
+        super().__init__(names, types, columns, live, dicts)
+        self.radix = radix
+
 try:
     import zstandard as _zstd
 except Exception:  # pragma: no cover
@@ -115,6 +134,29 @@ def register_dictionary(d: Dictionary) -> Dictionary:
     return out
 
 
+def _intern_hit(key: bytes) -> Optional[Dictionary]:
+    with _DICT_INTERN_LOCK:
+        hit = _DICT_INTERN.get(key)
+        if hit is not None:
+            _DICT_INTERN.move_to_end(key)
+        return hit
+
+
+def lookup_dictionary(digest_hex: str) -> Optional[List[str]]:
+    """Side-channel hook for the /v1/dict endpoint: the value list for an
+    interned dictionary digest, or None when evicted / never seen (the
+    producer interns every dictionary it sends by ref, so a miss means LRU
+    eviction — the consumer should fail the page, not guess)."""
+    try:
+        key = bytes.fromhex(digest_hex)
+    except ValueError:
+        return None
+    d = _intern_hit(key)
+    if d is None:
+        return None
+    return [str(v) for v in d.values]
+
+
 def _pack_bits(mask: np.ndarray) -> bytes:
     return np.packbits(mask.astype(np.uint8)).tobytes()
 
@@ -123,12 +165,24 @@ def _unpack_bits(data: bytes, n: int) -> np.ndarray:
     return np.unpackbits(np.frombuffer(data, np.uint8), count=n).astype(bool)
 
 
-def serialize_batch(b: Batch, compress: bool = True) -> bytes:
-    """Compact live rows and serialize. Safe to call on device or host arrays."""
+def serialize_batch(b: Batch, compress: bool = True,
+                    radix: Optional[tuple] = None,
+                    dict_refs: bool = False) -> bytes:
+    """Compact live rows and serialize. Safe to call on device or host arrays.
+
+    radix: (partition_id, num_partitions, key_names) — stamps the page so an
+    aligned consumer skips its re-partition sort (deserializes TaggedBatch).
+    dict_refs: large dictionaries go on the wire as a content digest instead
+    of their full value list; the consumer resolves a miss once through the
+    /v1/dict side channel. Leave False for spill files, which must stay
+    self-contained."""
     live = np.asarray(b.live)
     n = int(live.sum())
     header = {"n": n, "names": list(b.names), "types": [str(t) for t in b.types],
               "validity": [], "limbs": [], "struct": [], "dicts": {}}
+    if radix is not None:
+        r, num, keys = radix
+        header["radix"] = [int(r), int(num), list(keys)]
     buffers: List[bytes] = []
     for name, t, c in zip(b.names, b.types, b.columns):
         vals = np.asarray(c.values)[live]
@@ -164,13 +218,16 @@ def serialize_batch(b: Batch, compress: bool = True) -> bytes:
                     np.ascontiguousarray(np.asarray(c.keys)[live]).tobytes())
         else:
             header["struct"].append(None)
-        if name in b.dicts:
-            register_dictionary(b.dicts[name])
-            header["dicts"][name] = [str(v) for v in b.dicts[name].values]
-        if name + "#keys" in b.dicts:
-            register_dictionary(b.dicts[name + "#keys"])
-            header["dicts"][name + "#keys"] = [
-                str(v) for v in b.dicts[name + "#keys"].values]
+        for dk in (name, name + "#keys"):
+            if dk not in b.dicts:
+                continue
+            d = register_dictionary(b.dicts[dk])
+            if dict_refs and len(d.values) > _DICT_INLINE_MAX:
+                header["dicts"][dk] = {
+                    "ref": _dict_content_key(d.values).hex(),
+                    "len": len(d.values)}
+            else:
+                header["dicts"][dk] = [str(v) for v in d.values]
     payload = b"".join(buffers)
     flags = 0
     zc = _zc()
@@ -182,7 +239,9 @@ def serialize_batch(b: Batch, compress: bool = True) -> bytes:
 
 
 def deserialize_batch(data: bytes, capacity: Optional[int] = None,
-                      device_put: bool = False) -> Batch:
+                      device_put: bool = False,
+                      dict_resolver: Optional[Callable[[str], List[str]]]
+                      = None) -> Batch:
     assert data[:4] == _MAGIC, "bad page magic"
     flags, hlen, plen = struct.unpack_from("<BII", data, 4)
     off = 4 + 9
@@ -256,11 +315,39 @@ def deserialize_batch(data: bytes, capacity: Optional[int] = None,
                            sizes_arr, evalid_arr, keys_arr))
     live = np.zeros(cap, dtype=bool)
     live[:n] = True
-    dicts = {k: intern_dictionary(np.asarray(v, dtype=object))
-             for k, v in header["dicts"].items()}
-    b = Batch(names, types, cols, jnp.asarray(live), dicts)
+    dicts = {}
+    for k, v in header["dicts"].items():
+        if isinstance(v, dict):
+            # by-ref dictionary: the in-process intern table almost always
+            # has it (the producer interned it before sending); a genuine
+            # miss goes through the side channel exactly once
+            key = bytes.fromhex(v["ref"])
+            d = _intern_hit(key)
+            if d is None:
+                if dict_resolver is None:
+                    raise ValueError(
+                        "page references dictionary "
+                        f"{v['ref'][:12]} with no resolver available")
+                vals = np.asarray(dict_resolver(v["ref"]), dtype=object)
+                d = _intern_put(key, lambda vals=vals: Dictionary(vals))
+            dicts[k] = d
+        else:
+            dicts[k] = intern_dictionary(np.asarray(v, dtype=object))
+    rd = header.get("radix")
+    if rd is not None:
+        b = TaggedBatch(names, types, cols, jnp.asarray(live), dicts,
+                        (int(rd[0]), int(rd[1]), tuple(rd[2])))
+    else:
+        b = Batch(names, types, cols, jnp.asarray(live), dicts)
     if device_put:
         import jax
 
-        b = jax.device_put(b)
+        if isinstance(b, TaggedBatch):
+            # TaggedBatch is not a registered pytree — move a plain view
+            moved = jax.device_put(Batch(b.names, b.types, b.columns,
+                                         b.live, b.dicts))
+            b = TaggedBatch(moved.names, moved.types, moved.columns,
+                            moved.live, moved.dicts, b.radix)
+        else:
+            b = jax.device_put(b)
     return b

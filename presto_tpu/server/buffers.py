@@ -21,6 +21,20 @@ import threading
 from typing import List, Optional, Tuple
 
 
+class _SharedPage:
+    """One broadcast page shared by every partition queue. The buffer's
+    byte accounting holds it exactly ONCE and releases it when the last
+    consumer acks or aborts (BroadcastOutputBuffer's page refcounting) —
+    counting per partition overstated buffered bytes N× and tripped
+    back-pressure long before the buffer was actually full."""
+
+    __slots__ = ("page", "refs")
+
+    def __init__(self, page: bytes, refs: int):
+        self.page = page
+        self.refs = refs
+
+
 class _PartitionBuffer:
     """Token-addressed page queue for one consumer. Entries are either hot
     bytes or ("d", offset, length) descriptors into the shared spool file."""
@@ -77,8 +91,19 @@ class OutputBuffer:
     def _read_entry(self, entry) -> bytes:
         if isinstance(entry, bytes):
             return entry
+        if isinstance(entry, _SharedPage):
+            return entry.page
         _, off, length = entry
         return os.pread(self._spool_f.fileno(), length, off)
+
+    def _release_entry(self, entry):
+        # caller holds the lock
+        if isinstance(entry, bytes):
+            self._bytes -= len(entry)
+        elif isinstance(entry, _SharedPage):
+            entry.refs -= 1
+            if entry.refs == 0:
+                self._bytes -= len(entry.page)
 
     def enqueue(self, partition: Optional[int], page: bytes):
         """Append a page; partition=None broadcasts. Blocks for back-pressure
@@ -88,19 +113,20 @@ class OutputBuffer:
             if self._spool_dir is None:
                 while self._bytes >= self._max_bytes and not self._all_aborted():
                     self._cond.wait(timeout=1.0)
-            targets = range(self.n_partitions) if (self.broadcast or partition is None) \
+            fanout = range(self.n_partitions) if (self.broadcast or partition is None) \
                 else (partition,)
+            targets = [p for p in fanout if not self._parts[p].aborted]
             entry: object = page
             if (self._spool_dir is not None
                     and self._bytes + len(page) > self._max_bytes):
                 entry = self._spool_page(page)
+            elif len(targets) > 1:
+                entry = _SharedPage(page, len(targets))
+                self._bytes += len(page)
+            elif targets:
+                self._bytes += len(page)
             for p in targets:
-                pb = self._parts[p]
-                if pb.aborted:
-                    continue
-                pb.entries.append(entry)
-                if isinstance(entry, bytes):
-                    self._bytes += len(page)
+                self._parts[p].entries.append(entry)
             self._cond.notify_all()
 
     def set_no_more_pages(self):
@@ -172,9 +198,7 @@ class OutputBuffer:
             pb = self._parts[partition]
             drop = min(max(token - pb.base_token, 0), len(pb.entries))
             for i in range(drop):
-                e = pb.entries[i]
-                if isinstance(e, bytes):
-                    self._bytes -= len(e)
+                self._release_entry(pb.entries[i])
             del pb.entries[:drop]
             pb.base_token += drop
             self._maybe_release_spool()
@@ -185,8 +209,7 @@ class OutputBuffer:
             pb = self._parts[partition]
             pb.aborted = True
             for e in pb.entries:
-                if isinstance(e, bytes):
-                    self._bytes -= len(e)
+                self._release_entry(e)
             pb.entries.clear()
             pb.no_more = True
             self._maybe_release_spool()

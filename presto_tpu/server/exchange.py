@@ -154,9 +154,30 @@ class ExchangeClient:
                 continue
             yield item
 
+    def _resolve_dict(self, digest: str) -> List[str]:
+        """One-shot side-channel fetch for a by-ref dictionary. In-process
+        deployments never get here (producer and consumer share the intern
+        table); across processes, any upstream worker that shipped the ref
+        has it interned, so try each distinct base once."""
+        seen = set()
+        for loc in self.locations:
+            base = loc.split("/v1/")[0]
+            if base in seen or not base.startswith("http"):
+                continue
+            seen.add(base)
+            try:
+                with urllib.request.urlopen(f"{base}/v1/dict/{digest}",
+                                            timeout=30) as r:
+                    return json.loads(r.read())
+            except Exception:
+                continue
+        raise ExchangeFailure(
+            f"dictionary {digest[:12]} unresolvable from any upstream",
+            task_error=True)
+
     def batches(self) -> Iterator[Batch]:
         for page in self.pages():
-            yield deserialize_batch(page)
+            yield deserialize_batch(page, dict_resolver=self._resolve_dict)
 
     def close(self):
         self.closed = True

@@ -91,6 +91,27 @@ def _positive(name: str) -> Callable[[Any], None]:
     return check
 
 
+def _nonneg(name: str) -> Callable[[Any], None]:
+    def check(v):
+        if v is not None and v < 0:
+            raise SessionPropertyError(
+                f"{name}: must be non-negative, got {v}")
+
+    return check
+
+
+def _pow2_or_off(name: str) -> Callable[[Any], None]:
+    def check(v):
+        if v is None or v in (0, 1):
+            return
+        if v < 0 or v & (v - 1):
+            raise SessionPropertyError(
+                f"{name}: must be a power of two (or 0/1 to disable), "
+                f"got {v}")
+
+    return check
+
+
 class SystemSessionProperties:
     """The engine's per-query flag registry (SystemSessionProperties.java)."""
 
@@ -157,6 +178,15 @@ class SystemSessionProperties:
             PropertyMetadata("split_affinity",
                              "Rendezvous-hash split→worker placement",
                              bool, True),
+            # radix-partitioned pipeline breakers
+            PropertyMetadata("radix_partitions",
+                             "Within-worker radix fanout at joins and "
+                             "group-bys (power of two; 0/1 disables)",
+                             int, 0, validator=_pow2_or_off("radix_partitions")),
+            PropertyMetadata("join_spill_budget_bytes",
+                             "Per-partition device-byte budget beyond which "
+                             "a radix partition spills to host (0 = never)",
+                             int, 0, validator=_nonneg("join_spill_budget_bytes")),
         ]
 
     def names(self) -> List[str]:
@@ -258,4 +288,7 @@ class Session:
             recoverable_grouped_execution=self.get(
                 "recoverable_grouped_execution"),
             split_affinity=self.get("split_affinity"),
+            radix_partitions=self.get("radix_partitions"),
+            join_spill_budget_bytes=(self.get("join_spill_budget_bytes")
+                                     or None),
         )

@@ -80,6 +80,15 @@ def _jit_partition_ids(keys: tuple, n_parts: int):
     return jax.jit(lambda b: partition_ids(b, keys, n_parts))
 
 
+@lru_cache(maxsize=256)
+def _jit_radix_ids(keys: tuple, n_radix: int):
+    import jax
+
+    from presto_tpu.ops.radix import radix_ids
+
+    return jax.jit(lambda b: radix_ids(b, keys, n_radix))
+
+
 class TaskExecutor:
     """Fair batch-granularity time slicing across concurrent tasks — the
     analog of TaskExecutor.java:78 + MultilevelSplitQueue.java:41. Each
@@ -270,7 +279,7 @@ class TaskExecution:
         ctx.split_counts = self.update.split_counts
         ctx.remote_sources = self._remote_source_factory
         f = self.update.fragment
-        sink = self._make_sink(f)
+        sink = self._make_sink(f, cfg)
         stream = execute_node(f.root, ctx)
         # fair time slicing applies to LEAF fragments only: a task
         # with remote sources can block inside next() waiting for
@@ -325,21 +334,44 @@ class TaskExecution:
                      for k, v in ctx.stats.items()]
             self.stats_report = rows
 
-    def _make_sink(self, f: Fragment):
+    def _make_sink(self, f: Fragment, cfg):
         if f.output_partitioning == OUT_HASH and self.update.n_out_partitions > 1:
             pid_fn = _jit_partition_ids(
                 tuple(f.output_keys), self.update.n_out_partitions
             )
+            R = cfg.radix_partitions if f.radix_align else 0
+            rid_fn = _jit_radix_ids(tuple(f.output_keys), R) if R > 1 else None
 
             def sink(b: Batch):
                 # device-side hash, host-side scatter into per-consumer pages
                 # (PartitionedOutputOperator.partitionPage:377 analog)
                 pid = np.asarray(pid_fn(b))
                 live = np.asarray(b.live)
+                if rid_fn is None:
+                    for p in range(self.update.n_out_partitions):
+                        mask = live & (pid == p)
+                        if mask.any():
+                            self.buffer.enqueue(
+                                p, serialize_batch(b.with_live(mask),
+                                                   dict_refs=True))
+                    return
+                # partition-aligned exchange: the consumer breaker radix-
+                # partitions on these same keys, so split each consumer's
+                # page further by the radix id (top bits of the SAME 63-bit
+                # hash whose modulo picked the consumer) and tag it — the
+                # consumer routes the page straight to partition r with no
+                # re-partition sort
+                rid = np.asarray(rid_fn(b))
+                keys = tuple(f.output_keys)
                 for p in range(self.update.n_out_partitions):
-                    mask = live & (pid == p)
-                    if mask.any():
-                        self.buffer.enqueue(p, serialize_batch(b.with_live(mask)))
+                    pmask = live & (pid == p)
+                    if not pmask.any():
+                        continue
+                    for r in np.unique(rid[pmask]):
+                        self.buffer.enqueue(
+                            p, serialize_batch(
+                                b.with_live(pmask & (rid == r)),
+                                radix=(int(r), R, keys), dict_refs=True))
 
             return sink
 
@@ -355,7 +387,7 @@ class TaskExecution:
                     return
                 p = state["next"] % n_parts
                 state["next"] += 1
-                self.buffer.enqueue(p, serialize_batch(b))
+                self.buffer.enqueue(p, serialize_batch(b, dict_refs=True))
 
             return sink
 
@@ -363,7 +395,7 @@ class TaskExecution:
             # gather/broadcast: one serialized page, fanned out by the buffer
             if int(np.asarray(b.live).sum()) == 0:
                 return
-            page = serialize_batch(b)
+            page = serialize_batch(b, dict_refs=True)
             if f.output_partitioning == OUT_BROADCAST:
                 self.buffer.enqueue(None, page)
             else:
@@ -471,6 +503,7 @@ _ACK_RE = re.compile(r"^/v1/task/([^/]+)/results/(\d+)/(\d+)/ack$")
 _BUFFER_RE = re.compile(r"^/v1/task/([^/]+)/results/(\d+)$")
 _STATUS_RE = re.compile(r"^/v1/task/([^/]+)/status$")
 _TRACE_RE = re.compile(r"^/v1/task/([^/]+)/trace$")
+_DICT_RE = re.compile(r"^/v1/dict/([0-9a-f]{64})$")
 
 
 class Worker:
@@ -587,6 +620,17 @@ class Worker:
                     if t is None:
                         return self._json({"error": "no such task"}, 404)
                     return self._json(t.tracer.to_json())
+                m = _DICT_RE.match(self.path)
+                if m:
+                    # dictionary side channel: by-ref wire pages resolve
+                    # their content here exactly once on an intern miss
+                    from presto_tpu.serde import lookup_dictionary
+
+                    vals = lookup_dictionary(m.group(1))
+                    if vals is None:
+                        return self._json(
+                            {"error": "dictionary not interned"}, 404)
+                    return self._json(vals)
                 if self.path == "/v1/info":
                     return self._json({
                         "nodeId": worker.node_id,

@@ -122,7 +122,7 @@ class TestJoin:
         tbl = build_side(bb, ("id",))
         pk = rng.integers(0, 12, 100)
         pb = Batch.from_numpy({"id": pk}, {"id": BIGINT})
-        lo, counts, offsets, total, _ = probe_counts(tbl, pb, ("id",), ("id",), max_fanout_scan=4)
+        lo, counts, offsets, total, _, _ovf = probe_counts(tbl, pb, ("id",), ("id",), max_fanout_scan=4)
         pr, bi, ol = probe_expand(tbl, pb, ("id",), ("id",), lo, counts, offsets, 0, 8192)
         got = set()
         y = np.asarray(tbl.batch.column("y").values)

@@ -1,0 +1,305 @@
+"""Radix-partitioned pipeline breakers (ops/radix.py + the runtime drivers).
+
+Property matrix: with `radix_partitions` set, every join and group-by must
+produce row-for-row the SAME result as the unpartitioned kernels — across
+NULL keys, FULL OUTER remainders, dictionary-encoded varchar keys,
+long-decimal payloads, and partitions forced through the hybrid spill path
+(`join_spill_budget_bytes=1` sends every partition to host files).
+
+Plus unit coverage for the radix kernels, the partition-aligned wire tag,
+by-ref wire dictionaries, and the broadcast buffer's shared-page byte
+accounting.
+"""
+
+import json
+import struct
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from presto_tpu.batch import Batch, Column
+from presto_tpu.catalog.memory import MemoryConnector
+from presto_tpu.connector import Catalog
+from presto_tpu.dictionary import Dictionary
+from presto_tpu.exec import ExecConfig, LocalRunner
+from presto_tpu.types import BIGINT, DOUBLE, DecimalType
+
+from conftest import assert_frames_match
+
+
+@pytest.fixture(scope="module")
+def catalog():
+    rng = np.random.default_rng(7)
+    n, m = 4000, 700
+    conn = MemoryConnector("mem")
+    build_id = rng.integers(0, 500, m).tolist()
+    for i in range(0, m, 9):           # NULL build keys never match
+        build_id[i] = None
+    conn.add_table("build", {
+        "id": build_id,
+        "name": rng.choice(["alpha", "beta", "gamma", "delta"], m).tolist(),
+    })
+    probe_fk = rng.integers(0, 650, n).tolist()
+    for i in range(0, n, 11):          # NULL probe keys never match
+        probe_fk[i] = None
+    conn.add_table("probe", {
+        "fk": probe_fk,
+        "v": rng.normal(size=n).tolist(),
+        "g": rng.choice(["x", "y", "z", "w", "q"], n).tolist(),
+    })
+    # long-decimal payload: unscaled cents near 9e16 so grouped sums
+    # exceed int64 and must come back exact through both radix paths
+    cents = rng.integers(89_000_000_000_000_000, 90_000_000_000_000_000,
+                         50_000)
+    conn.add_generated("big", {
+        "g": rng.integers(0, 40, 50_000),
+        "dv": ("raw_decimal", DecimalType(15, 2), cents),
+    })
+    # high-NDV table: its CBO presize exceeds the base agg capacity, so
+    # the radix group-by engages even without a spill budget
+    conn.add_table("wide", {
+        "k": rng.integers(0, 1 << 40, 20_000).tolist(),
+        "v": rng.normal(size=20_000).tolist(),
+    })
+    cat = Catalog()
+    cat.register("mem", conn, default=True)
+    return cat
+
+
+QUERIES = {
+    "inner": "select p.fk, p.v, b.name from probe p "
+             "join build b on p.fk = b.id",
+    "left": "select p.fk, p.v, b.name from probe p "
+            "left join build b on p.fk = b.id",
+    "full_outer": "select p.fk, p.v, b.id, b.name from probe p "
+                  "full outer join build b on p.fk = b.id",
+    "varchar_key": "select p.g, count(*) as c from probe p "
+                   "join build b on p.fk = b.id group by p.g",
+    "groupby_null_key": "select fk, count(*) as c, sum(v) as s "
+                        "from probe group by fk",
+    "groupby_dict_key": "select g, count(*) as c, avg(v) as a "
+                        "from probe group by g",
+    "long_decimal_sum": "select g, sum(dv) as s, count(*) as c "
+                        "from big group by g",
+    "groupby_high_ndv": "select k, count(*) as c, sum(v) as s "
+                        "from wide group by k",
+}
+
+VARIANTS = {
+    "radix": dict(radix_partitions=8),
+    # 1-byte budget: EVERY partition takes the hybrid spill path
+    "forced_spill": dict(radix_partitions=4, join_spill_budget_bytes=1),
+}
+
+
+@pytest.fixture(scope="module")
+def runners(catalog):
+    base = LocalRunner(catalog, ExecConfig(batch_rows=1 << 11))
+    variants = {name: LocalRunner(catalog,
+                                  ExecConfig(batch_rows=1 << 11, **kw))
+                for name, kw in VARIANTS.items()}
+    return base, variants
+
+
+@pytest.mark.parametrize("variant", list(VARIANTS))
+@pytest.mark.parametrize("query", list(QUERIES))
+def test_partitioned_matches_unpartitioned(runners, query, variant):
+    base, variants = runners
+    exp = base.run(QUERIES[query])
+    got = variants[variant].run(QUERIES[query])
+    assert_frames_match(got, exp)
+
+
+def test_radix_agg_gate(catalog):
+    # small CBO presize (5 distinct g values) keeps the radix group-by
+    # OFF without a spill budget; a high-NDV key (or any budget) opens it
+    r = LocalRunner(catalog, ExecConfig(batch_rows=1 << 11,
+                                        radix_partitions=8))
+    r.run(QUERIES["groupby_dict_key"])
+    assert "radix.agg_engaged" not in (r.last_stats or {})
+    r.run(QUERIES["groupby_high_ndv"])
+    assert (r.last_stats or {}).get("radix.agg_engaged")
+    rb = LocalRunner(catalog, ExecConfig(batch_rows=1 << 11,
+                                         radix_partitions=8,
+                                         join_spill_budget_bytes=1 << 30))
+    rb.run(QUERIES["groupby_dict_key"])
+    assert (rb.last_stats or {}).get("radix.agg_engaged")
+
+
+def test_forced_spill_actually_spilled(catalog):
+    r = LocalRunner(catalog, ExecConfig(batch_rows=1 << 11,
+                                        radix_partitions=4,
+                                        join_spill_budget_bytes=1))
+    r.run(QUERIES["inner"])
+    stats = r.last_stats or {}
+    assert stats.get("radix.partitions_spilled", 0) >= 1
+    assert stats.get("radix.spill_bytes", 0) > 0
+
+
+def test_tagged_pages_reach_ungated_aggregate(catalog):
+    # the aligned exchange sink stamps radix tags without seeing the CBO
+    # gate; a low-NDV final aggregate (gate closed) must strip them
+    # instead of passing TaggedBatch into jit
+    from presto_tpu.server.coordinator import DistributedRunner
+
+    sql = ("select g, count(*) as c from probe group by g order by g")
+    base = LocalRunner(catalog, ExecConfig()).run(sql)
+    cfg = ExecConfig(batch_rows=1 << 11, radix_partitions=4)
+    with DistributedRunner(catalog, n_workers=2, config=cfg) as dr:
+        got = dr.run_batch(sql).to_pandas()
+    assert_frames_match(got, base)
+
+
+# -- kernels ----------------------------------------------------------------
+
+
+def _toy_batch(keys, live=None):
+    keys = np.asarray(keys, dtype=np.int64)
+    n = len(keys)
+    if live is None:
+        live = np.ones(n, dtype=bool)
+    return Batch(["k"], [BIGINT], [Column(jnp.asarray(keys))],
+                 jnp.asarray(live), {})
+
+
+def test_radix_ids_top_bits_in_range():
+    from presto_tpu.ops.radix import radix_ids
+
+    b = _toy_batch(np.arange(256))
+    ids = np.asarray(radix_ids(b, ("k",), 8))
+    assert ids.min() >= 0 and ids.max() < 8
+    # one partition must not swallow everything (top-bit mixing works)
+    assert len(np.unique(ids)) > 1
+
+
+def test_radix_ids_rejects_non_pow2():
+    from presto_tpu.ops.radix import radix_bits
+
+    with pytest.raises(ValueError):
+        radix_bits(6)
+
+
+def test_radix_sort_window_partition_exactly():
+    from presto_tpu.ops.radix import radix_ids, radix_sort, radix_window
+
+    rng = np.random.default_rng(3)
+    keys = rng.integers(0, 1 << 40, 128)
+    live = rng.random(128) < 0.8
+    b = _toy_batch(keys, live)
+    P = 4
+    want_ids = np.asarray(radix_ids(b, ("k",), P))
+    sb, counts = radix_sort(b, ("k",), P)
+    cnts = np.asarray(counts)
+    assert cnts.sum() == live.sum()     # dead rows fall out of every bucket
+    starts = np.concatenate([[0], np.cumsum(cnts)])
+    seen = []
+    for p in range(P):
+        n = int(cnts[p])
+        if n == 0:
+            continue
+        w = radix_window(sb, np.int32(starts[p]), np.int32(n), bucket=128)
+        wk = np.asarray(w.columns[0].values)[np.asarray(w.live)]
+        assert len(wk) == n
+        # every row in window p radix-hashes to p
+        wb = _toy_batch(wk)
+        assert (np.asarray(radix_ids(wb, ("k",), P)) == p).all()
+        seen.extend(wk.tolist())
+    assert sorted(seen) == sorted(keys[live].tolist())
+
+
+# -- partition-aligned wire tag + by-ref dictionaries -----------------------
+
+
+def _dict_batch(n_dict_values):
+    vals = np.array([f"s{i:04d}" for i in range(n_dict_values)],
+                    dtype=object)
+    codes = jnp.arange(8, dtype=jnp.int32) % n_dict_values
+    from presto_tpu.types import VARCHAR
+
+    return Batch(["k", "s"], [BIGINT, VARCHAR],
+                 [Column(jnp.arange(8, dtype=jnp.int64)), Column(codes)],
+                 jnp.ones(8, dtype=bool), {"s": Dictionary(vals)})
+
+
+def _page_header(page):
+    hlen, _ = struct.unpack_from("<II", page, 5)
+    return json.loads(page[13:13 + hlen])
+
+
+def test_radix_tag_roundtrip():
+    from presto_tpu import serde
+
+    page = serde.serialize_batch(_dict_batch(4), radix=(3, 8, ("k",)))
+    out = serde.deserialize_batch(page)
+    assert isinstance(out, serde.TaggedBatch)
+    assert out.radix == (3, 8, ("k",))
+    # untagged pages stay plain Batch
+    plain = serde.deserialize_batch(serde.serialize_batch(_dict_batch(4)))
+    assert type(plain) is Batch
+
+
+def test_dict_refs_on_wire_and_resolution():
+    from presto_tpu import serde
+
+    b = _dict_batch(200)               # > inline cap → by-ref
+    page = serde.serialize_batch(b, dict_refs=True)
+    hdr = _page_header(page)
+    assert isinstance(hdr["dicts"]["s"], dict) and "ref" in hdr["dicts"]["s"]
+    # producer interned it during serialize: resolves with no side channel
+    out = serde.deserialize_batch(page)
+    assert list(out.dicts["s"].values) == list(b.dicts["s"].values)
+    # intern miss → the resolver is consulted exactly once
+    with serde._DICT_INTERN_LOCK:
+        serde._DICT_INTERN.clear()
+    calls = []
+
+    def resolver(digest):
+        calls.append(digest)
+        return [str(v) for v in b.dicts["s"].values]
+
+    out2 = serde.deserialize_batch(page, dict_resolver=resolver)
+    assert len(calls) == 1
+    assert list(out2.dicts["s"].values) == list(b.dicts["s"].values)
+    # miss with no resolver fails loudly
+    with serde._DICT_INTERN_LOCK:
+        serde._DICT_INTERN.clear()
+    with pytest.raises(ValueError):
+        serde.deserialize_batch(page)
+    # small dictionaries stay inline even with dict_refs on
+    small = serde.serialize_batch(_dict_batch(4), dict_refs=True)
+    assert isinstance(_page_header(small)["dicts"]["s"], list)
+
+
+# -- broadcast buffer shared-page accounting --------------------------------
+
+
+def test_broadcast_bytes_counted_once():
+    from presto_tpu.server.buffers import OutputBuffer
+
+    buf = OutputBuffer(3, broadcast=True)
+    page = b"x" * 1000
+    buf.enqueue(None, page)
+    assert buf.buffered_bytes() == 1000  # was 3000 before refcounting
+    # each consumer still reads the full page
+    for p in range(3):
+        pages, nxt, _ = buf.get(p, 0, max_wait_s=0)
+        assert pages == [page]
+    # bytes release only when the LAST consumer acks
+    buf.ack(0, 1)
+    buf.ack(1, 1)
+    assert buf.buffered_bytes() == 1000
+    buf.ack(2, 1)
+    assert buf.buffered_bytes() == 0
+
+
+def test_broadcast_abort_releases_last_ref():
+    from presto_tpu.server.buffers import OutputBuffer
+
+    buf = OutputBuffer(2, broadcast=True)
+    buf.enqueue(None, b"y" * 500)
+    assert buf.buffered_bytes() == 500
+    buf.ack(0, 1)
+    assert buf.buffered_bytes() == 500
+    buf.abort(1)
+    assert buf.buffered_bytes() == 0
