@@ -92,6 +92,57 @@ if [ "$rc" -ne 0 ]; then
   exit "$rc"
 fi
 
+# Static-analysis step: the kernel lint must be clean over the shipped
+# tree, the analyzer must actually FAIL on an injected violation (a
+# linter that can't fail is decoration), the plan-invariant checker must
+# pass over every TPC-H tier-1 plan (re-checked after each optimizer
+# pass), and a representative query must execute under the
+# bounded-recompile guard.
+echo "== analysis: kernel lint + plan invariants + recompile guard =="
+env JAX_PLATFORMS=cpu python -m presto_tpu.analysis
+rc=$?
+if [ "$rc" -ne 0 ]; then
+  echo "analysis step FAILED: shipped tree does not lint clean (exit $rc)"
+  exit 1
+fi
+inj="$(mktemp -d)/ops"; mkdir -p "$inj"
+cat > "$inj/injected.py" <<'PYEOF'
+def kernel(x):
+    if jnp.any(x > 0):
+        return float(x.sum())
+    return jnp.zeros(100)
+PYEOF
+env JAX_PLATFORMS=cpu python -m presto_tpu.analysis "$inj/injected.py" \
+    > /tmp/_inj.log 2>&1
+rc=$?
+rm -rf "$(dirname "$inj")"
+if [ "$rc" -eq 0 ]; then
+  echo "analysis step FAILED: injected violation was NOT detected"
+  cat /tmp/_inj.log
+  exit 1
+fi
+grep -q "injected.py:2: \[traced-branch\]" /tmp/_inj.log \
+  && grep -q "injected.py:3: \[host-sync\]" /tmp/_inj.log \
+  && grep -q "injected.py:4: \[pow2-capacity\]" /tmp/_inj.log
+if [ $? -ne 0 ]; then
+  echo "analysis step FAILED: injected findings missing rule/file:line"
+  cat /tmp/_inj.log
+  exit 1
+fi
+echo "injected-violation self-check OK (exit $rc, 3 rules attributed)"
+env JAX_PLATFORMS=cpu python -m presto_tpu.analysis --no-lint --tpch-plans
+rc=$?
+if [ "$rc" -ne 0 ]; then
+  echo "analysis step FAILED: TPC-H plan invariants (exit $rc)"
+  exit 1
+fi
+env JAX_PLATFORMS=cpu python -m presto_tpu.analysis --no-lint --tpch-run q1,q6
+rc=$?
+if [ "$rc" -ne 0 ]; then
+  echo "analysis step FAILED: recompile guard over TPC-H (exit $rc)"
+  exit 1
+fi
+
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
   --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly \

@@ -1,0 +1,51 @@
+"""Static-analysis plane: plan-IR invariant checking, TPU kernel
+linting, and the bounded-recompile guard.
+
+Three checkers, one findings vocabulary (findings.Finding), one CLI
+(`python -m presto_tpu.analysis` — text or JSON, nonzero exit on any
+finding):
+
+- plan_check: every PlanNode tree / DistributedPlan upholds the schema,
+  key-dtype, and exchange-wiring invariants the optimizer and fragmenter
+  are supposed to preserve; interposable into optimize() so a violation
+  is attributed to the rewrite that introduced it.
+- kernel_lint: ast rules over the device-kernel modules — host-sync
+  hazards, implicit float64, data-dependent branches on traced arrays,
+  non-pow2 capacity constants.
+- recompile: `_node_jit` compile counts stay under a per-program shape
+  budget, making "bounded compiled shapes" an enforced invariant.
+"""
+
+from presto_tpu.analysis.findings import Finding, render_json, render_text
+from presto_tpu.analysis.kernel_lint import RULES, lint_paths, lint_source
+from presto_tpu.analysis.plan_check import (
+    PlanInvariantError,
+    check_distributed,
+    check_plan,
+    check_query_plan,
+)
+from presto_tpu.analysis.recompile import (
+    DEFAULT_SHAPE_BUDGET,
+    RecompileBudgetError,
+    check_recompiles,
+    enforce,
+    iter_jit_stats,
+)
+
+__all__ = [
+    "Finding",
+    "render_json",
+    "render_text",
+    "RULES",
+    "lint_paths",
+    "lint_source",
+    "PlanInvariantError",
+    "check_plan",
+    "check_query_plan",
+    "check_distributed",
+    "DEFAULT_SHAPE_BUDGET",
+    "RecompileBudgetError",
+    "check_recompiles",
+    "enforce",
+    "iter_jit_stats",
+]

@@ -1,0 +1,192 @@
+"""CLI for the static-analysis plane.
+
+Usage:
+
+  python -m presto_tpu.analysis [paths...] [--json] [--rules r1,r2]
+      lint the kernel modules (default scope: presto_tpu/ops/ +
+      presto_tpu/exec/runtime.py) — exit 1 on any finding
+  python -m presto_tpu.analysis --tpch-plans [--sf 0.01]
+      build + optimize + fragment the canonical TPC-H queries (texts
+      loaded from --queries, default tests/test_tpch.py) and run the
+      plan-invariant checker on every local and distributed plan
+  python -m presto_tpu.analysis --tpch-run q1,q6 [--shape-budget N]
+      execute the named TPC-H queries with the bounded-recompile guard
+      enforced
+
+Modes compose; findings from all requested planes are merged into one
+text or JSON document and the exit code is 1 iff any finding exists.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import List
+
+from presto_tpu.analysis.findings import Finding, render_json, render_text
+
+
+def _default_scope() -> List[str]:
+    import presto_tpu
+
+    pkg = os.path.dirname(os.path.abspath(presto_tpu.__file__))
+    return [os.path.join(pkg, "ops"),
+            os.path.join(pkg, "exec", "runtime.py")]
+
+
+def _load_queries(path: str) -> dict:
+    """Load the QUERIES dict from the canonical TPC-H test module (the
+    single source of query texts in this repo) without requiring tests/
+    to be an importable package."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location("_tpch_queries", path)
+    if spec is None or spec.loader is None:
+        raise FileNotFoundError(path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return dict(mod.QUERIES)
+
+
+def _check_tpch_plans(sf: float, queries_path: str) -> List[Finding]:
+    from presto_tpu.analysis.plan_check import (
+        check_distributed,
+        check_query_plan,
+    )
+    from presto_tpu.catalog.tpch import tpch_catalog
+    from presto_tpu.plan.builder import plan_query
+    from presto_tpu.plan.fragmenter import fragment_plan
+    from presto_tpu.plan.optimizer import optimize
+
+    catalog = tpch_catalog(sf)
+    findings: List[Finding] = []
+    queries = _load_queries(queries_path)
+    for name in sorted(queries):
+        sql = queries[name]
+        try:
+            qp = optimize(plan_query(sql, catalog), catalog,
+                          debug_checks=True)
+        except Exception as e:
+            findings.append(Finding("plan-build", f"tpch {name}",
+                                    f"{type(e).__name__}: {e}", "plan"))
+            continue
+        for f in check_query_plan(qp):
+            findings.append(Finding(f.rule, f"tpch {name}: {f.loc}",
+                                    f.message, "plan"))
+        if qp.scalar_subqueries:
+            # fragmentation requires bound scalar subqueries; local
+            # checking above already covered the subplans
+            continue
+        try:
+            dp = fragment_plan(qp, catalog)
+        except Exception as e:
+            findings.append(Finding("plan-build", f"tpch {name} (dist)",
+                                    f"{type(e).__name__}: {e}", "plan"))
+            continue
+        for f in check_distributed(dp):
+            findings.append(Finding(f.rule, f"tpch {name} (dist): {f.loc}",
+                                    f.message, "plan"))
+    return findings
+
+
+def _run_tpch_guarded(names: List[str], sf: float, queries_path: str,
+                      budget: int) -> List[Finding]:
+    import dataclasses
+
+    from presto_tpu.analysis.recompile import check_recompiles
+    from presto_tpu.catalog.tpch import tpch_catalog
+    from presto_tpu.exec import ExecConfig, LocalRunner
+
+    queries = _load_queries(queries_path)
+    runner = LocalRunner(
+        tpch_catalog(sf),
+        dataclasses.replace(ExecConfig(batch_rows=1 << 14,
+                                       agg_capacity=1 << 10),
+                            max_compiled_shapes=budget))
+    findings: List[Finding] = []
+    for name in names:
+        if name not in queries:
+            findings.append(Finding("plan-build", f"tpch {name}",
+                                    "unknown query name", "recompile"))
+            continue
+        try:
+            runner.run(queries[name])
+        except Exception as e:
+            findings.append(Finding("shape-budget", f"tpch {name}",
+                                    f"{type(e).__name__}: {e}",
+                                    "recompile"))
+            continue
+        qp = runner._plan_cache.get(queries[name])
+        if qp is not None:
+            for f in check_recompiles(qp.root, budget):
+                findings.append(Finding(f.rule, f"tpch {name}: {f.loc}",
+                                        f.message, "recompile"))
+    return findings
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m presto_tpu.analysis",
+        description="presto_tpu static analysis: kernel lint, plan "
+                    "invariants, recompile guard")
+    ap.add_argument("paths", nargs="*",
+                    help="files/directories to lint (default: the kernel "
+                         "modules)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit findings as JSON")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated lint rule subset")
+    ap.add_argument("--no-lint", action="store_true",
+                    help="skip the kernel lint plane")
+    ap.add_argument("--tpch-plans", action="store_true",
+                    help="check plan invariants over the TPC-H queries")
+    ap.add_argument("--tpch-run", default=None, metavar="q1,q6",
+                    help="execute TPC-H queries with the recompile guard")
+    ap.add_argument("--sf", type=float, default=0.01,
+                    help="TPC-H scale factor (default 0.01)")
+    ap.add_argument("--queries", default="tests/test_tpch.py",
+                    help="module file providing the QUERIES dict")
+    ap.add_argument("--shape-budget", type=int, default=None,
+                    help="compiled-shape budget per node program")
+    args = ap.parse_args(argv)
+
+    findings: List[Finding] = []
+    planes: List[str] = []
+    if not args.no_lint:
+        from presto_tpu.analysis.kernel_lint import RULES, lint_paths
+
+        rules = (tuple(r.strip() for r in args.rules.split(","))
+                 if args.rules else RULES)
+        paths = args.paths or _default_scope()
+        try:
+            findings.extend(lint_paths(paths, rules))
+        except OSError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        planes.append(f"lint ({', '.join(os.path.relpath(p) for p in paths)})")
+    if args.tpch_plans:
+        findings.extend(_check_tpch_plans(args.sf, args.queries))
+        planes.append("tpch plan invariants")
+    if args.tpch_run:
+        from presto_tpu.analysis.recompile import DEFAULT_SHAPE_BUDGET
+
+        budget = (DEFAULT_SHAPE_BUDGET if args.shape_budget is None
+                  else args.shape_budget)
+        names = [n.strip() for n in args.tpch_run.split(",") if n.strip()]
+        findings.extend(
+            _run_tpch_guarded(names, args.sf, args.queries, budget))
+        planes.append(f"tpch recompile guard ({', '.join(names)})")
+
+    if args.json:
+        print(render_json(findings, {"planes": planes}))
+    else:
+        if findings:
+            print(render_text(findings))
+        else:
+            print(f"clean: {'; '.join(planes)} — 0 findings")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

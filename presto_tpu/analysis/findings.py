@@ -1,0 +1,52 @@
+"""Shared finding model for the static-analysis plane.
+
+Every checker in this package (plan invariants, kernel lint, recompile
+guard) reports the same flat record so the CLI can render one text or
+JSON document and CI can gate on a single exit code. The shape mirrors
+the reference engine's validation surfaces — PlanSanityChecker emits
+(rule, node, message) triples, error-prone emits (check, file:line,
+message) — collapsed into one vocabulary.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import List, Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One violation: `rule` is a stable kebab-case id, `loc` a
+    human-meaningful anchor (``file.py:123`` for source findings,
+    ``fragment 2: HashJoin`` for plan findings, ``node Aggregate/key
+    'step'`` for runtime findings), `message` the explanation."""
+
+    rule: str
+    loc: str
+    message: str
+    # which checker produced it: "plan" | "lint" | "recompile"
+    plane: str = "lint"
+
+    def to_json(self) -> dict:
+        return {"rule": self.rule, "loc": self.loc,
+                "message": self.message, "plane": self.plane}
+
+    def __str__(self) -> str:
+        return f"{self.loc}: [{self.rule}] {self.message}"
+
+
+def render_text(findings: List[Finding]) -> str:
+    lines = [str(f) for f in findings]
+    n = len(findings)
+    lines.append(f"{n} finding{'s' if n != 1 else ''}")
+    return "\n".join(lines)
+
+
+def render_json(findings: List[Finding],
+                extra: Optional[dict] = None) -> str:
+    doc = {"findings": [f.to_json() for f in findings],
+           "count": len(findings)}
+    if extra:
+        doc.update(extra)
+    return json.dumps(doc, indent=2, sort_keys=True)

@@ -1,0 +1,458 @@
+"""Python-`ast` linter for TPU kernel code.
+
+Scope: the device-kernel modules (`presto_tpu/ops/*.py`) and the jitted
+regions of the runtime driver (`presto_tpu/exec/runtime.py`). The rules
+encode the discipline the engine's hot path depends on — every violation
+class here has produced a real regression shape in engines of this
+design (silent host round-trips, f64 emulation on f32 hardware,
+per-batch recompiles):
+
+- ``host-sync``: `.item()`, `float(x)` / `int(x)` / `bool(x)` on
+  non-static values, and `np.asarray` / `np.array` inside traced code.
+  Each forces a device→host transfer per call (~70-90 ms on a tunneled
+  TPU) or breaks tracing outright.
+- ``float64``: implicit f64 creation — `np.float64(...)` scalars (strong
+  typed: they infect f32/weak arrays), array constructors
+  (`zeros/ones/full/empty`) without an explicit dtype (this engine runs
+  with x64 enabled, so the default is f64), `dtype=float`, and
+  `array(...)` literals containing bare floats with no dtype.
+- ``traced-branch``: Python `if` / `while` whose test calls into
+  `jnp.` / `jax.` or `.any()` / `.all()` — a data-dependent branch on a
+  traced array (TracerBoolConversionError at best, a silent host sync
+  under concrete re-execution at worst).
+- ``pow2-capacity``: integer capacity constants in shape positions that
+  are not powers of two. Every distinct capacity is a distinct compiled
+  program; the blessed path is `round_up_capacity` / the pow2 bucket
+  helpers, never a bare odd constant.
+
+Kernel-region detection: in `ops/` every function is kernel code (they
+are device-kernel libraries). Elsewhere a function is kernel code iff it
+is reachable from a jit root — decorated with `jax.jit` /
+`partial(jax.jit, ...)`, passed to `jax.jit(...)`, or returned by a
+builder passed to `_node_jit(...)` — transitively through same-module
+calls.
+
+Suppressions: append ``# lint: allow(<rule>[, <rule>...])`` to the
+offending line; on a `def` line it covers the whole function.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from presto_tpu.analysis.findings import Finding
+
+RULES = ("host-sync", "float64", "traced-branch", "pow2-capacity")
+
+_ALLOW_RE = re.compile(r"#\s*lint:\s*allow\(([a-z0-9_,\- ]+)\)")
+
+_NUMPY_ALIASES = {"np", "numpy"}
+_JAX_NUMPY_ALIASES = {"jnp"}
+_ARRAY_CTORS = {"zeros", "ones", "full", "empty"}
+_SHAPE_CTORS = {"zeros", "ones", "full", "empty", "arange", "iota",
+                "broadcasted_iota"}
+_CAPACITY_KWARGS = {"capacity", "cap", "bucket", "num_groups_cap",
+                    "out_cap", "minimum", "num_segments"}
+# attribute tails that are static at trace time (shapes, type params)
+_STATIC_ATTRS = {"shape", "ndim", "size", "capacity", "width", "scale",
+                 "precision", "dtype", "itemsize", "bits"}
+_BLESSED_HELPERS = {"round_up_capacity"}
+# jnp/np calls that are dtype metadata queries — static at trace time,
+# so branching on them is shape/type dispatch, not a traced branch
+_DTYPE_PREDICATES = {"issubdtype", "isdtype", "iinfo", "finfo",
+                     "result_type", "promote_types", "dtype",
+                     "canonicalize_dtype"}
+
+
+def _is_pow2(n: int) -> bool:
+    return n >= 0 and (n & (n - 1)) == 0
+
+
+def _root_name(e: ast.expr) -> Optional[str]:
+    while isinstance(e, ast.Attribute):
+        e = e.value
+    return e.id if isinstance(e, ast.Name) else None
+
+
+def _attr_chain(e: ast.expr) -> Optional[Tuple[str, str]]:
+    """`np.float64` -> ("np", "float64"); one-level chains only."""
+    if isinstance(e, ast.Attribute) and isinstance(e.value, ast.Name):
+        return e.value.id, e.attr
+    return None
+
+
+def _is_static_expr(e: ast.expr) -> bool:
+    """Conservatively true when an expression is compile-time static:
+    literals, len()/shape/type-parameter access, arithmetic over those."""
+    if isinstance(e, ast.Constant):
+        return True
+    if isinstance(e, ast.Attribute):
+        return e.attr in _STATIC_ATTRS or _is_static_expr(e.value)
+    if isinstance(e, ast.Subscript):
+        return _is_static_expr(e.value)
+    if isinstance(e, ast.BinOp):
+        return _is_static_expr(e.left) and _is_static_expr(e.right)
+    if isinstance(e, ast.UnaryOp):
+        return _is_static_expr(e.operand)
+    if isinstance(e, ast.Call):
+        fn = e.func
+        if isinstance(fn, ast.Name) and fn.id == "len":
+            # len() of anything (including a traced array) is a host int
+            return True
+        if isinstance(fn, ast.Name) and fn.id in (
+                {"max", "min", "abs"} | _BLESSED_HELPERS):
+            return all(_is_static_expr(a) for a in e.args)
+        chain = _attr_chain(fn)
+        if chain and chain[1] == "bit_length":
+            return True
+        if isinstance(fn, ast.Attribute) and fn.attr in ("get",):
+            return False
+        return False
+    if isinstance(e, ast.IfExp):
+        return (_is_static_expr(e.test) and _is_static_expr(e.body)
+                and _is_static_expr(e.orelse))
+    return False
+
+
+class _Suppressions:
+    def __init__(self, source: str):
+        self.lines: Dict[int, Set[str]] = {}
+        for i, line in enumerate(source.splitlines(), start=1):
+            m = _ALLOW_RE.search(line)
+            if m:
+                self.lines[i] = {r.strip() for r in m.group(1).split(",")}
+        # function-level: allow() on a def/lambda line covers its body
+        self.spans: List[Tuple[int, int, Set[str]]] = []
+
+    def add_span(self, lo: int, hi: int, rules: Set[str]):
+        self.spans.append((lo, hi, rules))
+
+    def allowed(self, rule: str, line: int) -> bool:
+        if rule in self.lines.get(line, ()):
+            return True
+        return any(lo <= line <= hi and rule in rules
+                   for lo, hi, rules in self.spans)
+
+
+# ---------------------------------------------------------------------------
+# kernel-region discovery
+
+
+def _collect_functions(tree: ast.AST) -> Dict[str, List[ast.AST]]:
+    """name -> every def with that name, any nesting depth."""
+    out: Dict[str, List[ast.AST]] = {}
+    for n in ast.walk(tree):
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out.setdefault(n.name, []).append(n)
+    return out
+
+
+def _is_jax_jit(e: ast.expr) -> bool:
+    chain = _attr_chain(e)
+    if chain is not None:
+        return chain == ("jax", "jit")
+    return isinstance(e, ast.Name) and e.id == "jit"
+
+
+def _jit_roots(tree: ast.AST,
+               funcs: Dict[str, List[ast.AST]]) -> List[ast.AST]:
+    roots: List[ast.AST] = []
+
+    def add_target(e: ast.expr):
+        if isinstance(e, ast.Lambda):
+            roots.append(e)
+        elif isinstance(e, ast.Name):
+            roots.extend(funcs.get(e.id, ()))
+
+    for n in ast.walk(tree):
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in n.decorator_list:
+                if _is_jax_jit(dec):
+                    roots.append(n)
+                elif isinstance(dec, ast.Call):
+                    # @partial(jax.jit, ...) / @jax.jit(...)
+                    if _is_jax_jit(dec.func):
+                        roots.append(n)
+                    elif (isinstance(dec.func, ast.Name)
+                          and dec.func.id == "partial" and dec.args
+                          and _is_jax_jit(dec.args[0])):
+                        roots.append(n)
+        if not isinstance(n, ast.Call):
+            continue
+        if _is_jax_jit(n.func) and n.args:
+            add_target(n.args[0])
+        fname = (n.func.id if isinstance(n.func, ast.Name)
+                 else n.func.attr if isinstance(n.func, ast.Attribute)
+                 else None)
+        if fname == "_node_jit" and len(n.args) >= 3:
+            builder = n.args[2]
+            if isinstance(builder, ast.Lambda):
+                add_target(builder.body)
+            elif isinstance(builder, ast.Name):
+                # builder by reference: its return value is jitted; treat
+                # the builder body itself as kernel code (the inner defs
+                # are reached transitively)
+                roots.extend(funcs.get(builder.id, ()))
+    return roots
+
+
+def _called_names(fn: ast.AST) -> Set[str]:
+    out: Set[str] = set()
+    for n in ast.walk(fn):
+        if isinstance(n, ast.Call) and isinstance(n.func, ast.Name):
+            out.add(n.func.id)
+    return out
+
+
+def kernel_functions(tree: ast.AST, path: str) -> List[ast.AST]:
+    """The kernel region: every def in ops/ modules; jit-rooted defs (plus
+    same-module transitive callees) elsewhere."""
+    funcs = _collect_functions(tree)
+    norm = path.replace("\\", "/")
+    if "/ops/" in norm or norm.startswith("ops/"):
+        return [f for fs in funcs.values() for f in fs]
+    work = list(_jit_roots(tree, funcs))
+    seen: List[ast.AST] = []
+    seen_ids: Set[int] = set()
+    while work:
+        f = work.pop()
+        if id(f) in seen_ids:
+            continue
+        seen_ids.add(id(f))
+        seen.append(f)
+        for name in _called_names(f):
+            work.extend(funcs.get(name, ()))
+    return seen
+
+
+# ---------------------------------------------------------------------------
+# rules
+
+
+class _RuleVisitor(ast.NodeVisitor):
+    def __init__(self, path: str, supp: _Suppressions,
+                 rules: Sequence[str]):
+        self.path = path
+        self.supp = supp
+        self.rules = set(rules)
+        self.findings: List[Finding] = []
+
+    def err(self, rule: str, node: ast.AST, msg: str):
+        line = getattr(node, "lineno", 0)
+        if rule not in self.rules or self.supp.allowed(rule, line):
+            return
+        self.findings.append(
+            Finding(rule, f"{self.path}:{line}", msg, "lint"))
+
+    # do not descend into nested defs here; each kernel function is
+    # visited exactly once by the driver (nested defs are themselves in
+    # the kernel set when reachable)
+    def visit_body(self, fn: ast.AST):
+        body = fn.body if isinstance(fn.body, list) else [fn.body]
+        for stmt in body:
+            self.visit(stmt)
+
+    # -- host-sync ----------------------------------------------------------
+
+    def visit_Call(self, node: ast.Call):
+        fn = node.func
+        if isinstance(fn, ast.Attribute) and fn.attr == "item":
+            self.err("host-sync", node,
+                     ".item() forces a device→host sync inside traced "
+                     "code")
+        if isinstance(fn, ast.Name) and fn.id in ("float", "int", "bool") \
+                and node.args:
+            if not all(_is_static_expr(a) for a in node.args):
+                self.err("host-sync", node,
+                         f"{fn.id}() on a non-static value host-syncs (or "
+                         f"fails to trace); compute on-device with "
+                         f"jnp/astype instead")
+        chain = _attr_chain(fn)
+        if chain and chain[0] in _NUMPY_ALIASES and chain[1] in (
+                "asarray", "array"):
+            if not all(_is_static_expr(a) for a in node.args):
+                self.err("host-sync", node,
+                         f"np.{chain[1]}() on a traced value copies to "
+                         f"host; use jnp.{chain[1]} or keep it on-device")
+        self._check_float64(node, chain)
+        self._check_pow2(node, chain)
+        self.generic_visit(node)
+
+    # -- float64 ------------------------------------------------------------
+
+    def visit_Attribute(self, node: ast.Attribute):
+        chain = _attr_chain(node)
+        if chain and chain[0] in _NUMPY_ALIASES and chain[1] == "float64":
+            self.err("float64", node,
+                     "np.float64 is strongly typed and promotes f32/weak "
+                     "operands to f64; use the column's declared dtype")
+        self.generic_visit(node)
+
+    def _has_dtype(self, node: ast.Call, ctor: str) -> bool:
+        if any(kw.arg == "dtype" for kw in node.keywords):
+            return True
+        # positional dtype: zeros(shape, dtype) / full(shape, fill, dtype)
+        # / arange(n, dtype) — any arg beyond the shape/fill slots
+        slots = {"zeros": 1, "ones": 1, "empty": 1, "full": 2}
+        return len(node.args) > slots.get(ctor, 1)
+
+    def _check_float64(self, node: ast.Call, chain):
+        if chain is None:
+            return
+        mod, name = chain
+        if mod not in (_NUMPY_ALIASES | _JAX_NUMPY_ALIASES):
+            return
+        for kw in node.keywords:
+            if kw.arg == "dtype" and isinstance(kw.value, ast.Name) \
+                    and kw.value.id == "float":
+                self.err("float64", node,
+                         "dtype=float is float64; name the intended width "
+                         "explicitly")
+        if name in _ARRAY_CTORS and not self._has_dtype(node, name):
+            self.err("float64", node,
+                     f"{mod}.{name}() without an explicit dtype creates "
+                     f"float64 under x64; pass the intended dtype")
+        if name in ("array", "asarray") \
+                and not any(kw.arg == "dtype" for kw in node.keywords) \
+                and len(node.args) == 1 and _has_bare_float(node.args[0]):
+            self.err("float64", node,
+                     f"{mod}.{name}() over bare float literals with no "
+                     f"dtype creates a strong float64 array")
+
+    # -- pow2-capacity -------------------------------------------------------
+
+    def _check_pow2(self, node: ast.Call, chain):
+        fname = None
+        if chain is not None:
+            mod, name = chain
+            if mod in (_NUMPY_ALIASES | _JAX_NUMPY_ALIASES | {"lax"}):
+                fname = name
+        elif isinstance(node.func, ast.Name):
+            fname = node.func.id
+        if fname in _SHAPE_CTORS and node.args:
+            self._pow2_value(node.args[0], node)
+        for kw in node.keywords:
+            if kw.arg in _CAPACITY_KWARGS:
+                self._pow2_value(kw.value, node)
+
+    def _pow2_value(self, e: ast.expr, node: ast.Call):
+        vals = []
+        if isinstance(e, ast.Constant) and isinstance(e.value, int) \
+                and not isinstance(e.value, bool):
+            vals = [e.value]
+        elif isinstance(e, ast.Tuple):
+            vals = [el.value for el in e.elts
+                    if isinstance(el, ast.Constant)
+                    and isinstance(el.value, int)
+                    and not isinstance(el.value, bool)]
+        for v in vals:
+            if v > 1 and not _is_pow2(v):
+                self.err("pow2-capacity", node,
+                         f"capacity constant {v} is not a power of two — "
+                         f"each distinct capacity is a distinct compiled "
+                         f"program; route sizes through "
+                         f"round_up_capacity()")
+
+    # -- traced-branch -------------------------------------------------------
+
+    def _test_is_traced(self, test: ast.expr) -> bool:
+        for n in ast.walk(test):
+            if isinstance(n, ast.Call):
+                root = _root_name(n.func)
+                if (isinstance(n.func, ast.Attribute)
+                        and n.func.attr in _DTYPE_PREDICATES):
+                    continue
+                if root in (_JAX_NUMPY_ALIASES | {"jax", "lax"}):
+                    return True
+                if isinstance(n.func, ast.Attribute) and n.func.attr in (
+                        "any", "all"):
+                    return True
+        return False
+
+    def visit_If(self, node: ast.If):
+        if self._test_is_traced(node.test):
+            self.err("traced-branch", node,
+                     "Python branch on a traced array value — lower to "
+                     "jnp.where / lax.cond, or hoist the decision to the "
+                     "host driver")
+        self.generic_visit(node)
+
+    def visit_While(self, node: ast.While):
+        if self._test_is_traced(node.test):
+            self.err("traced-branch", node,
+                     "Python loop condition on a traced array value — use "
+                     "lax.while_loop or drive the loop from the host")
+        self.generic_visit(node)
+
+
+def _has_bare_float(e: ast.expr) -> bool:
+    for n in ast.walk(e):
+        if isinstance(n, ast.Constant) and isinstance(n.value, float):
+            return True
+    return False
+
+
+# ---------------------------------------------------------------------------
+# driver
+
+
+def lint_source(source: str, path: str,
+                rules: Sequence[str] = RULES) -> List[Finding]:
+    """Lint one module's source text; `path` labels the findings."""
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [Finding("syntax-error", f"{path}:{e.lineno or 0}",
+                        str(e.msg), "lint")]
+    supp = _Suppressions(source)
+    kernels = kernel_functions(tree, path)
+    # def-line suppressions cover the function body
+    for fn in kernels:
+        line = getattr(fn, "lineno", None)
+        end = getattr(fn, "end_lineno", None)
+        if line is not None and end is not None and line in supp.lines:
+            supp.add_span(line, end, supp.lines[line])
+    findings: List[Finding] = []
+    visited: Set[int] = set()
+    nested: Set[int] = set()
+    kernel_ids = {id(f) for f in kernels}
+    # visit outermost kernel functions only: generic_visit descends into
+    # nested defs already, and double-visiting double-reports
+    for fn in kernels:
+        for sub in ast.walk(fn):
+            if sub is not fn and id(sub) in kernel_ids:
+                nested.add(id(sub))
+    for fn in kernels:
+        if id(fn) in visited or id(fn) in nested:
+            continue
+        visited.add(id(fn))
+        v = _RuleVisitor(path, supp, rules)
+        body = fn.body if isinstance(fn.body, list) else [fn.body]
+        for stmt in body:
+            v.visit(stmt)
+        findings.extend(v.findings)
+    # stable order, dedup (a def reachable through two roots reports once)
+    uniq = {}
+    for f in findings:
+        uniq[(f.rule, f.loc, f.message)] = f
+    return sorted(uniq.values(), key=lambda f: (f.loc, f.rule))
+
+
+def lint_paths(paths: Sequence[str],
+               rules: Sequence[str] = RULES) -> List[Finding]:
+    import os
+
+    findings: List[Finding] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for name in sorted(os.listdir(p)):
+                if name.endswith(".py"):
+                    findings.extend(
+                        lint_paths([os.path.join(p, name)], rules))
+            continue
+        with open(p, encoding="utf-8") as f:
+            src = f.read()
+        findings.extend(lint_source(src, p, rules))
+    return findings

@@ -1,0 +1,427 @@
+"""Plan-IR invariant checker.
+
+Analog of the reference's sanity surface (sql/planner/sanity/
+PlanSanityChecker.java + ValidateDependenciesChecker): every optimizer
+rewrite and every fragmenter cut must leave a tree where
+
+- every symbol a node references exists in its children's output
+  (``dangling-column``),
+- equi-join / set-operation key columns agree on device dtype
+  (``key-dtype-mismatch``),
+- Aggregate / Window inputs resolve — including the partial/final state
+  column vocabulary of a split aggregation (``agg-input`` /
+  ``window-input``),
+- every node's `output` schema is computable at all (``schema-error``),
+- in a DistributedPlan, RemoteSource ↔ Fragment wiring is sound
+  (``fragment-wiring``) and partition-aligned exchanges carry exactly
+  the consumer breaker's keys with matching arity/dtype on both sides
+  (``radix-align``).
+
+Used three ways: `check_plan` on any single-node tree,
+`check_distributed` on a fragmented plan, and interposed into
+plan/optimizer.optimize() (debug mode) so a violation is attributed to
+the rewrite pass that introduced it.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Set, Tuple
+
+from presto_tpu.analysis.findings import Finding
+from presto_tpu.expr.ir import expr_inputs
+from presto_tpu.plan.nodes import (
+    Aggregate,
+    Filter,
+    HashJoin,
+    HostProject,
+    IndexJoin,
+    Limit,
+    NestedLoopJoin,
+    OneRow,
+    Output,
+    PlanNode,
+    Project,
+    QueryPlan,
+    RemoteSource,
+    SemiJoin,
+    SetOp,
+    Sort,
+    TableScan,
+    TableWriter,
+    Unnest,
+    Window,
+)
+
+
+class PlanInvariantError(ValueError):
+    """Raised by the optimizer debug interposition: carries the findings
+    plus the name of the rewrite pass that introduced them."""
+
+    def __init__(self, pass_name: str, findings: List[Finding]):
+        self.pass_name = pass_name
+        self.findings = findings
+        lines = "\n".join(f"  {f}" for f in findings)
+        super().__init__(
+            f"plan invariant violated after pass {pass_name!r}:\n{lines}")
+
+
+def _loc(node: PlanNode, path: Tuple[str, ...]) -> str:
+    return "/".join(path + (type(node).__name__,))
+
+
+def _out_types(node: PlanNode) -> Optional[Dict[str, object]]:
+    try:
+        return dict(node.output)
+    except Exception:
+        return None
+
+
+def _dtype_of(t) -> Optional[str]:
+    try:
+        return str(t.dtype)
+    except Exception:
+        return None
+
+
+class _Checker:
+    def __init__(self):
+        self.findings: List[Finding] = []
+
+    def err(self, rule: str, node: PlanNode, path, msg: str):
+        self.findings.append(Finding(rule, _loc(node, path), msg, "plan"))
+
+    # -- helpers ------------------------------------------------------------
+
+    def _resolve(self, node, path, rule: str, syms, avail: Dict[str, object],
+                 what: str):
+        for s in syms:
+            if s is not None and s not in avail:
+                self.err(rule, node, path,
+                         f"{what} references {s!r}, not produced by its "
+                         f"input (has: {sorted(avail)[:12]}...)"
+                         if len(avail) > 12 else
+                         f"{what} references {s!r}, not produced by its "
+                         f"input (has: {sorted(avail)})")
+
+    def _keys_agree(self, node, path, lkeys, rkeys, ltypes, rtypes,
+                    what: str):
+        if len(lkeys) != len(rkeys):
+            self.err("key-dtype-mismatch", node, path,
+                     f"{what} key arity differs: {lkeys} vs {rkeys}")
+            return
+        for lk, rk in zip(lkeys, rkeys):
+            lt, rt = ltypes.get(lk), rtypes.get(rk)
+            if lt is None or rt is None:
+                continue  # dangling-column already reported
+            ld, rd = _dtype_of(lt), _dtype_of(rt)
+            if ld is not None and rd is not None and ld != rd:
+                self.err("key-dtype-mismatch", node, path,
+                         f"{what} key pair {lk!r}={rk!r} disagrees on "
+                         f"device dtype: {lt} ({ld}) vs {rt} ({rd})")
+
+    # -- walk ---------------------------------------------------------------
+
+    def check(self, node: PlanNode, path: Tuple[str, ...] = ()):
+        kids = node.children()
+        child_path = path + (type(node).__name__,)
+        for c in kids:
+            self.check(c, child_path)
+
+        outs = [_out_types(c) for c in kids]
+        for c, o in zip(kids, outs):
+            if o is None:
+                self.err("schema-error", c, child_path,
+                         "output schema is not computable (a child column "
+                         "it derives from is missing)")
+        # a broken child schema poisons every rule below — stop here and
+        # let the deepest finding carry the attribution
+        if any(o is None for o in outs):
+            return
+        avail: Dict[str, object] = {}
+        for o in outs:
+            avail.update(o)
+
+        if isinstance(node, Filter):
+            self._resolve(node, path, "dangling-column",
+                          expr_inputs(node.predicate), avail,
+                          "filter predicate")
+        elif isinstance(node, Project):
+            for s, e in node.exprs:
+                self._resolve(node, path, "dangling-column",
+                              expr_inputs(e), avail, f"projection {s!r}")
+        elif isinstance(node, Aggregate):
+            self._resolve(node, path, "agg-input", node.group_keys, avail,
+                          f"{node.step} aggregation group key set")
+            if node.step == "final":
+                # the child carries the partial step's state columns, not
+                # the original argument symbols
+                from presto_tpu.plan.agg_states import agg_state_layout
+
+                try:
+                    layout = agg_state_layout(node.aggs, avail)
+                except NotImplementedError:
+                    layout = []
+                self._resolve(node, path, "agg-input",
+                              [name for name, _, _ in layout], avail,
+                              "final aggregation state column set")
+            else:
+                for a in node.aggs:
+                    self._resolve(node, path, "agg-input",
+                                  [a.arg, a.arg2], avail,
+                                  f"aggregate {a.fn}({a.symbol})")
+        elif isinstance(node, HashJoin):
+            ltypes, rtypes = outs[0], outs[1]
+            self._resolve(node, path, "dangling-column", node.left_keys,
+                          ltypes, "join probe keys")
+            self._resolve(node, path, "dangling-column", node.right_keys,
+                          rtypes, "join build keys")
+            self._keys_agree(node, path, node.left_keys, node.right_keys,
+                             ltypes, rtypes, f"{node.kind} join")
+            if node.residual is not None:
+                self._resolve(node, path, "dangling-column",
+                              expr_inputs(node.residual), avail,
+                              "join residual")
+        elif isinstance(node, SemiJoin):
+            ltypes, rtypes = outs[0], outs[1]
+            self._resolve(node, path, "dangling-column", node.left_keys,
+                          ltypes, "semijoin probe keys")
+            self._resolve(node, path, "dangling-column", node.right_keys,
+                          rtypes, "semijoin build keys")
+            self._keys_agree(node, path, node.left_keys, node.right_keys,
+                             ltypes, rtypes, "semijoin")
+            if node.residual is not None:
+                self._resolve(node, path, "dangling-column",
+                              expr_inputs(node.residual), avail,
+                              "semijoin residual")
+        elif isinstance(node, NestedLoopJoin):
+            if node.residual is not None:
+                self._resolve(node, path, "dangling-column",
+                              expr_inputs(node.residual), avail,
+                              "nested-loop residual")
+        elif isinstance(node, IndexJoin):
+            ltypes = outs[0]
+            self._resolve(node, path, "dangling-column", node.left_keys,
+                          ltypes, "index-join probe keys")
+            itypes = dict(node.index_output)
+            col_to_sym = {c: s for s, c in node.assignments.items()}
+            ikeys = [col_to_sym.get(c) for c in node.index_key_cols]
+            if None in ikeys:
+                missing = [c for c in node.index_key_cols
+                           if c not in col_to_sym]
+                self.err("dangling-column", node, path,
+                         f"index key columns {missing} are not covered by "
+                         f"the index-side assignments")
+            else:
+                self._keys_agree(node, path, node.left_keys, ikeys,
+                                 ltypes, itypes, "index join")
+        elif isinstance(node, SetOp):
+            for side, o in (("left", outs[0]), ("right", outs[1])):
+                if len(o) != len(node.symbols):
+                    self.err("key-dtype-mismatch", node, path,
+                             f"{node.kind} {side} child arity "
+                             f"{len(o)} != {len(node.symbols)} output "
+                             f"columns")
+            for i, (sym, t) in enumerate(zip(node.symbols, node.types)):
+                for side, c, o in (("left", kids[0], outs[0]),
+                                   ("right", kids[1], outs[1])):
+                    cols = list(o.items())
+                    if i >= len(cols):
+                        continue
+                    ct = cols[i][1]
+                    cd, td = _dtype_of(ct), _dtype_of(t)
+                    if cd is not None and td is not None and cd != td:
+                        self.err("key-dtype-mismatch", node, path,
+                                 f"{node.kind} column {i} ({sym!r}) dtype "
+                                 f"{td} != {side} child column "
+                                 f"{cols[i][0]!r} dtype {cd}")
+        elif isinstance(node, Sort):
+            self._resolve(node, path, "dangling-column",
+                          [k.symbol for k in node.keys], avail, "sort keys")
+        elif isinstance(node, Window):
+            self._resolve(node, path, "window-input", node.partition_keys,
+                          avail, "window partition keys")
+            self._resolve(node, path, "window-input",
+                          [k.symbol for k in node.order_items], avail,
+                          "window order keys")
+            for f in node.funcs:
+                self._resolve(node, path, "window-input", [f.arg], avail,
+                              f"window function {f.fn}({f.symbol})")
+        elif isinstance(node, Unnest):
+            self._resolve(node, path, "dangling-column",
+                          list(node.sources) + list(node.replicate), avail,
+                          "unnest")
+        elif isinstance(node, HostProject):
+            self._resolve(node, path, "dangling-column",
+                          [in_s for _, _, in_s, _ in node.items], avail,
+                          "host projection")
+        elif isinstance(node, Output):
+            self._resolve(node, path, "dangling-column", node.symbols,
+                          avail, "output")
+        elif isinstance(node, (TableScan, RemoteSource, OneRow, Limit,
+                               TableWriter)):
+            pass
+
+        if _out_types(node) is None:
+            self.err("schema-error", node, path,
+                     "output schema is not computable")
+
+
+def check_plan(root: PlanNode) -> List[Finding]:
+    """Validate one plan tree; returns findings (empty = invariants hold)."""
+    c = _Checker()
+    c.check(root)
+    return c.findings
+
+
+def check_query_plan(plan: QueryPlan) -> List[Finding]:
+    out = check_plan(plan.root)
+    for sym, sub in plan.scalar_subqueries.items():
+        for f in check_query_plan(sub):
+            out.append(Finding(f.rule, f"subquery {sym}/{f.loc}", f.message,
+                               "plan"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# distributed plans
+
+
+def _breaker_radix_keys(node: PlanNode):
+    """Map RemoteSource fragment id -> the key list its consuming breaker
+    partitions on (joins: per-side keys; final aggregations: group keys)."""
+    out: Dict[int, List[str]] = {}
+
+    def walk(n: PlanNode):
+        if isinstance(n, HashJoin):
+            for side, keys in ((n.left, n.left_keys), (n.right, n.right_keys)):
+                if isinstance(side, RemoteSource):
+                    out[side.fragment_id] = list(keys)
+        if isinstance(n, Aggregate) and isinstance(n.child, RemoteSource):
+            out[n.child.fragment_id] = list(n.group_keys)
+        for c in n.children():
+            walk(c)
+
+    walk(node)
+    return out
+
+
+def check_distributed(dplan) -> List[Finding]:
+    """Fragment-level invariants: RemoteSource wiring, reachability,
+    acyclicity, and radix-aligned exchange consistency."""
+    findings: List[Finding] = []
+    frags = dplan.fragments
+
+    def err(rule, fid, msg):
+        findings.append(Finding(rule, f"fragment {fid}", msg, "plan"))
+
+    if dplan.root_fid not in frags:
+        findings.append(Finding("fragment-wiring", "plan root",
+                                f"root fragment {dplan.root_fid} missing",
+                                "plan"))
+        return findings
+
+    consumers: Dict[int, List[int]] = {fid: [] for fid in frags}
+    for fid, f in frags.items():
+        # per-node invariants inside each fragment
+        for pf in check_plan(f.root):
+            findings.append(Finding(pf.rule, f"fragment {fid}: {pf.loc}",
+                                    pf.message, "plan"))
+        for rs in f.remote_sources():
+            src = frags.get(rs.fragment_id)
+            if src is None:
+                err("fragment-wiring", fid,
+                    f"RemoteSource references fragment {rs.fragment_id}, "
+                    f"which does not exist")
+                continue
+            consumers[rs.fragment_id].append(fid)
+            src_out = _out_types(src.root)
+            if src_out is None:
+                continue  # schema-error reported above
+            rs_out = dict(rs.output)
+            if list(rs_out) != [s for s, _ in src.root.output]:
+                err("fragment-wiring", fid,
+                    f"RemoteSource schema {sorted(rs_out)} != producing "
+                    f"fragment {rs.fragment_id} output "
+                    f"{[s for s, _ in src.root.output]}")
+            else:
+                for s, t in rs.output:
+                    sd, fd = _dtype_of(t), _dtype_of(src_out[s])
+                    if sd is not None and fd is not None and sd != fd:
+                        err("fragment-wiring", fid,
+                            f"RemoteSource column {s!r} dtype {sd} != "
+                            f"fragment {rs.fragment_id} dtype {fd}")
+
+    # reachability + cycles from the root
+    seen: Set[int] = set()
+    stack: Set[int] = set()
+
+    def visit(fid: int):
+        if fid in stack:
+            err("fragment-wiring", fid, "fragment participates in a cycle")
+            return
+        if fid in seen:
+            return
+        seen.add(fid)
+        stack.add(fid)
+        for rs in frags[fid].remote_sources():
+            if rs.fragment_id in frags:
+                visit(rs.fragment_id)
+        stack.discard(fid)
+
+    visit(dplan.root_fid)
+    for fid in frags:
+        if fid not in seen:
+            err("fragment-wiring", fid,
+                "fragment is unreachable from the root")
+
+    # radix-aligned exchanges: producer keys must be exactly the consumer
+    # breaker's partition keys, and the two sides of one partitioned join
+    # must agree on arity + dtype (the partition-count/key contract the
+    # hybrid-hash-join literature shows engines lose silently)
+    for fid, f in frags.items():
+        if not f.radix_align:
+            continue
+        if f.output_partitioning != "hash" or not f.output_keys:
+            err("radix-align", fid,
+                f"radix_align requires hash output partitioning with keys; "
+                f"got {f.output_partitioning!r} keys={f.output_keys}")
+            continue
+        for cfid in consumers.get(fid, []):
+            want = _breaker_radix_keys(frags[cfid].root).get(fid)
+            if want is None:
+                err("radix-align", fid,
+                    f"consumer fragment {cfid} has no breaker partitioning "
+                    f"on this radix-aligned input")
+            elif list(f.output_keys) != list(want):
+                err("radix-align", fid,
+                    f"sink partitions on {f.output_keys} but consumer "
+                    f"fragment {cfid}'s breaker partitions on {want}")
+    # both radix-aligned inputs of one join must agree pairwise
+    for fid, f in frags.items():
+        for n in _walk_nodes(f.root):
+            if not isinstance(n, HashJoin):
+                continue
+            if not (isinstance(n.left, RemoteSource)
+                    and isinstance(n.right, RemoteSource)):
+                continue
+            lf = frags.get(n.left.fragment_id)
+            rf = frags.get(n.right.fragment_id)
+            if lf is None or rf is None:
+                continue
+            if lf.radix_align != rf.radix_align:
+                err("radix-align", fid,
+                    f"partitioned join inputs disagree on radix alignment: "
+                    f"fragment {lf.fid} align={lf.radix_align}, fragment "
+                    f"{rf.fid} align={rf.radix_align}")
+            if (lf.radix_align and rf.radix_align
+                    and len(lf.output_keys) != len(rf.output_keys)):
+                err("radix-align", fid,
+                    f"partitioned join inputs disagree on key arity: "
+                    f"{lf.output_keys} vs {rf.output_keys}")
+    return findings
+
+
+def _walk_nodes(node: PlanNode):
+    yield node
+    for c in node.children():
+        yield from _walk_nodes(c)

@@ -1,0 +1,79 @@
+"""Bounded-recompile guard over the runtime's per-node jit stats.
+
+The radix work (ops/radix.py) promises that every pipeline breaker runs
+at a bounded set of compiled shapes — a handful of power-of-two buckets
+regardless of input size. `_node_jit` (exec/runtime.py) already counts
+compile events per (plan node, program key): each event is one distinct
+input-shape combination reaching that program. This module turns the
+promise into an enforced invariant: a query (or CI run) FAILS when any
+single node program compiles more than `shape_budget` distinct shapes,
+instead of silently burning compile wall (the failure mode EXPLAIN
+ANALYZE merely *renders*).
+
+Budget intuition: a breaker sees its bucket capacity (one shape), a few
+geometric growth steps (agg_capacity → agg_cap_ceiling is ≤ 6 doublings
+at the defaults), and a short/last-batch shape — comfortably under the
+default budget of 16. A node compiling dozens of shapes is churning
+(unpadded batches, a capacity leak, a non-pow2 bucket) and should fail
+loudly.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Optional, Tuple
+
+from presto_tpu.analysis.findings import Finding
+
+# default per-(node, program) distinct-shape ceiling; chosen to clear the
+# TPC-H tier-1 suite at the default ExecConfig with headroom (measured:
+# the worst node program compiles 6 shapes at SF 0.01)
+DEFAULT_SHAPE_BUDGET = 16
+
+
+class RecompileBudgetError(RuntimeError):
+    """A node program exceeded the compiled-shape budget."""
+
+    def __init__(self, findings: List[Finding]):
+        self.findings = findings
+        lines = "\n".join(f"  {f}" for f in findings)
+        super().__init__(
+            f"compiled-shape budget exceeded:\n{lines}")
+
+
+def iter_jit_stats(root) -> Iterator[Tuple[object, str, int, float]]:
+    """Yield (node, program_key, compiles, compile_wall_s) for every
+    jitted program under `root` (walks children; works on plan trees
+    and fragment roots alike)."""
+    stats = root.__dict__.get("_jit_stats") if hasattr(root, "__dict__") \
+        else None
+    if stats:
+        for key, s in stats.items():
+            yield (root, key, int(s.get("compiles", 0)),
+                   float(s.get("compile_wall_s", 0.0)))
+    for c in root.children():
+        yield from iter_jit_stats(c)
+
+
+def check_recompiles(root, shape_budget: Optional[int] = None
+                     ) -> List[Finding]:
+    """Findings for every node program over budget (empty = bounded)."""
+    budget = DEFAULT_SHAPE_BUDGET if shape_budget is None else shape_budget
+    findings: List[Finding] = []
+    for node, key, compiles, wall in iter_jit_stats(root):
+        if compiles > budget:
+            findings.append(Finding(
+                "shape-budget",
+                f"node {type(node).__name__}/program {key!r}",
+                f"compiled {compiles} distinct shapes (budget {budget}, "
+                f"{wall:.2f}s compile wall) — shapes are not bounded; "
+                f"check batch padding and capacity bucketing",
+                "recompile"))
+    return findings
+
+
+def enforce(root, shape_budget: Optional[int] = None) -> None:
+    """Raise RecompileBudgetError if any program under `root` is over
+    budget (the run_plan / CI hook)."""
+    findings = check_recompiles(root, shape_budget)
+    if findings:
+        raise RecompileBudgetError(findings)

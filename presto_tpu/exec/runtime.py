@@ -177,6 +177,11 @@ class ExecConfig:
     # stay resident); the reference analog is the dynamic hybrid hash
     # join's per-partition memory budget.
     join_spill_budget_bytes: Optional[int] = None
+    # bounded-recompile guard (analysis/recompile.py): fail the query when
+    # any single node program compiled more than this many distinct shapes
+    # — the "bounded compiled shapes" promise of the radix/bucketing work
+    # enforced, not just rendered by EXPLAIN ANALYZE. None = off.
+    max_compiled_shapes: Optional[int] = None
 
 
 def _node_jit(node: PlanNode, key: str, builder, **jit_kwargs):
@@ -1158,7 +1163,7 @@ def _sorted_group_agg(b: Batch, key_syms, a: AggSpec, cap: int):
         # lowering — inputs here are ≤ occupied-bucket rows, not raw data)
         from presto_tpu.ops.grouping import _segmented_scan
 
-        p = float(a.param)
+        p = float(a.param)  # lint: allow(host-sync)
         wcol = b.column(a.arg2)
         wsorted = wcol.values.astype(jnp.int64)[sperm]
         wsorted = jnp.where(ov_sorted & (sdead == 0), wsorted, 0)
@@ -1177,7 +1182,7 @@ def _sorted_group_agg(b: Batch, key_syms, a: AggSpec, cap: int):
     if a.fn == "approx_percentile":
         # exact quantile: index ceil(p*n_valid)-1 of the sorted valid values
         # (NULLs sort first, valid range is [start+cnt-cntv, start+cnt))
-        p = float(a.param)
+        p = float(a.param)  # lint: allow(host-sync)
         k = jnp.clip(jnp.ceil(p * cntv).astype(jnp.int32) - 1, 0, jnp.maximum(cntv - 1, 0))
         pos = start + (cnt - cntv) + k
         pos = jnp.clip(pos, 0, n - 1)
@@ -3803,7 +3808,12 @@ def _run_plan_inner(qp: QueryPlan, ctx: ExecContext) -> Batch:
             {},
         )
     merged = merged.select(out_node.symbols).rename(out_node.names)
-    return _JIT_COMPACT(merged)
+    out = _JIT_COMPACT(merged)
+    if ctx.config.max_compiled_shapes:
+        from presto_tpu.analysis.recompile import enforce
+
+        enforce(qp.root, ctx.config.max_compiled_shapes)
+    return out
 
 
 def _bind_plan_params(node: PlanNode, bindings):

@@ -302,9 +302,9 @@ def parse_frame_bound(tok: str):
     if tok in ("up", "uf", "cur"):
         return tok, 0
     if tok[0] == "p":
-        return "p", int(tok[1:])
+        return "p", int(tok[1:])  # lint: allow(host-sync)
     if tok[0] == "f":
-        return "f", int(tok[1:])
+        return "f", int(tok[1:])  # lint: allow(host-sync)
     raise ValueError(f"bad frame bound {tok!r}")
 
 
@@ -423,7 +423,7 @@ def range_frame_bounds(k: WindowKeys, order_vals, frame: str,
         """v + delta with saturation (int keys must not wrap past the
         extremes; float +/-inf saturates on its own)."""
         if jnp.issubdtype(v.dtype, jnp.floating):
-            return v + float(delta)
+            return v + float(delta)  # lint: allow(host-sync)
         t = v + jnp.asarray(delta, v.dtype)
         if delta > 0:
             t = jnp.where(t < v, jnp.iinfo(v.dtype).max, t)

@@ -89,6 +89,8 @@ class DistributedPlan:
             head = f"Fragment {fid} [{f.partitioning}] → {f.output_partitioning}"
             if f.output_keys:
                 head += f"({', '.join(f.output_keys)})"
+            if f.radix_align:
+                head += " radix_align"
             parts.append(head + "\n" + plan_to_string(f.root, 1))
         return "\n".join(parts)
 
@@ -456,8 +458,15 @@ def fragment_plan(plan: QueryPlan, catalog=None,
 
 
 def strip_runtime_state(node: PlanNode):
-    """Remove jit caches / memos before pickling a fragment for the wire."""
-    node.__dict__.pop("_jit_cache", None)
-    node.__dict__.pop("_collapsed", None)
+    """Remove runtime state before pickling a fragment for the wire.
+
+    Anything underscore-prefixed in a node's instance dict is runtime-only
+    by convention (`_jit_cache` / `_jit_stats` memos, `_collapsed`,
+    `_probe_shim`, `_node_stats`, ...) — no declared plan field starts
+    with an underscore, so popping the prefix wholesale keeps the wire
+    image equal to the logical plan. plan/codec.py enforces the same
+    contract structurally (only declared fields serialize)."""
+    for key in [k for k in node.__dict__ if k.startswith("_")]:
+        node.__dict__.pop(key, None)
     for c in node.children():
         strip_runtime_state(c)

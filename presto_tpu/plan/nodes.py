@@ -487,11 +487,15 @@ def plan_to_string(node: PlanNode, indent: int = 0, node_stats=None) -> str:
             s += (f", compiles={compiles}, compile={cwall:.2f}s, "
                   f"execute={max(0.0, st['wall_s'] - cwall):.2f}s")
         s += "]"
-    elif node_stats is not None and jstats:
+    elif jstats:
+        # an executed node renders its recompile profile even without the
+        # EXPLAIN ANALYZE stats map: distinct programs × compiled shapes
+        # is the bounded-shapes contract analysis/recompile.py enforces
         compiles = sum(v["compiles"] for v in jstats.values())
         cwall = sum(v["compile_wall_s"] for v in jstats.values())
         if compiles:
-            s += f"   [compiles={compiles}, compile_wall={cwall:.2f}s]"
+            s += (f"   [programs={len(jstats)}, compiles={compiles}, "
+                  f"compile_wall={cwall:.2f}s]")
     return s + "".join(
         "\n" + plan_to_string(c, indent + 1, node_stats) for c in node.children()
     )

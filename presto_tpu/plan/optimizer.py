@@ -370,24 +370,58 @@ def make_index_joins(node: PlanNode, catalog) -> PlanNode:
     return node
 
 
-def optimize(plan: QueryPlan, catalog=None) -> QueryPlan:
-    """Run the pass pipeline (reference: PlanOptimizers.java:146 ordering)."""
+def _debug_checks_enabled() -> bool:
+    import os
+
+    return os.environ.get("PRESTO_TPU_PLAN_CHECK", "") not in ("", "0")
+
+
+def optimize(plan: QueryPlan, catalog=None,
+             debug_checks: Optional[bool] = None) -> QueryPlan:
+    """Run the pass pipeline (reference: PlanOptimizers.java:146 ordering).
+
+    With `debug_checks` (or env PRESTO_TPU_PLAN_CHECK=1), the plan-IR
+    invariant checker (analysis/plan_check.py) re-runs after every pass,
+    so a violation is attributed to the rewrite rule that introduced it
+    instead of surfacing as a KeyError three layers later — the
+    PlanSanityChecker-between-optimizers discipline of the reference."""
     from presto_tpu.plan.stats import invalidate
 
     from presto_tpu.plan.rules import IterativeOptimizer
 
+    if debug_checks is None:
+        debug_checks = _debug_checks_enabled()
+
+    def checked(pass_name: str):
+        if not debug_checks:
+            return
+        from presto_tpu.analysis.plan_check import (
+            PlanInvariantError,
+            check_plan,
+        )
+
+        findings = check_plan(plan.root)
+        if findings:
+            raise PlanInvariantError(pass_name, findings)
+
     root = plan.root
+    checked("input (builder output)")
     root.child = push_filters(root.child)
+    checked("push_filters")
     prune_columns(root, set(root.symbols))
+    checked("prune_columns")
     root.child = cleanup(root.child)
+    checked("cleanup")
     # iterative pattern rules (merge filters/projects/limits, TopN
     # formation) run after the big passes, to fixpoint
     root.child = IterativeOptimizer().optimize(root.child)
+    checked("IterativeOptimizer")
     if catalog is not None:
         root.child = make_index_joins(root.child, catalog)
+        checked("make_index_joins")
     # builder-time stats memos are stale once filters/pruning rewrote the
     # tree; later consumers (fragmenter, capacity planner) re-derive
     invalidate(root)
     for sub in plan.scalar_subqueries.values():
-        optimize(sub, catalog)
+        optimize(sub, catalog, debug_checks=debug_checks)
     return plan

@@ -1,0 +1,511 @@
+"""Static-analysis plane: plan-IR invariant checker, kernel linter,
+bounded-recompile guard, and the serialization-drops-runtime-state
+contract.
+
+Reference: sql/planner/sanity/PlanSanityChecker.java (the
+between-optimizers validation discipline) and the checkstyle/error-prone
+surface of the reference build — here re-aimed at the TPU execution
+hazards (host syncs, f64 promotion, unbounded recompiles).
+"""
+
+import jax.numpy as jnp
+import pytest
+
+from presto_tpu.analysis.kernel_lint import RULES, lint_source
+from presto_tpu.analysis.plan_check import (
+    PlanInvariantError,
+    check_distributed,
+    check_plan,
+    check_query_plan,
+)
+from presto_tpu.analysis.recompile import (
+    DEFAULT_SHAPE_BUDGET,
+    RecompileBudgetError,
+    check_recompiles,
+    enforce,
+)
+from presto_tpu.catalog.tpch import tpch_catalog
+from presto_tpu.expr.ir import Call, Constant, InputRef
+from presto_tpu.plan.builder import plan_query
+from presto_tpu.plan.fragmenter import fragment_plan, strip_runtime_state
+from presto_tpu.plan.nodes import (
+    Aggregate,
+    AggSpec,
+    Filter,
+    HashJoin,
+    Output,
+    QueryPlan,
+    SetOp,
+    TableScan,
+    plan_to_string,
+)
+from presto_tpu.plan.optimizer import optimize
+from presto_tpu.types import BIGINT, BOOLEAN, DOUBLE
+
+
+@pytest.fixture(scope="module")
+def cat():
+    return tpch_catalog(0.01)
+
+
+def scan(cols):
+    return TableScan("tpch", "t", {s: s for s, _ in cols}, list(cols))
+
+
+def rules_of(findings):
+    return {f.rule for f in findings}
+
+
+# ---------------------------------------------------------------------------
+# plan_check: fixture plans with deliberate violations
+
+
+def test_clean_tree_has_no_findings():
+    s = scan([("a", BIGINT), ("b", DOUBLE)])
+    f = Filter(s, Call(BOOLEAN, "gt", (InputRef(DOUBLE, "b"),
+                                       Constant(DOUBLE, 1.0))))
+    assert check_plan(Output(f, ["a"], ["a"])) == []
+
+
+def test_dangling_filter_predicate_caught_and_located():
+    s = scan([("a", BIGINT)])
+    f = Filter(s, Call(BOOLEAN, "eq", (InputRef(BIGINT, "zzz"),
+                                       Constant(BIGINT, 1))))
+    findings = check_plan(Output(f, ["a"], ["a"]))
+    assert any(x.rule == "dangling-column" and "'zzz'" in x.message
+               for x in findings)
+    # attribution: the loc names the offending node type in its path
+    assert any("Filter" in x.loc for x in findings
+               if x.rule == "dangling-column")
+
+
+def test_dangling_output_symbol():
+    s = scan([("a", BIGINT)])
+    findings = check_plan(Output(s, ["gone"], ["gone"]))
+    assert "dangling-column" in rules_of(findings)
+
+
+def test_join_key_dtype_mismatch():
+    l = scan([("lk", BIGINT)])
+    r = scan([("rk", DOUBLE)])
+    j = HashJoin("inner", l, r, ["lk"], ["rk"])
+    findings = check_plan(Output(j, ["lk"], ["lk"]))
+    assert any(x.rule == "key-dtype-mismatch"
+               and "int64" in x.message and "float64" in x.message
+               for x in findings)
+
+
+def test_join_key_arity_mismatch():
+    l = scan([("a", BIGINT), ("b", BIGINT)])
+    r = scan([("c", BIGINT)])
+    j = HashJoin("inner", l, r, ["a", "b"], ["c"])
+    findings = check_plan(Output(j, ["a"], ["a"]))
+    assert any(x.rule == "key-dtype-mismatch" and "arity" in x.message
+               for x in findings)
+
+
+def test_setop_positional_dtype_mismatch():
+    l = scan([("a", BIGINT)])
+    r = scan([("b", DOUBLE)])
+    u = SetOp("union", True, l, r, ["x"], [BIGINT])
+    findings = check_plan(Output(u, ["x"], ["x"]))
+    assert any(x.rule == "key-dtype-mismatch" and "right child" in x.message
+               for x in findings)
+
+
+def test_final_aggregate_requires_state_columns():
+    # a final-step avg consumes sum/count state columns from the partial,
+    # not the original argument symbol; a child without them is invalid
+    child = scan([("k", BIGINT), ("v", DOUBLE)])
+    agg = Aggregate(child, ["k"],
+                    [AggSpec("m", "avg", "v", DOUBLE)], step="final")
+    findings = check_plan(Output(agg, ["k", "m"], ["k", "m"]))
+    assert "agg-input" in rules_of(findings)
+
+
+def test_aggregate_dangling_group_key():
+    child = scan([("k", BIGINT)])
+    agg = Aggregate(child, ["nope"],
+                    [AggSpec("c", "count_star", None, BIGINT)])
+    findings = check_plan(Output(agg, ["nope", "c"], ["nope", "c"]))
+    assert any(x.rule == "agg-input" and "'nope'" in x.message
+               for x in findings)
+
+
+def test_optimizer_debug_mode_attributes_to_pass():
+    # the interposition re-checks after every rewrite: a violation in the
+    # optimizer's *input* is attributed to the builder, not to whichever
+    # later pass happens to crash on it
+    s = scan([("a", BIGINT)])
+    f = Filter(s, Call(BOOLEAN, "eq", (InputRef(BIGINT, "zzz"),
+                                       Constant(BIGINT, 1))))
+    qp = QueryPlan(Output(f, ["a"], ["a"]))
+    with pytest.raises(PlanInvariantError) as ei:
+        optimize(qp, debug_checks=True)
+    assert ei.value.pass_name == "input (builder output)"
+    assert any(x.rule == "dangling-column" for x in ei.value.findings)
+
+
+def test_optimizer_debug_mode_clean_on_real_query(cat):
+    sql = ("select c_nationkey, count(*) as c from customer "
+           "join orders on c_custkey = o_custkey "
+           "group by c_nationkey order by c limit 5")
+    qp = optimize(plan_query(sql, cat), cat, debug_checks=True)
+    assert check_query_plan(qp) == []
+
+
+# ---------------------------------------------------------------------------
+# distributed invariants
+
+
+@pytest.fixture()
+def dist(cat):
+    sql = ("select c_nationkey, count(*) as c from customer "
+           "join orders on c_custkey = o_custkey group by c_nationkey")
+    qp = optimize(plan_query(sql, cat), cat)
+    # tiny broadcast threshold forces the partitioned (radix-aligned) path
+    return fragment_plan(qp, cat, broadcast_threshold_rows=1)
+
+
+def test_fragmented_tpch_join_is_clean(dist):
+    assert check_distributed(dist) == []
+    assert any(f.radix_align for f in dist.fragments.values())
+
+
+def test_dangling_remote_source_fragment(dist):
+    rs = next(iter(dist.fragments[dist.root_fid].remote_sources()))
+    rs.fragment_id = 999
+    findings = check_distributed(dist)
+    assert any(x.rule == "fragment-wiring" and "999" in x.message
+               for x in findings)
+
+
+def test_radix_align_requires_hash_partitioning(dist):
+    fid, frag = next((fid, f) for fid, f in dist.fragments.items()
+                     if f.radix_align)
+    frag.output_partitioning = "gather"
+    findings = check_distributed(dist)
+    assert any(x.rule == "radix-align" and f"fragment {fid}" == x.loc
+               for x in findings)
+
+
+def test_radix_align_keys_must_match_consumer_breaker(dist):
+    frag = next(f for f in dist.fragments.values() if f.radix_align)
+    frag.output_keys = ["some_other_key"]
+    findings = check_distributed(dist)
+    assert any(x.rule in ("radix-align", "radix-align")
+               and "some_other_key" in x.message for x in findings)
+
+
+def test_partitioned_join_sides_must_agree_on_alignment(dist):
+    aligned = [f for f in dist.fragments.values() if f.radix_align]
+    if len(aligned) < 2:
+        pytest.skip("plan did not radix-align both join inputs")
+    aligned[0].radix_align = False
+    findings = check_distributed(dist)
+    assert any(x.rule == "radix-align" and "disagree" in x.message
+               for x in findings)
+
+
+def test_distributed_plan_renders_radix_align(dist):
+    s = dist.to_string()
+    assert "radix_align" in s
+
+
+# ---------------------------------------------------------------------------
+# kernel lint: rule matrix over synthetic kernel sources
+
+OPS = "presto_tpu/ops/fake.py"  # ops/ path → every def is kernel code
+
+
+def lint(src, path=OPS, rules=RULES):
+    return lint_source(src, path, rules)
+
+
+def test_lint_item_and_casts_flagged():
+    src = (
+        "def k(x):\n"
+        "    a = x.sum().item()\n"
+        "    b = float(x)\n"
+        "    c = int(x[0])\n"
+        "    return a + b + c\n"
+    )
+    findings = lint(src)
+    assert [f.rule for f in findings] == ["host-sync"] * 3
+    assert findings[0].loc == f"{OPS}:2"
+
+
+def test_lint_static_casts_not_flagged():
+    src = (
+        "def k(x, n):\n"
+        "    a = float(1)\n"
+        "    b = int(x.shape[0])\n"
+        "    c = int(len(x) * 2)\n"
+        "    return a + b + c\n"
+    )
+    assert lint(src) == []
+
+
+def test_lint_np_asarray_on_traced():
+    src = "def k(x):\n    return np.asarray(x)\n"
+    findings = lint(src)
+    assert rules_of(findings) == {"host-sync"}
+
+
+def test_lint_float64_rules():
+    src = (
+        "def k(n):\n"
+        "    a = jnp.zeros(n)\n"              # no dtype under x64 → f64
+        "    b = np.float64(1)\n"             # strong f64 scalar
+        "    c = jnp.full(n, 0, dtype=float)\n"   # dtype=float is f64
+        "    d = jnp.array([1.5, 2.5])\n"     # bare float literals
+        "    return a, b, c, d\n"
+    )
+    findings = lint(src)
+    assert [f.rule for f in findings] == ["float64"] * 4
+
+
+def test_lint_float64_explicit_dtype_ok():
+    src = (
+        "def k(n, dt):\n"
+        "    a = jnp.zeros(n, dt)\n"
+        "    b = jnp.array([1.5], dtype=dt)\n"
+        "    return a, b\n"
+    )
+    assert lint(src) == []
+
+
+def test_lint_traced_branch():
+    src = (
+        "def k(x):\n"
+        "    if jnp.any(x > 0):\n"
+        "        return x\n"
+        "    while x.all():\n"
+        "        x = x - 1\n"
+        "    return x\n"
+    )
+    findings = lint(src)
+    assert [f.rule for f in findings] == ["traced-branch"] * 2
+
+
+def test_lint_dtype_predicate_branch_is_static():
+    # dtype dispatch is trace-time static — the idiom all over ops/
+    src = (
+        "def k(x):\n"
+        "    if jnp.issubdtype(x.dtype, jnp.floating):\n"
+        "        return x\n"
+        "    return x.astype(jnp.int64)\n"
+    )
+    assert lint(src) == []
+
+
+def test_lint_pow2_capacity():
+    src = (
+        "def k(x):\n"
+        "    a = jnp.zeros(1000, jnp.int32)\n"
+        "    b = grow(x, capacity=100)\n"
+        "    c = jnp.zeros(1024, jnp.int32)\n"
+        "    d = grow(x, capacity=round_up_capacity(100))\n"
+        "    return a, b, c, d\n"
+    )
+    findings = lint(src)
+    assert [f.rule for f in findings] == ["pow2-capacity"] * 2
+    assert all(f.loc.endswith((":2", ":3")) for f in findings)
+
+
+def test_lint_line_suppression():
+    src = (
+        "def k(x):\n"
+        "    a = float(x)  # lint: allow(host-sync)\n"
+        "    b = float(x)\n"
+        "    return a + b\n"
+    )
+    findings = lint(src)
+    assert len(findings) == 1 and findings[0].loc == f"{OPS}:3"
+
+
+def test_lint_def_level_suppression_covers_body():
+    src = (
+        "def k(x):  # lint: allow(host-sync, traced-branch)\n"
+        "    if jnp.any(x):\n"
+        "        return float(x)\n"
+        "    return jnp.zeros(4)\n"
+    )
+    findings = lint(src)
+    assert rules_of(findings) == {"float64"}  # not suppressed
+
+
+def test_lint_rule_subset():
+    src = "def k(x):\n    a = jnp.zeros(5)\n    return float(x) + a\n"
+    findings = lint(src, rules=("float64",))
+    assert rules_of(findings) == {"float64"}
+
+
+def test_lint_scope_outside_ops_requires_jit_root():
+    # plain driver code in runtime-like modules is not kernel code ...
+    src = "def host(x):\n    return float(x)\n"
+    assert lint(src, path="presto_tpu/exec/fake.py") == []
+    # ... jit-decorated defs and _node_jit builders are
+    src2 = (
+        "@jax.jit\n"
+        "def dev(x):\n"
+        "    return float(x)\n"
+    )
+    assert rules_of(lint(src2, path="presto_tpu/exec/fake.py")) == \
+        {"host-sync"}
+    src3 = (
+        "def run(node, b):\n"
+        "    def body(x):\n"
+        "        return float(x)\n"
+        "    return _node_jit(node, 'k', lambda: body)(b)\n"
+    )
+    assert rules_of(lint(src3, path="presto_tpu/exec/fake.py")) == \
+        {"host-sync"}
+
+
+def test_shipped_tree_lints_clean():
+    import os
+
+    import presto_tpu
+    from presto_tpu.analysis.kernel_lint import lint_paths
+
+    pkg = os.path.dirname(os.path.abspath(presto_tpu.__file__))
+    findings = lint_paths([os.path.join(pkg, "ops"),
+                           os.path.join(pkg, "exec", "runtime.py")])
+    assert findings == [], "\n".join(str(f) for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# recompile guard
+
+
+def churner(n_shapes):
+    """A real _node_jit program driven through n_shapes distinct input
+    shapes — each one is a genuine XLA compile event."""
+    from presto_tpu.exec.runtime import _node_jit
+
+    node = scan([("a", BIGINT)])
+    fn = _node_jit(node, "churn", lambda: (lambda x: x + 1))
+    for n in range(1, n_shapes + 1):
+        fn(jnp.zeros(n, jnp.int32))
+    return node
+
+
+def test_recompile_guard_trips_on_shape_churn():
+    node = churner(6)
+    stats = node.__dict__["_jit_stats"]["churn"]
+    assert stats["compiles"] == 6
+    findings = check_recompiles(node, shape_budget=4)
+    assert len(findings) == 1 and findings[0].rule == "shape-budget"
+    assert "compiled 6 distinct shapes" in findings[0].message
+    with pytest.raises(RecompileBudgetError):
+        enforce(node, shape_budget=4)
+
+
+def test_recompile_guard_quiet_under_budget():
+    node = churner(3)
+    assert check_recompiles(node, shape_budget=4) == []
+    enforce(node, shape_budget=4)  # no raise
+
+
+def test_recompile_guard_quiet_on_real_query(cat):
+    import dataclasses
+
+    from presto_tpu.exec import ExecConfig, LocalRunner
+
+    sql = ("select count(*) as c, sum(l_quantity) as q from lineitem "
+           "where l_discount between 0.05 and 0.07")
+    runner = LocalRunner(cat, dataclasses.replace(
+        ExecConfig(batch_rows=1 << 14, agg_capacity=1 << 10),
+        max_compiled_shapes=DEFAULT_SHAPE_BUDGET))
+    out = runner.run(sql)
+    assert int(out.iloc[0, 0]) > 0
+    qp = runner._plan_cache[sql]
+    assert check_recompiles(qp.root, DEFAULT_SHAPE_BUDGET) == []
+
+
+def test_executed_plan_renders_recompile_counts():
+    node = churner(2)
+    s = plan_to_string(Output(node, ["a"], ["a"]))
+    assert "programs=1" in s and "compiles=2" in s
+
+
+# ---------------------------------------------------------------------------
+# serialization never carries runtime state (satellite of the analysis
+# plane: the wire image equals the logical plan)
+
+
+def runtime_polluted_fragment(cat):
+    sql = ("select c_nationkey, count(*) as c from customer "
+           "join orders on c_custkey = o_custkey group by c_nationkey")
+    qp = optimize(plan_query(sql, cat), cat)
+    dp = fragment_plan(qp, cat, broadcast_threshold_rows=1)
+    frag = dp.fragments[dp.root_fid]
+    # simulate a fragment that already executed locally
+    node = frag.root
+    node.__dict__["_jit_cache"] = {"k": lambda: None}
+    node.__dict__["_jit_stats"] = {"k": {"compiles": 3,
+                                         "compile_wall_s": 0.5}}
+    node.__dict__["_probe_shim"] = object()
+    node.__dict__["_node_stats"] = {"rows": 9}
+    return frag
+
+
+def underscore_attrs(node):
+    out = {k for k in node.__dict__ if k.startswith("_")}
+    for c in node.children():
+        out |= underscore_attrs(c)
+    return out
+
+
+def test_codec_round_trip_drops_runtime_attrs(cat):
+    from presto_tpu.plan.codec import fragment_from_json, fragment_to_json
+
+    frag = runtime_polluted_fragment(cat)
+    back = fragment_from_json(fragment_to_json(frag))
+    assert underscore_attrs(back.root) == set()
+    # and the logical plan survived intact: strip the original's runtime
+    # state and the two renderings agree
+    strip_runtime_state(frag.root)
+    assert plan_to_string(back.root) == plan_to_string(frag.root)
+
+
+def test_strip_runtime_state_pops_all_underscore_attrs(cat):
+    frag = runtime_polluted_fragment(cat)
+    assert underscore_attrs(frag.root) >= {"_jit_cache", "_jit_stats",
+                                           "_probe_shim", "_node_stats"}
+    strip_runtime_state(frag.root)
+    assert underscore_attrs(frag.root) == set()
+
+
+# ---------------------------------------------------------------------------
+# CLI
+
+
+def test_cli_exit_codes(tmp_path, capsys):
+    from presto_tpu.analysis.__main__ import main
+
+    clean = tmp_path / "ops" / "clean.py"
+    clean.parent.mkdir()
+    clean.write_text("def k(x):\n    return x + 1\n")
+    assert main([str(clean)]) == 0
+
+    bad = tmp_path / "ops" / "bad.py"
+    bad.write_text("def k(x):\n    return float(x)\n")
+    assert main([str(bad)]) == 1
+    out = capsys.readouterr().out
+    assert "[host-sync]" in out and "bad.py:2" in out
+
+
+def test_cli_json_output(tmp_path, capsys):
+    import json
+
+    from presto_tpu.analysis.__main__ import main
+
+    bad = tmp_path / "ops" / "bad.py"
+    bad.parent.mkdir()
+    bad.write_text("def k(x):\n    return x.item()\n")
+    assert main(["--json", str(bad)]) == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["findings"][0]["rule"] == "host-sync"
+    assert doc["findings"][0]["loc"].endswith("bad.py:2")
