@@ -249,11 +249,19 @@ def _child(name: str, sf: float, cap_s: float = 0.0):
 
     runs = over.get("runs", 3)
     cfg = {k: v for k, v in over.items() if k != "runs"}
+    # ahead-of-stream precompilation on by default: chain programs trace
+    # on a side pool while the scan decodes, shrinking warmup_s
+    cfg.setdefault("precompile_workers", 2)
     runner = LocalRunner(cat, ExecConfig(batch_rows=1 << 20, **cfg))
+    from presto_tpu.exec import programs
+    snap0 = programs.snapshot()
     t0 = time.time()
     runner.run_batch(sql)  # warm-up: compiles + host/device caches
     warm_s = round(time.time() - t0, 1)
-    _log(f"{name}: warmup (compile + cache fill) {warm_s}s")
+    snap1 = programs.snapshot()
+    _log(f"{name}: warmup (compile + cache fill) {warm_s}s "
+         f"({snap1['compiles'] - snap0['compiles']} compiles, "
+         f"{snap1['trace_wall_s'] - snap0['trace_wall_s']:.1f}s trace wall)")
     times = []
     for _ in range(runs):
         if times and cap_s and (
@@ -267,9 +275,19 @@ def _child(name: str, sf: float, cap_s: float = 0.0):
     best = min(times)
     _log(f"{name}: best {best:.3f}s of {sorted(round(t, 3) for t in times)} "
          f"({nrows} {driving_table} rows)")
+    snap2 = programs.snapshot()
+    lookups = snap2["hits"] + snap2["misses"]
     print(json.dumps({
         "seconds": round(best, 4), "rows": nrows, "sf": sf, "sf_actual": sf,
         "rows_per_sec": round(nrows / best, 1), "warmup_s": warm_s,
+        "compile": {
+            "warm_compiles": snap1["compiles"] - snap0["compiles"],
+            "post_warm_compiles": snap2["compiles"] - snap1["compiles"],
+            "cache_hits": snap2["hits"],
+            "cache_misses": snap2["misses"],
+            "hit_rate": round(snap2["hits"] / lookups, 3) if lookups else 0.0,
+            "trace_wall_s": round(snap2["trace_wall_s"], 2),
+        },
     }), flush=True)
 
 

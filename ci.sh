@@ -92,6 +92,59 @@ if [ "$rc" -ne 0 ]; then
   exit "$rc"
 fi
 
+# Compile-plane smoke: the process-wide structural program cache
+# (exec/programs.py) must make (1) the same TPC-H query from a SECOND
+# runner in one process compile ZERO new XLA programs, and (2) two
+# concurrent tasks of one fragment share each program — every program
+# both tasks called compiled exactly once, not once per task.
+echo "== compile-plane smoke: cold-vs-warm + cross-task sharing =="
+env JAX_PLATFORMS=cpu python - <<'PYEOF'
+from presto_tpu.catalog.tpch import tpch_catalog
+from presto_tpu.exec import ExecConfig, LocalRunner, programs
+
+cat = tpch_catalog(0.01)
+sql = ("select l_returnflag as f, count(*) as c, sum(l_quantity) as q "
+       "from lineitem where l_discount between 0.02 and 0.08 "
+       "group by l_returnflag order by f")
+cold = LocalRunner(cat, ExecConfig()).run(sql)
+before = programs.snapshot()
+# a FRESH runner: new plan objects, so reuse can only come from the
+# structural cache, not from per-node jit memoization
+warm = LocalRunner(cat, ExecConfig()).run(sql)
+after = programs.snapshot()
+assert warm.equals(cold)
+delta = after["compiles"] - before["compiles"]
+assert delta == 0, f"warm run recompiled {delta} programs"
+assert after["hits"] > before["hits"], "warm run never hit the cache"
+print(f"cold-vs-warm OK: 2nd run 0 compiles "
+      f"({after['hits'] - before['hits']} cache hits, "
+      f"{before['compiles']} cold compiles, "
+      f"{before['trace_wall_s']:.2f}s trace wall)")
+
+# two tasks of one fragment (n_workers=2 → the leaf scan fragment runs
+# as two concurrent tasks in this process)
+from presto_tpu.server.coordinator import DistributedRunner
+
+programs.reset(counters_only=False)
+with DistributedRunner(cat, n_workers=2) as dr:
+    out = dr.run("select o_orderpriority, count(*) as c from orders "
+                 "group by o_orderpriority order by o_orderpriority")
+    assert len(out) == 5
+    shared = [e for e in programs.entries() if e.calls >= 2]
+    assert shared, "no program was shared across the two tasks"
+    multi = [e for e in shared if e.compiles > 1]
+    assert not multi, (
+        f"{len(multi)} cross-task programs compiled more than once: "
+        + ", ".join(f"calls={e.calls} compiles={e.compiles}" for e in multi))
+    print(f"cross-task OK: {len(shared)} programs shared by both tasks, "
+          f"each compiled exactly once")
+PYEOF
+rc=$?
+if [ "$rc" -ne 0 ]; then
+  echo "compile-plane smoke FAILED (exit $rc)"
+  exit "$rc"
+fi
+
 # Static-analysis step: the kernel lint must be clean over the shipped
 # tree, the analyzer must actually FAIL on an injected violation (a
 # linter that can't fail is decoration), the plan-invariant checker must

@@ -55,11 +55,17 @@ def _machine_tag() -> str:
     return platform.machine()
 
 
-_cache_dir = _os.environ.get(
-    "PRESTO_TPU_COMPILE_CACHE",
-    _os.path.join(_os.path.expanduser("~"), ".cache",
-                  f"presto_tpu_xla_{_machine_tag()}"),
-)
+# PRESTO_TPU_CACHE_DIR is the documented umbrella knob for the compile
+# plane's on-disk state; PRESTO_TPU_COMPILE_CACHE stays as the specific
+# (and overriding) name. Either set to "" disables.
+_cache_dir = _os.environ.get("PRESTO_TPU_COMPILE_CACHE")
+if _cache_dir is None:
+    _cache_dir = _os.environ.get("PRESTO_TPU_CACHE_DIR")
+    if _cache_dir:
+        _cache_dir = _os.path.join(_cache_dir, f"xla_{_machine_tag()}")
+if _cache_dir is None:
+    _cache_dir = _os.path.join(_os.path.expanduser("~"), ".cache",
+                               f"presto_tpu_xla_{_machine_tag()}")
 if _cache_dir:
     jax.config.update("jax_compilation_cache_dir", _cache_dir)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)

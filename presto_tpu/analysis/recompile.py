@@ -29,6 +29,27 @@ from presto_tpu.analysis.findings import Finding
 # the worst node program compiles 6 shapes at SF 0.01)
 DEFAULT_SHAPE_BUDGET = 16
 
+# operator classes for per-class budgets: a streaming scan-chain node
+# emits one padded capacity (plus the merging-output rebucket ladder when
+# it sits on a join), while a pipeline breaker legitimately walks the
+# geometric capacity-growth ladder. Scan-class nodes churning past a
+# tight budget almost always indicate unpadded batches; breaker-class
+# churn indicates a capacity leak.
+SCAN_CLASS = frozenset({
+    "TableScan", "Filter", "Project", "Limit", "Output", "Unnest",
+    "OneRow", "RemoteSource", "HostProject",
+})
+BREAKER_CLASS = frozenset({
+    "Aggregate", "HashJoin", "SemiJoin", "NestedLoopJoin", "IndexJoin",
+    "SetOp", "Sort", "Window", "TableWriter",
+})
+
+
+def node_class(node) -> str:
+    """"scan" | "breaker" for a plan node (unknown kinds are breakers —
+    the permissive class)."""
+    return "scan" if type(node).__name__ in SCAN_CLASS else "breaker"
+
 
 class RecompileBudgetError(RuntimeError):
     """A node program exceeded the compiled-shape budget."""
@@ -54,26 +75,46 @@ def iter_jit_stats(root) -> Iterator[Tuple[object, str, int, float]]:
         yield from iter_jit_stats(c)
 
 
-def check_recompiles(root, shape_budget: Optional[int] = None
+def budget_for(node, shape_budget: Optional[int] = None,
+               scan_budget: Optional[int] = None,
+               breaker_budget: Optional[int] = None) -> int:
+    """Effective distinct-shape budget for one node: the per-class
+    override when set, else the global budget, else the default."""
+    cls_budget = scan_budget if node_class(node) == "scan" \
+        else breaker_budget
+    if cls_budget is not None:
+        return cls_budget
+    return DEFAULT_SHAPE_BUDGET if shape_budget is None else shape_budget
+
+
+def check_recompiles(root, shape_budget: Optional[int] = None,
+                     scan_budget: Optional[int] = None,
+                     breaker_budget: Optional[int] = None
                      ) -> List[Finding]:
-    """Findings for every node program over budget (empty = bounded)."""
-    budget = DEFAULT_SHAPE_BUDGET if shape_budget is None else shape_budget
+    """Findings for every node program over budget (empty = bounded).
+    Per-class budgets (scan vs breaker) override the global one for
+    their class when given."""
     findings: List[Finding] = []
     for node, key, compiles, wall in iter_jit_stats(root):
+        budget = budget_for(node, shape_budget, scan_budget, breaker_budget)
         if compiles > budget:
+            cls = node_class(node)
             findings.append(Finding(
                 "shape-budget",
                 f"node {type(node).__name__}/program {key!r}",
-                f"compiled {compiles} distinct shapes (budget {budget}, "
-                f"{wall:.2f}s compile wall) — shapes are not bounded; "
-                f"check batch padding and capacity bucketing",
+                f"compiled {compiles} distinct shapes ({cls} budget "
+                f"{budget}, {wall:.2f}s compile wall) — shapes are not "
+                f"bounded; check batch padding and capacity bucketing",
                 "recompile"))
     return findings
 
 
-def enforce(root, shape_budget: Optional[int] = None) -> None:
+def enforce(root, shape_budget: Optional[int] = None,
+            scan_budget: Optional[int] = None,
+            breaker_budget: Optional[int] = None) -> None:
     """Raise RecompileBudgetError if any program under `root` is over
     budget (the run_plan / CI hook)."""
-    findings = check_recompiles(root, shape_budget)
+    findings = check_recompiles(root, shape_budget,
+                                scan_budget, breaker_budget)
     if findings:
         raise RecompileBudgetError(findings)

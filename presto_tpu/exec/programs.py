@@ -1,0 +1,311 @@
+"""Process-wide compile plane: structural program cache + precompilation.
+
+Reference: the reference engine treats generated code as a shared cached
+artifact — ExpressionCompiler / PageFunctionCompiler generated classes are
+keyed by expression structure and reused across every execution of the
+same plan shape. `_node_jit` (exec/runtime.py) used to key programs on the
+plan-node *object*, so identical filter chains, probe programs and agg
+steppers re-traced and re-compiled per node, per fragment, per concurrent
+task in the shared-process cluster, and per query. This module gives the
+runtime the missing process-wide layer:
+
+- ``install_plan`` stamps every node of a bound plan with a *structural
+  namespace*: sha256 over the plan codec JSON of the node's subtree (the
+  canonical wire encoding — fused chains, constants, key symbols and
+  child schemas included) plus a fingerprint of the program-relevant
+  ExecConfig fields. Two nodes (in one plan, two tasks, or two queries)
+  whose subtrees and configs encode identically share a namespace.
+- ``entry_for`` resolves (namespace, node kind, program key, jit kwargs)
+  to ONE process-wide :class:`ProgramEntry` holding the ``jax.jit``
+  wrapper, so the underlying program traces and compiles exactly once
+  per structural identity; per-node ``_jit_stats`` stay per-node views
+  (EXPLAIN ANALYZE and the recompile guard keep node attribution).
+- compile accounting moved here under a per-entry lock fixes the
+  ``_cache_size()`` before/after race of the old wrapper: concurrent
+  callers claim the cache-size delta exactly once.
+- ``warm_chain_programs`` precompiles scan-side fused chain programs
+  ahead of the stream on a small thread pool, so trace+compile overlaps
+  host-side scan decode instead of serializing in front of batch 0.
+
+Nodes NOT stamped (hand-built nodes in tests, runtime shims, nodes whose
+builders capture runtime data) fall back to a private per-node entry with
+the same locked accounting — sharing is opt-in via the stamp.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+import time
+from collections import OrderedDict
+from typing import Callable, Dict, List, Optional, Tuple
+
+# ExecConfig fields that never change what a traced program computes —
+# excluded from the config fingerprint so toggling observability or
+# host-side policy knobs does not fork the program cache. Everything NOT
+# listed here is conservatively part of the structural identity (e.g.
+# radix_partitions is baked into split closures, batch_rows shapes the
+# merging-output rebucketing).
+_VOLATILE_CONFIG_FIELDS = frozenset({
+    "collect_stats", "tracing", "memory_pool_bytes", "spill_dir",
+    "scan_prefetch", "query_retry_count", "execution_policy",
+    "recoverable_grouped_execution", "phase_wait_timeout_s",
+    "split_affinity", "max_compiled_shapes", "max_compiled_shapes_scan",
+    "max_compiled_shapes_breaker", "precompile_workers",
+})
+
+# program cache bound: one entry is one (structure, program key) identity;
+# a TPC-H query compiles ~10-60 of them, so 512 holds many live plans
+# before LRU eviction (an evicted entry keeps working for nodes already
+# holding its wrapper — it just stops being shared with new nodes)
+_MAX_ENTRIES = 512
+
+
+class ProgramEntry:
+    """One structurally-keyed program: the jit wrapper + locked compile
+    accounting shared by every node that maps to it."""
+
+    __slots__ = ("jfn", "lock", "seen_cache_size", "compiles",
+                 "compile_wall_s", "calls")
+
+    def __init__(self, jfn):
+        self.jfn = jfn
+        self.lock = threading.Lock()
+        # last observed jfn._cache_size(): compile detection claims the
+        # delta under the lock, so two concurrent callers never double-
+        # or under-count (the race the per-call before/after pattern had)
+        self.seen_cache_size = 0
+        self.compiles = 0
+        self.compile_wall_s = 0.0
+        self.calls = 0
+
+
+_lock = threading.Lock()
+_entries: "OrderedDict[str, ProgramEntry]" = OrderedDict()
+_counters: Dict[str, int] = {
+    # structural lookups that found an existing shared program
+    "hits": 0,
+    # structural lookups that created a new shared program entry
+    "misses": 0,
+    # XLA trace+compile events observed through any entry (shared or
+    # private) — the process-wide "how much compiling happened" truth
+    "compiles": 0,
+}
+_trace_wall_s = [0.0]
+
+
+def config_fingerprint(config) -> str:
+    """Stable digest of the program-relevant ExecConfig fields."""
+    import dataclasses
+
+    items = []
+    for f in dataclasses.fields(config):
+        if f.name in _VOLATILE_CONFIG_FIELDS:
+            continue
+        items.append((f.name, repr(getattr(config, f.name, None))))
+    return hashlib.sha256(repr(sorted(items)).encode()).hexdigest()[:16]
+
+
+def structural_fingerprint(node, config=None) -> Optional[str]:
+    """sha256 namespace for one plan node: the codec's canonical JSON of
+    its subtree (survives a wire round trip because strip_runtime_state
+    keeps plans runtime-state-free) plus the config fingerprint. None
+    when the subtree has no codec encoding."""
+    from presto_tpu.plan.codec import CodecError, canonical_node_json
+
+    try:
+        doc = canonical_node_json(node)
+    except (CodecError, TypeError, ValueError):
+        return None
+    h = hashlib.sha256(doc.encode())
+    if config is not None:
+        h.update(config_fingerprint(config).encode())
+    return h.hexdigest()
+
+
+def install_plan(root, config) -> int:
+    """Stamp every node under `root` with its structural namespace
+    (``_program_ns``) so `_node_jit` routes programs through the shared
+    cache. Call AFTER scalar-subquery binding and colocation tagging —
+    both mutate plan structure the fingerprint must cover. Underscore
+    attrs are stripped by the plan codec / strip_runtime_state, so stamps
+    never travel on the wire. Returns the number of nodes stamped."""
+    cfg_fp = config_fingerprint(config)
+    stamped = 0
+
+    def walk(n):
+        nonlocal stamped
+        ns = structural_fingerprint(n)
+        if ns is not None:
+            n.__dict__["_program_ns"] = ns + cfg_fp
+            stamped += 1
+        for c in n.children():
+            walk(c)
+
+    walk(root)
+    return stamped
+
+
+def entry_for(ns: Optional[str], node_kind: str, key: str,
+              jit_kwargs: dict, make: Callable[[], object]) -> ProgramEntry:
+    """The shared ProgramEntry for (namespace, kind, program key, jit
+    kwargs), creating it with `make()` on first use. ns None → a private
+    unregistered entry (per-node semantics, shared accounting fix)."""
+    if ns is None:
+        return ProgramEntry(make())
+    fp = f"{ns}|{node_kind}|{key}|{sorted(jit_kwargs.items())!r}"
+    with _lock:
+        e = _entries.get(fp)
+        if e is not None:
+            _entries.move_to_end(fp)
+            _counters["hits"] += 1
+            return e
+        # constructing jax.jit() is cheap (no trace happens here), so the
+        # critical section stays small even on a miss
+        e = _entries[fp] = ProgramEntry(make())
+        _counters["misses"] += 1
+        while len(_entries) > _MAX_ENTRIES:
+            _entries.popitem(last=False)
+        return e
+
+
+def record_compiles(delta: int, wall_s: float) -> None:
+    """Process counters + trace-wall histogram for compile events claimed
+    by an entry (called under that entry's lock)."""
+    with _lock:
+        _counters["compiles"] += int(delta)
+        _trace_wall_s[0] += float(wall_s)
+    try:
+        from presto_tpu.obs import metrics as _obs_metrics
+
+        _obs_metrics.COMPILE_TRACE_WALL.observe(wall_s, plane="worker")
+    except Exception:
+        pass
+
+
+def wrap(entry: ProgramEntry, node_stats: Dict[str, float],
+         node_kind: str, key: str):
+    """Call-through wrapper binding one node's stats view to a (possibly
+    shared) entry. Compile events are detected via jit-cache-size growth
+    and claimed under the entry lock — exact under concurrency — and
+    attributed to the node whose call triggered them."""
+    from presto_tpu.obs import trace as _obs_trace
+
+    jfn = entry.jfn
+
+    def wrapped(*args, **kw):
+        try:
+            t0 = time.perf_counter()
+            w0 = time.time()
+            out = jfn(*args, **kw)
+            dt = time.perf_counter() - t0
+            cur = jfn._cache_size()
+        except AttributeError:
+            return jfn(*args, **kw)
+        with entry.lock:
+            entry.calls += 1
+            delta = cur - entry.seen_cache_size
+            if delta > 0:
+                entry.seen_cache_size = cur
+                entry.compiles += delta
+                entry.compile_wall_s += dt
+                node_stats["compiles"] += delta
+                node_stats["compile_wall_s"] += dt
+            else:
+                delta = 0
+        if delta > 0:
+            record_compiles(delta, dt)
+            tr = _obs_trace.current()
+            if tr.enabled:
+                tr.record("compile", "compile", w0, w0 + dt,
+                          node=node_kind, key=key)
+        return out
+
+    wrapped._entry = entry  # introspection hook for tests / EXPLAIN
+    return wrapped
+
+
+# -- ahead-of-stream precompilation -----------------------------------------
+
+_warm_pools: List[object] = []
+_warm_pools_lock = threading.Lock()
+
+
+def submit_warmers(tasks: List[Callable[[], None]], workers: int) -> int:
+    """Run `tasks` concurrently on a short-lived thread pool without
+    blocking the caller (compile overlaps scan decode / exchange warm-up).
+    Failures are swallowed — warming is best-effort by contract."""
+    if not tasks or workers <= 0:
+        return 0
+    from concurrent.futures import ThreadPoolExecutor
+
+    pool = ThreadPoolExecutor(max_workers=min(workers, len(tasks)),
+                              thread_name_prefix="precompile")
+
+    def safe(fn):
+        try:
+            fn()
+        except Exception:
+            pass
+
+    for t in tasks:
+        pool.submit(safe, t)
+    pool.shutdown(wait=False)
+    with _warm_pools_lock:
+        _warm_pools.append(pool)
+        del _warm_pools[:-8]
+    return len(tasks)
+
+
+def drain_warmers() -> None:
+    """Block until every outstanding warm task finished (tests/bench)."""
+    with _warm_pools_lock:
+        pools = list(_warm_pools)
+        _warm_pools.clear()
+    for p in pools:
+        p.shutdown(wait=True)
+
+
+# -- introspection / metrics -------------------------------------------------
+
+
+def snapshot() -> Dict[str, float]:
+    with _lock:
+        return {"entries": len(_entries), **_counters,
+                "trace_wall_s": _trace_wall_s[0]}
+
+
+def entries() -> List[ProgramEntry]:
+    """Live shared entries (CI/tests: per-entry calls/compiles introspection)."""
+    with _lock:
+        return list(_entries.values())
+
+
+def reset(counters_only: bool = True) -> None:
+    """Test/CI hook. counters_only=False also drops the shared entries
+    (forces cold-cache behavior for the next plan install)."""
+    with _lock:
+        for k in _counters:
+            _counters[k] = 0
+        _trace_wall_s[0] = 0.0
+        if not counters_only:
+            _entries.clear()
+
+
+def metric_rows(labels: Optional[Dict[str, str]] = None) -> List[Tuple]:
+    """Counter rows for server.metrics.render_metrics — process-wide, so
+    callers label the exposing plane (same discipline as scan counters)."""
+    snap = snapshot()
+    return [
+        ("presto_tpu_compile_cache_hits_total",
+         "program-cache lookups served by an already-built shared program",
+         snap["hits"], labels, "counter"),
+        ("presto_tpu_compile_cache_misses_total",
+         "program-cache lookups that created a new shared program entry",
+         snap["misses"], labels, "counter"),
+        ("presto_tpu_compile_events_total",
+         "XLA trace+compile events observed across all node programs",
+         snap["compiles"], labels, "counter"),
+        ("presto_tpu_compile_cache_entries",
+         "live shared program entries", snap["entries"], labels, "gauge"),
+    ]

@@ -37,6 +37,7 @@ from presto_tpu.batch import (
     slice_column,
 )
 from presto_tpu.connector import Catalog
+from presto_tpu.exec import programs as _programs
 from presto_tpu.expr.compile import compile_expr, compile_predicate
 from presto_tpu.obs import trace as _obs_trace
 from presto_tpu.expr.ir import Constant, InputRef, substitute_params
@@ -182,45 +183,51 @@ class ExecConfig:
     # — the "bounded compiled shapes" promise of the radix/bucketing work
     # enforced, not just rendered by EXPLAIN ANALYZE. None = off.
     max_compiled_shapes: Optional[int] = None
+    # per-operator-CLASS overrides of the guard: streaming scan-chain
+    # nodes emit one padded capacity and should stay near 1-2 shapes,
+    # while pipeline breakers legitimately see pow2 growth ladders. None
+    # = fall back to max_compiled_shapes.
+    max_compiled_shapes_scan: Optional[int] = None
+    max_compiled_shapes_breaker: Optional[int] = None
+    # donate accumulator buffers on linearly-threaded stepping programs
+    # (TopN step, global-aggregate step): the caller never reuses the
+    # input accumulator, so XLA may update it in place instead of
+    # double-buffering accumulator HBM. Keyed-agg steps are NOT donated —
+    # the optimistic dispatch window holds acc_before for overflow replay.
+    donate_stepping: bool = True
+    # ahead-of-stream precompilation: trace+compile scan-side fused chain
+    # programs on this many background threads at plan install, so
+    # compilation overlaps host scan decode instead of serializing in
+    # front of batch 0. 0 disables.
+    precompile_workers: int = 0
 
 
-def _node_jit(node: PlanNode, key: str, builder, **jit_kwargs):
-    """Per-plan-node memoized jit compilation (the analog of Presto's
-    codegen class cache: ExpressionCompiler's generated classes are cached
-    and reused across executions of the same plan). Executing a cached
-    QueryPlan twice reuses every compiled XLA program.
+def _node_jit(node: PlanNode, key: str, builder, _shared=True, **jit_kwargs):
+    """Node-facing jit memoization, delegating to the process-wide
+    structural program cache (exec/programs.py — the analog of Presto's
+    codegen class cache: ExpressionCompiler's generated classes are keyed
+    by expression structure and reused across every execution of the same
+    plan shape). Nodes stamped by ``programs.install_plan`` share one
+    compiled program per (structural namespace, key, jit kwargs) across
+    plans, fragments, concurrent tasks and queries; unstamped nodes (and
+    ``_shared=False`` call sites, whose builders close over runtime data
+    such as a materialized build table) keep a private entry.
 
-    Each wrapped program also tracks its compile events (count + wall
-    time, detected via jit cache-size growth across a call) in
-    node._jit_stats[key] — surfaced by EXPLAIN ANALYZE so compile latency
-    is a visible first-class cost, not folded silently into 'warmup'."""
+    Compile events (count + wall, detected via jit cache-size growth) are
+    claimed under the entry's lock — exact under concurrency — and mirrored
+    into node._jit_stats[key] for EXPLAIN ANALYZE and the recompile guard."""
     cache = node.__dict__.setdefault("_jit_cache", {})
-    if key not in cache:
-        jfn = jax.jit(builder(), **jit_kwargs)
+    fn = cache.get(key)
+    if fn is None:
         stats = node.__dict__.setdefault("_jit_stats", {}).setdefault(
             key, {"compiles": 0, "compile_wall_s": 0.0})
-
-        def wrapped(*args, __jfn=jfn, __stats=stats,
-                    __node=type(node).__name__, __key=key, **kw):
-            try:
-                before = __jfn._cache_size()
-            except Exception:
-                return __jfn(*args, **kw)
-            t0 = time.perf_counter()
-            w0 = time.time()
-            out = __jfn(*args, **kw)
-            if __jfn._cache_size() > before:
-                dt = time.perf_counter() - t0
-                __stats["compiles"] += 1
-                __stats["compile_wall_s"] += dt
-                tr = _obs_trace.current()
-                if tr.enabled:
-                    tr.record("compile", "compile", w0, w0 + dt,
-                              node=__node, key=__key)
-            return out
-
-        cache[key] = wrapped
-    return cache[key]
+        ns = node.__dict__.get("_program_ns") if _shared else None
+        entry = _programs.entry_for(
+            ns, type(node).__name__, key, jit_kwargs,
+            lambda: jax.jit(builder(), **jit_kwargs))
+        fn = cache[key] = _programs.wrap(entry, stats,
+                                         type(node).__name__, key)
+    return fn
 
 
 class ExecContext:
@@ -1808,7 +1815,15 @@ def _execute_aggregate(node: Aggregate, ctx: ExecContext) -> Iterator[Batch]:
         dicts = {k: v for k, v in b.dicts.items() if k in names}
         return Batch(names, types, cols, out_live, dicts), n_groups
 
-    jit_step = _node_jit(node, "step", lambda: (lambda acc, b, cap: merge_step(acc, b, cap)), static_argnums=(2,))
+    # global (ungrouped) aggregation threads the accumulator linearly and
+    # never replays (no_overflow below): the input acc is dead the moment
+    # the step returns, so its device buffers can be donated and updated
+    # in place. Keyed aggregation CANNOT donate — the optimistic dispatch
+    # window keeps acc_before alive as the overflow-replay checkpoint.
+    _step_jit_kw = {}
+    if ctx.config.donate_stepping and not key_syms:
+        _step_jit_kw["donate_argnums"] = (0,)
+    jit_step = _node_jit(node, "step", lambda: (lambda acc, b, cap: merge_step(acc, b, cap)), static_argnums=(2,), **_step_jit_kw)
     jit_step0 = _node_jit(node, "step0", lambda: (lambda b, cap: merge_step(None, b, cap)), static_argnums=(1,))
     jit_accstep = _node_jit(node, "accstep", lambda: acc_merge_step, static_argnums=(2,))
     # grace (hash-partitioned) aggregation: partition replay feeds batches
@@ -3235,8 +3250,11 @@ def _execute_nljoin(node: NestedLoopJoin, ctx: ExecContext) -> Iterator[Batch]:
             out = out.with_live(out.live & pred(out))
         return out
 
-    # chunk size must match expand()'s: recompute identically per capacity
-    jexpand = _node_jit(node, "expand", lambda: expand)
+    # chunk size must match expand()'s: recompute identically per capacity.
+    # _shared=False: chunk_size bakes THIS build table's capacity into the
+    # trace, so a structurally-identical node with a different build side
+    # must not reuse the program.
+    jexpand = _node_jit(node, "expand", lambda: expand, _shared=False)
     for raw in probe_stream:
         c = chunk_size(raw.capacity)
         for off in range(0, nb, c):
@@ -3316,7 +3334,10 @@ def _execute_semijoin(node: SemiJoin, ctx: ExecContext) -> Iterator[Batch]:
         pba = align_probe_strings(pb, lkeys, table, rkeys)
         return pb, pba
 
-    chain_j = _node_jit(node, "chain_align", lambda: chain_align)
+    # _shared=False: chain_align closes over THIS query's build table (its
+    # string dictionaries become trace constants via align_probe_strings)
+    chain_j = _node_jit(node, "chain_align", lambda: chain_align,
+                        _shared=False)
     counts_fn = _node_jit(
         node, "counts", lambda: lambda t, pba: probe_counts(t, pba, lkeys, rkeys)
     )
@@ -3726,7 +3747,12 @@ def _execute_sort(node: Sort, ctx: ExecContext) -> Iterator[Batch]:
             out = sort_batch(merged, _sort_keys(node, merged), limit=node.limit)
             return _truncate(out, cap)
 
-        jstep = _node_jit(node, "topn", lambda: topn_step)
+        # acc is threaded linearly (the previous acc is dead once the step
+        # returns, and only the final one is yielded), so its buffers are
+        # donated for in-place update instead of double-buffering the heap
+        _topn_kw = ({"donate_argnums": (0,)}
+                    if ctx.config.donate_stepping else {})
+        jstep = _node_jit(node, "topn", lambda: topn_step, **_topn_kw)
         for raw in in_stream:
             acc = jstep(acc, raw)
         if acc is not None:
@@ -3777,6 +3803,89 @@ def bind_scalar_subqueries(qp: QueryPlan, ctx: ExecContext) -> None:
     _bind_plan_params(qp.root, bindings)
 
 
+# breaker children pulled through _fused_child (their chain fuses into the
+# breaker's own stepping programs — no separate "down" program exists for
+# them, so precompiling one would be wasted work)
+_FUSED_CHILD_SIDES = {
+    Aggregate: (0,), Sort: (0,), Unnest: (0,),
+    HashJoin: (0,), SemiJoin: (0,), NestedLoopJoin: (0,), IndexJoin: (0,),
+}
+
+
+def _chain_warmers(root: PlanNode, ctx: ExecContext) -> List[Callable]:
+    """Warm tasks for the scan-side fused chain programs execute_node will
+    jit under key "down": one zero-filled batch at the scan's (single,
+    padded) capacity per chain whose base is a TableScan. Scans carrying
+    dictionary-encoded or multi-plane columns are skipped — their batch
+    pytree structure depends on decoded data the warmer cannot fabricate,
+    so a warm call would compile an unused specialization. Best-effort by
+    contract: a missed warm only means the compile happens on batch 0, as
+    it did before the compile plane existed."""
+    from presto_tpu.types import DecimalType as _Dec, VarcharType as _Vc
+
+    tasks: List[Callable] = []
+
+    def warmable(scan: TableScan):
+        types = dict(scan.output)
+        for sym in scan.assignments:
+            t = types[sym]
+            if isinstance(t, _Vc) or not hasattr(t, "dtype"):
+                return None
+            if isinstance(t, _Dec) and t.precision > 18:
+                return None
+            try:
+                t.dtype
+            except Exception:
+                return None
+        if not scan.assignments:
+            return None
+        try:
+            handle = ctx.catalog.connectors[scan.catalog].get_table(scan.table)
+            nrows = int(handle.row_count or 0)
+        except Exception:
+            return None
+        return round_up_capacity(min(nrows, ctx.config.batch_rows) or 1)
+
+    def visit(n: PlanNode, top: bool):
+        if isinstance(n, (Filter, Project)):
+            base, down = collapse_chain(n)
+            if top and down is not None and isinstance(base, TableScan):
+                cap = warmable(base)
+                if cap is not None:
+                    tasks.append(partial(_warm_down_chain, n, down, base, cap))
+            visit(base, False)
+            return
+        fused = _FUSED_CHILD_SIDES.get(type(n), ())
+        for i, c in enumerate(n.children()):
+            visit(c, i not in fused)
+
+    visit(root, True)
+    return tasks
+
+
+def _warm_down_chain(node: PlanNode, down, scan: TableScan, cap: int) -> None:
+    types = dict(scan.output)
+    syms = list(scan.assignments.keys())
+    zb = Batch(syms, [types[s] for s in syms],
+               [Column(jnp.zeros(cap, types[s].dtype), None) for s in syms],
+               jnp.zeros(cap, bool), {})
+    out = _node_jit(node, "down", lambda: down)(zb)
+    jax.block_until_ready(out.live)
+
+
+def install_plan_programs(root: PlanNode, ctx: ExecContext) -> None:
+    """Compile-plane entry point for a bound, fully-rewritten plan: stamp
+    every node's structural program namespace (so _node_jit shares
+    programs process-wide) and, when configured, kick off ahead-of-stream
+    precompilation so scan-chain compiles overlap host decode. Call after
+    every structure-mutating pass (subquery binding, colocation tagging,
+    fragment decode)."""
+    _programs.install_plan(root, ctx.config)
+    if ctx.config.precompile_workers > 0:
+        _programs.submit_warmers(_chain_warmers(root, ctx),
+                                 ctx.config.precompile_workers)
+
+
 def run_plan(qp: QueryPlan, ctx: ExecContext) -> Batch:
     """Execute a QueryPlan to a single host-collectable Batch."""
     with _obs_trace.use(ctx.tracer), ctx.tracer.span("query", "query"):
@@ -3795,6 +3904,14 @@ def _run_plan_inner(qp: QueryPlan, ctx: ExecContext) -> Batch:
         tag_colocated_joins(qp.root, ctx.catalog)
         qp.__dict__["_colocated_tagged"] = True
 
+    # stamp structural program namespaces once the plan is fully bound
+    # (subqueries bound, colocation tagged); re-stamped only when the
+    # config's program-relevant fields change
+    cfg_fp = _programs.config_fingerprint(ctx.config)
+    if qp.__dict__.get("_programs_installed") != cfg_fp:
+        install_plan_programs(qp.root, ctx)
+        qp.__dict__["_programs_installed"] = cfg_fp
+
     out_node = qp.root
     batches = list(execute_node(out_node.child, ctx))
     merged = _collect_concat(iter(batches))
@@ -3809,10 +3926,14 @@ def _run_plan_inner(qp: QueryPlan, ctx: ExecContext) -> Batch:
         )
     merged = merged.select(out_node.symbols).rename(out_node.names)
     out = _JIT_COMPACT(merged)
-    if ctx.config.max_compiled_shapes:
+    cfg = ctx.config
+    if (cfg.max_compiled_shapes or cfg.max_compiled_shapes_scan
+            or cfg.max_compiled_shapes_breaker):
         from presto_tpu.analysis.recompile import enforce
 
-        enforce(qp.root, ctx.config.max_compiled_shapes)
+        enforce(qp.root, cfg.max_compiled_shapes,
+                scan_budget=cfg.max_compiled_shapes_scan,
+                breaker_budget=cfg.max_compiled_shapes_breaker)
     return out
 
 

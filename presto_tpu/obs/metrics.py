@@ -125,10 +125,15 @@ RADIX_PARTITION_ROWS = Histogram(
     "rows per radix partition at a partitioned breaker (skew shows as a "
     "wide spread)",
     log_buckets(1.0, 1e8))
+COMPILE_TRACE_WALL = Histogram(
+    "presto_tpu_compile_trace_wall_seconds",
+    "wall time of one XLA trace+compile event observed by the program "
+    "cache (exec/programs.py)",
+    log_buckets(0.001, 600.0))
 
 ALL_HISTOGRAMS: Tuple[Histogram, ...] = (
     QUERY_LATENCY, TASK_SCHEDULE_DELAY, BATCH_KERNEL_WALL, EXCHANGE_WAIT,
-    RADIX_PARTITION_ROWS)
+    RADIX_PARTITION_ROWS, COMPILE_TRACE_WALL)
 
 
 def render_histograms(plane: str) -> str:

@@ -211,6 +211,29 @@ def node_to_json(n: PlanNode) -> Dict[str, Any]:
     raise CodecError(f"unencodable plan node {type(n).__name__}")
 
 
+def canonical_node_json(n: PlanNode) -> str:
+    """Canonical structural serialization of one node's subtree: the wire
+    encoding rendered with sorted keys and no whitespace, so it is
+    byte-identical for any two nodes that encode to the same logical plan
+    — across a codec round trip, across two decodes of one fragment, and
+    across processes. strip_runtime_state keeps wire plans free of
+    runtime attrs, so nothing execution-dependent can leak in. This is
+    the basis of the compile plane's structural program fingerprints
+    (exec/programs.py)."""
+    import json
+
+    return json.dumps(node_to_json(n), sort_keys=True,
+                      separators=(",", ":"), default=str)
+
+
+def node_fingerprint(n: PlanNode) -> str:
+    """sha256 hex digest of canonical_node_json — the structural identity
+    under which exec/programs.py shares compiled programs."""
+    import hashlib
+
+    return hashlib.sha256(canonical_node_json(n).encode()).hexdigest()
+
+
 def node_from_json(d: Dict[str, Any]) -> PlanNode:
     k = d.get("k")
     if k == "scan":

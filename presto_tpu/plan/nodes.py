@@ -428,10 +428,16 @@ class QueryPlan:
     cacheable: bool = True
 
 
-def plan_to_string(node: PlanNode, indent: int = 0, node_stats=None) -> str:
+def plan_to_string(node: PlanNode, indent: int = 0, node_stats=None,
+                   shape_budgets=None) -> str:
     """EXPLAIN-style rendering (reference: sql/planner/planPrinter); with
     node_stats, renders EXPLAIN ANALYZE-style per-operator output rows /
-    batches / wall time (ExplainAnalyzeOperator analog)."""
+    batches / wall time (ExplainAnalyzeOperator analog). `shape_budgets`
+    is an optional (global, scan, breaker) budget triple for the
+    headroom rendering; executed nodes always render their worst
+    program's compiled-shape count against the node's class budget, so
+    how close a plan runs to the bounded-shapes guard is visible in
+    EXPLAIN output, not only as a guard failure."""
     pad = "  " * indent
     if isinstance(node, TableScan):
         cols = ", ".join(f"{s}:={c}" for s, c in node.assignments.items())
@@ -486,6 +492,7 @@ def plan_to_string(node: PlanNode, indent: int = 0, node_stats=None) -> str:
             cwall = sum(v["compile_wall_s"] for v in jstats.values())
             s += (f", compiles={compiles}, compile={cwall:.2f}s, "
                   f"execute={max(0.0, st['wall_s'] - cwall):.2f}s")
+            s += _shape_headroom(node, jstats, shape_budgets)
         s += "]"
     elif jstats:
         # an executed node renders its recompile profile even without the
@@ -495,7 +502,25 @@ def plan_to_string(node: PlanNode, indent: int = 0, node_stats=None) -> str:
         cwall = sum(v["compile_wall_s"] for v in jstats.values())
         if compiles:
             s += (f"   [programs={len(jstats)}, compiles={compiles}, "
-                  f"compile_wall={cwall:.2f}s]")
+                  f"compile_wall={cwall:.2f}s"
+                  f"{_shape_headroom(node, jstats, shape_budgets)}]")
     return s + "".join(
-        "\n" + plan_to_string(c, indent + 1, node_stats) for c in node.children()
+        "\n" + plan_to_string(c, indent + 1, node_stats, shape_budgets)
+        for c in node.children()
     )
+
+
+def _shape_headroom(node, jstats, shape_budgets) -> str:
+    """', shapes=<worst>/<budget>' — the node's worst program's distinct
+    compiled shapes against its operator-class budget (scan vs breaker;
+    analysis/recompile.py is the source of truth for both the classes
+    and the defaults)."""
+    try:
+        from presto_tpu.analysis.recompile import budget_for
+    except Exception:
+        return ""
+    worst = max((int(v.get("compiles", 0)) for v in jstats.values()),
+                default=0)
+    g, sc, br = shape_budgets or (None, None, None)
+    budget = budget_for(node, g, sc, br)
+    return f", shapes={worst}/{budget}"

@@ -79,13 +79,15 @@ def worker_metrics(worker) -> str:
         ("presto_tpu_worker_spill_count_total", "spill events",
          st["spillCount"], lbl),
     ]
+    from presto_tpu.exec import programs as exec_programs
     from presto_tpu.obs import metrics as obs_metrics
     from presto_tpu.scan import metrics as scan_metrics
 
-    # scan counters are process-wide; the plane label keeps the worker and
-    # coordinator expositions of a shared-process cluster distinguishable
-    # (sum over planes double-counts — filter on one)
+    # scan + compile counters are process-wide; the plane label keeps the
+    # worker and coordinator expositions of a shared-process cluster
+    # distinguishable (sum over planes double-counts — filter on one)
     rows.extend(scan_metrics.metric_rows({**lbl, "plane": "worker"}))
+    rows.extend(exec_programs.metric_rows({**lbl, "plane": "worker"}))
     return render_metrics(rows) + obs_metrics.render_histograms("worker")
 
 
@@ -105,10 +107,12 @@ def coordinator_metrics(coordinator) -> str:
                      {"state": state}))
     rows.append(("presto_tpu_plan_cache_entries", "cached distributed plans",
                  len(coordinator._dplan_cache), None))
+    from presto_tpu.exec import programs as exec_programs
     from presto_tpu.obs import metrics as obs_metrics
     from presto_tpu.scan import metrics as scan_metrics
 
     rows.extend(scan_metrics.metric_rows({"plane": "coordinator"}))
+    rows.extend(exec_programs.metric_rows({"plane": "coordinator"}))
     return (render_metrics(rows)
             + obs_metrics.render_histograms("coordinator"))
 

@@ -187,6 +187,22 @@ class SystemSessionProperties:
                              "Per-partition device-byte budget beyond which "
                              "a radix partition spills to host (0 = never)",
                              int, 0, validator=_nonneg("join_spill_budget_bytes")),
+            # compile plane (exec/programs.py)
+            PropertyMetadata("donate_stepping",
+                             "Donate accumulator buffers on linearly-"
+                             "threaded stepping programs", bool, True),
+            PropertyMetadata("precompile_workers",
+                             "Ahead-of-stream precompile thread count "
+                             "(0 disables)", int, 0,
+                             validator=_nonneg("precompile_workers")),
+            PropertyMetadata("max_compiled_shapes_scan",
+                             "Compiled-shape budget override for scan-class "
+                             "nodes (0 = inherit global)", int, 0,
+                             validator=_nonneg("max_compiled_shapes_scan")),
+            PropertyMetadata("max_compiled_shapes_breaker",
+                             "Compiled-shape budget override for breaker-"
+                             "class nodes (0 = inherit global)", int, 0,
+                             validator=_nonneg("max_compiled_shapes_breaker")),
         ]
 
     def names(self) -> List[str]:
@@ -291,4 +307,10 @@ class Session:
             radix_partitions=self.get("radix_partitions"),
             join_spill_budget_bytes=(self.get("join_spill_budget_bytes")
                                      or None),
+            donate_stepping=self.get("donate_stepping"),
+            precompile_workers=self.get("precompile_workers"),
+            max_compiled_shapes_scan=(self.get("max_compiled_shapes_scan")
+                                      or None),
+            max_compiled_shapes_breaker=(
+                self.get("max_compiled_shapes_breaker") or None),
         )

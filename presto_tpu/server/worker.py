@@ -279,6 +279,15 @@ class TaskExecution:
         ctx.split_counts = self.update.split_counts
         ctx.remote_sources = self._remote_source_factory
         f = self.update.fragment
+        # compile plane: stamp structural program namespaces so this task
+        # shares compiled programs with every other task of this fragment
+        # (and any other fragment whose nodes encode identically), and
+        # kick off ahead-of-stream precompilation when configured — the
+        # trace/compile overlaps scan decode instead of serializing in
+        # front of the first batch
+        from presto_tpu.exec.runtime import install_plan_programs
+
+        install_plan_programs(f.root, ctx)
         sink = self._make_sink(f, cfg)
         stream = execute_node(f.root, ctx)
         # fair time slicing applies to LEAF fragments only: a task
