@@ -173,6 +173,13 @@ _REF = {
 # "sf_actual" — the scale that really ran.
 _CONFIGS = {
     "q1_sf1": (Q1, "tpch", 1.0, "lineitem", {}),
+    # fragment-fusion A/B: the same Q1 with the fused lax.scan ingest
+    # disabled — the per-batch dispatch loop this round removes. The
+    # rows/s delta between q1_sf1 and this key IS the dispatch-collapse
+    # win (on CPU it mostly measures dispatch overhead; on a tunneled TPU
+    # it measures the RTT budget — see BENCH_NOTES.md)
+    "q1_nofuse_sf1": (Q1, "tpch", 1.0, "lineitem",
+                      {"fragment_fusion": False}),
     "q6_sf10": (Q6, "tpch", 10.0, "lineitem", {}),
     "q3_sf10": (Q3, "tpch", 10.0, "lineitem", {}),
     "join_sf1": (JOIN_SF1, "tpch", 1.0, "lineitem",
@@ -186,8 +193,8 @@ _CONFIGS = {
 _ALIASES = {"q9_sf100": "q9", "q64_sf100": "q64"}
 
 # Per-config wall caps (seconds): one slow compile can only burn this much.
-_CAPS = {"q1_sf1": 420, "q6_sf10": 420, "q3_sf10": 600, "join_sf1": 420,
-         "q9": 900, "q64": 900}
+_CAPS = {"q1_sf1": 420, "q1_nofuse_sf1": 420, "q6_sf10": 420,
+         "q3_sf10": 600, "join_sf1": 420, "q9": 900, "q64": 900}
 
 
 def _dataset_ready(kind: str, sf: float) -> bool:
@@ -277,9 +284,18 @@ def _child(name: str, sf: float, cap_s: float = 0.0):
          f"({nrows} {driving_table} rows)")
     snap2 = programs.snapshot()
     lookups = snap2["hits"] + snap2["misses"]
+    # dispatch-collapse accounting (exec/fragment_jit.py): how many fused
+    # window dispatches vs per-batch step dispatches the LAST timed run
+    # issued — the counters EXPLAIN ANALYZE and /v1/metrics also expose
+    st = getattr(runner, "last_stats", {}) or {}
     print(json.dumps({
         "seconds": round(best, 4), "rows": nrows, "sf": sf, "sf_actual": sf,
         "rows_per_sec": round(nrows / best, 1), "warmup_s": warm_s,
+        "fragment": {
+            "fused_dispatches": st.get("fragment.dispatches", 0),
+            "fused_batches": st.get("fragment.fused_batches", 0),
+            "batch_dispatches": st.get("fragment.batch_dispatches", 0),
+        },
         "compile": {
             "warm_compiles": snap1["compiles"] - snap0["compiles"],
             "post_warm_compiles": snap2["compiles"] - snap1["compiles"],
@@ -384,7 +400,7 @@ def main():
     sf_over = {"q9": float(os.environ.get("BENCH_SF_Q9", "100")),
                "q64": float(os.environ.get("BENCH_SF_Q64", "100"))}
     wanted = os.environ.get(
-        "BENCH_CONFIGS", "q1_sf1,q6_sf10,q3_sf10,join_sf1,q9,q64"
+        "BENCH_CONFIGS", "q1_sf1,q1_nofuse_sf1,q6_sf10,q3_sf10,join_sf1,q9,q64"
     ).split(",")
 
     for name in (w.strip() for w in wanted):

@@ -32,7 +32,8 @@ def _default_scope() -> List[str]:
 
     pkg = os.path.dirname(os.path.abspath(presto_tpu.__file__))
     return [os.path.join(pkg, "ops"),
-            os.path.join(pkg, "exec", "runtime.py")]
+            os.path.join(pkg, "exec", "runtime.py"),
+            os.path.join(pkg, "exec", "fragment_jit.py")]
 
 
 def _load_queries(path: str) -> dict:

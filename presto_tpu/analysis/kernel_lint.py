@@ -24,13 +24,19 @@ per-batch recompiles):
   are not powers of two. Every distinct capacity is a distinct compiled
   program; the blessed path is `round_up_capacity` / the pow2 bucket
   helpers, never a bare odd constant.
+- ``where-free-masking``: multiplying by a boolean mask (a comparison,
+  its `.astype`, or a mask-named value like `live` / `validity` /
+  `*_mask`) to zero out lanes. Mask-multiply propagates NaN/Inf from the
+  dead lanes (NaN·0 = NaN) and silently widens dtypes; the blessed
+  pattern is `jnp.where(mask, x, fill)`, which selects instead of
+  scaling.
 
-Kernel-region detection: in `ops/` every function is kernel code (they
-are device-kernel libraries). Elsewhere a function is kernel code iff it
-is reachable from a jit root — decorated with `jax.jit` /
-`partial(jax.jit, ...)`, passed to `jax.jit(...)`, or returned by a
-builder passed to `_node_jit(...)` — transitively through same-module
-calls.
+Kernel-region detection: in `ops/` and `exec/fragment_jit.py` every
+function is kernel code (they are device-kernel libraries). Elsewhere a
+function is kernel code iff it is reachable from a jit root — decorated
+with `jax.jit` / `partial(jax.jit, ...)`, passed to `jax.jit(...)`, or
+returned by a builder passed to `_node_jit(...)` — transitively through
+same-module calls.
 
 Suppressions: append ``# lint: allow(<rule>[, <rule>...])`` to the
 offending line; on a `def` line it covers the whole function.
@@ -44,7 +50,8 @@ from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 from presto_tpu.analysis.findings import Finding
 
-RULES = ("host-sync", "float64", "traced-branch", "pow2-capacity")
+RULES = ("host-sync", "float64", "traced-branch", "pow2-capacity",
+         "where-free-masking")
 
 _ALLOW_RE = re.compile(r"#\s*lint:\s*allow\(([a-z0-9_,\- ]+)\)")
 
@@ -211,7 +218,8 @@ def kernel_functions(tree: ast.AST, path: str) -> List[ast.AST]:
     same-module transitive callees) elsewhere."""
     funcs = _collect_functions(tree)
     norm = path.replace("\\", "/")
-    if "/ops/" in norm or norm.startswith("ops/"):
+    if ("/ops/" in norm or norm.startswith("ops/")
+            or norm.endswith("exec/fragment_jit.py")):
         return [f for fs in funcs.values() for f in fs]
     work = list(_jit_roots(tree, funcs))
     seen: List[ast.AST] = []
@@ -371,6 +379,18 @@ class _RuleVisitor(ast.NodeVisitor):
                     return True
         return False
 
+    # -- where-free-masking --------------------------------------------------
+
+    def visit_BinOp(self, node: ast.BinOp):
+        if isinstance(node.op, ast.Mult) and (
+                _is_mask_like(node.left) or _is_mask_like(node.right)):
+            self.err("where-free-masking", node,
+                     "multiplying by a boolean mask propagates NaN/Inf "
+                     "from the masked-out lanes (NaN*0 = NaN) and widens "
+                     "dtypes silently; select with "
+                     "jnp.where(mask, x, fill) instead")
+        self.generic_visit(node)
+
     def visit_If(self, node: ast.If):
         if self._test_is_traced(node.test):
             self.err("traced-branch", node,
@@ -385,6 +405,30 @@ class _RuleVisitor(ast.NodeVisitor):
                      "Python loop condition on a traced array value — use "
                      "lax.while_loop or drive the loop from the host")
         self.generic_visit(node)
+
+
+_MASK_NAMES = {"mask", "live", "valid", "validity", "evalid"}
+
+
+def _is_mask_like(e: ast.expr) -> bool:
+    """True for expressions that read as boolean masks: comparisons,
+    their .astype() lifts, and values whose (terminal) name follows the
+    engine's mask conventions (live / validity / *_mask / *_valid)."""
+    if isinstance(e, ast.Compare):
+        return True
+    if (isinstance(e, ast.Call) and isinstance(e.func, ast.Attribute)
+            and e.func.attr == "astype"):
+        return _is_mask_like(e.func.value)
+    name = None
+    if isinstance(e, ast.Name):
+        name = e.id
+    elif isinstance(e, ast.Attribute):
+        name = e.attr
+    if name is not None:
+        low = name.lower()
+        return (low in _MASK_NAMES or low.endswith("_mask")
+                or low.endswith("_valid"))
+    return False
 
 
 def _has_bare_float(e: ast.expr) -> bool:

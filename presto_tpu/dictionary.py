@@ -36,6 +36,19 @@ def safe_str_array(values) -> np.ndarray:
     if arr.dtype.kind == "O":
         if any(isinstance(v, str) and v.endswith("\x00") for v in arr.flat):
             return np.asarray([str(v) for v in arr.flat], dtype=object)
+        # U-dtype is n * maxlen * 4 bytes: one long entry (a serialized
+        # HLL/tdigest sketch is ~10 KB) in a capacity-sized column turns
+        # the astype + np.unique sort into gigabytes of fixed-width
+        # copies (measured: 245 s for ONE approx_set query). Past a
+        # modest footprint, stay object-dtype — np.unique sorts it with
+        # per-object compares, which mostly-duplicate sketch columns
+        # finish in milliseconds.
+        maxlen = max((len(v) for v in arr.flat if isinstance(v, str)),
+                     default=0)
+        if arr.size * maxlen * 4 > (1 << 24):
+            return np.asarray(
+                [v if isinstance(v, str) else str(v) for v in arr.flat],
+                dtype=object)
         return arr.astype(str)
     return arr
 

@@ -1,0 +1,314 @@
+"""Whole-fragment device residency: stack a window of scan batches and fold
+the breaker's merge step over it inside ONE compiled XLA program.
+
+The per-batch driver loop costs a host→device dispatch per operator per
+batch — on a tunneled TPU that is ~35-50 ms of transport per round trip
+while the chip does microseconds of work (BENCH_NOTES.md round-5 roofline:
+Q1 SF1 runs ~700× above the HBM floor on dispatch latency alone). This
+module removes the loop from the host: consecutive same-structure batches
+are stacked along a new leading axis (a "window"), and a `lax.scan` inside
+the breaker's own jitted stepping program iterates the window on-device.
+A fragment then costs O(ceil(batches / window)) dispatches instead of
+O(batches × operators).
+
+Pieces (mechanism only — eligibility gating and the program keys live in
+exec/runtime.py, which owns the plan/breaker knowledge):
+
+- ``batch_struct_key``: the stacking-compatibility key. Two batches stack
+  iff their pytrees are structurally identical — same column names/types,
+  same dictionary OBJECTS (Dictionary equality is identity, so one
+  treedef match guarantees `_unify_batch_dicts` no-ops inside the traced
+  scan body), same validity/limb presence, same leaf shapes and dtypes.
+- ``iter_windows``: groups a batch stream into stacked windows of at most
+  `width` batches. Ragged tails pad with DEAD copies of the last real
+  batch (live mask zeroed — dead rows contribute nothing to a group merge
+  or a TopN heap) up to the next power of two, so the compiled window
+  shapes stay bounded: {2, 4, ..., width} plus the per-batch single path.
+- ``WindowSource``: the async producer. A host thread pulls the (already
+  decode-prefetched) scan stream, stacks windows, and stages them in a
+  depth-1 queue — the device-side double buffer: window k+1 is stacked
+  and its device work dispatched while the consumer's fused step for
+  window k is still executing. ``drain()`` recovers every pulled-but-
+  undispatched batch for the grace-spill path.
+- ``scan_stepper`` / ``topn_stepper``: builders for the fused stepping
+  functions runtime.py hands to `_node_jit` (one shared program per plan
+  structure via exec/programs.py).
+
+Everything here is kernel code for the analysis plane: the module is part
+of the kernel linter's jit-rooted scope (analysis/kernel_lint.py).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Iterable, Iterator, List, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from presto_tpu.batch import Batch
+
+
+class Window:
+    """A stacked window of `k` real batches (padded to `width` = k rounded
+    up to a power of two). `stacked` is a Batch pytree whose every leaf
+    carries a leading [width] axis; `first` is the untouched first real
+    batch (host-side handle kept for structure-sensitive fallbacks)."""
+
+    __slots__ = ("stacked", "k", "width", "first")
+
+    def __init__(self, stacked: Batch, k: int, width: int, first: Batch):
+        self.stacked = stacked
+        self.k = k
+        self.width = width
+        self.first = first
+
+
+WindowItem = Union[Batch, Window]
+
+
+def batch_struct_key(b: Batch):
+    """Hashable stacking-compatibility key: treedef (names, types, dict
+    identities, optional-plane presence) + per-leaf (shape, dtype)."""
+    leaves, treedef = jax.tree_util.tree_flatten(b)
+    return treedef, tuple((tuple(l.shape), str(l.dtype)) for l in leaves)
+
+
+def stack_batches(batches: List[Batch]) -> Batch:
+    """Stack K structurally-identical batches into one Batch whose leaves
+    carry a leading [K] axis (the aux — names/types/dicts — is shared, so
+    every `lax.scan` slice sees the SAME dictionary objects)."""
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *batches)
+
+
+def unstack_batch(stacked: Batch, k: int) -> List[Batch]:
+    """The first `k` (real) slices of a stacked window as plain batches —
+    the grace-overflow handler spills per-batch."""
+    return [jax.tree_util.tree_map(lambda x, i=i: x[i], stacked)
+            for i in range(k)]
+
+
+def dead_like(b: Batch) -> Batch:
+    """A structural clone of `b` with every row dead — window tail padding.
+    Chain filters AND into the zero live mask, group merges and TopN sorts
+    count only live rows, so padding slices are provably inert."""
+    return b.with_live(jnp.zeros_like(b.live))
+
+
+def window_device_bytes(w: Window) -> int:
+    """Device footprint of a stacked window (for spill accounting)."""
+    return sum(l.size * l.dtype.itemsize
+               for l in jax.tree_util.tree_leaves(w.stacked))
+
+
+def _pow2_at_least(n: int) -> int:
+    return 1 << max(n - 1, 0).bit_length()
+
+
+def iter_windows(stream: Iterable[Batch], width: int) -> Iterator[WindowItem]:
+    """Group CONSECUTIVE same-structure batches into stacked windows of at
+    most `width`; a batch whose structure differs from its predecessors
+    flushes the pending group first (order is always preserved). Lone
+    batches pass through unstacked — padding a single to width would spend
+    width× the compute to save zero dispatches."""
+    pending: List[Batch] = []
+    key = None
+    for b in stream:
+        k = batch_struct_key(b)
+        if pending and k != key:
+            yield _flush(pending)
+            pending = []
+        key = k
+        pending.append(b)
+        if len(pending) >= width:
+            yield _flush(pending)
+            pending = []
+    if pending:
+        yield _flush(pending)
+
+
+def _flush(pending: List[Batch]) -> WindowItem:
+    k = len(pending)
+    if k == 1:
+        return pending[0]
+    width = _pow2_at_least(k)
+    padded = pending + [dead_like(pending[-1])] * (width - k)
+    return Window(stack_batches(padded), k, width, pending[0])
+
+
+_SENTINEL = object()
+
+
+class WindowSource:
+    """Async window producer: a host thread pulls the scan stream (itself
+    fed by the decode-prefetch producer), stacks windows, and stages them
+    in a depth-1 queue. `jnp.stack` dispatches asynchronously, so window
+    k+1's device staging overlaps the consumer's in-flight fused step for
+    window k — a device-side double buffer with exactly one window in
+    flight and one staged.
+
+    ``drain()`` stops the producer and returns every batch it pulled from
+    the stream but the consumer never received (staged windows unstacked
+    back to their real batches, plus the partial pending group) — the
+    grace-overflow path hands these to the spill partitioner so no input
+    is lost when the consumer abandons the window loop mid-stream."""
+
+    def __init__(self, stream: Iterable[Batch], width: int):
+        self._stream = iter(stream)
+        # host-side producer config, not traced code (the module-wide
+        # kernel scope is for the stepper builders below)
+        self._width = max(2, int(width))  # lint: allow(host-sync)
+        self._q: "queue.Queue" = queue.Queue(maxsize=1)
+        self._stop = threading.Event()
+        self._exc: Optional[BaseException] = None
+        self._pending: List[Batch] = []
+        self._thread = threading.Thread(
+            target=self._produce, name="fragment-window-producer", daemon=True)
+        self._thread.start()
+
+    def _produce(self):
+        pending = self._pending
+        key = None
+        try:
+            for b in self._stream:
+                k = batch_struct_key(b)
+                if pending and k != key:
+                    if not self._put(_flush(list(pending))):
+                        return
+                    del pending[:]
+                key = k
+                pending.append(b)
+                if len(pending) >= self._width:
+                    if not self._put(_flush(list(pending))):
+                        return
+                    del pending[:]
+                if self._stop.is_set():
+                    return
+            if pending and self._put(_flush(list(pending))):
+                del pending[:]
+        except BaseException as e:  # propagated to the consumer
+            self._exc = e
+        finally:
+            self._put(_SENTINEL, force=True)
+
+    def _put(self, item, force: bool = False) -> bool:
+        while True:
+            stopped = self._stop.is_set()
+            if stopped and not force:
+                return False
+            try:
+                self._q.put(item, timeout=0.05)
+                return True
+            except queue.Full:
+                if stopped and force:
+                    # nobody will consume after a stop — drop the sentinel
+                    # rather than spin against a full queue under join()
+                    return False
+
+    def __iter__(self) -> Iterator[WindowItem]:
+        while True:
+            item = self._q.get()
+            if item is _SENTINEL:
+                if self._exc is not None:
+                    exc, self._exc = self._exc, None
+                    raise exc
+                return
+            yield item
+
+    def close(self):
+        self._stop.set()
+        self._thread.join(timeout=30.0)
+
+    def drain(self) -> List[Batch]:
+        """Stop the producer and recover its pulled-but-undelivered batches
+        in stream order: staged queue items first, then the partial group."""
+        self._stop.set()
+        self._thread.join(timeout=30.0)
+        rest: List[Batch] = []
+        while True:
+            try:
+                item = self._q.get_nowait()
+            except queue.Empty:
+                break
+            if item is _SENTINEL:
+                continue
+            if isinstance(item, Window):
+                rest.extend(unstack_batch(item.stacked, item.k))
+            else:
+                rest.append(item)
+        rest.extend(self._pending)
+        del self._pending[:]
+        return rest
+
+
+# ---------------------------------------------------------------------------
+# fused stepping-function builders (runtime.py jits these via _node_jit)
+
+
+def _split_first(stacked: Batch) -> Tuple[Batch, Batch]:
+    first = jax.tree_util.tree_map(lambda x: x[0], stacked)
+    rest = jax.tree_util.tree_map(lambda x: x[1:], stacked)
+    return first, rest
+
+
+def scan_stepper(merge_step: Callable, first: bool) -> Callable:
+    """Fused aggregate fragment step: fold `merge_step` (acc, batch, cap)
+    -> (acc, n_groups) over a stacked window via `lax.scan`, returning the
+    window's final accumulator and its MAX group count (the one scalar the
+    host confirms per window instead of per batch). The first slice is
+    peeled outside the scan so the carry is seeded with the step's own
+    output structure — `merge_step` is a structural fixed point (its
+    output feeds its input) only from the second application on.
+
+    `first=True` builds the no-incoming-accumulator variant (window 0)."""
+
+    def fold(acc0, stacked: Batch, cap: int):
+        first_b, rest = _split_first(stacked)
+        acc, ng = merge_step(acc0, first_b, cap)
+
+        def body(carry, b):
+            a, mx = carry
+            out, n = merge_step(a, b, cap)
+            return (out, jnp.maximum(mx, n)), None
+
+        (acc, ng), _ = jax.lax.scan(body, (acc, ng), rest)
+        return acc, ng
+
+    if first:
+        def fragment_step0(stacked: Batch, cap: int):
+            return fold(None, stacked, cap)
+
+        return fragment_step0
+
+    def fragment_step(acc, stacked: Batch, cap: int):
+        return fold(acc, stacked, cap)
+
+    return fragment_step
+
+
+def topn_stepper(topn_step: Callable, first: bool) -> Callable:
+    """Fused TopN fragment step: fold `topn_step` (acc, batch) -> acc over
+    a stacked window. TopN never overflows (the heap capacity is the
+    query's LIMIT), so the carry is just the accumulator."""
+
+    def fold(acc0, stacked: Batch):
+        first_b, rest = _split_first(stacked)
+        acc = topn_step(acc0, first_b)
+
+        def body(a, b):
+            return topn_step(a, b), None
+
+        acc, _ = jax.lax.scan(body, acc, rest)
+        return acc
+
+    if first:
+        def fragment_topn0(stacked: Batch):
+            return fold(None, stacked)
+
+        return fragment_topn0
+
+    def fragment_topn(acc, stacked: Batch):
+        return fold(acc, stacked)
+
+    return fragment_topn

@@ -52,6 +52,10 @@ _VOLATILE_CONFIG_FIELDS = frozenset({
     "recoverable_grouped_execution", "phase_wait_timeout_s",
     "split_affinity", "max_compiled_shapes", "max_compiled_shapes_scan",
     "max_compiled_shapes_breaker", "precompile_workers",
+    # fragment fusion selects WHICH programs dispatch (fused window vs
+    # per-batch), never what any one program computes; window width only
+    # shapes the stacked inputs, which jit keys on dynamically
+    "fragment_fusion", "fragment_window",
 })
 
 # program cache bound: one entry is one (structure, program key) identity;

@@ -23,6 +23,7 @@ from __future__ import annotations
 import dataclasses
 import time
 from functools import partial
+from types import SimpleNamespace
 from typing import Callable, Dict, Iterator, List, Optional, Tuple
 
 import jax
@@ -37,6 +38,7 @@ from presto_tpu.batch import (
     slice_column,
 )
 from presto_tpu.connector import Catalog
+from presto_tpu.exec import fragment_jit as _fragment_jit
 from presto_tpu.exec import programs as _programs
 from presto_tpu.expr.compile import compile_expr, compile_predicate
 from presto_tpu.obs import trace as _obs_trace
@@ -200,6 +202,16 @@ class ExecConfig:
     # compilation overlaps host scan decode instead of serializing in
     # front of batch 0. 0 disables.
     precompile_workers: int = 0
+    # whole-fragment device residency (exec/fragment_jit.py): stack up to
+    # fragment_window consecutive same-structure scan batches and fold the
+    # breaker step over the window inside ONE compiled program (lax.scan),
+    # collapsing O(batches) per-batch dispatches to O(batches / window).
+    # Applies to scan-rooted leaf fragments feeding a decomposable
+    # aggregate or a TopN sort; everything else (unnest, host projections,
+    # spill replay, grouped execution, radix) keeps the per-batch path.
+    # fragment_fusion=False preserves the per-batch path everywhere.
+    fragment_fusion: bool = True
+    fragment_window: int = 8
 
 
 def _node_jit(node: PlanNode, key: str, builder, _shared=True, **jit_kwargs):
@@ -1001,7 +1013,7 @@ def _input_state(b: Batch, name: str, op: str, a: AggSpec, st: Type,
             c = b.column(a.arg)
             vals = c.values.astype(jnp.int64)
             if c.validity is not None:
-                vals = vals * c.validity.astype(jnp.int64)
+                vals = jnp.where(c.validity, vals, 0)
             return StateCol(vals, None, "count_add")
         if a.fn in _COVAR_FNS:
             both = b.column(a.arg).valid_mask() & b.column(a.arg2).valid_mask()
@@ -1655,35 +1667,20 @@ def _grouped_execution_lifespans(node: Aggregate) -> int:
             return 0
 
 
-def _execute_aggregate(node: Aggregate, ctx: ExecContext) -> Iterator[Batch]:
+def _agg_steps(node: Aggregate) -> SimpleNamespace:
+    """Structural merge-step closures for one Aggregate node, memoized on
+    the node so the executor and the install-time breaker warmers hand
+    _node_jit the SAME function objects (one trace, one shared program).
+    Everything here derives from the node and its collapsed child chain —
+    no runtime data is captured, which is what makes the steps warmable
+    ahead of the stream."""
+    memo = node.__dict__.get("_agg_steps")
+    if memo is not None:
+        return memo
     from presto_tpu.plan.agg_states import state_types as _layout_state_types
 
-    if ctx.lifespan is None:
-        ls = _grouped_execution_lifespans(node)
-        if ls:
-            # grouped execution covers the aggregation too: sweep the
-            # task's buckets with the sweep rooted HERE so each bucket's
-            # accumulator is finalized and freed before the next builds
-            try:
-                ctx.lifespans = ls
-                for b in range(ctx.task_index, ls, ctx.n_tasks):
-                    ctx.lifespan = b
-                    yield from _execute_aggregate(node, ctx)
-            finally:
-                ctx.lifespan = None
-                ctx.lifespans = None
-            return
-
-    if any(a.fn in _NON_DECOMPOSABLE_FNS for a in node.aggs):
-        if node.step != "single":
-            raise RuntimeError(
-                "non-decomposable aggregates must run single-step "
-                "(fragmenter gathers them)"
-            )
-        yield from _execute_materialized_aggregate(node, ctx)
-        return
-
-    in_stream, chain = _fused_child(node.child, ctx)
+    _, chain0 = collapse_chain(node.child)
+    chain = chain0 or (lambda b: b)
     in_types = dict(node.child.output)
     layout = agg_state_layout(node.aggs, in_types)
     lpairs = limb_pairs(layout)
@@ -1815,33 +1812,22 @@ def _execute_aggregate(node: Aggregate, ctx: ExecContext) -> Iterator[Batch]:
         dicts = {k: v for k, v in b.dicts.items() if k in names}
         return Batch(names, types, cols, out_live, dicts), n_groups
 
-    # global (ungrouped) aggregation threads the accumulator linearly and
-    # never replays (no_overflow below): the input acc is dead the moment
-    # the step returns, so its device buffers can be donated and updated
-    # in place. Keyed aggregation CANNOT donate — the optimistic dispatch
-    # window keeps acc_before alive as the overflow-replay checkpoint.
-    _step_jit_kw = {}
-    if ctx.config.donate_stepping and not key_syms:
-        _step_jit_kw["donate_argnums"] = (0,)
-    jit_step = _node_jit(node, "step", lambda: (lambda acc, b, cap: merge_step(acc, b, cap)), static_argnums=(2,), **_step_jit_kw)
-    jit_step0 = _node_jit(node, "step0", lambda: (lambda b, cap: merge_step(None, b, cap)), static_argnums=(1,))
-    jit_accstep = _node_jit(node, "accstep", lambda: acc_merge_step, static_argnums=(2,))
-    # grace (hash-partitioned) aggregation: partition replay feeds batches
-    # that went through `chain` before spilling — merge must not re-chain
-    jit_step_raw = _node_jit(
-        node, "step_raw",
-        lambda: (lambda acc, b, cap: merge_step(acc, b, cap, prechained=True)),
-        static_argnums=(2,))
-    jit_step0_raw = _node_jit(
-        node, "step0_raw",
-        lambda: (lambda b, cap: merge_step(None, b, cap, prechained=True)),
-        static_argnums=(1,))
-    jit_chain = _node_jit(node, "chain_only", lambda: chain)
+    memo = SimpleNamespace(
+        chain=chain, in_types=in_types, layout=layout, lpairs=lpairs,
+        key_syms=key_syms, key_types=key_types, state_types=state_types,
+        in_to_states=in_to_states, acc_to_states=acc_to_states,
+        merge_step=merge_step, acc_merge_step=acc_merge_step)
+    node.__dict__["_agg_steps"] = memo
+    return memo
 
-    from presto_tpu.memory import LocalMemoryContext, batch_device_bytes
 
-    import threading as _threading
-
+def _agg_presize(node: Aggregate, ctx: "ExecContext"):
+    """CBO group-table pre-sizing + grace decision for an Aggregate,
+    shared by the executor and the install-time breaker warmers (the
+    warmers need the same capacity fingerprint the run will use or the
+    warm compiles the wrong shape). Returns (cap, ceiling, can_spill,
+    grace_from_start)."""
+    key_syms = node.group_keys
     cap = ctx.config.agg_capacity
     can_spill = bool(key_syms) and ctx.config.spill_enabled
     ceiling = max(ctx.config.agg_cap_ceiling, ctx.config.agg_capacity)
@@ -1872,8 +1858,151 @@ def _execute_aggregate(node: Aggregate, ctx: ExecContext) -> Iterator[Batch]:
     grace_from_start = can_spill and cap > ceiling
     if can_spill:
         cap = min(cap, ceiling)
+    return cap, ceiling, can_spill, grace_from_start
+
+
+def _fragment_eligibility(node: PlanNode, config: ExecConfig) -> Optional[str]:
+    """Why a breaker's ingest loop can NOT run as a fused fragment
+    (None = eligible). Static structure only — the executors add the
+    per-query gates (grouped-execution sweeps, radix engagement,
+    grace-from-start). Conservative by design: anything the fuser can't
+    prove inert under lax.scan (unnest, host projections, non-scan bases)
+    keeps the per-batch path."""
+    if not config.fragment_fusion:
+        return "off"
+    if config.fragment_window < 2:
+        return "window < 2"
+    if isinstance(node, Aggregate):
+        if any(a.fn in _NON_DECOMPOSABLE_FNS for a in node.aggs):
+            return "non-decomposable aggregate"
+    elif isinstance(node, Sort):
+        if node.limit is None:
+            return "full sort materializes"
+    else:
+        return "not a fused breaker"
+    try:
+        base, _ = collapse_chain(node.child)
+    except Exception:
+        return "chain does not collapse"
+    if not isinstance(base, TableScan):
+        return "chain base is not a table scan"
+    return None
+
+
+def _record_fragment_dispatch(node: PlanNode, ctx: "ExecContext",
+                              fused: bool, k: int = 1) -> None:
+    """Dispatch accounting for breaker ingest loops: one fused fragment
+    dispatch covers k batches; a per-batch step covers one. Feeds the
+    per-node EXPLAIN ANALYZE rendering, ctx.stats, and the process-wide
+    presto_tpu_{fragment,batch}_dispatches_total counters."""
+    from presto_tpu.scan import metrics as _scan_metrics
+
+    fs = node.__dict__.setdefault(
+        "_fragment_stats",
+        {"fragment_dispatches": 0, "batch_dispatches": 0, "fused_batches": 0})
+    if fused:
+        fs["fragment_dispatches"] += 1
+        fs["fused_batches"] += k
+        ctx.stats["fragment.dispatches"] = (
+            ctx.stats.get("fragment.dispatches", 0) + 1)
+        ctx.stats["fragment.fused_batches"] = (
+            ctx.stats.get("fragment.fused_batches", 0) + k)
+        _scan_metrics.record("fragment_dispatches", 1)
+    else:
+        fs["batch_dispatches"] += 1
+        ctx.stats["fragment.batch_dispatches"] = (
+            ctx.stats.get("fragment.batch_dispatches", 0) + 1)
+        _scan_metrics.record("batch_dispatches", 1)
+
+
+def _execute_aggregate(node: Aggregate, ctx: ExecContext) -> Iterator[Batch]:
+    if ctx.lifespan is None:
+        ls = _grouped_execution_lifespans(node)
+        if ls:
+            # grouped execution covers the aggregation too: sweep the
+            # task's buckets with the sweep rooted HERE so each bucket's
+            # accumulator is finalized and freed before the next builds
+            try:
+                ctx.lifespans = ls
+                for b in range(ctx.task_index, ls, ctx.n_tasks):
+                    ctx.lifespan = b
+                    yield from _execute_aggregate(node, ctx)
+            finally:
+                ctx.lifespan = None
+                ctx.lifespans = None
+            return
+
+    if any(a.fn in _NON_DECOMPOSABLE_FNS for a in node.aggs):
+        if node.step != "single":
+            raise RuntimeError(
+                "non-decomposable aggregates must run single-step "
+                "(fragmenter gathers them)"
+            )
+        yield from _execute_materialized_aggregate(node, ctx)
+        return
+
+    in_stream, _ = _fused_child(node.child, ctx)
+    steps = _agg_steps(node)
+    chain = steps.chain
+    in_types = steps.in_types
+    layout = steps.layout
+    key_syms = steps.key_syms
+    key_types = steps.key_types
+    state_types = steps.state_types
+    in_to_states = steps.in_to_states
+    merge_step = steps.merge_step
+    acc_merge_step = steps.acc_merge_step
+
+    # global (ungrouped) aggregation threads the accumulator linearly and
+    # never replays (no_overflow below): the input acc is dead the moment
+    # the step returns, so its device buffers can be donated and updated
+    # in place. Keyed aggregation CANNOT donate — the optimistic dispatch
+    # window keeps acc_before alive as the overflow-replay checkpoint.
+    _step_jit_kw = {}
+    if ctx.config.donate_stepping and not key_syms:
+        _step_jit_kw["donate_argnums"] = (0,)
+    jit_step = _node_jit(node, "step", lambda: (lambda acc, b, cap: merge_step(acc, b, cap)), static_argnums=(2,), **_step_jit_kw)
+    jit_step0 = _node_jit(node, "step0", lambda: (lambda b, cap: merge_step(None, b, cap)), static_argnums=(1,))
+    jit_accstep = _node_jit(node, "accstep", lambda: acc_merge_step, static_argnums=(2,))
+    # grace (hash-partitioned) aggregation: partition replay feeds batches
+    # that went through `chain` before spilling — merge must not re-chain
+    jit_step_raw = _node_jit(
+        node, "step_raw",
+        lambda: (lambda acc, b, cap: merge_step(acc, b, cap, prechained=True)),
+        static_argnums=(2,))
+    jit_step0_raw = _node_jit(
+        node, "step0_raw",
+        lambda: (lambda b, cap: merge_step(None, b, cap, prechained=True)),
+        static_argnums=(1,))
+    jit_chain = _node_jit(node, "chain_only", lambda: chain)
+
+    from presto_tpu.memory import LocalMemoryContext, batch_device_bytes
+
+    import threading as _threading
+
+    cap, ceiling, can_spill, grace_from_start = _agg_presize(node, ctx)
+    # whole-fragment fusion gate: static eligibility plus the per-query
+    # modes whose ingest must stay per-batch (memory-tight lifespan
+    # sweeps pin ~window× the state the mode exists to avoid)
+    frag_why = _fragment_eligibility(node, ctx.config)
+    if frag_why is None and ctx.lifespans is not None:
+        frag_why = "grouped-execution sweep"
+    if frag_why is None and grace_from_start:
+        frag_why = "grace-from-start spill"
+    node.__dict__["_fragment_fusion"] = (
+        "fused" if frag_why is None else frag_why)
+    if frag_why is None:
+        jit_frag_step = _node_jit(
+            node, "fragment_step",
+            lambda: _fragment_jit.scan_stepper(merge_step, False),
+            static_argnums=(2,), **_step_jit_kw)
+        jit_frag_step0 = _node_jit(
+            node, "fragment_step0",
+            lambda: _fragment_jit.scan_stepper(merge_step, True),
+            static_argnums=(1,))
 
     if node.step == "partial" and grace_from_start:
+        node.__dict__["_fragment_fusion"] = "partial passthrough"
         # Adaptive partial-aggregation bypass (reference: partial agg
         # adaptivity — when NDV ≈ row count the partial merge does no
         # reduction): emit per-row state contributions unmerged; the final
@@ -1923,6 +2052,7 @@ def _execute_aggregate(node: Aggregate, ctx: ExecContext) -> Iterator[Batch]:
         from presto_tpu.scan import metrics as _scan_metrics
         from presto_tpu.spiller import SpillFile
 
+        node.__dict__["_fragment_fusion"] = "radix-partitioned"
         P = ctx.config.radix_partitions
         budget = ctx.config.join_spill_budget_bytes
         split = _radix_splitter(node, ctx, key_syms, P, "agg_")
@@ -2114,6 +2244,7 @@ def _execute_aggregate(node: Aggregate, ctx: ExecContext) -> Iterator[Batch]:
                 else:
                     out, ng = step_fn(acc_before, b, cap)
                 state["acc"] = out
+                _record_fragment_dispatch(node, ctx, fused=False)
                 if no_overflow:
                     return
                 try:
@@ -2204,11 +2335,142 @@ def _execute_aggregate(node: Aggregate, ctx: ExecContext) -> Iterator[Batch]:
                 raw.spill(jit_chain(b))
             ctx.spill_manager.record(raw.spilled_bytes)
 
+        def absorb_fused(stream):
+            """Whole-fragment ingest: consecutive same-structure batches
+            arrive STACKED (WindowSource double-buffers them), and one
+            fused program folds chain+merge over the whole window on-device
+            via lax.scan — O(batches / window) dispatches instead of
+            O(batches). The overflow protocol matches absorb(): an
+            optimistic window of (checkpoint, item, max-ng) confirms up to
+            `depth` items late and replays from the checkpoint on the rare
+            capacity overflow, with whole windows as the replay unit.
+            Growth past the grace ceiling unstacks the unmerged windows
+            back to raw batches for the hash-partitioned spill path."""
+            nonlocal cap
+            depth = max(1, ctx.config.agg_pipeline_depth)
+            no_overflow = not key_syms
+            window = []  # (acc_before, WindowItem, ng_device_scalar)
+
+            def apply(acc_before, item, c):
+                if isinstance(item, _fragment_jit.Window):
+                    if acc_before is None:
+                        return jit_frag_step0(item.stacked, c)
+                    return jit_frag_step(acc_before, item.stacked, c)
+                if acc_before is None:
+                    return jit_step0(item, c)
+                return jit_step(acc_before, item, c)
+
+            def expand(entries):
+                """Unmerged optimistic-window entries → raw-batch triples
+                the _GraceOverflow handler understands."""
+                out = []
+                for _, item, _ in entries:
+                    if isinstance(item, _fragment_jit.Window):
+                        out.extend(
+                            (None, rb, None) for rb in
+                            _fragment_jit.unstack_batch(item.stacked, item.k))
+                    else:
+                        out.append((None, item, None))
+                return out
+
+            def dispatch(item):
+                acc_before = state["acc"]
+                t0 = time.time()
+                out, ng = apply(acc_before, item, cap)
+                state["acc"] = out
+                fused = isinstance(item, _fragment_jit.Window)
+                _record_fragment_dispatch(node, ctx, fused,
+                                          item.k if fused else 1)
+                if fused and ctx.tracer.enabled:
+                    ctx.tracer.record("fragment_step", "fragment_step", t0,
+                                      time.time(), batches=item.k,
+                                      width=item.width)
+                if no_overflow:
+                    return
+                try:
+                    ng.copy_to_host_async()
+                except Exception:
+                    pass
+                window.append((acc_before, item, ng))
+
+            def replay(entries, ngi):
+                nonlocal cap
+                state["acc"] = entries[0][0]
+                want2 = round_up_capacity(ngi)
+                if can_spill and want2 > ceiling:
+                    raise _GraceOverflow(expand(entries))
+                cap = want2
+                for i, (_, item, _) in enumerate(entries):
+                    for _ in range(ctx.config.max_growth_retries):
+                        acc_before = state["acc"]
+                        out, ng2 = apply(acc_before, item, cap)
+                        n2 = int(ng2)
+                        if n2 <= cap:
+                            state["acc"] = out
+                            break
+                        want2 = round_up_capacity(n2)
+                        if can_spill and want2 > ceiling:
+                            # acc holds the pre-entry checkpoint:
+                            # entries[i:] have not been merged into it
+                            raise _GraceOverflow(expand(entries[i:]))
+                        cap = want2
+                    else:
+                        raise RuntimeError(
+                            "aggregate capacity growth exceeded retries")
+
+            def confirm(block):
+                while window and (block or len(window) > depth):
+                    ngi = int(window[0][2])
+                    if ngi <= cap:
+                        window.pop(0)
+                        continue
+                    entries = list(window)
+                    window.clear()
+                    replay(entries, ngi)
+
+            def pinned_bytes(item):
+                if isinstance(item, _fragment_jit.Window):
+                    return _fragment_jit.window_device_bytes(item)
+                return batch_device_bytes(item)
+
+            src = _fragment_jit.WindowSource(stream,
+                                             ctx.config.fragment_window)
+            try:
+                for item in src:
+                    dispatch(item)
+                    confirm(block=False)
+                    out_bytes = batch_device_bytes(state["acc"])
+                    for acc_before, wi, _ in window:
+                        out_bytes += pinned_bytes(wi)
+                        if acc_before is not None:
+                            out_bytes += batch_device_bytes(acc_before)
+                    if can_spill and (
+                        state["revoke_requested"]
+                        or ctx.should_spill(out_bytes - mctx.bytes)
+                    ):
+                        confirm(block=True)
+                        state["revoke_requested"] = False
+                        do_spill()
+                    else:
+                        mctx.set_bytes(out_bytes)
+                confirm(block=True)
+            except _GraceOverflow as ov:
+                # recover everything the producer pulled but never delivered
+                # so the grace handler spills the COMPLETE remaining input
+                rest = src.drain()
+                raise _GraceOverflow(list(ov.entries)
+                                     + [(None, rb, None) for rb in rest])
+            finally:
+                src.close()
+
         if grace_from_start:
             grace_ingest(in_stream)
         else:
             try:
-                absorb(in_stream, jit_step, jit_step0)
+                if frag_why is None:
+                    absorb_fused(in_stream)
+                else:
+                    absorb(in_stream, jit_step, jit_step0)
             except _GraceOverflow as ov:
                 # the table outgrew the ceiling mid-stream: spill the
                 # confirmed accumulator as state pages, the unmerged window
@@ -3731,21 +3993,38 @@ def _sort_keys(node: Sort, b: Batch) -> List[SortKey]:
     return keys
 
 
+def _topn_step(node: Sort) -> Callable:
+    """Traceable TopN stepping closure (chain → merge → sort → truncate),
+    memoized on the node so the executor and the install-time breaker
+    warmers hand _node_jit the SAME function object (one trace, one shared
+    program). Derives everything from the node and its collapsed child
+    chain — no runtime data captured."""
+    memo = node.__dict__.get("_topn_step")
+    if memo is not None:
+        return memo
+    _, chain0 = collapse_chain(node.child)
+    chain = chain0 or (lambda b: b)
+    cap = round_up_capacity(node.limit)
+
+    def topn_step(acc: Optional[Batch], b: Batch):
+        b = chain(b)
+        if acc is not None:
+            acc, b = _unify_batch_dicts([acc, b])
+            merged = _concat2(acc, b)
+        else:
+            merged = b
+        out = sort_batch(merged, _sort_keys(node, merged), limit=node.limit)
+        return _truncate(out, cap)
+
+    node.__dict__["_topn_step"] = topn_step
+    return topn_step
+
+
 def _execute_sort(node: Sort, ctx: ExecContext) -> Iterator[Batch]:
     in_stream, chain = _fused_child(node.child, ctx)
     if node.limit is not None:
-        cap = round_up_capacity(node.limit)
         acc: Optional[Batch] = None
-
-        def topn_step(acc: Optional[Batch], b: Batch):
-            b = chain(b)
-            if acc is not None:
-                acc, b = _unify_batch_dicts([acc, b])
-                merged = _concat2(acc, b)
-            else:
-                merged = b
-            out = sort_batch(merged, _sort_keys(node, merged), limit=node.limit)
-            return _truncate(out, cap)
+        topn_step = _topn_step(node)
 
         # acc is threaded linearly (the previous acc is dead once the step
         # returns, and only the final one is yielded), so its buffers are
@@ -3753,8 +4032,43 @@ def _execute_sort(node: Sort, ctx: ExecContext) -> Iterator[Batch]:
         _topn_kw = ({"donate_argnums": (0,)}
                     if ctx.config.donate_stepping else {})
         jstep = _node_jit(node, "topn", lambda: topn_step, **_topn_kw)
-        for raw in in_stream:
-            acc = jstep(acc, raw)
+        frag_why = _fragment_eligibility(node, ctx.config)
+        node.__dict__["_fragment_fusion"] = (
+            "fused" if frag_why is None else frag_why)
+        if frag_why is None:
+            # fused fragment: fold the TopN step over stacked windows
+            # on-device — the heap never overflows (capacity is the LIMIT)
+            # so there is no confirm/replay protocol to thread through
+            jfstep = _node_jit(
+                node, "fragment_topn",
+                lambda: _fragment_jit.topn_stepper(topn_step, False),
+                **_topn_kw)
+            jfstep0 = _node_jit(
+                node, "fragment_topn0",
+                lambda: _fragment_jit.topn_stepper(topn_step, True))
+            src = _fragment_jit.WindowSource(in_stream,
+                                             ctx.config.fragment_window)
+            try:
+                for item in src:
+                    if isinstance(item, _fragment_jit.Window):
+                        t0 = time.time()
+                        acc = (jfstep0(item.stacked) if acc is None
+                               else jfstep(acc, item.stacked))
+                        _record_fragment_dispatch(node, ctx, True, item.k)
+                        if ctx.tracer.enabled:
+                            ctx.tracer.record(
+                                "fragment_step", "fragment_step", t0,
+                                time.time(), batches=item.k,
+                                width=item.width)
+                    else:
+                        acc = jstep(acc, item)
+                        _record_fragment_dispatch(node, ctx, False)
+            finally:
+                src.close()
+        else:
+            for raw in in_stream:
+                acc = jstep(acc, raw)
+                _record_fragment_dispatch(node, ctx, False)
         if acc is not None:
             yield acc
         return
@@ -3812,49 +4126,114 @@ _FUSED_CHILD_SIDES = {
 }
 
 
-def _chain_warmers(root: PlanNode, ctx: ExecContext) -> List[Callable]:
-    """Warm tasks for the scan-side fused chain programs execute_node will
-    jit under key "down": one zero-filled batch at the scan's (single,
-    padded) capacity per chain whose base is a TableScan. Scans carrying
-    dictionary-encoded or multi-plane columns are skipped — their batch
-    pytree structure depends on decoded data the warmer cannot fabricate,
-    so a warm call would compile an unused specialization. Best-effort by
-    contract: a missed warm only means the compile happens on batch 0, as
-    it did before the compile plane existed."""
-    from presto_tpu.types import DecimalType as _Dec, VarcharType as _Vc
+def _scan_warm_cap(scan: TableScan, ctx: ExecContext) -> Optional[int]:
+    """Eligibility + capacity for fabricating this scan's runtime batch
+    structure ahead of the stream. VARCHAR columns ARE warmable when the
+    table handle carries their (identity-stable) dictionary — the batch
+    codes against that same object at run time, so the fabricated treedef
+    matches. Decimals past 18 digits (hi-limb plane) and types without a
+    static dtype stay unwarmable: their plane layout depends on decoded
+    data."""
+    from presto_tpu.types import DecimalType as _Dec
 
-    tasks: List[Callable] = []
-
-    def warmable(scan: TableScan):
-        types = dict(scan.output)
-        for sym in scan.assignments:
-            t = types[sym]
-            if isinstance(t, _Vc) or not hasattr(t, "dtype"):
-                return None
-            if isinstance(t, _Dec) and t.precision > 18:
-                return None
-            try:
-                t.dtype
-            except Exception:
-                return None
-        if not scan.assignments:
+    if not scan.assignments:
+        return None
+    types = dict(scan.output)
+    try:
+        handle = ctx.catalog.connectors[scan.catalog].get_table(scan.table)
+        nrows = int(handle.row_count or 0)
+    except Exception:
+        return None
+    for sym, colname in scan.assignments.items():
+        t = types[sym]
+        if isinstance(t, _Dec) and t.precision > 18:
             return None
         try:
-            handle = ctx.catalog.connectors[scan.catalog].get_table(scan.table)
-            nrows = int(handle.row_count or 0)
+            t.dtype
         except Exception:
             return None
-        return round_up_capacity(min(nrows, ctx.config.batch_rows) or 1)
+        if getattr(t, "is_string", False):
+            try:
+                if handle.column(colname).dictionary is None:
+                    return None
+            except Exception:
+                return None
+    return round_up_capacity(min(nrows, ctx.config.batch_rows) or 1)
+
+
+def _fabricate_scan_batch(scan: TableScan, cap: int,
+                          ctx: ExecContext) -> Optional[Batch]:
+    """Zero-filled batch with the same pytree STRUCTURE runtime scan
+    batches will have: per-column dtype, validity-plane presence (stats
+    null_fraction hint), and the handle's own Dictionary objects (treedef
+    identity — Dictionary equality is `is`)."""
+    types = dict(scan.output)
+    try:
+        handle = ctx.catalog.connectors[scan.catalog].get_table(scan.table)
+    except Exception:
+        return None
+    names, btypes, cols, dicts = [], [], [], {}
+    for sym, colname in scan.assignments.items():
+        t = types[sym]
+        try:
+            info = handle.column(colname)
+        except Exception:
+            info = None
+        st = info.stats if info is not None else None
+        validity = (jnp.ones(cap, dtype=bool)
+                    if st is not None and (st.null_fraction or 0.0) > 0.0
+                    else None)
+        d = info.dictionary if info is not None else None
+        if getattr(t, "is_string", False) and d is None:
+            return None
+        if d is not None:
+            dicts[sym] = d
+        names.append(sym)
+        btypes.append(t)
+        cols.append(Column(jnp.zeros(cap, t.dtype), validity))
+    return Batch(names, btypes, cols, jnp.zeros(cap, dtype=bool), dicts)
+
+
+def _chain_warmers(root: PlanNode, ctx: ExecContext) -> List[Callable]:
+    """Warm tasks for ahead-of-stream precompilation: the scan-side fused
+    chain programs execute_node will jit under key "down", plus the
+    breaker step / fused fragment-step programs of Aggregate and TopN
+    nodes whose collapsed child base is a warmable TableScan (their chain
+    fuses INTO the stepping programs, so the breaker warm is the only way
+    those chains precompile). Best-effort by contract: a missed warm only
+    means the compile happens on batch 0, as it did before the compile
+    plane existed; a structurally-wrong fabrication compiles one unused
+    specialization."""
+    tasks: List[Callable] = []
+
+    def breaker_scan(n: PlanNode) -> Optional[Tuple[TableScan, int]]:
+        try:
+            base, _ = collapse_chain(n.child)
+        except Exception:
+            return None
+        if not isinstance(base, TableScan):
+            return None
+        cap = _scan_warm_cap(base, ctx)
+        return None if cap is None else (base, cap)
 
     def visit(n: PlanNode, top: bool):
         if isinstance(n, (Filter, Project)):
             base, down = collapse_chain(n)
             if top and down is not None and isinstance(base, TableScan):
-                cap = warmable(base)
+                cap = _scan_warm_cap(base, ctx)
                 if cap is not None:
                     tasks.append(partial(_warm_down_chain, n, down, base, cap))
             visit(base, False)
             return
+        if (isinstance(n, Aggregate)
+                and not any(a.fn in _NON_DECOMPOSABLE_FNS for a in n.aggs)):
+            hit = breaker_scan(n)
+            if hit is not None:
+                tasks.append(partial(_warm_agg_breaker, n, *hit, ctx))
+        elif isinstance(n, Sort) and n.limit is not None:
+            hit = breaker_scan(n)
+            if hit is not None:
+                tasks.append(partial(_warm_topn_breaker, n, *hit, ctx))
         fused = _FUSED_CHILD_SIDES.get(type(n), ())
         for i, c in enumerate(n.children()):
             visit(c, i not in fused)
@@ -3863,14 +4242,100 @@ def _chain_warmers(root: PlanNode, ctx: ExecContext) -> List[Callable]:
     return tasks
 
 
-def _warm_down_chain(node: PlanNode, down, scan: TableScan, cap: int) -> None:
-    types = dict(scan.output)
-    syms = list(scan.assignments.keys())
-    zb = Batch(syms, [types[s] for s in syms],
-               [Column(jnp.zeros(cap, types[s].dtype), None) for s in syms],
-               jnp.zeros(cap, bool), {})
+def _warm_down_chain(node: PlanNode, down, scan: TableScan, cap: int,
+                     ctx: Optional[ExecContext] = None) -> None:
+    if ctx is not None:
+        zb = _fabricate_scan_batch(scan, cap, ctx)
+    else:
+        types = dict(scan.output)
+        syms = list(scan.assignments.keys())
+        zb = Batch(syms, [types[s] for s in syms],
+                   [Column(jnp.zeros(cap, types[s].dtype), None)
+                    for s in syms],
+                   jnp.zeros(cap, bool), {})
+    if zb is None:
+        return
     out = _node_jit(node, "down", lambda: down)(zb)
     jax.block_until_ready(out.live)
+
+
+def _warm_agg_breaker(node: Aggregate, scan: TableScan, scan_cap: int,
+                      ctx: ExecContext) -> None:
+    """Warm the Aggregate breaker's step/step0 (and, when the fragment
+    fuses, fragment_step/fragment_step0) programs at the runtime presize
+    fingerprint. The builders come from the SAME memoized _agg_steps
+    closures and _node_jit keys the executor will use, so the warm and
+    the run share one trace and one compiled program. Modes whose ingest
+    never uses these programs (grace-from-start, radix engagement,
+    grouped-execution sweeps) are skipped rather than guessed at."""
+    if _grouped_execution_lifespans(node):
+        return
+    cap, ceiling, can_spill, grace_from_start = _agg_presize(node, ctx)
+    if grace_from_start:
+        return
+    steps = _agg_steps(node)
+    merge_step = steps.merge_step
+    key_syms = steps.key_syms
+    if (key_syms and ctx.config.radix_partitions > 1
+            and (ctx.config.join_spill_budget_bytes is not None
+                 or cap > ctx.config.agg_capacity)):
+        return  # radix ingest uses the prechained step family instead
+    zb = _fabricate_scan_batch(scan, scan_cap, ctx)
+    if zb is None:
+        return
+    _step_jit_kw = {}
+    if ctx.config.donate_stepping and not key_syms:
+        _step_jit_kw["donate_argnums"] = (0,)
+    jit_step = _node_jit(node, "step", lambda: (lambda acc, b, cap: merge_step(acc, b, cap)), static_argnums=(2,), **_step_jit_kw)
+    jit_step0 = _node_jit(node, "step0", lambda: (lambda b, cap: merge_step(None, b, cap)), static_argnums=(1,))
+    acc, _ = jit_step0(zb, cap)
+    acc, _ = jit_step(acc, zb, cap)
+    if _fragment_eligibility(node, ctx.config) is None:
+        stacked = _fragment_jit.stack_batches(
+            [zb] * max(2, ctx.config.fragment_window))
+        jit_frag_step = _node_jit(
+            node, "fragment_step",
+            lambda: _fragment_jit.scan_stepper(merge_step, False),
+            static_argnums=(2,), **_step_jit_kw)
+        jit_frag_step0 = _node_jit(
+            node, "fragment_step0",
+            lambda: _fragment_jit.scan_stepper(merge_step, True),
+            static_argnums=(1,))
+        facc, _ = jit_frag_step0(stacked, cap)
+        facc, _ = jit_frag_step(facc, stacked, cap)
+        jax.block_until_ready(facc.live)
+    jax.block_until_ready(acc.live)
+
+
+def _warm_topn_breaker(node: Sort, scan: TableScan, scan_cap: int,
+                       ctx: ExecContext) -> None:
+    """Warm the TopN breaker's stepping programs (per-batch and, when the
+    fragment fuses, the stacked-window variants) from a fabricated scan
+    batch — same memoized _topn_step closure and _node_jit keys as the
+    executor."""
+    zb = _fabricate_scan_batch(scan, scan_cap, ctx)
+    if zb is None:
+        return
+    topn_step = _topn_step(node)
+    _topn_kw = ({"donate_argnums": (0,)}
+                if ctx.config.donate_stepping else {})
+    jstep = _node_jit(node, "topn", lambda: topn_step, **_topn_kw)
+    acc = jstep(None, zb)
+    acc = jstep(acc, zb)
+    if _fragment_eligibility(node, ctx.config) is None:
+        stacked = _fragment_jit.stack_batches(
+            [zb] * max(2, ctx.config.fragment_window))
+        jfstep = _node_jit(
+            node, "fragment_topn",
+            lambda: _fragment_jit.topn_stepper(topn_step, False),
+            **_topn_kw)
+        jfstep0 = _node_jit(
+            node, "fragment_topn0",
+            lambda: _fragment_jit.topn_stepper(topn_step, True))
+        facc = jfstep0(stacked)
+        facc = jfstep(facc, stacked)
+        jax.block_until_ready(facc.live)
+    jax.block_until_ready(acc.live)
 
 
 def install_plan_programs(root: PlanNode, ctx: ExecContext) -> None:
@@ -3881,9 +4346,30 @@ def install_plan_programs(root: PlanNode, ctx: ExecContext) -> None:
     every structure-mutating pass (subquery binding, colocation tagging,
     fragment decode)."""
     _programs.install_plan(root, ctx.config)
+    try:
+        _mark_fragment_fusion(root, ctx.config)
+    except Exception:
+        pass  # cosmetic EXPLAIN marker; the executor re-stamps on run
     if ctx.config.precompile_workers > 0:
         _programs.submit_warmers(_chain_warmers(root, ctx),
                                  ctx.config.precompile_workers)
+
+
+def _mark_fragment_fusion(root: PlanNode, config: ExecConfig) -> None:
+    """Stamp the static fragment-fusion eligibility verdict on every
+    breaker so EXPLAIN (without ANALYZE) already shows which fragments
+    will fuse; executors overwrite with the runtime decision (which adds
+    per-query gates like grace-from-start)."""
+
+    def visit(n: PlanNode):
+        if isinstance(n, (Aggregate, Sort)):
+            why = _fragment_eligibility(n, config)
+            n.__dict__["_fragment_fusion"] = (
+                "fused" if why is None else why)
+        for c in n.children():
+            visit(c)
+
+    visit(root)
 
 
 def run_plan(qp: QueryPlan, ctx: ExecContext) -> Batch:

@@ -29,7 +29,7 @@ from presto_tpu.batch import Batch, Column, round_up_capacity
 from presto_tpu.ops.grouping import KeyCol, StateCol, grouped_merge
 from presto_tpu.ops.join import build_side, gather_join_output, probe_unique
 from presto_tpu.ops.partition import partition_for_exchange
-from presto_tpu.parallel.mesh import WORKERS
+from presto_tpu.parallel.mesh import WORKERS, shard_map
 
 
 def _specs_like(batch: Batch, spec):
@@ -159,7 +159,7 @@ def distributed_aggregate(
         out = Batch(partial.names, partial.types, cols, out_live, partial.dicts)
         return out, jax.lax.psum(ovf, WORKERS)
 
-    prog = jax.shard_map(
+    prog = shard_map(
         device_program,
         mesh=mesh,
         in_specs=(_specs_like(batch, P(WORKERS)),),
@@ -255,7 +255,7 @@ def distributed_join_probe(
             dicts[c] = build.dicts[c]
     tmpl = Batch(names, types, tmpl_cols, jnp.zeros(1, bool), dicts)
 
-    prog = jax.shard_map(
+    prog = shard_map(
         device_program,
         mesh=mesh,
         in_specs=(

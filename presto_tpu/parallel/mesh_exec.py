@@ -53,7 +53,7 @@ from presto_tpu.ops.join import (
 )
 from presto_tpu.ops.partition import partition_for_exchange
 from presto_tpu.ops.sort import limit_batch, sort_batch
-from presto_tpu.parallel.mesh import WORKERS
+from presto_tpu.parallel.mesh import WORKERS, shard_map
 from presto_tpu.plan.agg_states import (
     agg_state_layout,
     limb_pairs,
@@ -571,7 +571,7 @@ class MeshExecutor:
         # replica; a one-fragment plan is row-sharded and the global view
         # IS the concatenated result
         out_spec = P(WORKERS)
-        prog = jax.jit(jax.shard_map(
+        prog = jax.jit(shard_map(
             program, mesh=self.mesh,
             in_specs=in_specs,
             out_specs=(out_spec, P()),

@@ -476,6 +476,16 @@ def plan_to_string(node: PlanNode, indent: int = 0, node_stats=None,
         s = f"{pad}Output[{', '.join(node.names)}]"
     else:
         s = f"{pad}{type(node).__name__}"
+    frag = node.__dict__.get("_fragment_fusion")
+    if frag is not None:
+        fs = node.__dict__.get("_fragment_stats")
+        if fs and (fs.get("fragment_dispatches") or fs.get("batch_dispatches")):
+            s += (f"   [fragment={frag}; dispatches="
+                  f"{fs['fragment_dispatches']}fused"
+                  f"({fs['fused_batches']} batches)"
+                  f"+{fs['batch_dispatches']}per-batch]")
+        else:
+            s += f"   [fragment={frag}]"
     jstats = getattr(node, "_jit_stats", None)
     if node_stats and id(node) in node_stats:
         st = node_stats[id(node)]

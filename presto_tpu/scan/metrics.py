@@ -18,6 +18,11 @@ COUNTER_NAMES = (
     "radix_spill_bytes", "radix_aligned_batches",
 )
 
+# dispatch-count counters for whole-fragment fusion (exec/fragment_jit.py):
+# these render as presto_tpu_{k}_total — NOT under the scan_ prefix, they
+# count engine dispatches — but share the store/lock/plane-label contract
+_DISPATCH_COUNTER_NAMES = ("fragment_dispatches", "batch_dispatches")
+
 _HELP = {
     "splits_pruned": "splits eliminated by min/max split statistics",
     "rows_predecode_filtered":
@@ -35,10 +40,16 @@ _HELP = {
     "radix_aligned_batches":
         "exchange pages consumed with a radix tag, skipping the device "
         "re-partition sort",
+    "fragment_dispatches":
+        "fused whole-fragment device dispatches (one lax.scan program "
+        "covering a stacked window of batches)",
+    "batch_dispatches":
+        "per-batch breaker step dispatches (the unfused fallback path)",
 }
 
 _lock = threading.Lock()
-_counters: Dict[str, int] = {k: 0 for k in COUNTER_NAMES}
+_counters: Dict[str, int] = {
+    k: 0 for k in COUNTER_NAMES + _DISPATCH_COUNTER_NAMES}
 
 
 def record(name: str, delta: int) -> None:
@@ -70,6 +81,10 @@ def metric_rows(labels: Optional[Dict[str, str]] = None,
     adds plane=worker / plane=coordinator) or a single-process deployment
     scraped on both planes double-counts."""
     snap = snapshot()
-    return [(f"presto_tpu_scan_{k}_total", _HELP[k], snap[k], labels,
+    rows = [(f"presto_tpu_scan_{k}_total", _HELP[k], snap[k], labels,
              "counter")
             for k in COUNTER_NAMES]
+    rows.extend((f"presto_tpu_{k}_total", _HELP[k], snap[k], labels,
+                 "counter")
+                for k in _DISPATCH_COUNTER_NAMES)
+    return rows

@@ -203,6 +203,13 @@ class SystemSessionProperties:
                              "Compiled-shape budget override for breaker-"
                              "class nodes (0 = inherit global)", int, 0,
                              validator=_nonneg("max_compiled_shapes_breaker")),
+            PropertyMetadata("fragment_fusion",
+                             "Fold eligible leaf fragments into one fused "
+                             "lax.scan program per batch window", bool, True),
+            PropertyMetadata("fragment_window",
+                             "Max batches stacked per fused fragment "
+                             "dispatch", int, 8,
+                             validator=_positive("fragment_window")),
         ]
 
     def names(self) -> List[str]:
@@ -313,4 +320,6 @@ class Session:
                                       or None),
             max_compiled_shapes_breaker=(
                 self.get("max_compiled_shapes_breaker") or None),
+            fragment_fusion=self.get("fragment_fusion"),
+            fragment_window=self.get("fragment_window"),
         )

@@ -22,6 +22,12 @@ import pandas as pd  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running sweeps excluded from the tier-1 'not slow' run")
+
+
 @pytest.fixture(scope="session")
 def rng():
     return np.random.default_rng(1234)

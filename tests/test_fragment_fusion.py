@@ -1,0 +1,330 @@
+"""Whole-fragment device residency (exec/fragment_jit.py): window
+stacking/padding units, the async double-buffer producer, and
+local-vs-fused verifier equality — the fused lax.scan ingest must be
+result-identical to the per-batch path, decline the modes it cannot
+cover (grace spill, grouped execution), and actually collapse the
+dispatch count."""
+
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+import pytest
+
+from presto_tpu.batch import Batch
+from presto_tpu.catalog.memory import MemoryConnector
+from presto_tpu.catalog.tpch import tpch_catalog
+from presto_tpu.connector import Catalog
+from presto_tpu.exec import ExecConfig, LocalRunner
+from presto_tpu.exec import fragment_jit as fj
+from presto_tpu.verifier import Verifier, report
+
+from conftest import assert_frames_match
+
+
+def _mkbatch(n=4, base=0, cap=8):
+    vals = np.zeros(cap, np.int64)
+    vals[:n] = np.arange(base, base + n)
+    live = np.zeros(cap, bool)
+    live[:n] = True
+    from presto_tpu.batch import Column
+    from presto_tpu.types import BIGINT
+
+    return Batch(["x"], [BIGINT], [Column(jnp.asarray(vals), None)],
+                 jnp.asarray(live), {})
+
+
+# ---------------------------------------------------------------------------
+# window stacking units
+
+
+def test_iter_windows_groups_and_pads():
+    bs = [_mkbatch(base=i) for i in range(6)]
+    items = list(fj.iter_windows(iter(bs), width=4))
+    # 6 same-struct batches at width 4 -> one full window + a 2-tail
+    assert [type(i) for i in items] == [fj.Window, fj.Window]
+    assert items[0].k == 4 and items[0].width == 4
+    assert items[1].k == 2 and items[1].width == 2
+    assert items[0].stacked.live.shape == (4, 8)
+
+
+def test_iter_windows_ragged_tail_pads_to_pow2_with_dead_rows():
+    bs = [_mkbatch(base=i) for i in range(7)]
+    (w,) = list(fj.iter_windows(iter(bs), width=8))
+    assert w.k == 7 and w.width == 8
+    # padding slice is a dead clone of the last real batch
+    assert not bool(w.stacked.live[7].any())
+    assert bool(w.stacked.live[6].any())
+    np.testing.assert_array_equal(np.asarray(w.stacked.column("x").values[7]),
+                                  np.asarray(bs[-1].column("x").values))
+
+
+def test_iter_windows_lone_batch_passes_through():
+    bs = [_mkbatch()]
+    items = list(fj.iter_windows(iter(bs), width=8))
+    assert len(items) == 1 and isinstance(items[0], Batch)
+
+
+def test_iter_windows_flushes_on_structure_change():
+    small = [_mkbatch(cap=8, base=i) for i in range(3)]
+    big = [_mkbatch(cap=16, base=i) for i in range(2)]
+    items = list(fj.iter_windows(iter(small + big), width=8))
+    assert isinstance(items[0], fj.Window) and items[0].k == 3
+    assert isinstance(items[1], fj.Window) and items[1].k == 2
+    assert items[0].stacked.live.shape[1] == 8
+    assert items[1].stacked.live.shape[1] == 16
+
+
+def test_unstack_roundtrip():
+    bs = [_mkbatch(base=i) for i in range(5)]
+    (w,) = list(fj.iter_windows(iter(bs), width=8))
+    back = fj.unstack_batch(w.stacked, w.k)
+    assert len(back) == 5
+    for orig, rb in zip(bs, back):
+        np.testing.assert_array_equal(np.asarray(orig.column("x").values),
+                                      np.asarray(rb.column("x").values))
+        np.testing.assert_array_equal(np.asarray(orig.live),
+                                      np.asarray(rb.live))
+
+
+# ---------------------------------------------------------------------------
+# the async double-buffer producer
+
+
+def test_window_source_preserves_order():
+    bs = [_mkbatch(base=i) for i in range(20)]
+    src = fj.WindowSource(iter(bs), width=4)
+    got = []
+    for item in src:
+        if isinstance(item, fj.Window):
+            got.extend(fj.unstack_batch(item.stacked, item.k))
+        else:
+            got.append(item)
+    src.close()
+    assert len(got) == 20
+    for i, b in enumerate(got):
+        assert int(b.column("x").values[0]) == i
+
+
+def test_window_source_drain_recovers_undelivered():
+    """Consumer abandons mid-stream: drain() must hand back every batch
+    the producer pulled but never delivered, in stream order."""
+    bs = [_mkbatch(base=i) for i in range(32)]
+    src = fj.WindowSource(iter(bs), width=4)
+    consumed = []
+    it = iter(src)
+    first = next(it)
+    assert isinstance(first, fj.Window)
+    consumed.extend(fj.unstack_batch(first.stacked, first.k))
+    rest = src.drain()
+    firsts = [int(b.column("x").values[0]) for b in consumed + rest]
+    # no duplicates, no gaps within what was pulled; prefix of the stream
+    assert firsts == sorted(set(firsts))
+    assert firsts[: len(consumed)] == [0, 1, 2, 3]
+
+
+def test_window_source_propagates_producer_exception():
+    def stream():
+        yield _mkbatch(base=0)
+        raise RuntimeError("decode failed")
+
+    src = fj.WindowSource(stream(), width=4)
+    with pytest.raises(RuntimeError, match="decode failed"):
+        list(src)
+    src.close()
+
+
+def test_window_source_close_does_not_hang_when_unconsumed():
+    bs = [_mkbatch(base=i) for i in range(64)]
+    src = fj.WindowSource(iter(bs), width=4)
+    t0 = time.time()
+    src.close()
+    assert time.time() - t0 < 5.0
+    assert not src._thread.is_alive()
+
+
+# ---------------------------------------------------------------------------
+# fused-vs-per-batch equality (memory connector, counters)
+
+
+def _memory_catalog(n=3000, nulls=True):
+    rng = np.random.default_rng(7)
+    conn = MemoryConnector()
+    g = rng.integers(0, 5, n)
+    v = rng.normal(0.0, 10.0, n)
+    vals = np.array([None if nulls and i % 17 == 0 else float(x)
+                     for i, x in enumerate(v)], dtype=object)
+    conn.add_table("t", pd.DataFrame({
+        "g": g, "v": vals, "s": [f"s{int(x) % 3}" for x in g]}))
+    cat = Catalog()
+    cat.register("mem", conn, default=True)
+    return cat
+
+
+def _run_pair(sql, n=3000, **cfg):
+    cat = _memory_catalog(n)
+    on = LocalRunner(cat, ExecConfig(batch_rows=512, **cfg))
+    off = LocalRunner(cat, ExecConfig(batch_rows=512,
+                                      fragment_fusion=False, **cfg))
+    return on, on.run(sql), off, off.run(sql)
+
+
+def test_fused_agg_matches_and_collapses_dispatches():
+    on, d_on, off, d_off = _run_pair(
+        "select g, count(*) c, sum(v) s, avg(v) a from t group by g")
+    assert_frames_match(d_on, d_off)
+    assert on.last_stats.get("fragment.batch_dispatches", 0) == 0
+    assert off.last_stats.get("fragment.dispatches", 0) == 0
+    # 3000 rows / 512-row batches = 6 batches -> one fused window (W=8)
+    assert on.last_stats["fragment.dispatches"] <= 3
+    assert on.last_stats["fragment.fused_batches"] == \
+        off.last_stats["fragment.batch_dispatches"]
+
+
+def test_fused_varchar_group_key_matches():
+    on, d_on, off, d_off = _run_pair(
+        "select s, count(*) c from t group by s order by s")
+    assert_frames_match(d_on, d_off)
+    assert on.last_stats.get("fragment.dispatches", 0) >= 1
+
+
+def test_fused_topn_matches():
+    on, d_on, off, d_off = _run_pair(
+        "select g, v from t order by v desc limit 7")
+    assert_frames_match(d_on, d_off)
+    assert on.last_stats.get("fragment.dispatches", 0) >= 1
+    assert on.last_stats.get("fragment.batch_dispatches", 0) == 0
+
+
+def test_overflow_replay_matches():
+    """A derived group key (no column stats, so the CBO can't pre-size)
+    at tiny initial capacity forces the growth-replay ladder through the
+    fused window path; results must still match bit-for-bit."""
+    on, d_on, off, d_off = _run_pair(
+        "select cast(v * 100 as bigint) k, count(*) c, sum(v) s"
+        " from t group by cast(v * 100 as bigint)",
+        agg_capacity=128, n=5000)
+    assert_frames_match(d_on, d_off)
+
+
+def test_grace_spill_declines_fusion_and_matches():
+    """A ceiling below the CBO presize forces grace-from-start: the fused
+    path must decline (per-batch spill ingest) and still match."""
+    cat = _memory_catalog(5000)
+    base = dict(batch_rows=512, agg_capacity=128, agg_cap_ceiling=128,
+                spill_enabled=True)
+    on = LocalRunner(cat, ExecConfig(**base))
+    off = LocalRunner(cat, ExecConfig(fragment_fusion=False, **base))
+    sql = "select g, v, count(*) c from t group by g, v"
+    d_on, d_off = on.run(sql), off.run(sql)
+    assert_frames_match(d_on, d_off)
+    assert on.last_stats.get("fragment.dispatches", 0) == 0
+
+
+def test_fusion_off_is_default_behavior():
+    """fragment_fusion=false must preserve the per-batch path bit-for-bit
+    (no windows, no fused programs, batch counters only)."""
+    cat = _memory_catalog(3000)
+    off = LocalRunner(cat, ExecConfig(batch_rows=512,
+                                      fragment_fusion=False))
+    d = off.run("select g, sum(v) s from t group by g")
+    assert off.last_stats.get("fragment.dispatches", 0) == 0
+    assert off.last_stats["fragment.batch_dispatches"] > 0
+    assert len(d) == 5
+
+
+def test_explain_analyze_shows_fragment_marker():
+    cat = _memory_catalog(3000)
+    r = LocalRunner(cat, ExecConfig(batch_rows=512))
+    out = r.explain_analyze("select g, count(*) c from t group by g")
+    assert "fragment=fused" in out
+    assert "fused(" in out
+
+
+def test_dispatch_metrics_exposed():
+    from presto_tpu.scan import metrics as scan_metrics
+
+    cat = _memory_catalog(3000)
+    r = LocalRunner(cat, ExecConfig(batch_rows=512))
+    r.run("select g, count(*) c from t group by g")
+    rows = scan_metrics.metric_rows()
+    names = {row[0] for row in rows}
+    assert "presto_tpu_fragment_dispatches_total" in names
+    assert "presto_tpu_batch_dispatches_total" in names
+    snap = scan_metrics.snapshot()
+    assert snap["fragment_dispatches"] >= 1
+
+
+def test_session_property_roundtrip():
+    from presto_tpu.server.session import SYSTEM_PROPERTIES, Session
+
+    s = Session(properties={"fragment_fusion": False, "fragment_window": 4})
+    cfg = s.exec_config()
+    assert cfg.fragment_fusion is False
+    assert cfg.fragment_window == 4
+    assert SYSTEM_PROPERTIES.default("fragment_fusion") is True
+    with pytest.raises(Exception):
+        SYSTEM_PROPERTIES.decode("fragment_window", "0")
+
+
+# ---------------------------------------------------------------------------
+# local-vs-fused verifier sweep over the TPC-H suite
+
+
+@pytest.fixture(scope="module")
+def tpch_engines():
+    cat = tpch_catalog(0.01)
+    # small batches force multi-batch fragments so fusion actually engages
+    control = LocalRunner(cat, ExecConfig(batch_rows=1 << 13,
+                                          fragment_fusion=False))
+    test = LocalRunner(cat, ExecConfig(batch_rows=1 << 13))
+    return control, test
+
+
+def _tpch_queries():
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "tpch_queries", os.path.join(os.path.dirname(__file__),
+                                     "test_tpch.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod.QUERIES
+
+
+def test_tpch_subset_fused_matches_unfused(tpch_engines):
+    """Non-slow representative subset: agg-only (q1), filter+agg (q6),
+    topn (q2), join+agg (q3), high-NDV group (q13)."""
+    control, test = tpch_engines
+    queries = _tpch_queries()
+    picks = [(k, queries[k]) for k in ("q1", "q2", "q3", "q6", "q13")]
+    v = Verifier(control, test)
+    outcomes = v.run_suite(picks)
+    assert all(o.ok for o in outcomes), report(outcomes)
+
+
+@pytest.mark.slow
+def test_tpch_sweep_fused_matches_unfused(tpch_engines):
+    control, test = tpch_engines
+    queries = _tpch_queries()
+    v = Verifier(control, test)
+    outcomes = v.run_suite(sorted(queries.items(),
+                                  key=lambda kv: int(kv[0][1:])))
+    assert all(o.ok for o in outcomes), report(outcomes)
+
+
+def test_tpch_sweep_spill_configs_match():
+    """Spill/overflow-replay shapes: tiny capacity + ceiling on the agg-
+    heavy queries — fusion must decline into grace or replay correctly."""
+    cat = tpch_catalog(0.01)
+    cfg = dict(batch_rows=1 << 12, agg_capacity=256, agg_cap_ceiling=1024,
+               spill_enabled=True)
+    control = LocalRunner(cat, ExecConfig(fragment_fusion=False, **cfg))
+    test = LocalRunner(cat, ExecConfig(**cfg))
+    queries = _tpch_queries()
+    picks = [(k, queries[k]) for k in ("q1", "q3", "q6", "q13", "q18")]
+    v = Verifier(control, test)
+    outcomes = v.run_suite(picks)
+    assert all(o.ok for o in outcomes), report(outcomes)

@@ -87,11 +87,11 @@ def test_worker_loss_reruns_only_unfinished_lifespans(cat):
         orig = w1.task_manager.update_task
         state = {"n": 0}
 
-        def dying_update(tid, update):
+        def dying_update(tid, update, **kw):
             state["n"] += 1
             if state["n"] > 2:
                 raise OSError("injected: worker refuses new tasks")
-            return orig(tid, update)
+            return orig(tid, update, **kw)
 
         w1.task_manager.update_task = dying_update
         got = dist.run(SQL)
