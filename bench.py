@@ -184,6 +184,16 @@ _CONFIGS = {
     "q3_sf10": (Q3, "tpch", 10.0, "lineitem", {}),
     "join_sf1": (JOIN_SF1, "tpch", 1.0, "lineitem",
                  {"radix_partitions": 8}),
+    # breaker-engine A/B: the same keyed aggregation forced through the
+    # Pallas linear-probing hash engine vs the sort/segment engine. The
+    # rows/s delta between the pair IS the hash-engine win on a
+    # high-duplication group-by (on TPU the hash path replaces the
+    # O(n log n) sort with one MXU-free probe pass; the CBO picks it
+    # when est. duplication x4+ — plan/stats.choose_breaker_engine)
+    "groupby_engine_ab_sf1": (Q1, "tpch", 1.0, "lineitem",
+                              {"breaker_engine": "hash"}),
+    "groupby_engine_ab_sort_sf1": (Q1, "tpch", 1.0, "lineitem",
+                                   {"breaker_engine": "sort"}),
     "q9": (Q9, "tpch", None, "lineitem", {"runs": 2}),
     "q64": (Q64, "tpcds", None, "store_sales",
             {"agg_capacity": 1 << 16, "runs": 2}),
@@ -194,7 +204,8 @@ _ALIASES = {"q9_sf100": "q9", "q64_sf100": "q64"}
 
 # Per-config wall caps (seconds): one slow compile can only burn this much.
 _CAPS = {"q1_sf1": 420, "q1_nofuse_sf1": 420, "q6_sf10": 420,
-         "q3_sf10": 600, "join_sf1": 420, "q9": 900, "q64": 900}
+         "q3_sf10": 600, "join_sf1": 420, "q9": 900, "q64": 900,
+         "groupby_engine_ab_sf1": 420, "groupby_engine_ab_sort_sf1": 420}
 
 
 def _dataset_ready(kind: str, sf: float) -> bool:
@@ -400,7 +411,8 @@ def main():
     sf_over = {"q9": float(os.environ.get("BENCH_SF_Q9", "100")),
                "q64": float(os.environ.get("BENCH_SF_Q64", "100"))}
     wanted = os.environ.get(
-        "BENCH_CONFIGS", "q1_sf1,q1_nofuse_sf1,q6_sf10,q3_sf10,join_sf1,q9,q64"
+        "BENCH_CONFIGS", "q1_sf1,q1_nofuse_sf1,q6_sf10,q3_sf10,join_sf1,"
+        "groupby_engine_ab_sf1,groupby_engine_ab_sort_sf1,q9,q64"
     ).split(",")
 
     for name in (w.strip() for w in wanted):

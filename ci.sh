@@ -198,6 +198,53 @@ if [ "$rc" -ne 0 ]; then
   exit "$rc"
 fi
 
+# Breaker-engine smoke: a keyed aggregation and a join forced through
+# the Pallas linear-probing hash engine must return exactly the sort
+# engine's result, and the engine-labeled dispatch counters must fire.
+echo "== breaker smoke: hash engine equals sort + labeled counters =="
+env JAX_PLATFORMS=cpu python - <<'PYEOF'
+import numpy as np
+import pandas as pd
+
+from presto_tpu.catalog.memory import MemoryConnector
+from presto_tpu.connector import Catalog
+from presto_tpu.exec import ExecConfig, LocalRunner
+from presto_tpu.scan import metrics as scan_metrics
+
+rng = np.random.default_rng(11)
+conn = MemoryConnector()
+conn.add_table("t", pd.DataFrame({"g": rng.integers(0, 300, 4000),
+                                  "v": rng.normal(size=4000)}))
+conn.add_table("d", pd.DataFrame({"k": np.arange(300),
+                                  "w": rng.integers(0, 7, 300)}))
+cat = Catalog()
+cat.register("m", conn, default=True)
+before = scan_metrics.snapshot()
+for sql in ("select g, count(*) as c, sum(v) as s from t "
+            "group by g order by g",
+            "select d.w, count(*) as c, sum(t.v) as s from t "
+            "join d on t.g = d.k group by d.w order by d.w"):
+    hr = LocalRunner(cat, ExecConfig(batch_rows=512, breaker_engine="hash"))
+    sr = LocalRunner(cat, ExecConfig(batch_rows=512, breaker_engine="sort"))
+    got, exp = hr.run(sql), sr.run(sql)
+    pd.testing.assert_frame_equal(got.reset_index(drop=True),
+                                  exp.reset_index(drop=True),
+                                  check_dtype=False)
+    assert hr.last_stats.get("breaker.engine_hash", 0) >= 1, hr.last_stats
+    assert sr.last_stats.get("breaker.engine_sort", 0) >= 1, sr.last_stats
+after = scan_metrics.snapshot()
+dh = after["breaker_dispatches_hash"] - before["breaker_dispatches_hash"]
+ds = after["breaker_dispatches_sort"] - before["breaker_dispatches_sort"]
+assert dh >= 2 and ds >= 2, (dh, ds)
+print(f"breaker smoke OK: hash==sort on agg+join "
+      f"({dh} hash / {ds} sort labeled dispatches)")
+PYEOF
+rc=$?
+if [ "$rc" -ne 0 ]; then
+  echo "breaker smoke FAILED (exit $rc)"
+  exit "$rc"
+fi
+
 # Static-analysis step: the kernel lint must be clean over the shipped
 # tree, the analyzer must actually FAIL on an injected violation (a
 # linter that can't fail is decoration), the plan-invariant checker must

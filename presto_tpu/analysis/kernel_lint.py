@@ -30,13 +30,29 @@ per-batch recompiles):
   dead lanes (NaN·0 = NaN) and silently widens dtypes; the blessed
   pattern is `jnp.where(mask, x, fill)`, which selects instead of
   scaling.
+- ``ref-indexing``: dynamic-shape loads/stores on Pallas refs — a
+  `*_ref[...]` subscript whose Python-slice bounds are not trace-time
+  static, or a `pl.ds(start, size)` whose SIZE is not static. A dynamic
+  START is the supported pattern (`pl.ds(traced_start, STATIC_SIZE)`);
+  a dynamic extent has no lowering on TPU and fails only at Mosaic
+  compile time, far from the offending line.
 
 Kernel-region detection: in `ops/` and `exec/fragment_jit.py` every
 function is kernel code (they are device-kernel libraries). Elsewhere a
 function is kernel code iff it is reachable from a jit root — decorated
-with `jax.jit` / `partial(jax.jit, ...)`, passed to `jax.jit(...)`, or
-returned by a builder passed to `_node_jit(...)` — transitively through
-same-module calls.
+with `jax.jit` / `partial(jax.jit, ...)`, passed to `jax.jit(...)`,
+passed to `pl.pallas_call(...)` (directly or through
+`functools.partial(kernel, ...)`), or returned by a builder passed to
+`_node_jit(...)` — transitively through same-module calls.
+
+Static-expression classification is TAINT-TRACKED: a name assigned from
+a session/runtime source (a `.get(...)` property read, an attribute or
+subscript rooted at `session` / `ctx` / `cfg` / `config` / `os`, a
+`jnp.`/`jax.`/`lax.`/`pl.` call, or a `*_ref[...]` load — transitively
+through local assignments) is never classified static, even behind a
+`.shape`-style attribute that would otherwise be blessed. A
+session-derived capacity flowing into a shape position is a per-session
+recompile (or a dynamic Pallas extent), not a constant.
 
 Suppressions: append ``# lint: allow(<rule>[, <rule>...])`` to the
 offending line; on a `def` line it covers the whole function.
@@ -51,7 +67,7 @@ from typing import Dict, List, Optional, Sequence, Set, Tuple
 from presto_tpu.analysis.findings import Finding
 
 RULES = ("host-sync", "float64", "traced-branch", "pow2-capacity",
-         "where-free-masking")
+         "where-free-masking", "ref-indexing")
 
 _ALLOW_RE = re.compile(r"#\s*lint:\s*allow\(([a-z0-9_,\- ]+)\)")
 
@@ -90,19 +106,31 @@ def _attr_chain(e: ast.expr) -> Optional[Tuple[str, str]]:
     return None
 
 
-def _is_static_expr(e: ast.expr) -> bool:
+def _is_static_expr(e: ast.expr, tainted: frozenset = frozenset()) -> bool:
     """Conservatively true when an expression is compile-time static:
-    literals, len()/shape/type-parameter access, arithmetic over those."""
+    literals, len()/shape/type-parameter access, arithmetic over those.
+
+    `tainted` names hold session-/runtime-derived values (see
+    `_collect_taint`); any attribute/subscript chain rooted at one is
+    non-static even when the attribute tail would normally be blessed —
+    `cfg.capacity` is a per-session value, not a trace constant."""
     if isinstance(e, ast.Constant):
         return True
     if isinstance(e, ast.Attribute):
-        return e.attr in _STATIC_ATTRS or _is_static_expr(e.value)
+        root = _root_name(e)
+        if root is not None and root in tainted:
+            return False
+        return e.attr in _STATIC_ATTRS or _is_static_expr(e.value, tainted)
     if isinstance(e, ast.Subscript):
-        return _is_static_expr(e.value)
+        root = _root_name(e.value)
+        if root is not None and root in tainted:
+            return False
+        return _is_static_expr(e.value, tainted)
     if isinstance(e, ast.BinOp):
-        return _is_static_expr(e.left) and _is_static_expr(e.right)
+        return (_is_static_expr(e.left, tainted)
+                and _is_static_expr(e.right, tainted))
     if isinstance(e, ast.UnaryOp):
-        return _is_static_expr(e.operand)
+        return _is_static_expr(e.operand, tainted)
     if isinstance(e, ast.Call):
         fn = e.func
         if isinstance(fn, ast.Name) and fn.id == "len":
@@ -110,7 +138,7 @@ def _is_static_expr(e: ast.expr) -> bool:
             return True
         if isinstance(fn, ast.Name) and fn.id in (
                 {"max", "min", "abs"} | _BLESSED_HELPERS):
-            return all(_is_static_expr(a) for a in e.args)
+            return all(_is_static_expr(a, tainted) for a in e.args)
         chain = _attr_chain(fn)
         if chain and chain[1] == "bit_length":
             return True
@@ -118,9 +146,69 @@ def _is_static_expr(e: ast.expr) -> bool:
             return False
         return False
     if isinstance(e, ast.IfExp):
-        return (_is_static_expr(e.test) and _is_static_expr(e.body)
-                and _is_static_expr(e.orelse))
+        return (_is_static_expr(e.test, tainted)
+                and _is_static_expr(e.body, tainted)
+                and _is_static_expr(e.orelse, tainted))
     return False
+
+
+# roots whose attribute/subscript reads are runtime values by definition
+_RUNTIME_ROOTS = {"session", "ctx", "cfg", "config", "os", "environ",
+                  "properties"}
+
+
+def _expr_taints(e: ast.expr, tainted) -> bool:
+    """True when the r.h.s. of an assignment carries runtime/session
+    taint: a `.get(...)` read, a chain rooted in _RUNTIME_ROOTS, a
+    traced `jnp/jax/lax/pl` call, a `*_ref[...]` load, or an
+    already-tainted name."""
+    for n in ast.walk(e):
+        if isinstance(n, ast.Name) and n.id in tainted:
+            return True
+        if isinstance(n, ast.Call):
+            fn = n.func
+            if isinstance(fn, ast.Attribute) and fn.attr == "get":
+                return True
+            root = _root_name(fn)
+            if root in (_JAX_NUMPY_ALIASES | {"jax", "lax", "pl"}):
+                return True
+        if isinstance(n, ast.Attribute):
+            if _root_name(n) in _RUNTIME_ROOTS:
+                return True
+        if isinstance(n, ast.Subscript):
+            root = _root_name(n.value)
+            if root in _RUNTIME_ROOTS:
+                return True
+            if root is not None and root.endswith("_ref"):
+                return True
+    return False
+
+
+def _collect_taint(fn: ast.AST) -> frozenset:
+    """Fixpoint over a kernel function's assignments: the set of local
+    names that (transitively) hold session-/runtime-derived values."""
+    tainted: Set[str] = set()
+    changed = True
+    while changed:
+        changed = False
+        for n in ast.walk(fn):
+            if isinstance(n, ast.Assign):
+                targets, value = n.targets, n.value
+            elif isinstance(n, (ast.AugAssign, ast.AnnAssign)) \
+                    and getattr(n, "value", None) is not None:
+                targets, value = [n.target], n.value
+            elif isinstance(n, ast.For):
+                targets, value = [n.target], n.iter
+            else:
+                continue
+            if not _expr_taints(value, tainted):
+                continue
+            for t in targets:
+                for tn in ast.walk(t):
+                    if isinstance(tn, ast.Name) and tn.id not in tainted:
+                        tainted.add(tn.id)
+                        changed = True
+    return frozenset(tainted)
 
 
 class _Suppressions:
@@ -173,6 +261,10 @@ def _jit_roots(tree: ast.AST,
         elif isinstance(e, ast.Name):
             roots.extend(funcs.get(e.id, ()))
 
+    def is_partial(e: ast.expr) -> bool:
+        return ((isinstance(e, ast.Name) and e.id == "partial")
+                or _attr_chain(e) == ("functools", "partial"))
+
     for n in ast.walk(tree):
         if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
             for dec in n.decorator_list:
@@ -193,6 +285,14 @@ def _jit_roots(tree: ast.AST,
         fname = (n.func.id if isinstance(n.func, ast.Name)
                  else n.func.attr if isinstance(n.func, ast.Attribute)
                  else None)
+        if fname == "pallas_call" and n.args:
+            # pl.pallas_call(kernel, ...) — the kernel body IS device
+            # code, wherever the module lives; unwrap partial(kernel, ..)
+            tgt = n.args[0]
+            if isinstance(tgt, ast.Call) and is_partial(tgt.func) \
+                    and tgt.args:
+                tgt = tgt.args[0]
+            add_target(tgt)
         if fname == "_node_jit" and len(n.args) >= 3:
             builder = n.args[2]
             if isinstance(builder, ast.Lambda):
@@ -241,10 +341,11 @@ def kernel_functions(tree: ast.AST, path: str) -> List[ast.AST]:
 
 class _RuleVisitor(ast.NodeVisitor):
     def __init__(self, path: str, supp: _Suppressions,
-                 rules: Sequence[str]):
+                 rules: Sequence[str], tainted: frozenset = frozenset()):
         self.path = path
         self.supp = supp
         self.rules = set(rules)
+        self.tainted = tainted
         self.findings: List[Finding] = []
 
     def err(self, rule: str, node: ast.AST, msg: str):
@@ -272,7 +373,8 @@ class _RuleVisitor(ast.NodeVisitor):
                      "code")
         if isinstance(fn, ast.Name) and fn.id in ("float", "int", "bool") \
                 and node.args:
-            if not all(_is_static_expr(a) for a in node.args):
+            if not all(_is_static_expr(a, self.tainted)
+                       for a in node.args):
                 self.err("host-sync", node,
                          f"{fn.id}() on a non-static value host-syncs (or "
                          f"fails to trace); compute on-device with "
@@ -280,12 +382,14 @@ class _RuleVisitor(ast.NodeVisitor):
         chain = _attr_chain(fn)
         if chain and chain[0] in _NUMPY_ALIASES and chain[1] in (
                 "asarray", "array"):
-            if not all(_is_static_expr(a) for a in node.args):
+            if not all(_is_static_expr(a, self.tainted)
+                       for a in node.args):
                 self.err("host-sync", node,
                          f"np.{chain[1]}() on a traced value copies to "
                          f"host; use jnp.{chain[1]} or keep it on-device")
         self._check_float64(node, chain)
         self._check_pow2(node, chain)
+        self._check_dslice(node, chain)
         self.generic_visit(node)
 
     # -- float64 ------------------------------------------------------------
@@ -362,6 +466,49 @@ class _RuleVisitor(ast.NodeVisitor):
                          f"each distinct capacity is a distinct compiled "
                          f"program; route sizes through "
                          f"round_up_capacity()")
+
+    # -- ref-indexing --------------------------------------------------------
+
+    def _static_size(self, e: ast.expr) -> bool:
+        """A slice bound / dslice size is acceptable when it is a static
+        expression OR a bare un-tainted name (kernel closure constants —
+        block sizes, capacities — arrive as plain Python ints; traced
+        values originate from ref loads or jnp/lax calls and are
+        tainted)."""
+        if _is_static_expr(e, self.tainted):
+            return True
+        return isinstance(e, ast.Name) and e.id not in self.tainted
+
+    def _check_dslice(self, node: ast.Call, chain):
+        name = None
+        if chain is not None and chain[0] == "pl":
+            name = chain[1]
+        elif isinstance(node.func, ast.Name):
+            name = node.func.id
+        if name in ("ds", "dslice") and len(node.args) >= 2 \
+                and not self._static_size(node.args[1]):
+            self.err("ref-indexing", node,
+                     "pl.ds with a non-static SIZE is a dynamic-shape "
+                     "load — keep the extent a trace-time constant and "
+                     "let only the start be traced")
+
+    def visit_Subscript(self, node: ast.Subscript):
+        root = _root_name(node.value)
+        if root is not None and root.endswith("_ref"):
+            sl = node.slice
+            elts = sl.elts if isinstance(sl, ast.Tuple) else [sl]
+            for e in elts:
+                if not isinstance(e, ast.Slice):
+                    continue  # scalar / pl.ds indices checked elsewhere
+                for bound in (e.lower, e.upper, e.step):
+                    if bound is not None and not self._static_size(bound):
+                        self.err(
+                            "ref-indexing", node,
+                            "ref slice with non-static bounds is a "
+                            "dynamic-shape load; use pl.ds(start, "
+                            "STATIC_SIZE) so the extent stays compiled-in")
+                        break
+        self.generic_visit(node)
 
     # -- traced-branch -------------------------------------------------------
 
@@ -472,7 +619,7 @@ def lint_source(source: str, path: str,
         if id(fn) in visited or id(fn) in nested:
             continue
         visited.add(id(fn))
-        v = _RuleVisitor(path, supp, rules)
+        v = _RuleVisitor(path, supp, rules, tainted=_collect_taint(fn))
         body = fn.body if isinstance(fn.body, list) else [fn.body]
         for stmt in body:
             v.visit(stmt)

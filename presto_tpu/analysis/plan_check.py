@@ -413,11 +413,32 @@ def check_distributed(dplan) -> List[Finding]:
                     f"partitioned join inputs disagree on radix alignment: "
                     f"fragment {lf.fid} align={lf.radix_align}, fragment "
                     f"{rf.fid} align={rf.radix_align}")
-            if (lf.radix_align and rf.radix_align
-                    and len(lf.output_keys) != len(rf.output_keys)):
-                err("radix-align", fid,
-                    f"partitioned join inputs disagree on key arity: "
-                    f"{lf.output_keys} vs {rf.output_keys}")
+            if lf.radix_align and rf.radix_align:
+                if len(lf.output_keys) != len(rf.output_keys):
+                    err("radix-align", fid,
+                        f"partitioned join inputs disagree on key arity: "
+                        f"{lf.output_keys} vs {rf.output_keys}")
+                    continue
+                # per-position dtype agreement: the content hash routes
+                # by bit pattern after an int64 cast, so a dtype split
+                # across one key pair (float vs int, dict codes vs
+                # values) lands equal keys in DIFFERENT partitions — the
+                # join silently loses matches, no shape error anywhere
+                lt_types = dict(lf.root.output)
+                rt_types = dict(rf.root.output)
+                for pos, (lk, rk) in enumerate(
+                        zip(lf.output_keys, rf.output_keys)):
+                    lt, rt = lt_types.get(lk), rt_types.get(rk)
+                    if lt is None or rt is None:
+                        continue  # fragment-wiring reports missing syms
+                    ld, rd = _dtype_of(lt), _dtype_of(rt)
+                    if ld is not None and rd is not None and ld != rd:
+                        err("radix-align", fid,
+                            f"partitioned join key pair #{pos} "
+                            f"({lk!r}={rk!r}) disagrees on device dtype "
+                            f"across radix-aligned inputs: {lt} ({ld}) "
+                            f"vs {rt} ({rd}) — equal keys would hash to "
+                            f"different partitions")
     return findings
 
 

@@ -153,7 +153,17 @@ class LocalRunner:
         return qp
 
     def explain(self, sql: str) -> str:
-        return plan_to_string(self.plan(sql).root)
+        qp = self.plan(sql)
+        try:
+            from presto_tpu.exec.runtime import (_mark_breaker_engines,
+                                                 _mark_fragment_fusion)
+
+            _mark_fragment_fusion(qp.root, self.config)
+            _mark_breaker_engines(qp.root, ExecContext(self.catalog,
+                                                       self.config))
+        except Exception:
+            pass  # cosmetic markers; the executor re-stamps on run
+        return plan_to_string(qp.root)
 
     def run_batch(self, sql: str):
         from presto_tpu.sql import ast as _ast

@@ -50,6 +50,11 @@ from presto_tpu.ops.join import (
     align_probe_strings,
     build_side,
     gather_join_output,
+    hash_build_side,
+    hash_probe_counts,
+    hash_probe_expand,
+    hash_probe_unique,
+    join_compare_dtypes,
     probe_counts,
     probe_expand,
     probe_unique,
@@ -212,6 +217,12 @@ class ExecConfig:
     # fragment_fusion=False preserves the per-batch path everywhere.
     fragment_fusion: bool = True
     fragment_window: int = 8
+    # breaker engine selection (ops/pallas_hash vs the sorted-primitive
+    # engine): "auto" lets the CBO (plan/stats.choose_breaker_engine) pick
+    # per breaker from derived NDV/row-count/payload-width stats; "sort" /
+    # "hash" force one engine everywhere (the hash side of the forcing is
+    # what the engine-equivalence verifier sweeps run)
+    breaker_engine: str = "auto"
 
 
 def _node_jit(node: PlanNode, key: str, builder, _shared=True, **jit_kwargs):
@@ -1667,14 +1678,47 @@ def _grouped_execution_lifespans(node: Aggregate) -> int:
             return 0
 
 
-def _agg_steps(node: Aggregate) -> SimpleNamespace:
+def _breaker_engine_choice(node: PlanNode, ctx: "ExecContext",
+                           record: bool = True) -> str:
+    """Resolve the breaker engine ("sort" | "hash") for a pipeline
+    breaker: session override (ExecConfig.breaker_engine) first, else the
+    CBO's NDV/row-count/payload-width thresholds
+    (plan/stats.choose_breaker_engine). Stamps the decision + rationale
+    on the node for EXPLAIN and, when ``record``, bumps the
+    engine-labeled dispatch counters (ctx.stats + /v1/metrics)."""
+    from presto_tpu.plan.stats import choose_breaker_engine
+    from presto_tpu.scan import metrics as _scan_metrics
+
+    override = getattr(ctx.config, "breaker_engine", "auto")
+    try:
+        engine, why = choose_breaker_engine(node, ctx.catalog, override)
+    except Exception:
+        engine, why = "sort", "stats derivation failed"
+    node.__dict__["_breaker_engine"] = engine
+    node.__dict__["_breaker_engine_why"] = why
+    if record:
+        key = f"breaker.engine_{engine}"
+        ctx.stats[key] = ctx.stats.get(key, 0) + 1
+        _scan_metrics.record(f"breaker_dispatches_{engine}", 1)
+    return engine
+
+
+def _engine_key(key: str, engine: str) -> str:
+    """Jit-cache key for an engine-dependent program: the hash engine's
+    traces differ structurally from the sort engine's, so they must not
+    share a structural program-cache entry."""
+    return key if engine == "sort" else f"{key}@h"
+
+
+def _agg_steps(node: Aggregate, engine: str = "sort") -> SimpleNamespace:
     """Structural merge-step closures for one Aggregate node, memoized on
-    the node so the executor and the install-time breaker warmers hand
-    _node_jit the SAME function objects (one trace, one shared program).
-    Everything here derives from the node and its collapsed child chain —
-    no runtime data is captured, which is what makes the steps warmable
-    ahead of the stream."""
-    memo = node.__dict__.get("_agg_steps")
+    the node (per breaker engine) so the executor and the install-time
+    breaker warmers hand _node_jit the SAME function objects (one trace,
+    one shared program). Everything here derives from the node, its
+    collapsed child chain and the engine — no runtime data is captured,
+    which is what makes the steps warmable ahead of the stream."""
+    memos = node.__dict__.setdefault("_agg_steps", {})
+    memo = memos.get(engine)
     if memo is not None:
         return memo
     from presto_tpu.plan.agg_states import state_types as _layout_state_types
@@ -1757,7 +1801,8 @@ def _agg_steps(node: Aggregate) -> SimpleNamespace:
                 for a, i in zip(sa, sin)
             ]
             live = jnp.concatenate([acc.live, live])
-        kout, sout, out_live, n_groups = grouped_merge(kin, sin, live, cap)
+        kout, sout, out_live, n_groups = grouped_merge(kin, sin, live, cap,
+                                                       engine=engine)
         sout = _renorm_limbs(list(sout), lpairs)
         cols = [Column(k.values, k.validity) for k in kout] + [
             Column(s.values, s.validity if s.op != "count_add" else None) for s in sout
@@ -1802,7 +1847,8 @@ def _agg_steps(node: Aggregate) -> SimpleNamespace:
                 for a, i in zip(sa, sin)
             ]
             live = jnp.concatenate([acc.live, live])
-        kout, sout, out_live, n_groups = grouped_merge(kin, sin, live, cap)
+        kout, sout, out_live, n_groups = grouped_merge(kin, sin, live, cap,
+                                                       engine=engine)
         sout = _renorm_limbs(list(sout), lpairs)
         cols = [Column(k.values, k.validity) for k in kout] + [
             Column(s.values, s.validity if s.op != "count_add" else None) for s in sout
@@ -1817,7 +1863,7 @@ def _agg_steps(node: Aggregate) -> SimpleNamespace:
         key_syms=key_syms, key_types=key_types, state_types=state_types,
         in_to_states=in_to_states, acc_to_states=acc_to_states,
         merge_step=merge_step, acc_merge_step=acc_merge_step)
-    node.__dict__["_agg_steps"] = memo
+    memos[engine] = memo
     return memo
 
 
@@ -1942,7 +1988,8 @@ def _execute_aggregate(node: Aggregate, ctx: ExecContext) -> Iterator[Batch]:
         return
 
     in_stream, _ = _fused_child(node.child, ctx)
-    steps = _agg_steps(node)
+    engine = _breaker_engine_choice(node, ctx)
+    steps = _agg_steps(node, engine)
     chain = steps.chain
     in_types = steps.in_types
     layout = steps.layout
@@ -1961,17 +2008,18 @@ def _execute_aggregate(node: Aggregate, ctx: ExecContext) -> Iterator[Batch]:
     _step_jit_kw = {}
     if ctx.config.donate_stepping and not key_syms:
         _step_jit_kw["donate_argnums"] = (0,)
-    jit_step = _node_jit(node, "step", lambda: (lambda acc, b, cap: merge_step(acc, b, cap)), static_argnums=(2,), **_step_jit_kw)
-    jit_step0 = _node_jit(node, "step0", lambda: (lambda b, cap: merge_step(None, b, cap)), static_argnums=(1,))
-    jit_accstep = _node_jit(node, "accstep", lambda: acc_merge_step, static_argnums=(2,))
+    _ek = lambda k: _engine_key(k, engine)  # noqa: E731
+    jit_step = _node_jit(node, _ek("step"), lambda: (lambda acc, b, cap: merge_step(acc, b, cap)), static_argnums=(2,), **_step_jit_kw)
+    jit_step0 = _node_jit(node, _ek("step0"), lambda: (lambda b, cap: merge_step(None, b, cap)), static_argnums=(1,))
+    jit_accstep = _node_jit(node, _ek("accstep"), lambda: acc_merge_step, static_argnums=(2,))
     # grace (hash-partitioned) aggregation: partition replay feeds batches
     # that went through `chain` before spilling — merge must not re-chain
     jit_step_raw = _node_jit(
-        node, "step_raw",
+        node, _ek("step_raw"),
         lambda: (lambda acc, b, cap: merge_step(acc, b, cap, prechained=True)),
         static_argnums=(2,))
     jit_step0_raw = _node_jit(
-        node, "step0_raw",
+        node, _ek("step0_raw"),
         lambda: (lambda b, cap: merge_step(None, b, cap, prechained=True)),
         static_argnums=(1,))
     jit_chain = _node_jit(node, "chain_only", lambda: chain)
@@ -1993,11 +2041,11 @@ def _execute_aggregate(node: Aggregate, ctx: ExecContext) -> Iterator[Batch]:
         "fused" if frag_why is None else frag_why)
     if frag_why is None:
         jit_frag_step = _node_jit(
-            node, "fragment_step",
+            node, _ek("fragment_step"),
             lambda: _fragment_jit.scan_stepper(merge_step, False),
             static_argnums=(2,), **_step_jit_kw)
         jit_frag_step0 = _node_jit(
-            node, "fragment_step0",
+            node, _ek("fragment_step0"),
             lambda: _fragment_jit.scan_stepper(merge_step, True),
             static_argnums=(1,))
 
@@ -2057,7 +2105,7 @@ def _execute_aggregate(node: Aggregate, ctx: ExecContext) -> Iterator[Batch]:
         budget = ctx.config.join_spill_budget_bytes
         split = _radix_splitter(node, ctx, key_syms, P, "agg_")
         jit_accstep0 = _node_jit(
-            node, "accstep0",
+            node, _ek("accstep0"),
             lambda: (lambda b, c: acc_merge_step(None, b, c)),
             static_argnums=(1,))
         # CBO pre-sizing applies per partition: each holds ~1/P of the
@@ -3190,6 +3238,19 @@ def _execute_index_join(node, ctx: ExecContext) -> Iterator[Batch]:
                                jkey="index_")
 
 
+def _join_plan_cdt(node) -> tuple:
+    """Per-key-position pairwise-promoted compare dtypes of an equi-join,
+    derived from PLAN output types alone (ops/join.join_compare_dtypes is
+    the batch-side twin). Purely structural, so probe closures computing
+    it stay shareable across the structural program cache."""
+    ltypes = dict(node.left.output)
+    rtypes = dict(node.right.output)
+    return tuple(
+        jnp.result_type(jnp.dtype(rtypes[rk].dtype),
+                        jnp.dtype(ltypes[lk].dtype))
+        for lk, rk in zip(node.left_keys, node.right_keys))
+
+
 class _JoinProber:
     """One build table, probed incrementally.
 
@@ -3224,9 +3285,36 @@ class _JoinProber:
                 {},
             )
 
-        table = _node_jit(node, "build", lambda: build_side, static_argnames=("key_names",))(
-            build_in, tuple(node.right_keys)
-        )
+        engine = _breaker_engine_choice(node, ctx)
+        # pairwise-promoted compare dtypes come from the PLAN's output
+        # types on both sides, so the probe closures (shared across the
+        # radix path's P probers, never seeing a build batch) agree with
+        # hash_build_side's encode. An executed batch that deviates from
+        # its plan-declared dtype would silently mis-encode — fall back.
+        ltypes = dict(node.left.output)
+        probe_dtypes = tuple(
+            jnp.dtype(ltypes[lk].dtype) for lk in node.left_keys)
+        if engine == "hash" and join_compare_dtypes(
+                build_in, tuple(node.right_keys),
+                probe_dtypes) != _join_plan_cdt(node):
+            engine = "sort"
+            node.__dict__["_breaker_engine"] = "sort"
+            node.__dict__["_breaker_engine_why"] = (
+                "build batch dtypes deviate from plan types")
+        self.engine = engine
+        self.fanout_scan = fanout_scan
+        _ek = lambda k: _engine_key(k, engine)  # noqa: E731
+        self._ek, self._jkey, self._chain = _ek, jkey, chain
+
+        if engine == "hash":
+            table = _node_jit(
+                node, _ek("build"), lambda: hash_build_side,
+                static_argnames=("key_names", "probe_dtypes"))(
+                build_in, tuple(node.right_keys), probe_dtypes)
+        else:
+            table = _node_jit(node, "build", lambda: build_side, static_argnames=("key_names",))(
+                build_in, tuple(node.right_keys)
+            )
         self.table = table
 
         self.want_full = node.kind == "full"
@@ -3260,10 +3348,15 @@ class _JoinProber:
 
         if node.build_unique:
 
-            def probe_fn(table: BuildTable, pb: Batch, bm):
+            def probe_fn(table, pb: Batch, bm):
                 pb = chain(pb)
                 pba = align_probe_strings(pb, tuple(node.left_keys), table, tuple(node.right_keys))
-                idx, matched = probe_unique(table, pba, tuple(node.left_keys), tuple(node.right_keys))
+                if engine == "hash":
+                    idx, matched = hash_probe_unique(
+                        table, pba, tuple(node.left_keys),
+                        _join_plan_cdt(node))
+                else:
+                    idx, matched = probe_unique(table, pba, tuple(node.left_keys), tuple(node.right_keys))
                 out = gather_join_output(
                     pb, table, jnp.arange(pb.capacity, dtype=jnp.int32), idx,
                     pb.live, lsyms, rsyms,
@@ -3282,7 +3375,7 @@ class _JoinProber:
                         cols[i] = Column(c.values, valid & matched, c.hi)
                 return Batch(out.names, out.types, cols, out.live, out.dicts), bm
 
-            self.jfn = _node_jit(node, jkey + "probe", lambda: probe_fn)
+            self.jfn = _node_jit(node, _ek(jkey + "probe"), lambda: probe_fn)
             return
 
         # general fanout join (inner / left): counts pass + chunked
@@ -3301,22 +3394,21 @@ class _JoinProber:
 
         self.chain_j = _node_jit(node, jkey + "chain_align", lambda: chain_align)
         # the fanout window is part of the compiled closure: a non-default
-        # scan width (the radix path probes with a wider one) keys its own
-        # cache entry
-        ckey = "counts" if fanout_scan == 8 else f"counts{fanout_scan}"
-        self.counts_fn = _node_jit(
-            node, ckey,
-            lambda: lambda t, pba: probe_counts(
-                t, pba, tuple(node.left_keys), tuple(node.right_keys),
-                max_fanout_scan=fanout_scan,
-            ),
-        )
+        # scan width (the radix path probes with a wider one, the hash
+        # engine's overflow ladder doubles it) keys its own cache entry
+        self.counts_fn = self._counts_program(fanout_scan)
 
         def expand_fn(t, pb, pba, lo, counts, offsets, base, out_cap, bm):
-            pr, bi, ol = probe_expand(
-                t, pba, tuple(node.left_keys), tuple(node.right_keys),
-                lo, counts, offsets, base, out_cap,
-            )
+            # hash engine: `lo` is the match matrix mm[n, F] (exact build
+            # row indices); sort engine: the range starts, re-verified
+            if engine == "hash":
+                pr, bi, ol = hash_probe_expand(
+                    t, lo, counts, offsets, base, out_cap)
+            else:
+                pr, bi, ol = probe_expand(
+                    t, pba, tuple(node.left_keys), tuple(node.right_keys),
+                    lo, counts, offsets, base, out_cap,
+                )
             out = gather_join_output(pb, t, pr, bi, ol, lsyms, rsyms)
             exists = (
                 jnp.zeros(pb.capacity, dtype=jnp.int32)
@@ -3342,9 +3434,31 @@ class _JoinProber:
                                      cols[i].hi)
             return Batch(out.names, out.types, cols, out.live, out.dicts)
 
-        self.jexpand = _node_jit(node, "expand", lambda: expand_fn,
+        self.jexpand = _node_jit(node, _ek("expand"), lambda: expand_fn,
                                  static_argnames=("out_cap",))
         self.jnull = _node_jit(node, "null_extend", lambda: null_extend_fn)
+
+    def _counts_program(self, fanout: int):
+        """Counting-pass program for one fanout width (jit-cached per
+        width: the hash engine's overflow ladder re-probes at doubled
+        widths, each its own compiled shape)."""
+        node = self.node
+        if self.engine == "hash":
+            return _node_jit(
+                self.node, f"counts@h{fanout}",
+                lambda: lambda t, pba: hash_probe_counts(
+                    t, pba, tuple(node.left_keys), _join_plan_cdt(node),
+                    max_fanout_scan=fanout,
+                ),
+            )
+        ckey = "counts" if fanout == 8 else f"counts{fanout}"
+        return _node_jit(
+            self.node, ckey,
+            lambda: lambda t, pba: probe_counts(
+                t, pba, tuple(node.left_keys), tuple(node.right_keys),
+                max_fanout_scan=fanout,
+            ),
+        )
 
     def probe_start(self, pb_raw: Batch):
         """Dispatch phase of one probe batch: everything up to (not
@@ -3381,6 +3495,32 @@ class _JoinProber:
             return
         (_, pb, pba, lo, counts, offsets, total, ovf, out_cap, out,
          exists_acc) = st
+        # the sort engine's overflow is informational (counts already
+        # widened) and syncs after the chunk loop; the hash engine's must
+        # be confirmed BEFORE chunk 0 is yielded
+        ovn = int(ovf) if self.engine == "hash" else 0
+        if ovn:
+            # hash-engine fanout overflow: counts/total are EXACT but the
+            # match matrix truncated past its width — the optimistically
+            # dispatched chunk 0 would duplicate the last held match, so
+            # discard it, re-probe at doubled widths until every row fits,
+            # and redo chunk 0 from the full matrix. (The discarded
+            # chunk's bm/exists updates only marked GENUINE matches, so
+            # they stand.) Counts don't change, so no re-cumsum drift.
+            ov_rows = ovn
+            fanout = self.fanout_scan
+            while ovn:
+                fanout *= 2
+                if fanout > int(self.table.slot_row.shape[0]):
+                    raise RuntimeError(
+                        "join fanout exceeded build table capacity")
+                lo, counts, offsets, total, _, ovf = self._counts_program(
+                    fanout)(table, pba)
+                ovn = int(ovf)
+            out, exists, self.bm = self.jexpand(
+                table, pb, pba, lo, counts, offsets, 0, out_cap, self.bm)
+            exists_acc = exists_acc | exists
+            ovn = ov_rows  # recorded after the chunk loop
         yield out
         tot = int(total)
         base = out_cap
@@ -3390,7 +3530,8 @@ class _JoinProber:
             exists_acc = exists_acc | exists
             yield out
             base += out_cap
-        ovn = int(ovf)
+        if self.engine != "hash":
+            ovn = int(ovf)
         if ovn:
             from presto_tpu.scan import metrics as _scan_metrics
 
@@ -3540,24 +3681,45 @@ def _execute_semijoin(node: SemiJoin, ctx: ExecContext) -> Iterator[Batch]:
         return
 
     if node.residual is None:
+        engine = _breaker_engine_choice(node, ctx)
+        ltypes = dict(node.left.output)
+        probe_dtypes = tuple(jnp.dtype(ltypes[lk].dtype) for lk in lkeys)
+        if engine == "hash" and join_compare_dtypes(
+                right_in, rkeys, probe_dtypes) != _join_plan_cdt(node):
+            engine = "sort"
+            node.__dict__["_breaker_engine"] = "sort"
+            node.__dict__["_breaker_engine_why"] = (
+                "build batch dtypes deviate from plan types")
+        _ek = lambda k: _engine_key(k, engine)  # noqa: E731
 
-        def dedup_build(b: Batch):
-            cols = [b.column(r) for r in rkeys]
-            keys, _, out_live, _ = grouped_merge(
-                [KeyCol(c.values, c.validity) for c in cols], [], b.live, b.capacity
-            )
-            db = Batch(
-                list(rkeys), [b.type_of(r) for r in rkeys],
-                [Column(k.values, k.validity) for k in keys], out_live, b.dicts,
-            )
-            return build_side(db, rkeys)
+        if engine == "hash":
+            # the linear-probing table tolerates duplicate build keys (the
+            # probe walks the whole chain; EXISTS only needs count > 0),
+            # so the sort engine's dedup pass has no hash twin
+            def dedup_build(b: Batch):
+                return hash_build_side(b, rkeys, probe_dtypes)
+        else:
+            def dedup_build(b: Batch):
+                cols = [b.column(r) for r in rkeys]
+                keys, _, out_live, _ = grouped_merge(
+                    [KeyCol(c.values, c.validity) for c in cols], [], b.live, b.capacity
+                )
+                db = Batch(
+                    list(rkeys), [b.type_of(r) for r in rkeys],
+                    [Column(k.values, k.validity) for k in keys], out_live, b.dicts,
+                )
+                return build_side(db, rkeys)
 
-        table = _node_jit(node, "dedup_build", lambda: dedup_build)(right_in)
+        table = _node_jit(node, _ek("dedup_build"), lambda: dedup_build)(right_in)
 
         def probe_fn(t, pb: Batch):
             b = chain(pb)
             ba = align_probe_strings(b, lkeys, t, rkeys)
-            _, matched = probe_unique(t, ba, lkeys, rkeys)
+            if engine == "hash":
+                _, matched = hash_probe_unique(
+                    t, ba, lkeys, _join_plan_cdt(node))
+            else:
+                _, matched = probe_unique(t, ba, lkeys, rkeys)
             if node.negated:
                 if node.null_aware:
                     # SQL: NULL NOT IN (non-empty set) is NULL → row filtered.
@@ -3576,7 +3738,7 @@ def _execute_semijoin(node: SemiJoin, ctx: ExecContext) -> Iterator[Batch]:
                 return b.with_live(b.live & keep)
             return b.with_live(b.live & matched)
 
-        jfn = _node_jit(node, "probe", lambda: probe_fn)
+        jfn = _node_jit(node, _ek("probe"), lambda: probe_fn)
         for pb in probe_stream:
             yield jfn(table, pb)
         return
@@ -3587,6 +3749,8 @@ def _execute_semijoin(node: SemiJoin, ctx: ExecContext) -> Iterator[Batch]:
     lsyms = [n for n, _ in node.left.output]
     rsyms = [n for n, _ in node.right.output]
     pred = compile_predicate(node.residual)
+    node.__dict__["_breaker_engine"] = "sort"
+    node.__dict__["_breaker_engine_why"] = "residual semijoin"
     table = _node_jit(node, "build", lambda: build_side, static_argnames=("key_names",))(
         right_in, rkeys
     )
@@ -4273,7 +4437,11 @@ def _warm_agg_breaker(node: Aggregate, scan: TableScan, scan_cap: int,
     cap, ceiling, can_spill, grace_from_start = _agg_presize(node, ctx)
     if grace_from_start:
         return
-    steps = _agg_steps(node)
+    # same engine chooser as the run (no counter bump: warming is not a
+    # dispatch) so the warm compiles the programs the run will use
+    engine = _breaker_engine_choice(node, ctx, record=False)
+    _ek = lambda k: _engine_key(k, engine)  # noqa: E731
+    steps = _agg_steps(node, engine)
     merge_step = steps.merge_step
     key_syms = steps.key_syms
     if (key_syms and ctx.config.radix_partitions > 1
@@ -4286,19 +4454,19 @@ def _warm_agg_breaker(node: Aggregate, scan: TableScan, scan_cap: int,
     _step_jit_kw = {}
     if ctx.config.donate_stepping and not key_syms:
         _step_jit_kw["donate_argnums"] = (0,)
-    jit_step = _node_jit(node, "step", lambda: (lambda acc, b, cap: merge_step(acc, b, cap)), static_argnums=(2,), **_step_jit_kw)
-    jit_step0 = _node_jit(node, "step0", lambda: (lambda b, cap: merge_step(None, b, cap)), static_argnums=(1,))
+    jit_step = _node_jit(node, _ek("step"), lambda: (lambda acc, b, cap: merge_step(acc, b, cap)), static_argnums=(2,), **_step_jit_kw)
+    jit_step0 = _node_jit(node, _ek("step0"), lambda: (lambda b, cap: merge_step(None, b, cap)), static_argnums=(1,))
     acc, _ = jit_step0(zb, cap)
     acc, _ = jit_step(acc, zb, cap)
     if _fragment_eligibility(node, ctx.config) is None:
         stacked = _fragment_jit.stack_batches(
             [zb] * max(2, ctx.config.fragment_window))
         jit_frag_step = _node_jit(
-            node, "fragment_step",
+            node, _ek("fragment_step"),
             lambda: _fragment_jit.scan_stepper(merge_step, False),
             static_argnums=(2,), **_step_jit_kw)
         jit_frag_step0 = _node_jit(
-            node, "fragment_step0",
+            node, _ek("fragment_step0"),
             lambda: _fragment_jit.scan_stepper(merge_step, True),
             static_argnums=(1,))
         facc, _ = jit_frag_step0(stacked, cap)
@@ -4350,9 +4518,29 @@ def install_plan_programs(root: PlanNode, ctx: ExecContext) -> None:
         _mark_fragment_fusion(root, ctx.config)
     except Exception:
         pass  # cosmetic EXPLAIN marker; the executor re-stamps on run
+    try:
+        _mark_breaker_engines(root, ctx)
+    except Exception:
+        pass  # cosmetic EXPLAIN marker; the executor re-stamps on run
     if ctx.config.precompile_workers > 0:
         _programs.submit_warmers(_chain_warmers(root, ctx),
                                  ctx.config.precompile_workers)
+
+
+def _mark_breaker_engines(root: PlanNode, ctx: "ExecContext") -> None:
+    """Stamp the CBO's breaker-engine verdict (sort | hash + rationale)
+    on every engine-dimensioned breaker so EXPLAIN (without ANALYZE)
+    already shows it; the executors re-stamp on run (adding per-query
+    gates like a build-batch dtype deviation) and bump the dispatch
+    counters there."""
+
+    def visit(n: PlanNode):
+        if isinstance(n, (Aggregate, HashJoin, SemiJoin)):
+            _breaker_engine_choice(n, ctx, record=False)
+        for c in n.children():
+            visit(c)
+
+    visit(root)
 
 
 def _mark_fragment_fusion(root: PlanNode, config: ExecConfig) -> None:

@@ -76,15 +76,25 @@ def grouped_merge(
     states: Sequence[StateCol],
     live: jnp.ndarray,
     num_groups_cap: int,
+    engine: str = "sort",
 ) -> Tuple[list, list, jnp.ndarray, jnp.ndarray]:
     """Group rows by `keys`, merging `states` within each group.
 
     Returns (key_cols_out, state_cols_out, out_live, n_groups) where all
-    output arrays have length num_groups_cap and rows beyond n_groups are
-    dead. NULL key values form their own group (SQL GROUP BY semantics).
-    Rows with live=False are ignored. If n_groups > num_groups_cap the
-    caller must retry with a bigger capacity (groups beyond cap are dropped
-    deterministically — the driver checks).
+    output arrays share one capacity (num_groups_cap on the sort path;
+    the pow2 hash-table capacity on the hash path — drivers must size off
+    the returned arrays, not the requested cap) and slots with
+    out_live=False are dead. NULL key values form their own group (SQL
+    GROUP BY semantics). Rows with live=False are ignored. If
+    n_groups > num_groups_cap the caller must retry with a bigger
+    capacity (groups beyond cap are dropped deterministically — the
+    driver checks; on the hash engine n_groups then upper-bounds the true
+    distinct count instead of equaling it).
+
+    engine: "sort" (lexicographic sort + segmented scan — the default) or
+    "hash" (ops/pallas_hash linear probing; chosen per breaker by
+    plan/stats.choose_breaker_engine). Both engines produce the same
+    group multiset; group ORDER differs (sorted by key vs. hash slot).
     """
     if not keys:
         return _global_merge(states, live, num_groups_cap)
@@ -99,8 +109,11 @@ def grouped_merge(
             total *= ds
         if 0 < total <= min(num_groups_cap, _MASK_SLOTS):
             return _direct_grouped_merge(
-                keys, states, live, num_groups_cap, dom_slots
+                keys, states, live, num_groups_cap, dom_slots, engine
             )
+
+    if engine == "hash":
+        return _hash_grouped_merge(keys, states, live, num_groups_cap)
 
     n = live.shape[0]
     dead = (~live).astype(jnp.int32)
@@ -238,6 +251,7 @@ def _direct_grouped_merge(
     live: jnp.ndarray,
     num_groups_cap: int,
     dom_slots: Sequence[int],
+    engine: str = "sort",
 ) -> Tuple[list, list, jnp.ndarray, jnp.ndarray]:
     """Small-key-domain GROUP BY: the group id IS the mixed-radix number of
     the key digits (nullable keys reserve digit 0 for NULL), so states
@@ -266,10 +280,12 @@ def _direct_grouped_merge(
     gid = jnp.where(live, gid, total)  # dead rows match no slot
 
     from presto_tpu.ops import pallas_groupby as _pg
+    from presto_tpu.ops import pallas_hash as _ph
 
-    if _pg.enabled():
+    if engine == "hash" or _pg.enabled():
         return _pallas_direct_merge(keys, states, live, num_groups_cap,
-                                    dom_slots, gid, total)
+                                    dom_slots, gid, total,
+                                    interpret=_ph.use_interpret())
 
     # [G, n] group-membership mask, reused across all states
     eq = gid[None, :] == jnp.arange(total, dtype=jnp.int32)[:, None]
@@ -378,6 +394,130 @@ def _pallas_direct_merge(keys, states, live, num_groups_cap, dom_slots,
         nvalid = widen(iouts[nv_idx], jnp.int32)
         state_out.append(StateCol(agg, nvalid > 0, s.op))
     return key_out, state_out, out_live, n_groups
+
+
+# One-hot [B, G] MXU membership is O(B·G); past this many physical slots
+# the gid-sorted segmented-scan reduction (G-independent) wins.
+_HASH_MXU_SLOTS = 512
+
+
+def _hash_grouped_merge(
+    keys: Sequence[KeyCol],
+    states: Sequence[StateCol],
+    live: jnp.ndarray,
+    num_groups_cap: int,
+) -> Tuple[list, list, jnp.ndarray, jnp.ndarray]:
+    """General GROUP BY on the Pallas linear-probing table
+    (ops/pallas_hash): encode keys into int64 planes, assign group ids by
+    hash-table insert, then reduce states by gid — via the exact
+    limb-split MXU kernel (ops/pallas_groupby.grouped_sums) when every
+    state is an integer sum and the table is small, else via a gid sort
+    feeding the same segmented-scan reduction the sort engine uses
+    (stable sort, so per-group float addition order matches input order).
+
+    The group table is sparse over the physical capacity (2× the pow2
+    logical cap): out_live marks occupied slots, keys decode from the
+    stored planes. Overflow reports n_groups > num_groups_cap so the
+    driver's regrow-replay fires on the existing contract."""
+    from presto_tpu.ops import pallas_groupby as _pg
+    from presto_tpu.ops import pallas_hash as _ph
+    from presto_tpu.ops import radix as _radix
+    from presto_tpu.ops.hashing import hash_columns
+
+    interpret = _ph.use_interpret()
+    cap = 1
+    while cap < num_groups_cap:
+        cap *= 2
+    tcap = 2 * cap
+
+    planes, has_nulls = _ph.encode_group_keys(
+        [(k.values, k.validity) for k in keys])
+    h = hash_columns(list(planes))
+    slot0 = _radix.slot_hash(h, tcap)
+    gid, table, occ, ngroups, ovf = _ph.group_insert(
+        planes, slot0, live, cap, interpret=interpret)
+    out_live = occ > 0
+
+    # On overflow report > cap so the driver regrows; ovf counts unplaced
+    # ROWS (an upper bound on the missing distinct keys), so clamp the
+    # overshoot to keep the regrow ladder geometric, not row-count-sized.
+    ng = jnp.where(
+        ovf > 0,
+        jnp.int64(cap) + jnp.minimum(ovf.astype(jnp.int64),
+                                     jnp.int64(3 * cap)),
+        ngroups.astype(jnp.int64))
+
+    nullplane = table[len(keys)] if has_nulls else None
+    key_out = []
+    for j, k in enumerate(keys):
+        kv = _ph.decode_plane(table[j], k.values.dtype)
+        if k.validity is not None:
+            nbit = (nullplane >> jnp.int64(j)) & jnp.int64(1)
+            key_out.append(KeyCol(kv, out_live & (nbit == 0), k.domain))
+        else:
+            key_out.append(KeyCol(kv, None, k.domain))
+
+    if not states:
+        return key_out, [], out_live, ng
+    all_int_sums = all(
+        s.op in ("sum", "count_add")
+        and not jnp.issubdtype(s.values.dtype, jnp.floating)
+        for s in states)
+    if all_int_sums and tcap <= _HASH_MXU_SLOTS:
+        state_out = _hash_states_mxu(states, live, gid, tcap, interpret)
+    else:
+        state_out = _hash_states_sorted(states, gid, tcap)
+    return key_out, state_out, out_live, ng
+
+
+def _hash_states_mxu(states, live, gid, tcap: int, interpret: bool):
+    """All-integer-sum states reduce on the MXU limb-split kernel: one
+    fused pass, exact int64 sums (gid >= tcap marks dead/unplaced rows)."""
+    from presto_tpu.ops import pallas_groupby as _pg
+
+    int_states, plan = [], []
+    for s in states:
+        valid = live if s.validity is None else (live & s.validity)
+        contrib = jnp.where(valid, s.values, jnp.zeros_like(s.values))
+        main = len(int_states)
+        int_states.append(contrib.astype(jnp.int64))
+        if s.op != "count_add":
+            plan.append((main, len(int_states)))
+            int_states.append(valid.astype(jnp.int64))
+        else:
+            plan.append((main, None))
+    iouts = _pg.grouped_sums(gid, int_states, tcap, interpret=interpret)
+    state_out = []
+    for s, (mi, ni) in zip(states, plan):
+        agg = iouts[mi].astype(s.values.dtype)
+        if s.op == "count_add":
+            state_out.append(StateCol(agg, None, s.op))
+        else:
+            state_out.append(StateCol(agg, iouts[ni] > 0, s.op))
+    return state_out
+
+
+def _hash_states_sorted(states, gid, tcap: int):
+    """General states reduce by a stable sort on gid feeding the same
+    segmented-scan machinery as the sort engine — per-group combine order
+    is input row order on both engines. Dead/unplaced rows (gid == tcap)
+    sink past every slot's segment."""
+    n = gid.shape[0]
+    perm = jnp.arange(n, dtype=jnp.int32)
+    sgid, sperm = jax.lax.sort([gid, perm], num_keys=1, is_stable=True)
+    change = jnp.zeros(n, dtype=bool).at[0].set(True)
+    change = change.at[1:].set(sgid[1:] != sgid[:-1])
+    slots = jnp.arange(tcap, dtype=sgid.dtype)
+    starts = jnp.searchsorted(sgid, slots, side="left")
+    ends = jnp.searchsorted(sgid, slots, side="right") - 1
+    has = ends >= starts
+    ends_c = jnp.clip(ends, 0, n - 1).astype(jnp.int32)
+    out = []
+    for s in states:
+        sv = s.values[sperm]
+        svalid = s.validity[sperm] if s.validity is not None else None
+        out.append(_state_merge_sorted(sv, svalid, s.op, change, ends_c, has))
+    return out
 
 
 def _state_merge_masked(s: StateCol, eq, total: int, num_groups_cap: int):

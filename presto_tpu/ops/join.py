@@ -25,8 +25,10 @@ from typing import NamedTuple, Optional, Sequence
 import jax
 import jax.numpy as jnp
 
-from presto_tpu.batch import Batch, Column
+from presto_tpu.batch import Batch, Column, round_up_capacity
+from presto_tpu.ops import pallas_hash
 from presto_tpu.ops.hashing import hash_columns
+from presto_tpu.ops.radix import slot_hash
 from presto_tpu.ops.sort import permute_batch
 
 
@@ -187,6 +189,12 @@ def probe_counts(
         idx = jnp.clip(lo + j, 0, cap - 1).astype(jnp.int32)
         ok = (j < width) & _keys_equal(table, idx, probe, probe_keys, build_keys)
         counts = counts + ok.astype(jnp.int64)
+    # A range can verify NON-contiguously when distinct keys share a hash
+    # (float keys hash by integer truncation, so every value with the same
+    # integer part collides). probe_expand assumes verified matches start
+    # at lo, so emit the whole range in that case and let expand's key
+    # verification mask the non-matches — capacity widens, results don't.
+    counts = jnp.where(counts == width, counts, width)
     widened = live & (width > max_fanout_scan)
     counts = jnp.where(width > max_fanout_scan, width, counts)
     counts = jnp.where(live, counts, 0)
@@ -237,6 +245,149 @@ def probe_expand(
             pv, bv = pv.astype(t), bv.astype(t)
         pk_ok = pk_ok & (pv == bv)
     return probe_row, build_idx, in_range & pk_ok
+
+
+# ---------------------------------------------------------------------------
+# linear-probing hash-table engine (ops/pallas_hash) — the alternative to the
+# sorted build above, selected per breaker by plan/stats.choose_breaker_engine
+
+
+class HashJoinTable(NamedTuple):
+    """Linear-probing build side. Unlike BuildTable there is NO sort: the
+    build batch keeps input row order and `slot_row` maps probe-chain
+    slots to build ROW indices (-1 = empty); duplicate keys occupy
+    consecutive chain slots. `planes` are the pairwise-promoted encoded
+    key planes (pallas_hash.encode_plane), reused by every probe batch.
+    `hashes`/`orig_live` keep BuildTable's shape contract so the FULL
+    OUTER remainder path is engine-agnostic."""
+
+    hashes: jnp.ndarray       # int64[cap_b], per-row content hash
+    batch: Batch              # NULL-key rows live-killed, input order
+    n_rows: jnp.ndarray       # device scalar
+    orig_live: jnp.ndarray    # bool[cap_b]
+    slot_row: jnp.ndarray     # int32[tcap], tcap = 2 * pow2(cap_b)
+    planes: jnp.ndarray       # int64[K, cap_b]
+
+
+def join_compare_dtypes(build_batch: Batch, build_keys: Sequence[str],
+                        probe_dtypes: Sequence) -> tuple:
+    """Pairwise-promoted compare dtype per key position — the dtype at
+    which _keys_equal would compare, applied at ENCODE time so plane
+    equality matches the sort engine's `==` (identical rounding for
+    int→float promotions)."""
+    return tuple(
+        jnp.result_type(build_batch.column(k).values.dtype, jnp.dtype(d))
+        for k, d in zip(build_keys, probe_dtypes))
+
+
+def _encode_join_planes(batch: Batch, key_names: Sequence[str],
+                        compare_dtypes: Sequence):
+    """Encode one side's key columns at the promoted compare dtypes.
+
+    Returns (planes int64[K, cap], live, matchable): `live` kills
+    NULL-key rows (an equi-join never matches NULL — same as
+    build_side/_probe_ranges); `matchable` additionally excludes rows
+    with a NaN float key, because the hash table would make equal NaN
+    bit patterns match while IEEE `==` (the sort engine) never does."""
+    planes = []
+    live = batch.live
+    matchable = batch.live
+    for k, dt in zip(key_names, compare_dtypes):
+        c = batch.column(k)
+        if c.validity is not None:
+            live = live & c.validity
+        v = c.values
+        dt = jnp.dtype(dt)
+        if v.dtype != dt:
+            v = v.astype(dt)
+        if jnp.issubdtype(dt, jnp.floating):
+            matchable = matchable & jnp.logical_not(jnp.isnan(v))
+        planes.append(pallas_hash.encode_plane(v, canonicalize_nan=False))
+    return jnp.stack(planes), live, live & matchable
+
+
+def hash_build_side(batch: Batch, key_names: Sequence[str],
+                    probe_dtypes: Sequence) -> HashJoinTable:
+    """Build-side insert on the Pallas linear-probing kernel. The table
+    holds 2× the batch capacity (load ≤ 50%), so every live row claims a
+    slot. `probe_dtypes` are the probe side's key dtypes (from the plan),
+    fixing the pairwise-promoted encoding before any probe batch exists."""
+    compare = join_compare_dtypes(batch, key_names, probe_dtypes)
+    planes, live, ins_live = _encode_join_planes(batch, key_names, compare)
+    h = hash_columns(list(planes))
+    tcap = 2 * round_up_capacity(batch.capacity, minimum=64)
+    slot_row = pallas_hash.join_insert(
+        slot_hash(h, tcap), ins_live, tcap,
+        interpret=pallas_hash.use_interpret())
+    n = jnp.sum(live.astype(jnp.int64))
+    return HashJoinTable(h, batch.with_live(live), n, batch.live,
+                         slot_row, planes)
+
+
+def _hash_probe(table: HashJoinTable, probe: Batch,
+                probe_keys: Sequence[str], compare_dtypes: Sequence,
+                fanout: int):
+    planes, live, matchable = _encode_join_planes(
+        probe, probe_keys, compare_dtypes)
+    h = hash_columns(list(planes))
+    slot0 = slot_hash(h, table.slot_row.shape[0])
+    mm, cnt, ovf = pallas_hash.join_probe(
+        slot0, planes, matchable, table.slot_row, table.planes, fanout,
+        interpret=pallas_hash.use_interpret())
+    return mm, cnt, ovf, live
+
+
+def hash_probe_unique(table: HashJoinTable, probe: Batch,
+                      probe_keys: Sequence[str], compare_dtypes: Sequence):
+    """Unique-build fast path: first (only) match per probe row.
+    Same contract as probe_unique: (build_idx int32[cap], matched)."""
+    mm, cnt, _ovf, _live = _hash_probe(
+        table, probe, probe_keys, compare_dtypes, 1)
+    idx = jnp.clip(mm[:, 0], 0, table.batch.capacity - 1).astype(jnp.int32)
+    return idx, cnt > 0
+
+
+def hash_probe_counts(table: HashJoinTable, probe: Batch,
+                      probe_keys: Sequence[str], compare_dtypes: Sequence,
+                      max_fanout_scan: int = 8):
+    """General path, pass 1. Counts are EXACT (the kernel keeps counting
+    past the match-matrix width), so offsets/total never inflate;
+    overflow = #rows with more matches than the matrix holds — the
+    driver re-runs ONLY this probe with the fanout doubled.
+
+    Returns (mm int32[n, F], counts int64, offsets, total, live,
+    overflow)."""
+    mm, cnt, ovf, live = _hash_probe(
+        table, probe, probe_keys, compare_dtypes, max_fanout_scan)
+    counts = cnt.astype(jnp.int64)
+    offsets = jnp.cumsum(counts) - counts
+    total = jnp.sum(counts)
+    return mm, counts, offsets, total, live, ovf.astype(jnp.int64)
+
+
+def hash_probe_expand(table: HashJoinTable, mm: jnp.ndarray,
+                      counts: jnp.ndarray, offsets: jnp.ndarray,
+                      chunk_base, out_capacity: int):
+    """General path, pass 2 — pure XLA (no kernel): slot i maps back to
+    (probe_row, ordinal) by one searchsorted over the inclusive ends and
+    the build row is mm[probe_row, ordinal]. Precondition: counts <= F
+    everywhere (the driver widened the probe on overflow), so no key
+    re-verification is needed — the kernel matched exact planes.
+
+    Returns (probe_idx, build_idx, out_live), like probe_expand."""
+    ends = offsets + counts
+    i = jnp.arange(out_capacity, dtype=jnp.int64) + chunk_base
+    pcap = counts.shape[0]
+    probe_row = jnp.searchsorted(ends, i, side="right").astype(jnp.int32)
+    probe_row = jnp.clip(probe_row, 0, pcap - 1)
+    ordinal = i - offsets[probe_row]
+    in_range = (i < ends[-1]) & (ordinal >= 0) & (ordinal < counts[probe_row])
+    fanout = mm.shape[1]
+    oc = jnp.clip(ordinal, 0, fanout - 1).astype(jnp.int32)
+    build_idx = mm[probe_row, oc]
+    out_live = in_range & (build_idx >= 0)
+    build_idx = jnp.clip(build_idx, 0, table.batch.capacity - 1)
+    return probe_row, build_idx, out_live
 
 
 def gather_join_output(

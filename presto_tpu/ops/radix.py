@@ -48,6 +48,20 @@ def radix_bits(num_partitions: int) -> int:
     return num_partitions.bit_length() - 1
 
 
+def slot_hash(h: jnp.ndarray, tcap: int) -> jnp.ndarray:
+    """Initial probe slot for the Pallas hash-table engine
+    (ops/pallas_hash): the LOW log2(tcap) bits of the shared 63-bit
+    content hash. Partition ids (radix_ids, above) take the TOP bits of
+    the same hash, so under radix every per-partition hash table still
+    sees fully mixed slot bits — the breaker-engine dimension composes
+    with radix partitioning without hash-bit reuse (table capacities stay
+    far below 2^(63 - log2(P)))."""
+    if tcap <= 0 or tcap & (tcap - 1):
+        raise ValueError(
+            f"slot table capacity must be a power of two, got {tcap}")
+    return (h & jnp.int64(tcap - 1)).astype(jnp.int32)
+
+
 def radix_ids(batch: Batch, key_names: Sequence[str],
               num_partitions: int) -> jnp.ndarray:
     """Row → radix partition id: top `log2(P)` bits of the content hash."""

@@ -476,6 +476,10 @@ def plan_to_string(node: PlanNode, indent: int = 0, node_stats=None,
         s = f"{pad}Output[{', '.join(node.names)}]"
     else:
         s = f"{pad}{type(node).__name__}"
+    beng = node.__dict__.get("_breaker_engine")
+    if beng is not None:
+        why = node.__dict__.get("_breaker_engine_why")
+        s += f"   [engine={beng}{f': {why}' if why else ''}]"
     frag = node.__dict__.get("_fragment_fusion")
     if frag is not None:
         fs = node.__dict__.get("_fragment_stats")

@@ -301,3 +301,70 @@ def _derive(node: PlanNode, catalog) -> Optional[NodeStats]:
     if isinstance(node, RemoteSource):
         return None
     return None
+
+
+# ---------------------------------------------------------------------------
+# breaker engine choice: sort-based vs Pallas linear-probing hash table
+# (ops/pallas_hash). The hash engine wins when the group/build table is
+# SMALL and rows hit it repeatedly — each row costs O(probe chain) serial
+# work instead of participating in an O((cap + batch) log) sort — and
+# loses when the table is large (long kernel, big planes) or barely
+# reused. The reference analog is DetermineJoinDistributionType: a
+# stats-driven physical-strategy pick recorded on the plan node.
+
+# above this many estimated groups the group table stops being "small":
+# the insert kernel's serial row loop dominates and the sort engine's
+# O(n log n) batched primitives win
+HASH_MAX_GROUPS = 1 << 12
+# minimum rows-per-group duplication for keyed aggregation: near-distinct
+# keys mean the hash table does no reduction, all insert cost
+HASH_MIN_DUPLICATION = 4.0
+# join/semijoin build sides larger than this probe too long a chain under
+# skew and carry wide slot_row tables
+HASH_MAX_BUILD_ROWS = 1 << 13
+# each key adds an int64 plane every kernel walks per probe step; wide
+# key tuples (and wide agg payloads) favor the sort engine's columnar ops
+HASH_MAX_KEY_WIDTH = 6
+HASH_MAX_PAYLOAD_STATES = 16
+
+
+def choose_breaker_engine(node: PlanNode, catalog,
+                          override: str = "auto"):
+    """(engine, why) for a pipeline breaker: ``engine`` ∈ {sort, hash}.
+
+    ``override`` is the ``breaker_engine`` session property: ``sort`` /
+    ``hash`` force the engine; ``auto`` asks the stats above. No stats →
+    sort (never regress the known-good engine on a blind guess)."""
+    if override == "sort":
+        return "sort", "session breaker_engine=sort"
+    if override == "hash":
+        return "hash", "session breaker_engine=hash"
+    if isinstance(node, Aggregate):
+        if not node.group_keys:
+            return "sort", "global aggregate"
+        if len(node.group_keys) > HASH_MAX_KEY_WIDTH:
+            return "sort", f"{len(node.group_keys)} group keys > {HASH_MAX_KEY_WIDTH}"
+        if len(node.aggs) > HASH_MAX_PAYLOAD_STATES:
+            return "sort", f"{len(node.aggs)} agg states > {HASH_MAX_PAYLOAD_STATES}"
+        st = derive(node, catalog)
+        child = derive(node.child, catalog)
+        if st is None or child is None or not st.rows or not child.rows:
+            return "sort", "no stats"
+        groups, rows = st.rows, child.rows
+        if groups > HASH_MAX_GROUPS:
+            return "sort", f"est {groups:.3g} groups > {HASH_MAX_GROUPS}"
+        dup = rows / max(groups, 1.0)
+        if dup < HASH_MIN_DUPLICATION:
+            return "sort", f"duplication x{dup:.2g} < {HASH_MIN_DUPLICATION:.2g}"
+        return "hash", f"est {groups:.3g} groups, x{dup:.3g} duplication"
+    if isinstance(node, (HashJoin, SemiJoin)):
+        keys = node.right_keys
+        if len(keys) > HASH_MAX_KEY_WIDTH:
+            return "sort", f"{len(keys)} join keys > {HASH_MAX_KEY_WIDTH}"
+        build = derive(node.right, catalog)
+        if build is None or not build.rows:
+            return "sort", "no build-side stats"
+        if build.rows > HASH_MAX_BUILD_ROWS:
+            return "sort", f"est build {build.rows:.3g} rows > {HASH_MAX_BUILD_ROWS}"
+        return "hash", f"est build {build.rows:.3g} rows"
+    return "sort", "not an engine-dimensioned breaker"

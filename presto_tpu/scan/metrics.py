@@ -21,7 +21,12 @@ COUNTER_NAMES = (
 # dispatch-count counters for whole-fragment fusion (exec/fragment_jit.py):
 # these render as presto_tpu_{k}_total — NOT under the scan_ prefix, they
 # count engine dispatches — but share the store/lock/plane-label contract
-_DISPATCH_COUNTER_NAMES = ("fragment_dispatches", "batch_dispatches")
+_DISPATCH_COUNTER_NAMES = (
+    "fragment_dispatches", "batch_dispatches",
+    # breaker-engine dispatches (exec/runtime.py): one count per breaker
+    # program instantiation, labeled by the CBO's hash-vs-sort choice
+    "breaker_dispatches_hash", "breaker_dispatches_sort",
+)
 
 _HELP = {
     "splits_pruned": "splits eliminated by min/max split statistics",
@@ -45,6 +50,12 @@ _HELP = {
         "covering a stacked window of batches)",
     "batch_dispatches":
         "per-batch breaker step dispatches (the unfused fallback path)",
+    "breaker_dispatches_hash":
+        "breaker program instantiations routed to the Pallas linear-probing "
+        "hash engine (ops/pallas_hash) by the CBO or a session override",
+    "breaker_dispatches_sort":
+        "breaker program instantiations routed to the sort/searchsorted "
+        "engine (the default when stats disfavor or preclude hashing)",
 }
 
 _lock = threading.Lock()

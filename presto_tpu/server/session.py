@@ -210,6 +210,12 @@ class SystemSessionProperties:
                              "Max batches stacked per fused fragment "
                              "dispatch", int, 8,
                              validator=_positive("fragment_window")),
+            PropertyMetadata("breaker_engine",
+                             "Keyed-agg/join breaker engine: auto lets the "
+                             "CBO pick per breaker from derived stats; "
+                             "sort/hash force one engine", str, "auto",
+                             validator=_enum("breaker_engine",
+                                             ["AUTO", "SORT", "HASH"])),
         ]
 
     def names(self) -> List[str]:
@@ -322,4 +328,5 @@ class Session:
                 self.get("max_compiled_shapes_breaker") or None),
             fragment_fusion=self.get("fragment_fusion"),
             fragment_window=self.get("fragment_window"),
+            breaker_engine=self.get("breaker_engine").lower(),
         )

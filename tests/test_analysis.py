@@ -252,6 +252,47 @@ def test_lint_np_asarray_on_traced():
     assert rules_of(findings) == {"host-sync"}
 
 
+def test_lint_ref_indexing_dynamic_shapes_flagged():
+    src = (
+        "def k(x_ref, o_ref, bucket):\n"
+        "    w = x_ref[0]\n"                       # ref load → tainted
+        "    a = x_ref[0:w]\n"                     # dynamic slice bound
+        "    o_ref[pl.ds(0, w)] = a\n"             # dynamic pl.ds SIZE
+        "    b = x_ref[0:bucket]\n"                # closure const: fine
+        "    c = o_ref[pl.ds(w, bucket)]\n"        # dynamic START: fine
+        "    return b + c\n"
+    )
+    findings = lint(src)
+    assert [f.rule for f in findings] == ["ref-indexing"] * 2
+    assert {f.loc for f in findings} == {f"{OPS}:3", f"{OPS}:4"}
+
+
+def test_lint_taint_blocks_runtime_derived_static():
+    # cap.capacity LOOKS static (blessed attr tail) but cap came off the
+    # runtime ctx; the taint must survive the assignment into int()
+    src = (
+        "def k(x, ctx):\n"
+        "    cap = ctx.config\n"
+        "    return int(cap.capacity)\n"
+    )
+    assert rules_of(lint(src)) == {"host-sync"}
+    # same attribute tail rooted at a genuinely static object stays clean
+    src2 = (
+        "def k(batch):\n"
+        "    return int(batch.capacity)\n"
+    )
+    assert lint(src2) == []
+
+
+def test_lint_taint_session_get_flagged():
+    src = (
+        "def k(x, session):\n"
+        "    rows = session.get('batch_rows')\n"
+        "    return float(rows)\n"
+    )
+    assert rules_of(lint(src)) == {"host-sync"}
+
+
 def test_lint_float64_rules():
     src = (
         "def k(n):\n"
