@@ -40,6 +40,7 @@ Env knobs:
   BENCH_BUDGET_S  total wall budget (default 2400)
   BENCH_DATA_DIR  dataset directory (default <repo>/.bench_data)
   BENCH_SF_Q9 / BENCH_SF_Q64  override the big scale factors (default 100)
+  BENCH_SF_MESH   scale factor for the mesh_scaling sweep (default 0.1)
   BENCH_PALLAS=1  run aggregation configs with the Pallas MXU kernel
 """
 
@@ -318,6 +319,88 @@ def _child(name: str, sf: float, cap_s: float = 0.0):
     }), flush=True)
 
 
+def _mesh_child(n_dev: int, sf: float):
+    """One mesh_scaling point: Q3 over an n_dev-device mesh. The PARENT
+    sets XLA_FLAGS=--xla_force_host_platform_device_count before this
+    process imports jax — device count is an import-time decision."""
+    from presto_tpu.catalog.parquet import ParquetConnector, export_tpch_chunked
+    from presto_tpu.connector import Catalog
+    from presto_tpu.exec import ExecConfig
+    from presto_tpu.parallel.mesh import make_mesh
+    from presto_tpu.parallel.mesh_exec import MeshExecutor
+
+    d = os.path.join(DATA_DIR, f"tpch_sf{sf:g}")
+    export_tpch_chunked(d, sf, log=_log)
+    cat = Catalog()
+    conn = ParquetConnector(d, name="tpch")
+    cat.register("tpch", conn, default=True)
+    nrows = int(conn.get_table("lineitem").row_count)
+    mx = MeshExecutor(cat, make_mesh(n_dev),
+                      ExecConfig(batch_rows=1 << 18))
+    t0 = time.time()
+    mx.run_batch(Q3)  # warm-up: trace + compile + staging caches
+    warm_s = round(time.time() - t0, 1)
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        out = mx.run_batch(Q3)
+        out.num_live()
+        times.append(time.perf_counter() - t0)
+    best = min(times)
+    lr = mx.last_run or {"retries": 0, "attempts": [{"exchanges": []}]}
+    ex = lr["attempts"][-1]["exchanges"]
+    used = sum(e["lanes_used"] for e in ex)
+    total = sum(e["lanes_total"] for e in ex)
+    print(json.dumps({
+        "n_dev": n_dev, "seconds": round(best, 4), "rows": nrows,
+        "rows_per_sec": round(nrows / best, 1), "warmup_s": warm_s,
+        "a2a_bytes": sum(e["bytes"] for e in ex),
+        "a2a_collectives": sum(e["a2a"] for e in ex),
+        "exchanges": len(ex),
+        "fused_exchanges": sum(1 for e in ex if e["fused"]),
+        "lanes_used": used, "lanes_total": total,
+        "lane_util": round(used / total, 4) if total else 0.0,
+        "overflow_retries": lr["retries"],
+    }), flush=True)
+
+
+def _run_mesh_scaling(extra: dict, remaining: float):
+    """ICI exchange scaling sweep: Q3 at n_dev ∈ {1,2,4,8} on the host
+    platform (deterministic on any machine; on a real slice the same
+    sweep measures ICI). Each point is its own subprocess because the
+    device count is fixed at jax import."""
+    sf = float(os.environ.get("BENCH_SF_MESH", "0.1"))
+    deadline = time.time() + remaining
+    points = {}
+    for n_dev in (1, 2, 4, 8):
+        if time.time() > deadline - 60:
+            points[f"n{n_dev}"] = {"skipped": "budget"}
+            continue
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
+                            f" --xla_force_host_platform_device_count={n_dev}")
+        try:
+            p = subprocess.run(
+                [sys.executable, os.path.abspath(__file__),
+                 "--mesh-child", str(n_dev), str(sf)],
+                env=env, stdout=subprocess.PIPE, timeout=600)
+            lines = p.stdout.decode().strip().splitlines()
+            if p.returncode == 0 and lines:
+                rec = json.loads(lines[-1])
+                _log(f"mesh_scaling n_dev={n_dev}: {rec['seconds']}s, "
+                     f"{rec['a2a_bytes']} a2a bytes, "
+                     f"{100 * rec['lane_util']:.1f}% lane util")
+                points[f"n{n_dev}"] = rec
+            else:
+                points[f"n{n_dev}"] = {"error": f"child rc={p.returncode}"}
+        except subprocess.TimeoutExpired:
+            points[f"n{n_dev}"] = {"error": "timeout"}
+        except Exception as e:
+            points[f"n{n_dev}"] = {"error": f"{type(e).__name__}: {e}"}
+    extra["mesh_scaling"] = {"sf": sf, "query": "q3", **points}
+
+
 # --------------------------------------------------------------- parent ----
 
 _STATE = {"extra": {}, "emitted": False, "child": None}
@@ -396,6 +479,9 @@ def main():
         _child(sys.argv[2], float(sys.argv[3]),
                float(sys.argv[4]) if len(sys.argv) > 4 else 0.0)
         return
+    if len(sys.argv) >= 4 and sys.argv[1] == "--mesh-child":
+        _mesh_child(int(sys.argv[2]), float(sys.argv[3]))
+        return
 
     signal.signal(signal.SIGTERM, _on_term)
     signal.signal(signal.SIGINT, _on_term)
@@ -412,13 +498,23 @@ def main():
                "q64": float(os.environ.get("BENCH_SF_Q64", "100"))}
     wanted = os.environ.get(
         "BENCH_CONFIGS", "q1_sf1,q1_nofuse_sf1,q6_sf10,q3_sf10,join_sf1,"
-        "groupby_engine_ab_sf1,groupby_engine_ab_sort_sf1,q9,q64"
+        "groupby_engine_ab_sf1,groupby_engine_ab_sort_sf1,mesh_scaling,"
+        "q9,q64"
     ).split(",")
 
     for name in (w.strip() for w in wanted):
         if not name:
             continue
         name = _ALIASES.get(name, name)
+        if name == "mesh_scaling":
+            remaining = budget - (time.time() - _T0)
+            if remaining < 60:
+                _log("mesh_scaling: SKIPPED (budget exhausted)")
+                extra["mesh_scaling"] = {"skipped": "budget"}
+            else:
+                _run_mesh_scaling(extra, remaining)
+            _checkpoint()
+            continue
         if name not in _CONFIGS:
             _log(f"{name}: UNKNOWN config (valid: {','.join(_CONFIGS)})")
             extra[name] = {"error": "unknown config"}

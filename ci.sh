@@ -245,6 +245,52 @@ if [ "$rc" -ne 0 ]; then
   exit "$rc"
 fi
 
+# Mesh data-plane smoke: a Q3-shaped join + keyed aggregation over an
+# 8-device CPU mesh must (a) match the local streaming engine's
+# checksum, (b) ride the fused single-buffer exchange path for every
+# OUT_HASH exchange, and (c) finish without a single overflow replay —
+# the stats-sized lanes must be right on the first attempt.
+echo "== mesh smoke: fused ICI exchanges + local-vs-mesh checksum =="
+env JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python - <<'PYEOF'
+from presto_tpu.catalog.tpch import tpch_catalog
+from presto_tpu.exec import ExecConfig, LocalRunner
+from presto_tpu.parallel.mesh import make_mesh
+from presto_tpu.parallel.mesh_exec import MeshExecutor
+from presto_tpu.verifier import result_checksum
+
+cat = tpch_catalog(0.01)
+mx = MeshExecutor(cat, make_mesh(8),
+                  ExecConfig(batch_rows=1 << 12, agg_capacity=1 << 10))
+local = LocalRunner(cat, ExecConfig(batch_rows=1 << 13))
+q = """
+select l_orderkey, sum(l_extendedprice * (1 - l_discount)) as revenue,
+       o_orderdate, o_shippriority
+from customer, orders, lineitem
+where c_mktsegment = 'BUILDING' and c_custkey = o_custkey
+  and l_orderkey = o_orderkey and o_orderdate < date '1995-03-15'
+  and l_shipdate > date '1995-03-15'
+group by l_orderkey, o_orderdate, o_shippriority
+order by revenue desc, o_orderdate limit 10
+"""
+assert result_checksum(mx.run_batch(q)) == result_checksum(local.run_batch(q))
+lr = mx.last_run
+assert lr["retries"] == 0, lr
+exchanges = lr["attempts"][0]["exchanges"]
+fused = [e for e in exchanges if e["fused"]]
+assert fused, exchanges
+bts = sum(e["bytes"] for e in exchanges)
+util = (sum(e["lanes_used"] for e in exchanges)
+        / max(sum(e["lanes_total"] for e in exchanges), 1))
+print(f"mesh smoke OK: {len(fused)}/{len(exchanges)} fused exchanges, "
+      f"{bts} a2a bytes, {100*util:.1f}% lane util, 0 replays")
+PYEOF
+rc=$?
+if [ "$rc" -ne 0 ]; then
+  echo "mesh smoke FAILED (exit $rc)"
+  exit "$rc"
+fi
+
 # Static-analysis step: the kernel lint must be clean over the shipped
 # tree, the analyzer must actually FAIL on an injected violation (a
 # linter that can't fail is decoration), the plan-invariant checker must

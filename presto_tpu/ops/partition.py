@@ -61,18 +61,26 @@ def partition_ids(batch: Batch, key_names: Sequence[str], num_partitions: int):
     return (h % num_partitions).astype(jnp.int32)
 
 
-def partition_for_exchange(
+def partition_layout(
     batch: Batch,
     key_names: Sequence[str],
     num_partitions: int,
     per_partition_capacity: int,
-) -> Tuple[Batch, jnp.ndarray, jnp.ndarray]:
-    """Scatter rows into (P, C) per-partition lanes.
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Routing layout shared by the per-column and the packed (fused-lane)
+    exchange paths: sort rows by partition id once, derive every plane's
+    scatter from that single sort.
 
-    Returns (out_batch with leading partition axis folded as P*C rows,
-    per-partition live counts int32[P], overflow_count scalar).
-    The out batch's arrays are reshaped by the exchange into (P, C) and fed
-    to all_to_all; row order within a partition follows input order.
+    Returns (sperm, dest, counts, routed, overflow):
+    - sperm int32[n]: source row for each sorted position,
+    - dest  int32[n]: output slot (partition * C + rank) for each sorted
+      position; the value ``P*C`` marks dead/overflow rows (scatter with
+      mode="drop" discards them),
+    - counts int32[P]: live rows per partition (uncapped — overflow rows
+      included, so lane-utilization accounting sees true demand),
+    - routed bool[n]: sorted-order mask of rows that landed in a lane
+      (live and within capacity) — the source plane for `live`,
+    - overflow: scalar count of live rows beyond per-partition capacity.
     """
     n = batch.capacity
     pid = partition_ids(batch, key_names, num_partitions)
@@ -88,7 +96,30 @@ def partition_for_exchange(
     in_cap = slot < per_partition_capacity
     dest = jnp.clip(spid, 0, num_partitions - 1) * per_partition_capacity + slot
     dest = jnp.where(live_sorted & in_cap, dest, num_partitions * per_partition_capacity)
+    counts = jax.ops.segment_sum(
+        live_sorted.astype(jnp.int32),
+        jnp.clip(spid, 0, num_partitions),
+        num_segments=num_partitions + 1,
+    )[:num_partitions]
+    overflow = jnp.sum(live_sorted & ~in_cap)
+    return sperm, dest, counts, live_sorted & in_cap, overflow
 
+
+def partition_for_exchange(
+    batch: Batch,
+    key_names: Sequence[str],
+    num_partitions: int,
+    per_partition_capacity: int,
+) -> Tuple[Batch, jnp.ndarray, jnp.ndarray]:
+    """Scatter rows into (P, C) per-partition lanes.
+
+    Returns (out_batch with leading partition axis folded as P*C rows,
+    per-partition live counts int32[P], overflow_count scalar).
+    The out batch's arrays are reshaped by the exchange into (P, C) and fed
+    to all_to_all; row order within a partition follows input order.
+    """
+    sperm, dest, counts, routed, overflow = partition_layout(
+        batch, key_names, num_partitions, per_partition_capacity)
     out_n = num_partitions * per_partition_capacity
     cols = []
     for c in batch.columns:
@@ -105,13 +136,6 @@ def partition_for_exchange(
         else:
             ohi = None
         cols.append(Column(ov, oval, ohi))
-    out_live = jnp.zeros(out_n, dtype=bool).at[dest].set(live_sorted & in_cap, mode="drop")
-
-    counts = jax.ops.segment_sum(
-        live_sorted.astype(jnp.int32),
-        jnp.clip(spid, 0, num_partitions),
-        num_segments=num_partitions + 1,
-    )[:num_partitions]
-    overflow = jnp.sum(live_sorted & ~in_cap)
+    out_live = jnp.zeros(out_n, dtype=bool).at[dest].set(routed, mode="drop")
     out = Batch(batch.names, batch.types, cols, out_live, batch.dicts)
     return out, counts, overflow

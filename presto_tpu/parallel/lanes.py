@@ -1,0 +1,148 @@
+"""Fused exchange lanes — one collective per dtype bucket, not per array.
+
+Reference contrast: PartitionedOutputOperator serializes a page's blocks
+into ONE wire buffer per destination (PagesSerde), so the HTTP shuffle
+always ships a single stream per consumer. The prototype mesh exchange
+instead issued one `all_to_all` per column plane (values, validity, hi,
+live) — a Q3-shaped exchange with 6 columns dispatched ~14 collectives,
+each paying ICI latency and a fresh XLA collective op.
+
+This module is the PagesSerde analog for the collective path: every plane
+of a Batch is assigned a LANE in a dense [L, n] buffer, planes are
+bucketed by dtype (a collective moves one dtype), and the exchange issues
+exactly one `all_to_all` per dtype bucket — O(1) collectives per exchange
+regardless of column count. Unpacking is pure slicing, so the round trip
+is bit-exact: the packed path must be indistinguishable from the
+per-column path (tests/test_mesh_exchange.py property-checks this).
+
+Lane order is deterministic (live first, then per column: values,
+validity, hi) so a LanePlan derived from a Batch TEMPLATE applies to any
+batch with the same schema — the plan is trace-time static.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from presto_tpu.batch import Batch, Column
+
+# plane kinds, in deterministic enumeration order per column
+_VALUES, _VALIDITY, _HI = "values", "validity", "hi"
+
+
+class LanePlan:
+    """Static description of a Batch's planes → (bucket, lane) mapping.
+
+    buckets: list of (np.dtype, n_lanes). entries: one (kind, col_idx,
+    bucket_idx, lane_idx) per plane; col_idx == -1 is the live mask.
+    """
+
+    def __init__(self, buckets, entries):
+        self.buckets: List[Tuple[np.dtype, int]] = buckets
+        self.entries: List[Tuple[str, int, int, int]] = entries
+
+    @property
+    def n_collectives(self) -> int:
+        return len(self.buckets)
+
+    def nbytes(self, capacity: int) -> int:
+        """Per-device packed bytes for buffers of row capacity `capacity`."""
+        return sum(nl * capacity * dt.itemsize for dt, nl in self.buckets)
+
+
+def plan_lanes(batch: Batch) -> Optional[LanePlan]:
+    """Derive the lane plan for a batch's schema, or None when the batch
+    holds planes the packer doesn't model (structural array/map columns) —
+    callers fall back to the per-column exchange."""
+    planes: List[Tuple[str, int, np.dtype]] = [
+        ("live", -1, np.dtype(bool))]
+    for ci, c in enumerate(batch.columns):
+        if c.sizes is not None or c.evalid is not None or c.keys is not None:
+            return None
+        if c.values.ndim != 1:
+            return None
+        planes.append((_VALUES, ci, np.dtype(c.values.dtype)))
+        if c.validity is not None:
+            planes.append((_VALIDITY, ci, np.dtype(bool)))
+        if c.hi is not None:
+            planes.append((_HI, ci, np.dtype(c.hi.dtype)))
+    buckets: List[Tuple[np.dtype, int]] = []
+    index = {}
+    entries = []
+    for kind, ci, dt in planes:
+        bi = index.get(dt)
+        if bi is None:
+            bi = index[dt] = len(buckets)
+            buckets.append((dt, 0))
+        dt0, nl = buckets[bi]
+        entries.append((kind, ci, bi, nl))
+        buckets[bi] = (dt0, nl + 1)
+    return LanePlan(buckets, entries)
+
+
+def _source_plane(batch: Batch, kind: str, ci: int):
+    if ci == -1:
+        return batch.live
+    c = batch.columns[ci]
+    return {_VALUES: c.values, _VALIDITY: c.validity, _HI: c.hi}[kind]
+
+
+def pack_batch(batch: Batch, plan: LanePlan) -> List[jnp.ndarray]:
+    """Stack every plane into its bucket buffer: one [L, capacity] array
+    per dtype bucket, lanes in plan order."""
+    per_bucket: List[List[jnp.ndarray]] = [[] for _ in plan.buckets]
+    for kind, ci, bi, _lane in plan.entries:
+        dt = plan.buckets[bi][0]
+        per_bucket[bi].append(_source_plane(batch, kind, ci).astype(dt))
+    return [jnp.stack(ps) for ps in per_bucket]
+
+
+def pack_partitioned(batch: Batch, plan: LanePlan, sperm, dest, routed,
+                     out_n: int) -> List[jnp.ndarray]:
+    """Partition + pack in one scatter per bucket: permute each bucket's
+    stacked planes by the partition sort and scatter all lanes at once
+    along the row axis (ops/partition.partition_layout supplies
+    sperm/dest/routed). Bit-identical to partition_for_exchange followed
+    by pack_batch, but K column scatters collapse into B bucket scatters
+    and the packed buffers feed all_to_all directly."""
+    bufs = []
+    for bi, (dt, nl) in enumerate(plan.buckets):
+        rows = []
+        for kind, ci, b, _lane in plan.entries:
+            if b != bi:
+                continue
+            if ci == -1:
+                # live lane: routed is already in sorted order — rows that
+                # landed in a lane are live there by construction
+                rows.append(routed.astype(dt))
+            else:
+                rows.append(_source_plane(batch, kind, ci)[sperm].astype(dt))
+        src = jnp.stack(rows)  # [nl, n] in sorted row order
+        buf = jnp.zeros((nl, out_n), dtype=dt)
+        bufs.append(buf.at[:, dest].set(src, mode="drop"))
+    return bufs
+
+
+def unpack_batch(template: Batch, plan: LanePlan,
+                 bufs: Sequence[jnp.ndarray]) -> Batch:
+    """Rebuild a Batch (same schema/dicts as `template`, capacity =
+    buffer row count) from packed bucket buffers."""
+    lane_of = {(kind, ci): (bi, lane)
+               for kind, ci, bi, lane in plan.entries}
+
+    def plane(kind, ci, dtype):
+        bi, lane = lane_of[(kind, ci)]
+        return bufs[bi][lane].astype(dtype)
+
+    cols = []
+    for ci, c in enumerate(template.columns):
+        validity = (plane(_VALIDITY, ci, bool)
+                    if (_VALIDITY, ci) in lane_of else None)
+        hi = (plane(_HI, ci, c.hi.dtype)
+              if (_HI, ci) in lane_of else None)
+        cols.append(Column(plane(_VALUES, ci, c.values.dtype), validity, hi))
+    live = plane("live", -1, bool)
+    return Batch(template.names, template.types, cols, live, template.dicts)

@@ -18,14 +18,40 @@ broadcast and hash-partitioned joins (unique and bounded-fanout; INNER /
 LEFT / FULL OUTER — RIGHT normalizes to LEFT at analysis), semi joins,
 window functions (one-sort closed-form kernels), UNION [ALL] /
 INTERSECT / EXCEPT, UNNEST, gathered sort/topn/limit/output.
-Data-dependent sizes (join fanout, exchange partition skew, group counts)
-use static capacities with device-side overflow counters, psum-reduced and
-checked on the host after execution — the driver retries with doubled
-capacities on overflow (the mesh analog of the streaming engine's
-capacity-growth replay)."""
+
+The exchange plane is production-shaped along four axes:
+
+1. **Stats-sized lanes** — an OUT_HASH exchange's per-lane capacity comes
+   from the producing fragment's CBO estimate (Fragment.est_rows /
+   est_key_ndv via plan/stats.exchange_lane_rows) with a skew headroom
+   factor, clamped by the pessimistic padding bound, so ICI bytes track
+   estimated rows instead of `capacity // n_dev * 2` padding.
+2. **Fused single-buffer collectives** — every exchanged plane (values /
+   validity / hi / live) is packed into dtype-bucketed dense buffers
+   (parallel/lanes.py) and the exchange issues ONE all_to_all per dtype
+   bucket instead of one per array; the partition scatter and the packing
+   fuse into a single scatter per bucket (ops/partition.partition_layout).
+3. **Surgical overflow replay** — every data-dependent capacity (exchange
+   lane, group table, join fanout width, join output) claims a SITE in
+   lowering order; its overflow diagnostic is psum-reduced into a per-site
+   vector checked on the host. A retry re-traces with ONLY the overflowing
+   sites' capacities doubled — not the old global `_cap_boost *= 2` that
+   re-padded every capacity and stayed sticky across queries.
+4. **Hash-engine breakers on-mesh** — `choose_breaker_engine` (the PR 7
+   CBO) routes small-NDV/high-duplication aggregates and small-build
+   joins/semijoins to the Pallas linear-probing kernels inside the
+   shard_map program (`interpret=True` off-TPU keeps CPU sweeps exact);
+   the engine choice is part of the traced structure, so it keys the
+   mesh program cache.
+
+Structurally identical queries reuse the compiled shard_map program via a
+per-executor cache keyed on (fragment canonical JSON, per-site boosts,
+config fingerprint) — the mesh analog of exec/programs.py.
+"""
 
 from __future__ import annotations
 
+import hashlib
 from typing import Dict, List, Optional, Tuple
 
 import jax
@@ -38,6 +64,7 @@ from presto_tpu.connector import Catalog
 from presto_tpu.exec.runtime import (
     ExecConfig,
     _input_state,
+    _join_plan_cdt,
     _renorm_limbs,
     build_agg_finalizer,
     collapse_chain,
@@ -47,12 +74,18 @@ from presto_tpu.ops.join import (
     align_probe_strings,
     build_side,
     gather_join_output,
+    hash_build_side,
+    hash_probe_counts,
+    hash_probe_expand,
+    hash_probe_unique,
+    join_compare_dtypes,
     probe_counts,
     probe_expand,
     probe_unique,
 )
-from presto_tpu.ops.partition import partition_for_exchange
+from presto_tpu.ops.partition import partition_for_exchange, partition_layout
 from presto_tpu.ops.sort import limit_batch, sort_batch
+from presto_tpu.parallel import lanes
 from presto_tpu.parallel.mesh import WORKERS, shard_map
 from presto_tpu.plan.agg_states import (
     agg_state_layout,
@@ -81,13 +114,65 @@ from presto_tpu.plan.nodes import (
     Window,
 )
 from presto_tpu.exec.runtime import _sort_keys
+from presto_tpu.scan import metrics as _scan_metrics
 
 
 class MeshOverflow(RuntimeError):
-    pass
+    """A capacity site overflowed. `sites` maps site id → globally dropped
+    rows; `site_caps` maps site id → the capacity that overflowed (for
+    diagnostics); `labels` names each site."""
+
+    def __init__(self, msg: str, sites=None, site_caps=None, labels=None):
+        super().__init__(msg)
+        self.sites: Dict[int, int] = dict(sites or {})
+        self.site_caps: Dict[int, int] = dict(site_caps or {})
+        self.labels = list(labels or [])
+
+
+class _SiteTracker:
+    """Per-trace registry of data-dependent capacity sites.
+
+    A site id is the claim ORDER during lowering — deterministic because
+    lowering walks the fragment DAG identically on every trace of the
+    same plan — so a host-side {site: boost} map survives re-tracing and
+    a retry can double exactly the site that overflowed. Each claimed
+    site must `record` exactly one overflow diagnostic."""
+
+    def __init__(self, boosts: Dict[int, int]):
+        self._boosts = boosts
+        self.labels: List[tuple] = []
+        self.caps: List[Optional[int]] = []
+        self.diags: List[Optional[jnp.ndarray]] = []
+        # OUT_HASH exchange accounting, in exchange order:
+        self.exchanges: List[dict] = []       # static per-exchange meta
+        self.lane_used: List[jnp.ndarray] = []  # traced occupied-slot counts
+
+    def claim(self, label: tuple) -> Tuple[int, int]:
+        i = len(self.labels)
+        self.labels.append(label)
+        self.caps.append(None)
+        self.diags.append(None)
+        return i, self._boosts.get(i, 1)
+
+    def record(self, site: int, diag, cap: Optional[int] = None) -> None:
+        self.diags[site] = diag
+        if cap is not None:
+            self.caps[site] = cap
+
+
+class _CachedProgram:
+    __slots__ = ("fn", "meta")
+
+    def __init__(self):
+        self.fn = None
+        # filled at trace time: n_sites, labels, caps, exchanges, traces
+        self.meta: dict = {"traces": 0}
 
 
 def _all_to_all_batch(b: Batch, n_dev: int, per_cap: int) -> Batch:
+    """Per-plane exchange — the fallback when the lane packer declines the
+    batch (structural columns); one all_to_all per array."""
+
     def a2a(x):
         if x is None:
             return None
@@ -98,6 +183,20 @@ def _all_to_all_batch(b: Batch, n_dev: int, per_cap: int) -> Batch:
     cols = [Column(a2a(c.values), a2a(c.validity), a2a(c.hi))
             for c in b.columns]
     return Batch(b.names, b.types, cols, a2a(b.live), b.dicts)
+
+
+def _fused_all_to_all(bufs, n_dev: int, per_cap: int):
+    """Exchange packed lane buffers: one collective per dtype bucket. Each
+    buffer is [L, n_dev*per_cap]; splitting the folded partition axis and
+    concatenating received chunks on the same axis preserves the (device,
+    partition, slot) addressing the per-plane path uses."""
+    out = []
+    for buf in bufs:
+        nl = buf.shape[0]
+        y = jax.lax.all_to_all(buf.reshape(nl, n_dev, per_cap), WORKERS,
+                               split_axis=1, concat_axis=1, tiled=False)
+        out.append(y.reshape(nl, n_dev * per_cap))
+    return out
 
 
 def _gather_batch(b: Batch) -> Batch:
@@ -116,16 +215,20 @@ class MeshExecutor:
     """Executes SQL over an n-device mesh with collective exchanges."""
 
     def __init__(self, catalog: Catalog, mesh, config: Optional[ExecConfig] = None,
-                 fanout_budget: int = 4, max_retries: int = 3):
+                 fanout_budget: int = 4, max_retries: int = 6):
         self.catalog = catalog
         self.mesh = mesh
         self.n_dev = mesh.shape[WORKERS]
         self.config = config or ExecConfig()
         self.fanout_budget = fanout_budget
         self.max_retries = max_retries
-        # doubled on each MeshOverflow retry; scales every static capacity
-        # (group tables, exchange lanes, join fanout)
-        self._cap_boost = 1
+        # structural program cache: (plan digest, boosts) → compiled
+        # shard_map program + its trace-time site/exchange metadata
+        self._progs: Dict[tuple, _CachedProgram] = {}
+        # observability snapshot of the most recent run_dplan: retries,
+        # per-site boosts (always fresh per run — overflow inflation must
+        # not leak into later queries), and per-attempt site/exchange meta
+        self.last_run: Optional[dict] = None
 
     # -- host-side staging -------------------------------------------------
 
@@ -206,10 +309,54 @@ class MeshExecutor:
         return Batch(names, types, cols,
                      jax.device_put(live.reshape(-1), sharding), dicts)
 
+    # -- engine choice (CBO) -----------------------------------------------
+
+    def _engine_for(self, node: PlanNode) -> str:
+        """Breaker engine for an on-mesh Aggregate/join: the session
+        override, else the CBO thresholds. Stamped on the node (EXPLAIN)
+        and counted on the shared engine-dispatch families. Runs at trace
+        time, so a cached mesh program keeps its engine choice."""
+        from presto_tpu.plan.stats import choose_breaker_engine
+
+        override = getattr(self.config, "breaker_engine", "auto")
+        try:
+            engine, why = choose_breaker_engine(node, self.catalog, override)
+        except Exception:
+            engine, why = "sort", "stats derivation failed"
+        node.__dict__["_breaker_engine"] = engine
+        node.__dict__["_breaker_engine_why"] = why
+        _scan_metrics.record(f"breaker_dispatches_{engine}", 1)
+        return engine
+
+    def _join_engine(self, node, build: Batch):
+        """(engine, probe_dtypes, compare_dtypes) for a HashJoin/SemiJoin.
+        Mirrors the streaming engine's guard (_JoinProber): a build batch
+        whose key dtypes deviate from the plan's output types would
+        mis-encode the hash planes — fall back to the sort engine."""
+        engine = self._engine_for(node)
+        ltypes = dict(node.left.output)
+        probe_dtypes = tuple(
+            jnp.dtype(ltypes[lk].dtype) for lk in node.left_keys)
+        cdt = _join_plan_cdt(node)
+        if engine == "hash" and join_compare_dtypes(
+                build, tuple(node.right_keys), probe_dtypes) != cdt:
+            engine = "sort"
+            node.__dict__["_breaker_engine"] = "sort"
+            node.__dict__["_breaker_engine_why"] = (
+                "build batch dtypes deviate from plan types")
+        return engine, probe_dtypes, cdt
+
+    def _build_table(self, node, build: Batch, engine: str,
+                     probe_dtypes):
+        if engine == "hash":
+            return hash_build_side(build, tuple(node.right_keys),
+                                   probe_dtypes)
+        return build_side(build, tuple(node.right_keys))
+
     # -- trace-time node lowering -----------------------------------------
 
     def _lower_agg(self, node: Aggregate, child: Batch, cap: int,
-                   diags: list) -> Batch:
+                   sites: _SiteTracker, site: int) -> Batch:
         in_types = dict(node.child.output)
         layout = agg_state_layout(node.aggs, in_types)
         lpairs = limb_pairs(layout)
@@ -231,9 +378,11 @@ class MeshExecutor:
                 states.append(StateCol(c.values.astype(st.dtype), c.validity, op))
             else:
                 states.append(_input_state(b, name, op, a, st, in_types))
-        kout, sout, out_live, ng = grouped_merge(keys, states, b.live, cap)
+        engine = self._engine_for(node)
+        kout, sout, out_live, ng = grouped_merge(keys, states, b.live, cap,
+                                                 engine=engine)
         sout = _renorm_limbs(list(sout), lpairs)
-        diags.append(jnp.maximum(ng - cap, 0))
+        sites.record(site, jnp.maximum(ng - cap, 0), cap)
         cols = [Column(k.values, k.validity) for k in kout] + [
             Column(s.values, s.validity if s.op != "count_add" else None)
             for s in sout
@@ -258,7 +407,9 @@ class MeshExecutor:
         columns (LookupJoinOperators.fullOuterJoin's lookup-outer pass).
         Correct on-mesh because the fragmenter never broadcasts a FULL
         join's build side (plan/fragmenter.py:157) — each device owns a
-        disjoint hash partition of the build rows."""
+        disjoint hash partition of the build rows. Engine-agnostic: both
+        BuildTable and HashJoinTable keep the hashes/orig_live/batch
+        shape contract."""
         lsyms = [n for n, _ in node.left.output]
         rsyms = [n for n, _ in node.right.output]
         ltypes = dict(node.left.output)
@@ -279,29 +430,44 @@ class MeshExecutor:
                       if c in table.batch.dicts})
 
     def _expand_pairs(self, probe: Batch, table, pba, lkeys, rkeys,
-                      diags: list):
+                      sites: _SiteTracker, engine: str = "sort", cdt=None):
         """Bounded-fanout pair expansion with overflow accounting — shared
         by joins and residual semijoins so the capacity formula and the
-        MeshOverflow diag protocol can't diverge."""
-        lo, counts, offsets, total, _, _ovf = probe_counts(table, pba, lkeys,
-                                                           rkeys)
-        out_cap = probe.capacity * self.fanout_budget * self._cap_boost
-        pr, bi, ol = probe_expand(table, pba, lkeys, rkeys,
-                                  lo, counts, offsets, 0, out_cap)
-        diags.append(jnp.maximum(total - out_cap, 0))
+        per-site overflow protocol can't diverge. The hash engine claims a
+        SECOND site for the match-matrix width: its surgical replay IS the
+        streaming engine's fanout-widening ladder."""
+        site, boost = sites.claim(("join_out",))
+        out_cap = probe.capacity * self.fanout_budget * boost
+        if engine == "hash":
+            wsite, wboost = sites.claim(("join_fanout",))
+            fanout = 8 * wboost  # pow2 — the probe kernel requires it
+            mm, counts, offsets, total, _, wovf = hash_probe_counts(
+                table, pba, lkeys, cdt, max_fanout_scan=fanout)
+            sites.record(wsite, wovf, fanout)
+            pr, bi, ol = hash_probe_expand(table, mm, counts, offsets,
+                                           0, out_cap)
+        else:
+            lo, counts, offsets, total, _, _ovf = probe_counts(
+                table, pba, lkeys, rkeys)
+            pr, bi, ol = probe_expand(table, pba, lkeys, rkeys,
+                                      lo, counts, offsets, 0, out_cap)
+        sites.record(site, jnp.maximum(total - out_cap, 0), out_cap)
         return pr, bi, ol
 
     def _lower_join(self, node: HashJoin, probe: Batch, build: Batch,
-                    diags: list) -> Batch:
+                    sites: _SiteTracker) -> Batch:
         lsyms = [n for n, _ in node.left.output]
         rsyms = [n for n, _ in node.right.output]
-        table = build_side(build, tuple(node.right_keys))
-        pba = align_probe_strings(probe, tuple(node.left_keys), table,
-                                  tuple(node.right_keys))
+        lkeys, rkeys = tuple(node.left_keys), tuple(node.right_keys)
+        engine, probe_dtypes, cdt = self._join_engine(node, build)
+        table = self._build_table(node, build, engine, probe_dtypes)
+        pba = align_probe_strings(probe, lkeys, table, rkeys)
         build_cap = table.hashes.shape[0]
         if node.build_unique:
-            idx, matched = probe_unique(table, pba, tuple(node.left_keys),
-                                        tuple(node.right_keys))
+            if engine == "hash":
+                idx, matched = hash_probe_unique(table, pba, lkeys, cdt)
+            else:
+                idx, matched = probe_unique(table, pba, lkeys, rkeys)
             out = gather_join_output(
                 probe, table, jnp.arange(probe.capacity, dtype=jnp.int32),
                 idx, probe.live, lsyms, rsyms)
@@ -322,9 +488,8 @@ class MeshExecutor:
                                                                bm))
             return out
         # bounded fanout: one expansion chunk of probe_cap × fanout_budget
-        pr, bi, ol = self._expand_pairs(
-            probe, table, pba, tuple(node.left_keys),
-            tuple(node.right_keys), diags)
+        pr, bi, ol = self._expand_pairs(probe, table, pba, lkeys, rkeys,
+                                        sites, engine, cdt)
         out = gather_join_output(probe, table, pr, bi, ol, lsyms, rsyms)
         if node.kind in ("left", "full"):
             exists = (jnp.zeros(probe.capacity, dtype=jnp.int32)
@@ -347,32 +512,38 @@ class MeshExecutor:
             out = _trace_concat(out, self._build_remainder(node, table, bm))
         return out
 
-    def _lower(self, node: PlanNode, fragments, staged, memo, diags) -> Batch:
+    def _lower(self, node: PlanNode, fragments, staged, memo,
+               sites: _SiteTracker) -> Batch:
         """Per-device local lowering of a fragment subtree."""
         base, chain = collapse_chain(node)
         if chain is not None:
-            return chain(self._lower(base, fragments, staged, memo, diags))
+            return chain(self._lower(base, fragments, staged, memo, sites))
         if isinstance(node, TableScan):
             return staged[id(node)]
         if isinstance(node, RemoteSource):
             return self._lower_exchange(node.fragment_id, fragments, staged,
-                                        memo, diags)
+                                        memo, sites)
         if isinstance(node, Aggregate):
-            child = self._lower(node.child, fragments, staged, memo, diags)
-            cap = self._agg_cap(node)
-            return self._lower_agg(node, child, cap, diags)
+            child = self._lower(node.child, fragments, staged, memo, sites)
+            site, boost = sites.claim(("agg", node.step or "single"))
+            cap = self._agg_cap(node) * boost
+            return self._lower_agg(node, child, cap, sites, site)
         if isinstance(node, HashJoin):
-            probe = self._lower(node.left, fragments, staged, memo, diags)
-            build = self._lower(node.right, fragments, staged, memo, diags)
-            return self._lower_join(node, probe, build, diags)
+            probe = self._lower(node.left, fragments, staged, memo, sites)
+            build = self._lower(node.right, fragments, staged, memo, sites)
+            return self._lower_join(node, probe, build, sites)
         if isinstance(node, SemiJoin):
-            probe = self._lower(node.left, fragments, staged, memo, diags)
-            build = self._lower(node.right, fragments, staged, memo, diags)
+            probe = self._lower(node.left, fragments, staged, memo, sites)
+            build = self._lower(node.right, fragments, staged, memo, sites)
             lkeys, rkeys = tuple(node.left_keys), tuple(node.right_keys)
-            table = build_side(build, rkeys)
+            engine, probe_dtypes, cdt = self._join_engine(node, build)
+            table = self._build_table(node, build, engine, probe_dtypes)
             pba = align_probe_strings(probe, lkeys, table, rkeys)
             if node.residual is None:
-                _, matched = probe_unique(table, pba, lkeys, rkeys)
+                if engine == "hash":
+                    _, matched = hash_probe_unique(table, pba, lkeys, cdt)
+                else:
+                    _, matched = probe_unique(table, pba, lkeys, rkeys)
             else:
                 # correlated EXISTS with non-equi conjuncts (Q21 shape):
                 # bounded pair expansion + residual + per-probe-row ANY —
@@ -383,7 +554,8 @@ class MeshExecutor:
                 rsyms = [n for n, _ in node.right.output]
                 pred = compile_predicate(node.residual)
                 pr, bi, ol = self._expand_pairs(probe, table, pba,
-                                                lkeys, rkeys, diags)
+                                                lkeys, rkeys, sites,
+                                                engine, cdt)
                 pair = gather_join_output(probe, table, pr, bi, ol,
                                           lsyms, rsyms)
                 ok = pred(pair) & pair.live
@@ -406,20 +578,20 @@ class MeshExecutor:
                 keep = matched
             return probe.with_live(probe.live & keep)
         if isinstance(node, Sort):
-            child = self._lower(node.child, fragments, staged, memo, diags)
+            child = self._lower(node.child, fragments, staged, memo, sites)
             return sort_batch(child, _sort_keys(node, child), limit=node.limit)
         if isinstance(node, Limit):
-            child = self._lower(node.child, fragments, staged, memo, diags)
+            child = self._lower(node.child, fragments, staged, memo, sites)
             return limit_batch(child, node.count)
         if isinstance(node, Output):
-            child = self._lower(node.child, fragments, staged, memo, diags)
+            child = self._lower(node.child, fragments, staged, memo, sites)
             return child.select(node.symbols).rename(node.names)
         from presto_tpu.plan.nodes import SetOp, Unnest
 
         if isinstance(node, Unnest):
             from presto_tpu.exec.runtime import unnest_expand
 
-            child = self._lower(node.child, fragments, staged, memo, diags)
+            child = self._lower(node.child, fragments, staged, memo, sites)
             return unnest_expand(node, child)
         if isinstance(node, SetOp) and node.kind == "union":
             from presto_tpu.exec.runtime import (
@@ -427,8 +599,8 @@ class MeshExecutor:
                 _unify_batch_dicts,
             )
 
-            left = self._lower(node.left, fragments, staged, memo, diags)
-            right = self._lower(node.right, fragments, staged, memo, diags)
+            left = self._lower(node.left, fragments, staged, memo, sites)
+            right = self._lower(node.right, fragments, staged, memo, sites)
             left = left.rename(node.symbols)
             right = right.rename(node.symbols)
             left, right = _unify_batch_dicts([left, right])
@@ -446,8 +618,8 @@ class MeshExecutor:
                 _unify_batch_dicts,
             )
 
-            left = self._lower(node.left, fragments, staged, memo, diags)
-            right = self._lower(node.right, fragments, staged, memo, diags)
+            left = self._lower(node.left, fragments, staged, memo, sites)
+            right = self._lower(node.right, fragments, staged, memo, sites)
             left = left.rename(node.symbols)
             right = right.rename(node.symbols)
             left, right = _unify_batch_dicts([left, right])
@@ -460,23 +632,76 @@ class MeshExecutor:
         if isinstance(node, Window):
             from presto_tpu.exec.runtime import build_window_compute
 
-            child = self._lower(node.child, fragments, staged, memo, diags)
+            child = self._lower(node.child, fragments, staged, memo, sites)
             return build_window_compute(node)(child)
         raise NotImplementedError(
             f"mesh executor: {type(node).__name__}")
 
-    def _lower_exchange(self, fid: int, fragments, staged, memo, diags) -> Batch:
+    def _exchange_cap(self, f, out: Batch, boost: int) -> int:
+        """Per-lane row capacity of an OUT_HASH exchange. Stats-sized when
+        the fragmenter stamped an estimate (exchange_lane_rows: uniform
+        rows/n_dev² vs low-NDV concentration, × skew headroom), else the
+        pessimistic capacity//n_dev×2 padding. The site boost doubles it
+        on surgical replay; a lane never needs to exceed the producing
+        batch's own capacity (it can hold every local row), which bounds
+        the replay ladder."""
+        fallback = max(out.capacity // self.n_dev, 128) * 2
+        cap = fallback
+        rows = getattr(f, "est_rows", None)
+        if rows:
+            from presto_tpu.plan.stats import exchange_lane_rows
+
+            est = exchange_lane_rows(rows, getattr(f, "est_key_ndv", None),
+                                     self.n_dev)
+            cap = int(min(max(est, 64.0), float(max(out.capacity, 64))))
+        cap = min(cap * boost, round_up_capacity(out.capacity, minimum=64))
+        return round_up_capacity(cap, minimum=64)
+
+    def _lower_exchange(self, fid: int, fragments, staged, memo,
+                        sites: _SiteTracker) -> Batch:
         if fid in memo:
             return memo[fid]
         f = fragments[fid]
-        out = self._lower(f.root, fragments, staged, memo, diags)
+        out = self._lower(f.root, fragments, staged, memo, sites)
         if f.output_partitioning == OUT_HASH:
-            per_cap = round_up_capacity(
-                max(out.capacity // self.n_dev, 128) * 2 * self._cap_boost)
-            parts, _, ovf = partition_for_exchange(
-                out, list(f.output_keys), self.n_dev, per_cap)
-            diags.append(ovf)
-            out = _all_to_all_batch(parts, self.n_dev, per_cap)
+            site, boost = sites.claim(("exchange", fid))
+            per_cap = self._exchange_cap(f, out, boost)
+            keys = list(f.output_keys)
+            out_n = self.n_dev * per_cap
+            plan = lanes.plan_lanes(out)
+            if plan is not None:
+                sperm, dest, counts, routed, ovf = partition_layout(
+                    out, keys, self.n_dev, per_cap)
+                bufs = lanes.pack_partitioned(out, plan, sperm, dest,
+                                              routed, out_n)
+                bufs = _fused_all_to_all(bufs, self.n_dev, per_cap)
+                exch = lanes.unpack_batch(out, plan, bufs)
+                nbytes = plan.nbytes(out_n) * self.n_dev
+                n_coll = plan.n_collectives
+            else:
+                parts, counts, ovf = partition_for_exchange(
+                    out, keys, self.n_dev, per_cap)
+                exch = _all_to_all_batch(parts, self.n_dev, per_cap)
+                planes = [p for c in parts.columns
+                          for p in (c.values, c.validity, c.hi)
+                          if p is not None] + [parts.live]
+                nbytes = sum(int(p.size) * p.dtype.itemsize
+                             for p in planes) * self.n_dev
+                n_coll = len(planes)
+            sites.record(site, ovf, per_cap)
+            sites.lane_used.append(
+                jnp.sum(jnp.minimum(counts, per_cap)).astype(jnp.int64))
+            sites.exchanges.append({
+                "fid": fid, "site": site, "per_cap": per_cap,
+                "lanes_total": self.n_dev * self.n_dev * per_cap,
+                "bytes": int(nbytes), "a2a": n_coll,
+                "fused": plan is not None,
+                # what the pre-stats sizing rule would have allocated —
+                # bench/tests measure the utilization win against it
+                "naive_per_cap": round_up_capacity(
+                    max(out.capacity // self.n_dev, 128) * 2),
+            })
+            out = exch
         elif f.output_partitioning in (OUT_GATHER, OUT_BROADCAST):
             out = _gather_batch(out)
         elif f.output_partitioning == "rr":
@@ -497,7 +722,7 @@ class MeshExecutor:
         if st is not None and st.rows:
             cap = max(cap, round_up_capacity(
                 int(min(st.rows * 1.25, float(1 << 22)))))
-        return cap * self._cap_boost
+        return cap
 
     # -- entry -------------------------------------------------------------
 
@@ -520,18 +745,98 @@ class MeshExecutor:
         return self.run_dplan(dplan)
 
     def run_dplan(self, dplan: DistributedPlan) -> Batch:
-        """Execute with automatic capacity-doubling retries on overflow
-        (the mesh analog of the streaming engine's growth replay)."""
+        """Execute with surgical per-site overflow replay: a retry doubles
+        ONLY the sites that overflowed. Boosts are local to this call —
+        an overflow on one query must not permanently inflate every later
+        query's capacities (the old executor-level _cap_boost did)."""
+        boosts: Dict[int, int] = {}
+        attempts: List[dict] = []
         last = None
         for _ in range(self.max_retries + 1):
             try:
-                return self._run_dplan_once(dplan)
+                out = self._run_dplan_once(dplan, boosts, attempts)
+                self.last_run = {
+                    "retries": len(attempts) - 1,
+                    "boosts": dict(boosts),
+                    "attempts": attempts,
+                }
+                return out
             except MeshOverflow as e:
                 last = e
-                self._cap_boost *= 2
+                for s in e.sites:
+                    boosts[s] = boosts.get(s, 1) * 2
+                _scan_metrics.record("mesh_exchange_overflow_retries", 1)
+        self.last_run = {"retries": len(attempts) - 1,
+                         "boosts": dict(boosts), "attempts": attempts}
         raise last
 
-    def _run_dplan_once(self, dplan: DistributedPlan) -> Batch:
+    def _dplan_key(self, dplan: DistributedPlan):
+        """Structural digest for the mesh program cache. None (no caching)
+        when a fragment has no canonical codec form."""
+        from presto_tpu.exec.programs import config_fingerprint
+        from presto_tpu.plan.codec import canonical_node_json
+
+        h = hashlib.sha256()
+        h.update(config_fingerprint(self.config).encode())
+        h.update(f"|n={self.n_dev}|fb={self.fanout_budget}".encode())
+        try:
+            for fid in sorted(dplan.fragments):
+                f = dplan.fragments[fid]
+                h.update((f"|{fid}|{f.partitioning}|{f.output_partitioning}"
+                          f"|{','.join(f.output_keys)}|").encode())
+                h.update(canonical_node_json(f.root).encode())
+        except Exception:
+            return None
+        h.update(f"|root={dplan.root_fid}".encode())
+        return h.hexdigest()
+
+    def _build_program(self, dplan, scan_nodes, scan_sharded,
+                       boosts: Dict[int, int]) -> _CachedProgram:
+        fragments = dplan.fragments
+        root = fragments[dplan.root_fid]
+        boosts = dict(boosts)
+        entry = _CachedProgram()
+        meta = entry.meta
+
+        def program(*scan_batches):
+            # the body runs at TRACE time only — meta capture is free on
+            # cached executions
+            meta["traces"] = meta.get("traces", 0) + 1
+            st = {nid: b for nid, b in zip([id(s) for s in scan_nodes],
+                                           scan_batches)}
+            sites = _SiteTracker(boosts)
+            memo: Dict[int, Batch] = {}
+            out = self._lower(root.root, fragments, st, memo, sites)
+            meta["n_sites"] = len(sites.labels)
+            meta["labels"] = list(sites.labels)
+            meta["caps"] = list(sites.caps)
+            meta["exchanges"] = [dict(e) for e in sites.exchanges]
+            diags = [jnp.int64(0) if d is None else d.astype(jnp.int64)
+                     for d in sites.diags]
+            # one psum over the stacked site vector (trailing sentinel 0
+            # keeps the stack non-empty for site-free plans)
+            ovf = jax.lax.psum(jnp.stack(diags + [jnp.int64(0)]), WORKERS)
+            used = jax.lax.psum(
+                jnp.stack(sites.lane_used + [jnp.int64(0)]), WORKERS)
+            return out, ovf, used
+
+        in_specs = tuple(P(WORKERS) if sh else P()
+                         for sh in scan_sharded)
+        # the root fragment is always SINGLE (fragment_plan gathers before
+        # it), so with multiple fragments every device computes an identical
+        # replica; a one-fragment plan is row-sharded and the global view
+        # IS the concatenated result
+        entry.fn = jax.jit(shard_map(
+            program, mesh=self.mesh,
+            in_specs=in_specs,
+            out_specs=(P(WORKERS), P(), P()),
+            check_vma=False,
+        ))
+        return entry
+
+    def _run_dplan_once(self, dplan: DistributedPlan,
+                        boosts: Dict[int, int],
+                        attempts: List[dict]) -> Batch:
         fragments = dplan.fragments
         staged: Dict[int, Batch] = {}
         scan_nodes: List[TableScan] = []
@@ -551,38 +856,66 @@ class MeshExecutor:
         for s, sh in zip(scan_nodes, scan_sharded):
             staged[id(s)] = self._stage_scan(s, sh)
 
-        root = fragments[dplan.root_fid]
-        multi = len(fragments) > 1
+        pkey = self._dplan_key(dplan)
+        key = (None if pkey is None
+               else (pkey, tuple(sorted(boosts.items()))))
+        entry = None if key is None else self._progs.get(key)
+        if entry is None:
+            entry = self._build_program(dplan, scan_nodes, scan_sharded,
+                                        boosts)
+            if key is not None:
+                self._progs[key] = entry
 
-        def program(*scan_batches):
-            st = {nid: b for nid, b in zip([id(s) for s in scan_nodes],
-                                           scan_batches)}
-            diags: list = []
-            memo: Dict[int, Batch] = {}
-            out = self._lower(root.root, fragments, st, memo, diags)
-            ovf = (sum(jax.lax.psum(d, WORKERS) for d in diags)
-                   if diags else jax.lax.psum(jnp.int64(0), WORKERS))
-            return out, ovf
+        out, ovf_vec, used_vec = entry.fn(
+            *[staged[id(s)] for s in scan_nodes])
+        meta = entry.meta
+        n_sites = meta.get("n_sites", 0)
+        ovf = np.asarray(ovf_vec)[:n_sites]
+        exchanges = [dict(e) for e in meta.get("exchanges", ())]
+        used = np.asarray(used_vec)[:len(exchanges)]
 
-        in_specs = tuple(P(WORKERS) if sh else P()
-                         for sh in scan_sharded)
-        # the root fragment is always SINGLE (fragment_plan gathers before
-        # it), so with multiple fragments every device computes an identical
-        # replica; a one-fragment plan is row-sharded and the global view
-        # IS the concatenated result
-        out_spec = P(WORKERS)
-        prog = jax.jit(shard_map(
-            program, mesh=self.mesh,
-            in_specs=in_specs,
-            out_specs=(out_spec, P()),
-            check_vma=False,
-        ))
-        out, ovf = prog(*[staged[id(s)] for s in scan_nodes])
-        if int(ovf) > 0:
+        total_bytes = total_slots = total_used = 0
+        for e, u in zip(exchanges, used):
+            e["lanes_used"] = int(u)
+            e["util"] = (float(u) / e["lanes_total"]
+                         if e["lanes_total"] else 0.0)
+            total_bytes += e["bytes"]
+            total_slots += e["lanes_total"]
+            total_used += int(u)
+        _scan_metrics.record("mesh_exchange_bytes", total_bytes)
+        _scan_metrics.record("mesh_exchange_lanes_used", total_used)
+        _scan_metrics.record("mesh_exchange_lanes_total", total_slots)
+        attempts.append({
+            "labels": list(meta.get("labels", ())),
+            "site_caps": list(meta.get("caps", ())),
+            "exchanges": exchanges,
+            "overflow": [int(v) for v in ovf],
+        })
+
+        bad = {i: int(v) for i, v in enumerate(ovf) if int(v) > 0}
+        if bad:
+            labels = meta.get("labels", [])
+            caps = meta.get("caps", [])
+            desc = ", ".join(
+                f"site {i} {labels[i]} cap={caps[i]} dropped={n}"
+                for i, n in bad.items())
             raise MeshOverflow(
-                f"static capacity overflow ({int(ovf)} rows dropped) — "
-                "raise agg_capacity / fanout_budget")
-        if multi:
+                f"static capacity overflow: {desc}",
+                sites=bad,
+                site_caps={i: caps[i] for i in bad if caps[i] is not None},
+                labels=labels)
+
+        # stamp the exchange telemetry onto the plan for EXPLAIN-style
+        # rendering (DistributedPlan.to_string shows [mesh: …] markers)
+        for e in exchanges:
+            frag = fragments.get(e["fid"])
+            if frag is not None:
+                frag.__dict__["_mesh_a2a"] = {
+                    "a2a": e["a2a"], "bytes": e["bytes"], "util": e["util"],
+                    "per_cap": e["per_cap"], "fused": e["fused"],
+                }
+
+        if len(fragments) > 1:
             # keep the first replica's rows
             from presto_tpu.exec.runtime import _truncate
 

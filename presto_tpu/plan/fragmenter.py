@@ -60,6 +60,11 @@ class Fragment:
     # sink may additionally tag each page with its radix id so the consumer
     # skips the device re-partition sort (partition-aligned exchange)
     radix_align: bool = False
+    # CBO estimates of the fragment's OUTPUT, stamped at cut time
+    # (plan/stats.derive): the mesh executor sizes OUT_HASH exchange lanes
+    # from these instead of padding every lane to capacity//n_dev*2
+    est_rows: Optional[float] = None
+    est_key_ndv: Optional[float] = None
 
     def remote_sources(self) -> List[RemoteSource]:
         out = []
@@ -91,6 +96,15 @@ class DistributedPlan:
                 head += f"({', '.join(f.output_keys)})"
             if f.radix_align:
                 head += " radix_align"
+            if f.est_rows is not None:
+                head += f" ~rows={f.est_rows:.3g}"
+            mesh = getattr(f, "_mesh_a2a", None)
+            if mesh:
+                # stamped by the mesh executor after a run: collectives
+                # issued, global bytes shipped, lane (slot) utilization
+                head += (f" [mesh: a2a={mesh['a2a']}"
+                         f" bytes={mesh['bytes']}"
+                         f" util={100.0 * mesh['util']:.0f}%]")
             parts.append(head + "\n" + plan_to_string(f.root, 1))
         return "\n".join(parts)
 
@@ -215,10 +229,28 @@ class _Fragmenter:
             radix_align: bool = False) -> RemoteSource:
         fid = self._next
         self._next += 1
-        self.fragments[fid] = Fragment(fid, root, partitioning, out_part,
-                                       list(keys or []),
-                                       radix_align=radix_align)
-        return RemoteSource(fid, list(root.output))
+        try:
+            from presto_tpu.plan.stats import combined_key_ndv, derive
+
+            st = derive(root, self.catalog)
+        except Exception:
+            st = None
+        frag = Fragment(fid, root, partitioning, out_part,
+                        list(keys or []), radix_align=radix_align)
+        if st is not None:
+            frag.est_rows = st.rows
+            if keys:
+                frag.est_key_ndv = combined_key_ndv(st, keys)
+        self.fragments[fid] = frag
+        rs = RemoteSource(fid, list(root.output))
+        # a cut is transparent to stats: stamping the producing fragment's
+        # estimate as the RemoteSource's memo lets downstream derivations
+        # (final-agg capacity, breaker engine choice, consumer exchange
+        # sizing) see through the fragment boundary instead of derive()'s
+        # None-on-RemoteSource. strip_runtime_state removes it before the
+        # wire, and codec never serializes underscore state.
+        rs.__dict__["_node_stats"] = st
+        return rs
 
     # returns (node-in-current-fragment, partitioning of current fragment)
     def process(self, node: PlanNode) -> Tuple[PlanNode, str]:

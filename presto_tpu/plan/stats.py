@@ -304,6 +304,55 @@ def _derive(node: PlanNode, catalog) -> Optional[NodeStats]:
 
 
 # ---------------------------------------------------------------------------
+# exchange lane sizing: how many rows the fullest (src device, dst
+# partition) lane of an OUT_HASH exchange must hold. The prototype mesh
+# exchange padded every lane to capacity//n_dev*2 — ICI bytes tracked the
+# batch's padding, not its rows. Stats size the lane instead; under-
+# estimates are safe because the executor's per-site overflow replay
+# (parallel/mesh_exec) doubles exactly the lane that overflowed.
+
+# multiplied onto the per-lane row estimate: absorbs hash placement
+# variance and moderate skew without triggering a replay
+EXCHANGE_SKEW_HEADROOM = 2.0
+
+
+def combined_key_ndv(stats: NodeStats, keys) -> Optional[float]:
+    """Combined NDV of a key tuple: product of per-key NDVs capped by the
+    row count (the reference caps distinct counts by output rows the same
+    way). None when no key has an estimate."""
+    prod, known = 1.0, False
+    for k in keys:
+        cs = stats.col(k)
+        if cs is not None and cs.ndv:
+            prod *= cs.ndv
+            known = True
+    if not known:
+        return None
+    return min(prod, stats.rows) if stats.rows else prod
+
+
+def exchange_lane_rows(rows: float, key_ndv: Optional[float],
+                       n_dev: int) -> float:
+    """Estimated rows in the FULLEST lane of an n_dev-way hash exchange.
+
+    A lane is one (source device, destination partition) bucket: each
+    device holds ~rows/n_dev and splits them n_dev ways, so the uniform
+    expectation is rows/n_dev². Low-NDV keys concentrate load: partition
+    p receives ~ceil(ndv/n_dev) whole keys of ~rows/ndv rows each, of
+    which each source device contributes a 1/n_dev share — the max of the
+    two models sizes the lane, times EXCHANGE_SKEW_HEADROOM."""
+    if rows <= 0:
+        return 1.0
+    if n_dev <= 1:
+        return max(1.0, rows)
+    per_lane = rows / (n_dev * n_dev)
+    if key_ndv and key_ndv > 0:
+        per_part = (rows / key_ndv) * math.ceil(key_ndv / n_dev)
+        per_lane = max(per_lane, per_part / n_dev)
+    return max(1.0, per_lane * EXCHANGE_SKEW_HEADROOM)
+
+
+# ---------------------------------------------------------------------------
 # breaker engine choice: sort-based vs Pallas linear-probing hash table
 # (ops/pallas_hash). The hash engine wins when the group/build table is
 # SMALL and rows hit it repeatedly — each row costs O(probe chain) serial

@@ -26,6 +26,12 @@ _DISPATCH_COUNTER_NAMES = (
     # breaker-engine dispatches (exec/runtime.py): one count per breaker
     # program instantiation, labeled by the CBO's hash-vs-sort choice
     "breaker_dispatches_hash", "breaker_dispatches_sort",
+    # mesh ICI exchange plane (parallel/mesh_exec.py): bytes shipped by
+    # all_to_all, lane slot occupancy vs allocation (utilization =
+    # used/total — a lane-sizing regression shows as the ratio dropping),
+    # and surgical overflow replays
+    "mesh_exchange_bytes", "mesh_exchange_lanes_used",
+    "mesh_exchange_lanes_total", "mesh_exchange_overflow_retries",
 )
 
 _HELP = {
@@ -56,6 +62,18 @@ _HELP = {
     "breaker_dispatches_sort":
         "breaker program instantiations routed to the sort/searchsorted "
         "engine (the default when stats disfavor or preclude hashing)",
+    "mesh_exchange_bytes":
+        "bytes shipped through mesh OUT_HASH exchange collectives "
+        "(all_to_all payload, summed over devices)",
+    "mesh_exchange_lanes_used":
+        "occupied exchange lane row slots (rows actually routed into "
+        "(src device, dst partition) lanes)",
+    "mesh_exchange_lanes_total":
+        "allocated exchange lane row slots (n_dev^2 x per_cap per "
+        "exchange) — used/total is lane utilization",
+    "mesh_exchange_overflow_retries":
+        "mesh query replays triggered by a capacity-site overflow "
+        "(per-site surgical retry, parallel/mesh_exec)",
 }
 
 _lock = threading.Lock()
