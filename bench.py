@@ -316,7 +316,22 @@ def _child(name: str, sf: float, cap_s: float = 0.0):
             "hit_rate": round(snap2["hits"] / lookups, 3) if lookups else 0.0,
             "trace_wall_s": round(snap2["trace_wall_s"], 2),
         },
+        "hbo": _hbo_snapshot(st),
     }), flush=True)
+
+
+def _hbo_snapshot(st):
+    """Runtime-statistics feedback accounting for a bench child record:
+    replay waves paid this query + the process HBO counters."""
+    from presto_tpu.obs import runstats
+    snap = runstats.snapshot()
+    return {
+        "replay_waves": st.get("breaker.replay_waves", 0),
+        "observations": sum(snap["observations"].values()),
+        "would_flip": sum(snap["would_flip"].values()),
+        "corrections": sum(snap["corrections"].values()),
+        "history_entries": len(snap["history"]),
+    }
 
 
 def _mesh_child(n_dev: int, sf: float):

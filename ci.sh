@@ -245,6 +245,69 @@ if [ "$rc" -ne 0 ]; then
   exit "$rc"
 fi
 
+# HBO smoke: a skew-heavy group-by whose static NDV estimate is 10×
+# wrong must pay at least one overflow-replay wave on its first run,
+# then — with history-based correction on — flip to the right engine
+# and presize on run 2 with ZERO replay waves and an explicit
+# "(hbo: observed)" provenance marker in EXPLAIN ANALYZE. The HBO
+# metric rows must also lint clean as an exposition document.
+echo "== hbo smoke: run-2 correction, zero replay waves =="
+env JAX_PLATFORMS=cpu python - <<'PYEOF'
+import os
+import tempfile
+
+import numpy as np
+import pandas as pd
+
+with tempfile.TemporaryDirectory() as d:
+    os.environ["PRESTO_TPU_CACHE_DIR"] = d
+
+    from presto_tpu.catalog.memory import MemoryConnector
+    from presto_tpu.connector import Catalog
+    from presto_tpu.exec import ExecConfig, LocalRunner
+    from presto_tpu.obs import runstats
+    from presto_tpu.obs.exposition import lint_exposition
+    from presto_tpu.server.metrics import render_metrics
+
+    runstats.reset()
+    conn = MemoryConnector()
+    # all-distinct keys grouped through an expression: the exact column
+    # NDV can't see through `k % 100000`, so the estimate is rows*0.1
+    conn.add_table("t", pd.DataFrame({"k": np.arange(6000, dtype=np.int64),
+                                      "v": np.ones(6000, dtype=np.int64)}))
+    cat = Catalog()
+    cat.register("m", conn, default=True)
+    sql = "select k % 100000 as g, sum(v) from m.t group by 1"
+
+    r1 = LocalRunner(cat, ExecConfig(hbo="observe"))
+    txt1 = r1.explain_analyze(sql)
+    w1 = r1.last_stats.get("breaker.replay_waves", 0)
+    assert "drift=10x" in txt1, txt1
+    assert w1 >= 1, r1.last_stats
+
+    r2 = LocalRunner(cat, ExecConfig(hbo="correct"))
+    txt2 = r2.explain_analyze(sql)
+    w2 = r2.last_stats.get("breaker.replay_waves", 0)
+    assert "(hbo: observed)" in txt2, txt2
+    assert w2 == 0, r2.last_stats
+
+    d1 = r1.run(sql).sort_values("g").reset_index(drop=True)
+    d2 = r2.run(sql).sort_values("g").reset_index(drop=True)
+    assert d1.equals(d2)
+
+    errs = lint_exposition(render_metrics(
+        runstats.metric_rows({"plane": "worker"})))
+    assert errs == [], errs
+    corr = runstats.snapshot()["corrections"]
+    print(f"hbo smoke OK: run1 {w1} replay wave(s) observed, run2 0 "
+          f"(corrections: {dict(sorted(corr.items()))})")
+PYEOF
+rc=$?
+if [ "$rc" -ne 0 ]; then
+  echo "hbo smoke FAILED (exit $rc)"
+  exit "$rc"
+fi
+
 # Mesh data-plane smoke: a Q3-shaped join + keyed aggregation over an
 # 8-device CPU mesh must (a) match the local streaming engine's
 # checksum, (b) ride the fused single-buffer exchange path for every

@@ -56,6 +56,9 @@ _VOLATILE_CONFIG_FIELDS = frozenset({
     # per-batch), never what any one program computes; window width only
     # shapes the stacked inputs, which jit keys on dynamically
     "fragment_fusion", "fragment_window",
+    # hbo picks BETWEEN programs (engine keys fork via the @h suffix) and
+    # adjusts capacities (static args), never what one program computes
+    "hbo",
 })
 
 # program cache bound: one entry is one (structure, program key) identity;

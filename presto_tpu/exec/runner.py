@@ -203,4 +203,5 @@ class LocalRunner:
         cfg = _dc.replace(self.config, collect_stats=True)
         ctx = self._new_ctx(cfg)
         run_plan(qp, ctx)
+        self.last_stats = ctx.stats
         return plan_to_string(qp.root, node_stats=ctx.node_stats)

@@ -44,7 +44,8 @@ from presto_tpu.expr.compile import compile_expr, compile_predicate
 from presto_tpu.obs import trace as _obs_trace
 from presto_tpu.expr.ir import Constant, InputRef, substitute_params
 from presto_tpu.expr.structural import StructVal
-from presto_tpu.ops.grouping import KeyCol, StateCol, grouped_merge
+from presto_tpu.ops.grouping import (KeyCol, StateCol, grouped_merge,
+                                     partition_skew)
 from presto_tpu.ops.join import (
     BuildTable,
     align_probe_strings,
@@ -58,6 +59,7 @@ from presto_tpu.ops.join import (
     probe_counts,
     probe_expand,
     probe_unique,
+    table_rows,
 )
 from presto_tpu.ops.sort import (
     SortKey,
@@ -223,6 +225,13 @@ class ExecConfig:
     # "hash" force one engine everywhere (the hash side of the forcing is
     # what the engine-equivalence verifier sweeps run)
     breaker_engine: str = "auto"
+    # history-based optimization (obs/runstats.py): "observe" (default)
+    # records estimate-vs-actual drift at every stats-driven decision site
+    # keyed on structural fingerprints; "correct" additionally feeds
+    # observed values back into engine choice / presize / lane sizing on a
+    # repeat of the same structure; "off" is a strict no-op — the pre-HBO
+    # engine bit-for-bit (no observation syncs, no history writes).
+    hbo: str = "observe"
 
 
 def _node_jit(node: PlanNode, key: str, builder, _shared=True, **jit_kwargs):
@@ -1690,8 +1699,10 @@ def _breaker_engine_choice(node: PlanNode, ctx: "ExecContext",
     from presto_tpu.scan import metrics as _scan_metrics
 
     override = getattr(ctx.config, "breaker_engine", "auto")
+    hbo = getattr(ctx.config, "hbo", "observe")
     try:
-        engine, why = choose_breaker_engine(node, ctx.catalog, override)
+        engine, why = choose_breaker_engine(node, ctx.catalog, override,
+                                            hbo=hbo)
     except Exception:
         engine, why = "sort", "stats derivation failed"
     node.__dict__["_breaker_engine"] = engine
@@ -1700,6 +1711,17 @@ def _breaker_engine_choice(node: PlanNode, ctx: "ExecContext",
         key = f"breaker.engine_{engine}"
         ctx.stats[key] = ctx.stats.get(key, 0) + 1
         _scan_metrics.record(f"breaker_dispatches_{engine}", 1)
+        if "(hbo: observed)" in why:
+            try:
+                from presto_tpu.obs import runstats as _runstats
+                _runstats.record_correction("breaker_engine")
+            except Exception:
+                pass
+        if ctx.tracer.enabled:
+            t = time.time()
+            ctx.tracer.record("breaker_engine", "breaker_engine", t, t,
+                              node=type(node).__name__, engine=engine,
+                              why=why)
     return engine
 
 
@@ -1888,8 +1910,25 @@ def _agg_presize(node: Aggregate, ctx: "ExecContext"):
             _st = _derive_stats(node, ctx.catalog)
         except Exception:
             _st = None
-        if _st is not None and _st.rows:
-            rows = _st.rows
+        rows = _st.rows if (_st is not None and _st.rows) else None
+        if getattr(ctx.config, "hbo", "observe") == "correct":
+            # HBO: a previous run of this structure measured the real
+            # group count — presize from the high-water mark instead of
+            # the NDV estimate (replaces it: shrinking a bloated estimate
+            # is as valid as growing a blind one)
+            try:
+                from presto_tpu.obs import runstats as _runstats
+
+                h = _runstats.lookup_node(node, ctx.catalog, "agg_groups")
+            except Exception:
+                h = None
+            if h and h.get("actual"):
+                rows = float(h["actual"])
+                try:
+                    _runstats.record_correction("agg_presize")
+                except Exception:
+                    pass
+        if rows:
             if ctx.lifespans:
                 # grouped execution: one bucket holds ~1/lifespans of the
                 # groups — size the table for a bucket, not the table
@@ -1961,6 +2000,137 @@ def _record_fragment_dispatch(node: PlanNode, ctx: "ExecContext",
         _scan_metrics.record("batch_dispatches", 1)
 
 
+def _bump_replay_wave(node: PlanNode, ctx: "ExecContext",
+                      hbo_obs: Optional[dict] = None,
+                      cap_to: Optional[int] = None) -> None:
+    """Account one overflow-replay wave: a stats-sized capacity proved too
+    small and a breaker re-merged from a checkpoint at a bigger size.
+    Plain telemetry (ctx.stats + process counter + zero-width span), not
+    gated on hbo — the wave happened regardless of who is watching."""
+    from presto_tpu.scan import metrics as _scan_metrics
+
+    ctx.stats["breaker.replay_waves"] = (
+        ctx.stats.get("breaker.replay_waves", 0) + 1)
+    _scan_metrics.record("breaker_replay_waves", 1)
+    if hbo_obs is not None:
+        hbo_obs["replays"] += 1
+    if ctx.tracer.enabled:
+        t = time.time()
+        attrs = {"node": type(node).__name__}
+        if cap_to is not None:
+            attrs["cap_to"] = cap_to
+        ctx.tracer.record("overflow_replay", "overflow_replay", t, t,
+                          **attrs)
+
+
+def _hbo_record_agg(node: Aggregate, ctx: "ExecContext", obs: dict,
+                    skew: Optional[float] = None) -> None:
+    """Record the aggregate's observed group count into the runstats
+    history (the exact confirmed `ng` the overflow protocol already
+    fetched — no extra device sync), stamp the node for EXPLAIN ANALYZE
+    drift rendering, and count whether the engine choice would flip on
+    the observed value."""
+    if getattr(ctx.config, "hbo", "observe") == "off":
+        return
+    if not node.group_keys or not obs.get("groups"):
+        return
+    try:
+        from presto_tpu.obs import runstats as _runstats
+        from presto_tpu.plan.stats import choose_breaker_engine
+        from presto_tpu.plan.stats import derive as _derive_stats
+
+        fp = _runstats.node_fingerprint(node, ctx.catalog)
+        if fp is None:
+            return
+        try:
+            st = _derive_stats(node, ctx.catalog)
+        except Exception:
+            st = None
+        est = float(st.rows) if (st is not None and st.rows) else None
+        actual = float(obs["groups"])
+        extra = {"replays": int(obs.get("replays", 0))}
+        if skew is not None:
+            extra["skew"] = float(skew)
+        _runstats.observe(fp, "agg_groups", "aggregate", est, actual,
+                          extra=extra)
+        node.__dict__["_runstats"] = {
+            "site": "agg_groups", "est": est, "actual": actual}
+        made = node.__dict__.get("_breaker_engine")
+        if made:
+            would, _ = choose_breaker_engine(
+                node, ctx.catalog,
+                getattr(ctx.config, "breaker_engine", "auto"),
+                hbo="correct")
+            if would != made:
+                _runstats.record_flip("breaker_engine")
+    except Exception:
+        pass
+
+
+def _hbo_fragment_window(node: PlanNode, ctx: "ExecContext") -> int:
+    """Fused-fragment window width: the configured value, shrunk to the
+    observed batch count of the fragment's base scan (hbo=correct, warm
+    history) — stacking an 8-batch window over a source that emits 2
+    batches pushes 6 batches of dead padding through every fused step."""
+    win = max(1, ctx.config.fragment_window)
+    if getattr(ctx.config, "hbo", "observe") != "correct":
+        return win
+    try:
+        from presto_tpu.obs import runstats as _runstats
+
+        base, _ = collapse_chain(node.child)
+        if not isinstance(base, TableScan):
+            return win
+        fp = _runstats.node_fingerprint(base, ctx.catalog)
+        h = _runstats.lookup(fp, "scan_rows") if fp else None
+        if not h or not h.get("actual"):
+            return win
+        batches = -(-int(h["actual"]) // max(1, ctx.config.batch_rows))
+        if 0 < batches < win:
+            _runstats.record_correction("fragment_window")
+            return batches
+    except Exception:
+        pass
+    return win
+
+
+def _hbo_record_scans(root: PlanNode, ctx: "ExecContext") -> None:
+    """Observe per-scan actual rows against the derived estimates
+    (collect_stats runs only — the row counts ride the instrumented
+    stream's existing host sync; an uninstrumented run records nothing
+    rather than adding a sync of its own)."""
+    if getattr(ctx.config, "hbo", "observe") == "off":
+        return
+    if not ctx.config.collect_stats or not ctx.node_stats:
+        return
+    try:
+        from presto_tpu.obs import runstats as _runstats
+        from presto_tpu.plan.stats import derive as _derive_stats
+
+        def walk(n):
+            if isinstance(n, TableScan):
+                rec = ctx.node_stats.get(id(n))
+                if rec and rec.get("rows"):
+                    fp = _runstats.node_fingerprint(n, ctx.catalog)
+                    try:
+                        st = _derive_stats(n, ctx.catalog)
+                    except Exception:
+                        st = None
+                    est = (float(st.rows)
+                           if (st is not None and st.rows) else None)
+                    _runstats.observe(fp, "scan_rows", "tablescan", est,
+                                      float(rec["rows"]))
+                    n.__dict__["_runstats"] = {
+                        "site": "scan_rows", "est": est,
+                        "actual": float(rec["rows"])}
+            for c in n.children():
+                walk(c)
+
+        walk(root)
+    except Exception:
+        pass
+
+
 def _execute_aggregate(node: Aggregate, ctx: ExecContext) -> Iterator[Batch]:
     if ctx.lifespan is None:
         ls = _grouped_execution_lifespans(node)
@@ -2029,6 +2199,9 @@ def _execute_aggregate(node: Aggregate, ctx: ExecContext) -> Iterator[Batch]:
     import threading as _threading
 
     cap, ceiling, can_spill, grace_from_start = _agg_presize(node, ctx)
+    # HBO observation scratchpad: confirmed group-count high-water mark +
+    # overflow-replay waves, recorded once the stream is fully absorbed
+    hbo_obs = {"groups": 0, "replays": 0}
     # whole-fragment fusion gate: static eligibility plus the per-query
     # modes whose ingest must stay per-batch (memory-tight lifespan
     # sweeps pin ~window× the state the mode exists to avoid)
@@ -2116,6 +2289,7 @@ def _execute_aggregate(node: Aggregate, ctx: ExecContext) -> Iterator[Batch]:
         caps = [start_cap] * P
         accs: List[Optional[Batch]] = [None] * P
         rrows = [0] * P
+        part_ng = [0] * P  # confirmed per-partition group counts (host ints)
         afiles: Dict[int, SpillFile] = {}  # spilled accumulator state pages
         rfiles: Dict[int, SpillFile] = {}  # spilled raw (chained) input
 
@@ -2135,9 +2309,11 @@ def _execute_aggregate(node: Aggregate, ctx: ExecContext) -> Iterator[Batch]:
                 n2 = int(ng)
                 if n2 <= caps[p]:
                     accs[p] = out
+                    part_ng[p] = max(part_ng[p], n2)
                     return
                 # acc unchanged on overflow: retry same inputs bigger
                 caps[p] = round_up_capacity(n2)
+                _bump_replay_wave(node, ctx, hbo_obs, cap_to=caps[p])
             raise RuntimeError("aggregate capacity growth exceeded retries")
 
         def _emit(acc):
@@ -2211,6 +2387,10 @@ def _execute_aggregate(node: Aggregate, ctx: ExecContext) -> Iterator[Batch]:
                 if accs[p] is not None:
                     yield _emit(accs[p])
                     accs[p] = None
+            if ctx.lifespans is None:
+                hbo_obs["groups"] = sum(part_ng)
+                _hbo_record_agg(node, ctx, hbo_obs,
+                                skew=partition_skew(rrows))
         finally:
             spilled = (sum(f.bytes for f in afiles.values())
                        + sum(f.bytes for f in rfiles.values()))
@@ -2314,6 +2494,7 @@ def _execute_aggregate(node: Aggregate, ctx: ExecContext) -> Iterator[Batch]:
                 if allow_spill and can_spill and want2 > ceiling:
                     raise _GraceOverflow(entries)
                 cap = want2
+                _bump_replay_wave(node, ctx, hbo_obs, cap_to=cap)
                 for i, (_, b, _) in enumerate(entries):
                     for _ in range(ctx.config.max_growth_retries):
                         acc_before = state["acc"]
@@ -2324,6 +2505,7 @@ def _execute_aggregate(node: Aggregate, ctx: ExecContext) -> Iterator[Batch]:
                         n2 = int(ng2)
                         if n2 <= cap:
                             state["acc"] = out
+                            hbo_obs["groups"] = max(hbo_obs["groups"], n2)
                             break
                         # power-of-two bucketing already gives ≤2× headroom;
                         # doubling on top would 4× the memory footprint
@@ -2341,6 +2523,7 @@ def _execute_aggregate(node: Aggregate, ctx: ExecContext) -> Iterator[Batch]:
                 while window and (block or len(window) > depth):
                     ngi = int(window[0][2])  # usually already on host
                     if ngi <= cap:
+                        hbo_obs["groups"] = max(hbo_obs["groups"], ngi)
                         window.pop(0)
                         continue
                     entries = list(window)
@@ -2448,6 +2631,7 @@ def _execute_aggregate(node: Aggregate, ctx: ExecContext) -> Iterator[Batch]:
                 if can_spill and want2 > ceiling:
                     raise _GraceOverflow(expand(entries))
                 cap = want2
+                _bump_replay_wave(node, ctx, hbo_obs, cap_to=cap)
                 for i, (_, item, _) in enumerate(entries):
                     for _ in range(ctx.config.max_growth_retries):
                         acc_before = state["acc"]
@@ -2455,6 +2639,7 @@ def _execute_aggregate(node: Aggregate, ctx: ExecContext) -> Iterator[Batch]:
                         n2 = int(ng2)
                         if n2 <= cap:
                             state["acc"] = out
+                            hbo_obs["groups"] = max(hbo_obs["groups"], n2)
                             break
                         want2 = round_up_capacity(n2)
                         if can_spill and want2 > ceiling:
@@ -2470,6 +2655,7 @@ def _execute_aggregate(node: Aggregate, ctx: ExecContext) -> Iterator[Batch]:
                 while window and (block or len(window) > depth):
                     ngi = int(window[0][2])
                     if ngi <= cap:
+                        hbo_obs["groups"] = max(hbo_obs["groups"], ngi)
                         window.pop(0)
                         continue
                     entries = list(window)
@@ -2482,7 +2668,7 @@ def _execute_aggregate(node: Aggregate, ctx: ExecContext) -> Iterator[Batch]:
                 return batch_device_bytes(item)
 
             src = _fragment_jit.WindowSource(stream,
-                                             ctx.config.fragment_window)
+                                             _hbo_fragment_window(node, ctx))
             try:
                 for item in src:
                     dispatch(item)
@@ -2530,6 +2716,10 @@ def _execute_aggregate(node: Aggregate, ctx: ExecContext) -> Iterator[Batch]:
                 grace_ingest(in_stream)
 
         if state["spiller"] is None and state["raw_spiller"] is None:
+            if ctx.lifespans is None:
+                # spilled/sweeping runs hold only per-bucket group counts,
+                # which would poison the history as a whole-table total
+                _hbo_record_agg(node, ctx, hbo_obs)
             acc = state["acc"]
             if node.step == "partial":
                 # emit raw state columns for the exchange; no finalization
@@ -3316,6 +3506,7 @@ class _JoinProber:
                 build_in, tuple(node.right_keys)
             )
         self.table = table
+        self._hbo_observe_build()
 
         self.want_full = node.kind == "full"
         build_cap = int(table.hashes.shape[0])
@@ -3438,6 +3629,46 @@ class _JoinProber:
                                  static_argnames=("out_cap",))
         self.jnull = _node_jit(node, "null_extend", lambda: null_extend_fn)
 
+    def _hbo_observe_build(self) -> None:
+        """Observe the build side's actual live row count (one host sync of
+        an already-materialized device scalar) against the CBO's estimate.
+        Whole-build probers only — the radix/spilled drivers hold P probers
+        over per-partition sub-builds whose counts are not table totals."""
+        ctx = self.ctx
+        if getattr(ctx.config, "hbo", "observe") == "off" or self._jkey:
+            return
+        try:
+            from presto_tpu.obs import runstats as _runstats
+            from presto_tpu.plan.stats import choose_breaker_engine
+            from presto_tpu.plan.stats import derive as _derive_stats
+
+            node = self.node
+            fp = _runstats.node_fingerprint(node, ctx.catalog)
+            if fp is None:
+                return
+            actual = float(table_rows(self.table))
+            if actual <= 0:
+                return
+            try:
+                bst = _derive_stats(node.right, ctx.catalog)
+            except Exception:
+                bst = None
+            est = float(bst.rows) if (bst is not None and bst.rows) else None
+            _runstats.observe(fp, "join_build", type(node).__name__.lower(),
+                              est, actual)
+            node.__dict__["_runstats"] = {
+                "site": "join_build", "est": est, "actual": actual}
+            made = node.__dict__.get("_breaker_engine")
+            if made:
+                would, _ = choose_breaker_engine(
+                    node, ctx.catalog,
+                    getattr(ctx.config, "breaker_engine", "auto"),
+                    hbo="correct")
+                if would != made:
+                    _runstats.record_flip("breaker_engine")
+        except Exception:
+            pass
+
     def _counts_program(self, fanout: int):
         """Counting-pass program for one fanout width (jit-cached per
         width: the hash engine's overflow ladder re-probes at doubled
@@ -3514,6 +3745,7 @@ class _JoinProber:
                 if fanout > int(self.table.slot_row.shape[0]):
                     raise RuntimeError(
                         "join fanout exceeded build table capacity")
+                _bump_replay_wave(node, self.ctx, cap_to=fanout)
                 lo, counts, offsets, total, _, ovf = self._counts_program(
                     fanout)(table, pba)
                 ovn = int(ovf)
@@ -3539,6 +3771,15 @@ class _JoinProber:
             key = "join.fanout_overflow_rows"
             self.ctx.stats[key] = self.ctx.stats.get(key, 0) + ovn
             _scan_metrics.record("join_fanout_overflow_rows", ovn)
+            if getattr(self.ctx.config, "hbo", "observe") != "off":
+                try:
+                    from presto_tpu.obs import runstats as _runstats
+
+                    _runstats.note(
+                        _runstats.node_fingerprint(node, self.ctx.catalog),
+                        "join_build", fanout_overflow_rows=ovn)
+                except Exception:
+                    pass
         if node.kind in ("left", "full"):
             yield self.jnull(table, pb, exists_acc)
 
@@ -4588,6 +4829,7 @@ def _run_plan_inner(qp: QueryPlan, ctx: ExecContext) -> Batch:
 
     out_node = qp.root
     batches = list(execute_node(out_node.child, ctx))
+    _hbo_record_scans(qp.root, ctx)
     merged = _collect_concat(iter(batches))
     if merged is None:
         types = dict(out_node.child.output)

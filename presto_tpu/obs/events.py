@@ -33,6 +33,10 @@ class SlowQueryLogger:
         if elapsed < self.threshold_s:
             return
         top: List[dict] = []
+        engines: List[dict] = []
+        lane_util: List[dict] = []
+        replays = 0
+        boosts = 0
         if spans:
             closed = [s for s in spans if s.end is not None]
             closed.sort(key=lambda s: s.duration_s, reverse=True)
@@ -42,6 +46,23 @@ class SlowQueryLogger:
                 if s.attrs:
                     d["attrs"] = s.attrs
                 top.append(d)
+            # breaker/exchange verdict markers (obs/runstats plane): the
+            # CBO choices and replay waves behind a slow query, inline
+            for s in spans:
+                a = s.attrs or {}
+                if s.kind == "breaker_engine":
+                    engines.append({"node": a.get("node"),
+                                    "engine": a.get("engine"),
+                                    "why": a.get("why")})
+                elif s.kind == "exchange_wait" and "util" in a:
+                    lane_util.append({"fid": a.get("fid"),
+                                      "lanesUsed": a.get("lanes_used"),
+                                      "lanesTotal": a.get("lanes_total"),
+                                      "util": a.get("util")})
+                elif s.kind == "overflow_replay":
+                    replays += 1
+                    if a.get("cap_to"):
+                        boosts += 1
         rec = {
             "event": "queryCompleted",
             "ts": time.time(),
@@ -53,6 +74,13 @@ class SlowQueryLogger:
             "error": info.error,
             "topSpans": top,
         }
+        if engines:
+            rec["breakerEngines"] = engines
+        if lane_util:
+            rec["laneUtil"] = lane_util
+        if replays:
+            rec["overflowReplays"] = replays
+            rec["overflowBoosts"] = boosts
         line = json.dumps(rec, default=str)
         with self._lock:
             with open(self.path, "a") as fh:

@@ -130,10 +130,16 @@ COMPILE_TRACE_WALL = Histogram(
     "wall time of one XLA trace+compile event observed by the program "
     "cache (exec/programs.py)",
     log_buckets(0.001, 600.0))
+STATS_DRIFT = Histogram(
+    "presto_tpu_stats_drift_ratio",
+    "observed/estimated ratio at a stats-driven decision site "
+    "(obs/runstats.py; 1.0 = perfect estimate, labeled by operator "
+    "class and decision site)",
+    log_buckets(0.01, 100.0))
 
 ALL_HISTOGRAMS: Tuple[Histogram, ...] = (
     QUERY_LATENCY, TASK_SCHEDULE_DELAY, BATCH_KERNEL_WALL, EXCHANGE_WAIT,
-    RADIX_PARTITION_ROWS, COMPILE_TRACE_WALL)
+    RADIX_PARTITION_ROWS, COMPILE_TRACE_WALL, STATS_DRIFT)
 
 
 def render_histograms(plane: str) -> str:

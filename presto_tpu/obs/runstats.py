@@ -1,0 +1,296 @@
+"""Runtime statistics feedback plane (HBO: history-based optimization).
+
+Every stats-driven decision the engine makes — breaker engine choice,
+aggregate presize, exchange lane capacity, fragment window sizing — runs
+on *static* estimates from plan/stats.py. This module closes the loop:
+execution sites report what they actually saw (group counts the breakers
+already hold, build-side live rows, per-lane exchange occupancy, scan
+rows, overflow-replay waves, partition skew) keyed on the PR 5
+structural fingerprint plus a catalog snapshot token, and the planner
+consults that history on a repeat of the same structure.
+
+Three exposure paths:
+
+  * drift telemetry: every observation with a usable estimate feeds the
+    ``presto_tpu_stats_drift_ratio`` log-bucket histogram (labels:
+    plane, op, site) in obs/metrics.py, plus per-site counters for
+    observations, corrections applied, and decisions-that-would-flip;
+  * EXPLAIN ANALYZE: observing sites stamp ``node._runstats`` which
+    plan_to_string renders as ``[est=… actual=… drift=…x]``, and
+    history-corrected CBO verdicts carry an ``(hbo: observed)`` suffix;
+  * the history store itself: process-wide, and JSONL-persisted under
+    ``$PRESTO_TPU_CACHE_DIR/hbo_history.jsonl`` when that umbrella cache
+    knob is set — one JSON object per line, ``{"fp": fingerprint,
+    "site": site, "est": …, "actual": …, "n": …, …extras}``; the file is
+    append-only and the last line for a (fp, site) pair wins on load.
+
+Merge policy: ``actual`` and all numeric extras merge with max() — the
+consumers are capacity decisions, where the high-water mark is the safe
+correction; ``n`` counts observations. The store is behavior-neutral
+unless the ``hbo`` session property / ExecConfig field asks for it:
+``off`` disables even observation (strict no-op — the pre-HBO engine
+bit-for-bit), ``observe`` (default) records and exposes drift, and
+``correct`` additionally feeds observed values back into the CBO.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+from presto_tpu.obs import metrics as _obs_metrics
+
+_LOCK = threading.Lock()
+_loaded = False
+# (fingerprint, site) -> {"est": float|None, "actual": float, "n": int, ...}
+_history: Dict[Tuple[str, str], Dict[str, Any]] = {}
+_observations: Dict[str, int] = {}
+_would_flip: Dict[str, int] = {}
+_corrections: Dict[str, int] = {}
+# bumped on every history mutation: consumers that bake corrected values
+# into traced programs (the mesh executor) mix this into their cache key
+# so a fresh observation invalidates stale capacities
+_generation = 0
+
+_HISTORY_FILE = "hbo_history.jsonl"
+
+
+def history_path() -> Optional[str]:
+    d = os.environ.get("PRESTO_TPU_CACHE_DIR")
+    if not d:
+        return None
+    return os.path.join(d, _HISTORY_FILE)
+
+
+def catalog_token(catalog) -> str:
+    """Cheap snapshot token for the catalog: connector names, their table
+    lists, and per-table row counts. A history entry is only reusable
+    while the data it was observed against is unchanged; this token is
+    the best effort short of content hashing."""
+    parts: List[str] = []
+    try:
+        for cname in sorted(getattr(catalog, "connectors", {}) or {}):
+            conn = catalog.connectors[cname]
+            try:
+                names = sorted(conn.table_names())
+            except Exception:
+                names = []
+            for t in names:
+                rows = None
+                try:
+                    rows = conn.get_table(t).row_count
+                except Exception:
+                    pass
+                parts.append(f"{cname}.{t}={rows}")
+    except Exception:
+        pass
+    h = hashlib.sha256("|".join(parts).encode()).hexdigest()
+    return h[:12]
+
+
+def node_fingerprint(node, catalog) -> Optional[str]:
+    """History key for a plan node: pure structural sha (reusing the
+    compile plane's ``_program_ns`` stamp when present — its last 16 hex
+    chars are the config fingerprint, which must NOT key history) plus
+    the catalog snapshot token. Memoized on the node."""
+    fp = node.__dict__.get("_hbo_fp")
+    if fp is not None:
+        return fp or None
+    sha = None
+    ns = node.__dict__.get("_program_ns")
+    if isinstance(ns, str) and len(ns) > 16:
+        sha = ns[:-16]
+    if sha is None:
+        try:
+            from presto_tpu.exec.programs import structural_fingerprint
+            sha = structural_fingerprint(node)
+        except Exception:
+            node.__dict__["_hbo_fp"] = ""
+            return None
+    fp = sha[:24] + "/" + catalog_token(catalog)
+    node.__dict__["_hbo_fp"] = fp
+    return fp
+
+
+def _load_locked() -> None:
+    global _loaded
+    if _loaded:
+        return
+    _loaded = True
+    path = history_path()
+    if not path or not os.path.exists(path):
+        return
+    try:
+        with open(path, "r") as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                    fp, site = rec.pop("fp"), rec.pop("site")
+                except Exception:
+                    continue
+                _history[(str(fp), str(site))] = rec
+    except OSError:
+        pass
+
+
+def _persist_locked(fp: str, site: str, ent: Dict[str, Any]) -> None:
+    path = history_path()
+    if not path:
+        return
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "a") as fh:
+            fh.write(json.dumps({"fp": fp, "site": site, **ent}) + "\n")
+    except OSError:
+        pass
+
+
+def observe(fp: Optional[str], site: str, op: str,
+            est: Optional[float], actual: Optional[float],
+            extra: Optional[Dict[str, Any]] = None,
+            plane: str = "worker") -> Optional[Dict[str, Any]]:
+    """Record one estimate-vs-actual observation. Updates the history
+    store (max-merge), appends the merged entry to the JSONL file, and
+    feeds the drift histogram when the estimate is usable."""
+    if fp is None or actual is None:
+        return None
+    actual = float(actual)
+    global _generation
+    with _LOCK:
+        _load_locked()
+        _generation += 1
+        key = (fp, site)
+        ent = _history.get(key)
+        if ent is None:
+            ent = {"est": None, "actual": 0.0, "n": 0}
+            _history[key] = ent
+        if est is not None:
+            ent["est"] = float(est)
+        ent["actual"] = max(float(ent.get("actual") or 0.0), actual)
+        ent["n"] = int(ent.get("n") or 0) + 1
+        for k, v in (extra or {}).items():
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                ent[k] = max(float(ent.get(k) or 0.0), float(v))
+            else:
+                ent[k] = v
+        _observations[site] = _observations.get(site, 0) + 1
+        _persist_locked(fp, site, ent)
+        out = dict(ent)
+    if est is not None and est > 0:
+        _obs_metrics.STATS_DRIFT.observe(
+            actual / float(est), plane=plane, op=op, site=site)
+    return out
+
+
+def note(fp: Optional[str], site: str, **extras: Any) -> None:
+    """Merge extras into an existing/new history entry without recording
+    a drift observation (no estimate involved — e.g. fanout overflow
+    rows discovered mid-probe)."""
+    if fp is None or not extras:
+        return
+    global _generation
+    with _LOCK:
+        _load_locked()
+        _generation += 1
+        key = (fp, site)
+        ent = _history.setdefault(key, {"est": None, "actual": 0.0, "n": 0})
+        for k, v in extras.items():
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                ent[k] = max(float(ent.get(k) or 0.0), float(v))
+            else:
+                ent[k] = v
+        _persist_locked(fp, site, ent)
+
+
+def generation() -> int:
+    """History mutation counter — see the module-state comment."""
+    with _LOCK:
+        return _generation
+
+
+def lookup(fp: Optional[str], site: str) -> Optional[Dict[str, Any]]:
+    if fp is None:
+        return None
+    with _LOCK:
+        _load_locked()
+        ent = _history.get((fp, site))
+        return dict(ent) if ent is not None else None
+
+
+def lookup_node(node, catalog, site: str) -> Optional[Dict[str, Any]]:
+    return lookup(node_fingerprint(node, catalog), site)
+
+
+def record_flip(site: str) -> None:
+    """A decision site, re-evaluated against freshly observed values,
+    would have chosen differently than the static estimate did."""
+    with _LOCK:
+        _would_flip[site] = _would_flip.get(site, 0) + 1
+
+
+def record_correction(site: str) -> None:
+    """A decision site actually used an observed value in place of its
+    static estimate (hbo=correct, warm history)."""
+    with _LOCK:
+        _corrections[site] = _corrections.get(site, 0) + 1
+
+
+_HELP = {
+    "presto_tpu_hbo_observations_total":
+        "runtime estimate-vs-actual observations recorded, by decision site",
+    "presto_tpu_hbo_would_flip_total":
+        "decisions whose observed values would flip the static choice",
+    "presto_tpu_hbo_corrections_total":
+        "decisions that used history-observed values instead of estimates",
+    "presto_tpu_hbo_history_entries":
+        "distinct (fingerprint, site) entries in the HBO history store",
+}
+
+
+def metric_rows(labels: Dict[str, str]) -> List[tuple]:
+    """Rows for server.metrics.render_metrics: per-site HBO counters plus
+    a history-size gauge."""
+    rows: List[tuple] = []
+    with _LOCK:
+        for name, per_site in (
+                ("presto_tpu_hbo_observations_total", _observations),
+                ("presto_tpu_hbo_would_flip_total", _would_flip),
+                ("presto_tpu_hbo_corrections_total", _corrections)):
+            for site in sorted(per_site):
+                rows.append((name, _HELP[name], per_site[site],
+                             {**labels, "site": site}, "counter"))
+        rows.append(("presto_tpu_hbo_history_entries",
+                     _HELP["presto_tpu_hbo_history_entries"],
+                     len(_history), dict(labels), "gauge"))
+    return rows
+
+
+def snapshot() -> Dict[str, Any]:
+    """Test/bench hook: a copy of the full in-memory state."""
+    with _LOCK:
+        return {
+            "history": {f"{fp}|{site}": dict(ent)
+                        for (fp, site), ent in _history.items()},
+            "observations": dict(_observations),
+            "would_flip": dict(_would_flip),
+            "corrections": dict(_corrections),
+        }
+
+
+def reset() -> None:
+    """Test hook: clear in-memory state and force a lazy reload from the
+    JSONL file (if any) on the next lookup/observe."""
+    global _loaded, _generation
+    with _LOCK:
+        _loaded = False
+        _generation += 1
+        _history.clear()
+        _observations.clear()
+        _would_flip.clear()
+        _corrections.clear()

@@ -16,7 +16,19 @@ Span kinds:
   compile          one XLA compile event inside a jitted program
   host_decode      one split's host-side decode (incl. selective cascade)
   device_transfer  host→device upload + readiness of one split's batch
-  exchange_wait    time a consumer spent blocked on a pull exchange
+  exchange_wait    time a consumer spent blocked on a pull exchange; on
+                   the mesh path, one per fused-collective exchange site
+                   with lane occupancy attrs (fid/bytes/lanes_used/util)
+  lane_pack        zero-width marker describing a mesh exchange's packed
+                   lane layout (dtype buckets, collectives, payload bytes)
+  mesh_program     wall time of one fused mesh device program dispatch
+                   (covers every exchange + breaker inside the shard_map)
+  breaker_engine   zero-width marker: the CBO's hash-vs-sort verdict for
+                   one breaker (attrs carry engine + why, incl. HBO
+                   provenance)
+  overflow_replay  zero-width marker: one capacity-regrow / fanout-widen
+                   replay wave a breaker executed (the runtime cost of
+                   estimate error; obs/runstats drives it to zero)
 
 Everything is allocation-light: tracing disabled means every call site
 talks to the module NOOP singleton (`enabled=False` short-circuits before

@@ -541,3 +541,15 @@ def _state_merge_masked(s: StateCol, eq, total: int, num_groups_cap: int):
         nvalid_g = jnp.sum(eq & svalid[None, :], axis=1, dtype=jnp.int32)
     nvalid = jnp.zeros(num_groups_cap, jnp.int32).at[:total].set(nvalid_g)
     return StateCol(agg, nvalid > 0, s.op)
+
+
+def partition_skew(rows) -> float:
+    """Skew factor of a per-partition row distribution: fullest partition
+    over the mean of the non-empty ones (1.0 = perfectly balanced). Host
+    math over already-synced ints — the radix drivers feed it the
+    partition row counters they hold anyway, and obs/runstats stores it
+    as the observed-skew input to future presize decisions."""
+    live = [int(r) for r in rows if int(r) > 0]  # lint: allow(host-sync)
+    if not live:
+        return 1.0
+    return max(live) * len(live) / float(sum(live))  # lint: allow(host-sync)

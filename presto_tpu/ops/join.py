@@ -418,3 +418,11 @@ def gather_join_output(
         if c in table.batch.dicts:
             dicts[out_name] = table.batch.dicts[c]
     return Batch(names, types, cols, out_live, dicts)
+
+
+def table_rows(table) -> int:
+    """Host-synced live row count of a built join table (BuildTable or
+    HashJoinTable — both carry ``n_rows`` as a device scalar). One sync;
+    the HBO observation path calls it after the build phase has already
+    materialized the table, so the transfer is of a ready scalar."""
+    return int(table.n_rows)  # lint: allow(host-sync)

@@ -52,6 +52,16 @@ class LanePlan:
         """Per-device packed bytes for buffers of row capacity `capacity`."""
         return sum(nl * capacity * dt.itemsize for dt, nl in self.buckets)
 
+    def describe(self) -> dict:
+        """Static lane-layout summary — JSON-safe attrs for the mesh
+        executor's lane_pack trace markers."""
+        return {
+            "collectives": self.n_collectives,
+            "lanes": len(self.entries),
+            "dtypes": ",".join(f"{dt.name}x{nl}"
+                               for dt, nl in self.buckets),
+        }
+
 
 def plan_lanes(batch: Batch) -> Optional[LanePlan]:
     """Derive the lane plan for a batch's schema, or None when the batch

@@ -52,6 +52,7 @@ config fingerprint) — the mesh analog of exec/programs.py.
 from __future__ import annotations
 
 import hashlib
+import time
 from typing import Dict, List, Optional, Tuple
 
 import jax
@@ -114,6 +115,7 @@ from presto_tpu.plan.nodes import (
     Window,
 )
 from presto_tpu.exec.runtime import _sort_keys
+from presto_tpu.obs import trace as _obs_trace
 from presto_tpu.scan import metrics as _scan_metrics
 
 
@@ -146,6 +148,10 @@ class _SiteTracker:
         # OUT_HASH exchange accounting, in exchange order:
         self.exchanges: List[dict] = []       # static per-exchange meta
         self.lane_used: List[jnp.ndarray] = []  # traced occupied-slot counts
+        # traced UNCAPPED per-lane row maxima (pmax-reduced): the true lane
+        # capacity this exchange needed — obs/runstats records it against
+        # est_lane_rows so a repeat run sizes lanes from observation
+        self.lane_max: List[jnp.ndarray] = []
 
     def claim(self, label: tuple) -> Tuple[int, int]:
         i = len(self.labels)
@@ -319,13 +325,26 @@ class MeshExecutor:
         from presto_tpu.plan.stats import choose_breaker_engine
 
         override = getattr(self.config, "breaker_engine", "auto")
+        hbo = getattr(self.config, "hbo", "observe")
         try:
-            engine, why = choose_breaker_engine(node, self.catalog, override)
+            engine, why = choose_breaker_engine(node, self.catalog, override,
+                                                hbo=hbo)
         except Exception:
             engine, why = "sort", "stats derivation failed"
         node.__dict__["_breaker_engine"] = engine
         node.__dict__["_breaker_engine_why"] = why
         _scan_metrics.record(f"breaker_dispatches_{engine}", 1)
+        if "(hbo: observed)" in why:
+            try:
+                from presto_tpu.obs import runstats
+                runstats.record_correction("breaker_engine")
+            except Exception:
+                pass
+        tracer = _obs_trace.current()
+        if tracer.enabled:
+            t = time.time()
+            tracer.record("breaker_engine", "breaker_engine", t, t,
+                          node=type(node).__name__, engine=engine, why=why)
         return engine
 
     def _join_engine(self, node, build: Batch):
@@ -637,22 +656,49 @@ class MeshExecutor:
         raise NotImplementedError(
             f"mesh executor: {type(node).__name__}")
 
-    def _exchange_cap(self, f, out: Batch, boost: int) -> int:
-        """Per-lane row capacity of an OUT_HASH exchange. Stats-sized when
-        the fragmenter stamped an estimate (exchange_lane_rows: uniform
-        rows/n_dev² vs low-NDV concentration, × skew headroom), else the
-        pessimistic capacity//n_dev×2 padding. The site boost doubles it
-        on surgical replay; a lane never needs to exceed the producing
-        batch's own capacity (it can hold every local row), which bounds
-        the replay ladder."""
+    def _exchange_fp(self, f) -> str:
+        """obs/runstats history key for an exchange: the producing
+        fragment's root structure + catalog snapshot."""
+        from presto_tpu.obs import runstats
+
+        return runstats.node_fingerprint(f.root, self.catalog)
+
+    def _observed_lane_rows(self, f) -> Optional[float]:
+        """Observed per-lane row maximum from a prior run of the same
+        structure, when hbo=correct and history exists."""
+        if getattr(self.config, "hbo", "observe") != "correct":
+            return None
+        try:
+            from presto_tpu.obs import runstats
+
+            h = runstats.lookup(self._exchange_fp(f), "exchange_lane")
+            if h and h.get("actual"):
+                return float(h["actual"])
+        except Exception:
+            pass
+        return None
+
+    def _exchange_cap(self, f, out: Batch, boost: int,
+                      observed_lane_rows: Optional[float] = None) -> int:
+        """Per-lane row capacity of an OUT_HASH exchange. Observation-sized
+        when hbo=correct and a prior run of the same structure recorded the
+        true lane maximum; else stats-sized when the fragmenter stamped an
+        estimate (exchange_lane_rows: uniform rows/n_dev² vs low-NDV
+        concentration, × skew headroom), else the pessimistic
+        capacity//n_dev×2 padding. The site boost doubles it on surgical
+        replay; a lane never needs to exceed the producing batch's own
+        capacity (it can hold every local row), which bounds the replay
+        ladder."""
         fallback = max(out.capacity // self.n_dev, 128) * 2
         cap = fallback
         rows = getattr(f, "est_rows", None)
-        if rows:
+        if rows or observed_lane_rows is not None:
             from presto_tpu.plan.stats import exchange_lane_rows
 
-            est = exchange_lane_rows(rows, getattr(f, "est_key_ndv", None),
-                                     self.n_dev)
+            est = exchange_lane_rows(rows or 0.0,
+                                     getattr(f, "est_key_ndv", None),
+                                     self.n_dev,
+                                     observed_lane_rows=observed_lane_rows)
             cap = int(min(max(est, 64.0), float(max(out.capacity, 64))))
         cap = min(cap * boost, round_up_capacity(out.capacity, minimum=64))
         return round_up_capacity(cap, minimum=64)
@@ -665,7 +711,14 @@ class MeshExecutor:
         out = self._lower(f.root, fragments, staged, memo, sites)
         if f.output_partitioning == OUT_HASH:
             site, boost = sites.claim(("exchange", fid))
-            per_cap = self._exchange_cap(f, out, boost)
+            obs_rows = self._observed_lane_rows(f)
+            per_cap = self._exchange_cap(f, out, boost, obs_rows)
+            if obs_rows is not None:
+                try:
+                    from presto_tpu.obs import runstats
+                    runstats.record_correction("exchange_lane")
+                except Exception:
+                    pass
             keys = list(f.output_keys)
             out_n = self.n_dev * per_cap
             plan = lanes.plan_lanes(out)
@@ -691,6 +744,11 @@ class MeshExecutor:
             sites.record(site, ovf, per_cap)
             sites.lane_used.append(
                 jnp.sum(jnp.minimum(counts, per_cap)).astype(jnp.int64))
+            sites.lane_max.append(jnp.max(counts).astype(jnp.int64))
+            try:
+                fp = self._exchange_fp(f)
+            except Exception:
+                fp = ""
             sites.exchanges.append({
                 "fid": fid, "site": site, "per_cap": per_cap,
                 "lanes_total": self.n_dev * self.n_dev * per_cap,
@@ -700,6 +758,13 @@ class MeshExecutor:
                 # bench/tests measure the utilization win against it
                 "naive_per_cap": round_up_capacity(
                     max(out.capacity // self.n_dev, 128) * 2),
+                # runstats plane: history key, the pure static estimate
+                # (no boost, no HBO) the drift is measured against, and
+                # whether observation sized this run's lanes
+                "fp": fp,
+                "est_lane_rows": self._exchange_cap(f, out, 1),
+                "hbo_sized": obs_rows is not None,
+                "lane_plan": plan.describe() if plan is not None else None,
             })
             out = exch
         elif f.output_partitioning in (OUT_GATHER, OUT_BROADCAST):
@@ -719,9 +784,22 @@ class MeshExecutor:
             st = derive(node, self.catalog)
         except Exception:
             st = None
-        if st is not None and st.rows:
+        rows = st.rows if (st is not None and st.rows) else None
+        if getattr(self.config, "hbo", "observe") == "correct":
+            # observed group count from a prior run of this structure
+            # (streaming or mesh — the fingerprint space is shared)
+            try:
+                from presto_tpu.obs import runstats
+
+                h = runstats.lookup_node(node, self.catalog, "agg_groups")
+                if h and h.get("actual"):
+                    rows = float(h["actual"])
+                    runstats.record_correction("agg_presize")
+            except Exception:
+                pass
+        if rows:
             cap = max(cap, round_up_capacity(
-                int(min(st.rows * 1.25, float(1 << 22)))))
+                int(min(rows * 1.25, float(1 << 22)))))
         return cap
 
     # -- entry -------------------------------------------------------------
@@ -741,7 +819,8 @@ class MeshExecutor:
             )
 
             bind_scalar_subqueries(qp, ExecContext(self.catalog, self.config))
-        dplan = fragment_plan(qp, self.catalog)
+        dplan = fragment_plan(qp, self.catalog,
+                              hbo=getattr(self.config, "hbo", "observe"))
         return self.run_dplan(dplan)
 
     def run_dplan(self, dplan: DistributedPlan) -> Batch:
@@ -766,6 +845,16 @@ class MeshExecutor:
                 for s in e.sites:
                     boosts[s] = boosts.get(s, 1) * 2
                 _scan_metrics.record("mesh_exchange_overflow_retries", 1)
+                _scan_metrics.record("breaker_replay_waves", 1)
+                tracer = _obs_trace.current()
+                if tracer.enabled:
+                    t = time.time()
+                    tracer.record(
+                        "overflow_replay", "overflow_replay", t, t,
+                        sites=",".join(str(s) for s in sorted(e.sites)),
+                        cap_to=",".join(
+                            str(e.site_caps.get(s, 0) * 2)
+                            for s in sorted(e.sites)))
         self.last_run = {"retries": len(attempts) - 1,
                          "boosts": dict(boosts), "attempts": attempts}
         raise last
@@ -779,6 +868,17 @@ class MeshExecutor:
         h = hashlib.sha256()
         h.update(config_fingerprint(self.config).encode())
         h.update(f"|n={self.n_dev}|fb={self.fanout_budget}".encode())
+        hbo = getattr(self.config, "hbo", "observe")
+        if hbo == "correct":
+            # corrected capacities are baked into the trace; mixing the
+            # history generation in forces a re-trace once new
+            # observations land ("hbo" itself is a volatile config field,
+            # so config_fingerprint alone would collide with observe-mode)
+            try:
+                from presto_tpu.obs import runstats
+                h.update(f"|hbo=c{runstats.generation()}".encode())
+            except Exception:
+                h.update(b"|hbo=c?")
         try:
             for fid in sorted(dplan.fragments):
                 f = dplan.fragments[fid]
@@ -818,7 +918,11 @@ class MeshExecutor:
             ovf = jax.lax.psum(jnp.stack(diags + [jnp.int64(0)]), WORKERS)
             used = jax.lax.psum(
                 jnp.stack(sites.lane_used + [jnp.int64(0)]), WORKERS)
-            return out, ovf, used
+            # pmax, not psum: the lane maximum is a high-water mark — the
+            # worst (src device, dst partition) lane anywhere on the mesh
+            lmax = jax.lax.pmax(
+                jnp.stack(sites.lane_max + [jnp.int64(0)]), WORKERS)
+            return out, ovf, used, lmax
 
         in_specs = tuple(P(WORKERS) if sh else P()
                          for sh in scan_sharded)
@@ -829,7 +933,7 @@ class MeshExecutor:
         entry.fn = jax.jit(shard_map(
             program, mesh=self.mesh,
             in_specs=in_specs,
-            out_specs=(P(WORKERS), P(), P()),
+            out_specs=(P(WORKERS), P(), P(), P()),
             check_vma=False,
         ))
         return entry
@@ -866,17 +970,21 @@ class MeshExecutor:
             if key is not None:
                 self._progs[key] = entry
 
-        out, ovf_vec, used_vec = entry.fn(
+        t0 = time.time()
+        out, ovf_vec, used_vec, lmax_vec = entry.fn(
             *[staged[id(s)] for s in scan_nodes])
         meta = entry.meta
         n_sites = meta.get("n_sites", 0)
         ovf = np.asarray(ovf_vec)[:n_sites]
         exchanges = [dict(e) for e in meta.get("exchanges", ())]
         used = np.asarray(used_vec)[:len(exchanges)]
+        lmax = np.asarray(lmax_vec)[:len(exchanges)]
+        t1 = time.time()
 
         total_bytes = total_slots = total_used = 0
-        for e, u in zip(exchanges, used):
+        for e, u, lm in zip(exchanges, used, lmax):
             e["lanes_used"] = int(u)
+            e["lane_max"] = int(lm)
             e["util"] = (float(u) / e["lanes_total"]
                          if e["lanes_total"] else 0.0)
             total_bytes += e["bytes"]
@@ -891,6 +999,46 @@ class MeshExecutor:
             "exchanges": exchanges,
             "overflow": [int(v) for v in ovf],
         })
+
+        # runstats observation — BEFORE the overflow raise, so even a run
+        # that overflows teaches the next one its true lane maxima
+        if getattr(self.config, "hbo", "observe") != "off":
+            try:
+                from presto_tpu.obs import runstats
+
+                for e in exchanges:
+                    if e.get("fp") and e.get("lane_max", 0) > 0:
+                        runstats.observe(
+                            e["fp"], "exchange_lane", "exchange",
+                            float(e.get("est_lane_rows") or 0.0),
+                            float(e["lane_max"]),
+                            extra={"util": round(e["util"], 4)})
+            except Exception:
+                pass
+
+        # host-side trace spans: the fused program bypasses the tracer
+        # (everything inside shard_map is traced code), so the dispatch
+        # wall is covered by one mesh_program span with per-exchange
+        # exchange_wait markers and lane_pack layout markers under it
+        tracer = _obs_trace.current()
+        if tracer.enabled:
+            sp = tracer.record(
+                "mesh_program", "mesh_program", t0, t1,
+                n_sites=n_sites, exchanges=len(exchanges),
+                traces=meta.get("traces", 0))
+            for e in exchanges:
+                tracer.record(
+                    f"exchange f{e['fid']}", "exchange_wait", t1, t1,
+                    parent_id=sp.span_id, fid=e["fid"], bytes=e["bytes"],
+                    a2a=e["a2a"], per_cap=e["per_cap"],
+                    lanes_used=e["lanes_used"],
+                    lanes_total=e["lanes_total"],
+                    util=round(e["util"], 4))
+                if e.get("lane_plan"):
+                    tracer.record(
+                        f"lane_pack f{e['fid']}", "lane_pack", t1, t1,
+                        parent_id=sp.span_id, fid=e["fid"],
+                        **e["lane_plan"])
 
         bad = {i: int(v) for i, v in enumerate(ovf) if int(v) > 0}
         if bad:

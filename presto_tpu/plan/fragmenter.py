@@ -98,6 +98,8 @@ class DistributedPlan:
                 head += " radix_align"
             if f.est_rows is not None:
                 head += f" ~rows={f.est_rows:.3g}"
+                if getattr(f, "_est_src", None) == "hbo":
+                    head += " (hbo: observed)"
             mesh = getattr(f, "_mesh_a2a", None)
             if mesh:
                 # stamped by the mesh executor after a run: collectives
@@ -206,11 +208,13 @@ class _Fragmenter:
     def _colocated_buckets(self, node) -> int:
         return colocated_buckets(node, self.catalog)
 
-    def __init__(self, catalog, broadcast_threshold_rows: float, stats_fn=None):
+    def __init__(self, catalog, broadcast_threshold_rows: float,
+                 stats_fn=None, hbo: str = "off"):
         self.fragments: Dict[int, Fragment] = {}
         self._next = 0
         self.catalog = catalog
         self.broadcast_threshold = broadcast_threshold_rows
+        self.hbo = hbo
         # optional row-count estimator (CBO hook): node -> Optional[float]
         if stats_fn is None:
             def stats_fn(n, _catalog=catalog):
@@ -241,6 +245,22 @@ class _Fragmenter:
             frag.est_rows = st.rows
             if keys:
                 frag.est_key_ndv = combined_key_ndv(st, keys)
+        if self.hbo == "correct":
+            # history-refined output estimate: a prior run of the same
+            # fragment-root structure recorded its true output row count
+            # (scan_rows for scan chains, agg_groups for breaker roots) —
+            # trust the observation over the static derivation
+            try:
+                from presto_tpu.obs import runstats
+
+                fp = runstats.node_fingerprint(root, self.catalog)
+                h = (runstats.lookup(fp, "scan_rows")
+                     or runstats.lookup(fp, "agg_groups"))
+                if h and h.get("actual"):
+                    frag.est_rows = float(h["actual"])
+                    frag.__dict__["_est_src"] = "hbo"
+            except Exception:
+                pass
         self.fragments[fid] = frag
         rs = RemoteSource(fid, list(root.output))
         # a cut is transparent to stats: stamping the producing fragment's
@@ -471,14 +491,19 @@ def estimate_rows(node: PlanNode, catalog=None) -> Optional[float]:
 
 def fragment_plan(plan: QueryPlan, catalog=None,
                   broadcast_threshold_rows: float = 1_000_000,
-                  stats_fn=None) -> DistributedPlan:
+                  stats_fn=None, hbo: str = "off") -> DistributedPlan:
     """Cut an optimized single-node plan into a distributed fragment DAG.
 
     Scalar subqueries must have been bound first (the coordinator executes
     them before fragmenting, like the reference runs them as separate
     stages feeding semi-join/filter constants).
+
+    `hbo="correct"` lets the cut-time estimates consult the obs/runstats
+    history store: a repeated structure's fragment output estimate comes
+    from the prior run's observation instead of the static derivation
+    (rendered as "(hbo: observed)" in DistributedPlan.to_string).
     """
-    f = _Fragmenter(catalog, broadcast_threshold_rows, stats_fn)
+    f = _Fragmenter(catalog, broadcast_threshold_rows, stats_fn, hbo=hbo)
     out = plan.root
     child, cpart = f.process(out.child)
     if cpart != SINGLE:

@@ -480,6 +480,14 @@ def plan_to_string(node: PlanNode, indent: int = 0, node_stats=None,
     if beng is not None:
         why = node.__dict__.get("_breaker_engine_why")
         s += f"   [engine={beng}{f': {why}' if why else ''}]"
+    rs = node.__dict__.get("_runstats")
+    if rs is not None and node_stats is not None:
+        # estimate-vs-actual drift stamped by obs/runstats observation
+        # sites; EXPLAIN ANALYZE only — plain EXPLAIN stays estimate-land
+        est, actual = rs.get("est"), rs.get("actual")
+        if est and actual:
+            s += (f"   [est={est:.3g} actual={actual:.3g} "
+                  f"drift={actual / est:.2g}x]")
     frag = node.__dict__.get("_fragment_fusion")
     if frag is not None:
         fs = node.__dict__.get("_fragment_stats")

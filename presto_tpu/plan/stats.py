@@ -332,7 +332,8 @@ def combined_key_ndv(stats: NodeStats, keys) -> Optional[float]:
 
 
 def exchange_lane_rows(rows: float, key_ndv: Optional[float],
-                       n_dev: int) -> float:
+                       n_dev: int,
+                       observed_lane_rows: Optional[float] = None) -> float:
     """Estimated rows in the FULLEST lane of an n_dev-way hash exchange.
 
     A lane is one (source device, destination partition) bucket: each
@@ -340,7 +341,14 @@ def exchange_lane_rows(rows: float, key_ndv: Optional[float],
     expectation is rows/n_dev². Low-NDV keys concentrate load: partition
     p receives ~ceil(ndv/n_dev) whole keys of ~rows/ndv rows each, of
     which each source device contributes a 1/n_dev share — the max of the
-    two models sizes the lane, times EXCHANGE_SKEW_HEADROOM."""
+    two models sizes the lane, times EXCHANGE_SKEW_HEADROOM.
+
+    ``observed_lane_rows`` (HBO, runstats history) is a measured fullest-
+    lane high-water mark from a previous run of the same structure: it
+    replaces the model entirely, with modest padding instead of the blind
+    skew headroom."""
+    if observed_lane_rows is not None and observed_lane_rows > 0:
+        return max(1.0, float(observed_lane_rows) * 1.25)
     if rows <= 0:
         return 1.0
     if n_dev <= 1:
@@ -377,13 +385,29 @@ HASH_MAX_KEY_WIDTH = 6
 HASH_MAX_PAYLOAD_STATES = 16
 
 
+def _observed(node: PlanNode, catalog, site: str):
+    """History entry for this node's structural fingerprint, or None.
+    Lazy import: obs/runstats imports obs/metrics only, but keep the CBO
+    importable even if the observability plane is stripped."""
+    try:
+        from presto_tpu.obs import runstats
+        return runstats.lookup_node(node, catalog, site)
+    except Exception:
+        return None
+
+
 def choose_breaker_engine(node: PlanNode, catalog,
-                          override: str = "auto"):
+                          override: str = "auto", hbo: str = "off"):
     """(engine, why) for a pipeline breaker: ``engine`` ∈ {sort, hash}.
 
     ``override`` is the ``breaker_engine`` session property: ``sort`` /
     ``hash`` force the engine; ``auto`` asks the stats above. No stats →
-    sort (never regress the known-good engine on a blind guess)."""
+    sort (never regress the known-good engine on a blind guess).
+
+    ``hbo="correct"`` consults the runstats history first: a previous run
+    of the same structural fingerprint replaces the estimated group /
+    build-row counts with observed ones, and the why string carries an
+    ``(hbo: observed)`` provenance suffix."""
     if override == "sort":
         return "sort", "session breaker_engine=sort"
     if override == "hash":
@@ -395,25 +419,47 @@ def choose_breaker_engine(node: PlanNode, catalog,
             return "sort", f"{len(node.group_keys)} group keys > {HASH_MAX_KEY_WIDTH}"
         if len(node.aggs) > HASH_MAX_PAYLOAD_STATES:
             return "sort", f"{len(node.aggs)} agg states > {HASH_MAX_PAYLOAD_STATES}"
+        groups = None
+        src, suffix = "est", ""
+        if hbo == "correct":
+            h = _observed(node, catalog, "agg_groups")
+            if h and h.get("actual"):
+                groups = float(h["actual"])
+                src, suffix = "observed", " (hbo: observed)"
         st = derive(node, catalog)
         child = derive(node.child, catalog)
-        if st is None or child is None or not st.rows or not child.rows:
-            return "sort", "no stats"
-        groups, rows = st.rows, child.rows
+        if groups is None:
+            if st is None or child is None or not st.rows or not child.rows:
+                return "sort", "no stats"
+            groups = st.rows
+        rows = child.rows if (child is not None and child.rows) else None
+        if rows is None:
+            # observed groups without an input-row estimate: assume enough
+            # duplication that the group-count threshold alone decides
+            rows = groups * HASH_MIN_DUPLICATION
         if groups > HASH_MAX_GROUPS:
-            return "sort", f"est {groups:.3g} groups > {HASH_MAX_GROUPS}"
+            return "sort", f"{src} {groups:.3g} groups > {HASH_MAX_GROUPS}{suffix}"
         dup = rows / max(groups, 1.0)
         if dup < HASH_MIN_DUPLICATION:
-            return "sort", f"duplication x{dup:.2g} < {HASH_MIN_DUPLICATION:.2g}"
-        return "hash", f"est {groups:.3g} groups, x{dup:.3g} duplication"
+            return "sort", f"duplication x{dup:.2g} < {HASH_MIN_DUPLICATION:.2g}{suffix}"
+        return "hash", f"{src} {groups:.3g} groups, x{dup:.3g} duplication{suffix}"
     if isinstance(node, (HashJoin, SemiJoin)):
         keys = node.right_keys
         if len(keys) > HASH_MAX_KEY_WIDTH:
             return "sort", f"{len(keys)} join keys > {HASH_MAX_KEY_WIDTH}"
-        build = derive(node.right, catalog)
-        if build is None or not build.rows:
-            return "sort", "no build-side stats"
-        if build.rows > HASH_MAX_BUILD_ROWS:
-            return "sort", f"est build {build.rows:.3g} rows > {HASH_MAX_BUILD_ROWS}"
-        return "hash", f"est build {build.rows:.3g} rows"
+        build_rows = None
+        src, suffix = "est", ""
+        if hbo == "correct":
+            h = _observed(node, catalog, "join_build")
+            if h and h.get("actual"):
+                build_rows = float(h["actual"])
+                src, suffix = "observed", " (hbo: observed)"
+        if build_rows is None:
+            build = derive(node.right, catalog)
+            if build is None or not build.rows:
+                return "sort", "no build-side stats"
+            build_rows = build.rows
+        if build_rows > HASH_MAX_BUILD_ROWS:
+            return "sort", f"{src} build {build_rows:.3g} rows > {HASH_MAX_BUILD_ROWS}{suffix}"
+        return "hash", f"{src} build {build_rows:.3g} rows{suffix}"
     return "sort", "not an engine-dimensioned breaker"

@@ -32,6 +32,10 @@ _DISPATCH_COUNTER_NAMES = (
     # and surgical overflow replays
     "mesh_exchange_bytes", "mesh_exchange_lanes_used",
     "mesh_exchange_lanes_total", "mesh_exchange_overflow_retries",
+    # runtime-statistics feedback plane (obs/runstats.py): every capacity
+    # regrow / fanout-widening replay a breaker executed — the direct cost
+    # of estimate error that HBO correction exists to eliminate
+    "breaker_replay_waves",
 )
 
 _HELP = {
@@ -74,6 +78,10 @@ _HELP = {
     "mesh_exchange_overflow_retries":
         "mesh query replays triggered by a capacity-site overflow "
         "(per-site surgical retry, parallel/mesh_exec)",
+    "breaker_replay_waves":
+        "overflow-replay waves executed by pipeline breakers (capacity "
+        "regrows and join fanout widenings) — the runtime cost of "
+        "estimate error, driven to zero by hbo=correct on warm structures",
 }
 
 _lock = threading.Lock()

@@ -81,13 +81,15 @@ def worker_metrics(worker) -> str:
     ]
     from presto_tpu.exec import programs as exec_programs
     from presto_tpu.obs import metrics as obs_metrics
+    from presto_tpu.obs import runstats as obs_runstats
     from presto_tpu.scan import metrics as scan_metrics
 
-    # scan + compile counters are process-wide; the plane label keeps the
-    # worker and coordinator expositions of a shared-process cluster
+    # scan + compile + HBO counters are process-wide; the plane label keeps
+    # the worker and coordinator expositions of a shared-process cluster
     # distinguishable (sum over planes double-counts — filter on one)
     rows.extend(scan_metrics.metric_rows({**lbl, "plane": "worker"}))
     rows.extend(exec_programs.metric_rows({**lbl, "plane": "worker"}))
+    rows.extend(obs_runstats.metric_rows({**lbl, "plane": "worker"}))
     return render_metrics(rows) + obs_metrics.render_histograms("worker")
 
 
@@ -109,10 +111,12 @@ def coordinator_metrics(coordinator) -> str:
                  len(coordinator._dplan_cache), None))
     from presto_tpu.exec import programs as exec_programs
     from presto_tpu.obs import metrics as obs_metrics
+    from presto_tpu.obs import runstats as obs_runstats
     from presto_tpu.scan import metrics as scan_metrics
 
     rows.extend(scan_metrics.metric_rows({"plane": "coordinator"}))
     rows.extend(exec_programs.metric_rows({"plane": "coordinator"}))
+    rows.extend(obs_runstats.metric_rows({"plane": "coordinator"}))
     return (render_metrics(rows)
             + obs_metrics.render_histograms("coordinator"))
 

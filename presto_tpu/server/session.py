@@ -216,6 +216,15 @@ class SystemSessionProperties:
                              "sort/hash force one engine", str, "auto",
                              validator=_enum("breaker_engine",
                                              ["AUTO", "SORT", "HASH"])),
+            PropertyMetadata("hbo",
+                             "History-based optimization: off disables even "
+                             "observation (pre-HBO behavior bit-for-bit); "
+                             "observe records estimate-vs-actual drift keyed "
+                             "on structural fingerprints; correct also feeds "
+                             "observed values back into the CBO on a repeat "
+                             "of the same structure", str, "observe",
+                             validator=_enum("hbo",
+                                             ["OFF", "OBSERVE", "CORRECT"])),
         ]
 
     def names(self) -> List[str]:
@@ -329,4 +338,5 @@ class Session:
             fragment_fusion=self.get("fragment_fusion"),
             fragment_window=self.get("fragment_window"),
             breaker_engine=self.get("breaker_engine").lower(),
+            hbo=self.get("hbo").lower(),
         )

@@ -417,3 +417,75 @@ def test_local_runner_trace_and_disable():
     r2 = LocalRunner(cat, ExecConfig(tracing=False))
     r2.run("select count(*) as n from t")
     assert r2.last_trace is None
+
+
+# -- runtime statistics feedback plane (obs/runstats.py) -------------------
+
+
+class TestRunstatsExposition:
+    def test_drift_histogram_is_builtin(self):
+        names = {h.name for h in obs_metrics.ALL_HISTOGRAMS}
+        assert "presto_tpu_stats_drift_ratio" in names
+
+    def test_hbo_families_on_metrics_endpoints(self, cluster):
+        from presto_tpu.obs import runstats
+
+        runstats.observe("fpT/cat", "agg_groups", "aggregate", 2.0, 8.0)
+        for u in ([cluster.coordinator.url]
+                  + [w.url for w in cluster.workers]):
+            with urllib.request.urlopen(f"{u}/v1/metrics", timeout=10) as r:
+                body = r.read().decode()
+            assert lint_exposition(body) == []
+            assert "presto_tpu_hbo_observations_total" in body
+            assert "presto_tpu_hbo_history_entries" in body
+            assert "presto_tpu_stats_drift_ratio_bucket" in body
+            assert "presto_tpu_breaker_replay_waves_total" in body
+
+    def test_mesh_emits_exchange_and_lane_spans(self):
+        from presto_tpu.parallel.mesh import make_mesh
+        from presto_tpu.parallel.mesh_exec import MeshExecutor
+
+        cat = _catalog()
+        mx = MeshExecutor(cat, make_mesh(8), ExecConfig())
+        tr = obs_trace.Tracer()
+        with obs_trace.use(tr):
+            mx.run("select k, sum(v) as s from t group by k")
+        kinds = {s.kind for s in tr.spans()}
+        # PR 9's fused collectives bypass the tracer; the host-side
+        # markers close that wall-time hole
+        assert "mesh_program" in kinds
+        assert "exchange_wait" in kinds
+        assert "lane_pack" in kinds
+        assert "breaker_engine" in kinds
+        ew = next(s for s in tr.spans() if s.kind == "exchange_wait")
+        assert {"fid", "bytes", "lanes_used", "lanes_total",
+                "util"} <= set(ew.attrs)
+        mp = next(s for s in tr.spans() if s.kind == "mesh_program")
+        assert ew.parent_id == mp.span_id
+
+
+def test_slow_query_logger_hbo_fields(tmp_path):
+    p = str(tmp_path / "slow.jsonl")
+    lg = SlowQueryLogger(p, threshold_s=0.0)
+    spans = [
+        obs_trace.Span("s1", None, "breaker_engine", "breaker_engine",
+                       0.0, 0.0, {"node": "Aggregate", "engine": "sort",
+                                  "why": "observed 6e+03 groups"}),
+        obs_trace.Span("s2", None, "exchange f0", "exchange_wait",
+                       0.0, 0.0, {"fid": 0, "lanes_used": 12,
+                                  "lanes_total": 64, "util": 0.1875}),
+        obs_trace.Span("s3", None, "overflow_replay", "overflow_replay",
+                       0.0, 0.0, {"node": "Aggregate", "cap_to": 8192}),
+        obs_trace.Span("s4", None, "overflow_replay", "overflow_replay",
+                       0.0, 0.0, {"node": "HashJoin"}),
+    ]
+    lg.log(_qinfo(qid="q9", elapsed=1.0), spans)
+    with open(p) as fh:
+        rec = json.loads(fh.readlines()[-1])
+    assert rec["breakerEngines"] == [
+        {"node": "Aggregate", "engine": "sort",
+         "why": "observed 6e+03 groups"}]
+    assert rec["laneUtil"] == [
+        {"fid": 0, "lanesUsed": 12, "lanesTotal": 64, "util": 0.1875}]
+    assert rec["overflowReplays"] == 2
+    assert rec["overflowBoosts"] == 1  # only the cap_to-carrying wave
