@@ -271,6 +271,9 @@ def _child(name: str, sf: float, cap_s: float = 0.0):
     # ahead-of-stream precompilation on by default: chain programs trace
     # on a side pool while the scan decodes, shrinking warmup_s
     cfg.setdefault("precompile_workers", 2)
+    # device cost/HBM accounting on for bench children: the roofline block
+    # below needs XLA's per-program analysis; its cost lands in warmup
+    cfg.setdefault("devprof", "on")
     runner = LocalRunner(cat, ExecConfig(batch_rows=1 << 20, **cfg))
     from presto_tpu.exec import programs
     snap0 = programs.snapshot()
@@ -317,7 +320,30 @@ def _child(name: str, sf: float, cap_s: float = 0.0):
             "trace_wall_s": round(snap2["trace_wall_s"], 2),
         },
         "hbo": _hbo_snapshot(st),
+        "roofline": _roofline_snapshot(best),
     }), flush=True)
+
+
+def _roofline_snapshot(wall_s):
+    """Device cost/HBM accounting for a bench child record: call-weighted
+    FLOPs and bytes the timed run dispatched, achieved rates over the best
+    wall time, and the honest device label — on CPU the device block says
+    available=false, so readers know the numbers are XLA static analysis
+    over real wall time, not hardware counters."""
+    from presto_tpu.obs import devprof
+
+    s = devprof.summary(wall_s=wall_s)
+    return {
+        "programs_analyzed": s["programs"],
+        "total_flops": round(s["total_flops"], 1),
+        "total_bytes_accessed": round(s["total_bytes_accessed"], 1),
+        "arithmetic_intensity": (round(s["arithmetic_intensity"], 4)
+                                 if s["arithmetic_intensity"] else None),
+        "achieved_flops_per_s": round(s.get("achieved_flops_per_s", 0.0), 1),
+        "achieved_bytes_per_s": round(s.get("achieved_bytes_per_s", 0.0), 1),
+        "peak_program_footprint_bytes": s["peak_program_footprint_bytes"],
+        "device": s["device"],
+    }
 
 
 def _hbo_snapshot(st):

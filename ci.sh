@@ -354,6 +354,115 @@ if [ "$rc" -ne 0 ]; then
   exit "$rc"
 fi
 
+# Memory observability smoke: a spill-inducing aggregation on a worker
+# with a tiny memory pool must leave a nonzero high-water mark in the
+# coordinator's GET /v1/memory rollup (fed by real worker heartbeats),
+# with the devprof plane honest about device memory on CPU; and the
+# cluster low-memory killer must fail a hog with a structured
+# CLUSTER_OUT_OF_MEMORY error while dumping an oom_forensics.jsonl
+# snapshot under PRESTO_TPU_CACHE_DIR.
+echo "== memory smoke: /v1/memory rollup + structured OOM kill =="
+tmp_cache="$(mktemp -d)"
+env JAX_PLATFORMS=cpu PRESTO_TPU_CACHE_DIR="$tmp_cache" python - <<'PYEOF'
+import json, os, threading, time, urllib.request
+
+import numpy as np
+import pandas as pd
+
+from presto_tpu.catalog.memory import MemoryConnector
+from presto_tpu.connector import Catalog
+from presto_tpu.exec import ExecConfig
+from presto_tpu.server.coordinator import DistributedRunner
+
+rng = np.random.default_rng(7)
+n = 60_000
+facts = pd.DataFrame({
+    "g": rng.integers(0, 20_000, n), "v": rng.normal(size=n)})
+conn = MemoryConnector()
+conn.add_table("facts", facts)
+cat = Catalog()
+cat.register("m", conn, default=True)
+
+dr = DistributedRunner(cat, n_workers=1, config=ExecConfig(
+    batch_rows=1 << 13, memory_pool_bytes=1 << 20, spill_partitions=4,
+    devprof="on"))
+try:
+    df = dr.run_batch(
+        "select g, sum(v) as s, count(*) as c from facts group by g"
+    ).to_pandas()
+    assert len(df) == facts["g"].nunique(), len(df)
+    # the heartbeat prober (2s cadence) carries the pool's high-water
+    # mark + the devprof device doc into the coordinator rollup
+    doc, deadline = {}, time.time() + 20
+    while time.time() < deadline:
+        doc = json.load(urllib.request.urlopen(
+            dr.coordinator.url + "/v1/memory"))
+        if any(nd.get("peakBytes", 0) > 0 for nd in doc["nodes"].values()):
+            break
+        time.sleep(0.25)
+    peaks = {nid: nd["peakBytes"] for nid, nd in doc["nodes"].items()}
+    assert any(p > 0 for p in peaks.values()), doc
+    devdocs = [nd.get("deviceMemory") for nd in doc["nodes"].values()]
+    assert devdocs and all(d is not None for d in devdocs), doc
+    assert all(d.get("available") is False for d in devdocs), devdocs
+finally:
+    dr.coordinator.close()
+    for w in dr.workers:
+        w.close()
+
+# Structured kill: a hog query that sits on memory until the killer
+# fires. QueryManager + ClusterMemoryManager are the exact objects the
+# coordinator wires together; driving update_node/enforce directly makes
+# the heartbeat deterministic instead of cadence-dependent.
+from presto_tpu.server.cluster_memory import ClusterMemoryManager
+from presto_tpu.server.querymanager import FAILED, QueryManager, QueryResult
+from presto_tpu.server.session import Session
+
+release = threading.Event()
+
+
+def execute_fn(session, sql):
+    if "hog" in sql:
+        release.wait(30)
+    return QueryResult(columns=["x"], types=["bigint"], rows=[(1,)])
+
+
+qm = QueryManager(execute_fn)
+cmm = ClusterMemoryManager(limit_bytes=1_000_000, kill_delay_s=0.0)
+try:
+    hog = qm.create_query(Session(), "select hog")
+    deadline = time.time() + 5
+    while hog.state != "RUNNING" and time.time() < deadline:
+        time.sleep(0.01)
+    cmm.update_node("w0", {
+        "memory": {"reservedBytes": 2_000_000, "limitBytes": None,
+                   "peakBytes": 2_000_000},
+        "queryMemory": {hog.query_id: 2_000_000}})
+    cmm.enforce(qm)  # arms the pressure timer
+    assert cmm.enforce(qm) == hog.query_id
+    assert hog.state == FAILED, hog.state
+    assert hog.error_type == "CLUSTER_OUT_OF_MEMORY", hog.error_type
+finally:
+    release.set()
+    qm.close()
+
+fpath = os.path.join(os.environ["PRESTO_TPU_CACHE_DIR"],
+                     "oom_forensics.jsonl")
+assert os.path.exists(fpath), fpath
+rec = json.loads(open(fpath).read().splitlines()[-1])
+assert rec["event"] == "lowMemoryKill" and rec["victim"] == hog.query_id
+assert rec["nodes"]["w0"]["queryMemory"][hog.query_id] == 2_000_000
+print(f"memory smoke OK: peakBytes={max(peaks.values())}, devprof "
+      f"honest-unavailable on CPU, kill={rec['victim']} "
+      f"(CLUSTER_OUT_OF_MEMORY), forensics={os.path.basename(fpath)}")
+PYEOF
+rc=$?
+rm -rf "$tmp_cache"
+if [ "$rc" -ne 0 ]; then
+  echo "memory smoke FAILED (exit $rc)"
+  exit "$rc"
+fi
+
 # Static-analysis step: the kernel lint must be clean over the shipped
 # tree, the analyzer must actually FAIL on an injected violation (a
 # linter that can't fail is decoration), the plan-invariant checker must

@@ -134,7 +134,14 @@ def _flush(pending: List[Batch]) -> WindowItem:
         return pending[0]
     width = _pow2_at_least(k)
     padded = pending + [dead_like(pending[-1])] * (width - k)
-    return Window(stack_batches(padded), k, width, pending[0])
+    w = Window(stack_batches(padded), k, width, pending[0])
+    from presto_tpu.obs import devprof as _devprof
+
+    if _devprof.active():
+        # device-residency accounting: the fused path's staging
+        # high-water is the stacked window, not a single batch
+        _devprof.note_staging(window_device_bytes(w))
+    return w
 
 
 _SENTINEL = object()

@@ -59,6 +59,9 @@ _VOLATILE_CONFIG_FIELDS = frozenset({
     # hbo picks BETWEEN programs (engine keys fork via the @h suffix) and
     # adjusts capacities (static args), never what one program computes
     "hbo",
+    # devprof observes compiles and samples device memory; profile wraps
+    # a query in a jax.profiler capture — neither changes any program
+    "devprof", "profile",
 })
 
 # program cache bound: one entry is one (structure, program key) identity;
@@ -73,10 +76,13 @@ class ProgramEntry:
     accounting shared by every node that maps to it."""
 
     __slots__ = ("jfn", "lock", "seen_cache_size", "compiles",
-                 "compile_wall_s", "calls")
+                 "compile_wall_s", "calls", "fp")
 
-    def __init__(self, jfn):
+    def __init__(self, jfn, fp: Optional[str] = None):
         self.jfn = jfn
+        # registry key for shared entries (None = private): the devprof
+        # plane keys its per-program cost/memory analysis on this
+        self.fp = fp
         self.lock = threading.Lock()
         # last observed jfn._cache_size(): compile detection claims the
         # delta under the lock, so two concurrent callers never double-
@@ -169,7 +175,7 @@ def entry_for(ns: Optional[str], node_kind: str, key: str,
             return e
         # constructing jax.jit() is cheap (no trace happens here), so the
         # critical section stays small even on a miss
-        e = _entries[fp] = ProgramEntry(make())
+        e = _entries[fp] = ProgramEntry(make(), fp=fp)
         _counters["misses"] += 1
         while len(_entries) > _MAX_ENTRIES:
             _entries.popitem(last=False)
@@ -196,6 +202,7 @@ def wrap(entry: ProgramEntry, node_stats: Dict[str, float],
     shared) entry. Compile events are detected via jit-cache-size growth
     and claimed under the entry lock — exact under concurrency — and
     attributed to the node whose call triggered them."""
+    from presto_tpu.obs import devprof as _devprof
     from presto_tpu.obs import trace as _obs_trace
 
     jfn = entry.jfn
@@ -226,6 +233,17 @@ def wrap(entry: ProgramEntry, node_stats: Dict[str, float],
             if tr.enabled:
                 tr.record("compile", "compile", w0, w0 + dt,
                           node=node_kind, key=key)
+            if _devprof.active():
+                # the program just compiled for these concrete args:
+                # lower once more for its XLA cost/memory analysis
+                try:
+                    _devprof.on_compile(entry, node_kind, key, args, kw,
+                                        node_stats=node_stats)
+                except Exception:
+                    pass
+        if _devprof.active():
+            _devprof.on_call(entry, node_kind, key, args, kw,
+                             node_stats=node_stats)
         return out
 
     wrapped._entry = entry  # introspection hook for tests / EXPLAIN

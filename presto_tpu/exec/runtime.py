@@ -232,6 +232,16 @@ class ExecConfig:
     # repeat of the same structure; "off" is a strict no-op — the pre-HBO
     # engine bit-for-bit (no observation syncs, no history writes).
     hbo: str = "observe"
+    # device cost & HBM accounting plane (obs/devprof.py): "on" records
+    # XLA cost_analysis/memory_analysis per compiled program, samples
+    # device.memory_stats() watermarks, and reconciles them against the
+    # MemoryPool ledger; "off" (default) is a strict no-op — no extra
+    # lowering, no sampler thread, today's engine bit-for-bit.
+    devprof: str = "off"
+    # on-demand jax.profiler capture for this query's execution, dumped
+    # under PRESTO_TPU_CACHE_DIR (no-op with a warning when the profiler
+    # or the cache dir is unavailable)
+    profile: bool = False
 
 
 def _node_jit(node: PlanNode, key: str, builder, _shared=True, **jit_kwargs):
@@ -2051,6 +2061,13 @@ def _hbo_record_agg(node: Aggregate, ctx: "ExecContext", obs: dict,
         extra = {"replays": int(obs.get("replays", 0))}
         if skew is not None:
             extra["skew"] = float(skew)
+        if getattr(ctx.config, "devprof", "off") == "on" \
+                and ctx.memory_pool is not None \
+                and getattr(ctx.memory_pool, "peak", 0):
+            # devprof plane: the ledger's high-water so far rides the
+            # fingerprint into history — ROADMAP item-3 spill sizing
+            # reads it back as peak_bytes on a structure repeat
+            extra["peak_bytes"] = float(ctx.memory_pool.peak)
         _runstats.observe(fp, "agg_groups", "aggregate", est, actual,
                           extra=extra)
         node.__dict__["_runstats"] = {
@@ -4755,6 +4772,10 @@ def install_plan_programs(root: PlanNode, ctx: ExecContext) -> None:
     every structure-mutating pass (subquery binding, colocation tagging,
     fragment decode)."""
     _programs.install_plan(root, ctx.config)
+    if getattr(ctx.config, "devprof", "off") == "on":
+        from presto_tpu.obs import devprof as _devprof
+
+        _devprof.activate()
     try:
         _mark_fragment_fusion(root, ctx.config)
     except Exception:
@@ -4804,7 +4825,24 @@ def _mark_fragment_fusion(root: PlanNode, config: ExecConfig) -> None:
 def run_plan(qp: QueryPlan, ctx: ExecContext) -> Batch:
     """Execute a QueryPlan to a single host-collectable Batch."""
     with _obs_trace.use(ctx.tracer), ctx.tracer.span("query", "query"):
-        return _run_plan_inner(qp, ctx)
+        if getattr(ctx.config, "devprof", "off") != "on":
+            return _run_plan_inner(qp, ctx)
+        # devprof plane: HBM watermarks at the query span boundaries plus
+        # a ledger-vs-device reconciliation once the query's pool peak is
+        # final (obs/devprof.py; activate happens at plan install)
+        from presto_tpu.obs import devprof as _devprof
+
+        _devprof.activate()
+        _devprof.sample_hbm(tag="query_start")
+        try:
+            return _run_plan_inner(qp, ctx)
+        finally:
+            _devprof.sample_hbm(tag="query_end")
+            try:
+                _devprof.reconcile(ctx.memory_pool, plane="worker",
+                                   site="local_query")
+            except Exception:
+                pass
 
 
 def _run_plan_inner(qp: QueryPlan, ctx: ExecContext) -> Batch:

@@ -18,6 +18,7 @@ cluster OOM-killer policy).
 from __future__ import annotations
 
 import threading
+import time
 from typing import Callable, List, Optional
 
 
@@ -61,6 +62,7 @@ class MemoryPool:
             if revokers:
                 # MemoryRevokingScheduler: revoke until usage ≤ target
                 target = int(self.limit * self.revoke_target)
+                before, t0 = self.reserved, time.time()
                 for fn in revokers:
                     if self.reserved + bytes_ <= target:
                         break
@@ -68,6 +70,7 @@ class MemoryPool:
                         fn(self.reserved + bytes_ - target)
                     except Exception:
                         pass
+                self._trace_revoke(before, bytes_, target, t0)
             with self._lock:
                 if self.reserved + bytes_ > self.limit:
                     raise ExceededMemoryLimit(
@@ -81,6 +84,24 @@ class MemoryPool:
             with self._lock:
                 self.reserved += bytes_
                 self.peak = max(self.peak, self.reserved)
+
+    def _trace_revoke(self, before: int, requested: int, target: int,
+                      t0: float) -> None:
+        """Memory pressure as a structured trace event: a reserve()
+        crossed the revoke threshold and asked revokers to spill. Rides
+        the thread-local tracer (no-op when tracing is off)."""
+        try:
+            from presto_tpu.obs import trace as _obs_trace
+
+            tr = _obs_trace.current()
+            if tr.enabled:
+                tr.record("memory_revoke", "memory_revoke", t0, time.time(),
+                          reserved_before=int(before),
+                          reserved_after=int(self.reserved),
+                          requested=int(requested), target=int(target),
+                          limit=int(self.limit or 0))
+        except Exception:
+            pass
 
     def free(self, bytes_: int) -> None:
         if bytes_ <= 0:

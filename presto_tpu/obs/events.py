@@ -26,15 +26,21 @@ class SlowQueryLogger:
         self.top_k = top_k
         self._lock = threading.Lock()
 
-    def log(self, info, spans: Optional[list] = None) -> None:
+    def log(self, info, spans: Optional[list] = None,
+            memory: Optional[dict] = None) -> None:
         """`info` is a querymanager.QueryInfo; `spans` the query's trace
-        spans (obs.trace.Span), when tracing captured any."""
+        spans (obs.trace.Span), when tracing captured any; `memory` an
+        optional devprof-plane doc (per-query peak/footprint bytes +
+        device stats) folded into the record."""
         elapsed = max(0.0, (info.end_time or time.time()) - info.create_time)
         if elapsed < self.threshold_s:
             return
         top: List[dict] = []
         engines: List[dict] = []
         lane_util: List[dict] = []
+        revokes = 0
+        revoked_bytes = 0
+        kills: List[dict] = []
         replays = 0
         boosts = 0
         if spans:
@@ -63,6 +69,15 @@ class SlowQueryLogger:
                     replays += 1
                     if a.get("cap_to"):
                         boosts += 1
+                elif s.kind == "memory_revoke":
+                    # devprof plane: memory pressure behind a slow query
+                    revokes += 1
+                    before = a.get("reserved_before") or 0
+                    after = a.get("reserved_after") or 0
+                    revoked_bytes += max(0, int(before) - int(after))
+                elif s.kind == "memory_kill":
+                    kills.append({"reason": a.get("reason"),
+                                  "forensics": a.get("forensics")})
         rec = {
             "event": "queryCompleted",
             "ts": time.time(),
@@ -81,6 +96,14 @@ class SlowQueryLogger:
         if replays:
             rec["overflowReplays"] = replays
             rec["overflowBoosts"] = boosts
+        if revokes:
+            rec["memoryRevokes"] = revokes
+            rec["memoryRevokedBytes"] = revoked_bytes
+        if kills:
+            rec["memoryKills"] = kills
+        if memory:
+            # peak/footprint fields from the devprof memory rollup
+            rec["memory"] = memory
         line = json.dumps(rec, default=str)
         with self._lock:
             with open(self.path, "a") as fh:

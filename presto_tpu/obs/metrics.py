@@ -136,10 +136,16 @@ STATS_DRIFT = Histogram(
     "(obs/runstats.py; 1.0 = perfect estimate, labeled by operator "
     "class and decision site)",
     log_buckets(0.01, 100.0))
+LEDGER_DRIFT = Histogram(
+    "presto_tpu_memory_ledger_drift_ratio",
+    "device-reported peak HBM bytes over the MemoryPool ledger's "
+    "self-reported peak (obs/devprof.py reconciliation; 1.0 = the "
+    "accounting matches the hardware, labeled by reconciliation site)",
+    log_buckets(0.01, 100.0))
 
 ALL_HISTOGRAMS: Tuple[Histogram, ...] = (
     QUERY_LATENCY, TASK_SCHEDULE_DELAY, BATCH_KERNEL_WALL, EXCHANGE_WAIT,
-    RADIX_PARTITION_ROWS, COMPILE_TRACE_WALL, STATS_DRIFT)
+    RADIX_PARTITION_ROWS, COMPILE_TRACE_WALL, STATS_DRIFT, LEDGER_DRIFT)
 
 
 def render_histograms(plane: str) -> str:

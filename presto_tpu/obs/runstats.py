@@ -39,6 +39,7 @@ import hashlib
 import json
 import os
 import threading
+import time
 from typing import Any, Dict, List, Optional, Tuple
 
 from presto_tpu.obs import metrics as _obs_metrics
@@ -56,6 +57,18 @@ _corrections: Dict[str, int] = {}
 _generation = 0
 
 _HISTORY_FILE = "hbo_history.jsonl"
+
+# TTL / size bounds for the JSONL history (the file is append-only and
+# last-line-wins, so it grows without these): entries older than the
+# max age are dropped on load, the newest max-entries survive, and a
+# badly bloated file (many superseded lines per live entry) is rewritten
+# compacted in place. `python -m presto_tpu.obs.runstats --compact`
+# forces the rewrite.
+_MAX_AGE_S = float(os.environ.get("PRESTO_TPU_HBO_MAX_AGE_S",
+                                  30 * 86400))
+_MAX_ENTRIES = int(os.environ.get("PRESTO_TPU_HBO_MAX_ENTRIES", 10000))
+# rewrite-on-load trigger: superseded lines per live entry
+_COMPACT_BLOAT_RATIO = 4
 
 
 def history_path() -> Optional[str]:
@@ -115,7 +128,8 @@ def node_fingerprint(node, catalog) -> Optional[str]:
     return fp
 
 
-def _load_locked() -> None:
+def _load_locked(max_age_s: Optional[float] = None,
+                 max_entries: Optional[int] = None) -> None:
     global _loaded
     if _loaded:
         return
@@ -123,18 +137,58 @@ def _load_locked() -> None:
     path = history_path()
     if not path or not os.path.exists(path):
         return
+    max_age_s = _MAX_AGE_S if max_age_s is None else max_age_s
+    max_entries = _MAX_ENTRIES if max_entries is None else max_entries
+    lines = 0
+    now = time.time()
     try:
         with open(path, "r") as fh:
             for line in fh:
                 line = line.strip()
                 if not line:
                     continue
+                lines += 1
                 try:
                     rec = json.loads(line)
                     fp, site = rec.pop("fp"), rec.pop("site")
                 except Exception:
                     continue
+                # max-age compaction: stale observations (old data
+                # distributions) must not correct tomorrow's queries;
+                # ts-less records predate the TTL stamp — keep them
+                ts = rec.get("ts")
+                if max_age_s and isinstance(ts, (int, float)) \
+                        and now - float(ts) > max_age_s:
+                    _history.pop((str(fp), str(site)), None)
+                    continue
                 _history[(str(fp), str(site))] = rec
+    except OSError:
+        pass
+    if max_entries and len(_history) > max_entries:
+        # newest (by ts; ts-less sorts oldest) survive the entry cap
+        keys = sorted(_history,
+                      key=lambda k: float(_history[k].get("ts") or 0.0))
+        for k in keys[:len(_history) - max_entries]:
+            del _history[k]
+    if lines > max(len(_history) * _COMPACT_BLOAT_RATIO, 1024):
+        # the append-only file carries far more superseded lines than
+        # live entries — rewrite it compacted while we hold the lock
+        _rewrite_locked()
+
+
+def _rewrite_locked() -> None:
+    """Rewrite the JSONL file as exactly one line per live entry (atomic
+    replace, same discipline as the connectors' atomic writes)."""
+    path = history_path()
+    if not path:
+        return
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as fh:
+            for (fp, site), ent in _history.items():
+                fh.write(json.dumps({"fp": fp, "site": site, **ent}) + "\n")
+        os.replace(tmp, path)
     except OSError:
         pass
 
@@ -143,6 +197,7 @@ def _persist_locked(fp: str, site: str, ent: Dict[str, Any]) -> None:
     path = history_path()
     if not path:
         return
+    ent["ts"] = round(time.time(), 3)
     try:
         os.makedirs(os.path.dirname(path), exist_ok=True)
         with open(path, "a") as fh:
@@ -294,3 +349,60 @@ def reset() -> None:
         _observations.clear()
         _would_flip.clear()
         _corrections.clear()
+
+
+def compact(max_age_s: Optional[float] = None,
+            max_entries: Optional[int] = None) -> Dict[str, Any]:
+    """Force a TTL/size compaction of the JSONL history: reload with the
+    given bounds (defaults: the module TTL knobs) and rewrite the file
+    as one line per surviving entry. Returns what happened."""
+    global _loaded, _generation
+    path = history_path()
+    lines_before = 0
+    if path and os.path.exists(path):
+        try:
+            with open(path, "r") as fh:
+                lines_before = sum(1 for ln in fh if ln.strip())
+        except OSError:
+            pass
+    with _LOCK:
+        _history.clear()
+        _loaded = False
+        _load_locked(max_age_s=max_age_s, max_entries=max_entries)
+        _generation += 1
+        _rewrite_locked()
+        kept = len(_history)
+    return {"path": path, "lines_before": lines_before, "entries": kept}
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    """``python -m presto_tpu.obs.runstats --compact`` — operator-facing
+    history maintenance (TTL expiry + file rewrite)."""
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="python -m presto_tpu.obs.runstats",
+        description="HBO history store maintenance "
+                    "($PRESTO_TPU_CACHE_DIR/hbo_history.jsonl)")
+    ap.add_argument("--compact", action="store_true",
+                    help="drop entries past the TTL/size bounds and "
+                         "rewrite the JSONL one line per live entry")
+    ap.add_argument("--max-age-s", type=float, default=None,
+                    help=f"entry TTL in seconds (default {_MAX_AGE_S:g})")
+    ap.add_argument("--max-entries", type=int, default=None,
+                    help=f"entry cap, newest win (default {_MAX_ENTRIES})")
+    args = ap.parse_args(argv)
+    if not args.compact:
+        ap.print_help()
+        return 2
+    if history_path() is None:
+        print("no history: PRESTO_TPU_CACHE_DIR is not set")
+        return 1
+    res = compact(max_age_s=args.max_age_s, max_entries=args.max_entries)
+    print(f"compacted {res['path']}: {res['lines_before']} lines -> "
+          f"{res['entries']} entries")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -29,6 +29,16 @@ Span kinds:
   overflow_replay  zero-width marker: one capacity-regrow / fanout-widen
                    replay wave a breaker executed (the runtime cost of
                    estimate error; obs/runstats drives it to zero)
+  memory_revoke    one memory-pressure event: a pool reserve() crossed
+                   the revoke threshold and drove revokers toward the
+                   target (attrs: reserved before/after, request, limit)
+  memory_kill      zero-width marker on the victim query's trace: the
+                   cluster low-memory killer failed it with
+                   CLUSTER_OUT_OF_MEMORY (attrs point at the forensics
+                   snapshot dumped by server/cluster_memory.py)
+  hbm_sample       zero-width device memory watermark sample at a span
+                   boundary (obs/devprof.py; attrs carry bytes_in_use /
+                   peak or an honest available=false reason on CPU)
 
 Everything is allocation-light: tracing disabled means every call site
 talks to the module NOOP singleton (`enabled=False` short-circuits before

@@ -969,6 +969,21 @@ class MeshExecutor:
                                         boosts)
             if key is not None:
                 self._progs[key] = entry
+            from presto_tpu.obs import devprof as _devprof
+
+            if _devprof.active():
+                # devprof plane: analyze the whole-mesh program once on
+                # build (the lowering is cheap; the compile the analysis
+                # forces is the same one the first call pays anyway)
+                try:
+                    lowered = entry.fn.lower(
+                        *[staged[id(s)] for s in scan_nodes])
+                    rec = _devprof.analyze_lowered(lowered)
+                    _devprof.record_program(
+                        f"mesh|{pkey or 'uncached'}", rec,
+                        kind="mesh_program", key=len(scan_nodes))
+                except Exception:
+                    pass
 
         t0 = time.time()
         out, ovf_vec, used_vec, lmax_vec = entry.fn(

@@ -85,7 +85,7 @@ class DistributedPlan:
     root_fid: int
     output_names: List[str]
 
-    def to_string(self) -> str:
+    def to_string(self, node_stats=None) -> str:
         from presto_tpu.plan.nodes import plan_to_string
 
         parts = []
@@ -107,7 +107,8 @@ class DistributedPlan:
                 head += (f" [mesh: a2a={mesh['a2a']}"
                          f" bytes={mesh['bytes']}"
                          f" util={100.0 * mesh['util']:.0f}%]")
-            parts.append(head + "\n" + plan_to_string(f.root, 1))
+            parts.append(head + "\n"
+                         + plan_to_string(f.root, 1, node_stats=node_stats))
         return "\n".join(parts)
 
 

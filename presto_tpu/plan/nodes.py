@@ -516,6 +516,7 @@ def plan_to_string(node: PlanNode, indent: int = 0, node_stats=None,
                   f"execute={max(0.0, st['wall_s'] - cwall):.2f}s")
             s += _shape_headroom(node, jstats, shape_budgets)
         s += "]"
+        s += _devprof_annotation(jstats)
     elif jstats:
         # an executed node renders its recompile profile even without the
         # EXPLAIN ANALYZE stats map: distinct programs × compiled shapes
@@ -526,10 +527,37 @@ def plan_to_string(node: PlanNode, indent: int = 0, node_stats=None,
             s += (f"   [programs={len(jstats)}, compiles={compiles}, "
                   f"compile_wall={cwall:.2f}s"
                   f"{_shape_headroom(node, jstats, shape_budgets)}]")
+        s += _devprof_annotation(jstats)
     return s + "".join(
         "\n" + plan_to_string(c, indent + 1, node_stats, shape_budgets)
         for c in node.children()
     )
+
+
+def _devprof_annotation(jstats) -> str:
+    """'   [peak=… flops=… bytes=… ai=…]' — XLA's own cost/memory analysis
+    of the node's compiled programs, stamped into _jit_stats by the
+    obs/devprof plane (devprof=on only; off renders nothing, keeping the
+    pre-devprof output bit-for-bit). ai = flops per byte accessed — the
+    roofline x-axis."""
+    if not jstats:
+        return ""
+    flops = sum(v.get("flops", 0.0) for v in jstats.values())
+    byts = sum(v.get("bytes_accessed", 0.0) for v in jstats.values())
+    peak = max((v.get("footprint_bytes", 0.0) for v in jstats.values()),
+               default=0.0)
+    if not (flops or byts or peak):
+        return ""
+    parts = []
+    if peak:
+        parts.append(f"peak={int(peak):,}")
+    if flops:
+        parts.append(f"flops={flops:.4g}")
+    if byts:
+        parts.append(f"bytes={byts:.4g}")
+    if flops and byts:
+        parts.append(f"ai={flops / byts:.2f}")
+    return "   [" + " ".join(parts) + "]"
 
 
 def _shape_headroom(node, jstats, shape_budgets) -> str:

@@ -8,14 +8,20 @@ TotalReservationLowMemoryKiller / TotalReservationOnBlockedNodesLowMemoryKiller.
 
 TPU-native shape: workers already announce their status on a heartbeat;
 the status document now carries per-query reserved bytes (HBM accounting
-is exact — fixed-capacity device arrays). The coordinator aggregates
-those reports here and, when the cluster is out of memory, fails the
-query with the largest relevant reservation with a structured
-CLUSTER_OUT_OF_MEMORY error while smaller queries keep running.
+is exact — fixed-capacity device arrays) plus the pool's peak and the
+devprof plane's device memory doc. The coordinator aggregates those
+reports here and, when the cluster is out of memory, fails the query
+with the largest relevant reservation with a structured
+CLUSTER_OUT_OF_MEMORY error while smaller queries keep running — and
+dumps a forensics snapshot (every per-query reservation on every node at
+kill time) as JSONL under PRESTO_TPU_CACHE_DIR so the kill is
+diagnosable after the fact.
 """
 
 from __future__ import annotations
 
+import json
+import os
 import threading
 import time
 from typing import Dict, Optional
@@ -24,20 +30,28 @@ from typing import Dict, Optional
 class NodeMemory:
     """One worker's last-reported memory state (MemoryPoolInfo analog)."""
 
-    __slots__ = ("reserved", "limit", "queries", "at")
+    __slots__ = ("reserved", "peak", "limit", "queries", "device", "at",
+                 "blocked_threshold")
 
     def __init__(self, reserved: int, limit: Optional[int],
-                 queries: Dict[str, int], at: float):
+                 queries: Dict[str, int], at: float,
+                 peak: int = 0, device: Optional[dict] = None,
+                 blocked_threshold: float = 0.95):
         self.reserved = reserved
+        self.peak = peak
         self.limit = limit
         self.queries = queries
+        self.device = device
         self.at = at
+        self.blocked_threshold = blocked_threshold
 
     @property
     def blocked(self) -> bool:
         """A node whose pool is (nearly) exhausted blocks further reserves
-        (the reference's blocked-nodes signal for the OOM killer)."""
-        return self.limit is not None and self.reserved >= 0.95 * self.limit
+        (the reference's blocked-nodes signal for the OOM killer). The
+        threshold is the manager's `blocked_node_threshold` knob."""
+        return (self.limit is not None
+                and self.reserved >= self.blocked_threshold * self.limit)
 
 
 class ClusterMemoryManager:
@@ -51,18 +65,33 @@ class ClusterMemoryManager:
     or when any worker pool is blocked (its local limit is the binding
     constraint) — each after `kill_delay_s` of sustained pressure, so a
     transient spike between heartbeats doesn't kill a healthy query.
+    `blocked_node_threshold` is the pool-fullness fraction at which a
+    node counts as blocked (previously a hardcoded 0.95).
     """
 
     def __init__(self, limit_bytes: Optional[int] = None,
                  policy: str = "total-reservation-on-blocked",
-                 kill_delay_s: float = 1.0, stale_s: float = 30.0):
+                 kill_delay_s: float = 1.0, stale_s: float = 30.0,
+                 blocked_node_threshold: float = 0.95,
+                 forensics_dir: Optional[str] = None,
+                 trace_registry=None):
         if policy not in ("total-reservation",
                          "total-reservation-on-blocked", "none"):
             raise ValueError(f"unknown low-memory killer policy {policy!r}")
+        if not 0.0 < blocked_node_threshold <= 1.0:
+            raise ValueError(
+                f"blocked_node_threshold must be in (0, 1], got "
+                f"{blocked_node_threshold!r}")
         self.limit_bytes = limit_bytes
         self.policy = policy
         self.kill_delay_s = kill_delay_s
         self.stale_s = stale_s
+        self.blocked_node_threshold = blocked_node_threshold
+        # OOM forensics sink: explicit dir, else the umbrella cache dir
+        self.forensics_dir = forensics_dir
+        # optional obs.trace.TraceRegistry: a kill stamps a memory_kill
+        # span onto the victim's query trace
+        self.trace_registry = trace_registry
         self.kills = 0
         self._nodes: Dict[str, NodeMemory] = {}
         self._pressure_since: Optional[float] = None
@@ -79,6 +108,9 @@ class ClusterMemoryManager:
                 {str(q): int(b) for q, b in
                  (status.get("queryMemory") or {}).items()},
                 time.monotonic(),
+                peak=int(mem.get("peakBytes") or 0),
+                device=status.get("deviceMemory"),
+                blocked_threshold=self.blocked_node_threshold,
             )
 
     def drop_node(self, node_id: str):
@@ -103,8 +135,46 @@ class ClusterMemoryManager:
                 "totalReservedBytes": sum(n.reserved for n in nodes.values()),
                 "clusterLimitBytes": self.limit_bytes,
                 "blockedNodes": [nid for nid, n in nodes.items() if n.blocked],
+                "blockedNodeThreshold": self.blocked_node_threshold,
                 "queryMemory": by_query,
                 "lowMemoryKills": self.kills,
+            }
+
+    def memory_rollup(self) -> dict:
+        """The `GET /v1/memory` document (MemoryPoolInfo rollup analog):
+        per-node pools (reserved/peak/limit + device stats) + per-query
+        slices + the cluster view."""
+        with self._lock:
+            nodes = self._fresh_nodes()
+            node_docs = {}
+            for nid, nm in sorted(nodes.items()):
+                doc = {
+                    "reservedBytes": nm.reserved,
+                    "peakBytes": nm.peak,
+                    "limitBytes": nm.limit,
+                    "blocked": nm.blocked,
+                    "queryMemory": dict(nm.queries),
+                }
+                if nm.device is not None:
+                    doc["deviceMemory"] = nm.device
+                node_docs[nid] = doc
+            by_query: Dict[str, int] = {}
+            for nm in nodes.values():
+                for q, b in nm.queries.items():
+                    by_query[q] = by_query.get(q, 0) + b
+            return {
+                "cluster": {
+                    "totalReservedBytes": sum(
+                        n.reserved for n in nodes.values()),
+                    "peakReservedBytes": sum(n.peak for n in nodes.values()),
+                    "clusterLimitBytes": self.limit_bytes,
+                    "blockedNodes": [nid for nid, n in nodes.items()
+                                     if n.blocked],
+                    "blockedNodeThreshold": self.blocked_node_threshold,
+                    "lowMemoryKills": self.kills,
+                },
+                "nodes": node_docs,
+                "queryMemory": by_query,
             }
 
     # -- enforcement -------------------------------------------------------
@@ -120,6 +190,68 @@ class ClusterMemoryManager:
                 by_query[q] = by_query.get(q, 0) + b
         return [q for q, _ in sorted(by_query.items(),
                                      key=lambda kv: -kv[1])]
+
+    def _forensics_path(self) -> Optional[str]:
+        d = self.forensics_dir or os.environ.get("PRESTO_TPU_CACHE_DIR")
+        if not d:
+            return None
+        return os.path.join(d, "oom_forensics.jsonl")
+
+    def _dump_forensics(self, victim: str, nodes: Dict[str, NodeMemory],
+                        total: int, blocked: list) -> Optional[str]:
+        """One JSONL record per kill: every per-query reservation on every
+        node at kill time — the post-mortem the reference attaches to
+        CLUSTER_OUT_OF_MEMORY. Best-effort by contract."""
+        path = self._forensics_path()
+        if not path:
+            return None
+        rec = {
+            "event": "lowMemoryKill",
+            "ts": time.time(),
+            "victim": victim,
+            "totalReservedBytes": total,
+            "clusterLimitBytes": self.limit_bytes,
+            "blockedNodes": blocked,
+            "blockedNodeThreshold": self.blocked_node_threshold,
+            "nodes": {
+                nid: {
+                    "reservedBytes": nm.reserved,
+                    "peakBytes": nm.peak,
+                    "limitBytes": nm.limit,
+                    "blocked": nm.blocked,
+                    "queryMemory": dict(nm.queries),
+                    **({"deviceMemory": nm.device}
+                       if nm.device is not None else {}),
+                }
+                for nid, nm in sorted(nodes.items())
+            },
+        }
+        try:
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            with open(path, "a") as fh:
+                fh.write(json.dumps(rec, default=str) + "\n")
+            return path
+        except OSError:
+            return None
+
+    def _trace_kill(self, victim: str, forensics: Optional[str],
+                    total: int, blocked: list) -> None:
+        """Stamp a memory_kill span on the victim's query trace so the
+        kill shows up in /v1/query/{id}/trace and the slow-query log."""
+        reg = self.trace_registry
+        if reg is None:
+            return
+        try:
+            tr = reg.get(victim)
+            if tr is not None and tr.enabled:
+                t = time.time()
+                tr.record("memory_kill", "memory_kill", t, t,
+                          reason="CLUSTER_OUT_OF_MEMORY",
+                          total_reserved_bytes=int(total),
+                          blocked_nodes=list(blocked),
+                          forensics=forensics)
+        except Exception:
+            pass
 
     def enforce(self, query_manager) -> Optional[str]:
         """One enforcement pass (call on the heartbeat cadence). Returns
@@ -159,6 +291,8 @@ class ClusterMemoryManager:
                 continue
             if qe.done:
                 continue
+            forensics = self._dump_forensics(victim, nodes, total, blocked)
+            self._trace_kill(victim, forensics, total, blocked)
             qe.fail(
                 "Query killed because the cluster is out of memory. "
                 "Please try again in a few minutes.",

@@ -21,6 +21,7 @@ through the pull exchange.
 from __future__ import annotations
 
 import json
+import os
 import re
 import threading
 import time
@@ -707,6 +708,7 @@ class Coordinator:
                  cluster_memory_limit_bytes: Optional[int] = None,
                  low_memory_killer: str = "total-reservation-on-blocked",
                  low_memory_kill_delay_s: float = 1.0,
+                 blocked_node_threshold: float = 0.95,
                  access_control=None, tls=None,
                  slow_query_log: Optional[str] = None,
                  slow_query_threshold_s: float = 0.0):
@@ -727,7 +729,8 @@ class Coordinator:
         self.node_manager = NodeManager()
         self.cluster_memory = ClusterMemoryManager(
             cluster_memory_limit_bytes, policy=low_memory_killer,
-            kill_delay_s=low_memory_kill_delay_s)
+            kill_delay_s=low_memory_kill_delay_s,
+            blocked_node_threshold=blocked_node_threshold)
         self.failure_detector = HeartbeatFailureDetector(
             self.node_manager, cluster_memory=self.cluster_memory)
         self.size_monitor = ClusterSizeMonitor(self.node_manager, min_workers)
@@ -751,6 +754,9 @@ class Coordinator:
         # query-id → Tracer; /v1/query/{id}/trace and the UI drill-down
         # resolve from here (scheduler attempt ids alias to the same trace)
         self.trace_registry = _obs_trace.TraceRegistry()
+        # a low-memory kill stamps a memory_kill span onto the victim's
+        # trace (registry exists only now — created after the manager)
+        self.cluster_memory.trace_registry = self.trace_registry
 
         def _record_latency(event: str, info):
             if event != "queryCompleted":
@@ -776,7 +782,29 @@ class Coordinator:
                 if event != "queryCompleted":
                     return
                 tr = self.trace_registry.get(info.query_id)
-                _s.log(info, tr.spans() if tr is not None else None)
+                mem = None
+                try:
+                    # devprof plane: fold the query's memory picture into
+                    # the slow-query record — its cluster-ledger slice plus
+                    # the device's own numbers when the plane is on
+                    from presto_tpu.obs import devprof as _devprof
+
+                    doc = {}
+                    roll = self.cluster_memory.memory_rollup()
+                    qb = (roll.get("queryMemory") or {}).get(info.query_id)
+                    if qb:
+                        doc["reservedBytes"] = qb
+                    if _devprof.active():
+                        doc["device"] = _devprof.device_memory_doc()
+                        s = _devprof.summary()
+                        if s.get("peak_program_footprint_bytes"):
+                            doc["peakProgramFootprintBytes"] = \
+                                s["peak_program_footprint_bytes"]
+                    mem = doc or None
+                except Exception:
+                    mem = None
+                _s.log(info, tr.spans() if tr is not None else None,
+                       memory=mem)
 
             self.query_manager.listeners.append(_log_slow)
         if query_event_log:
@@ -886,6 +914,23 @@ class Coordinator:
                                  f" compile={cw:.3f}s"
                                  f" execute="
                                  f"{max(0.0, row['wall_s'] - cw):.3f}s")
+                    fl = float(row.get("flops") or 0.0)
+                    ba = float(row.get("bytes_accessed") or 0.0)
+                    pk = float(row.get("peak_bytes") or 0.0)
+                    if fl or ba or pk:
+                        # devprof plane: XLA's own cost/memory analysis of
+                        # the operator's compiled programs (ai = flops per
+                        # byte moved — the roofline x-axis)
+                        parts = []
+                        if pk:
+                            parts.append(f"peak={int(pk):,}")
+                        if fl:
+                            parts.append(f"flops={fl:.4g}")
+                        if ba:
+                            parts.append(f"bytes={ba:.4g}")
+                        if fl and ba:
+                            parts.append(f"ai={fl / ba:.2f}")
+                        line += " [" + " ".join(parts) + "]"
                     lines.append(line)
         return "\n".join(lines)
 
@@ -1016,6 +1061,11 @@ class Coordinator:
                         "totalQueries": len(qs),
                         "memory": coord.cluster_memory.info(),
                     })
+                if self.path == "/v1/memory":
+                    # cluster memory rollup (MemoryPoolInfo REST analog):
+                    # per-node reserved/peak/limit + device stats + the
+                    # per-query slices the low-memory killer ranks on
+                    return self._json(coord.cluster_memory.memory_rollup())
                 if self.path == "/v1/metrics":
                     from presto_tpu.server.metrics import coordinator_metrics
 
@@ -1264,10 +1314,65 @@ class Coordinator:
         for r in roots:
             walk(r)
 
+    def _profile_capture(self, session):
+        """Context manager for the `profile` session property: a
+        jax.profiler trace per query under PRESTO_TPU_CACHE_DIR/profiles/
+        <query_id>, surfaced as profileUri in the statement response.
+        No-op with a warning when the profiler or cache dir is
+        unavailable — the query still runs."""
+        import contextlib
+        import warnings
+
+        qid = getattr(session, "query_id", "") or "adhoc"
+        base = os.environ.get("PRESTO_TPU_CACHE_DIR")
+        cm = None
+        pdir = None
+        if not base:
+            warnings.warn("profile=true is a no-op: PRESTO_TPU_CACHE_DIR "
+                          "is not set", stacklevel=3)
+        else:
+            try:
+                import jax.profiler as _prof
+
+                pdir = os.path.join(base, "profiles", qid)
+                os.makedirs(pdir, exist_ok=True)
+                cm = _prof.trace(pdir)
+            except Exception as e:
+                warnings.warn("profile=true is a no-op: jax profiler "
+                              f"unavailable ({e})", stacklevel=3)
+                cm = None
+
+        @contextlib.contextmanager
+        def run():
+            if cm is None:
+                yield
+                return
+            try:
+                with cm:
+                    yield
+            finally:
+                try:
+                    from presto_tpu.obs import devprof as _devprof
+
+                    _devprof.register_profile(qid, pdir)
+                except Exception:
+                    pass
+
+        return run()
+
     def run_batch(self, sql: str, config: Optional[ExecConfig] = None,
                   session=None, stmt=None) -> Batch:
         """`stmt` overrides parsing — the bound AST of a prepared
         statement (EXECUTE path; no SQL re-rendering)."""
+        cfg = config or self.config
+        if getattr(cfg, "profile", False):
+            with self._profile_capture(session):
+                return self._run_batch_traced(sql, config, session, stmt)
+        return self._run_batch_traced(sql, config, session, stmt)
+
+    def _run_batch_traced(self, sql: str,
+                          config: Optional[ExecConfig] = None,
+                          session=None, stmt=None) -> Batch:
         cfg = config or self.config
         if not getattr(cfg, "tracing", True):
             return self._run_batch_inner(sql, config, session, stmt)

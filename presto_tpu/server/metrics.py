@@ -80,6 +80,7 @@ def worker_metrics(worker) -> str:
          st["spillCount"], lbl),
     ]
     from presto_tpu.exec import programs as exec_programs
+    from presto_tpu.obs import devprof as obs_devprof
     from presto_tpu.obs import metrics as obs_metrics
     from presto_tpu.obs import runstats as obs_runstats
     from presto_tpu.scan import metrics as scan_metrics
@@ -90,6 +91,7 @@ def worker_metrics(worker) -> str:
     rows.extend(scan_metrics.metric_rows({**lbl, "plane": "worker"}))
     rows.extend(exec_programs.metric_rows({**lbl, "plane": "worker"}))
     rows.extend(obs_runstats.metric_rows({**lbl, "plane": "worker"}))
+    rows.extend(obs_devprof.metric_rows({**lbl, "plane": "worker"}))
     return render_metrics(rows) + obs_metrics.render_histograms("worker")
 
 
@@ -110,6 +112,7 @@ def coordinator_metrics(coordinator) -> str:
     rows.append(("presto_tpu_plan_cache_entries", "cached distributed plans",
                  len(coordinator._dplan_cache), None))
     from presto_tpu.exec import programs as exec_programs
+    from presto_tpu.obs import devprof as obs_devprof
     from presto_tpu.obs import metrics as obs_metrics
     from presto_tpu.obs import runstats as obs_runstats
     from presto_tpu.scan import metrics as scan_metrics
@@ -117,6 +120,7 @@ def coordinator_metrics(coordinator) -> str:
     rows.extend(scan_metrics.metric_rows({"plane": "coordinator"}))
     rows.extend(exec_programs.metric_rows({"plane": "coordinator"}))
     rows.extend(obs_runstats.metric_rows({"plane": "coordinator"}))
+    rows.extend(obs_devprof.metric_rows({"plane": "coordinator"}))
     return (render_metrics(rows)
             + obs_metrics.render_histograms("coordinator"))
 

@@ -352,6 +352,16 @@ class StatementProtocol:
                 ),
             },
         }
+        try:
+            # `profile` session property: the captured jax.profiler trace
+            # directory for this query, when one was recorded
+            from presto_tpu.obs import devprof as _devprof
+
+            pdir = _devprof.profile_for(qe.query_id)
+            if pdir:
+                out["profileUri"] = f"file://{pdir}"
+        except Exception:
+            pass
         if qe.state == FAILED:
             # user mistakes (parse/analysis/session/admission) are USER_ERROR,
             # everything else INTERNAL (reference: StandardErrorCode types)

@@ -225,6 +225,21 @@ class SystemSessionProperties:
                              "of the same structure", str, "observe",
                              validator=_enum("hbo",
                                              ["OFF", "OBSERVE", "CORRECT"])),
+            # device cost/HBM accounting plane (obs/devprof.py)
+            PropertyMetadata("devprof",
+                             "Device cost & HBM accounting: off reproduces "
+                             "pre-devprof behavior bit-for-bit; on records "
+                             "XLA cost/memory analysis per compiled program, "
+                             "samples the device HBM watermark, and "
+                             "reconciles it against the memory-pool ledger",
+                             str, "OFF",
+                             validator=_enum("devprof", ["OFF", "ON"])),
+            PropertyMetadata("profile",
+                             "Capture a jax.profiler trace per query under "
+                             "PRESTO_TPU_CACHE_DIR (profileUri in the "
+                             "statement response; no-op with a warning when "
+                             "the profiler or cache dir is unavailable)",
+                             bool, False),
         ]
 
     def names(self) -> List[str]:
@@ -339,4 +354,6 @@ class Session:
             fragment_window=self.get("fragment_window"),
             breaker_engine=self.get("breaker_engine").lower(),
             hbo=self.get("hbo").lower(),
+            devprof=self.get("devprof").lower(),
+            profile=self.get("profile"),
         )

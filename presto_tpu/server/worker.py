@@ -312,6 +312,16 @@ class TaskExecution:
         else:
             for batch in stream:
                 sink(batch)
+        if getattr(cfg, "devprof", "off") == "on":
+            # devprof plane: reconcile this task's pool slice against the
+            # device watermark once the task's work is done
+            try:
+                from presto_tpu.obs import devprof as _devprof
+
+                _devprof.reconcile(ctx.memory_pool, plane="worker",
+                                   site="task")
+            except Exception:
+                pass
         if cfg.collect_stats:
             names = {}
             jstats = {}
@@ -338,6 +348,20 @@ class TaskExecution:
                     row["compile_wall_s"] = round(
                         sum(v.get("compile_wall_s", 0.0)
                             for v in js.values()), 6)
+                    # devprof plane: XLA-analyzed device numbers, summed
+                    # (flops/bytes) or maxed (footprint) per operator so
+                    # the coordinator can render [peak/flops/bytes/ai]
+                    flops = sum(v.get("flops", 0.0) for v in js.values())
+                    byts = sum(v.get("bytes_accessed", 0.0)
+                               for v in js.values())
+                    peak = max((v.get("footprint_bytes", 0.0)
+                                for v in js.values()), default=0.0)
+                    if flops:
+                        row["flops"] = flops
+                    if byts:
+                        row["bytes_accessed"] = byts
+                    if peak:
+                        row["peak_bytes"] = peak
                 rows.append(row)
             rows += [{"node": k, "rows": v, "batches": 0, "wall_s": 0.0}
                      for k, v in ctx.stats.items()]
@@ -713,7 +737,7 @@ class Worker:
 
     def status(self) -> dict:
         tasks = self.task_manager.tasks
-        return {
+        doc = {
             "nodeId": self.node_id,
             "state": self.node_state,
             "tasks": len(tasks),
@@ -723,6 +747,17 @@ class Worker:
             "spilledBytes": self.spill_manager.total_spilled_bytes,
             "spillCount": self.spill_manager.spill_count,
         }
+        try:
+            from presto_tpu.obs import devprof as _devprof
+
+            if _devprof.active():
+                # devprof plane: the device's own HBM accounting rides the
+                # heartbeat so the coordinator rollup can reconcile the
+                # ledger against real allocator numbers per node
+                doc["deviceMemory"] = _devprof.device_memory_doc()
+        except Exception:
+            pass
+        return doc
 
     def _announce_once(self):
         """One announcement PUT carrying this node's current state."""

@@ -102,6 +102,97 @@ class TestKillPolicy:
         with pytest.raises(ValueError):
             ClusterMemoryManager(policy="drop-tables")
 
+    def test_blocked_node_threshold_is_a_knob(self):
+        # previously hardcoded 0.95: a node at 80% of its pool only counts
+        # as blocked when the manager was configured that aggressively
+        cmm_default = ClusterMemoryManager()
+        cmm_default.update_node("w0", _status(800, 1000, {"q": 800}))
+        assert cmm_default.info()["blockedNodes"] == []
+        cmm = ClusterMemoryManager(blocked_node_threshold=0.75)
+        cmm.update_node("w0", _status(800, 1000, {"q": 800}))
+        assert cmm.info()["blockedNodes"] == ["w0"]
+        assert cmm.info()["blockedNodeThreshold"] == 0.75
+
+    def test_blocked_node_threshold_validated(self):
+        with pytest.raises(ValueError):
+            ClusterMemoryManager(blocked_node_threshold=0.0)
+        with pytest.raises(ValueError):
+            ClusterMemoryManager(blocked_node_threshold=1.5)
+
+    def test_memory_rollup_document(self):
+        cmm = ClusterMemoryManager(limit_bytes=10_000)
+        cmm.update_node("w0", {
+            "memory": {"reservedBytes": 300, "limitBytes": 1000,
+                       "peakBytes": 700},
+            "queryMemory": {"q1": 300},
+            "deviceMemory": {"available": False, "reason": "cpu"},
+        })
+        cmm.update_node("w1", _status(100, 1000, {"q1": 60, "q2": 40}))
+        doc = cmm.memory_rollup()
+        assert doc["cluster"]["totalReservedBytes"] == 400
+        assert doc["cluster"]["peakReservedBytes"] == 700
+        assert doc["cluster"]["clusterLimitBytes"] == 10_000
+        assert doc["nodes"]["w0"]["peakBytes"] == 700
+        assert doc["nodes"]["w0"]["deviceMemory"]["available"] is False
+        assert "deviceMemory" not in doc["nodes"]["w1"]
+        assert doc["queryMemory"] == {"q1": 360, "q2": 40}
+
+    def test_kill_dumps_forensics_jsonl(self, tmp_path):
+        import json
+
+        cmm = ClusterMemoryManager(limit_bytes=1000, kill_delay_s=0.0,
+                                   policy="total-reservation",
+                                   forensics_dir=str(tmp_path))
+
+        class FakeQM:
+            class _Q:
+                done = False
+
+                def fail(self, msg, error_type=""):
+                    pass
+
+            def get(self, qid):
+                return self._Q()
+
+        cmm.update_node("w0", _status(5000, 4000, {"q_hog": 5000}))
+        cmm.enforce(FakeQM())  # arm
+        assert cmm.enforce(FakeQM()) == "q_hog"
+        path = tmp_path / "oom_forensics.jsonl"
+        assert path.exists()
+        rec = json.loads(path.read_text().splitlines()[-1])
+        assert rec["event"] == "lowMemoryKill"
+        assert rec["victim"] == "q_hog"
+        assert rec["nodes"]["w0"]["queryMemory"] == {"q_hog": 5000}
+        assert rec["blockedNodeThreshold"] == 0.95
+
+    def test_kill_stamps_memory_kill_span(self):
+        from presto_tpu.obs import trace as obs_trace
+
+        reg = obs_trace.TraceRegistry()
+        tracer = obs_trace.Tracer(trace_id="q_hog")
+        reg.register(tracer)
+        cmm = ClusterMemoryManager(limit_bytes=1000, kill_delay_s=0.0,
+                                   policy="total-reservation",
+                                   trace_registry=reg)
+
+        class FakeQM:
+            class _Q:
+                done = False
+
+                def fail(self, msg, error_type=""):
+                    pass
+
+            def get(self, qid):
+                return self._Q()
+
+        cmm.update_node("w0", _status(5000, None, {"q_hog": 5000}))
+        cmm.enforce(FakeQM())
+        assert cmm.enforce(FakeQM()) == "q_hog"
+        kinds = [s.kind for s in tracer.spans()]
+        assert "memory_kill" in kinds
+        span = [s for s in tracer.spans() if s.kind == "memory_kill"][0]
+        assert span.attrs["reason"] == "CLUSTER_OUT_OF_MEMORY"
+
 
 class TestQueryScopedPool:
     def test_per_query_slices_share_node_pool(self):

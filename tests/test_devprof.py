@@ -1,0 +1,297 @@
+"""Device cost & HBM accounting plane (obs/devprof.py): per-program XLA
+cost/memory analysis keyed on structural fingerprints, HBM watermark
+sampling with honest unavailable labeling, ledger-vs-device
+reconciliation into the drift histogram, the devprof=off strict no-op
+contract, the /v1/memory cluster rollup, and the `profile` session
+property plumbing.
+
+Reference analog: the reference exposes MemoryPoolInfo over REST and
+operator-level stats through QueryStats; the TPU-native addition is
+XLA's own cost_analysis()/memory_analysis() per compiled program."""
+
+import json
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from presto_tpu.catalog.memory import MemoryConnector
+from presto_tpu.connector import Catalog
+from presto_tpu.exec import ExecConfig
+from presto_tpu.exec.runner import LocalRunner
+from presto_tpu.memory import MemoryPool
+from presto_tpu.obs import devprof
+from presto_tpu.obs import metrics as obs_metrics
+from presto_tpu.obs.exposition import lint_exposition
+from presto_tpu.server.session import Session, SessionPropertyError
+
+
+@pytest.fixture(autouse=True)
+def _clean_devprof():
+    devprof.reset()
+    yield
+    devprof.set_provider(None)
+    devprof.reset()
+
+
+def _catalog(n=5000):
+    conn = MemoryConnector()
+    conn.add_table("t", {"k": np.arange(n, dtype=np.int64) % 37,
+                         "v": np.arange(n, dtype=np.float64)})
+    cat = Catalog()
+    cat.register("m", conn, default=True)
+    return cat
+
+
+SQL = "select k, sum(v) from m.t group by 1"
+
+
+# -- the off contract ------------------------------------------------------
+
+
+class TestOffIsNoOp:
+    def test_off_records_nothing(self):
+        r = LocalRunner(_catalog(), ExecConfig(batch_rows=1 << 12))
+        r.run_batch(SQL)
+        assert not devprof.active()
+        snap = devprof.snapshot()
+        assert snap["programs"] == {}
+        assert all(v == 0 for v in snap["counters"].values())
+        # no devprof families on a scrape until the plane ever armed —
+        # an off-config scrape is byte-identical to the pre-devprof one
+        assert devprof.metric_rows({"plane": "worker"}) == []
+
+    def test_off_renders_no_annotations(self):
+        r = LocalRunner(_catalog(), ExecConfig(batch_rows=1 << 12))
+        txt = r.explain_analyze(SQL)
+        assert "flops=" not in txt and "[peak=" not in txt
+
+
+# -- per-program analysis --------------------------------------------------
+
+
+class TestProgramAnalysis:
+    def test_on_records_every_jit_program(self):
+        r = LocalRunner(_catalog(), ExecConfig(batch_rows=1 << 12,
+                                               devprof="on"))
+        r.run_batch(SQL)
+        assert devprof.active()
+        progs = devprof.programs_profile()
+        assert progs, "devprof=on must record analyzed programs"
+        # every record carries XLA's cost numbers and the analysis plane
+        # never fabricates: footprint comes from memory_analysis (works
+        # on CPU), flops/bytes from cost_analysis
+        for ent in progs.values():
+            assert ent.get("calls", 0) >= 1
+        assert any(ent.get("flops") for ent in progs.values())
+        assert any(ent.get("footprint_bytes") for ent in progs.values())
+
+    def test_summary_roofline_math(self):
+        devprof.activate()
+        devprof.record_program("fp_a", {"flops": 100.0,
+                                        "bytes_accessed": 50.0,
+                                        "footprint_bytes": 7.0})
+        devprof.record_program("fp_b", {"flops": 10.0,
+                                        "bytes_accessed": 10.0,
+                                        "footprint_bytes": 3.0})
+        s = devprof.summary(wall_s=2.0)
+        assert s["programs"] == 2
+        assert s["total_flops"] == 110.0
+        assert s["total_bytes_accessed"] == 60.0
+        assert s["arithmetic_intensity"] == pytest.approx(110.0 / 60.0)
+        assert s["peak_program_footprint_bytes"] == 7.0
+        assert s["achieved_flops_per_s"] == pytest.approx(55.0)
+
+    def test_record_max_merges_recompiles(self):
+        devprof.activate()
+        devprof.record_program("fp", {"flops": 10.0, "footprint_bytes": 5.0})
+        merged = devprof.record_program("fp", {"flops": 4.0,
+                                               "footprint_bytes": 9.0})
+        assert merged["flops"] == 10.0  # worst shape wins
+        assert merged["footprint_bytes"] == 9.0
+
+    def test_on_renders_explain_analyze_annotations(self):
+        r = LocalRunner(_catalog(), ExecConfig(batch_rows=1 << 12,
+                                               devprof="on"))
+        txt = r.explain_analyze(SQL)
+        assert "flops=" in txt and "ai=" in txt and "peak=" in txt
+
+    def test_explain_analyze_annotations(self):
+        from presto_tpu.plan.nodes import _devprof_annotation
+
+        js = {"k1": {"compiles": 1, "compile_wall_s": 0.1, "flops": 200.0,
+                     "bytes_accessed": 100.0, "footprint_bytes": 64.0}}
+        ann = _devprof_annotation(js)
+        assert "peak=64" in ann and "flops=200" in ann
+        assert "bytes=100" in ann and "ai=2.00" in ann
+        # no devprof keys -> renders nothing (off stays bit-identical)
+        assert _devprof_annotation(
+            {"k1": {"compiles": 1, "compile_wall_s": 0.1}}) == ""
+
+
+# -- HBM sampling + reconciliation ----------------------------------------
+
+
+class TestHbmAndReconcile:
+    def test_cpu_sample_is_honestly_unavailable(self):
+        devprof.activate()
+        doc = devprof.sample_hbm()
+        assert doc["available"] is False
+        assert doc["reason"]  # labeled, never fabricated zeros
+        assert "bytesInUse" not in doc
+
+    def test_fake_provider_watermark(self):
+        devprof.activate()
+        vals = iter([{"bytes_in_use": 100, "peak_bytes_in_use": 100,
+                      "bytes_limit": 1000},
+                     {"bytes_in_use": 50, "peak_bytes_in_use": 400,
+                      "bytes_limit": 1000}])
+        devprof.set_provider(lambda: next(vals))
+        devprof.sample_hbm()
+        doc = devprof.sample_hbm()
+        assert doc["available"] is True
+        assert doc["bytesInUse"] == 50
+        assert doc["peakBytesInUse"] == 400  # high-water across samples
+        assert doc["bytesLimit"] == 1000
+
+    def test_reconcile_feeds_drift_histogram(self):
+        devprof.activate()
+        devprof.set_provider(lambda: {"bytes_in_use": 900,
+                                      "peak_bytes_in_use": 1800,
+                                      "bytes_limit": 10_000})
+        pool = MemoryPool(1 << 20)
+        pool.reserve(1000, tag="q")
+        before = obs_metrics.LEDGER_DRIFT.snapshot("worker")
+        n_before = sum(s["count"] for s in before.values())
+        rec = devprof.reconcile(pool, plane="worker", site="unit")
+        assert rec["driftRatio"] == pytest.approx(1.8)
+        assert rec["ledgerPeakBytes"] == 1000.0
+        after = obs_metrics.LEDGER_DRIFT.snapshot("worker")
+        assert sum(s["count"] for s in after.values()) == n_before + 1
+
+    def test_reconcile_declines_without_device_numbers(self):
+        devprof.activate()
+        pool = MemoryPool(1 << 20)
+        pool.reserve(1000, tag="q")
+        # CPU default provider: no memory_stats -> honest None, no
+        # histogram observation on fabricated data
+        assert devprof.reconcile(pool) is None
+
+
+# -- exposition ------------------------------------------------------------
+
+
+class TestExposition:
+    def test_families_lint_clean_when_armed(self):
+        from presto_tpu.server.metrics import render_metrics
+
+        devprof.activate()
+        devprof.record_program("fp", {"flops": 5.0, "bytes_accessed": 2.0,
+                                      "footprint_bytes": 8.0})
+        devprof.sample_hbm()
+        rows = devprof.metric_rows({"plane": "worker", "node": "w0"})
+        names = {r[0] for r in rows}
+        assert "presto_tpu_devprof_programs_analyzed" in names
+        assert "presto_tpu_devprof_total_flops" in names
+        assert "presto_tpu_devprof_hbm_unavailable_total" in names
+        assert "presto_tpu_devprof_hbm_peak_bytes" in names
+        doc = render_metrics(rows)
+        assert lint_exposition(doc) == []
+
+    def test_hbm_gauge_labeled_by_availability(self):
+        devprof.activate()
+        devprof.sample_hbm()  # CPU: unavailable
+        rows = devprof.metric_rows({})
+        peak = [r for r in rows
+                if r[0] == "presto_tpu_devprof_hbm_peak_bytes"][0]
+        assert peak[3]["available"] == "false"
+        assert peak[2] == 0
+
+
+# -- session property + config plumbing -----------------------------------
+
+
+class TestSessionPlumbing:
+    def test_devprof_property_lowers_into_config(self):
+        s = Session()
+        assert s.exec_config().devprof == "off"
+        assert s.exec_config().profile is False
+        s.set("devprof", "ON")
+        s.set("profile", "true")
+        cfg = s.exec_config()
+        assert cfg.devprof == "on" and cfg.profile is True
+
+    def test_devprof_property_validated(self):
+        s = Session()
+        with pytest.raises(SessionPropertyError):
+            s.set("devprof", "sometimes")
+
+    def test_config_fields_are_volatile(self):
+        # toggling devprof/profile must not fork the structural program
+        # cache (same contract as hbo/tracing)
+        from presto_tpu.exec.programs import config_fingerprint
+
+        a = config_fingerprint(ExecConfig())
+        b = config_fingerprint(ExecConfig(devprof="on", profile=True))
+        assert a == b
+
+    def test_profile_noop_with_warning_without_cache_dir(self, monkeypatch):
+        monkeypatch.delenv("PRESTO_TPU_CACHE_DIR", raising=False)
+        from presto_tpu.server.coordinator import Coordinator
+
+        cat = _catalog()
+        coord = Coordinator(cat, min_workers=0)
+        try:
+            with pytest.warns(UserWarning, match="no-op"):
+                with coord._profile_capture(Session()):
+                    pass
+        finally:
+            coord.close()
+
+
+# -- cluster rollup --------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_v1_memory_scrape_and_heartbeat_peaks(tmp_path, monkeypatch):
+    """A devprof=on cluster query leaves nonzero per-node peakBytes in the
+    /v1/memory rollup, carries the device doc on the heartbeat, and the
+    devprof families appear (lint-clean) on both metrics planes."""
+    monkeypatch.setenv("PRESTO_TPU_CACHE_DIR", str(tmp_path))
+    from presto_tpu.server.coordinator import DistributedRunner
+
+    cat = _catalog(20000)
+    dr = DistributedRunner(cat, n_workers=2,
+                           config=ExecConfig(batch_rows=1 << 12,
+                                             devprof="on",
+                                             memory_pool_bytes=1 << 26))
+    try:
+        dr.run_batch(SQL)
+        deadline = time.time() + 15
+        doc = {}
+        while time.time() < deadline:
+            doc = json.load(urllib.request.urlopen(
+                dr.coordinator.url + "/v1/memory"))
+            if any(n.get("peakBytes", 0) > 0 for n in doc["nodes"].values()):
+                break
+            time.sleep(0.2)
+        assert doc["cluster"]["blockedNodeThreshold"] == 0.95
+        assert any(n.get("peakBytes", 0) > 0 for n in doc["nodes"].values())
+        # heartbeat device doc: present and honest about CPU
+        devdocs = [n.get("deviceMemory") for n in doc["nodes"].values()]
+        assert any(d is not None for d in devdocs)
+        assert all(d.get("available") is False for d in devdocs if d)
+        for path in ("/v1/metrics",):
+            body = urllib.request.urlopen(
+                dr.coordinator.url + path).read().decode()
+            assert "presto_tpu_devprof_programs_analyzed" in body
+            assert lint_exposition(body) == []
+        wbody = urllib.request.urlopen(
+            dr.workers[0].url + "/v1/metrics").read().decode()
+        assert "presto_tpu_devprof_programs_analyzed" in wbody
+        assert lint_exposition(wbody) == []
+    finally:
+        dr.coordinator.close()
+        for w in dr.workers:
+            w.close()

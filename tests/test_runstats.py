@@ -279,3 +279,71 @@ class TestFeedbackLoop:
         assert s.exec_config().hbo == "correct"
         with pytest.raises(SessionPropertyError):
             s.set("hbo", "sometimes")
+
+
+class TestHistoryCompaction:
+    """Satellite of the devprof PR: the history store ages out on load
+    (TTL + entry cap) and `python -m presto_tpu.obs.runstats --compact`
+    rewrites the append-only JSONL to one line per live entry."""
+
+    def _write(self, path, records):
+        with open(path, "a") as fh:
+            for rec in records:
+                fh.write(json.dumps(rec) + "\n")
+
+    def test_ttl_drops_stale_entries_on_load(self, history_dir):
+        import time as _time
+
+        now = _time.time()
+        path = history_dir / "hbo_history.jsonl"
+        self._write(path, [
+            {"fp": "old", "site": "s", "actual_rows": 1,
+             "ts": now - 100 * 86400.0},
+            {"fp": "fresh", "site": "s", "actual_rows": 2, "ts": now},
+            # ts-less records predate the TTL stamp — kept, not dropped
+            {"fp": "legacy", "site": "s", "actual_rows": 3},
+        ])
+        runstats.reset()  # force lazy reload with the default TTL
+        assert runstats.lookup("old", "s") is None
+        assert runstats.lookup("fresh", "s")["actual_rows"] == 2
+        assert runstats.lookup("legacy", "s")["actual_rows"] == 3
+
+    def test_entry_cap_keeps_newest(self, history_dir):
+        import time as _time
+
+        now = _time.time()
+        path = history_dir / "hbo_history.jsonl"
+        self._write(path, [
+            {"fp": f"fp{i}", "site": "s", "actual_rows": i, "ts": now + i}
+            for i in range(6)])
+        res = runstats.compact(max_entries=2)
+        assert res["lines_before"] == 6 and res["entries"] == 2
+        assert runstats.lookup("fp5", "s") is not None
+        assert runstats.lookup("fp4", "s") is not None
+        assert runstats.lookup("fp0", "s") is None
+        # the file itself was rewritten to the survivors
+        assert len(path.read_text().splitlines()) == 2
+
+    def test_compact_rewrites_superseded_lines(self, history_dir):
+        # same (fp, site) observed repeatedly: append-only bloat, one
+        # live entry
+        for actual in (10, 20, 30):
+            runstats.observe("fp", "s", "groupby", est=5, actual=actual)
+        path = history_dir / "hbo_history.jsonl"
+        assert len(path.read_text().splitlines()) == 3
+        res = runstats.compact()
+        assert res["lines_before"] == 3 and res["entries"] == 1
+        assert len(path.read_text().splitlines()) == 1
+        ent = runstats.lookup("fp", "s")
+        assert ent["actual"] == 30.0  # the merged (latest/max) entry wins
+
+    def test_cli_compact(self, history_dir, capsys):
+        runstats.observe("fp", "s", "groupby", est=5, actual=7)
+        runstats.observe("fp", "s", "groupby", est=5, actual=9)
+        assert runstats.main(["--compact"]) == 0
+        out = capsys.readouterr().out
+        assert "compacted" in out and "2 lines -> 1 entries" in out
+
+    def test_cli_without_cache_dir(self, no_history, capsys):
+        assert runstats.main(["--compact"]) == 1
+        assert "PRESTO_TPU_CACHE_DIR is not set" in capsys.readouterr().out
