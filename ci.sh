@@ -514,6 +514,71 @@ if [ "$rc" -ne 0 ]; then
   exit 1
 fi
 
+# Concurrency-lint step: the whole-program lock-discipline analysis must
+# be clean over the shipped tree, and must FAIL on an injected module
+# carrying the three bug classes it exists for: an unguarded mutation of
+# lock-guarded state, a check-then-act split across two critical
+# sections, and a two-lock lock-order cycle.
+echo "== analysis: concurrency safety (lock discipline + races) =="
+env JAX_PLATFORMS=cpu python -m presto_tpu.analysis --no-lint --concurrency
+rc=$?
+if [ "$rc" -ne 0 ]; then
+  echo "concurrency step FAILED: shipped tree is not race-clean (exit $rc)"
+  exit 1
+fi
+cinj="$(mktemp -d)"
+cat > "$cinj/injected_conc.py" <<'PYEOF'
+import threading
+
+_lock = threading.Lock()
+_other = threading.Lock()
+_cache = {}  # shared: guarded-by(_lock)
+
+
+def unguarded_put(k, v):
+    _cache[k] = v
+
+
+def check_then_act(k):
+    with _lock:
+        v = _cache.get(k)
+    if v is None:
+        v = object()
+        with _lock:
+            _cache[k] = v
+    return v
+
+
+def order_ab():
+    with _lock:
+        with _other:
+            pass
+
+
+def order_ba():
+    with _other:
+        with _lock:
+            pass
+PYEOF
+env JAX_PLATFORMS=cpu python -m presto_tpu.analysis --no-lint --concurrency \
+    "$cinj/injected_conc.py" > /tmp/_cinj.log 2>&1
+rc=$?
+rm -rf "$cinj"
+if [ "$rc" -eq 0 ]; then
+  echo "concurrency step FAILED: injected violations were NOT detected"
+  cat /tmp/_cinj.log
+  exit 1
+fi
+grep -q "injected_conc.py:9: \[unguarded\]" /tmp/_cinj.log \
+  && grep -q "injected_conc.py:.*\[check-then-act\]" /tmp/_cinj.log \
+  && grep -q "\[lock-order\]" /tmp/_cinj.log
+if [ $? -ne 0 ]; then
+  echo "concurrency step FAILED: injected findings missing rule/file:line"
+  cat /tmp/_cinj.log
+  exit 1
+fi
+echo "concurrency self-check OK (exit $rc, 3 rules attributed)"
+
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
   --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly \

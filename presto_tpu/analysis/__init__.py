@@ -14,8 +14,16 @@ finding):
   non-pow2 capacity constants.
 - recompile: `_node_jit` compile counts stay under a per-program shape
   budget, making "bounded compiled shapes" an enforced invariant.
+- concurrency: whole-program lock-discipline verification over the
+  shared-process singletons — unguarded mutations, check-then-act
+  races, lock-order cycles, and lock acquisition in jit-traced regions.
 """
 
+from presto_tpu.analysis.concurrency import (
+    CONCURRENCY_RULES,
+    analyze_paths,
+    analyze_source,
+)
 from presto_tpu.analysis.findings import Finding, render_json, render_text
 from presto_tpu.analysis.kernel_lint import RULES, lint_paths, lint_source
 from presto_tpu.analysis.plan_check import (
@@ -33,6 +41,9 @@ from presto_tpu.analysis.recompile import (
 )
 
 __all__ = [
+    "CONCURRENCY_RULES",
+    "analyze_paths",
+    "analyze_source",
     "Finding",
     "render_json",
     "render_text",

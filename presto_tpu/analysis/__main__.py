@@ -5,6 +5,10 @@ Usage:
   python -m presto_tpu.analysis [paths...] [--json] [--rules r1,r2]
       lint the kernel modules (default scope: presto_tpu/ops/ +
       presto_tpu/exec/runtime.py) — exit 1 on any finding
+  python -m presto_tpu.analysis --concurrency [paths...]
+      whole-program concurrency-safety analysis (lock discipline,
+      check-then-act races, lock-order cycles, locks in jit regions)
+      over presto_tpu/ (or the given paths)
   python -m presto_tpu.analysis --tpch-plans [--sf 0.01]
       build + optimize + fragment the canonical TPC-H queries (texts
       loaded from --queries, default tests/test_tpch.py) and run the
@@ -140,6 +144,9 @@ def main(argv=None) -> int:
                     help="comma-separated lint rule subset")
     ap.add_argument("--no-lint", action="store_true",
                     help="skip the kernel lint plane")
+    ap.add_argument("--concurrency", action="store_true",
+                    help="run the concurrency-safety analysis (default "
+                         "scope: the whole presto_tpu package)")
     ap.add_argument("--tpch-plans", action="store_true",
                     help="check plan invariants over the TPC-H queries")
     ap.add_argument("--tpch-run", default=None, metavar="q1,q6",
@@ -166,6 +173,21 @@ def main(argv=None) -> int:
             print(f"error: {e}", file=sys.stderr)
             return 2
         planes.append(f"lint ({', '.join(os.path.relpath(p) for p in paths)})")
+    if args.concurrency:
+        import presto_tpu
+        from presto_tpu.analysis import concurrency
+
+        crules = (tuple(r.strip() for r in args.rules.split(","))
+                  if args.rules else concurrency.RULES)
+        cpaths = args.paths or [
+            os.path.dirname(os.path.abspath(presto_tpu.__file__))]
+        try:
+            findings.extend(concurrency.analyze_paths(cpaths, crules))
+        except OSError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        planes.append(
+            f"concurrency ({', '.join(os.path.relpath(p) for p in cpaths)})")
     if args.tpch_plans:
         findings.extend(_check_tpch_plans(args.sf, args.queries))
         planes.append("tpch plan invariants")

@@ -1,0 +1,234 @@
+"""Shared AST infrastructure for the static-analysis plane.
+
+kernel_lint and concurrency both need (1) parsed module ASTs, (2) the
+jit-rooted kernel-region discovery, and (3) the ``# lint: allow(...)``
+suppression index. Each pass used to re-derive all three per invocation;
+this module is the single traversal they share:
+
+- :func:`load_file` parses a module once per (mtime, size) and caches
+  the (source, tree) pair, so one CLI run over ``presto_tpu/`` parses
+  each file exactly once even when the lint pass and the concurrency
+  pass both visit it;
+- :func:`kernel_functions` is the jit-region walk (``@jax.jit`` defs,
+  ``jax.jit(f)`` / ``pl.pallas_call(kernel)`` targets, ``_node_jit``
+  builders, and their same-module transitive callees), memoized on the
+  tree so the lint rules and the lock-in-jit rule walk it once;
+- :class:`Suppressions` indexes ``# lint: allow(<rule>[, <rule>...])``
+  line and def-level suppressions for any rule vocabulary.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+_ALLOW_RE = re.compile(r"#\s*lint:\s*allow\(([a-z0-9_,\- ]+)\)")
+
+
+def _root_name(e: ast.expr) -> Optional[str]:
+    while isinstance(e, ast.Attribute):
+        e = e.value
+    return e.id if isinstance(e, ast.Name) else None
+
+
+def _attr_chain(e: ast.expr) -> Optional[Tuple[str, str]]:
+    """`np.float64` -> ("np", "float64"); one-level chains only."""
+    if isinstance(e, ast.Attribute) and isinstance(e.value, ast.Name):
+        return e.value.id, e.attr
+    return None
+
+
+class Suppressions:
+    """Index of `# lint: allow(rule, ...)` comments: per-line sets plus
+    def-level spans (an allow() on a `def` line covers the body)."""
+
+    def __init__(self, source: str):
+        self.lines: Dict[int, Set[str]] = {}
+        for i, line in enumerate(source.splitlines(), start=1):
+            m = _ALLOW_RE.search(line)
+            if m:
+                self.lines[i] = {r.strip() for r in m.group(1).split(",")}
+        self.spans: List[Tuple[int, int, Set[str]]] = []
+
+    def add_span(self, lo: int, hi: int, rules: Set[str]):
+        self.spans.append((lo, hi, rules))
+
+    def cover_functions(self, fns: Sequence[ast.AST]) -> None:
+        """Promote def-line suppressions on `fns` to body-wide spans."""
+        for fn in fns:
+            line = getattr(fn, "lineno", None)
+            end = getattr(fn, "end_lineno", None)
+            if line is not None and end is not None and line in self.lines:
+                self.add_span(line, end, self.lines[line])
+
+    def allowed(self, rule: str, line: int) -> bool:
+        if rule in self.lines.get(line, ()):
+            return True
+        return any(lo <= line <= hi and rule in rules
+                   for lo, hi, rules in self.spans)
+
+
+# ---------------------------------------------------------------------------
+# per-file AST cache
+
+
+# path -> (mtime_ns, size, source, tree): one parse per file revision,
+# shared by every analysis pass in the process
+_FILE_CACHE: Dict[str, Tuple[int, int, str, ast.AST]] = {}
+
+
+def parse(source: str, path: str) -> ast.AST:
+    """Uncached parse for in-memory sources (tests, injected snippets)."""
+    return ast.parse(source, filename=path)
+
+
+def load_file(path: str) -> Tuple[str, ast.AST]:
+    """(source, tree) for a module file, cached on (mtime, size)."""
+    st = os.stat(path)
+    key = (st.st_mtime_ns, st.st_size)
+    hit = _FILE_CACHE.get(path)
+    if hit is not None and hit[:2] == key:
+        return hit[2], hit[3]
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    tree = ast.parse(src, filename=path)
+    _FILE_CACHE[path] = (key[0], key[1], src, tree)
+    return src, tree
+
+
+def cache_info() -> Dict[str, int]:
+    """Introspection hook for tests: number of cached file ASTs."""
+    return {"files": len(_FILE_CACHE)}
+
+
+def iter_py_files(paths: Sequence[str]) -> List[str]:
+    """Expand files/directories into a sorted .py file list (recursive
+    for directories, skipping __pycache__)."""
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, dirs, names in os.walk(p):
+                dirs[:] = sorted(d for d in dirs if d != "__pycache__")
+                for name in sorted(names):
+                    if name.endswith(".py"):
+                        out.append(os.path.join(root, name))
+        else:
+            out.append(p)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# kernel-region discovery (jit-rooted functions)
+
+
+def collect_functions(tree: ast.AST) -> Dict[str, List[ast.AST]]:
+    """name -> every def with that name, any nesting depth."""
+    out: Dict[str, List[ast.AST]] = {}
+    for n in ast.walk(tree):
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out.setdefault(n.name, []).append(n)
+    return out
+
+
+def _is_jax_jit(e: ast.expr) -> bool:
+    chain = _attr_chain(e)
+    if chain is not None:
+        return chain == ("jax", "jit")
+    return isinstance(e, ast.Name) and e.id == "jit"
+
+
+def jit_roots(tree: ast.AST,
+              funcs: Dict[str, List[ast.AST]]) -> List[ast.AST]:
+    """Functions whose bodies become traced device code: `@jax.jit`
+    (incl. `@partial(jax.jit, ...)`) defs, `jax.jit(f)` targets,
+    `pl.pallas_call(kernel)` kernels (unwrapping `partial(kernel, ..)`),
+    and `_node_jit(node, key, builder)` builders."""
+    roots: List[ast.AST] = []
+
+    def add_target(e: ast.expr):
+        if isinstance(e, ast.Lambda):
+            roots.append(e)
+        elif isinstance(e, ast.Name):
+            roots.extend(funcs.get(e.id, ()))
+
+    def is_partial(e: ast.expr) -> bool:
+        return ((isinstance(e, ast.Name) and e.id == "partial")
+                or _attr_chain(e) == ("functools", "partial"))
+
+    for n in ast.walk(tree):
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in n.decorator_list:
+                if _is_jax_jit(dec):
+                    roots.append(n)
+                elif isinstance(dec, ast.Call):
+                    # @partial(jax.jit, ...) / @jax.jit(...)
+                    if _is_jax_jit(dec.func):
+                        roots.append(n)
+                    elif (isinstance(dec.func, ast.Name)
+                          and dec.func.id == "partial" and dec.args
+                          and _is_jax_jit(dec.args[0])):
+                        roots.append(n)
+        if not isinstance(n, ast.Call):
+            continue
+        if _is_jax_jit(n.func) and n.args:
+            add_target(n.args[0])
+        fname = (n.func.id if isinstance(n.func, ast.Name)
+                 else n.func.attr if isinstance(n.func, ast.Attribute)
+                 else None)
+        if fname == "pallas_call" and n.args:
+            # pl.pallas_call(kernel, ...) — the kernel body IS device
+            # code, wherever the module lives; unwrap partial(kernel, ..)
+            tgt = n.args[0]
+            if isinstance(tgt, ast.Call) and is_partial(tgt.func) \
+                    and tgt.args:
+                tgt = tgt.args[0]
+            add_target(tgt)
+        if fname == "_node_jit" and len(n.args) >= 3:
+            builder = n.args[2]
+            if isinstance(builder, ast.Lambda):
+                add_target(builder.body)
+            elif isinstance(builder, ast.Name):
+                # builder by reference: its return value is jitted; treat
+                # the builder body itself as kernel code (the inner defs
+                # are reached transitively)
+                roots.extend(funcs.get(builder.id, ()))
+    return roots
+
+
+def called_names(fn: ast.AST) -> Set[str]:
+    out: Set[str] = set()
+    for n in ast.walk(fn):
+        if isinstance(n, ast.Call) and isinstance(n.func, ast.Name):
+            out.add(n.func.id)
+    return out
+
+
+def kernel_functions(tree: ast.AST, path: str) -> List[ast.AST]:
+    """The kernel region: every def in ops/ modules; jit-rooted defs (plus
+    same-module transitive callees) elsewhere. Memoized on the tree —
+    the lint rules and the concurrency lock-in-jit rule share one walk."""
+    cached = getattr(tree, "_kernel_fns", None)
+    if cached is not None:
+        return cached
+    funcs = collect_functions(tree)
+    norm = path.replace("\\", "/")
+    if ("/ops/" in norm or norm.startswith("ops/")
+            or norm.endswith("exec/fragment_jit.py")):
+        out = [f for fs in funcs.values() for f in fs]
+        tree._kernel_fns = out
+        return out
+    work = list(jit_roots(tree, funcs))
+    seen: List[ast.AST] = []
+    seen_ids: Set[int] = set()
+    while work:
+        f = work.pop()
+        if id(f) in seen_ids:
+            continue
+        seen_ids.add(id(f))
+        seen.append(f)
+        for name in called_names(f):
+            work.extend(funcs.get(name, ()))
+    tree._kernel_fns = seen
+    return seen

@@ -1,0 +1,1054 @@
+"""Whole-program concurrency-safety analysis for the shared-process path.
+
+The serving plane multiplexes every query over process-wide singletons:
+the structural program cache (exec/programs.py), the HBO history
+(obs/runstats.py), the devprof store (obs/devprof.py), the cluster
+memory ledger (server/cluster_memory.py), metric registries, exchange
+buffers. Each is a mutable structure guarded by a `threading.Lock`, and
+the one concurrency bug this repo has shipped (the PR 5 `_cache_size()`
+before/after compile-detection race) was a check-then-act on exactly
+such a structure that no test caught. This pass makes the locking
+discipline a checked invariant instead of a convention.
+
+Four rules (plane "concurrency"):
+
+- ``unguarded``: a mutation of registered shared state that is not
+  lexically under ``with <its lock>``. Also: a call to a ``*_locked``
+  function (the caller-holds-the-lock naming convention) from a context
+  holding no lock at all.
+- ``check-then-act``: within one function, a guarded read of shared
+  state in one critical section and a guarded mutation of the same
+  state in a *different* critical section — the decision made from the
+  read is stale by the time the mutation runs (the PR 5 bug class).
+- ``lock-order``: a cycle in the lock-order graph (deadlock potential),
+  or code that may re-acquire a non-reentrant lock it already holds
+  (self-deadlock). The graph is built from lexically nested ``with``
+  acquisitions plus an interprocedural may-acquire fixpoint over the
+  project call graph.
+- ``lock-in-jit``: a lock acquisition inside a jit-traced region
+  (kernel_lint's jit-rooted region discovery, shared via astutil) —
+  traced Python runs once per compile, so a lock there guards nothing
+  at execution time and can deadlock the tracer under the compile lock.
+
+Shared-state inventory — two sources, annotation wins over inference:
+
+- Annotations: trailing ``# shared: guarded-by(<lock>)`` on the
+  assignment that creates the state (module global or ``self.attr``)
+  registers it explicitly; ``# shared: requires(<lock>)`` on a ``def``
+  line declares the body runs with the lock already held (the whole
+  body is one critical section, and call sites are checked instead).
+  A function named ``*_locked`` gets the same treatment with the lock
+  left unspecified.
+- Inference: in a module that defines a module-level Lock/RLock, every
+  module-level mutable container (dict/list/set/… literal, ctor, or
+  comprehension) and every scalar rebound through ``global`` is shared
+  state; in a class whose ``__init__`` creates a ``self.<lock>``, every
+  mutable container attribute assigned in ``__init__`` is shared state.
+  Self-synchronized objects (Event, Condition, Queue, executors, …) are
+  exempt. The guard is the single lock in scope, or — when several are
+  declared — the lock that wraps the majority of the state's mutation
+  sites (annotate to override).
+
+Suppressions use the lint syntax: ``# lint: allow(<rule>)`` on the
+offending line (on a ``def`` line it covers the function). Every
+suppression shipped in-tree must carry a justification comment.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from presto_tpu.analysis import astutil
+from presto_tpu.analysis.astutil import (
+    Suppressions,
+    _attr_chain,
+    kernel_functions,
+)
+from presto_tpu.analysis.findings import Finding
+
+RULES = ("unguarded", "check-then-act", "lock-order", "lock-in-jit")
+# unambiguous name for `from presto_tpu.analysis import ...` users
+# (kernel_lint already exports a RULES tuple there)
+CONCURRENCY_RULES = RULES
+
+_GUARD_RE = re.compile(r"#\s*shared:\s*guarded-by\(([^)]+)\)")
+_REQUIRES_RE = re.compile(r"#\s*shared:\s*requires\(([^)]+)\)")
+
+_MUTABLE_CTORS = {"dict", "list", "set", "OrderedDict", "defaultdict",
+                  "deque", "Counter", "ChainMap"}
+# objects that carry their own synchronization — never inferred state
+_SELF_SYNC_CTORS = {"Lock", "RLock", "Condition", "Semaphore",
+                    "BoundedSemaphore", "Event", "Barrier", "local",
+                    "Queue", "LifoQueue", "PriorityQueue", "SimpleQueue",
+                    "ThreadPoolExecutor", "Thread"}
+_MUTATING_METHODS = {"append", "extend", "insert", "add", "remove",
+                     "discard", "pop", "popitem", "popleft", "appendleft",
+                     "clear", "update", "setdefault", "sort", "reverse",
+                     "move_to_end", "subtract"}
+_READ_METHODS = {"get", "keys", "values", "items", "copy", "index",
+                 "count"}
+
+
+def _expr_text(e: ast.expr) -> Optional[str]:
+    """Dotted text of a Name/Attribute chain ("self._lock"); None for
+    anything else (calls, subscripts, literals)."""
+    if isinstance(e, ast.Name):
+        return e.id
+    if isinstance(e, ast.Attribute):
+        base = _expr_text(e.value)
+        return None if base is None else f"{base}.{e.attr}"
+    return None
+
+
+def _rel(path: str) -> str:
+    norm = path.replace("\\", "/")
+    parts = norm.split("/")
+    if "presto_tpu" in parts:
+        return "/".join(parts[parts.index("presto_tpu"):])
+    return parts[-1]
+
+
+def _dotted(path: str) -> str:
+    rel = _rel(path)
+    return rel[:-3].replace("/", ".") if rel.endswith(".py") else rel
+
+
+class LockDecl:
+    __slots__ = ("id", "reentrant", "line")
+
+    def __init__(self, id_: str, reentrant: bool, line: int):
+        self.id = id_
+        self.reentrant = reentrant
+        self.line = line
+
+
+class ClassInfo:
+    def __init__(self, name: str, module: "ModuleInfo"):
+        self.name = name
+        self.module = module
+        self.lock_attrs: Dict[str, LockDecl] = {}
+        # attr -> guard text ("self._lock") or None (infer)
+        self.shared_attrs: Dict[str, Optional[str]] = {}
+        self.annotated: Set[str] = set()
+        self.methods: Dict[str, ast.AST] = {}
+
+
+class ModuleInfo:
+    def __init__(self, source: str, path: str, tree: ast.AST):
+        self.source = source
+        self.path = path
+        self.rel = _rel(path)
+        self.dotted = _dotted(path)
+        self.tree = tree
+        self.supp = Suppressions(source)
+        self.import_aliases: Dict[str, str] = {}   # alias -> module
+        self.from_imports: Dict[str, Tuple[str, str]] = {}  # name->(mod,orig)
+        self.module_locks: Dict[str, LockDecl] = {}
+        # name -> guard text or None (infer); module-level shared state
+        self.module_state: Dict[str, Optional[str]] = {}
+        self.annotated_state: Set[str] = set()
+        self.classes: Dict[str, ClassInfo] = {}
+        self.instances: Dict[str, str] = {}        # NAME -> class ctor name
+        self.top_names: Set[str] = set()
+        self.guard_ann: Dict[int, str] = {}        # line -> lock expr
+        self.requires_ann: Dict[int, str] = {}
+        self.scans: List["FunctionScan"] = []
+        for i, line in enumerate(source.splitlines(), start=1):
+            m = _GUARD_RE.search(line)
+            if m:
+                self.guard_ann[i] = m.group(1).strip()
+            m = _REQUIRES_RE.search(line)
+            if m:
+                self.requires_ann[i] = m.group(1).strip()
+
+
+def _lock_ctor(call: ast.expr, mod: ModuleInfo) -> Optional[Tuple[bool, bool]]:
+    """(is_lock, reentrant) when `call` constructs a threading lock
+    (through any import alias); Condition counts as reentrant (it wraps
+    an RLock by default and aliases an explicit one)."""
+    if not isinstance(call, ast.Call):
+        return None
+    name = None
+    chain = _attr_chain(call.func)
+    if chain is not None:
+        alias, attr = chain
+        if mod.import_aliases.get(alias) == "threading":
+            name = attr
+    elif isinstance(call.func, ast.Name):
+        src = mod.from_imports.get(call.func.id)
+        if src is not None and src[0] == "threading":
+            name = src[1]
+    if name in ("Lock",):
+        return True, False
+    if name in ("RLock", "Condition"):
+        return True, True
+    return None
+
+
+def _is_mutable_ctor(e: ast.expr, mod: ModuleInfo) -> bool:
+    if isinstance(e, (ast.Dict, ast.List, ast.Set, ast.ListComp,
+                      ast.DictComp, ast.SetComp)):
+        return True
+    if isinstance(e, ast.Call):
+        name = (e.func.id if isinstance(e.func, ast.Name)
+                else e.func.attr if isinstance(e.func, ast.Attribute)
+                else None)
+        return name in _MUTABLE_CTORS
+    return False
+
+
+def _is_self_sync(e: ast.expr) -> bool:
+    if isinstance(e, ast.Call):
+        name = (e.func.id if isinstance(e.func, ast.Name)
+                else e.func.attr if isinstance(e.func, ast.Attribute)
+                else None)
+        return name in _SELF_SYNC_CTORS
+    return False
+
+
+# ---------------------------------------------------------------------------
+# collection: module inventory
+
+
+def _collect_module(mod: ModuleInfo) -> None:
+    for n in mod.tree.body:
+        _collect_top(n, mod)
+    # class methods + nested defs, tagged with their enclosing class
+    for cname, ci in mod.classes.items():
+        for m in ci.methods.values():
+            _collect_class_method(m, ci, mod)
+
+
+def _collect_top(n: ast.stmt, mod: ModuleInfo) -> None:
+    if isinstance(n, ast.Import):
+        for a in n.names:
+            mod.import_aliases[a.asname or a.name.split(".")[0]] = a.name
+    elif isinstance(n, ast.ImportFrom) and n.module:
+        for a in n.names:
+            mod.from_imports[a.asname or a.name] = (n.module, a.name)
+    elif isinstance(n, ast.ClassDef):
+        ci = ClassInfo(n.name, mod)
+        mod.classes[n.name] = ci
+        for s in n.body:
+            if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                ci.methods[s.name] = s
+    elif isinstance(n, (ast.Assign, ast.AnnAssign)):
+        targets = n.targets if isinstance(n, ast.Assign) else [n.target]
+        value = n.value
+        if value is None:
+            return
+        for t in targets:
+            if not isinstance(t, ast.Name):
+                continue
+            mod.top_names.add(t.id)
+            lk = _lock_ctor(value, mod)
+            if lk is not None and lk[0]:
+                mod.module_locks[t.id] = LockDecl(
+                    f"{mod.rel}:{t.id}", lk[1], n.lineno)
+                continue
+            if isinstance(value, ast.Call):
+                ctor = (value.func.id if isinstance(value.func, ast.Name)
+                        else value.func.attr
+                        if isinstance(value.func, ast.Attribute) else None)
+                if ctor is not None and (
+                        ctor in mod.classes
+                        or ctor in mod.from_imports
+                        or _attr_chain(value.func) is not None):
+                    mod.instances.setdefault(t.id, ctor)
+            ann = mod.guard_ann.get(n.lineno)
+            if ann is not None:
+                mod.module_state[t.id] = ann
+                mod.annotated_state.add(t.id)
+            elif _is_mutable_ctor(value, mod) and not _is_self_sync(value):
+                mod.module_state.setdefault(t.id, None)
+
+
+def _collect_class_method(m: ast.AST, ci: ClassInfo,
+                          mod: ModuleInfo) -> None:
+    in_init = m.name == "__init__"
+    for n in ast.walk(m):
+        if not isinstance(n, (ast.Assign, ast.AnnAssign)):
+            continue
+        targets = n.targets if isinstance(n, ast.Assign) else [n.target]
+        value = n.value
+        if value is None:
+            continue
+        for t in targets:
+            if not (isinstance(t, ast.Attribute)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"):
+                continue
+            lk = _lock_ctor(value, mod)
+            if lk is not None and lk[0]:
+                # Condition(self._lock) aliases the wrapped lock
+                if (isinstance(value, ast.Call) and value.args
+                        and _expr_text(value.args[0]) is not None
+                        and _expr_text(value.args[0]).startswith("self.")):
+                    wrapped = _expr_text(value.args[0]).split(".", 1)[1]
+                    base = ci.lock_attrs.get(wrapped)
+                    if base is not None:
+                        ci.lock_attrs[t.attr] = base
+                        continue
+                ci.lock_attrs[t.attr] = LockDecl(
+                    f"{mod.rel}:{ci.name}.{t.attr}", lk[1], n.lineno)
+                continue
+            ann = mod.guard_ann.get(n.lineno)
+            if ann is not None:
+                ci.shared_attrs[t.attr] = ann
+                ci.annotated.add(t.attr)
+            elif (in_init and _is_mutable_ctor(value, mod)
+                  and not _is_self_sync(value)):
+                ci.shared_attrs.setdefault(t.attr, None)
+
+
+# ---------------------------------------------------------------------------
+# function event scan
+
+
+class Event:
+    __slots__ = ("kind", "key", "line", "held")
+
+    def __init__(self, kind: str, key, line: int, held: Tuple):
+        self.kind = kind    # acquire | mut | read | call
+        self.key = key      # state key / lock text / callee text
+        self.line = line
+        self.held = held    # ((text, with_id), ...) innermost last
+
+
+class FunctionScan(ast.NodeVisitor):
+    """One pass over a function body: acquisitions, state accesses, and
+    calls, each with the stack of `with` contexts open at that point."""
+
+    def __init__(self, node: ast.AST, mod: ModuleInfo,
+                 class_name: Optional[str]):
+        self.node = node
+        self.mod = mod
+        self.class_name = class_name
+        self.name = getattr(node, "name", "<lambda>")
+        self.fkey = (mod.dotted, class_name, self.name)
+        self.events: List[Event] = []
+        self.globals: Set[str] = set()
+        # caller-holds-lock convention: explicit annotation or *_locked
+        line = getattr(node, "lineno", 0)
+        self.requires: Optional[str] = mod.requires_ann.get(line)
+        if self.requires is None and self.name.endswith("_locked"):
+            self.requires = "*"
+        self._held: List[Tuple[str, int]] = []
+
+    def run(self) -> "FunctionScan":
+        for stmt in self.node.body if isinstance(self.node.body, list) \
+                else [self.node.body]:
+            self.visit(stmt)
+        return self
+
+    # -- context ------------------------------------------------------------
+
+    def _snap(self) -> Tuple:
+        return tuple(self._held)
+
+    def visit_With(self, node: ast.With):
+        self._with(node)
+
+    def visit_AsyncWith(self, node: ast.AsyncWith):
+        self._with(node)
+
+    def _with(self, node):
+        pushed = 0
+        for item in node.items:
+            text = _expr_text(item.context_expr)
+            if text is not None:
+                self.events.append(Event("acquire", text, node.lineno,
+                                         self._snap()))
+                self._held.append((text, id(node)))
+                pushed += 1
+            else:
+                self.visit(item.context_expr)
+        for stmt in node.body:
+            self.visit(stmt)
+        del self._held[len(self._held) - pushed:len(self._held)]
+
+    def visit_FunctionDef(self, node):
+        pass  # nested defs are scanned as their own functions
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_ClassDef(self, node):
+        pass
+
+    def visit_Global(self, node: ast.Global):
+        self.globals.update(node.names)
+
+    # -- state access -------------------------------------------------------
+
+    def _target_key(self, t: ast.expr):
+        """State key for an assignment/delete/method target."""
+        if isinstance(t, ast.Name):
+            return ("mod", t.id)
+        if isinstance(t, ast.Attribute):
+            base = _expr_text(t.value)
+            if base is not None:
+                return ("attr", base, t.attr)
+        if isinstance(t, ast.Subscript):
+            return self._target_key(t.value)
+        return None
+
+    def _mut(self, t: ast.expr, line: int):
+        key = self._target_key(t)
+        if key is not None:
+            self.events.append(Event("mut", key, line, self._snap()))
+
+    def _read(self, e: ast.expr, line: int):
+        key = self._target_key(e)
+        if key is not None:
+            self.events.append(Event("read", key, line, self._snap()))
+
+    def visit_Assign(self, node: ast.Assign):
+        for t in node.targets:
+            self._mut(t, node.lineno)
+        self.visit(node.value)
+
+    def visit_AugAssign(self, node: ast.AugAssign):
+        self._mut(node.target, node.lineno)
+        self.visit(node.value)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign):
+        if node.value is not None:
+            self._mut(node.target, node.lineno)
+            self.visit(node.value)
+
+    def visit_Delete(self, node: ast.Delete):
+        for t in node.targets:
+            if isinstance(t, (ast.Subscript, ast.Attribute)):
+                self._mut(t, node.lineno)
+
+    def visit_Subscript(self, node: ast.Subscript):
+        if isinstance(node.ctx, ast.Load):
+            self._read(node.value, node.lineno)
+        self.generic_visit(node)
+
+    def visit_Compare(self, node: ast.Compare):
+        if any(isinstance(op, (ast.In, ast.NotIn)) for op in node.ops):
+            for c in node.comparators:
+                self._read(c, node.lineno)
+        self.generic_visit(node)
+
+    def visit_For(self, node: ast.For):
+        self._read(node.iter, node.lineno)
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call):
+        fn = node.func
+        text = _expr_text(fn)
+        if text is not None:
+            self.events.append(Event("call", text, node.lineno,
+                                     self._snap()))
+        if isinstance(fn, ast.Attribute):
+            if fn.attr in _MUTATING_METHODS:
+                self._mut(fn.value, node.lineno)
+            elif fn.attr in _READ_METHODS:
+                self._read(fn.value, node.lineno)
+            elif fn.attr == "acquire":
+                base = _expr_text(fn.value)
+                if base is not None:
+                    self.events.append(Event("acquire", base, node.lineno,
+                                             self._snap()))
+        elif isinstance(fn, ast.Name) and fn.id == "len" and node.args:
+            self._read(node.args[0], node.lineno)
+        self.generic_visit(node)
+
+    def visit_Name(self, node: ast.Name):
+        # bare-name reads matter only for global scalars; container reads
+        # are caught at their subscript / method / `in` use sites
+        if isinstance(node.ctx, ast.Load) \
+                and node.id in self.mod.module_state \
+                and node.id in self.globals:
+            self._read(node, node.lineno)
+
+
+# ---------------------------------------------------------------------------
+# whole-program analysis
+
+
+class _Analyzer:
+    def __init__(self, modules: List[ModuleInfo], rules: Sequence[str]):
+        self.modules = modules
+        self.by_dotted = {m.dotted: m for m in modules}
+        self.rules = set(rules)
+        self.findings: List[Finding] = []
+        # attr -> (ClassInfo, guard_attr): explicitly annotated attrs are
+        # matched program-wide by attribute name (entry.compiles, ...)
+        self.ann_attr_registry: Dict[str, Tuple[ClassInfo, str]] = {}
+        # lock-attr name -> ClassInfo, when unique program-wide
+        self.lock_attr_owner: Dict[str, Optional[ClassInfo]] = {}
+
+    # -- driver -------------------------------------------------------------
+
+    def run(self) -> List[Finding]:
+        for mod in self.modules:
+            _collect_module(mod)
+            self._prune(mod)
+        self._index()
+        for mod in self.modules:
+            self._scan_functions(mod)
+        self._resolve_guards()
+        for mod in self.modules:
+            for scan in mod.scans:
+                self._check_unguarded(scan)
+                self._check_cta(scan)
+        if "lock-order" in self.rules:
+            self._check_lock_order()
+        if "lock-in-jit" in self.rules:
+            for mod in self.modules:
+                self._check_jit_regions(mod)
+        uniq = {}
+        for f in self.findings:
+            uniq[(f.rule, f.loc, f.message)] = f
+        return sorted(uniq.values(), key=lambda f: (f.loc, f.rule))
+
+    @staticmethod
+    def _prune(mod: ModuleInfo):
+        """Inference only applies where a lock exists to check against:
+        a module with no module-level lock has no inferred module state,
+        a class with no `self.<lock>` has no inferred attrs. Annotated
+        state always stays (the annotation names the guard)."""
+        if not mod.module_locks:
+            for name in list(mod.module_state):
+                if name not in mod.annotated_state:
+                    del mod.module_state[name]
+        for ci in mod.classes.values():
+            if not ci.lock_attrs:
+                for attr in list(ci.shared_attrs):
+                    if attr not in ci.annotated:
+                        del ci.shared_attrs[attr]
+
+    def err(self, mod: ModuleInfo, rule: str, line: int, msg: str):
+        if rule not in self.rules or mod.supp.allowed(rule, line):
+            return
+        self.findings.append(
+            Finding(rule, f"{mod.path}:{line}", msg, "concurrency"))
+
+    def _index(self):
+        for mod in self.modules:
+            for ci in mod.classes.values():
+                for attr in ci.annotated:
+                    guard = ci.shared_attrs[attr]
+                    if guard and guard.startswith("self."):
+                        self.ann_attr_registry.setdefault(
+                            attr, (ci, guard.split(".", 1)[1]))
+                for la, decl in ci.lock_attrs.items():
+                    if la in self.lock_attr_owner:
+                        self.lock_attr_owner[la] = None  # ambiguous
+                    else:
+                        self.lock_attr_owner[la] = ci
+
+    def _scan_functions(self, mod: ModuleInfo):
+        # every def, tagged with the nearest enclosing class (if any)
+        def walk(body, class_name):
+            for n in body:
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    mod.scans.append(
+                        FunctionScan(n, mod, class_name).run())
+                    walk(n.body, class_name)
+                elif isinstance(n, ast.ClassDef):
+                    walk(n.body, n.name)
+                elif hasattr(n, "body") and isinstance(
+                        getattr(n, "body", None), list):
+                    walk(n.body, class_name)
+                    for attr in ("orelse", "finalbody", "handlers"):
+                        sub = getattr(n, attr, None) or []
+                        for s in sub:
+                            if hasattr(s, "body"):
+                                walk(s.body, class_name)
+                            elif isinstance(s, (ast.FunctionDef,
+                                                ast.AsyncFunctionDef)):
+                                walk([s], class_name)
+
+        walk(mod.tree.body, None)
+        # def-line `# lint: allow(...)` covers the function body
+        mod.supp.cover_functions([s.node for s in mod.scans])
+
+    # -- guard resolution ---------------------------------------------------
+
+    def _resolve_guards(self):
+        for mod in self.modules:
+            mut_held: Dict[str, List[str]] = {}
+            for scan in mod.scans:
+                for ev in scan.events:
+                    if ev.kind == "mut" and ev.key[0] == "mod" \
+                            and ev.key[1] in mod.module_state:
+                        mut_held.setdefault(ev.key[1], []).extend(
+                            t for t, _ in ev.held)
+            for name, guard in list(mod.module_state.items()):
+                if guard is not None:
+                    continue
+                mod.module_state[name] = self._vote(
+                    mut_held.get(name, ()), mod.module_locks)
+            # include global-rebound scalars in locked modules: a bare
+            # `_loaded = True` in a `global` function is shared state
+            if mod.module_locks:
+                gnames = set()
+                for scan in mod.scans:
+                    gnames |= scan.globals & mod.top_names
+                for name in gnames:
+                    if name not in mod.module_state \
+                            and name not in mod.module_locks:
+                        held = []
+                        for scan in mod.scans:
+                            for ev in scan.events:
+                                if ev.kind == "mut" \
+                                        and ev.key == ("mod", name) \
+                                        and name in scan.globals:
+                                    held.extend(t for t, _ in ev.held)
+                        mod.module_state[name] = self._vote(
+                            held, mod.module_locks)
+            for ci in mod.classes.values():
+                amut: Dict[str, List[str]] = {}
+                for scan in mod.scans:
+                    if scan.class_name != ci.name:
+                        continue
+                    for ev in scan.events:
+                        if ev.kind == "mut" and ev.key[0] == "attr" \
+                                and ev.key[1] == "self" \
+                                and ev.key[2] in ci.shared_attrs:
+                            amut.setdefault(ev.key[2], []).extend(
+                                t for t, _ in ev.held)
+                for attr, guard in list(ci.shared_attrs.items()):
+                    if guard is not None:
+                        continue
+                    locks = {f"self.{a}": d
+                             for a, d in ci.lock_attrs.items()}
+                    ci.shared_attrs[attr] = self._vote(
+                        amut.get(attr, ()), locks,
+                        prefix_self=ci.lock_attrs)
+
+    @staticmethod
+    def _vote(held_texts, locks: Dict[str, LockDecl],
+              prefix_self: Optional[Dict[str, LockDecl]] = None) -> str:
+        """Pick the guard for an unannotated state: the only lock in
+        scope, else the lock wrapping the most mutation sites."""
+        if prefix_self is not None:
+            names = [f"self.{a}" for a in prefix_self]
+        else:
+            names = list(locks)
+        if len(names) == 1:
+            return names[0]
+        counts = {n: 0 for n in names}
+        for t in held_texts:
+            if t in counts:
+                counts[t] += 1
+        best = max(names, key=lambda n: counts[n]) if names else "?"
+        return best
+
+    # -- lock resolution ----------------------------------------------------
+
+    def _resolve_lock(self, text: str, mod: ModuleInfo,
+                      class_name: Optional[str]) -> Optional[LockDecl]:
+        """LockDecl for a `with <text>` acquisition, or None when the
+        expression is not a known lock."""
+        if "." not in text:
+            decl = mod.module_locks.get(text)
+            if decl is not None:
+                return decl
+            src = mod.from_imports.get(text)
+            if src is not None:
+                other = self.by_dotted.get(src[0])
+                if other is not None:
+                    return other.module_locks.get(src[1])
+            return None
+        root, attr = text.split(".", 1)[0], text.rsplit(".", 1)[1]
+        if root == "self" and class_name is not None:
+            ci = mod.classes.get(class_name)
+            if ci is not None and attr in ci.lock_attrs:
+                return ci.lock_attrs[attr]
+        cls = self._instance_class(root, mod)
+        if cls is not None and attr in cls.lock_attrs:
+            return cls.lock_attrs[attr]
+        owner = self.lock_attr_owner.get(attr)
+        if owner is not None:
+            return owner.lock_attrs[attr]
+        return None
+
+    def _instance_class(self, name: str, mod: ModuleInfo) \
+            -> Optional[ClassInfo]:
+        ctor = mod.instances.get(name)
+        if ctor is None:
+            return None
+        ci = mod.classes.get(ctor)
+        if ci is not None:
+            return ci
+        src = mod.from_imports.get(ctor)
+        if src is not None:
+            other = self.by_dotted.get(src[0])
+            if other is not None:
+                return other.classes.get(src[1])
+        return None
+
+    def _held_locks(self, scan: FunctionScan, held: Tuple) \
+            -> List[Tuple[str, int, Optional[LockDecl]]]:
+        out = []
+        for text, wid in held:
+            decl = self._resolve_lock(text, scan.mod, scan.class_name)
+            if decl is not None or "lock" in text.lower():
+                out.append((text, wid, decl))
+        return out
+
+    # -- state resolution at an access site ---------------------------------
+
+    def _state_guard(self, scan: FunctionScan, key) \
+            -> Optional[Tuple[str, str, Optional[LockDecl]]]:
+        """(state display name, required guard text, guard LockDecl) for
+        an access key, or None when the key is not registered state."""
+        mod = scan.mod
+        if key[0] == "mod":
+            name = key[1]
+            guard = mod.module_state.get(name)
+            if guard is None:
+                return None
+            return name, guard, self._resolve_lock(
+                guard, mod, scan.class_name)
+        _, root, attr = key
+        if root == "self" and scan.class_name is not None:
+            ci = mod.classes.get(scan.class_name)
+            if ci is not None and attr in ci.shared_attrs:
+                guard = ci.shared_attrs[attr] or "?"
+                return (f"self.{attr}", guard,
+                        self._resolve_lock(guard, mod, scan.class_name))
+        if root != "self":
+            cls = self._instance_class(root.split(".")[0], mod)
+            if cls is not None and attr in cls.shared_attrs:
+                guard = cls.shared_attrs[attr] or "?"
+                req = guard.replace("self.", f"{root}.", 1) \
+                    if guard.startswith("self.") else guard
+                decl = (cls.lock_attrs.get(guard.split(".", 1)[1])
+                        if guard.startswith("self.") else None)
+                return f"{root}.{attr}", req, decl
+            reg = self.ann_attr_registry.get(attr)
+            if reg is not None:
+                ci, guard_attr = reg
+                return (f"{root}.{attr}", f"{root}.{guard_attr}",
+                        ci.lock_attrs.get(guard_attr))
+        return None
+
+    @staticmethod
+    def _match_held(held_locks, req_text: str,
+                    req_decl: Optional[LockDecl]) -> Optional[int]:
+        """with-node id of the held entry satisfying the guard, else
+        None. Matches by resolved lock identity first (Condition
+        aliases), then by text."""
+        for text, wid, decl in held_locks:
+            if req_decl is not None and decl is not None \
+                    and decl.id == req_decl.id:
+                return wid
+            if text == req_text:
+                return wid
+        return None
+
+    # -- rule: unguarded ----------------------------------------------------
+
+    _EXEMPT_FNS = {"__init__", "__new__", "__post_init__", "__del__"}
+
+    def _check_unguarded(self, scan: FunctionScan):
+        if scan.requires is not None:
+            # body runs with the lock held by contract; call sites are
+            # checked below instead
+            pass
+        for ev in scan.events:
+            if ev.kind == "call" and ev.key.split(".")[-1].endswith(
+                    "_locked") and scan.requires is None:
+                if not self._held_locks(scan, ev.held):
+                    self.err(scan.mod, "unguarded", ev.line,
+                             f"call to '{ev.key}' (caller-holds-lock "
+                             f"convention) without any lock held")
+                continue
+            if ev.kind != "mut":
+                continue
+            sg = self._state_guard(scan, ev.key)
+            if sg is None:
+                continue
+            name, req, decl = sg
+            if scan.name in self._EXEMPT_FNS and ev.key[0] == "attr" \
+                    and ev.key[1] == "self":
+                continue  # object not yet shared during construction
+            if scan.requires is not None:
+                if scan.requires == "*" or scan.requires == req \
+                        or (decl is not None and self._resolve_lock(
+                            scan.requires, scan.mod, scan.class_name)
+                            is decl):
+                    continue
+            held = self._held_locks(scan, ev.held)
+            if self._match_held(held, req, decl) is None:
+                self.err(scan.mod, "unguarded", ev.line,
+                         f"mutation of shared state '{name}' (guarded by "
+                         f"'{req}') outside its critical section")
+
+    # -- rule: check-then-act -----------------------------------------------
+
+    def _check_cta(self, scan: FunctionScan):
+        if scan.requires is not None:
+            return  # whole body is one critical section by contract
+        reads: Dict[Tuple, List[Tuple[int, int]]] = {}
+        muts: Dict[Tuple, List[Tuple[int, int]]] = {}
+        mut_lines: Dict[Tuple, Set[int]] = {}
+        for ev in scan.events:
+            if ev.kind not in ("read", "mut"):
+                continue
+            sg = self._state_guard(scan, ev.key)
+            if sg is None:
+                continue
+            name, req, decl = sg
+            wid = self._match_held(
+                self._held_locks(scan, ev.held), req, decl)
+            if wid is None:
+                continue  # unguarded accesses are the other rule's job
+            (muts if ev.kind == "mut" else reads).setdefault(
+                ev.key, []).append((ev.line, wid))
+            if ev.kind == "mut":
+                mut_lines.setdefault(ev.key, set()).add(ev.line)
+        for key, ms in muts.items():
+            name = self._state_guard(scan, key)[0]
+            for mline, mwid in ms:
+                for rline, rwid in reads.get(key, ()):
+                    # a read on a mutation line is part of that mutation
+                    # (x += 1), not a decision the code acts on later
+                    if rline >= mline or rwid == mwid \
+                            or rline in mut_lines.get(key, ()):
+                        continue
+                    self.err(scan.mod, "check-then-act", mline,
+                             f"mutation of '{name}' in a different "
+                             f"critical section than its read at line "
+                             f"{rline} — the decision is stale by the "
+                             f"time this runs; widen the critical "
+                             f"section or re-validate under the lock")
+                    break
+
+    # -- rule: lock-order ---------------------------------------------------
+
+    def _check_lock_order(self):
+        # may-acquire fixpoint over the project call graph
+        direct: Dict[Tuple, Set[str]] = {}
+        callees: Dict[Tuple, Set[Tuple]] = {}
+        decls: Dict[str, LockDecl] = {}
+        scans: Dict[Tuple, FunctionScan] = {}
+        for mod in self.modules:
+            for scan in mod.scans:
+                scans[scan.fkey] = scan
+        for mod in self.modules:
+            for scan in mod.scans:
+                d = direct.setdefault(scan.fkey, set())
+                c = callees.setdefault(scan.fkey, set())
+                for ev in scan.events:
+                    if ev.kind == "acquire":
+                        decl = self._resolve_lock(
+                            ev.key, mod, scan.class_name)
+                        if decl is not None:
+                            d.add(decl.id)
+                            decls[decl.id] = decl
+                    elif ev.kind == "call":
+                        fk = self._resolve_call(ev.key, scan)
+                        if fk is not None and fk in scans:
+                            c.add(fk)
+        may = {fk: set(v) for fk, v in direct.items()}
+        changed = True
+        while changed:
+            changed = False
+            for fk, cs in callees.items():
+                for g in cs:
+                    add = may.get(g, ()) - may[fk]
+                    if add:
+                        may[fk] |= add
+                        changed = True
+        edges: Dict[Tuple[str, str], Tuple[ModuleInfo, int]] = {}
+        for mod in self.modules:
+            for scan in mod.scans:
+                for ev in scan.events:
+                    held = [(t, w, d) for t, w, d
+                            in self._held_locks(scan, ev.held)
+                            if d is not None]
+                    if ev.kind == "acquire":
+                        decl = self._resolve_lock(
+                            ev.key, mod, scan.class_name)
+                        if decl is None:
+                            continue
+                        for _, _, h in held:
+                            if h.id == decl.id:
+                                if not decl.reentrant:
+                                    self.err(
+                                        mod, "lock-order", ev.line,
+                                        f"re-acquisition of non-reentrant "
+                                        f"lock '{ev.key}' already held — "
+                                        f"self-deadlock")
+                            else:
+                                edges.setdefault(
+                                    (h.id, decl.id), (mod, ev.line))
+                    elif ev.kind == "call" and held:
+                        fk = self._resolve_call(ev.key, scan)
+                        if fk is None or fk not in may:
+                            continue
+                        for lid in may[fk]:
+                            for _, _, h in held:
+                                if h.id == lid:
+                                    if not h.reentrant:
+                                        self.err(
+                                            mod, "lock-order", ev.line,
+                                            f"call to '{ev.key}' may "
+                                            f"re-acquire non-reentrant "
+                                            f"lock '{h.id}' already held "
+                                            f"— self-deadlock")
+                                else:
+                                    edges.setdefault(
+                                        (h.id, lid), (mod, ev.line))
+        self._report_cycles(edges)
+
+    def _resolve_call(self, text: str, scan: FunctionScan) \
+            -> Optional[Tuple]:
+        mod = scan.mod
+        parts = text.split(".")
+        if len(parts) == 1:
+            name = parts[0]
+            if any(s.fkey == (mod.dotted, None, name) for s in mod.scans):
+                return (mod.dotted, None, name)
+            if name in mod.classes:  # ClassName(...) -> __init__
+                return (mod.dotted, name, "__init__")
+            src = mod.from_imports.get(name)
+            if src is not None:
+                other = self.by_dotted.get(src[0])
+                if other is not None:
+                    if src[1] in other.classes:
+                        return (other.dotted, src[1], "__init__")
+                    return (other.dotted, None, src[1])
+            return None
+        root, meth = parts[0], parts[-1]
+        if root == "self" and scan.class_name is not None:
+            # self.m() only — self.attr.m() is a call on the attribute
+            # (dict.get etc.), not on this class
+            if len(parts) == 2:
+                ci = mod.classes.get(scan.class_name)
+                if ci is not None and meth in ci.methods:
+                    return (mod.dotted, scan.class_name, meth)
+            return None
+        target_mod, inst = mod, parts[0]
+        if root in mod.import_aliases:
+            dotted = mod.import_aliases[root]
+            other = self.by_dotted.get(dotted)
+            if other is None:
+                return None
+            if len(parts) == 2:
+                return (other.dotted, None, meth)
+            if len(parts) != 3:
+                return None
+            target_mod, inst = other, parts[1]
+        elif len(parts) != 2:
+            return None
+        cls = self._instance_class(inst, target_mod)
+        if cls is not None and meth in cls.methods:
+            return (cls.module.dotted, cls.name, meth)
+        return None
+
+    def _report_cycles(self, edges):
+        graph: Dict[str, Set[str]] = {}
+        for (a, b) in edges:
+            graph.setdefault(a, set()).add(b)
+            graph.setdefault(b, set())
+        seen_cycles: Set[frozenset] = set()
+
+        def dfs(start, node, path, onpath):
+            for nxt in graph.get(node, ()):
+                if nxt == start and len(path) > 1:
+                    key = frozenset(path)
+                    if key not in seen_cycles:
+                        seen_cycles.add(key)
+                        first = path[0], path[1] if len(path) > 1 \
+                            else start
+                        mod, line = edges.get(
+                            (path[0], path[1]),
+                            edges.get((path[-1], start),
+                                      next(iter(edges.values()))))
+                        cyc = " -> ".join(path + [start])
+                        self.err(mod, "lock-order", line,
+                                 f"lock-order cycle (deadlock "
+                                 f"potential): {cyc}")
+                elif nxt not in onpath and nxt > start:
+                    dfs(start, nxt, path + [nxt], onpath | {nxt})
+
+        for n in sorted(graph):
+            dfs(n, n, [n], {n})
+
+    # -- rule: lock-in-jit --------------------------------------------------
+
+    def _check_jit_regions(self, mod: ModuleInfo):
+        for fn in kernel_functions(mod.tree, mod.path):
+            cname = None
+            for scan in mod.scans:
+                if scan.node is fn:
+                    cname = scan.class_name
+                    break
+            for n in ast.walk(fn):
+                if isinstance(n, (ast.With, ast.AsyncWith)):
+                    for item in n.items:
+                        text = _expr_text(item.context_expr)
+                        if text is None:
+                            continue
+                        if self._resolve_lock(text, mod, cname) \
+                                is not None or "lock" in text.lower():
+                            self.err(
+                                mod, "lock-in-jit", n.lineno,
+                                f"lock acquisition '{text}' inside a "
+                                f"jit-traced region — traced Python "
+                                f"runs once per compile, so this guards "
+                                f"nothing at execution time and can "
+                                f"deadlock under the compile path")
+                elif isinstance(n, ast.Call) \
+                        and isinstance(n.func, ast.Attribute) \
+                        and n.func.attr == "acquire":
+                    text = _expr_text(n.func.value)
+                    if text is not None and (
+                            self._resolve_lock(text, mod, cname)
+                            is not None or "lock" in text.lower()):
+                        self.err(
+                            mod, "lock-in-jit", n.lineno,
+                            f"'{text}.acquire()' inside a jit-traced "
+                            f"region — locks have no meaning in traced "
+                            f"code")
+
+
+# ---------------------------------------------------------------------------
+# drivers
+
+
+def analyze_modules(modules: Sequence[Tuple[str, str, ast.AST]],
+                    rules: Sequence[str] = RULES) -> List[Finding]:
+    """Run the whole-program analysis over (source, path, tree) triples."""
+    infos = []
+    for source, path, tree in modules:
+        infos.append(ModuleInfo(source, path, tree))
+    return _Analyzer(infos, rules).run()
+
+
+def analyze_source(source: str, path: str,
+                   rules: Sequence[str] = RULES) -> List[Finding]:
+    """Single-module convenience wrapper (tests, injected snippets)."""
+    try:
+        tree = astutil.parse(source, path)
+    except SyntaxError as e:
+        return [Finding("syntax-error", f"{path}:{e.lineno or 0}",
+                        str(e.msg), "concurrency")]
+    return analyze_modules([(source, path, tree)], rules)
+
+
+def analyze_paths(paths: Sequence[str],
+                  rules: Sequence[str] = RULES) -> List[Finding]:
+    """Whole-program analysis over files/directories (the CLI entry)."""
+    modules = []
+    findings: List[Finding] = []
+    for p in astutil.iter_py_files(paths):
+        try:
+            src, tree = astutil.load_file(p)
+        except SyntaxError as e:
+            findings.append(Finding("syntax-error", f"{p}:{e.lineno or 0}",
+                                    str(e.msg), "concurrency"))
+            continue
+        modules.append((src, p, tree))
+    findings.extend(analyze_modules(modules, rules))
+    return findings

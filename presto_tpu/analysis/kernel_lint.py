@@ -61,15 +61,19 @@ offending line; on a `def` line it covers the whole function.
 from __future__ import annotations
 
 import ast
-import re
-from typing import Dict, List, Optional, Sequence, Set, Tuple
+from typing import List, Sequence, Set
 
+from presto_tpu.analysis import astutil
+from presto_tpu.analysis.astutil import (
+    Suppressions,
+    _attr_chain,
+    _root_name,
+    kernel_functions,
+)
 from presto_tpu.analysis.findings import Finding
 
 RULES = ("host-sync", "float64", "traced-branch", "pow2-capacity",
          "where-free-masking", "ref-indexing")
-
-_ALLOW_RE = re.compile(r"#\s*lint:\s*allow\(([a-z0-9_,\- ]+)\)")
 
 _NUMPY_ALIASES = {"np", "numpy"}
 _JAX_NUMPY_ALIASES = {"jnp"}
@@ -91,19 +95,6 @@ _DTYPE_PREDICATES = {"issubdtype", "isdtype", "iinfo", "finfo",
 
 def _is_pow2(n: int) -> bool:
     return n >= 0 and (n & (n - 1)) == 0
-
-
-def _root_name(e: ast.expr) -> Optional[str]:
-    while isinstance(e, ast.Attribute):
-        e = e.value
-    return e.id if isinstance(e, ast.Name) else None
-
-
-def _attr_chain(e: ast.expr) -> Optional[Tuple[str, str]]:
-    """`np.float64` -> ("np", "float64"); one-level chains only."""
-    if isinstance(e, ast.Attribute) and isinstance(e.value, ast.Name):
-        return e.value.id, e.attr
-    return None
 
 
 def _is_static_expr(e: ast.expr, tainted: frozenset = frozenset()) -> bool:
@@ -211,128 +202,10 @@ def _collect_taint(fn: ast.AST) -> frozenset:
     return frozenset(tainted)
 
 
-class _Suppressions:
-    def __init__(self, source: str):
-        self.lines: Dict[int, Set[str]] = {}
-        for i, line in enumerate(source.splitlines(), start=1):
-            m = _ALLOW_RE.search(line)
-            if m:
-                self.lines[i] = {r.strip() for r in m.group(1).split(",")}
-        # function-level: allow() on a def/lambda line covers its body
-        self.spans: List[Tuple[int, int, Set[str]]] = []
-
-    def add_span(self, lo: int, hi: int, rules: Set[str]):
-        self.spans.append((lo, hi, rules))
-
-    def allowed(self, rule: str, line: int) -> bool:
-        if rule in self.lines.get(line, ()):
-            return True
-        return any(lo <= line <= hi and rule in rules
-                   for lo, hi, rules in self.spans)
-
-
-# ---------------------------------------------------------------------------
-# kernel-region discovery
-
-
-def _collect_functions(tree: ast.AST) -> Dict[str, List[ast.AST]]:
-    """name -> every def with that name, any nesting depth."""
-    out: Dict[str, List[ast.AST]] = {}
-    for n in ast.walk(tree):
-        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            out.setdefault(n.name, []).append(n)
-    return out
-
-
-def _is_jax_jit(e: ast.expr) -> bool:
-    chain = _attr_chain(e)
-    if chain is not None:
-        return chain == ("jax", "jit")
-    return isinstance(e, ast.Name) and e.id == "jit"
-
-
-def _jit_roots(tree: ast.AST,
-               funcs: Dict[str, List[ast.AST]]) -> List[ast.AST]:
-    roots: List[ast.AST] = []
-
-    def add_target(e: ast.expr):
-        if isinstance(e, ast.Lambda):
-            roots.append(e)
-        elif isinstance(e, ast.Name):
-            roots.extend(funcs.get(e.id, ()))
-
-    def is_partial(e: ast.expr) -> bool:
-        return ((isinstance(e, ast.Name) and e.id == "partial")
-                or _attr_chain(e) == ("functools", "partial"))
-
-    for n in ast.walk(tree):
-        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            for dec in n.decorator_list:
-                if _is_jax_jit(dec):
-                    roots.append(n)
-                elif isinstance(dec, ast.Call):
-                    # @partial(jax.jit, ...) / @jax.jit(...)
-                    if _is_jax_jit(dec.func):
-                        roots.append(n)
-                    elif (isinstance(dec.func, ast.Name)
-                          and dec.func.id == "partial" and dec.args
-                          and _is_jax_jit(dec.args[0])):
-                        roots.append(n)
-        if not isinstance(n, ast.Call):
-            continue
-        if _is_jax_jit(n.func) and n.args:
-            add_target(n.args[0])
-        fname = (n.func.id if isinstance(n.func, ast.Name)
-                 else n.func.attr if isinstance(n.func, ast.Attribute)
-                 else None)
-        if fname == "pallas_call" and n.args:
-            # pl.pallas_call(kernel, ...) — the kernel body IS device
-            # code, wherever the module lives; unwrap partial(kernel, ..)
-            tgt = n.args[0]
-            if isinstance(tgt, ast.Call) and is_partial(tgt.func) \
-                    and tgt.args:
-                tgt = tgt.args[0]
-            add_target(tgt)
-        if fname == "_node_jit" and len(n.args) >= 3:
-            builder = n.args[2]
-            if isinstance(builder, ast.Lambda):
-                add_target(builder.body)
-            elif isinstance(builder, ast.Name):
-                # builder by reference: its return value is jitted; treat
-                # the builder body itself as kernel code (the inner defs
-                # are reached transitively)
-                roots.extend(funcs.get(builder.id, ()))
-    return roots
-
-
-def _called_names(fn: ast.AST) -> Set[str]:
-    out: Set[str] = set()
-    for n in ast.walk(fn):
-        if isinstance(n, ast.Call) and isinstance(n.func, ast.Name):
-            out.add(n.func.id)
-    return out
-
-
-def kernel_functions(tree: ast.AST, path: str) -> List[ast.AST]:
-    """The kernel region: every def in ops/ modules; jit-rooted defs (plus
-    same-module transitive callees) elsewhere."""
-    funcs = _collect_functions(tree)
-    norm = path.replace("\\", "/")
-    if ("/ops/" in norm or norm.startswith("ops/")
-            or norm.endswith("exec/fragment_jit.py")):
-        return [f for fs in funcs.values() for f in fs]
-    work = list(_jit_roots(tree, funcs))
-    seen: List[ast.AST] = []
-    seen_ids: Set[int] = set()
-    while work:
-        f = work.pop()
-        if id(f) in seen_ids:
-            continue
-        seen_ids.add(id(f))
-        seen.append(f)
-        for name in _called_names(f):
-            work.extend(funcs.get(name, ()))
-    return seen
+# kernel-region discovery and the `# lint: allow(...)` suppression index
+# live in astutil (shared with the concurrency pass — one traversal for
+# both analyses); `Suppressions` and `kernel_functions` are re-imported
+# above.
 
 
 # ---------------------------------------------------------------------------
@@ -340,7 +213,7 @@ def kernel_functions(tree: ast.AST, path: str) -> List[ast.AST]:
 
 
 class _RuleVisitor(ast.NodeVisitor):
-    def __init__(self, path: str, supp: _Suppressions,
+    def __init__(self, path: str, supp: Suppressions,
                  rules: Sequence[str], tainted: frozenset = frozenset()):
         self.path = path
         self.supp = supp
@@ -590,21 +463,20 @@ def _has_bare_float(e: ast.expr) -> bool:
 
 
 def lint_source(source: str, path: str,
-                rules: Sequence[str] = RULES) -> List[Finding]:
-    """Lint one module's source text; `path` labels the findings."""
-    try:
-        tree = ast.parse(source, filename=path)
-    except SyntaxError as e:
-        return [Finding("syntax-error", f"{path}:{e.lineno or 0}",
-                        str(e.msg), "lint")]
-    supp = _Suppressions(source)
+                rules: Sequence[str] = RULES,
+                tree: ast.AST = None) -> List[Finding]:
+    """Lint one module's source text; `path` labels the findings. Pass a
+    pre-parsed `tree` to share the AST with other analysis passes."""
+    if tree is None:
+        try:
+            tree = astutil.parse(source, path)
+        except SyntaxError as e:
+            return [Finding("syntax-error", f"{path}:{e.lineno or 0}",
+                            str(e.msg), "lint")]
+    supp = Suppressions(source)
     kernels = kernel_functions(tree, path)
     # def-line suppressions cover the function body
-    for fn in kernels:
-        line = getattr(fn, "lineno", None)
-        end = getattr(fn, "end_lineno", None)
-        if line is not None and end is not None and line in supp.lines:
-            supp.add_span(line, end, supp.lines[line])
+    supp.cover_functions(kernels)
     findings: List[Finding] = []
     visited: Set[int] = set()
     nested: Set[int] = set()
@@ -633,17 +505,13 @@ def lint_source(source: str, path: str,
 
 def lint_paths(paths: Sequence[str],
                rules: Sequence[str] = RULES) -> List[Finding]:
-    import os
-
     findings: List[Finding] = []
-    for p in paths:
-        if os.path.isdir(p):
-            for name in sorted(os.listdir(p)):
-                if name.endswith(".py"):
-                    findings.extend(
-                        lint_paths([os.path.join(p, name)], rules))
+    for p in astutil.iter_py_files(paths):
+        try:
+            src, tree = astutil.load_file(p)
+        except SyntaxError as e:
+            findings.append(Finding("syntax-error", f"{p}:{e.lineno or 0}",
+                                    str(e.msg), "lint"))
             continue
-        with open(p, encoding="utf-8") as f:
-            src = f.read()
-        findings.extend(lint_source(src, p, rules))
+        findings.extend(lint_source(src, p, rules, tree=tree))
     return findings

@@ -108,7 +108,10 @@ class DbapiConnector(DeviceSplitCache, Connector):
         cols = [ColumnInfo(c, t, None) for c, t in zip(col_names, types)]
         h = TableHandle(self.name, name, cols, row_count=float(nrows))
         with self._lock:
-            self._handles[name] = h
+            # the remote schema probe above runs outside the lock by
+            # design; racing probes produce equivalent handles and the
+            # insert is idempotent (last writer wins)
+            self._handles[name] = h  # lint: allow(check-then-act)
         return h
 
     def splits(self, handle: TableHandle, desired: int = 1) -> List[Split]:

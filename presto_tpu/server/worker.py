@@ -475,7 +475,11 @@ class TaskManager:
         # reported to the coordinator's ClusterMemoryManager
         self._query_pools: Dict[str, "QueryScopedPool"] = {}
 
-    def _pool_for(self, task_id: str):
+    def _pool_for_locked(self, task_id: str):
+        """Caller holds self._lock: the lookup and the insert must share
+        one critical section, or two tasks of the same query arriving
+        concurrently fork the query's reservations across two pools and
+        the coordinator's per-query memory view undercounts."""
         from presto_tpu.memory import QueryScopedPool
 
         # task ids are "{query_id}.{fragment}.{index}" (coordinator.execute)
@@ -506,7 +510,7 @@ class TaskManager:
             t = self.tasks.get(task_id)
             if t is None:
                 t = TaskExecution(task_id, update, self.catalog,
-                                  self._pool_for(task_id),
+                                  self._pool_for_locked(task_id),
                                   self.spill_manager,
                                   executor=self.executor,
                                   trace_token=trace_token,

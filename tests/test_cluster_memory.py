@@ -271,8 +271,10 @@ def test_worker_status_reports_query_memory():
     tm.tasks = {}
     tm._lock = threading.Lock()
     tm._query_pools = {}
-    qp = tm._pool_for("20240101_000001.1.0")
-    qp2 = tm._pool_for("20240101_000001.2.3")
+    with tm._lock:  # _locked convention: lookup+insert under the lock
+        qp = tm._pool_for_locked("20240101_000001.1.0")
+    with tm._lock:
+        qp2 = tm._pool_for_locked("20240101_000001.2.3")
     assert qp is qp2  # same query → same scoped pool
     qp.reserve(4096)
     assert tm.query_memory() == {"20240101_000001": 4096}
