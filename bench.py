@@ -41,6 +41,8 @@ Env knobs:
   BENCH_DATA_DIR  dataset directory (default <repo>/.bench_data)
   BENCH_SF_Q9 / BENCH_SF_Q64  override the big scale factors (default 100)
   BENCH_SF_MESH   scale factor for the mesh_scaling sweep (default 0.1)
+  BENCH_SF_SERVING / BENCH_SERVING_CLIENTS / BENCH_SERVING_QUERIES
+                  serving_slo closed-loop knobs (default 0.1 / 8 / 4)
   BENCH_PALLAS=1  run aggregation configs with the Pallas MXU kernel
 """
 
@@ -405,6 +407,157 @@ def _mesh_child(n_dev: int, sf: float):
     }), flush=True)
 
 
+def _histogram_quantile(body: str, family: str, q: float):
+    """Quantile from a Prometheus log-bucket histogram exposition, summed
+    over every label set of the family (cumulative counts add across
+    groups at equal `le` edges). Linear interpolation inside the bucket;
+    None when the family has no samples."""
+    import re
+
+    pat = re.compile(rf"^{family}_bucket{{(.*)}} (\S+)$")
+    buckets = {}
+    for ln in body.splitlines():
+        m = pat.match(ln)
+        if not m:
+            continue
+        le = None
+        for part in m.group(1).split(","):
+            k, _, v = part.partition("=")
+            if k.strip() == "le":
+                le = float("inf") if v.strip('"') == "+Inf" else float(
+                    v.strip('"'))
+        if le is not None:
+            buckets[le] = buckets.get(le, 0.0) + float(m.group(2))
+    if not buckets:
+        return None
+    edges = sorted(buckets)
+    total = buckets[edges[-1]]
+    if total <= 0:
+        return None
+    target = q * total
+    prev_edge, prev_count = 0.0, 0.0
+    for e in edges:
+        c = buckets[e]
+        if c >= target:
+            if e == float("inf"):
+                return prev_edge
+            span = c - prev_count
+            frac = (target - prev_count) / span if span > 0 else 1.0
+            return prev_edge + frac * (e - prev_edge)
+        prev_edge, prev_count = e, c
+    return edges[-2] if len(edges) > 1 else edges[-1]
+
+
+def _serving_child(sf: float, n_clients: int, per_client: int):
+    """One closed-loop serving run: boot an in-process cluster over the
+    parquet dataset, drive n_clients concurrent client threads through a
+    mixed TPC-H workload over the real statement protocol, then read
+    p50/p99 queue-wait and e2e off the lifecycle SLO histograms the
+    coordinator scraped up (/v1/metrics — the same numbers an operator's
+    dashboard would chart)."""
+    import threading
+    import urllib.request
+
+    if os.environ.get("BENCH_FORCE_CPU"):
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    from presto_tpu.catalog.parquet import ParquetConnector, export_tpch_chunked
+    from presto_tpu.connector import Catalog
+    from presto_tpu.server.coordinator import DistributedRunner
+
+    d = os.path.join(DATA_DIR, f"tpch_sf{sf:g}")
+    export_tpch_chunked(d, sf, log=_log)
+    cat = Catalog()
+    conn = ParquetConnector(d, name="tpch")
+    cat.register("tpch", conn, default=True)
+    nrows = int(conn.get_table("lineitem").row_count)
+    dr = DistributedRunner(cat, n_workers=2)
+    base = dr.coordinator.url
+    mix = [Q1, Q6, JOIN_SF1]
+    errors = []
+    client_walls = []
+    lock = threading.Lock()
+
+    def client(cid: int):
+        for i in range(per_client):
+            sql = mix[(cid + i) % len(mix)]
+            t0 = time.perf_counter()
+            try:
+                req = urllib.request.Request(
+                    base + "/v1/statement", data=sql.encode(),
+                    headers={"X-Presto-User": f"bench-{cid}",
+                             "Content-Type": "text/plain"})
+                doc = json.loads(urllib.request.urlopen(
+                    req, timeout=600).read())
+                while doc.get("nextUri"):
+                    doc = json.loads(urllib.request.urlopen(
+                        doc["nextUri"], timeout=600).read())
+                if doc.get("error"):
+                    raise RuntimeError(doc["error"].get("message"))
+                with lock:
+                    client_walls.append(time.perf_counter() - t0)
+            except Exception as e:  # noqa: BLE001
+                with lock:
+                    errors.append(f"{type(e).__name__}: {e}")
+                return
+
+    t0 = time.time()
+    threads = [threading.Thread(target=client, args=(c,))
+               for c in range(n_clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.time() - t0
+    body = urllib.request.urlopen(
+        base + "/v1/metrics", timeout=30).read().decode()
+    dr.close()
+    rec = {
+        "clients": n_clients, "queries": len(client_walls),
+        "errors": errors[:5], "sf": sf, "sf_actual": sf, "rows": nrows,
+        "wall_s": round(wall, 2),
+        "queries_per_sec": round(len(client_walls) / wall, 3) if wall else 0,
+    }
+    for seg, fam in (("queue_wait", "presto_tpu_query_queue_wait_seconds"),
+                     ("e2e", "presto_tpu_query_e2e_seconds")):
+        for q, label in ((0.5, "p50"), (0.99, "p99")):
+            v = _histogram_quantile(body, fam, q)
+            rec[f"{seg}_{label}_s"] = round(v, 4) if v is not None else None
+    print(json.dumps(rec), flush=True)
+
+
+def _run_serving_slo(extra: dict, remaining: float):
+    """Closed-loop serving-SLO bench: N concurrent protocol clients over a
+    mixed TPC-H workload, latencies read from the per-group lifecycle
+    histograms (log buckets, so the p99 is bucket-interpolated — same
+    fidelity a Prometheus `histogram_quantile` would report)."""
+    sf = float(os.environ.get("BENCH_SF_SERVING", "0.1"))
+    n_clients = int(os.environ.get("BENCH_SERVING_CLIENTS", "8"))
+    per_client = int(os.environ.get("BENCH_SERVING_QUERIES", "4"))
+    env = dict(os.environ)
+    try:
+        p = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--serving-child",
+             str(sf), str(n_clients), str(per_client)],
+            env=env, stdout=subprocess.PIPE,
+            timeout=min(900, max(120, remaining - 15)))
+        lines = p.stdout.decode().strip().splitlines()
+        if p.returncode == 0 and lines:
+            rec = json.loads(lines[-1])
+            _log(f"serving_slo: {rec['queries']} queries from "
+                 f"{rec['clients']} clients, e2e p50={rec['e2e_p50_s']}s "
+                 f"p99={rec['e2e_p99_s']}s, queue p99="
+                 f"{rec['queue_wait_p99_s']}s")
+            extra["serving_slo"] = rec
+        else:
+            extra["serving_slo"] = {"error": f"child rc={p.returncode}"}
+    except subprocess.TimeoutExpired:
+        extra["serving_slo"] = {"error": "timeout"}
+    except Exception as e:  # noqa: BLE001
+        extra["serving_slo"] = {"error": f"{type(e).__name__}: {e}"}
+
+
 def _run_mesh_scaling(extra: dict, remaining: float):
     """ICI exchange scaling sweep: Q3 at n_dev ∈ {1,2,4,8} on the host
     platform (deterministic on any machine; on a real slice the same
@@ -523,6 +676,10 @@ def main():
     if len(sys.argv) >= 4 and sys.argv[1] == "--mesh-child":
         _mesh_child(int(sys.argv[2]), float(sys.argv[3]))
         return
+    if len(sys.argv) >= 5 and sys.argv[1] == "--serving-child":
+        _serving_child(float(sys.argv[2]), int(sys.argv[3]),
+                       int(sys.argv[4]))
+        return
 
     signal.signal(signal.SIGTERM, _on_term)
     signal.signal(signal.SIGINT, _on_term)
@@ -540,7 +697,7 @@ def main():
     wanted = os.environ.get(
         "BENCH_CONFIGS", "q1_sf1,q1_nofuse_sf1,q6_sf10,q3_sf10,join_sf1,"
         "groupby_engine_ab_sf1,groupby_engine_ab_sort_sf1,mesh_scaling,"
-        "q9,q64"
+        "serving_slo,q9,q64"
     ).split(",")
 
     for name in (w.strip() for w in wanted):
@@ -554,6 +711,17 @@ def main():
                 extra["mesh_scaling"] = {"skipped": "budget"}
             else:
                 _run_mesh_scaling(extra, remaining)
+            _checkpoint()
+            continue
+        if name == "serving_slo":
+            remaining = budget - (time.time() - _T0)
+            if remaining < 60:
+                _log("serving_slo: SKIPPED (budget exhausted)")
+                extra["serving_slo"] = {"skipped": "budget"}
+            else:
+                if not device_ok:
+                    os.environ["BENCH_FORCE_CPU"] = "1"
+                _run_serving_slo(extra, remaining)
             _checkpoint()
             continue
         if name not in _CONFIGS:

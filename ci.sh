@@ -463,6 +463,199 @@ if [ "$rc" -ne 0 ]; then
   exit "$rc"
 fi
 
+# Serving-SLO smoke: boot a shared-process cluster with the slow-query
+# and event-stream sinks armed, drive >= 8 concurrent mixed queries over
+# the statement protocol split across two resource groups, and assert
+# (a) the per-group SLO histogram families scrape lint-clean, (b) live
+# progress is monotone nondecreasing and ends at 1.0 with HBO-predicted
+# provenance on a fingerprint repeat, (c) /v1/events carries a sampled
+# query's lifecycle transitions in canonical order, (d) the five segments
+# sum to e2e for every completed query, and (e) a forced latency
+# regression (tiny pre-injected HBO baseline) lands on the counter, the
+# event stream, AND the slow-query JSONL record.
+echo "== serving-SLO smoke: lifecycle + progress + events + regression =="
+tmp_slo="$(mktemp -d)"
+env JAX_PLATFORMS=cpu PRESTO_TPU_SLO_DIR="$tmp_slo" python - <<'PYEOF'
+import json, os, threading, time, urllib.request
+
+from presto_tpu.catalog.tpch import tpch_catalog
+from presto_tpu.obs import runstats
+from presto_tpu.obs.exposition import lint_exposition
+from presto_tpu.server.coordinator import DistributedRunner
+from presto_tpu.server.resource_groups import (
+    ResourceGroupManager, ResourceGroupSpec, SelectorSpec)
+
+d = os.environ["PRESTO_TPU_SLO_DIR"]
+slow_log = os.path.join(d, "slow.jsonl")
+events_log = os.path.join(d, "events.jsonl")
+cat = tpch_catalog(0.01)
+dr = DistributedRunner(cat, n_workers=2, coordinator_kwargs={
+    "slow_query_log": slow_log, "slow_query_threshold_s": 0.0,
+    "events_log": events_log})
+# two leaf groups so the SLO families carry distinct group labels
+dr.coordinator.query_manager.resource_groups = ResourceGroupManager(
+    ResourceGroupSpec("global", hard_concurrency_limit=16, subgroups=[
+        ResourceGroupSpec("adhoc", hard_concurrency_limit=8),
+        ResourceGroupSpec("batch", hard_concurrency_limit=8)]),
+    [SelectorSpec(group="global.adhoc", source_regex="adhoc"),
+     SelectorSpec(group="global.batch", source_regex="batch"),
+     SelectorSpec(group="global")])
+base = dr.coordinator.url
+
+QUERIES = [
+    "select count(*) as c from lineitem where l_discount < 0.05",
+    "select l_returnflag as f, sum(l_quantity) as q from lineitem "
+    "group by l_returnflag order by f",
+    "select o_orderpriority as p, count(*) as c from orders "
+    "group by o_orderpriority order by p",
+    "select sum(l_extendedprice * l_discount) as rev from lineitem "
+    "where l_quantity < 24",
+]
+
+
+def run_sql(sql, source, out, idx):
+    try:
+        req = urllib.request.Request(
+            base + "/v1/statement", data=sql.encode(),
+            headers={"X-Presto-User": "smoke", "X-Presto-Source": source,
+                     "Content-Type": "text/plain"})
+        doc = json.load(urllib.request.urlopen(req, timeout=60))
+        prog = doc.get("progressUri")
+        fractions = []
+        while True:
+            if prog:
+                p = json.load(urllib.request.urlopen(prog, timeout=30))
+                fractions.append(p["fraction"])
+            nxt = doc.get("nextUri")
+            if not nxt:
+                break
+            doc = json.load(urllib.request.urlopen(nxt, timeout=60))
+            prog = prog or doc.get("progressUri")
+        if prog:  # terminal poll: must have pinned to 1.0
+            p = json.load(urllib.request.urlopen(prog, timeout=30))
+            fractions.append(p["fraction"])
+        out[idx] = {"id": doc.get("id"), "state": doc["stats"]["state"],
+                    "fractions": fractions, "final": p if prog else None,
+                    "error": doc.get("error")}
+    except Exception as e:  # noqa: BLE001
+        out[idx] = {"error": repr(e)}
+
+
+# forced-regression target: inject a tiny HBO wall baseline for this
+# query's fingerprint BEFORE its first run (note() max-merges, so the
+# baseline can only be injected while the history is empty)
+REG_SQL = ("select l_linestatus as s, max(l_tax) as t from lineitem "
+           "group by l_linestatus order by s")
+dplan = dr.plan_distributed(REG_SQL)
+fp = runstats.node_fingerprint(dplan.fragments[dplan.root_fid].root, cat)
+assert fp, "no fingerprint for regression target"
+runstats.note(fp, runstats.QUERY_SITE, wall_s=0.0001)
+
+results = {}
+threads = []
+jobs = [(QUERIES[i % len(QUERIES)], ("adhoc", "batch")[i % 2])
+        for i in range(8)] + [(REG_SQL, "batch")]
+# repeat wave: same SQL shapes again so every fingerprint has history
+jobs += [(QUERIES[i % len(QUERIES)], ("adhoc", "batch")[i % 2])
+         for i in range(4)]
+for i, (sql, src) in enumerate(jobs):
+    t = threading.Thread(target=run_sql, args=(sql, src, results, i))
+    threads.append(t)
+for t in threads[:9]:
+    t.start()
+for t in threads[:9]:
+    t.join()
+for t in threads[9:]:  # the repeat wave runs after history exists
+    t.start()
+for t in threads[9:]:
+    t.join()
+
+failed = [r for r in results.values() if r.get("state") != "FINISHED"]
+assert not failed, failed
+assert len(results) == len(jobs)
+
+# (b) progress monotone nondecreasing, ending at 1.0
+hbo_final = 0
+for r in results.values():
+    fr = r["fractions"]
+    assert fr == sorted(fr), f"progress went backwards: {fr}"
+    assert fr[-1] == 1.0, f"progress never reached 1.0: {fr}"
+    if r["final"]["provenance"] == "hbo":
+        hbo_final += 1
+assert hbo_final >= 4, (
+    f"only {hbo_final} queries finished with HBO-predicted provenance")
+
+# (a) per-group SLO families scrape lint-clean
+body = urllib.request.urlopen(base + "/v1/metrics", timeout=10).read().decode()
+errs = lint_exposition(body)
+assert errs == [], errs
+for fam in ("presto_tpu_query_queue_wait_seconds",
+            "presto_tpu_query_compile_seconds",
+            "presto_tpu_query_exec_seconds",
+            "presto_tpu_query_e2e_seconds"):
+    assert f"# TYPE {fam} histogram" in body, fam
+for grp in ('group="global.adhoc"', 'group="global.batch"'):
+    assert grp in body, f"{grp} missing from SLO families"
+assert "presto_tpu_slo_violations_total" in body
+
+# (c) sampled query's lifecycle transitions in canonical order on /v1/events
+sample = next(r for r in results.values() if r["final"])
+qid = sample["final"]["queryId"]
+ev = json.load(urllib.request.urlopen(
+    base + "/v1/events?queryId=" + qid + "&kind=lifecycle", timeout=10))
+states = [e["state"] for e in ev["events"]]
+canon = ["created", "queued", "admitted", "planning", "compiling",
+         "executing", "draining", "finished"]
+idxs = [canon.index(s) for s in states]
+assert idxs == sorted(idxs), f"out-of-order lifecycle events: {states}"
+assert states[0] == "created" and states[-1] == "finished", states
+assert "executing" in states, states
+assert all(e["traceToken"] == qid for e in ev["events"])
+# the JSONL sink mirrors the ring
+sunk = [json.loads(l) for l in open(events_log)]
+assert any(r.get("queryId") == qid and r.get("state") == "finished"
+           for r in sunk)
+
+# (d) segments sum to e2e for every completed query that carries a timeline
+qlist = json.load(urllib.request.urlopen(base + "/v1/query", timeout=10))
+checked = 0
+for q in qlist:
+    lc = (q.get("stats") or {}).get("lifecycle")
+    if not lc or q["state"] != "FINISHED":
+        continue
+    segs = lc["segments"]
+    s = sum(v for k, v in segs.items() if k != "e2e")
+    assert abs(s - segs["e2e"]) < 1e-3, (q["query_id"], segs)
+    checked += 1
+assert checked >= 9, f"only {checked} completed queries carried timelines"
+
+# (e) forced regression: counter + event stream + slow-log annotation
+assert "presto_tpu_latency_regression_total" in body
+reg_lines = [l for l in body.splitlines()
+             if l.startswith("presto_tpu_latency_regression_total")
+             and 'group="global.batch"' in l]
+assert reg_lines and float(reg_lines[0].rsplit(" ", 1)[1]) >= 1, reg_lines
+rev = json.load(urllib.request.urlopen(
+    base + "/v1/events?kind=latency_regression", timeout=10))
+assert rev["events"], "no latency_regression event"
+assert rev["events"][0]["baselineWallS"] == 0.0001
+slow_recs = [json.loads(l) for l in open(slow_log)]
+flagged = [r for r in slow_recs if "latencyRegression" in r]
+assert flagged, "slow-query log record missing latencyRegression"
+assert flagged[0]["latencyRegression"]["fingerprint"] == fp
+
+dr.close()
+print(f"serving-SLO smoke OK: {len(results)} queries across 2 groups, "
+      f"{hbo_final} HBO-provenance finishes, {checked} timelines "
+      f"segment-exact, regression counter/event/slow-log all flagged")
+PYEOF
+rc=$?
+rm -rf "$tmp_slo"
+if [ "$rc" -ne 0 ]; then
+  echo "serving-SLO smoke FAILED (exit $rc)"
+  exit "$rc"
+fi
+
 # Static-analysis step: the kernel lint must be clean over the shipped
 # tree, the analyzer must actually FAIL on an injected violation (a
 # linter that can't fail is decoration), the plan-invariant checker must

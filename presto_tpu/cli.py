@@ -37,12 +37,41 @@ def format_table(columns, rows, max_width: int = 40) -> str:
     return "\n".join(lines)
 
 
+def _progress_printer(client, stop, interval_s: float = 0.5):
+    """StatusPrinter analog: redraw one live status line from the server's
+    lifecycle progress estimate while the main thread drains rows."""
+    drew = False
+    while not stop.wait(interval_s):
+        doc = client.progress()
+        if not doc:
+            continue
+        frac = doc.get("fraction") or 0.0
+        filled = int(max(0.0, min(1.0, frac)) * 20)
+        bar = "=" * filled + " " * (20 - filled)
+        sys.stderr.write(
+            f"\r[{bar}] {frac * 100.0:5.1f}%  {doc.get('state', '')}"
+            f"  rows={doc.get('rows', 0)}  ({doc.get('provenance', '')})  ")
+        sys.stderr.flush()
+        drew = True
+    if drew:
+        sys.stderr.write("\r" + " " * 70 + "\r")
+        sys.stderr.flush()
+
+
 def run_statement(server: str, sql: str, session: ClientSession,
-                  out=None) -> bool:
+                  out=None, progress: bool = False) -> bool:
+    import threading
+
     out = out or sys.stdout
     t0 = time.perf_counter()
+    stop = threading.Event()
+    printer = None
     try:
         client = StatementClient(server, sql, session)
+        if progress and client.progress_uri:
+            printer = threading.Thread(
+                target=_progress_printer, args=(client, stop), daemon=True)
+            printer.start()
         rows = list(client.rows())
     except QueryError as e:
         print(f"Query failed: {e}", file=sys.stderr)
@@ -50,6 +79,10 @@ def run_statement(server: str, sql: str, session: ClientSession,
     except Exception as e:
         print(f"Error: {e}", file=sys.stderr)
         return False
+    finally:
+        stop.set()
+        if printer is not None:
+            printer.join(timeout=2)
     cols = [c["name"] for c in (client.columns or [])]
     if cols:
         print(format_table(cols, rows), file=out)
@@ -87,7 +120,7 @@ def split_statements(text: str):
     return [s for s in stmts if s]
 
 
-def repl(server: str, session: ClientSession):
+def repl(server: str, session: ClientSession, progress: bool = False):
     print(f"presto-tpu CLI — connected to {server}")
     print("Type a SQL statement ending with ';', or 'quit'.")
     buf = []
@@ -106,7 +139,7 @@ def repl(server: str, session: ClientSession):
             buf = []
             sql = text.rstrip().rstrip(";").strip()
             if sql:
-                run_statement(server, sql, session)
+                run_statement(server, sql, session, progress=progress)
 
 
 def main(argv=None):
@@ -117,20 +150,25 @@ def main(argv=None):
     p.add_argument("--schema")
     p.add_argument("--execute", "-e", help="run one statement and exit")
     p.add_argument("--file", "-f", help="run statements from a file (';'-separated)")
+    p.add_argument("--progress", action="store_true",
+                   help="show a live progress bar from the server's "
+                        "lifecycle estimate (requires session lifecycle=on)")
     args = p.parse_args(argv)
     session = ClientSession(user=args.user, catalog=args.catalog,
                             schema=args.schema)
     if args.execute:
-        ok = run_statement(args.server, args.execute, session)
+        ok = run_statement(args.server, args.execute, session,
+                           progress=args.progress)
         return 0 if ok else 1
     if args.file:
         with open(args.file) as f:
             text = f.read()
         for stmt in split_statements(text):
-            if not run_statement(args.server, stmt, session):
+            if not run_statement(args.server, stmt, session,
+                                 progress=args.progress):
                 return 1
         return 0
-    repl(args.server, session)
+    repl(args.server, session, progress=args.progress)
     return 0
 
 

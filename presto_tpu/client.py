@@ -32,6 +32,7 @@ class StatementClient:
         self.columns: Optional[List[dict]] = None
         self.query_id: Optional[str] = None
         self.stats: Dict[str, Any] = {}
+        self.progress_uri: Optional[str] = None
         self._next_uri: Optional[str] = None
         self._current_data: List[list] = []
         self._error: Optional[dict] = None
@@ -74,6 +75,9 @@ class StatementClient:
             self.columns = payload["columns"]
         self._current_data = payload.get("data") or []
         self._next_uri = payload.get("nextUri")
+        # present only when the server tracks this query's lifecycle
+        # (session lifecycle=on); pollable even after the query finishes
+        self.progress_uri = payload.get("progressUri") or self.progress_uri
         self._error = payload.get("error")
 
     def _submit(self):
@@ -113,6 +117,18 @@ class StatementClient:
             self._current_data = []
             if not self._advance():
                 return
+
+    def progress(self) -> Optional[dict]:
+        """Fetch the server's live progress estimate (fraction complete,
+        HBO/fragment provenance, lifecycle segments). None when the server
+        exposed no progressUri (lifecycle=off) or the fetch fails."""
+        if not self.progress_uri:
+            return None
+        try:
+            with urllib.request.urlopen(self.progress_uri, timeout=10) as r:
+                return json.loads(r.read())
+        except Exception:
+            return None
 
     def cancel(self):
         if self.query_id:

@@ -242,6 +242,11 @@ class ExecConfig:
     # under PRESTO_TPU_CACHE_DIR (no-op with a warning when the profiler
     # or the cache dir is unavailable)
     profile: bool = False
+    # serving-plane SLO telemetry (obs/lifecycle.py): "on" makes worker
+    # task sinks count emitted rows/batches so heartbeats carry live
+    # query progress; "off" is a strict no-op — pre-lifecycle task path
+    # and heartbeat doc bit-for-bit.
+    lifecycle: str = "on"
 
 
 def _node_jit(node: PlanNode, key: str, builder, _shared=True, **jit_kwargs):

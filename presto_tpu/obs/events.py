@@ -1,4 +1,4 @@
-"""Query-event listener sinks.
+"""Query-event listener sinks + the unified cluster event stream.
 
 The QueryManager fires `(event, QueryInfo)` listeners (the EventListener
 SPI's QueryCompletedEvent analog). This module's SlowQueryLogger is the
@@ -6,14 +6,44 @@ standard sink: a structured JSONL stream of completed queries over a
 latency threshold, each record carrying the top-k most expensive spans
 inline so a slow query is diagnosable from the log alone — no trace
 endpoint round trip.
+
+ClusterEventStream is the serving-plane's unified feed (`GET /v1/events`):
+a bounded in-memory ring buffer — lifecycle transitions, admission
+rejections, memory revokes/kills, overflow-replay waves, SLO violations,
+and latency-regression flags — with an optional JSONL sink. Every record
+carries the query's trace token for span correlation.
+
+Both JSONL sinks append with a single `os.write` to an `O_APPEND` fd
+under the shared `fcntl` flock from obs.runstats, so multiple server
+processes can share one file without torn lines.
 """
 
 from __future__ import annotations
 
+import collections
 import json
+import os
 import threading
 import time
-from typing import List, Optional
+from typing import Any, Dict, List, Optional
+
+from presto_tpu.obs.runstats import _flock, _funlock
+
+
+def _append_line(path: str, line: str) -> None:
+    """Cross-process-safe JSONL append: one `os.write` of the whole
+    record to an `O_APPEND` fd while holding the shared flock (the HBO
+    compactor takes it exclusively, so appends never interleave with a
+    rewrite)."""
+    lock_fd = _flock(path, exclusive=False)
+    try:
+        fd = os.open(path, os.O_WRONLY | os.O_APPEND | os.O_CREAT, 0o644)
+        try:
+            os.write(fd, (line + "\n").encode("utf-8"))
+        finally:
+            os.close(fd)
+    finally:
+        _funlock(lock_fd)
 
 
 class SlowQueryLogger:
@@ -27,11 +57,14 @@ class SlowQueryLogger:
         self._lock = threading.Lock()
 
     def log(self, info, spans: Optional[list] = None,
-            memory: Optional[dict] = None) -> None:
+            memory: Optional[dict] = None,
+            extra: Optional[dict] = None) -> None:
         """`info` is a querymanager.QueryInfo; `spans` the query's trace
         spans (obs.trace.Span), when tracing captured any; `memory` an
         optional devprof-plane doc (per-query peak/footprint bytes +
-        device stats) folded into the record."""
+        device stats) folded into the record; `extra` optional top-level
+        annotations (e.g. the lifecycle plane's latency-regression
+        flag)."""
         elapsed = max(0.0, (info.end_time or time.time()) - info.create_time)
         if elapsed < self.threshold_s:
             return
@@ -104,7 +137,79 @@ class SlowQueryLogger:
         if memory:
             # peak/footprint fields from the devprof memory rollup
             rec["memory"] = memory
+        if extra:
+            rec.update(extra)
         line = json.dumps(rec, default=str)
         with self._lock:
-            with open(self.path, "a") as fh:
-                fh.write(line + "\n")
+            _append_line(self.path, line)
+
+
+class ClusterEventStream:
+    """Bounded ring buffer of cluster events + optional JSONL sink.
+
+    `emit` is cheap and never raises toward the serving path: sink IO
+    errors are swallowed (the in-memory ring still gets the record).
+    Sequence numbers are monotonically increasing for the process
+    lifetime, so `events(since=seq)` is a stable resume cursor.
+    """
+
+    def __init__(self, capacity: int = 2048, path: Optional[str] = None):
+        self._lock = threading.Lock()
+        self._buf: "collections.deque[Dict[str, Any]]" = collections.deque(
+            maxlen=capacity)
+        self._seq = 0
+        self.path = path
+
+    def configure(self, path: Optional[str] = None,
+                  capacity: Optional[int] = None) -> None:
+        with self._lock:
+            if path is not None:
+                self.path = path
+            if capacity is not None and capacity != self._buf.maxlen:
+                self._buf = collections.deque(self._buf, maxlen=capacity)
+
+    def emit(self, kind: str, query_id: Optional[str] = None,
+             **attrs) -> Dict[str, Any]:
+        rec: Dict[str, Any] = {"ts": round(time.time(), 6), "kind": kind}
+        if query_id is not None:
+            rec["queryId"] = query_id
+            # trace ids are minted as the serving query id, so the query
+            # id doubles as the trace token for span correlation
+            rec["traceToken"] = query_id
+        rec.update(attrs)
+        with self._lock:
+            self._seq += 1
+            rec["seq"] = self._seq
+            self._buf.append(rec)
+            path = self.path
+        if path:
+            try:
+                _append_line(path, json.dumps(rec, default=str))
+            except OSError:
+                pass
+        return rec
+
+    def events(self, since: int = 0, query_id: Optional[str] = None,
+               kind: Optional[str] = None,
+               limit: int = 1000) -> List[Dict[str, Any]]:
+        with self._lock:
+            out = [dict(r) for r in self._buf if r["seq"] > since]
+        if query_id is not None:
+            out = [r for r in out if r.get("queryId") == query_id]
+        if kind is not None:
+            out = [r for r in out if r.get("kind") == kind]
+        return out[-limit:]
+
+    def last_seq(self) -> int:
+        with self._lock:
+            return self._seq
+
+    def clear(self) -> None:
+        """Test hook: drop buffered events (seq keeps counting)."""
+        with self._lock:
+            self._buf.clear()
+
+
+#: process-global stream — one serving plane per process; the coordinator
+#: configures the JSONL sink at construction when `events_log=` is set
+EVENTS = ClusterEventStream()

@@ -376,6 +376,18 @@ def lookup_node(node, catalog, site: str) -> Optional[Dict[str, Any]]:
     return lookup(node_fingerprint(node, catalog), site)
 
 
+# Whole-query profile site recorded by obs/lifecycle.py on every FINISHED
+# lifecycle-tracked query, keyed on the root plan-node fingerprint. Entries
+# carry wall_s / rows / sink_rows (max-merged like every note()d numeric).
+QUERY_SITE = "lifecycle/query"
+
+
+def query_baseline(fp: Optional[str]) -> Optional[Dict[str, Any]]:
+    """HBO baseline for a whole query: the lifecycle plane's live-progress
+    denominator and latency-regression reference."""
+    return lookup(fp, QUERY_SITE)
+
+
 def record_flip(site: str) -> None:
     """A decision site, re-evaluated against freshly observed values,
     would have chosen differently than the static estimate did."""

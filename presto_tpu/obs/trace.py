@@ -43,6 +43,15 @@ Span kinds:
 Everything is allocation-light: tracing disabled means every call site
 talks to the module NOOP singleton (`enabled=False` short-circuits before
 any work), so `ExecConfig.tracing=False` costs one attribute check.
+
+Correlation with the serving-plane telemetry (obs/lifecycle.py): the
+trace id IS the serving query id, so every record on the cluster event
+stream (`/v1/events`) carries it as `traceToken` — a lifecycle
+transition, admission rejection, SLO violation, or latency-regression
+flag joins back to this span tree by token equality. obs/lifecycle's
+`complete()` also walks the finished tree's span kinds
+(overflow_replay / memory_revoke / memory_kill) to republish those
+incidents on the event stream with the same token.
 """
 
 from __future__ import annotations

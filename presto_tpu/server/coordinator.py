@@ -32,6 +32,8 @@ from typing import Dict, List, Optional
 from presto_tpu.batch import Batch
 from presto_tpu.connector import Catalog
 from presto_tpu.exec.runtime import ExecConfig
+from presto_tpu.obs import events as _obs_events
+from presto_tpu.obs import lifecycle as _obs_lifecycle
 from presto_tpu.obs import trace as _obs_trace
 from presto_tpu.plan.fragmenter import (
     HASH,
@@ -129,6 +131,12 @@ class HeartbeatFailureDetector:
                 n.record_success()
             if self.cluster_memory is not None:
                 self.cluster_memory.update_node(n.node_id, status)
+            progress = status.get("queryProgress")
+            if progress:
+                # lifecycle plane: fold the worker's live per-query row
+                # counts into the progress registry (attempt ids resolve
+                # through the registry's alias map)
+                _obs_lifecycle.merge_worker_progress(n.node_id, progress)
         except Exception:
             n.record_failure()
 
@@ -711,7 +719,8 @@ class Coordinator:
                  blocked_node_threshold: float = 0.95,
                  access_control=None, tls=None,
                  slow_query_log: Optional[str] = None,
-                 slow_query_threshold_s: float = 0.0):
+                 slow_query_threshold_s: float = 0.0,
+                 events_log: Optional[str] = None):
         from presto_tpu.server.cluster_memory import ClusterMemoryManager
         from presto_tpu.server.protocol import StatementProtocol
         from presto_tpu.server.querymanager import (
@@ -757,6 +766,26 @@ class Coordinator:
         # a low-memory kill stamps a memory_kill span onto the victim's
         # trace (registry exists only now — created after the manager)
         self.cluster_memory.trace_registry = self.trace_registry
+
+        if events_log:
+            # unified cluster event stream JSONL sink (/v1/events mirrors
+            # the in-memory ring regardless)
+            _obs_events.EVENTS.configure(path=events_log)
+
+        def _lifecycle_complete(event: str, info):
+            # FIRST in the listener chain: SLO histograms, objective
+            # violations, and the latency-regression flag must exist
+            # before _log_slow reads the annotation
+            if event != "queryCompleted":
+                return
+            try:
+                tr = self.trace_registry.get(info.query_id)
+                _obs_lifecycle.complete(
+                    info, spans=tr.spans() if tr is not None else None)
+            except Exception:
+                pass
+
+        self.query_manager.listeners.append(_lifecycle_complete)
 
         def _record_latency(event: str, info):
             if event != "queryCompleted":
@@ -804,7 +833,9 @@ class Coordinator:
                 except Exception:
                     mem = None
                 _s.log(info, tr.spans() if tr is not None else None,
-                       memory=mem)
+                       memory=mem,
+                       extra=_obs_lifecycle.slow_log_annotation(
+                           info.query_id))
 
             self.query_manager.listeners.append(_log_slow)
         if query_event_log:
@@ -880,6 +911,14 @@ class Coordinator:
         self.size_monitor.wait_for_minimum()
         qid = self.next_query_id()
         workers = self.node_manager.active_nodes()
+        # lifecycle plane: EXPLAIN ANALYZE serves through a QueryExecution
+        # (the _immediate path), so the session query id already has a
+        # registered timeline when lifecycle=on
+        session_qid = getattr(session, "query_id", "") or ""
+        entry = _obs_lifecycle.get(session_qid) if session_qid else None
+        if entry is not None:
+            _obs_lifecycle.mark(session_qid, "compiling")
+            _obs_lifecycle.alias(qid, entry.query_id)
         tracer = _obs_trace.NOOP
         if getattr(cfg, "tracing", True):
             tracer = _obs_trace.Tracer(
@@ -888,10 +927,24 @@ class Coordinator:
             self.trace_registry.alias(qid, tracer.trace_id)
         with _obs_trace.use(tracer), tracer.span("query", "query",
                                                  sql=sql[:200]):
+            first = True
             for _ in self.scheduler.execute(qid, dplan, workers, cfg,
                                             stats_out=stats, tracer=tracer):
-                pass
-        lines = [dplan.to_string(), "", "-- task execution profile --"]
+                if first and entry is not None:
+                    _obs_lifecycle.mark(session_qid, "executing")
+                    first = False
+        lines = []
+        if entry is not None:
+            seg = entry.timeline.segments()
+            lines += [
+                "-- lifecycle --",
+                "  " + "  ".join(
+                    f"{k}={seg[k]:.3f}s"
+                    for k in ("queue_wait", "plan", "compile", "exec",
+                              "drain", "e2e")),
+                "",
+            ]
+        lines += [dplan.to_string(), "", "-- task execution profile --"]
         by_fid: Dict[int, list] = {}
         for tid, fid, info in stats:
             by_fid.setdefault(fid, []).append((tid, info))
@@ -1018,6 +1071,41 @@ class Coordinator:
                         return self._json({"error": "no trace for query"},
                                           404)
                     return self._json(tr.to_json())
+                m = re.match(r"^/v1/query/([^/]+)/progress$", self.path)
+                if m:
+                    qid = m.group(1)
+                    state = None
+                    try:
+                        state = coord.query_manager.get(qid).state
+                    except KeyError:
+                        pass
+                    doc = _obs_lifecycle.progress_doc(qid, state=state)
+                    if doc is None:
+                        return self._json(
+                            {"error": "no lifecycle for query "
+                                      "(unknown id or lifecycle=off)"}, 404)
+                    return self._json(doc)
+                m = re.match(r"^/v1/events(?:\?(.*))?$", self.path)
+                if m:
+                    import urllib.parse as _up
+
+                    q = _up.parse_qs(m.group(1) or "")
+
+                    def _one(name, cast=str, default=None):
+                        vals = q.get(name)
+                        try:
+                            return cast(vals[0]) if vals else default
+                        except (TypeError, ValueError):
+                            return default
+
+                    return self._json({
+                        "lastSeq": _obs_events.EVENTS.last_seq(),
+                        "events": _obs_events.EVENTS.events(
+                            since=_one("since", int, 0),
+                            query_id=_one("queryId"),
+                            kind=_one("kind"),
+                            limit=_one("limit", int, 1000)),
+                    })
                 m = re.match(r"^/ui/query/([^/]+)$", self.path)
                 if m:
                     from presto_tpu.server.metrics import render_query_page
@@ -1113,8 +1201,31 @@ class Coordinator:
             # task ids embed this scheduler attempt id — make it resolve
             # to the query's trace too
             self.trace_registry.alias(qid, tracer.trace_id)
-        yield from self.scheduler.execute(qid, dplan, workers, config,
-                                          tracer=tracer)
+            # ... and to the lifecycle progress entry (trace ids are
+            # minted as the serving query id), so worker heartbeats keyed
+            # by this attempt reach the right registry slot
+            _obs_lifecycle.alias(qid, tracer.trace_id)
+        entry = _obs_lifecycle.get(qid)
+        if entry is None:
+            yield from self.scheduler.execute(qid, dplan, workers, config,
+                                              tracer=tracer)
+            return
+        # lifecycle plane: the first root-stream batch is the
+        # compiling->executing boundary; every batch feeds the live
+        # progress counts
+        import numpy as _np
+        first = True
+        for b in self.scheduler.execute(qid, dplan, workers, config,
+                                        tracer=tracer):
+            if first:
+                _obs_lifecycle.mark(entry.query_id, "executing")
+                first = False
+            entry.observe_batch(int(_np.asarray(b.live).sum()))
+            yield b
+        if first:
+            # zero-batch stream (e.g. empty scan): still crossed into
+            # execution before draining
+            _obs_lifecycle.mark(entry.query_id, "executing")
 
     def _try_scaled_write(self, stmt, config, session) -> Optional[Batch]:
         """Scaled writers (SCALED_WRITER_DISTRIBUTION): CTAS into a
@@ -1445,6 +1556,20 @@ class Coordinator:
         dplan = self.plan_distributed(sql, session, stmt=stmt)
         self._enforce_access(
             (f.root for f in dplan.fragments.values()), session)
+        session_qid = getattr(session, "query_id", "") or ""
+        if session_qid and _obs_lifecycle.get(session_qid) is not None:
+            # lifecycle plane: plan ready = plan->compile boundary; stamp
+            # the structural fingerprint so progress gets its HBO
+            # prediction and completion its regression baseline
+            try:
+                from presto_tpu.obs import runstats as _runstats
+
+                _obs_lifecycle.set_fingerprint(
+                    session_qid, _runstats.node_fingerprint(
+                        dplan.fragments[dplan.root_fid].root, self.catalog))
+            except Exception:
+                pass
+            _obs_lifecycle.mark(session_qid, "compiling")
         batches = self._execute_with_retry(dplan, config)
         merged = _collect_concat(iter(batches))
         if merged is None:
@@ -1479,7 +1604,8 @@ class DistributedRunner:
     def __init__(self, catalog: Catalog, n_workers: int = 2,
                  config: Optional[ExecConfig] = None,
                  broadcast_threshold_rows: float = 1_000_000,
-                 access_control=None, tls=None):
+                 access_control=None, tls=None,
+                 coordinator_kwargs: Optional[dict] = None):
         import secrets as _secrets
 
         from presto_tpu.server.worker import Worker
@@ -1492,6 +1618,9 @@ class DistributedRunner:
             broadcast_threshold_rows=broadcast_threshold_rows,
             cluster_secret=cluster_secret,
             access_control=access_control, tls=tls,
+            # extra Coordinator knobs (slow_query_log, events_log, ...)
+            # without re-plumbing every parameter through the runner
+            **(coordinator_kwargs or {}),
         )
         self.workers = [
             Worker(catalog, node_id=f"worker-{i}",

@@ -121,8 +121,17 @@ def coordinator_metrics(coordinator) -> str:
     rows.extend(exec_programs.metric_rows({"plane": "coordinator"}))
     rows.extend(obs_runstats.metric_rows({"plane": "coordinator"}))
     rows.extend(obs_devprof.metric_rows({"plane": "coordinator"}))
-    return (render_metrics(rows)
-            + obs_metrics.render_histograms("coordinator"))
+    text = render_metrics(rows) + obs_metrics.render_histograms("coordinator")
+    from presto_tpu.obs import lifecycle as obs_lifecycle
+
+    # SLO families appear only once a lifecycle-tracked query has been
+    # registered — lifecycle=off stays bit-for-bit identical to pre-SLO
+    # expositions (no zeroed family declarations either).
+    if obs_lifecycle.armed():
+        slo_rows = obs_lifecycle.metric_rows({"plane": "coordinator"})
+        text += (render_metrics(slo_rows) if slo_rows else "")
+        text += obs_lifecycle.render_slo_histograms("coordinator")
+    return text
 
 
 _UI_PAGE = """<!DOCTYPE html>
@@ -136,6 +145,7 @@ _UI_PAGE = """<!DOCTYPE html>
  a {{ color: #7ec8e3; }}
  .RUNNING {{ color: #7ec8e3; }} .FINISHED {{ color: #8c8; }}
  .FAILED {{ color: #e88; }} .QUEUED {{ color: #cc8; }}
+ .EXPIRED {{ color: #e8a; }} .CANCELED {{ color: #aaa; }}
 </style></head><body>
 <h1>presto-tpu coordinator</h1>
 <h2>cluster</h2><table>
@@ -186,16 +196,22 @@ _QUERY_PAGE = """<!DOCTYPE html>
  th, td {{ text-align: left; padding: 3px 10px; border-bottom: 1px solid #333; }}
  th {{ color: #888; }}
  a {{ color: #7ec8e3; }}
+ .RUNNING {{ color: #7ec8e3; }} .FINISHED {{ color: #8c8; }}
+ .FAILED {{ color: #e88; }} .QUEUED {{ color: #cc8; }}
+ .EXPIRED {{ color: #e8a; }} .CANCELED {{ color: #aaa; }}
  pre {{ background: #1a1a1a; padding: 1em; overflow-x: auto; }}
  .bar {{ background: #2a6; height: 10px; display: inline-block; }}
+ .pbar {{ background: #333; width: 400px; height: 14px; display: inline-block; }}
+ .pfill {{ background: #7ec8e3; height: 14px; display: block; }}
 </style></head><body>
 <a href="/ui">&larr; queries</a>
 <h1>query {qid}</h1>
 <table>
-<tr><th>state</th><td>{state}</td></tr>
+<tr><th>state</th><td class="{state}">{state}</td></tr>
 <tr><th>elapsed</th><td>{elapsed}</td></tr>
 <tr><th>user</th><td>{user}</td></tr>
 </table>
+{progress}
 <h2>sql</h2><pre>{sql}</pre>
 <h2>trace spans</h2>
 {trace}
@@ -247,6 +263,24 @@ def render_query_page(coordinator, query_id: str) -> Optional[str]:
         elapsed = f"{(q.end_time or time.time()) - q.create_time:.3f}s"
     else:
         state, user, sql, elapsed = "?", "?", "", "?"
+    from presto_tpu.obs import lifecycle as obs_lifecycle
+
+    progress_html = ""
+    pdoc = obs_lifecycle.progress_doc(query_id, state=str(state))
+    if pdoc is not None:
+        frac = pdoc.get("fraction") or 0.0
+        width = int(max(0.0, min(1.0, frac)) * 400)
+        seg_rows = "".join(
+            f"<tr><td>{html.escape(seg)}</td><td>{val:.4f}</td></tr>"
+            for seg, val in (pdoc.get("segments") or {}).items())
+        progress_html = (
+            "<h2>progress</h2>"
+            f'<p><span class="pbar"><span class="pfill" '
+            f'style="width:{width}px"></span></span> '
+            f"{frac * 100.0:.1f}% "
+            f"({html.escape(str(pdoc.get('provenance')))})</p>"
+            "<table><tr><th>segment</th><th>wall (s)</th></tr>"
+            + seg_rows + "</table>")
     trace_html = "<p>no trace recorded</p>"
     if tracer is not None:
         doc = tracer.to_json()
@@ -262,5 +296,6 @@ def render_query_page(coordinator, query_id: str) -> Optional[str]:
                               state=html.escape(str(state)),
                               elapsed=html.escape(elapsed),
                               user=html.escape(str(user)),
+                              progress=progress_html,
                               sql=html.escape(sql),
                               trace=trace_html)

@@ -24,6 +24,7 @@ import numpy as np
 
 from presto_tpu.server.querymanager import (
     CANCELED,
+    EXPIRED,
     FAILED,
     FINISHED,
     QueryManager,
@@ -352,6 +353,11 @@ class StatementProtocol:
                 ),
             },
         }
+        if getattr(qe, "timeline", None) is not None:
+            # lifecycle plane only (lifecycle=off responses stay
+            # bit-for-bit): live fraction-complete endpoint
+            out["progressUri"] = (
+                f"{self.base_url}/v1/query/{qe.query_id}/progress")
         try:
             # `profile` session property: the captured jax.profiler trace
             # directory for this query, when one was recorded
@@ -381,6 +387,19 @@ class StatementProtocol:
                 "errorName": "USER_CANCELED",
                 "errorType": "USER_ERROR",
             }
+            return out
+        if qe.state == EXPIRED:
+            # enforcement-loop kill (query_max_run_time_s): resource
+            # exhaustion, not a user mistake and not an engine bug
+            err = {
+                "message": qe.error or "Query expired",
+                "errorName": qe.error_type or "EXCEEDED_TIME_LIMIT",
+                "errorType": "INSUFFICIENT_RESOURCES",
+            }
+            if qe.expired_limit_s is not None:
+                err["limitS"] = qe.expired_limit_s
+                err["elapsedS"] = qe.expired_elapsed_s
+            out["error"] = err
             return out
         if qe.state not in TERMINAL:
             out["nextUri"] = f"{base}/{token}"

@@ -16,10 +16,14 @@ import time
 import traceback
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from presto_tpu.obs import events as _obs_events
+from presto_tpu.obs import lifecycle as _lifecycle
 from presto_tpu.server.resource_groups import ResourceGroupManager
 from presto_tpu.server.session import Session
 
-# state lattice (QueryState.java) — terminal states are absorbing
+# state lattice (QueryState.java) — terminal states are absorbing;
+# EXPIRED is the enforcement loop's terminal (query_max_run_time_s),
+# distinct from FAILED so clients and the event stream can attribute it
 QUEUED = "QUEUED"
 PLANNING = "PLANNING"
 RUNNING = "RUNNING"
@@ -27,7 +31,8 @@ FINISHING = "FINISHING"
 FINISHED = "FINISHED"
 FAILED = "FAILED"
 CANCELED = "CANCELED"
-TERMINAL = {FINISHED, FAILED, CANCELED}
+EXPIRED = "EXPIRED"
+TERMINAL = {FINISHED, FAILED, CANCELED, EXPIRED}
 
 
 @dataclasses.dataclass
@@ -71,6 +76,12 @@ class QueryExecution:
         self.resource_group: Optional[str] = None
         self._cancel_requested = False
         self._listeners: List[Callable[[str], None]] = []
+        # lifecycle plane (obs/lifecycle.py): the registry entry's
+        # Timeline when the session runs with lifecycle=on, else None —
+        # a None timeline keeps every serving-path hook a no-op
+        self.timeline = None
+        self.expired_limit_s: Optional[float] = None
+        self.expired_elapsed_s: Optional[float] = None
 
     # -- state machine -----------------------------------------------------
 
@@ -81,6 +92,12 @@ class QueryExecution:
             self.state = new
             if new in TERMINAL:
                 self.end_time = time.time()
+        if self.timeline is not None:
+            attrs = {}
+            if new == EXPIRED and self.expired_limit_s is not None:
+                attrs = {"limitS": self.expired_limit_s,
+                         "elapsedS": self.expired_elapsed_s}
+            _lifecycle.transition(self.query_id, new, **attrs)
         for fn in list(self._listeners):
             fn(new)
         if new in TERMINAL:
@@ -103,6 +120,17 @@ class QueryExecution:
     def cancel(self):
         self._cancel_requested = True
         self._transition(CANCELED)
+
+    def expire(self, limit_s: float):
+        """Enforcement-loop kill: terminal EXPIRED with the limit and
+        elapsed wall in the error payload."""
+        elapsed = time.time() - self.create_time
+        self.expired_limit_s = float(limit_s)
+        self.expired_elapsed_s = round(elapsed, 6)
+        self.error = (f"Query exceeded maximum run time of {limit_s}s "
+                      f"(elapsed {elapsed:.3f}s)")
+        self.error_type = "EXCEEDED_TIME_LIMIT"
+        self._transition(EXPIRED)
 
     @property
     def done(self) -> bool:
@@ -133,6 +161,13 @@ class QueryExecution:
             self._traceback = traceback.format_exc()
 
     def info(self) -> QueryInfo:
+        stats: Dict[str, Any] = {"elapsed_s": round(
+            (self.end_time or time.time()) - self.create_time, 6)}
+        if self.timeline is not None:
+            stats["lifecycle"] = self.timeline.doc()
+        if self.expired_limit_s is not None:
+            stats["expired"] = {"limitS": self.expired_limit_s,
+                                "elapsedS": self.expired_elapsed_s}
         return QueryInfo(
             query_id=self.query_id,
             sql=self.sql,
@@ -142,8 +177,7 @@ class QueryExecution:
             create_time=self.create_time,
             end_time=self.end_time,
             error=self.error,
-            stats={"elapsed_s": round(
-                (self.end_time or time.time()) - self.create_time, 6)},
+            stats=stats,
         )
 
 
@@ -188,6 +222,23 @@ class QueryManager:
         qe._rg_slot_held = False
         qe._rg_released = False
         qe._rg_lock = threading.Lock()
+        try:
+            lifecycle_on = str(session.get("lifecycle")).lower() == "on"
+        except KeyError:
+            lifecycle_on = False
+        if lifecycle_on:
+            try:
+                objectives = _lifecycle.parse_objectives(
+                    session.get("slo_objectives"))
+            except (KeyError, ValueError):
+                objectives = {}
+            try:
+                factor = float(session.get("latency_regression_factor"))
+            except (KeyError, TypeError, ValueError):
+                factor = 0.0
+            qe.timeline = _lifecycle.register(
+                qe.query_id, objectives=objectives,
+                regression_factor=factor).timeline
         with self._lock:
             self._queries[qe.query_id] = qe
         self._emit("queryCreated", qe)
@@ -195,8 +246,15 @@ class QueryManager:
             lambda state, qe=qe: self._on_state(qe, state)
         )
 
+        def on_group(gid, qe=qe):
+            qe.resource_group = gid
+            entry = _lifecycle.get(qe.query_id)
+            if entry is not None:
+                entry.group = gid
+
         def start_from_group(qe=qe):
             qe._rg_slot_held = True
+            _lifecycle.mark(qe.query_id, "admitted")
             if qe.done:
                 # canceled/failed while queued: the group just granted a slot
                 # to a dead query — give it straight back
@@ -208,9 +266,16 @@ class QueryManager:
             self.resource_groups.submit(
                 session.user, session.source,
                 session.get("query_priority"), start_from_group,
-                on_group=lambda gid, qe=qe: setattr(qe, "resource_group", gid),
+                on_group=on_group,
+                on_queued=lambda qe=qe: _lifecycle.mark(qe.query_id,
+                                                        "queued"),
             )
         except Exception as e:  # admission rejection
+            if qe.timeline is not None:
+                _obs_events.EVENTS.emit(
+                    "admission_rejected", query_id=qe.query_id,
+                    group=getattr(e, "group", None) or qe.resource_group,
+                    reason=str(e))
             qe.fail(str(e), error_type="QUERY_QUEUE_FULL")
         self._expire_old()
         return qe
@@ -257,10 +322,7 @@ class QueryManager:
             for q in running:
                 limit = q.session.get("query_max_run_time_s")
                 if limit and now - q.create_time > limit:
-                    q.fail(
-                        f"Query exceeded maximum run time of {limit}s",
-                        error_type="EXCEEDED_TIME_LIMIT",
-                    )
+                    q.expire(limit)
 
     def _expire_old(self):
         with self._lock:

@@ -20,7 +20,10 @@ from typing import Callable, Dict, List, Optional
 
 
 class QueryQueueFullError(RuntimeError):
-    pass
+    """Admission rejection; `group` carries the rejecting group id when
+    the queue (rather than selector resolution) was the cause."""
+
+    group: Optional[str] = None
 
 
 @dataclasses.dataclass
@@ -92,10 +95,12 @@ class _Group:
 
     def enqueue(self, entry, priority: int):
         if len(self.queued) >= self.spec.max_queued:
-            raise QueryQueueFullError(
+            err = QueryQueueFullError(
                 f"Too many queued queries for {self.id!r} "
                 f"(max_queued={self.spec.max_queued})"
             )
+            err.group = self.id
+            raise err
         heapq.heappush(self.queued, (self._sort_key(priority), next(self._seq), entry))
 
     def dequeue(self):
@@ -159,12 +164,16 @@ class ResourceGroupManager:
 
     def submit(self, user: str, source: str, priority: int,
                start_fn: Callable[[], None],
-               on_group: Optional[Callable[[str], None]] = None) -> str:
+               on_group: Optional[Callable[[str], None]] = None,
+               on_queued: Optional[Callable[[], None]] = None) -> str:
         """Admit (calls start_fn now) or queue (start_fn called later when a
         slot frees). `on_group` is invoked with the resolved group id BEFORE
         start_fn can run — callers that release the slot from a completion
-        callback need the id recorded first. Raises QueryQueueFullError when
-        the group's queue is full."""
+        callback need the id recorded first. `on_queued` fires only when the
+        query actually queues, still under the manager lock, so it is
+        ordered strictly before any later dequeue can start the query (the
+        lifecycle plane relies on queued-before-admitted event order).
+        Raises QueryQueueFullError when the group's queue is full."""
         with self._lock:
             g = self.select(user, source)
             if on_group is not None:
@@ -175,6 +184,8 @@ class ResourceGroupManager:
             else:
                 g.enqueue(start_fn, priority)
                 run_now = False
+                if on_queued is not None:
+                    on_queued()
         if run_now:
             start_fn()
         return g.id

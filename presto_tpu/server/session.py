@@ -100,6 +100,19 @@ def _nonneg(name: str) -> Callable[[Any], None]:
     return check
 
 
+def _objectives(name: str) -> Callable[[Any], None]:
+    def check(v):
+        if not v:
+            return
+        from presto_tpu.obs.lifecycle import parse_objectives
+        try:
+            parse_objectives(v)
+        except ValueError as e:
+            raise SessionPropertyError(f"{name}: {e}")
+
+    return check
+
+
 def _pow2_or_off(name: str) -> Callable[[Any], None]:
     def check(v):
         if v is None or v in (0, 1):
@@ -240,6 +253,30 @@ class SystemSessionProperties:
                              "statement response; no-op with a warning when "
                              "the profiler or cache dir is unavailable)",
                              bool, False),
+            # serving-plane SLO telemetry (obs/lifecycle.py)
+            PropertyMetadata("lifecycle",
+                             "Query lifecycle timeline + live progress + "
+                             "cluster events: off reproduces the pre-"
+                             "lifecycle serving path bit-for-bit (no "
+                             "timeline, no progressUri, no new metric "
+                             "families); on decomposes e2e wall into "
+                             "queue/plan/compile/exec/drain segments and "
+                             "feeds the per-group SLO histograms",
+                             str, "on",
+                             validator=_enum("lifecycle", ["OFF", "ON"])),
+            PropertyMetadata("slo_objectives",
+                             "Comma list of segment=seconds latency "
+                             "objectives (segments: queue_wait, plan, "
+                             "compile, exec, drain, e2e); a completed query "
+                             "whose segment exceeds its bound increments "
+                             "presto_tpu_slo_violations_total{group,segment}",
+                             str, "", validator=_objectives("slo_objectives")),
+            PropertyMetadata("latency_regression_factor",
+                             "Flag a completed query as a latency regression "
+                             "when its e2e wall is at least this many times "
+                             "the fingerprint's HBO baseline wall (0 "
+                             "disables)", float, 3.0,
+                             validator=_nonneg("latency_regression_factor")),
         ]
 
     def names(self) -> List[str]:
@@ -356,4 +393,5 @@ class Session:
             hbo=self.get("hbo").lower(),
             devprof=self.get("devprof").lower(),
             profile=self.get("profile"),
+            lifecycle=self.get("lifecycle").lower(),
         )

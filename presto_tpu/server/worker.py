@@ -188,6 +188,13 @@ class TaskExecution:
         self.state = "running"
         self.error: Optional[str] = None
         self.stats_report: Optional[list] = None  # per-operator rows
+        # lifecycle plane (obs/lifecycle.py): count emitted rows/batches so
+        # heartbeats carry live query progress; gated — lifecycle=off keeps
+        # the pre-lifecycle sink path and heartbeat doc bit-for-bit
+        self._count_progress = str(
+            update.config.get("lifecycle", "on")).lower() == "on"
+        self.rows_emitted = 0
+        self.batches_emitted = 0
         f = update.fragment
         self.buffer = OutputBuffer(
             update.n_out_partitions,
@@ -368,6 +375,20 @@ class TaskExecution:
             self.stats_report = rows
 
     def _make_sink(self, f: Fragment, cfg):
+        sink = self._make_sink_inner(f, cfg)
+        if not self._count_progress:
+            return sink
+
+        def counting_sink(b: Batch, _sink=sink):
+            # live-row accounting happens before the inner sink's own
+            # serialize so a sink raise still leaves the rows visible
+            self.rows_emitted += int(np.asarray(b.live).sum())
+            self.batches_emitted += 1
+            _sink(b)
+
+        return counting_sink
+
+    def _make_sink_inner(self, f: Fragment, cfg):
         if f.output_partitioning == OUT_HASH and self.update.n_out_partitions > 1:
             pid_fn = _jit_partition_ids(
                 tuple(f.output_keys), self.update.n_out_partitions
@@ -453,7 +474,15 @@ class TaskExecution:
         }
         if self.stats_report is not None:
             out["stats"] = self.stats_report
+        if self._count_progress:
+            out["rowsEmitted"] = self.rows_emitted
+            out["batchesEmitted"] = self.batches_emitted
         return out
+
+
+# task ids are "{query_id}.{fragment}.{index}[.r{retry}]" — the greedy
+# query group absorbs any dots inside the query id itself
+_TASK_ID_RE = re.compile(r"^(.+)\.(\d+)\.(\d+)(?:\.r\d+)?$")
 
 
 class TaskManager:
@@ -490,6 +519,39 @@ class TaskManager:
             qp = self._query_pools[query_id] = QueryScopedPool(
                 self.memory_pool, query_id)
         return qp
+
+    def query_progress(self) -> Dict[str, dict]:
+        """Live per-query progress over lifecycle-counting tasks: rows and
+        batches emitted plus task/fragment completion, keyed by the attempt
+        query id (the coordinator's lifecycle registry resolves attempt ->
+        serving query via its alias map). Empty when no task counts, so
+        the heartbeat doc stays bit-for-bit pre-lifecycle."""
+        with self._lock:
+            tasks = list(self.tasks.values())
+        out: Dict[str, dict] = {}
+        frag_states: Dict[str, Dict[int, List[str]]] = {}
+        for t in tasks:
+            if not getattr(t, "_count_progress", False):
+                continue
+            m = _TASK_ID_RE.match(t.task_id)
+            qid = m.group(1) if m else t.task_id
+            fid = int(m.group(2)) if m else 0
+            d = out.setdefault(qid, {
+                "rows": 0, "batches": 0, "tasksDone": 0, "tasksTotal": 0,
+                "fragmentsDone": 0, "fragmentsTotal": 0})
+            d["rows"] += t.rows_emitted
+            d["batches"] += t.batches_emitted
+            d["tasksTotal"] += 1
+            if t.state != "running":
+                d["tasksDone"] += 1
+            frag_states.setdefault(qid, {}).setdefault(fid, []).append(
+                t.state)
+        for qid, fmap in frag_states.items():
+            out[qid]["fragmentsTotal"] = len(fmap)
+            out[qid]["fragmentsDone"] = sum(
+                1 for states in fmap.values()
+                if all(s != "running" for s in states))
+        return out
 
     def query_memory(self) -> Dict[str, int]:
         """Live per-query reserved bytes (stale finished queries pruned)."""
@@ -751,6 +813,11 @@ class Worker:
             "spilledBytes": self.spill_manager.total_spilled_bytes,
             "spillCount": self.spill_manager.spill_count,
         }
+        progress = self.task_manager.query_progress()
+        if progress:
+            # lifecycle plane: live operator row counts ride the heartbeat
+            # so the coordinator's progress endpoint sees mid-query state
+            doc["queryProgress"] = progress
         try:
             from presto_tpu.obs import devprof as _devprof
 

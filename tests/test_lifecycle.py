@@ -1,0 +1,363 @@
+"""Serving-plane SLO telemetry: lifecycle timelines, live progress,
+objectives/regression counters, and the unified cluster event stream
+(obs/lifecycle.py + obs/events.py + the querymanager EXPIRED state)."""
+
+import json
+import threading
+import time
+
+import pytest
+
+from presto_tpu.obs import events as obs_events
+from presto_tpu.obs import lifecycle
+from presto_tpu.obs import runstats
+from presto_tpu.server.querymanager import (
+    EXPIRED,
+    FINISHED,
+    QueryManager,
+    QueryResult,
+)
+from presto_tpu.server.session import Session, SessionPropertyError
+
+
+@pytest.fixture(autouse=True)
+def _clean_plane():
+    lifecycle.reset()
+    obs_events.EVENTS.clear()
+    runstats.reset()
+    yield
+    lifecycle.reset()
+    obs_events.EVENTS.clear()
+    runstats.reset()
+
+
+def _sum_segments(segs):
+    return sum(v for k, v in segs.items() if k != "e2e")
+
+
+# ---------------------------------------------------------------------------
+# timeline segment math
+
+
+def test_timeline_full_walk_sums_to_e2e():
+    tl = lifecycle.Timeline(created=100.0)
+    tl.mark("queued", 100.5)
+    tl.mark("admitted", 101.0)
+    tl.mark("planning", 101.0)
+    tl.mark("compiling", 101.25)
+    tl.mark("executing", 102.0)
+    tl.mark("draining", 103.5)
+    tl.finish("finished", 103.75)
+    segs = tl.segments()
+    assert segs["queue_wait"] == pytest.approx(1.0)
+    assert segs["plan"] == pytest.approx(0.25)
+    assert segs["compile"] == pytest.approx(0.75)
+    assert segs["exec"] == pytest.approx(1.5)
+    assert segs["drain"] == pytest.approx(0.25)
+    assert segs["e2e"] == pytest.approx(3.75)
+    assert _sum_segments(segs) == pytest.approx(segs["e2e"])
+
+
+def test_timeline_missing_marks_resolve_right():
+    # a query that dies while queued books its whole life to queue_wait
+    tl = lifecycle.Timeline(created=10.0)
+    tl.finish("canceled", 12.0)
+    segs = tl.segments()
+    assert segs["queue_wait"] == pytest.approx(2.0)
+    assert segs["plan"] == segs["compile"] == segs["exec"] == segs["drain"] == 0.0
+    assert segs["e2e"] == pytest.approx(2.0)
+
+    # coordinator-side statement: only planning was stamped, everything
+    # after books to the plan segment
+    tl = lifecycle.Timeline(created=10.0)
+    tl.mark("planning", 10.5)
+    tl.finish("finished", 11.5)
+    segs = tl.segments()
+    assert segs["queue_wait"] == pytest.approx(0.5)
+    assert segs["plan"] == pytest.approx(1.0)
+    assert _sum_segments(segs) == pytest.approx(segs["e2e"])
+
+
+def test_timeline_first_mark_wins_and_terminal_absorbs():
+    tl = lifecycle.Timeline(created=1.0)
+    assert tl.mark("executing", 2.0)
+    assert not tl.mark("executing", 5.0)  # replay re-entry: first wins
+    assert tl.finish("finished", 3.0)
+    assert not tl.finish("failed", 4.0)
+    assert not tl.mark("draining", 3.5)  # late mark after terminal dropped
+    assert tl.terminal == "finished"
+    assert tl.marks["executing"] == 2.0
+
+
+def test_timeline_running_query_segments_track_now():
+    tl = lifecycle.Timeline(created=50.0)
+    tl.mark("planning", 51.0)
+    segs = tl.segments(now=53.0)
+    assert segs["e2e"] == pytest.approx(3.0)
+    assert _sum_segments(segs) == pytest.approx(3.0)
+
+
+# ---------------------------------------------------------------------------
+# objectives parsing
+
+
+def test_parse_objectives():
+    out = lifecycle.parse_objectives("e2e=1.5, queue_wait=0.25")
+    assert out == {"e2e": 1.5, "queue_wait": 0.25}
+    assert lifecycle.parse_objectives("") == {}
+    with pytest.raises(ValueError):
+        lifecycle.parse_objectives("warp_speed=1")
+    with pytest.raises(ValueError):
+        lifecycle.parse_objectives("e2e=0")
+    with pytest.raises(ValueError):
+        lifecycle.parse_objectives("e2e")
+    with pytest.raises(ValueError):
+        lifecycle.parse_objectives("e2e=fast")
+
+
+def test_slo_objectives_session_property_validation():
+    s = Session()
+    s.set("slo_objectives", "e2e=2.0,exec=1.0")
+    with pytest.raises(SessionPropertyError):
+        s.set("slo_objectives", "bogus_segment=1")
+
+
+# ---------------------------------------------------------------------------
+# cluster event stream
+
+
+def test_event_stream_ring_and_filters(tmp_path):
+    es = obs_events.ClusterEventStream(capacity=4)
+    for i in range(6):
+        es.emit("lifecycle", query_id=f"q{i % 2}", state="created")
+    evs = es.events()
+    assert len(evs) == 4  # bounded ring
+    seqs = [e["seq"] for e in evs]
+    assert seqs == sorted(seqs) and seqs[-1] == 6
+    only_q1 = es.events(query_id="q1")
+    assert all(e["queryId"] == "q1" for e in only_q1)
+    assert all(e["traceToken"] == "q1" for e in only_q1)
+    assert es.events(since=5) == [e for e in evs if e["seq"] > 5]
+    assert es.events(kind="nope") == []
+
+    sink = tmp_path / "events.jsonl"
+    es.configure(path=str(sink))
+    es.emit("slo_violation", query_id="qx", segment="e2e")
+    recs = [json.loads(l) for l in sink.read_text().splitlines()]
+    assert recs[-1]["kind"] == "slo_violation"
+    assert recs[-1]["traceToken"] == "qx"
+
+
+def test_slow_query_logger_extra_annotation(tmp_path):
+    from presto_tpu.server.querymanager import QueryInfo
+
+    path = tmp_path / "slow.jsonl"
+    logger = obs_events.SlowQueryLogger(str(path), threshold_s=0.0)
+    now = time.time()
+    info = QueryInfo(query_id="q1", sql="SELECT 1", state="FINISHED",
+                     user="u", resource_group=None, create_time=now,
+                     end_time=now + 0.5)
+    logger.log(info, extra={"latencyRegression": {"factor": 3.0}})
+    rec = json.loads(path.read_text().splitlines()[-1])
+    assert rec["queryId"] == "q1"
+    assert rec["latencyRegression"] == {"factor": 3.0}
+
+
+# ---------------------------------------------------------------------------
+# progress estimation
+
+
+def test_progress_monotone_and_terminal():
+    entry = lifecycle.register("q_prog")
+    doc0 = lifecycle.progress_doc("q_prog")
+    assert doc0["fraction"] == 0.0
+    assert doc0["provenance"] == "fragments"
+
+    # HBO prediction: 100 output rows expected
+    runstats.note("fp_prog", lifecycle.HBO_SITE, rows=100.0, wall_s=10.0)
+    lifecycle.set_fingerprint("q_prog", "fp_prog")
+    assert entry.predicted["rows"] == 100.0
+
+    lifecycle.observe_batch("q_prog", 50)
+    d1 = lifecycle.progress_doc("q_prog")
+    assert d1["provenance"] == "hbo"
+    assert d1["fraction"] >= 0.5
+    lifecycle.observe_batch("q_prog", 500)  # overshoot clamps below 1.0
+    d2 = lifecycle.progress_doc("q_prog")
+    assert d1["fraction"] <= d2["fraction"] <= 0.99
+
+    entry.timeline.finish("finished")
+    d3 = lifecycle.progress_doc("q_prog")
+    assert d3["fraction"] == 1.0
+    # running max: later polls never go backwards
+    assert lifecycle.progress_doc("q_prog")["fraction"] == 1.0
+    assert d3["predicted"]["rows"] == 100.0
+
+
+def test_progress_fragments_fallback_and_worker_merge():
+    lifecycle.register("q_frag")
+    lifecycle.merge_worker_progress("w0", {
+        "q_frag": {"rows": 10, "batches": 2, "tasksDone": 3, "tasksTotal": 4,
+                   "fragmentsDone": 1, "fragmentsTotal": 2}})
+    doc = lifecycle.progress_doc("q_frag")
+    assert doc["provenance"] == "fragments"
+    assert doc["fraction"] == pytest.approx(0.75)
+    assert doc["workerRows"] == 10
+    assert doc["fragments"] == {"done": 1, "total": 2}
+
+
+def test_progress_alias_resolves_attempt_ids():
+    lifecycle.register("q_serve")
+    lifecycle.alias("attempt_1", "q_serve")
+    lifecycle.merge_worker_progress("w0", {
+        "attempt_1": {"rows": 7, "batches": 1, "tasksDone": 1,
+                      "tasksTotal": 1, "fragmentsDone": 1,
+                      "fragmentsTotal": 1}})
+    assert lifecycle.progress_doc("q_serve")["workerRows"] == 7
+    assert lifecycle.progress_doc("unknown") is None
+
+
+# ---------------------------------------------------------------------------
+# completion: histograms, objectives, regression
+
+
+class _Info:
+    def __init__(self, query_id, state="FINISHED"):
+        self.query_id = query_id
+        self.state = state
+
+
+def test_complete_observes_histograms_and_violations():
+    entry = lifecycle.register("q_slo", objectives={"e2e": 0.0001})
+    entry.group = "global.batch"
+    time.sleep(0.002)
+    entry.timeline.finish("finished")
+    lifecycle.complete(_Info("q_slo"))
+    rows = lifecycle.metric_rows({"plane": "coordinator"})
+    viol = [r for r in rows if r[0] == "presto_tpu_slo_violations_total"
+            and r[3].get("group") == "global.batch"]
+    assert viol and viol[0][2] == 1 and viol[0][3]["segment"] == "e2e"
+    kinds = [e["kind"] for e in obs_events.EVENTS.events(query_id="q_slo")]
+    assert "slo_violation" in kinds
+    text = lifecycle.render_slo_histograms("coordinator")
+    assert 'group="global.batch"' in text
+    assert "presto_tpu_query_e2e_seconds_bucket" in text
+
+
+def test_latency_regression_flags_and_records_profile():
+    # baseline must exist BEFORE the run completes (note() max-merges)
+    runstats.note("fp_reg", lifecycle.HBO_SITE, wall_s=0.0001)
+    entry = lifecycle.register("q_reg", regression_factor=2.0)
+    lifecycle.set_fingerprint("q_reg", "fp_reg")
+    time.sleep(0.002)
+    entry.timeline.finish("finished")
+    lifecycle.complete(_Info("q_reg"))
+    assert entry.regression is not None
+    assert entry.regression["baselineWallS"] == pytest.approx(0.0001)
+    assert lifecycle.slow_log_annotation("q_reg")["latencyRegression"][
+        "fingerprint"] == "fp_reg"
+    kinds = [e["kind"] for e in obs_events.EVENTS.events(query_id="q_reg")]
+    assert "latency_regression" in kinds
+    rows = lifecycle.metric_rows({})
+    regr = [r for r in rows
+            if r[0] == "presto_tpu_latency_regression_total" and r[2] > 0]
+    assert regr
+    # the completed profile was recorded back for the next run
+    assert runstats.query_baseline("fp_reg")["wall_s"] > 0.0001
+
+
+def test_no_regression_on_failed_queries():
+    runstats.note("fp_f", lifecycle.HBO_SITE, wall_s=0.0001)
+    entry = lifecycle.register("q_f", regression_factor=2.0)
+    lifecycle.set_fingerprint("q_f", "fp_f")
+    time.sleep(0.002)
+    entry.timeline.finish("failed")
+    lifecycle.complete(_Info("q_f", state="FAILED"))
+    assert entry.regression is None
+
+
+# ---------------------------------------------------------------------------
+# querymanager integration: transitions, EXPIRED, lifecycle=off
+
+
+def _instant(session, sql):
+    return QueryResult(columns=["x"], types=["bigint"], rows=[(1,)])
+
+
+def test_query_manager_emits_lifecycle_transitions():
+    qm = QueryManager(execute_fn=_instant)
+    try:
+        s = Session(user="u")
+        qe = qm.create_query(s, "SELECT 1")
+        assert qe.wait(10)
+        assert qe.state == FINISHED
+        assert qe.timeline is not None
+        states = [e["state"] for e in obs_events.EVENTS.events(
+            query_id=qe.query_id, kind="lifecycle")]
+        assert states[0] == "created"
+        assert states[-1] == "finished"
+        assert "admitted" in states and "planning" in states
+        assert states.index("admitted") < states.index("planning")
+        doc = qe.timeline.doc()
+        assert doc["terminal"] == "finished"
+        segs = doc["segments"]
+        assert _sum_segments(segs) == pytest.approx(segs["e2e"], abs=1e-5)
+        assert "lifecycle" in qe.info().stats
+    finally:
+        qm.close()
+
+
+def test_query_manager_lifecycle_off_is_inert():
+    qm = QueryManager(execute_fn=_instant)
+    try:
+        s = Session(user="u")
+        s.set("lifecycle", "off")
+        qe = qm.create_query(s, "SELECT 1")
+        assert qe.wait(10)
+        assert qe.timeline is None
+        assert not lifecycle.armed()
+        assert obs_events.EVENTS.events(query_id=qe.query_id) == []
+        assert "lifecycle" not in qe.info().stats
+    finally:
+        qm.close()
+
+
+def test_expired_is_distinct_terminal_state():
+    stop = threading.Event()
+
+    def _hang(session, sql):
+        stop.wait(30)
+        return QueryResult(columns=[], types=[], rows=[])
+
+    qm = QueryManager(execute_fn=_hang)
+    try:
+        s = Session(user="u")
+        s.set("query_max_run_time_s", 0.05)
+        qe = qm.create_query(s, "SELECT slow()")
+        assert qe.wait(15), "enforcement loop never expired the query"
+        assert qe.state == EXPIRED
+        assert "maximum run time of 0.05s" in qe.error
+        assert "elapsed" in qe.error
+        assert qe.error_type == "EXCEEDED_TIME_LIMIT"
+        info = qe.info()
+        assert info.stats["expired"]["limitS"] == 0.05
+        assert info.stats["expired"]["elapsedS"] > 0
+        states = [e["state"] for e in obs_events.EVENTS.events(
+            query_id=qe.query_id, kind="lifecycle")]
+        assert states[-1] == "expired"
+        exp = [e for e in obs_events.EVENTS.events(query_id=qe.query_id)
+               if e.get("state") == "expired"]
+        assert exp[0]["limitS"] == 0.05
+    finally:
+        stop.set()
+        qm.close()
+
+
+def test_metric_rows_zeroed_when_armed_but_quiet():
+    lifecycle.register("q_quiet")
+    rows = lifecycle.metric_rows({"plane": "coordinator"})
+    names = {r[0] for r in rows}
+    assert names == {"presto_tpu_slo_violations_total",
+                     "presto_tpu_latency_regression_total"}
+    assert all(r[2] == 0 for r in rows)
+    assert all(r[4] == "counter" for r in rows)

@@ -1,0 +1,140 @@
+"""Direct ResourceGroupManager coverage: policy ordering, hierarchical
+concurrency/queue accounting, and info() accuracy under concurrent
+submit/finish churn (reference: TestInternalResourceGroup)."""
+
+import threading
+
+import pytest
+
+from presto_tpu.server.resource_groups import (
+    QueryQueueFullError,
+    ResourceGroupManager,
+    ResourceGroupSpec,
+    SelectorSpec,
+)
+
+
+def _tree():
+    return ResourceGroupSpec(
+        "global", hard_concurrency_limit=3, max_queued=100,
+        subgroups=[
+            ResourceGroupSpec("adhoc", hard_concurrency_limit=2,
+                              max_queued=50),
+            ResourceGroupSpec("batch", hard_concurrency_limit=2,
+                              max_queued=50),
+        ])
+
+
+def _selectors():
+    return [
+        SelectorSpec(group="global.adhoc", source_regex="adhoc"),
+        SelectorSpec(group="global.batch", source_regex="batch"),
+        SelectorSpec(group="global"),
+    ]
+
+
+def test_query_priority_ordering():
+    rg = ResourceGroupManager(
+        ResourceGroupSpec("global", hard_concurrency_limit=1,
+                          scheduling_policy="query_priority"))
+    started = []
+    rg.submit("u", "", 1, lambda: started.append("running"))
+    for name, pri in [("low", 1), ("high", 9), ("mid", 5), ("top", 20)]:
+        rg.submit("u", "", pri, lambda n=name: started.append(n))
+    assert started == ["running"]
+    for _ in range(4):
+        rg.query_finished("global")
+    assert started == ["running", "top", "high", "mid", "low"]
+
+
+def test_can_run_respects_ancestor_limit():
+    # leaf limits allow 2+2 but the root caps the tree at 3
+    rg = ResourceGroupManager(_tree(), _selectors())
+    started = []
+    rg.submit("u", "adhoc", 1, lambda: started.append("a1"))
+    rg.submit("u", "adhoc", 1, lambda: started.append("a2"))
+    rg.submit("u", "batch", 1, lambda: started.append("b1"))
+    rg.submit("u", "batch", 1, lambda: started.append("b2"))  # root is full
+    assert started == ["a1", "a2", "b1"]
+    info = rg.info()
+    assert info["global"]["running"] == 3
+    assert info["global.batch"]["queued"] == 1
+    rg.query_finished("global.adhoc")
+    assert started == ["a1", "a2", "b1", "b2"]
+
+
+def test_hierarchical_total_queued():
+    rg = ResourceGroupManager(_tree(), _selectors())
+    for _ in range(3):
+        rg.submit("u", "adhoc", 1, lambda: None)  # 2 run, 1 queues at leaf
+    for _ in range(3):
+        rg.submit("u", "batch", 1, lambda: None)  # 1 runs (root cap), 2 queue
+    assert rg.root.total_queued() == 3
+    assert rg.root.children["adhoc"].total_queued() == 1
+    assert rg.root.children["batch"].total_queued() == 2
+
+
+def test_on_queued_fires_only_when_queued():
+    rg = ResourceGroupManager(
+        ResourceGroupSpec("global", hard_concurrency_limit=1, max_queued=1))
+    queued = []
+    rg.submit("u", "", 1, lambda: None, on_queued=lambda: queued.append(1))
+    assert queued == []  # ran immediately, never queued
+    rg.submit("u", "", 1, lambda: None, on_queued=lambda: queued.append(2))
+    assert queued == [2]
+    with pytest.raises(QueryQueueFullError) as ei:
+        rg.submit("u", "", 1, lambda: None, on_queued=lambda: queued.append(3))
+    assert queued == [2]  # rejection does not count as queued
+    assert ei.value.group == "global"
+
+
+def test_info_queue_depth_under_concurrent_churn():
+    rg = ResourceGroupManager(
+        ResourceGroupSpec("global", hard_concurrency_limit=4,
+                          max_queued=10_000))
+    done = threading.Event()
+    lock = threading.Lock()
+    finished = [0]
+    n_threads, per_thread = 8, 25
+
+    def release():
+        with lock:
+            finished[0] += 1
+        rg.query_finished("global")
+
+    def churn():
+        for _ in range(per_thread):
+            # start_fn releases its own slot from a worker thread, so
+            # slots cycle while other threads are mid-submit
+            rg.submit("u", "", 1,
+                      lambda: threading.Thread(target=release).start())
+
+    threads = [threading.Thread(target=churn) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    # drain: every queued entry eventually starts and releases
+    deadline = 10.0
+    import time
+    t0 = time.time()
+    while time.time() - t0 < deadline:
+        info = rg.info()
+        with lock:
+            got = finished[0]
+        if got == n_threads * per_thread and info["global"]["queued"] == 0:
+            break
+        time.sleep(0.01)
+    info = rg.info()
+    assert finished[0] == n_threads * per_thread
+    assert info["global"]["queued"] == 0
+    assert info["global"]["running"] == 0
+    done.set()
+
+
+def test_info_reports_limits_and_policy():
+    rg = ResourceGroupManager(_tree(), _selectors())
+    info = rg.info()
+    assert info["global.adhoc"]["hard_concurrency_limit"] == 2
+    assert info["global.adhoc"]["max_queued"] == 50
+    assert info["global"]["policy"] == "fair"
