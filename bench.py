@@ -527,6 +527,105 @@ def _serving_child(sf: float, n_clients: int, per_client: int):
     print(json.dumps(rec), flush=True)
 
 
+def _serving_cached_child(sf: float):
+    """Result-cache economics: the same mixed workload served twice over
+    the statement protocol with ``result_cache=query`` on the session.
+    Round 1 (cold) pays plan+compile+execute; rounds 2-3 (warm) must be
+    served out of the fingerprint-keyed result cache — the record carries
+    cold/warm p50, the hit rate, and the bytes the cache holds for it."""
+    import statistics
+    import urllib.request
+
+    if os.environ.get("BENCH_FORCE_CPU"):
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    from presto_tpu.catalog.parquet import ParquetConnector, export_tpch_chunked
+    from presto_tpu.connector import Catalog
+    from presto_tpu.server.coordinator import DistributedRunner
+
+    d = os.path.join(DATA_DIR, f"tpch_sf{sf:g}")
+    export_tpch_chunked(d, sf, log=_log)
+    cat = Catalog()
+    conn = ParquetConnector(d, name="tpch")
+    cat.register("tpch", conn, default=True)
+    dr = DistributedRunner(cat, n_workers=2)
+    base = dr.coordinator.url
+    mix = [Q1, Q6, JOIN_SF1]
+
+    def run_one(sql):
+        t0 = time.perf_counter()
+        req = urllib.request.Request(
+            base + "/v1/statement", data=sql.encode(),
+            headers={"X-Presto-User": "bench-cached",
+                     "X-Presto-Session": "result_cache=query",
+                     "Content-Type": "text/plain"})
+        doc = json.loads(urllib.request.urlopen(req, timeout=600).read())
+        while doc.get("nextUri"):
+            doc = json.loads(urllib.request.urlopen(
+                doc["nextUri"], timeout=600).read())
+        if doc.get("error"):
+            raise RuntimeError(doc["error"].get("message"))
+        return time.perf_counter() - t0
+
+    cold = [run_one(sql) for sql in mix]
+    warm = [run_one(sql) for _ in range(2) for sql in mix]
+    body = urllib.request.urlopen(
+        base + "/v1/metrics", timeout=30).read().decode()
+    dr.close()
+
+    def _gauge(name):
+        for line in body.splitlines():
+            if line.startswith(name + "{") or line.startswith(name + " "):
+                try:
+                    return float(line.rsplit(" ", 1)[1])
+                except ValueError:
+                    pass
+        return 0.0
+
+    hits = _gauge("presto_tpu_result_cache_hits_total")
+    misses = _gauge("presto_tpu_result_cache_misses_total")
+    cold_p50 = statistics.median(cold)
+    warm_p50 = statistics.median(warm)
+    rec = {
+        "sf": sf, "queries": len(mix),
+        "cold_p50_s": round(cold_p50, 4), "warm_p50_s": round(warm_p50, 4),
+        "speedup": round(cold_p50 / warm_p50, 1) if warm_p50 else None,
+        "cache_hits": int(hits), "cache_misses": int(misses),
+        "hit_rate": round(hits / (hits + misses), 3) if hits + misses else 0,
+        "cache_bytes": int(_gauge("presto_tpu_result_cache_bytes")),
+    }
+    print(json.dumps(rec), flush=True)
+
+
+def _run_serving_slo_cached(extra: dict, remaining: float):
+    """Warm-over-cold serving comparison for the semantic result cache
+    (the perf claim: an identical repeat never re-plans, re-compiles, or
+    re-executes — see BENCH_NOTES.md for how to read the record)."""
+    sf = float(os.environ.get("BENCH_SF_SERVING", "0.1"))
+    env = dict(os.environ)
+    try:
+        p = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--serving-cached-child", str(sf)],
+            env=env, stdout=subprocess.PIPE,
+            timeout=min(900, max(120, remaining - 15)))
+        lines = p.stdout.decode().strip().splitlines()
+        if p.returncode == 0 and lines:
+            rec = json.loads(lines[-1])
+            _log(f"serving_slo_cached: cold p50={rec['cold_p50_s']}s "
+                 f"warm p50={rec['warm_p50_s']}s "
+                 f"({rec['speedup']}x, hit rate {rec['hit_rate']}, "
+                 f"{rec['cache_bytes']}B held)")
+            extra["serving_slo_cached"] = rec
+        else:
+            extra["serving_slo_cached"] = {"error": f"child rc={p.returncode}"}
+    except subprocess.TimeoutExpired:
+        extra["serving_slo_cached"] = {"error": "timeout"}
+    except Exception as e:  # noqa: BLE001
+        extra["serving_slo_cached"] = {"error": f"{type(e).__name__}: {e}"}
+
+
 def _run_serving_slo(extra: dict, remaining: float):
     """Closed-loop serving-SLO bench: N concurrent protocol clients over a
     mixed TPC-H workload, latencies read from the per-group lifecycle
@@ -680,6 +779,9 @@ def main():
         _serving_child(float(sys.argv[2]), int(sys.argv[3]),
                        int(sys.argv[4]))
         return
+    if len(sys.argv) >= 3 and sys.argv[1] == "--serving-cached-child":
+        _serving_cached_child(float(sys.argv[2]))
+        return
 
     signal.signal(signal.SIGTERM, _on_term)
     signal.signal(signal.SIGINT, _on_term)
@@ -697,7 +799,7 @@ def main():
     wanted = os.environ.get(
         "BENCH_CONFIGS", "q1_sf1,q1_nofuse_sf1,q6_sf10,q3_sf10,join_sf1,"
         "groupby_engine_ab_sf1,groupby_engine_ab_sort_sf1,mesh_scaling,"
-        "serving_slo,q9,q64"
+        "serving_slo,serving_slo_cached,q9,q64"
     ).split(",")
 
     for name in (w.strip() for w in wanted):
@@ -722,6 +824,17 @@ def main():
                 if not device_ok:
                     os.environ["BENCH_FORCE_CPU"] = "1"
                 _run_serving_slo(extra, remaining)
+            _checkpoint()
+            continue
+        if name == "serving_slo_cached":
+            remaining = budget - (time.time() - _T0)
+            if remaining < 60:
+                _log("serving_slo_cached: SKIPPED (budget exhausted)")
+                extra["serving_slo_cached"] = {"skipped": "budget"}
+            else:
+                if not device_ok:
+                    os.environ["BENCH_FORCE_CPU"] = "1"
+                _run_serving_slo_cached(extra, remaining)
             _checkpoint()
             continue
         if name not in _CONFIGS:

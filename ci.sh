@@ -656,6 +656,98 @@ if [ "$rc" -ne 0 ]; then
   exit "$rc"
 fi
 
+# Result-cache smoke: with result_cache=query on the session, the second
+# run of an identical statement must (a) compile nothing, (b) dispatch
+# zero breakers, (c) go straight to draining with a cache_hit event and
+# a resultCache stat on the wire, (d) book ~zero compile/exec segment
+# time, and (e) land a cacheHit doc in the slow-query JSONL. A catalog
+# mutation (CTAS) must then bump the snapshot token and force a miss.
+echo "== result-cache smoke: identical-query reuse + snapshot invalidation =="
+tmp_rcache="$(mktemp -d)"
+env JAX_PLATFORMS=cpu PRESTO_TPU_RC_DIR="$tmp_rcache" python - <<'PYEOF'
+import json, os, urllib.request
+
+from presto_tpu.catalog.memory import MemoryConnector
+from presto_tpu.catalog.tpch import tpch_catalog
+from presto_tpu.exec import programs
+from presto_tpu.obs import lifecycle
+from presto_tpu.scan import metrics as scan_metrics
+from presto_tpu.server import result_cache as rcache
+from presto_tpu.server.coordinator import DistributedRunner
+
+slow_log = os.path.join(os.environ["PRESTO_TPU_RC_DIR"], "slow.jsonl")
+cat = tpch_catalog(0.01)
+cat.register("m", MemoryConnector())
+dr = DistributedRunner(cat, n_workers=2, coordinator_kwargs={
+    "slow_query_log": slow_log, "slow_query_threshold_s": 0.0})
+base = dr.coordinator.url
+
+SQL = ("select l_returnflag as f, sum(l_quantity) as q from lineitem "
+       "group by l_returnflag order by f")
+
+
+def run_sql(sql, session="result_cache=query"):
+    headers = {"X-Presto-User": "smoke", "Content-Type": "text/plain"}
+    if session:
+        headers["X-Presto-Session"] = session
+    req = urllib.request.Request(base + "/v1/statement",
+                                 data=sql.encode(), headers=headers)
+    doc = json.load(urllib.request.urlopen(req, timeout=60))
+    qid, rows, last = doc["id"], [], doc
+    while True:
+        rows += doc.get("data") or []
+        nxt = doc.get("nextUri")
+        if not nxt:
+            break
+        doc = json.load(urllib.request.urlopen(nxt, timeout=60))
+        last = doc
+    return qid, rows, last
+
+
+def breaker_dispatches():
+    snap = scan_metrics.snapshot()
+    return sum(v for k, v in snap.items()
+               if k.startswith("breaker_dispatches"))
+
+
+q1, rows1, _ = run_sql(SQL)
+c0, b0 = programs.snapshot()["compiles"], breaker_dispatches()
+q2, rows2, last2 = run_sql(SQL)
+c1, b1 = programs.snapshot()["compiles"], breaker_dispatches()
+assert rows1 == rows2 and rows1, "cached result must equal computed result"
+assert c1 == c0, f"second run compiled ({c1 - c0} programs)"
+assert b1 == b0, f"second run dispatched {b1 - b0} breakers"
+st = (last2.get("stats") or {}).get("resultCache")
+assert st and st["kind"] == "query", st
+seg = lifecycle.get(q2).timeline.segments()
+assert seg["compile"] == 0.0 and seg["exec"] == 0.0, seg
+ev = json.load(urllib.request.urlopen(
+    base + "/v1/events?kind=cache_hit", timeout=30))
+assert ev["events"], "no cache_hit event on the stream"
+slow = [json.loads(l) for l in open(slow_log)]
+hit_docs = [r for r in slow if "cacheHit" in r]
+assert hit_docs and hit_docs[0]["cacheHit"]["kind"] == "query", slow
+
+# catalog mutation: CTAS in ANY connector bumps the snapshot token
+run_sql("create table m.probe as select 1 as one", session=None)
+q3, rows3, _ = run_sql(SQL)
+assert rows3 == rows1, "post-DDL recompute must still be correct"
+snap = rcache.CACHE.counters()
+assert snap["hits"] == 1 and snap["misses"] >= 2, snap
+assert snap["evictions"] >= 1, "stale entry bytes were not reclaimed"
+dr.close()
+print(f"result-cache smoke OK: run2 zero compiles / zero breaker "
+      f"dispatches, exec segment 0.0s, wire stat {st['bytes']}B, "
+      f"{len(ev['events'])} cache_hit event(s), DDL forced recompute "
+      f"(counters {snap['hits']}h/{snap['misses']}m)")
+PYEOF
+rc=$?
+rm -rf "$tmp_rcache"
+if [ "$rc" -ne 0 ]; then
+  echo "result-cache smoke FAILED (exit $rc)"
+  exit "$rc"
+fi
+
 # Static-analysis step: the kernel lint must be clean over the shipped
 # tree, the analyzer must actually FAIL on an injected violation (a
 # linter that can't fail is decoration), the plan-invariant checker must

@@ -62,6 +62,9 @@ _VOLATILE_CONFIG_FIELDS = frozenset({
     # devprof observes compiles and samples device memory; profile wraps
     # a query in a jax.profiler capture — neither changes any program
     "devprof", "profile",
+    # the result cache elides whole executions; any program that DOES run
+    # computes exactly what it would with the cache off
+    "result_cache",
 })
 
 # program cache bound: one entry is one (structure, program key) identity;
@@ -76,7 +79,7 @@ class ProgramEntry:
     accounting shared by every node that maps to it."""
 
     __slots__ = ("jfn", "lock", "seen_cache_size", "compiles",
-                 "compile_wall_s", "calls", "fp")
+                 "compile_wall_s", "calls", "fp", "restored")
 
     def __init__(self, jfn, fp: Optional[str] = None):
         self.jfn = jfn
@@ -91,6 +94,9 @@ class ProgramEntry:
         self.compiles = 0          # shared: guarded-by(self.lock)
         self.compile_wall_s = 0.0  # shared: guarded-by(self.lock)
         self.calls = 0             # shared: guarded-by(self.lock)
+        # avals-key → callable restored from a persisted jax.export
+        # artifact (warm restart skips re-trace); None until populated
+        self.restored = None       # shared: guarded-by(self.lock)
 
 
 _lock = threading.Lock()
@@ -103,6 +109,9 @@ _counters: Dict[str, int] = {  # shared: guarded-by(_lock)
     # XLA trace+compile events observed through any entry (shared or
     # private) — the process-wide "how much compiling happened" truth
     "compiles": 0,
+    # programs restored from PRESTO_TPU_CACHE_DIR persisted artifacts
+    # (warm restart skipped their re-trace)
+    "restored": 0,
 }
 _trace_wall_s = [0.0]  # shared: guarded-by(_lock)
 
@@ -167,6 +176,7 @@ def entry_for(ns: Optional[str], node_kind: str, key: str,
     if ns is None:
         return ProgramEntry(make())
     fp = f"{ns}|{node_kind}|{key}|{sorted(jit_kwargs.items())!r}"
+    created = None
     with _lock:
         e = _entries.get(fp)
         if e is not None:
@@ -175,11 +185,143 @@ def entry_for(ns: Optional[str], node_kind: str, key: str,
             return e
         # constructing jax.jit() is cheap (no trace happens here), so the
         # critical section stays small even on a miss
-        e = _entries[fp] = ProgramEntry(make(), fp=fp)
+        e = created = _entries[fp] = ProgramEntry(make(), fp=fp)
         _counters["misses"] += 1
         while len(_entries) > _MAX_ENTRIES:
             _entries.popitem(last=False)
-        return e
+    # file IO stays outside the registry lock; a racing caller that grabs
+    # the entry before restore lands just falls through to jfn
+    _restore_programs(created)
+    return e
+
+
+# -- persisted programs (warm restart skips re-trace) ------------------------
+#
+# The structural namespace is a stable cross-process key, so a compiled
+# program's jax.export artifact can be written once and re-loaded by a
+# fresh process. The honest contract on CPU (and anywhere XLA executables
+# don't persist): deserialization skips Python re-TRACE; backend
+# compilation of the restored StableHLO still happens on first call.
+# Everything is best-effort and double-gated (cache dir set AND
+# PRESTO_TPU_PROGRAM_PERSIST=1) so the default path has zero overhead.
+
+
+def _persist_dir() -> Optional[str]:
+    import os
+
+    d = os.environ.get("PRESTO_TPU_CACHE_DIR")
+    if not d or os.environ.get("PRESTO_TPU_PROGRAM_PERSIST") != "1":
+        return None
+    return os.path.join(d, "programs")
+
+
+_pytree_serialization_ready = False  # shared: guarded-by(_lock)
+
+
+def _ensure_pytree_serialization() -> None:
+    """jax.export serializes the calling-convention pytrees; Batch/Column
+    are custom nodes and need a one-time serialization registration. Their
+    auxdata (names, types, dictionary pages) is plain static metadata, so
+    pickle round-trips it."""
+    global _pytree_serialization_ready
+    with _lock:
+        if _pytree_serialization_ready:
+            return
+        _pytree_serialization_ready = True
+    try:
+        import pickle
+
+        from jax import export as jax_export
+
+        from presto_tpu.batch import Batch, Column
+
+        jax_export.register_pytree_node_serialization(
+            Batch, serialized_name="presto_tpu.batch.Batch",
+            serialize_auxdata=pickle.dumps,
+            deserialize_auxdata=pickle.loads)
+        jax_export.register_pytree_node_serialization(
+            Column, serialized_name="presto_tpu.batch.Column",
+            serialize_auxdata=pickle.dumps,
+            deserialize_auxdata=pickle.loads)
+    except Exception:
+        pass
+
+
+def _avals_key(args, kw) -> str:
+    """16-hex digest of the call's abstract signature (tree structure +
+    leaf shapes/dtypes) — one persisted artifact per traced shape."""
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten((args, kw))
+    sig = [repr(treedef)]
+    for leaf in leaves:
+        shape = tuple(getattr(leaf, "shape", ()))
+        dtype = str(getattr(leaf, "dtype", type(leaf).__name__))
+        sig.append(f"{shape}:{dtype}")
+    return hashlib.sha256("|".join(sig).encode()).hexdigest()[:16]
+
+
+def _artifact_prefix(fp: str) -> str:
+    return hashlib.sha256(fp.encode()).hexdigest()[:24]
+
+
+def _persist_program(entry: ProgramEntry, args, kw) -> None:
+    """Serialize the program that just compiled for these args. Failures
+    (unexportable closure, read-only dir, no jax.export) are swallowed —
+    persistence is an optimization, never a correctness dependency."""
+    import os
+
+    d = _persist_dir()
+    if d is None or entry.fp is None:
+        return
+    _ensure_pytree_serialization()
+    try:
+        # submodule: not reachable as an attribute on older jax
+        from jax import export as jax_export
+
+        data = jax_export.export(entry.jfn)(*args, **kw).serialize()
+        os.makedirs(d, exist_ok=True)
+        path = os.path.join(
+            d, _artifact_prefix(entry.fp) + "." + _avals_key(args, kw)
+            + ".jaxexp")
+        tmp = path + f".tmp{os.getpid()}"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, path)
+    except Exception:
+        pass
+
+
+def _restore_programs(entry: Optional[ProgramEntry]) -> None:
+    """Load every persisted artifact matching a fresh entry's fingerprint
+    so its first call per shape dispatches without re-tracing."""
+    import os
+
+    if entry is None or entry.fp is None:
+        return
+    d = _persist_dir()
+    if d is None:
+        return
+    _ensure_pytree_serialization()
+    try:
+        from jax import export as jax_export
+
+        prefix = _artifact_prefix(entry.fp) + "."
+        restored = {}
+        for fn in os.listdir(d):
+            if not (fn.startswith(prefix) and fn.endswith(".jaxexp")):
+                continue
+            akey = fn[len(prefix):-len(".jaxexp")]
+            with open(os.path.join(d, fn), "rb") as f:
+                restored[akey] = jax_export.deserialize(f.read()).call
+        if not restored:
+            return
+        with entry.lock:
+            entry.restored = restored
+        with _lock:
+            _counters["restored"] += len(restored)
+    except Exception:
+        pass
 
 
 def record_compiles(delta: int, wall_s: float) -> None:
@@ -208,6 +350,14 @@ def wrap(entry: ProgramEntry, node_stats: Dict[str, float],
     jfn = entry.jfn
 
     def wrapped(*args, **kw):
+        r = entry.restored
+        if r:
+            fn = r.get(_avals_key(args, kw))
+            if fn is not None:
+                try:
+                    return fn(*args, **kw)
+                except Exception:
+                    pass  # shape/layout drift: fall through to jfn
         try:
             t0 = time.perf_counter()
             w0 = time.time()
@@ -229,6 +379,7 @@ def wrap(entry: ProgramEntry, node_stats: Dict[str, float],
                 delta = 0
         if delta > 0:
             record_compiles(delta, dt)
+            _persist_program(entry, args, kw)
             tr = _obs_trace.current()
             if tr.enabled:
                 tr.record("compile", "compile", w0, w0 + dt,
@@ -333,4 +484,10 @@ def metric_rows(labels: Optional[Dict[str, str]] = None) -> List[Tuple]:
          snap["compiles"], labels, "counter"),
         ("presto_tpu_compile_cache_entries",
          "live shared program entries", snap["entries"], labels, "gauge"),
-    ]
+    ] + ([
+        # rendered only once a warm restart actually restored something,
+        # so the default scrape stays bit-for-bit
+        ("presto_tpu_compile_programs_restored_total",
+         "programs restored from persisted artifacts (re-trace skipped)",
+         snap["restored"], labels, "counter"),
+    ] if snap.get("restored") else [])

@@ -247,6 +247,13 @@ class ExecConfig:
     # query progress; "off" is a strict no-op — pre-lifecycle task path
     # and heartbeat doc bit-for-bit.
     lifecycle: str = "on"
+    # semantic result cache (server/result_cache.py): "query" memoizes
+    # final results keyed on (structural plan sha, catalog snapshot token,
+    # session catalog.schema); "subplan" additionally materializes and
+    # reuses breaker-subplan results; "off" (default) is a strict no-op —
+    # no cache consult, no metric families, no events, today's engine
+    # bit-for-bit.
+    result_cache: str = "off"
 
 
 def _node_jit(node: PlanNode, key: str, builder, _shared=True, **jit_kwargs):

@@ -292,6 +292,9 @@ class QueryLifecycle:
         #: (node_id, attempt_query_id) -> latest heartbeat progress doc
         self.worker_progress: Dict[Tuple[str, str], Dict[str, Any]] = {}
         self.regression: Optional[Dict[str, Any]] = None
+        #: result-cache provenance doc (server/result_cache.py): set on a
+        #: cache hit; surfaces in stats.resultCache and the slow-query log
+        self.cache_info: Optional[Dict[str, Any]] = None
         self._max_fraction = 0.0
         self._lock = threading.Lock()
 
@@ -421,12 +424,26 @@ def merge_worker_progress(node_id: str, doc: Dict[str, Any]) -> None:
             entry.worker_progress[(node_id, attempt_id)] = dict(stats)
 
 
-def slow_log_annotation(query_id: str) -> Optional[Dict[str, Any]]:
-    """Extra fields for the slow-query JSONL record (regression flag)."""
+def note_cache(query_id: str, doc: Dict[str, Any]) -> None:
+    """Attach a result-cache provenance doc to the query's lifecycle
+    entry (no-op for unregistered queries, preserving off-discipline)."""
     entry = get(query_id)
-    if entry is not None and entry.regression is not None:
-        return {"latencyRegression": dict(entry.regression)}
-    return None
+    if entry is not None:
+        entry.cache_info = dict(doc)
+
+
+def slow_log_annotation(query_id: str) -> Optional[Dict[str, Any]]:
+    """Extra fields for the slow-query JSONL record (regression flag,
+    result-cache provenance)."""
+    entry = get(query_id)
+    if entry is None:
+        return None
+    extra: Dict[str, Any] = {}
+    if entry.regression is not None:
+        extra["latencyRegression"] = dict(entry.regression)
+    if entry.cache_info is not None:
+        extra["cacheHit"] = dict(entry.cache_info)
+    return extra or None
 
 
 # ---------------------------------------------------------------------------

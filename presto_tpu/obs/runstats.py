@@ -121,6 +121,11 @@ def catalog_token(catalog) -> str:
     parts: List[str] = []
     try:
         for cname in sorted(getattr(catalog, "connectors", {}) or {}):
+            if cname.startswith("_"):
+                # engine-internal connectors (e.g. the result cache's
+                # "_rc" splice tables) are derived state, not user data:
+                # their churn must not invalidate history or cache keys
+                continue
             conn = catalog.connectors[cname]
             try:
                 names = sorted(conn.table_names())

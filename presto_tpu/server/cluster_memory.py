@@ -96,6 +96,10 @@ class ClusterMemoryManager:
         self._nodes: Dict[str, NodeMemory] = {}
         self._pressure_since: Optional[float] = None  # shared: guarded-by(self._lock)
         self._lock = threading.Lock()
+        # result-cache ledger hook (server/result_cache.py): when set,
+        # cached-result bytes count toward cluster pressure and are
+        # revoked BEFORE any query is killed
+        self.result_cache = None
 
     # -- ingest (called from the heartbeat prober) -------------------------
 
@@ -124,14 +128,26 @@ class ClusterMemoryManager:
         return {nid: nm for nid, nm in self._nodes.items()
                 if now - nm.at < self.stale_s}
 
+    def _cache_doc(self) -> Optional[dict]:
+        """Result-cache slice of the ledger, or None until the cache has
+        been consulted (off-discipline: pre-cache docs stay bit-for-bit)."""
+        rc = self.result_cache
+        if rc is None or not rc.armed():
+            return None
+        c = rc.counters()
+        return {"bytes": c["bytes"], "entries": c["entries"],
+                "budgetBytes": c["budget_bytes"],
+                "evictions": c["evictions"]}
+
     def info(self) -> dict:
+        cache_doc = self._cache_doc()
         with self._lock:
             nodes = self._fresh_nodes()
             by_query: Dict[str, int] = {}
             for nm in nodes.values():
                 for q, b in nm.queries.items():
                     by_query[q] = by_query.get(q, 0) + b
-            return {
+            doc = {
                 "totalReservedBytes": sum(n.reserved for n in nodes.values()),
                 "clusterLimitBytes": self.limit_bytes,
                 "blockedNodes": [nid for nid, n in nodes.items() if n.blocked],
@@ -139,11 +155,15 @@ class ClusterMemoryManager:
                 "queryMemory": by_query,
                 "lowMemoryKills": self.kills,
             }
+            if cache_doc is not None:
+                doc["resultCache"] = cache_doc
+            return doc
 
     def memory_rollup(self) -> dict:
         """The `GET /v1/memory` document (MemoryPoolInfo rollup analog):
         per-node pools (reserved/peak/limit + device stats) + per-query
         slices + the cluster view."""
+        cache_doc = self._cache_doc()
         with self._lock:
             nodes = self._fresh_nodes()
             node_docs = {}
@@ -162,17 +182,20 @@ class ClusterMemoryManager:
             for nm in nodes.values():
                 for q, b in nm.queries.items():
                     by_query[q] = by_query.get(q, 0) + b
+            cluster = {
+                "totalReservedBytes": sum(
+                    n.reserved for n in nodes.values()),
+                "peakReservedBytes": sum(n.peak for n in nodes.values()),
+                "clusterLimitBytes": self.limit_bytes,
+                "blockedNodes": [nid for nid, n in nodes.items()
+                                 if n.blocked],
+                "blockedNodeThreshold": self.blocked_node_threshold,
+                "lowMemoryKills": self.kills,
+            }
+            if cache_doc is not None:
+                cluster["resultCache"] = cache_doc
             return {
-                "cluster": {
-                    "totalReservedBytes": sum(
-                        n.reserved for n in nodes.values()),
-                    "peakReservedBytes": sum(n.peak for n in nodes.values()),
-                    "clusterLimitBytes": self.limit_bytes,
-                    "blockedNodes": [nid for nid, n in nodes.items()
-                                     if n.blocked],
-                    "blockedNodeThreshold": self.blocked_node_threshold,
-                    "lowMemoryKills": self.kills,
-                },
+                "cluster": cluster,
                 "nodes": node_docs,
                 "queryMemory": by_query,
             }
@@ -258,9 +281,15 @@ class ClusterMemoryManager:
         the killed query id, if any."""
         if self.policy == "none":
             return None
+        # cached-result bytes are cluster-held memory too: they count
+        # toward the limit (so holding results can create pressure) and
+        # are the FIRST thing revoked when pressure sustains
+        rc = self.result_cache
+        cache_bytes = (rc.bytes_held()
+                       if rc is not None and rc.armed() else 0)
         with self._lock:
             nodes = self._fresh_nodes()
-            total = sum(n.reserved for n in nodes.values())
+            total = sum(n.reserved for n in nodes.values()) + cache_bytes
             over_cluster = (self.limit_bytes is not None
                             and total > self.limit_bytes)
             blocked = [nid for nid, n in nodes.items() if n.blocked]
@@ -281,6 +310,16 @@ class ClusterMemoryManager:
                 for q in self._candidates(nodes, blocked_only=False):
                     if q not in candidates:
                         candidates.append(q)
+        # revocation before eviction-by-kill: dropping cached results is
+        # free (they can always be recomputed); a killed query is not.
+        # Any bytes actually freed end the pass — the next heartbeat
+        # re-evaluates pressure against the lighter cluster.
+        if rc is not None and cache_bytes > 0:
+            freed = rc.revoke_for_pressure()
+            if freed > 0:
+                with self._lock:
+                    self._pressure_since = None
+                return None
         # kill accounting happens only on a CONFIRMED kill: a stale victim
         # (worker still reporting a finished query) must not reset the
         # pressure timer or count as a kill — fall through to the next hog

@@ -740,6 +740,13 @@ class Coordinator:
             cluster_memory_limit_bytes, policy=low_memory_killer,
             kill_delay_s=low_memory_kill_delay_s,
             blocked_node_threshold=blocked_node_threshold)
+        # semantic result cache (server/result_cache.py): process-wide;
+        # its bytes ride the cluster memory ledger and are revoked under
+        # pressure before any query is killed
+        from presto_tpu.server import result_cache as _result_cache
+
+        self.result_cache = _result_cache.CACHE
+        self.cluster_memory.result_cache = self.result_cache
         self.failure_detector = HeartbeatFailureDetector(
             self.node_manager, cluster_memory=self.cluster_memory)
         self.size_monitor = ClusterSizeMonitor(self.node_manager, min_workers)
@@ -907,6 +914,24 @@ class Coordinator:
         cfg = _dc.replace(
             session.exec_config() if session else self.config,
             collect_stats=True)
+        # result-cache header: what a NON-explain run of this statement
+        # would see right now. peek() is non-mutating — rendering the
+        # header neither counts a hit/miss nor refreshes the entry.
+        rc_line = None
+        rc_mode = (getattr(cfg, "result_cache", "off") or "off").lower()
+        if rc_mode != "off":
+            if dplan.__dict__.get("_rc_cacheable"):
+                from presto_tpu.server import result_cache as _rc_mod2
+
+                rc_key = _rc_mod2.query_key(
+                    dplan, self.catalog,
+                    getattr(session, "catalog", "") or "",
+                    getattr(session, "schema", "") or "")
+                rc_state = ("hit" if self.result_cache.peek(rc_key)
+                            else "miss")
+            else:
+                rc_state = "bypass"
+            rc_line = f"[cache: {rc_state}]"
         stats: list = []
         self.size_monitor.wait_for_minimum()
         qid = self.next_query_id()
@@ -934,6 +959,8 @@ class Coordinator:
                     _obs_lifecycle.mark(session_qid, "executing")
                     first = False
         lines = []
+        if rc_line is not None:
+            lines += [rc_line, ""]
         if entry is not None:
             seg = entry.timeline.segments()
             lines += [
@@ -1170,6 +1197,10 @@ class Coordinator:
                 if m:
                     coord.protocol.cancel(m.group(1))
                     return self._json({"ok": True})
+                if self.path == "/v1/cache":
+                    # explicit operator flush of the semantic result cache
+                    n = coord.result_cache.flush()
+                    return self._json({"ok": True, "flushed": n})
                 self._json({"error": "not found"}, 404)
 
         self._http = ThreadingHTTPServer(("127.0.0.1", port), Handler)
@@ -1393,6 +1424,10 @@ class Coordinator:
             qp, self.catalog,
             broadcast_threshold_rows=threshold,
         )
+        # result-cache eligibility rides on the plan object: only plans
+        # with no scalar subqueries and a cacheable (deterministic) tree
+        # may consult/populate the semantic result cache
+        dplan.__dict__["_rc_cacheable"] = cacheable
         if cacheable:
             # concurrent submissions of the same sql both plan (the get
             # above is a lock-free fast path) but the insert keeps the
@@ -1429,6 +1464,142 @@ class Coordinator:
 
         for r in roots:
             walk(r)
+
+    # -- result cache ------------------------------------------------------
+
+    def _invalidate_result_cache(self):
+        """Snapshot-token barrier after DDL/DML: reclaim every cached
+        result whose token no longer matches the live catalog."""
+        rc = self.result_cache
+        if rc is None or not rc.armed():
+            return
+        try:
+            from presto_tpu.obs.runstats import catalog_token
+
+            rc.flush_stale(catalog_token(self.catalog))
+        except Exception:
+            pass
+
+    def _rc_connector(self):
+        """The private memory connector holding materialized subplan
+        results. Underscore-prefixed, so `catalog_token` skips it — its
+        churn must never invalidate the cache keyed on that token."""
+        conn = self.catalog.connectors.get("_rc")
+        if conn is None:
+            from presto_tpu.catalog.memory import MemoryConnector
+
+            conn = MemoryConnector("_rc")
+            # direct registration (not Catalog.register): the splice
+            # connector must never become the session default
+            conn.name = "_rc"
+            self.catalog.connectors["_rc"] = conn
+        return conn
+
+    @staticmethod
+    def _rc_table_name(skey: str) -> str:
+        """Splice table name for a subplan cache key — derived from the
+        KEY (stable across plan objects and processes), never from plan
+        node identity."""
+        import hashlib as _hashlib
+
+        return "rc_" + _hashlib.sha256(skey.encode()).hexdigest()[:16]
+
+    def _materialize_subplan(self, node, skey, config):
+        """Execute one breaker subtree as its own distributed query and
+        land the result as a `_rc` memory table. Returns (table_name,
+        batch, wall_s) or None on any failure (the caller falls back to
+        executing the unspliced plan)."""
+        from presto_tpu.exec.runtime import _JIT_COMPACT, _collect_concat
+        from presto_tpu.plan.fragmenter import fragment_plan
+        from presto_tpu.plan.nodes import Output, QueryPlan
+
+        try:
+            names = [s for s, _ in node.output]
+            sub_qp = QueryPlan(Output(node, names, names))
+            sub_dplan = fragment_plan(
+                sub_qp, self.catalog,
+                broadcast_threshold_rows=self.broadcast_threshold_rows)
+            t0 = time.perf_counter()
+            batches = list(self.execute_distributed(sub_dplan, config))
+            merged = _collect_concat(iter(batches))
+            if merged is None:
+                return None
+            merged = _JIT_COMPACT(merged)
+            wall = time.perf_counter() - t0
+            tname = self._rc_table_name(skey)
+            conn = self._rc_connector()
+            conn.drop_table(tname, if_exists=True)
+            conn.create_table_from(tname, [merged])
+            return tname, merged, wall
+        except Exception:
+            return None
+
+    def _run_with_subplan_reuse(self, sql, stmt, config, session):
+        """result_cache=subplan: replan FRESH (the shared-plan-cache copy
+        must never be mutated), look up each topmost grouped-Aggregate
+        breaker in the subplan cache, splice hits in as `_rc` table
+        scans (materializing misses first), and execute the spliced
+        plan. Returns the merged batch, or None when nothing spliced —
+        the caller falls back to the normal path."""
+        from presto_tpu.exec.runtime import _collect_concat
+        from presto_tpu.plan.builder import plan_query
+        from presto_tpu.plan.fragmenter import fragment_plan
+        from presto_tpu.plan.nodes import TableScan
+        from presto_tpu.plan.optimizer import optimize
+        from presto_tpu.server import result_cache as _rc_mod
+
+        try:
+            qp = optimize(plan_query(
+                stmt if stmt is not None else sql, self.catalog),
+                self.catalog)
+        except Exception:
+            return None
+        if qp.scalar_subqueries or not qp.cacheable:
+            return None
+        # authorization runs against the PRE-splice plan: splicing only
+        # replaces subtrees the user was just cleared to read
+        self._enforce_access([qp.root], session)
+        candidates = _rc_mod.find_breaker_subplans(qp.root)
+        if not candidates:
+            return None
+        spliced = 0
+        for node in candidates:
+            skey = _rc_mod.subplan_key(node, self.catalog)
+            if skey is None:
+                continue
+            cached = self.result_cache.lookup(skey)
+            if cached is None:
+                made = self._materialize_subplan(node, skey, config)
+                if made is None:
+                    continue
+                tname, batch, wall = made
+                conn = self._rc_connector()
+                if not self.result_cache.admit(
+                        skey, "subplan", batch, wall_s=wall,
+                        token=skey.rsplit("/", 2)[1],
+                        on_evict=(lambda c=conn, t=tname:
+                                  c.drop_table(t, if_exists=True))):
+                    conn.drop_table(tname, if_exists=True)
+                    continue
+            else:
+                # entry present ⇒ its backing table is still registered
+                # (the entry's on_evict is what drops it)
+                tname = self._rc_table_name(skey)
+                if tname not in self._rc_connector().tables:
+                    continue
+            scan = TableScan(
+                catalog="_rc", table=tname,
+                assignments={s: s for s, _ in node.output},
+                output=list(node.output))
+            if _rc_mod.replace_child(qp.root, node, scan):
+                spliced += 1
+        if not spliced:
+            return None
+        dplan = fragment_plan(
+            qp, self.catalog,
+            broadcast_threshold_rows=self.broadcast_threshold_rows)
+        batches = self._execute_with_retry(dplan, config)
+        return _collect_concat(iter(batches))
 
     def _profile_capture(self, session):
         """Context manager for the `profile` session property: a
@@ -1520,47 +1691,59 @@ class Coordinator:
         from presto_tpu.exec.runner import is_ddl
 
         if stmt is not None and is_ddl(stmt):
-            scaled = self._try_scaled_write(stmt, config, session)
-            if scaled is not None:
-                return scaled
-            # DDL/DML executes coordinator-side; the source query still runs
-            # distributed (reference: DataDefinitionExecution on the
-            # coordinator + a distributed TableWriter source)
-            from presto_tpu.exec.runner import execute_data_definition
-            from presto_tpu.plan.builder import plan_query as _pq
+            try:
+                scaled = self._try_scaled_write(stmt, config, session)
+                if scaled is not None:
+                    return scaled
+                # DDL/DML executes coordinator-side; the source query
+                # still runs distributed (reference:
+                # DataDefinitionExecution on the coordinator + a
+                # distributed TableWriter source)
+                from presto_tpu.exec.runner import execute_data_definition
+                from presto_tpu.plan.builder import plan_query as _pq
 
-            def run_query_fn(q):
-                from presto_tpu.plan.fragmenter import fragment_plan
-                from presto_tpu.plan.optimizer import optimize as _opt
+                def run_query_fn(q):
+                    from presto_tpu.plan.fragmenter import fragment_plan
+                    from presto_tpu.plan.optimizer import optimize as _opt
 
-                qp = _opt(_pq(q, self.catalog), self.catalog)
-                self._enforce_access([qp.root], session)
-                d = fragment_plan(qp, self.catalog,
-                                  broadcast_threshold_rows=self.broadcast_threshold_rows)
-                batches = list(self.execute_distributed(d, config))
-                merged = _collect_concat(iter(batches))
-                if merged is None:
-                    root = d.fragments[d.root_fid].root
-                    types = dict(root.output)
-                    merged = Batch(
-                        d.output_names,
-                        [types[n] for n in d.output_names],
-                        [Column(jnp.zeros(128, types[n].dtype), None)
-                         for n in d.output_names],
-                        jnp.zeros(128, bool), {},
-                    )
-                return _JIT_COMPACT(merged)
+                    qp = _opt(_pq(q, self.catalog), self.catalog)
+                    self._enforce_access([qp.root], session)
+                    d = fragment_plan(qp, self.catalog,
+                                      broadcast_threshold_rows=self.broadcast_threshold_rows)
+                    batches = list(self.execute_distributed(d, config))
+                    merged = _collect_concat(iter(batches))
+                    if merged is None:
+                        root = d.fragments[d.root_fid].root
+                        types = dict(root.output)
+                        merged = Batch(
+                            d.output_names,
+                            [types[n] for n in d.output_names],
+                            [Column(jnp.zeros(128, types[n].dtype), None)
+                             for n in d.output_names],
+                            jnp.zeros(128, bool), {},
+                        )
+                    return _JIT_COMPACT(merged)
 
-            return execute_data_definition(stmt, self.catalog, run_query_fn)
+                return execute_data_definition(stmt, self.catalog,
+                                               run_query_fn)
+            finally:
+                # DDL/DML is the snapshot-token barrier: reclaim every
+                # cached result whose token no longer matches (a no-op on
+                # an unarmed cache — result_cache=off stays bit-for-bit)
+                self._invalidate_result_cache()
 
         dplan = self.plan_distributed(sql, session, stmt=stmt)
         self._enforce_access(
             (f.root for f in dplan.fragments.values()), session)
         session_qid = getattr(session, "query_id", "") or ""
-        if session_qid and _obs_lifecycle.get(session_qid) is not None:
-            # lifecycle plane: plan ready = plan->compile boundary; stamp
-            # the structural fingerprint so progress gets its HBO
+        lifecycle_on = bool(
+            session_qid and _obs_lifecycle.get(session_qid) is not None)
+
+        def _stamp_fingerprint():
+            # stamp the structural fingerprint so progress gets its HBO
             # prediction and completion its regression baseline
+            if not lifecycle_on:
+                return
             try:
                 from presto_tpu.obs import runstats as _runstats
 
@@ -1569,9 +1752,50 @@ class Coordinator:
                         dplan.fragments[dplan.root_fid].root, self.catalog))
             except Exception:
                 pass
+
+        # result cache consult: after plan install + authorization,
+        # BEFORE fragment scheduling. mode=off touches nothing (no key
+        # computation, no arming — the pre-cache path bit-for-bit).
+        cfg = config or self.config
+        mode = (getattr(cfg, "result_cache", "off") or "off").lower()
+        rc_key = rc_token = None
+        if mode != "off" and dplan.__dict__.get("_rc_cacheable"):
+            from presto_tpu.obs.runstats import catalog_token as _ctok
+            from presto_tpu.server import result_cache as _rc_mod
+
+            rc_token = _ctok(self.catalog)
+            rc_key = _rc_mod.query_key(
+                dplan, self.catalog,
+                getattr(session, "catalog", "") or "",
+                getattr(session, "schema", "") or "")
+            if rc_key is not None:
+                hit = self.result_cache.lookup(
+                    rc_key, query_id=session_qid or None)
+                if hit is not None:
+                    # a hit short-circuits scheduling entirely: the
+                    # timeline jumps straight to draining with a cache
+                    # provenance mark (compile and exec segments resolve
+                    # to exactly zero — segments() fills unstamped
+                    # boundaries rightward)
+                    _stamp_fingerprint()
+                    if lifecycle_on:
+                        _obs_lifecycle.mark(session_qid, "draining",
+                                            provenance="cache")
+                    _obs_lifecycle.note_cache(session_qid, {
+                        "kind": "query", "key": rc_key[:24],
+                        "bytes": _rc_mod.batch_nbytes(hit)})
+                    return hit
+        _stamp_fingerprint()
+        if lifecycle_on:
+            # lifecycle plane: plan ready = plan->compile boundary
             _obs_lifecycle.mark(session_qid, "compiling")
-        batches = self._execute_with_retry(dplan, config)
-        merged = _collect_concat(iter(batches))
+        t_exec0 = time.perf_counter()
+        merged = None
+        if mode == "subplan":
+            merged = self._run_with_subplan_reuse(sql, stmt, config, session)
+        if merged is None:
+            batches = self._execute_with_retry(dplan, config)
+            merged = _collect_concat(iter(batches))
         if merged is None:
             root = dplan.fragments[dplan.root_fid].root
             types = dict(root.output)
@@ -1583,7 +1807,26 @@ class Coordinator:
                 jnp.zeros(128, bool),
                 {},
             )
-        return _JIT_COMPACT(merged)
+        out = _JIT_COMPACT(merged)
+        if rc_key is not None:
+            # cost-aware admission: observed exec wall, floored by the
+            # HBO baseline for this structure (a lucky fast run must not
+            # undervalue a historically expensive query)
+            wall = time.perf_counter() - t_exec0
+            try:
+                from presto_tpu.obs import runstats as _runstats
+
+                ent = _runstats.lookup_node(
+                    dplan.fragments[dplan.root_fid].root, self.catalog,
+                    _runstats.QUERY_SITE)
+                if ent and ent.get("wall_s"):
+                    wall = max(wall, float(ent["wall_s"]))
+            except Exception:
+                pass
+            self.result_cache.admit(rc_key, "query", out, wall_s=wall,
+                                    token=rc_token,
+                                    query_id=session_qid or None)
+        return out
 
     def close(self):
         self.failure_detector.stop()

@@ -92,6 +92,11 @@ def worker_metrics(worker) -> str:
     rows.extend(exec_programs.metric_rows({**lbl, "plane": "worker"}))
     rows.extend(obs_runstats.metric_rows({**lbl, "plane": "worker"}))
     rows.extend(obs_devprof.metric_rows({**lbl, "plane": "worker"}))
+    from presto_tpu.server import result_cache as _result_cache
+
+    # result-cache families appear only once the cache has been consulted
+    # (result_cache=off scrapes stay bit-for-bit pre-cache)
+    rows.extend(_result_cache.CACHE.metric_rows({**lbl, "plane": "worker"}))
     return render_metrics(rows) + obs_metrics.render_histograms("worker")
 
 
@@ -121,6 +126,10 @@ def coordinator_metrics(coordinator) -> str:
     rows.extend(exec_programs.metric_rows({"plane": "coordinator"}))
     rows.extend(obs_runstats.metric_rows({"plane": "coordinator"}))
     rows.extend(obs_devprof.metric_rows({"plane": "coordinator"}))
+    from presto_tpu.server import result_cache as _result_cache
+
+    # same armed-gating as the worker plane: no families until consulted
+    rows.extend(_result_cache.CACHE.metric_rows({"plane": "coordinator"}))
     text = render_metrics(rows) + obs_metrics.render_histograms("coordinator")
     from presto_tpu.obs import lifecycle as obs_lifecycle
 

@@ -358,6 +358,16 @@ class StatementProtocol:
             # bit-for-bit): live fraction-complete endpoint
             out["progressUri"] = (
                 f"{self.base_url}/v1/query/{qe.query_id}/progress")
+            try:
+                # result-cache provenance (result_cache=off responses
+                # stay bit-for-bit: no entry, no key)
+                from presto_tpu.obs import lifecycle as _lc
+
+                _entry = _lc.get(qe.query_id)
+                if _entry is not None and _entry.cache_info is not None:
+                    out["stats"]["resultCache"] = dict(_entry.cache_info)
+            except Exception:
+                pass
         try:
             # `profile` session property: the captured jax.profiler trace
             # directory for this query, when one was recorded

@@ -254,6 +254,15 @@ class QueryManager:
 
         def start_from_group(qe=qe):
             qe._rg_slot_held = True
+            try:
+                # compile-budget accounting baseline: the process-wide
+                # compile counter as of this query's start; the delta at
+                # completion is charged to its resource group
+                from presto_tpu.exec import programs as _programs
+
+                qe._rg_compiles0 = _programs.snapshot()["compiles"]
+            except Exception:
+                qe._rg_compiles0 = None
             _lifecycle.mark(qe.query_id, "admitted")
             if qe.done:
                 # canceled/failed while queued: the group just granted a slot
@@ -289,8 +298,29 @@ class QueryManager:
 
     def _on_state(self, qe: QueryExecution, state: str):
         if state in TERMINAL:
+            self._charge_compiles(qe)
             self._release_slot(qe)
             self._emit("queryCompleted", qe)
+
+    def _charge_compiles(self, qe: QueryExecution):
+        """Charge the query's compile-event delta to its resource group
+        BEFORE the slot release, so the release-triggered drain evaluates
+        budgets that already include this query's consumption. The
+        process-wide counter over-attributes under concurrency (a
+        neighbor's compiles land in the delta) — acceptable for a budget
+        whose job is throttling storms, not exact billing."""
+        base = getattr(qe, "_rg_compiles0", None)
+        if base is None or not qe.resource_group:
+            return
+        try:
+            from presto_tpu.exec import programs as _programs
+
+            delta = _programs.snapshot()["compiles"] - base
+            if delta > 0:
+                self.resource_groups.charge_compiles(
+                    qe.resource_group, delta, qe.session.user)
+        except Exception:
+            pass
 
     def _emit(self, event: str, qe: QueryExecution):
         for fn in list(self.listeners):

@@ -7,6 +7,24 @@ a tree of groups, each with concurrency/queue limits and a scheduling
 policy; selectors route an incoming query (by user/source) to a leaf group;
 queries queue when their group (or any ancestor) is at its hard concurrency
 limit and start in policy order as slots free up.
+
+Multi-tenant additions (the result-cache PR's admission side):
+
+- ``weighted_fair`` is now a true dequeue-time discipline. Each child of a
+  weighted_fair parent carries a virtual time advanced by ``1/weight`` per
+  started query (stride scheduling / the reference's WeightedFairQueue
+  counters); when slots free, the eligible group with the smallest
+  root-to-leaf vtime path starts next, so siblings converge on their
+  weight ratio regardless of arrival order. The old implementation froze
+  ``running/weight`` into the ENQUEUE key, which is always 0 at
+  concurrency 1 — i.e. no weighting at exactly the contention level where
+  it matters.
+- per-group compile budgets: ``compile_budget`` caps how many XLA
+  trace+compile events (PR 5 compile counters, charged by the query
+  manager at query completion) a group may consume per
+  ``compile_budget_window_s`` rolling window; an exhausted group queues
+  until the window rolls or ``replenish_compile_budgets`` runs. One
+  tenant's cold compile storm cannot starve a sibling's cached hot path.
 """
 
 from __future__ import annotations
@@ -16,6 +34,7 @@ import heapq
 import itertools
 import re
 import threading
+import time
 from typing import Callable, Dict, List, Optional
 
 
@@ -37,6 +56,11 @@ class ResourceGroupSpec:
     scheduling_policy: str = "fair"  # fair | weighted_fair | query_priority
     scheduling_weight: int = 1
     soft_memory_limit_fraction: float = 1.0
+    # compile-budget accounting: at most `compile_budget` XLA
+    # trace+compile events per `compile_budget_window_s` rolling window
+    # (0 = unlimited; window 0 = never auto-replenishes)
+    compile_budget: int = 0
+    compile_budget_window_s: float = 0.0
     subgroups: List["ResourceGroupSpec"] = dataclasses.field(default_factory=list)
 
 
@@ -67,15 +91,35 @@ class _Group:
         self.running = 0
         self.queued: List = []  # heap of (sort_key, seq, entry)
         self._seq = itertools.count()
+        # stride-scheduling virtual time: advanced by 1/weight per started
+        # query when the PARENT's policy is weighted_fair
+        self.vtime = 0.0
+        # compile events charged against this group's budget in the
+        # current window
+        self.compiles_used = 0
+        self._window_start = time.monotonic()
         for sub in spec.subgroups:
             self.children[sub.name] = _Group(sub, self)
 
     # -- capacity ----------------------------------------------------------
 
+    def _budget_ok(self, now: float) -> bool:
+        b = self.spec.compile_budget
+        if b <= 0:
+            return True
+        w = self.spec.compile_budget_window_s
+        if w > 0 and (now - self._window_start) >= w:
+            self._window_start = now
+            self.compiles_used = 0
+        return self.compiles_used < b
+
     def can_run(self) -> bool:
+        now = time.monotonic()
         g: Optional[_Group] = self
         while g is not None:
             if g.running >= g.spec.hard_concurrency_limit:
+                return False
+            if not g._budget_ok(now):
                 return False
             g = g.parent
         return True
@@ -88,10 +132,10 @@ class _Group:
     def _sort_key(self, priority: int):
         if self.spec.scheduling_policy == "query_priority":
             return -priority
-        if self.spec.scheduling_policy == "weighted_fair":
-            # smaller running/weight ratio first — approximated at enqueue
-            return self.running / max(1, self.spec.scheduling_weight)
-        return 0  # fair = FIFO via seq tiebreak
+        # fair AND weighted_fair queues are FIFO within the group (seq
+        # tiebreak); weighted fairness is enforced ACROSS groups at
+        # dequeue time by the manager's vtime-path selection
+        return 0
 
     def enqueue(self, entry, priority: int):
         if len(self.queued) >= self.spec.max_queued:
@@ -112,6 +156,9 @@ class _Group:
         g: Optional[_Group] = self
         while g is not None:
             g.running += 1
+            if (g.parent is not None
+                    and g.parent.spec.scheduling_policy == "weighted_fair"):
+                g.vtime += 1.0 / max(1, g.spec.scheduling_weight)
             g = g.parent
 
     def finish(self):
@@ -119,6 +166,20 @@ class _Group:
         while g is not None:
             g.running -= 1
             g = g.parent
+
+    def vtime_path(self) -> tuple:
+        """Root-to-self vtimes under weighted_fair parents (0.0 under
+        fair/priority parents, so mixed trees compare cleanly)."""
+        path = []
+        g: Optional[_Group] = self
+        while g is not None and g.parent is not None:
+            if g.parent.spec.scheduling_policy == "weighted_fair":
+                path.append(g.vtime)
+            else:
+                path.append(0.0)
+            g = g.parent
+        path.reverse()
+        return tuple(path)
 
     def walk(self):
         yield self
@@ -147,10 +208,15 @@ class ResourceGroupManager:
         g = self.root
         for p in parts[1:]:
             if p not in g.children:
-                # dynamic per-user leaf (the `${USER}` pattern): inherit limits
-                g.children[p] = _Group(
+                # dynamic per-user leaf (the `${USER}` pattern): inherit
+                # limits; a late joiner starts at the minimum sibling
+                # vtime so it cannot burst ahead of established tenants
+                child = _Group(
                     dataclasses.replace(g.spec, name=p, subgroups=[]), g
                 )
+                child.vtime = min(
+                    (c.vtime for c in g.children.values()), default=0.0)
+                g.children[p] = child
             g = g.children[p]
         return g
 
@@ -190,19 +256,66 @@ class ResourceGroupManager:
             start_fn()
         return g.id
 
-    def query_finished(self, group_id: str, user: str = ""):
-        """Release the slot and start queued queries that now fit."""
+    # -- dequeue -----------------------------------------------------------
+
+    def _drain_key(self, g: _Group) -> tuple:
+        # (vtime path, queue-head seq): the lowest virtual time wins;
+        # the enqueue sequence breaks exact ties FIFO. The path tuple is
+        # compared FIRST as a unit, so mixed tree depths never compare a
+        # sequence number against a vtime.
+        head = g.queued[0]
+        return (g.vtime_path(), (head[0], head[1]))
+
+    def _drain_locked(self) -> List[Callable[[], None]]:
+        # shared: requires(self._lock)
         to_start = []
+        while True:
+            eligible = [g for g in self.root.walk()
+                        if g.queued and g.can_run()]
+            if not eligible:
+                return to_start
+            g = min(eligible, key=self._drain_key)
+            entry = g.dequeue()
+            g.start()
+            to_start.append(entry)
+
+    def query_finished(self, group_id: str, user: str = ""):
+        """Release the slot and start queued queries that now fit, in
+        weighted-fair vtime order across sibling groups."""
         with self._lock:
             g = self._resolve(group_id, user)
             g.finish()
-            # drain eligible queued entries anywhere in the tree (a released
-            # ancestor slot can unblock several leaves)
-            for grp in self.root.walk():
-                while grp.queued and grp.can_run():
-                    entry = grp.dequeue()
-                    grp.start()
-                    to_start.append(entry)
+            to_start = self._drain_locked()
+        for fn in to_start:
+            fn()
+
+    # -- compile budgets ---------------------------------------------------
+
+    def charge_compiles(self, group_id: str, n: int, user: str = ""):
+        """Charge `n` XLA compile events (PR 5 compile counters) against
+        every budget-configured group on the path. Called by the query
+        manager when a query completes."""
+        if n <= 0:
+            return
+        with self._lock:
+            try:
+                g: Optional[_Group] = self._resolve(group_id, user)
+            except KeyError:
+                return
+            while g is not None:
+                if g.spec.compile_budget > 0:
+                    g.compiles_used += int(n)
+                g = g.parent
+
+    def replenish_compile_budgets(self):
+        """Zero every group's window usage and drain newly-eligible
+        queued queries (ops hook / tests; rolling windows replenish
+        themselves via `compile_budget_window_s`)."""
+        with self._lock:
+            for g in self.root.walk():
+                g.compiles_used = 0
+                g._window_start = time.monotonic()
+            to_start = self._drain_locked()
         for fn in to_start:
             fn()
 
@@ -215,6 +328,10 @@ class ResourceGroupManager:
                     "hard_concurrency_limit": g.spec.hard_concurrency_limit,
                     "max_queued": g.spec.max_queued,
                     "policy": g.spec.scheduling_policy,
+                    "weight": g.spec.scheduling_weight,
+                    "vtime": round(g.vtime, 6),
+                    "compile_budget": g.spec.compile_budget,
+                    "compiles_used": g.compiles_used,
                 }
                 for g in self.root.walk()
             }

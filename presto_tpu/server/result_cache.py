@@ -1,0 +1,496 @@
+"""Multi-tenant result reuse: fingerprint-keyed semantic result cache.
+
+Process-wide, memory-budgeted memoization of final query results and
+materialized breaker-subplan results. The cache key composes the three
+planes that already exist in the engine:
+
+- the compile plane's structural sha256 of the bound plan (PR 5,
+  ``exec/programs.structural_fingerprint``) — for a distributed plan the
+  root fragment alone is NOT discriminating (RemoteSource leaves carry
+  only fragment ids), so ``plan_fingerprint`` hashes every fragment root
+  in fid order plus the output names;
+- the HBO plane's catalog snapshot token (PR 10,
+  ``obs/runstats.catalog_token``) — any INSERT/CTAS/DROP changes a row
+  count or table list and the token, so stale entries can never hit;
+- the result-relevant session fingerprint (catalog.schema name-resolution
+  context). Engine knobs like ``breaker_engine`` deliberately do NOT key:
+  they change how a result is computed, never what it is.
+
+Admission is cost-aware: an entry's value is its observed execution wall
+(floored by the HBO history wall when available) per byte held, so the
+cache keeps what was expensive to compute and cheap to hold. Bytes are
+charged to the PR 11 cluster memory ledger; under sustained pressure
+``ClusterMemoryManager.enforce`` revokes cache entries (cheapest density
+first) BEFORE killing queries.
+
+Reference discipline: presto-main's semantic cache proposals and
+Aria-style cycle elision — the cheapest query is the one never re-planned,
+re-compiled, or re-executed.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+__all__ = [
+    "CACHE",
+    "ResultCache",
+    "batch_nbytes",
+    "find_breaker_subplans",
+    "plan_fingerprint",
+    "query_key",
+    "replace_child",
+    "spliceable_output",
+    "subplan_key",
+]
+
+_DEFAULT_BUDGET = 256 << 20  # bytes
+
+
+def _env_budget() -> int:
+    try:
+        return int(os.environ.get("PRESTO_TPU_RESULT_CACHE_BYTES",
+                                  _DEFAULT_BUDGET))
+    except (TypeError, ValueError):
+        return _DEFAULT_BUDGET
+
+
+# -- key composition -------------------------------------------------------
+
+
+def plan_fingerprint(dplan) -> Optional[str]:
+    """Structural sha256 over ALL fragment roots of a DistributedPlan (fid
+    order) plus the output names, memoized on the plan. Hashing only the
+    root fragment would collide across queries whose differing scans live
+    in leaf fragments behind RemoteSource placeholders."""
+    sha = dplan.__dict__.get("_rc_sha")
+    if sha is not None:
+        return sha or None
+    try:
+        from presto_tpu.plan.codec import canonical_node_json
+
+        parts = []
+        for fid in sorted(dplan.fragments):
+            parts.append(f"#{fid}:"
+                         + canonical_node_json(dplan.fragments[fid].root))
+        parts.append("|".join(dplan.output_names))
+        sha = hashlib.sha256("\n".join(parts).encode()).hexdigest()
+    except Exception:
+        sha = ""
+    dplan.__dict__["_rc_sha"] = sha
+    return sha or None
+
+
+def query_key(dplan, catalog, session_catalog: str = "",
+              session_schema: str = "") -> Optional[str]:
+    """Full-result cache key for a distributed plan, or None when the plan
+    cannot be fingerprinted (codec-unsupported node)."""
+    sha = plan_fingerprint(dplan)
+    if sha is None:
+        return None
+    from presto_tpu.obs.runstats import catalog_token
+
+    return (sha + "/" + catalog_token(catalog) + "/"
+            + f"{session_catalog or ''}.{session_schema or ''}")
+
+
+def subplan_key(node, catalog) -> Optional[str]:
+    """Cache key for a breaker subplan (a bound plan subtree). Subplan
+    entries share the snapshot-token invalidation of query entries but
+    live in their own key namespace."""
+    try:
+        from presto_tpu.exec.programs import structural_fingerprint
+
+        sha = structural_fingerprint(node)
+    except Exception:
+        sha = None
+    if sha is None:
+        return None
+    from presto_tpu.obs.runstats import catalog_token
+
+    return sha + "/" + catalog_token(catalog) + "/subplan"
+
+
+# -- batch accounting ------------------------------------------------------
+
+_COL_SLOTS = ("values", "validity", "hi", "sizes", "evalid", "keys")
+
+
+def batch_nbytes(batch) -> int:
+    """Held-bytes estimate for a Batch: every array hanging off every
+    column plus the live mask. Dictionary pages are shared engine-wide and
+    are not charged to the entry."""
+    total = 0
+    try:
+        for c in batch.columns:
+            for slot in _COL_SLOTS:
+                a = getattr(c, slot, None)
+                total += int(getattr(a, "nbytes", 0) or 0)
+        total += int(getattr(batch.live, "nbytes", 0) or 0)
+    except Exception:
+        pass
+    return total
+
+
+# -- subplan discovery / splicing ------------------------------------------
+
+_SPLICE_TYPES = frozenset([
+    "bigint", "integer", "smallint", "tinyint",
+    "double", "real", "boolean", "varchar",
+])
+
+
+def spliceable_output(node) -> bool:
+    """Only subtrees whose output round-trips losslessly through a memory
+    table are splice candidates (decimals re-scale on ingest; structural
+    types re-encode)."""
+    try:
+        out = node.output
+    except Exception:
+        return False
+    if not out:
+        return False
+    return all(str(t) in _SPLICE_TYPES for _, t in out)
+
+
+def find_breaker_subplans(root, limit: int = 4) -> List[Any]:
+    """Topmost grouped Aggregates under ``root`` — the pipeline breakers
+    whose materialized output is a natural reuse unit. Descent stops at a
+    match (nested aggregates are covered by their ancestor's entry)."""
+    from presto_tpu.plan.nodes import Aggregate
+
+    found: List[Any] = []
+
+    def walk(n):
+        if len(found) >= limit:
+            return
+        if (isinstance(n, Aggregate) and n.step == "single"
+                and n.group_keys and spliceable_output(n)):
+            found.append(n)
+            return
+        for c in n.children():
+            walk(c)
+
+    walk(root)
+    return found
+
+
+def replace_child(root, old, new) -> bool:
+    """Replace ``old`` (by identity) with ``new`` anywhere in the plan
+    tree under ``root``, scanning dataclass fields and lists in place."""
+    import dataclasses
+
+    def fix(n) -> bool:
+        if not dataclasses.is_dataclass(n):
+            return False
+        for f in dataclasses.fields(n):
+            v = getattr(n, f.name, None)
+            if v is old:
+                setattr(n, f.name, new)
+                return True
+            if isinstance(v, list):
+                for i, item in enumerate(v):
+                    if item is old:
+                        v[i] = new
+                        return True
+                    if fix(item):
+                        return True
+            elif fix(v):
+                return True
+        return False
+
+    return fix(root)
+
+
+# -- the cache -------------------------------------------------------------
+
+
+class _Entry:
+    __slots__ = ("key", "kind", "batch", "nbytes", "wall_s", "token",
+                 "hits", "created", "on_evict")
+
+    def __init__(self, key: str, kind: str, batch, nbytes: int,
+                 wall_s: float, token: str,
+                 on_evict: Optional[Callable[[], None]]):
+        self.key = key
+        self.kind = kind  # "query" | "subplan"
+        self.batch = batch
+        self.nbytes = nbytes
+        self.wall_s = wall_s
+        self.token = token
+        self.hits = 0
+        self.created = time.time()
+        self.on_evict = on_evict
+
+    @property
+    def density(self) -> float:
+        # value-per-byte: what was expensive to compute and cheap to hold
+        # survives admission pressure
+        return self.wall_s / float(max(1, self.nbytes))
+
+
+class ResultCache:
+    """Process-wide result cache. All mutation is under one lock; evict
+    callbacks and event emission run outside it (they take other planes'
+    locks)."""
+
+    def __init__(self, budget_bytes: Optional[int] = None):
+        self._lock = threading.Lock()
+        self._budget = (budget_bytes if budget_bytes is not None
+                        else _env_budget())
+        self._entries: Dict[str, _Entry] = {}  # shared: guarded-by(self._lock)
+        self._bytes = 0  # shared: guarded-by(self._lock)
+        self._hits = 0  # shared: guarded-by(self._lock)
+        self._misses = 0  # shared: guarded-by(self._lock)
+        self._evictions = 0  # shared: guarded-by(self._lock)
+        self._wall_saved_s = 0.0  # shared: guarded-by(self._lock)
+        self._armed = False  # shared: guarded-by(self._lock)
+
+    # -- discipline: ``off`` must stay bit-for-bit pre-PR. Nothing arms
+    # the cache until a coordinator actually consults it with the session
+    # knob on; until then metric_rows() contributes no families.
+
+    def armed(self) -> bool:
+        with self._lock:
+            return self._armed
+
+    def configure(self, budget_bytes: int) -> None:
+        with self._lock:
+            self._budget = int(budget_bytes)
+
+    @property
+    def budget_bytes(self) -> int:
+        with self._lock:
+            return self._budget
+
+    def bytes_held(self) -> int:
+        with self._lock:
+            return self._bytes
+
+    def counters(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "hits": self._hits,
+                "misses": self._misses,
+                "evictions": self._evictions,
+                "bytes": self._bytes,
+                "entries": len(self._entries),
+                "wall_saved_s": round(self._wall_saved_s, 6),
+                "budget_bytes": self._budget,
+            }
+
+    def reset(self) -> None:
+        """Test hook: drop everything including counters and arming."""
+        with self._lock:
+            entries = list(self._entries.values())
+            self._entries.clear()
+            self._bytes = 0
+            self._hits = 0
+            self._misses = 0
+            self._evictions = 0
+            self._wall_saved_s = 0.0
+            self._armed = False
+        for e in entries:
+            self._run_evict_cb(e)
+
+    # -- lookup / admission ------------------------------------------------
+
+    def lookup(self, key: Optional[str], query_id: Optional[str] = None):
+        """Consult the cache; counts a hit or miss and emits a
+        ``cache_hit`` event. Returns the cached batch or None."""
+        if key is None:
+            return None
+        hit = None
+        with self._lock:
+            self._armed = True
+            e = self._entries.get(key)
+            if e is None:
+                self._misses += 1
+            else:
+                e.hits += 1
+                self._hits += 1
+                self._wall_saved_s += e.wall_s
+                hit = e
+        if hit is not None:
+            self._emit("cache_hit", query_id=query_id, key=key[:24],
+                       cache_kind=hit.kind, bytes=hit.nbytes,
+                       wall_saved_s=round(hit.wall_s, 6))
+            return hit.batch
+        return None
+
+    def peek(self, key: Optional[str]) -> bool:
+        """Non-mutating presence probe (EXPLAIN ANALYZE header): no
+        counters, no events, no arming."""
+        if key is None:
+            return False
+        with self._lock:
+            return key in self._entries
+
+    def admit(self, key: Optional[str], kind: str, batch, wall_s: float,
+              token: str, nbytes: Optional[int] = None,
+              on_evict: Optional[Callable[[], None]] = None,
+              query_id: Optional[str] = None) -> bool:
+        """Cost-aware admission. Rejects oversized entries outright;
+        otherwise evicts strictly lower-density entries to make room and
+        rejects the newcomer if room would cost denser residents."""
+        if key is None or batch is None:
+            return False
+        nb = batch_nbytes(batch) if nbytes is None else int(nbytes)
+        cand = _Entry(key, kind, batch, nb, max(0.0, float(wall_s)), token,
+                      on_evict)
+        evicted: List[_Entry] = []
+        admitted = False
+        with self._lock:
+            self._armed = True
+            if nb > self._budget:
+                return False
+            prev = self._entries.pop(key, None)
+            if prev is not None:
+                self._bytes -= prev.nbytes
+                evicted.append(prev)
+            need = self._bytes + nb - self._budget
+            if need > 0:
+                victims = self._pick_victims_locked(need, cand.density)
+                if victims is None:
+                    # rollback the same-key displacement; the resident
+                    # population is denser than the newcomer
+                    if prev is not None:
+                        self._entries[key] = prev
+                        self._bytes += prev.nbytes
+                        evicted.clear()
+                    return False
+                for v in victims:
+                    del self._entries[v.key]
+                    self._bytes -= v.nbytes
+                    evicted.append(v)
+            self._entries[key] = cand
+            self._bytes += nb
+            self._evictions += len(evicted)
+            admitted = True
+        for e in evicted:
+            self._run_evict_cb(e)
+            self._emit("cache_evict", query_id=query_id, key=e.key[:24],
+                       cache_kind=e.kind, bytes=e.nbytes, reason="admission")
+        return admitted
+
+    def _pick_victims_locked(self, need: int,
+                             new_density: float) -> Optional[List[_Entry]]:
+        # shared: requires(self._lock)
+        victims: List[_Entry] = []
+        freed = 0
+        for e in sorted(self._entries.values(), key=lambda e: e.density):
+            if freed >= need:
+                break
+            if e.density >= new_density:
+                return None
+            victims.append(e)
+            freed += e.nbytes
+        return victims if freed >= need else None
+
+    # -- invalidation ------------------------------------------------------
+
+    def flush(self, reason: str = "flush") -> int:
+        """Drop every entry (explicit flush / DDL barrier)."""
+        with self._lock:
+            entries = list(self._entries.values())
+            self._entries.clear()
+            self._bytes = 0
+            self._evictions += len(entries)
+        for e in entries:
+            self._run_evict_cb(e)
+            self._emit("cache_evict", key=e.key[:24], cache_kind=e.kind,
+                       bytes=e.nbytes, reason=reason)
+        return len(entries)
+
+    def flush_stale(self, token: str) -> int:
+        """Drop entries whose snapshot token no longer matches the live
+        catalog. Key mismatch already guarantees they can never hit; this
+        reclaims their bytes eagerly after DDL."""
+        stale: List[_Entry] = []
+        with self._lock:
+            for k in [k for k, e in self._entries.items()
+                      if e.token != token]:
+                e = self._entries.pop(k)
+                self._bytes -= e.nbytes
+                stale.append(e)
+            self._evictions += len(stale)
+        for e in stale:
+            self._run_evict_cb(e)
+            self._emit("cache_evict", key=e.key[:24], cache_kind=e.kind,
+                       bytes=e.nbytes, reason="invalidated")
+        return len(stale)
+
+    def revoke_for_pressure(self, target_bytes: Optional[int] = None) -> int:
+        """Memory-ledger revocation: free at least ``target_bytes``
+        (default: everything), cheapest density first. Returns bytes
+        freed. Called by ClusterMemoryManager.enforce BEFORE it considers
+        killing queries."""
+        revoked: List[_Entry] = []
+        with self._lock:
+            goal = self._bytes if target_bytes is None else int(target_bytes)
+            freed = 0
+            for e in sorted(self._entries.values(), key=lambda e: e.density):
+                if freed >= goal:
+                    break
+                del self._entries[e.key]
+                self._bytes -= e.nbytes
+                freed += e.nbytes
+                revoked.append(e)
+            self._evictions += len(revoked)
+        freed = 0
+        for e in revoked:
+            freed += e.nbytes
+            self._run_evict_cb(e)
+            self._emit("cache_evict", key=e.key[:24], cache_kind=e.kind,
+                       bytes=e.nbytes, reason="memory_pressure")
+        return freed
+
+    # -- exposition --------------------------------------------------------
+
+    def metric_rows(self, labels: Optional[Dict[str, str]] = None) -> List[Tuple]:
+        """Prometheus rows for both metric planes. Empty until armed so a
+        ``result_cache=off`` process scrapes bit-for-bit pre-PR."""
+        with self._lock:
+            if not self._armed:
+                return []
+            hits, misses = self._hits, self._misses
+            evictions, nbytes = self._evictions, self._bytes
+        return [
+            ("presto_tpu_result_cache_hits_total",
+             "Result cache hits", hits, labels, "counter"),
+            ("presto_tpu_result_cache_misses_total",
+             "Result cache misses", misses, labels, "counter"),
+            ("presto_tpu_result_cache_evictions_total",
+             "Result cache evictions (admission, invalidation, pressure)",
+             evictions, labels, "counter"),
+            ("presto_tpu_result_cache_bytes",
+             "Bytes held by cached result batches", nbytes, labels, "gauge"),
+        ]
+
+    # -- internals ---------------------------------------------------------
+
+    @staticmethod
+    def _run_evict_cb(e: _Entry) -> None:
+        cb = e.on_evict
+        if cb is None:
+            return
+        try:
+            cb()
+        except Exception:
+            pass
+
+    @staticmethod
+    def _emit(kind: str, query_id: Optional[str] = None, **attrs) -> None:
+        try:
+            from presto_tpu.obs.events import EVENTS
+
+            EVENTS.emit(kind, query_id=query_id, **attrs)
+        except Exception:
+            pass
+
+
+CACHE = ResultCache()

@@ -277,6 +277,18 @@ class SystemSessionProperties:
                              "the fingerprint's HBO baseline wall (0 "
                              "disables)", float, 3.0,
                              validator=_nonneg("latency_regression_factor")),
+            # semantic result cache (server/result_cache.py)
+            PropertyMetadata("result_cache",
+                             "Fingerprint-keyed result reuse: off "
+                             "reproduces the pre-cache serving path "
+                             "bit-for-bit (no consult, no metric families, "
+                             "no events); query memoizes final results "
+                             "keyed on structural plan sha + catalog "
+                             "snapshot token; subplan additionally reuses "
+                             "materialized breaker-subplan results",
+                             str, "off",
+                             validator=_enum("result_cache",
+                                             ["OFF", "QUERY", "SUBPLAN"])),
         ]
 
     def names(self) -> List[str]:
@@ -394,4 +406,5 @@ class Session:
             devprof=self.get("devprof").lower(),
             profile=self.get("profile"),
             lifecycle=self.get("lifecycle").lower(),
+            result_cache=self.get("result_cache").lower(),
         )

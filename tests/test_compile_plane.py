@@ -351,3 +351,36 @@ def test_trace_wall_histogram_in_families():
 
     assert COMPILE_TRACE_WALL in ALL_HISTOGRAMS
     assert COMPILE_TRACE_WALL.name == "presto_tpu_compile_trace_wall_seconds"
+
+# ---------------------------------------------------------------------------
+# persisted programs (PRESTO_TPU_CACHE_DIR warm restart)
+
+
+def test_program_persistence_restores_after_cold_cache(cat, tmp_path,
+                                                       monkeypatch):
+    # double gate: cache dir set AND persist flag on
+    monkeypatch.setenv("PRESTO_TPU_CACHE_DIR", str(tmp_path))
+    monkeypatch.setenv("PRESTO_TPU_PROGRAM_PERSIST", "1")
+    sql = ("select l_returnflag as f, sum(l_quantity) as s from lineitem "
+           "where l_discount > 0.02 group by l_returnflag order by f")
+    exp = LocalRunner(cat, ExecConfig()).run(sql)
+    pdir = tmp_path / "programs"
+    arts = list(pdir.glob("*.jaxexp")) if pdir.exists() else []
+    if not arts:
+        pytest.skip("jax.export unavailable for these programs "
+                    "(persistence is best-effort by contract)")
+    # simulate a restart: drop the shared in-memory entries entirely
+    programs.reset(counters_only=False)
+    out = LocalRunner(cat, ExecConfig()).run(sql)
+    snap = programs.snapshot()
+    assert snap["restored"] > 0  # artifacts re-hydrated, re-trace skipped
+    assert out.equals(exp)  # restored programs compute the same answer
+
+
+def test_program_persistence_gate_defaults_off(cat, tmp_path, monkeypatch):
+    # cache dir alone must NOT write artifacts (opt-in flag required)
+    monkeypatch.setenv("PRESTO_TPU_CACHE_DIR", str(tmp_path))
+    monkeypatch.delenv("PRESTO_TPU_PROGRAM_PERSIST", raising=False)
+    LocalRunner(cat, ExecConfig()).run(
+        "select count(*) as c from region")
+    assert not (tmp_path / "programs").exists()

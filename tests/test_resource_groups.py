@@ -138,3 +138,170 @@ def test_info_reports_limits_and_policy():
     assert info["global.adhoc"]["hard_concurrency_limit"] == 2
     assert info["global.adhoc"]["max_queued"] == 50
     assert info["global"]["policy"] == "fair"
+
+
+# ---------------------------------------------------------------------------
+# weighted fair-share dequeue (the result-cache PR's admission side)
+
+
+def _weighted_tree(w_heavy=3, w_light=1):
+    return ResourceGroupSpec(
+        "global", hard_concurrency_limit=1, max_queued=1000,
+        scheduling_policy="weighted_fair",
+        subgroups=[
+            ResourceGroupSpec("heavy", hard_concurrency_limit=1,
+                              max_queued=500, scheduling_weight=w_heavy),
+            ResourceGroupSpec("light", hard_concurrency_limit=1,
+                              max_queued=500, scheduling_weight=w_light),
+        ])
+
+
+def _weighted_selectors():
+    return [
+        SelectorSpec(group="global.heavy", source_regex="heavy"),
+        SelectorSpec(group="global.light", source_regex="light"),
+        SelectorSpec(group="global"),
+    ]
+
+
+def test_weighted_fair_long_run_dequeue_ratio():
+    # two sibling tenants with 3:1 weights contend for a single slot;
+    # over a long backlog the dequeue stream must converge on 3:1
+    # regardless of arrival interleaving
+    rg = ResourceGroupManager(_weighted_tree(3, 1), _weighted_selectors())
+    order = []
+    # saturate the slot first so everything else queues
+    rg.submit("u", "heavy", 1, lambda: order.append("warm"))
+    n = 20
+    for i in range(n):
+        rg.submit("u", "light", 1, lambda: order.append("light"))
+        rg.submit("u", "heavy", 1, lambda: order.append("heavy"))
+    # drain one at a time: each finish dequeues exactly one query
+    groups = {"warm": "global.heavy", "heavy": "global.heavy",
+              "light": "global.light"}
+    i = 0
+    while i < len(order):
+        rg.query_finished(groups[order[i]])
+        i += 1
+    started = order[1:]  # drop the warmup
+    assert len(started) == 2 * n
+    # steady state (skip the warmup transient): with vtime strides 1/3
+    # vs 1 the heavy tenant takes 3 of every 4 starts (9 heavy : 3 light)
+    window = started[4:16]
+    assert window.count("heavy") == 9
+    assert window.count("light") == 3
+    # and the overall stream is heavy-dominated well beyond FIFO's 1:1
+    assert started[:24].count("heavy") >= 16
+    # and the full backlog drains completely
+    info = rg.info()
+    assert info["global.heavy"]["queued"] == 0
+    assert info["global.light"]["queued"] == 0
+
+
+def test_weighted_fair_late_joiner_does_not_burst():
+    # a tenant that joins after siblings accumulated vtime starts at the
+    # minimum sibling vtime (not 0), so it cannot monopolize the slot
+    rg = ResourceGroupManager(
+        ResourceGroupSpec("global", hard_concurrency_limit=1,
+                          max_queued=1000,
+                          scheduling_policy="weighted_fair"),
+        [SelectorSpec(group="global.${USER}")])
+    order = []
+    rg.submit("alice", "", 1, lambda: order.append("warm"))
+    for _ in range(6):
+        rg.submit("alice", "", 1, lambda: order.append("alice"))
+    for _ in range(6):
+        rg.submit("bob", "", 1, lambda: order.append("bob"))
+    groups = {"warm": "global.alice", "alice": "global.alice",
+              "bob": "global.bob"}
+    i = 0
+    while i < len(order):
+        rg.query_finished(groups[order[i]], user=order[i].replace(
+            "warm", "alice"))
+        i += 1
+    started = order[1:]
+    # equal weights → strict alternation once both queues are non-empty
+    assert started[:6].count("alice") == 3
+    assert started[:6].count("bob") == 3
+
+
+def test_info_exposes_weight_and_vtime():
+    rg = ResourceGroupManager(_weighted_tree(3, 1), _weighted_selectors())
+    rg.submit("u", "heavy", 1, lambda: None)
+    info = rg.info()
+    assert info["global.heavy"]["weight"] == 3
+    assert info["global.heavy"]["vtime"] == pytest.approx(1 / 3)
+    assert info["global.light"]["vtime"] == 0.0
+
+
+# ---------------------------------------------------------------------------
+# per-group compile budgets
+
+
+def test_compile_budget_exhaustion_queues_until_replenished():
+    rg = ResourceGroupManager(
+        ResourceGroupSpec(
+            "global", hard_concurrency_limit=10, max_queued=100,
+            subgroups=[ResourceGroupSpec("cold", hard_concurrency_limit=10,
+                                         max_queued=100, compile_budget=5)]),
+        [SelectorSpec(group="global.cold")])
+    started = []
+    rg.submit("u", "", 1, lambda: started.append("q1"))
+    assert started == ["q1"]
+    # the query manager charges observed compiles at completion
+    rg.charge_compiles("global.cold", 5)
+    info = rg.info()
+    assert info["global.cold"]["compiles_used"] == 5
+    # budget exhausted → next submission queues even though slots are free
+    rg.submit("u", "", 1, lambda: started.append("q2"))
+    assert started == ["q1"]
+    assert rg.info()["global.cold"]["queued"] == 1
+    # ops replenish drains the queue
+    rg.replenish_compile_budgets()
+    assert started == ["q1", "q2"]
+    assert rg.info()["global.cold"]["compiles_used"] == 0
+
+
+def test_compile_budget_window_rolls_over():
+    import time as _time
+
+    rg = ResourceGroupManager(
+        ResourceGroupSpec(
+            "global", hard_concurrency_limit=10, max_queued=100,
+            subgroups=[ResourceGroupSpec(
+                "cold", hard_concurrency_limit=10, max_queued=100,
+                compile_budget=1, compile_budget_window_s=0.05)]),
+        [SelectorSpec(group="global.cold")])
+    started = []
+    rg.charge_compiles("global.cold", 1)
+    rg.submit("u", "", 1, lambda: started.append("q"))
+    assert started == []  # exhausted inside the window
+    _time.sleep(0.06)
+    # window rolled: a finish (or any drain) re-evaluates eligibility
+    rg.query_finished("global.cold")
+    assert started == ["q"]
+
+
+def test_budget_exhausted_sibling_does_not_starve_other_tenant():
+    rg = ResourceGroupManager(
+        ResourceGroupSpec(
+            "global", hard_concurrency_limit=1, max_queued=100,
+            scheduling_policy="weighted_fair",
+            subgroups=[
+                ResourceGroupSpec("cold", hard_concurrency_limit=1,
+                                  max_queued=100, compile_budget=1),
+                ResourceGroupSpec("hot", hard_concurrency_limit=1,
+                                  max_queued=100),
+            ]),
+        [SelectorSpec(group="global.cold", source_regex="cold"),
+         SelectorSpec(group="global.hot", source_regex="hot")])
+    started = []
+    rg.submit("u", "cold", 1, lambda: started.append("c1"))
+    rg.charge_compiles("global.cold", 1)
+    rg.submit("u", "cold", 1, lambda: started.append("c2"))
+    rg.submit("u", "hot", 1, lambda: started.append("h1"))
+    assert started == ["c1"]
+    rg.query_finished("global.cold")
+    # cold is out of budget — the hot tenant's query starts instead of
+    # the slot idling behind cold's queue head
+    assert started == ["c1", "h1"]

@@ -1,0 +1,327 @@
+"""Multi-tenant result reuse: the fingerprint-keyed semantic result cache
+(server/result_cache.py) and its wiring — snapshot-token invalidation,
+cost-aware admission, memory-ledger revocation BEFORE query kills, the
+subplan splice path, and the off-mode discipline."""
+
+import dataclasses
+
+import pandas as pd
+import pytest
+
+from presto_tpu.catalog.memory import MemoryConnector
+from presto_tpu.connector import Catalog
+from presto_tpu.exec import ExecConfig, LocalRunner
+from presto_tpu.obs import events as obs_events
+from presto_tpu.server import result_cache as rc
+from presto_tpu.server.cluster_memory import ClusterMemoryManager
+from presto_tpu.server.result_cache import ResultCache
+
+
+@pytest.fixture(autouse=True)
+def _clean_cache():
+    rc.CACHE.reset()
+    obs_events.EVENTS.clear()
+    yield
+    rc.CACHE.reset()
+    obs_events.EVENTS.clear()
+
+
+# ---------------------------------------------------------------------------
+# unit: admission / eviction / invalidation mechanics (no cluster)
+
+
+def _mk(budget=1000):
+    return ResultCache(budget_bytes=budget)
+
+
+class TestCacheUnit:
+    def test_admit_then_hit(self):
+        c = _mk()
+        assert c.lookup("k") is None  # counted miss, arms
+        assert c.admit("k", "query", "payload", wall_s=2.0, token="t",
+                       nbytes=100)
+        assert c.lookup("k") == "payload"
+        snap = c.counters()
+        assert snap["hits"] == 1 and snap["misses"] == 1
+        assert snap["bytes"] == 100 and snap["entries"] == 1
+        assert snap["wall_saved_s"] == pytest.approx(2.0)
+
+    def test_oversized_entry_rejected(self):
+        c = _mk(budget=100)
+        assert not c.admit("k", "query", "x", wall_s=9.0, token="t",
+                           nbytes=101)
+        assert c.counters()["entries"] == 0
+
+    def test_density_eviction_prefers_cheap_entries(self):
+        c = _mk(budget=1000)
+        # low density: cheap to recompute per byte held
+        assert c.admit("cheap", "query", "a", wall_s=0.001, token="t",
+                       nbytes=600)
+        # newcomer is denser — the cheap resident is evicted to make room
+        assert c.admit("dear", "query", "b", wall_s=10.0, token="t",
+                       nbytes=600)
+        assert c.lookup("dear") == "b"
+        assert c.lookup("cheap") is None
+        assert c.counters()["evictions"] == 1
+
+    def test_denser_residents_reject_newcomer(self):
+        c = _mk(budget=1000)
+        assert c.admit("dear", "query", "a", wall_s=10.0, token="t",
+                       nbytes=600)
+        assert not c.admit("cheap", "query", "b", wall_s=0.001, token="t",
+                           nbytes=600)
+        assert c.lookup("dear") == "a"
+
+    def test_flush_stale_drops_only_token_mismatches(self):
+        c = _mk()
+        c.admit("a", "query", "x", wall_s=1.0, token="old", nbytes=10)
+        c.admit("b", "query", "y", wall_s=1.0, token="new", nbytes=10)
+        assert c.flush_stale("new") == 1
+        assert c.lookup("a") is None and c.lookup("b") == "y"
+
+    def test_revoke_for_pressure_frees_cheapest_first(self):
+        c = _mk()
+        c.admit("cheap", "query", "x", wall_s=0.01, token="t", nbytes=100)
+        c.admit("dear", "query", "y", wall_s=50.0, token="t", nbytes=100)
+        freed = c.revoke_for_pressure(target_bytes=50)
+        assert freed == 100
+        assert c.lookup("cheap") is None and c.lookup("dear") == "y"
+        # no target: everything goes
+        assert c.revoke_for_pressure() == 100
+        assert c.bytes_held() == 0
+
+    def test_on_evict_callback_runs_outside_flush(self):
+        c = _mk()
+        dropped = []
+        c.admit("k", "subplan", "x", wall_s=1.0, token="t", nbytes=10,
+                on_evict=lambda: dropped.append("k"))
+        assert c.flush() == 1
+        assert dropped == ["k"]
+
+    def test_metric_rows_absent_until_armed(self):
+        c = _mk()
+        assert c.metric_rows({"plane": "coordinator"}) == []
+        c.lookup("never-admitted")  # consulting the cache arms it
+        names = {r[0] for r in c.metric_rows({"plane": "coordinator"})}
+        assert names == {
+            "presto_tpu_result_cache_hits_total",
+            "presto_tpu_result_cache_misses_total",
+            "presto_tpu_result_cache_evictions_total",
+            "presto_tpu_result_cache_bytes",
+        }
+
+
+# ---------------------------------------------------------------------------
+# ledger integration: revocation BEFORE the low-memory killer fires
+
+
+class _FakeQM:
+    class _Q:
+        done = False
+
+        def fail(self, msg, error_type=""):
+            _FakeQM.killed = True
+
+    killed = False
+
+    def get(self, qid):
+        return self._Q()
+
+
+class TestRevokeBeforeKill:
+    def test_cache_is_revoked_before_any_query_dies(self):
+        cmm = ClusterMemoryManager(limit_bytes=1000, kill_delay_s=0.0)
+        cache = ResultCache(budget_bytes=10_000)
+        cache.admit("k", "query", "x", wall_s=1.0, token="t", nbytes=900)
+        cmm.result_cache = cache
+        _FakeQM.killed = False
+        qm = _FakeQM()
+        # 200 reserved + 900 cached > 1000 limit → pressure
+        cmm.update_node("w0", {"memory": {"reservedBytes": 200,
+                                          "limitBytes": None},
+                               "queryMemory": {"q1": 200}})
+        assert cmm.enforce(qm) is None  # arms the timer
+        assert cmm.enforce(qm) is None  # revokes the cache, kills nothing
+        assert not _FakeQM.killed
+        assert cache.bytes_held() == 0
+        assert cmm.kills == 0
+        # with the cache empty the cluster is back under its limit
+        assert cmm.enforce(qm) is None
+        assert not _FakeQM.killed
+
+    def test_cache_bytes_surface_in_ledger_rollup(self):
+        cmm = ClusterMemoryManager(limit_bytes=None)
+        cache = ResultCache(budget_bytes=10_000)
+        cmm.result_cache = cache
+        assert "resultCache" not in cmm.info()  # unarmed → invisible
+        cache.admit("k", "query", "x", wall_s=1.0, token="t", nbytes=64)
+        doc = cmm.info()["resultCache"]
+        assert doc["bytes"] == 64 and doc["entries"] == 1
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: invalidation matrix over a live cluster
+
+
+def _mem_catalog():
+    conn = MemoryConnector()
+    conn.add_table("t", pd.DataFrame({"g": [1, 1, 2],
+                                      "v": [10.0, 20.0, 30.0]}))
+    cat = Catalog()
+    cat.register("m", conn, default=True)
+    return cat
+
+
+@pytest.fixture()
+def cluster():
+    from presto_tpu.server.coordinator import DistributedRunner
+
+    runner = DistributedRunner(
+        _mem_catalog(), n_workers=2,
+        config=ExecConfig(batch_rows=1 << 10, result_cache="query"))
+    yield runner
+    runner.close()
+
+
+SQL = "select g, sum(v) as s from t group by g order by g"
+
+
+class TestInvalidationMatrix:
+    def test_identical_query_hits(self, cluster):
+        a = cluster.run(SQL)
+        b = cluster.run(SQL)
+        snap = rc.CACHE.counters()
+        assert snap["hits"] == 1 and snap["misses"] == 1
+        pd.testing.assert_frame_equal(a, b)
+        kinds = [e["kind"] for e in obs_events.EVENTS.events()]
+        assert "cache_hit" in kinds
+
+    def test_different_literals_miss(self, cluster):
+        cluster.run(SQL)
+        cluster.run("select g, sum(v) as s from t where v > 5 "
+                    "group by g order by g")
+        snap = rc.CACHE.counters()
+        assert snap["hits"] == 0 and snap["misses"] == 2
+
+    def test_insert_bumps_token_and_recomputes(self, cluster):
+        cluster.run(SQL)
+        cluster.run(SQL)
+        c0 = rc.CACHE.counters()
+        assert c0["hits"] == 1
+        cluster.run_batch("insert into t select g, v from t where g = 2")
+        out = cluster.run(SQL)
+        c1 = rc.CACHE.counters()
+        assert c1["misses"] == c0["misses"] + 1  # stale entry cannot hit
+        assert c1["evictions"] >= 1  # and its bytes were reclaimed eagerly
+        assert float(out[out.g == 2].s.iloc[0]) == 60.0
+
+    def test_breaker_engine_does_not_key(self, cluster):
+        # engine selection changes HOW the result is computed, never WHAT
+        # it is — flipping it must still hit
+        cluster.run(SQL)
+        alt = dataclasses.replace(cluster.config, breaker_engine="xla")
+        out = cluster.coordinator.run_batch(SQL, config=alt).to_pandas()
+        snap = rc.CACHE.counters()
+        assert snap["hits"] == 1 and snap["misses"] == 1
+        pd.testing.assert_frame_equal(out, cluster.run(SQL))
+
+    def test_catalog_contents_do_key(self):
+        # same SQL over a catalog with different row counts → different
+        # snapshot token → miss (this is also how scale factor keys)
+        from presto_tpu.server.coordinator import DistributedRunner
+
+        cfg = ExecConfig(batch_rows=1 << 10, result_cache="query")
+        r1 = DistributedRunner(_mem_catalog(), n_workers=1, config=cfg)
+        try:
+            r1.run(SQL)
+        finally:
+            r1.close()
+        conn = MemoryConnector()
+        conn.add_table("t", pd.DataFrame({"g": [1, 2, 2, 3],
+                                          "v": [1.0, 2.0, 3.0, 4.0]}))
+        cat2 = Catalog()
+        cat2.register("m", conn, default=True)
+        r2 = DistributedRunner(cat2, n_workers=1, config=cfg)
+        try:
+            out = r2.run(SQL)
+        finally:
+            r2.close()
+        snap = rc.CACHE.counters()
+        assert snap["hits"] == 0 and snap["misses"] == 2
+        assert list(out.g) == [1, 2, 3]
+
+    def test_explain_analyze_cache_header(self, cluster):
+        from presto_tpu.server.session import Session
+
+        s = Session(catalog="m", schema="default")
+        s.set("result_cache", "query")
+        txt = cluster.coordinator.explain_analyze_distributed(SQL, s)
+        assert "[cache: miss]" in txt
+        cluster.coordinator.run_batch(SQL, config=cluster.config)
+        txt = cluster.coordinator.explain_analyze_distributed(SQL, s)
+        # EXPLAIN runs under the SESSION fingerprint (m.default) while the
+        # config path runs under the empty fingerprint — both states are
+        # legitimate; what matters is the header renders and peek() does
+        # not mutate counters
+        assert "[cache: " in txt
+
+    def test_off_mode_never_arms(self):
+        from presto_tpu.server.coordinator import DistributedRunner
+        from presto_tpu.server.metrics import coordinator_metrics
+
+        runner = DistributedRunner(
+            _mem_catalog(), n_workers=1,
+            config=ExecConfig(batch_rows=1 << 10))  # result_cache="off"
+        try:
+            runner.run(SQL)
+            runner.run(SQL)
+            assert not rc.CACHE.armed()
+            snap = rc.CACHE.counters()
+            assert snap["hits"] == snap["misses"] == snap["entries"] == 0
+            assert "result_cache" not in coordinator_metrics(
+                runner.coordinator)
+        finally:
+            runner.close()
+
+
+# ---------------------------------------------------------------------------
+# subplan splice path
+
+
+class TestSubplanReuse:
+    def test_shared_aggregate_subtree_is_spliced(self):
+        from presto_tpu.server.coordinator import DistributedRunner
+
+        runner = DistributedRunner(
+            _mem_catalog(), n_workers=2,
+            config=ExecConfig(batch_rows=1 << 10, result_cache="subplan"))
+        local = LocalRunner(_mem_catalog(), ExecConfig(batch_rows=1 << 10))
+        q2 = ("select t2.g, t2.s from (select g, sum(v) as s from t "
+              "group by g) t2 where t2.s > 25 order by t2.g")
+        try:
+            runner.run(SQL)  # materializes the grouped-aggregate subplan
+            c0 = rc.CACHE.counters()
+            assert c0["entries"] >= 2  # query entry + subplan entry
+            out = runner.run(q2)  # different query, same subtree → splice
+            c1 = rc.CACHE.counters()
+            assert c1["hits"] >= c0["hits"] + 1
+            exp = local.run(q2)
+            pd.testing.assert_frame_equal(out.reset_index(drop=True),
+                                          exp.reset_index(drop=True))
+        finally:
+            runner.close()
+
+    def test_subplan_entry_eviction_drops_splice_table(self):
+        from presto_tpu.server.coordinator import DistributedRunner
+
+        runner = DistributedRunner(
+            _mem_catalog(), n_workers=1,
+            config=ExecConfig(batch_rows=1 << 10, result_cache="subplan"))
+        try:
+            runner.run(SQL)
+            conn = runner.coordinator.catalog.connectors.get("_rc")
+            assert conn is not None and conn.tables
+            rc.CACHE.flush()
+            assert not conn.tables  # on_evict dropped the backing table
+        finally:
+            runner.close()
