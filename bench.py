@@ -44,6 +44,7 @@ Env knobs:
   BENCH_SF_SERVING / BENCH_SERVING_CLIENTS / BENCH_SERVING_QUERIES
                   serving_slo closed-loop knobs (default 0.1 / 8 / 4)
   BENCH_PALLAS=1  run aggregation configs with the Pallas MXU kernel
+  BENCH_SPILL_ROWS  build-side rows for the spill_skew config (default 400000)
 """
 
 import json
@@ -598,6 +599,109 @@ def _serving_cached_child(sf: float):
     print(json.dumps(rec), flush=True)
 
 
+def _spill_child(n_rows: int):
+    """Skew-adversarial spilled join: 90% one-hot build keys joined under a
+    memory pool ~40x smaller than the build side, vs the same join
+    unconstrained. The slowdown factor is the price of graceful degradation
+    under memory pressure; the stat block records how the dynamic hybrid
+    hash converged (partition leaves, next-bit repartitions, role
+    reversals) and the checksum proves the degraded path stayed correct."""
+    if os.environ.get("BENCH_FORCE_CPU"):
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import pandas as pd
+
+    from presto_tpu.catalog.memory import MemoryConnector
+    from presto_tpu.connector import Catalog
+    from presto_tpu.exec import ExecConfig, LocalRunner
+    from presto_tpu.exec.runtime import ExecContext, run_plan
+    from presto_tpu.verifier import result_checksum
+
+    rng = np.random.default_rng(47)
+    bk = np.where(rng.random(n_rows) < 0.9, 7,
+                  rng.integers(0, 50_000, n_rows)).astype(np.int64)
+    conn = MemoryConnector()
+    conn.add_table("build", pd.DataFrame({
+        "bk": bk, "w": rng.normal(size=n_rows)}))
+    n_probe = n_rows // 2
+    conn.add_table("probe", pd.DataFrame({
+        "k": rng.integers(0, 50_000, n_probe).astype(np.int64),
+        "v": rng.normal(size=n_probe)}))
+    cat = Catalog()
+    cat.register("m", conn, default=True)
+    sql = ("select probe.v, build.w from probe join build "
+           "on probe.k = build.bk")
+
+    base = LocalRunner(cat, ExecConfig(batch_rows=1 << 15))
+    base.run_batch(sql)  # warm-up: compiles
+    t0 = time.perf_counter()
+    ref = base.run_batch(sql)
+    ref.num_live()
+    base_s = time.perf_counter() - t0
+
+    pool = max(1 << 17, (n_rows * 16) // 40)
+    lim = LocalRunner(cat, ExecConfig(
+        batch_rows=1 << 15, memory_pool_bytes=pool, spill_partitions=8,
+        spill_max_depth=4))
+    times, last = [], None
+    for i in range(3):  # first iteration doubles as spill-path warm-up
+        qp = lim.plan(sql)
+        ctx = ExecContext(cat, lim.config)
+        t0 = time.perf_counter()
+        out = run_plan(qp, ctx)
+        out.num_live()
+        if i > 0:
+            times.append(time.perf_counter() - t0)
+        last = (ctx, out)
+    ctx, out = last
+    best = min(times)
+    print(json.dumps({
+        "rows": n_rows + n_probe, "seconds": round(best, 4),
+        "rows_per_sec": round((n_rows + n_probe) / best, 1),
+        "unconstrained_seconds": round(base_s, 4),
+        "degradation_factor": round(best / base_s, 2) if base_s else None,
+        "pool_bytes": pool,
+        "spilled_bytes": ctx.spill_manager.total_spilled_bytes,
+        "spill_partitions": ctx.stats.get("spill.partitions", 0),
+        "spill_repartitions": ctx.stats.get("spill.repartitions", 0),
+        "spill_role_reversals": ctx.stats.get("spill.role_reversals", 0),
+        "spill_revocations": ctx.stats.get("spill.revocations", 0),
+        "checksum_equal": result_checksum(out) == result_checksum(ref),
+    }), flush=True)
+
+
+def _run_spill_skew(extra: dict, remaining: float):
+    """Skew-adversarial spill bench (see BENCH_NOTES.md round 15): the
+    graceful-degradation price of a join that cannot fit memory."""
+    n_rows = int(os.environ.get("BENCH_SPILL_ROWS", "400000"))
+    env = dict(os.environ)
+    try:
+        p = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--spill-child",
+             str(n_rows)],
+            env=env, stdout=subprocess.PIPE,
+            timeout=min(600, max(120, remaining - 15)))
+        lines = p.stdout.decode().strip().splitlines()
+        if p.returncode == 0 and lines:
+            rec = json.loads(lines[-1])
+            _log(f"spill_skew: {rec['seconds']}s spilled vs "
+                 f"{rec['unconstrained_seconds']}s unconstrained "
+                 f"({rec['degradation_factor']}x, "
+                 f"{rec['spilled_bytes']}B spilled, "
+                 f"{rec['spill_repartitions']} repartitions, "
+                 f"{rec['spill_role_reversals']} reversals, "
+                 f"checksum_equal={rec['checksum_equal']})")
+            extra["spill_skew"] = rec
+        else:
+            extra["spill_skew"] = {"error": f"child rc={p.returncode}"}
+    except subprocess.TimeoutExpired:
+        extra["spill_skew"] = {"error": "timeout"}
+    except Exception as e:  # noqa: BLE001
+        extra["spill_skew"] = {"error": f"{type(e).__name__}: {e}"}
+
+
 def _run_serving_slo_cached(extra: dict, remaining: float):
     """Warm-over-cold serving comparison for the semantic result cache
     (the perf claim: an identical repeat never re-plans, re-compiles, or
@@ -782,6 +886,9 @@ def main():
     if len(sys.argv) >= 3 and sys.argv[1] == "--serving-cached-child":
         _serving_cached_child(float(sys.argv[2]))
         return
+    if len(sys.argv) >= 3 and sys.argv[1] == "--spill-child":
+        _spill_child(int(sys.argv[2]))
+        return
 
     signal.signal(signal.SIGTERM, _on_term)
     signal.signal(signal.SIGINT, _on_term)
@@ -799,7 +906,7 @@ def main():
     wanted = os.environ.get(
         "BENCH_CONFIGS", "q1_sf1,q1_nofuse_sf1,q6_sf10,q3_sf10,join_sf1,"
         "groupby_engine_ab_sf1,groupby_engine_ab_sort_sf1,mesh_scaling,"
-        "serving_slo,serving_slo_cached,q9,q64"
+        "serving_slo,serving_slo_cached,spill_skew,q9,q64"
     ).split(",")
 
     for name in (w.strip() for w in wanted):
@@ -835,6 +942,17 @@ def main():
                 if not device_ok:
                     os.environ["BENCH_FORCE_CPU"] = "1"
                 _run_serving_slo_cached(extra, remaining)
+            _checkpoint()
+            continue
+        if name == "spill_skew":
+            remaining = budget - (time.time() - _T0)
+            if remaining < 60:
+                _log("spill_skew: SKIPPED (budget exhausted)")
+                extra["spill_skew"] = {"skipped": "budget"}
+            else:
+                if not device_ok:
+                    os.environ["BENCH_FORCE_CPU"] = "1"
+                _run_spill_skew(extra, remaining)
             _checkpoint()
             continue
         if name not in _CONFIGS:

@@ -463,6 +463,128 @@ if [ "$rc" -ne 0 ]; then
   exit "$rc"
 fi
 
+# Spill-pressure smoke: a skew-adversarial join (90% one-hot build keys)
+# under a per-worker pool ~40x smaller than the build side must complete
+# CORRECTLY via the dynamic hybrid hash path — partitioned spill, mid-build
+# growth, role reversal — with zero low-memory kills, nonzero spill
+# counters on the worker metrics plane, and an EMPTY spill directory after
+# (leak guard). Then the revoke-before-kill ladder is driven
+# deterministically over the live coordinator->worker HTTP revoke path and
+# its order (spill_revoke_requested BEFORE low_memory_kill) audited from
+# /v1/events.
+echo "== spill-pressure smoke: skewed join under tiny pool + revoke ladder =="
+env JAX_PLATFORMS=cpu python - <<'PYEOF'
+import json, os, threading, time, urllib.request
+
+import numpy as np
+import pandas as pd
+
+from presto_tpu.catalog.memory import MemoryConnector
+from presto_tpu.connector import Catalog
+from presto_tpu.exec import ExecConfig, LocalRunner
+from presto_tpu.server.coordinator import DistributedRunner
+from presto_tpu.verifier import result_checksum
+
+rng = np.random.default_rng(19)
+n = 40_000
+bk = np.where(rng.random(n) < 0.9, 7,
+              rng.integers(0, 2_000, n)).astype(np.int64)
+conn = MemoryConnector()
+conn.add_table("build", pd.DataFrame({"bk": bk, "w": rng.normal(size=n)}))
+conn.add_table("probe", pd.DataFrame({
+    "k": rng.integers(0, 2_000, 24_000).astype(np.int64),
+    "v": rng.normal(size=24_000)}))
+cat = Catalog()
+cat.register("m", conn, default=True)
+sql = "select probe.v, build.w from probe join build on probe.k = build.bk"
+
+local = LocalRunner(cat, ExecConfig(batch_rows=1 << 13))
+dr = DistributedRunner(cat, n_workers=1, config=ExecConfig(
+    batch_rows=1 << 13, memory_pool_bytes=128 << 10, spill_partitions=4,
+    spill_max_depth=2))
+try:
+    assert result_checksum(dr.run_batch(sql)) == \
+        result_checksum(local.run_batch(sql)), "spilled join result differs"
+    w = dr.workers[0]
+    assert w.spill_manager.total_spilled_bytes > 0, "join never spilled"
+    assert dr.coordinator.cluster_memory.kills == 0, "graceful path killed"
+    sd = w.spill_manager._dir
+    leaked = os.listdir(sd) if sd and os.path.isdir(sd) else []
+    assert leaked == [], f"spill files leaked: {leaked}"
+    body = urllib.request.urlopen(w.url + "/v1/metrics",
+                                  timeout=10).read().decode()
+    for fam in ("presto_tpu_spill_partitions_total",
+                "presto_tpu_spill_repartitions_total",
+                "presto_tpu_spilled_bytes"):
+        assert fam in body, f"{fam} missing from worker metrics"
+    parts = [ln for ln in body.splitlines()
+             if ln.startswith("presto_tpu_spill_partitions_total")]
+    assert parts and float(parts[0].rsplit(" ", 1)[1]) > 0, parts
+
+    # -- revoke-before-kill ladder, deterministically ---------------------
+    # A standalone manager (so the live heartbeat cadence can't interleave)
+    # wired to the REAL coordinator->worker HTTP revoke path; a registered
+    # pool revoker stands in for a mid-build join.
+    from presto_tpu.obs.events import EVENTS
+    from presto_tpu.server.cluster_memory import ClusterMemoryManager
+    from presto_tpu.server.querymanager import (FAILED, QueryManager,
+                                                QueryResult)
+    from presto_tpu.server.session import Session
+
+    release = threading.Event()
+
+    def execute_fn(session, sql):
+        release.wait(30)
+        return QueryResult(columns=["x"], types=["bigint"], rows=[(1,)])
+
+    revoked = []
+    w.memory_pool.add_revoker(lambda need: revoked.append(need) or 0)
+    cmm = ClusterMemoryManager(limit_bytes=1_000_000, kill_delay_s=0.0)
+    cmm.spill_revoker = dr.coordinator._revoke_spillable_state
+    qm = QueryManager(execute_fn)
+    try:
+        hog = qm.create_query(Session(), "select hog")
+        deadline = time.time() + 5
+        while hog.state != "RUNNING" and time.time() < deadline:
+            time.sleep(0.01)
+        seq0 = EVENTS.last_seq()
+        pressure = {"memory": {"reservedBytes": 2_000_000,
+                               "limitBytes": None, "peakBytes": 2_000_000},
+                    "queryMemory": {hog.query_id: 2_000_000}}
+        cmm.update_node("w0", pressure)
+        cmm.enforce(qm)  # arms the pressure timer
+        assert cmm.enforce(qm) is None, "killed before trying spill revoke"
+        assert revoked, "worker pool revoker was never signaled over HTTP"
+        assert hog.state == "RUNNING" and cmm.kills == 0
+        # pressure persists and the episode's one revoke shot is spent:
+        # the next sustained pass must kill
+        cmm.enforce(qm)  # re-arms
+        assert cmm.enforce(qm) == hog.query_id
+        assert hog.state == FAILED
+        assert hog.error_type == "CLUSTER_OUT_OF_MEMORY"
+        ev = json.load(urllib.request.urlopen(
+            dr.coordinator.url + f"/v1/events?since={seq0}", timeout=10))
+        kinds = [e["kind"] for e in ev["events"]
+                 if e["kind"] in ("spill_revoke_requested",
+                                  "low_memory_kill")]
+        assert kinds == ["spill_revoke_requested", "low_memory_kill"], (
+            f"ladder out of order on /v1/events: {kinds}")
+    finally:
+        release.set()
+        qm.close()
+    print(f"spill-pressure smoke OK: checksum equal, "
+          f"{w.spill_manager.total_spilled_bytes}B spilled, 0 kills, "
+          f"spill dir empty, ladder order spill_revoke -> kill on "
+          f"/v1/events ({len(revoked)} revoker signal(s))")
+finally:
+    dr.close()
+PYEOF
+rc=$?
+if [ "$rc" -ne 0 ]; then
+  echo "spill-pressure smoke FAILED (exit $rc)"
+  exit "$rc"
+fi
+
 # Serving-SLO smoke: boot a shared-process cluster with the slow-query
 # and event-stream sinks armed, drive >= 8 concurrent mixed queries over
 # the statement protocol split across two resource groups, and assert

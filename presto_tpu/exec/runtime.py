@@ -139,6 +139,17 @@ class ExecConfig:
     spill_enabled: bool = True
     spill_dir: Optional[str] = None
     spill_partitions: int = 8
+    # dynamic hybrid hash spill (spiller.py): how many times a spill
+    # partition may split by the next hash bits — mid-build when it blows
+    # past its byte budget, or at replay when the partition still doesn't
+    # fit the memory budget (recursive repartitioning). A partition that
+    # exceeds the budget at max depth fails with SPILL_LIMIT_EXCEEDED
+    # (identical keys share every hash bit and can never split).
+    spill_max_depth: int = 4
+    # spill directory byte budget: a spill write that would push the
+    # directory's live footprint past this fails the spilling query with
+    # SPILL_LIMIT_EXCEEDED instead of filling the disk. None = unlimited.
+    spill_dir_budget_bytes: Optional[int] = None
     memory_revoking_threshold: float = 0.9
     memory_revoking_target: float = 0.5
     # Aria selective scan (scan/ package): constrained scans on connectors
@@ -333,6 +344,26 @@ class ExecContext:
             revoke_target=config.memory_revoking_target,
         )
         self.spill_manager = spill_manager or SpillManager(config.spill_dir)
+        if (config.spill_dir_budget_bytes is not None
+                and self.spill_manager.budget_bytes is None):
+            self.spill_manager.budget_bytes = config.spill_dir_budget_bytes
+        # every spiller/spill-file an operator opens registers here so task
+        # teardown can close+unlink them even when the operator generator
+        # died mid-spill (failed or canceled query) — close() is idempotent
+        self.spill_resources: List = []
+
+    def track_spill(self, resource) -> None:
+        self.spill_resources.append(resource)
+
+    def cleanup_spill(self) -> None:
+        """Leak guard: close (and unlink) every spill resource this context
+        ever opened. Safe to call repeatedly and after normal closes."""
+        for r in self.spill_resources:
+            try:
+                r.close()
+            except Exception:
+                pass
+        self.spill_resources = []
 
     def should_spill(self, projected_delta_bytes: int) -> bool:
         """Would adding this reservation cross the revoke threshold?"""
@@ -2045,6 +2076,124 @@ def _bump_replay_wave(node: PlanNode, ctx: "ExecContext",
                           **attrs)
 
 
+def _spill_stats_for(node: PlanNode, ctx: "ExecContext") -> dict:
+    """Per-node spill accounting stamped for EXPLAIN ANALYZE's
+    [spill: P=… depth=… reversed=…] rendering and the HBO spill sites."""
+    return node.__dict__.setdefault(
+        "_spill_stats",
+        {"partitions": 0, "repartitions": 0, "reversed": 0, "depth": 0,
+         "revocations": 0, "bytes": 0})
+
+
+def _note_spill_repartition(node: PlanNode, ctx: "ExecContext",
+                            child, parent_p: int) -> None:
+    """One next-hash-bits split happened (mid-build growth or replay-time
+    recursive repartitioning): counters + span + EXPLAIN stats."""
+    from presto_tpu.scan import metrics as _scan_metrics
+
+    st = _spill_stats_for(node, ctx)
+    st["repartitions"] += 1
+    st["depth"] = max(st["depth"], child.depth)
+    ctx.stats["spill.repartitions"] = ctx.stats.get("spill.repartitions", 0) + 1
+    _scan_metrics.record("spill_repartitions", 1)
+    if ctx.tracer.enabled:
+        t = time.time()
+        ctx.tracer.record("spill_repartition", "spill_repartition", t, t,
+                          node=type(node).__name__, partition=int(parent_p),
+                          depth=int(child.depth),
+                          fanout=int(child.n_partitions))
+
+
+def _note_spill_revoke(node: PlanNode, ctx: "ExecContext",
+                       freed: int) -> None:
+    """A pool-pressure revoke request was honored: spillable operator
+    state left the device at a batch boundary."""
+    from presto_tpu.scan import metrics as _scan_metrics
+
+    st = _spill_stats_for(node, ctx)
+    st["revocations"] += 1
+    ctx.stats["spill.revocations"] = ctx.stats.get("spill.revocations", 0) + 1
+    _scan_metrics.record("spill_revocations", 1)
+    if ctx.tracer.enabled:
+        t = time.time()
+        ctx.tracer.record("spill_revoke", "spill_revoke", t, t,
+                          node=type(node).__name__, freed=int(freed))
+
+
+def _spill_replay_budget(ctx: "ExecContext") -> Optional[int]:
+    """Byte budget one replayed spill partition's build side must fit in:
+    the explicit per-partition budget when set, else the memory pool's
+    revoke target (the replay concat has to fit back under the pool limit
+    with headroom). None = unbudgeted (replay whole partitions)."""
+    if ctx.config.join_spill_budget_bytes is not None:
+        return ctx.config.join_spill_budget_bytes
+    pool = ctx.memory_pool
+    if pool.limit is not None:
+        return max(1, int(pool.limit * pool.revoke_target))
+    return None
+
+
+def _hbo_spill_partitions(node: PlanNode, ctx: "ExecContext", site: str,
+                          default_p: int) -> int:
+    """hbo=correct: seed the initial spill partition count from the leaf
+    count a previous run of this structure converged to, so the repeat run
+    skips the repartition waves entirely."""
+    if getattr(ctx.config, "hbo", "observe") != "correct":
+        return default_p
+    try:
+        from presto_tpu.obs import runstats as _runstats
+
+        h = _runstats.lookup_node(node, ctx.catalog, site)
+    except Exception:
+        h = None
+    if h and h.get("actual"):
+        want = int(h["actual"])
+        if want > default_p:
+            try:
+                _runstats.record_correction("spill_partitions")
+            except Exception:
+                pass
+            return min(want, 1024)
+    return default_p
+
+
+def _record_spill_done(node: PlanNode, ctx: "ExecContext", site: str,
+                       est_p: int, spilled_bytes: int, side: str) -> None:
+    """Close out one spilling operator: final leaf count to the counter
+    plane, spilled bytes to the histogram plane, and the whole shape
+    (partitions / repartitions / reversals / depth / skew-visible bytes)
+    into HBO history keyed on the node's structural fingerprint."""
+    from presto_tpu.obs import metrics as _obs_metrics
+    from presto_tpu.scan import metrics as _scan_metrics
+
+    st = _spill_stats_for(node, ctx)
+    st["bytes"] += int(spilled_bytes)
+    if st["partitions"]:
+        _scan_metrics.record("spill_partitions", st["partitions"])
+        ctx.stats["spill.partitions"] = (
+            ctx.stats.get("spill.partitions", 0) + st["partitions"])
+    if spilled_bytes:
+        _obs_metrics.SPILLED_BYTES.observe(
+            float(spilled_bytes), plane="worker", side=side)
+    if getattr(ctx.config, "hbo", "observe") == "off":
+        return
+    try:
+        from presto_tpu.obs import runstats as _runstats
+
+        fp = _runstats.node_fingerprint(node, ctx.catalog)
+        if fp is None:
+            return
+        _runstats.observe(
+            fp, site, type(node).__name__.lower(), float(est_p),
+            float(max(st["partitions"], 1)),
+            extra={"repartitions": int(st["repartitions"]),
+                   "reversals": int(st["reversed"]),
+                   "depth": int(st["depth"]),
+                   "spilled_bytes": int(spilled_bytes)})
+    except Exception:
+        pass
+
+
 def _hbo_record_agg(node: Aggregate, ctx: "ExecContext", obs: dict,
                     skew: Optional[float] = None) -> None:
     """Record the aggregate's observed group count into the runstats
@@ -2295,8 +2444,6 @@ def _execute_aggregate(node: Aggregate, ctx: ExecContext) -> Iterator[Batch]:
         # exceeds join_spill_budget_bytes hybrid-spill: the confirmed
         # state pages plus all later raw sub-batches go to host files and
         # replay one-at-a-time at the end.
-        import os as _os
-
         from presto_tpu.memory import batch_device_bytes as _bdb
         from presto_tpu.obs import metrics as _obs_metrics
         from presto_tpu.scan import metrics as _scan_metrics
@@ -2351,6 +2498,32 @@ def _execute_aggregate(node: Aggregate, ctx: ExecContext) -> Iterator[Batch]:
             return _finalize_aggregate(node, acc, layout, key_syms,
                                        key_types, state_types, in_types)
 
+        def spill_partition(p):
+            """Hybrid-spill partition p: the confirmed state pages plus all
+            later raw sub-batches go to host files and replay at the end."""
+            af = ctx.spill_manager.spill_file(f"radix-agg-acc-p{p}")
+            ctx.track_spill(af)
+            if accs[p] is not None:
+                af.append(accs[p])
+            afiles[p] = af
+            rfiles[p] = ctx.spill_manager.spill_file(f"radix-agg-raw-p{p}")
+            ctx.track_spill(rfiles[p])
+            accs[p] = None
+            caps[p] = start_cap
+            _stat("radix.partitions_spilled", 1)
+            _scan_metrics.record("radix_partitions_spilled", 1)
+
+        rev = {"flag": False}
+
+        def _revoke(_need):
+            # pool-pressure REQUEST honored at the next batch boundary
+            # (spilling synchronously inside reserve() would re-enter the
+            # accounting — same protocol as the non-radix agg revoker)
+            rev["flag"] = True
+            return 0
+
+        if ctx.config.spill_enabled:
+            ctx.memory_pool.add_revoker(_revoke)
         try:
             for raw_b in in_stream:
                 rid = _radix_tag(raw_b, P, key_syms)
@@ -2379,18 +2552,17 @@ def _execute_aggregate(node: Aggregate, ctx: ExecContext) -> Iterator[Batch]:
                 for p, sub, first in pend:
                     merge_into(p, sub, jit_step_raw, jit_step0_raw, first)
                     if budget is not None and _bdb(accs[p]) > budget:
-                        af = SpillFile(_os.path.join(
-                            ctx.spill_manager.dir,
-                            f"radix-agg-acc-p{p}-{id(node)}.bin"))
-                        af.append(accs[p])
-                        afiles[p] = af
-                        rfiles[p] = SpillFile(_os.path.join(
-                            ctx.spill_manager.dir,
-                            f"radix-agg-raw-p{p}-{id(node)}.bin"))
-                        accs[p] = None
-                        caps[p] = start_cap
-                        _stat("radix.partitions_spilled", 1)
-                        _scan_metrics.record("radix_partitions_spilled", 1)
+                        spill_partition(p)
+                if rev["flag"]:
+                    # revoke ladder asked for memory back: spill the
+                    # LARGEST resident partition down to host
+                    rev["flag"] = False
+                    resident = [(pp, _bdb(accs[pp])) for pp in range(P)
+                                if accs[pp] is not None and pp not in rfiles]
+                    if resident:
+                        pp, nbytes = max(resident, key=lambda t: t[1])
+                        spill_partition(pp)
+                        _note_spill_revoke(node, ctx, nbytes)
             rrows = [int(r) for r in rrows]
             for p in range(P):
                 if rrows[p]:
@@ -2421,12 +2593,16 @@ def _execute_aggregate(node: Aggregate, ctx: ExecContext) -> Iterator[Batch]:
                 _hbo_record_agg(node, ctx, hbo_obs,
                                 skew=partition_skew(rrows))
         finally:
+            if ctx.config.spill_enabled:
+                ctx.memory_pool.remove_revoker(_revoke)
             spilled = (sum(f.bytes for f in afiles.values())
                        + sum(f.bytes for f in rfiles.values()))
             if spilled:
                 _stat("radix.spill_bytes", spilled)
                 _scan_metrics.record("radix_spill_bytes", spilled)
                 ctx.spill_manager.record(spilled)
+                _obs_metrics.SPILLED_BYTES.observe(
+                    float(spilled), plane="worker", side="group")
             for f in afiles.values():
                 f.close()
             for f in rfiles.values():
@@ -2442,11 +2618,20 @@ def _execute_aggregate(node: Aggregate, ctx: ExecContext) -> Iterator[Batch]:
              "revoke_requested": False}
     mctx = LocalMemoryContext(ctx.memory_pool, "aggregate")
     owner_thread = _threading.get_ident()
+    # dynamic hybrid hash: the initial partition count is an ESTIMATE —
+    # hbo=correct seeds it from the leaf count a previous run of this
+    # structure converged to, so the repeat skips the repartition waves
+    grace_P = (_hbo_spill_partitions(node, ctx, "spill_agg",
+                                     ctx.config.spill_partitions)
+               if can_spill else ctx.config.spill_partitions)
 
     def mk_raw_spiller():
         if state["raw_spiller"] is None:
             state["raw_spiller"] = ctx.spill_manager.partitioning_spiller(
-                key_syms, ctx.config.spill_partitions, "agg-raw")
+                key_syms, grace_P, "agg-raw",
+                on_grow=lambda child, pp: _note_spill_repartition(
+                    node, ctx, child, pp))
+            ctx.track_spill(state["raw_spiller"])
         return state["raw_spiller"]
 
     def do_spill() -> int:
@@ -2458,8 +2643,10 @@ def _execute_aggregate(node: Aggregate, ctx: ExecContext) -> Iterator[Batch]:
             return 0
         if state["spiller"] is None:
             state["spiller"] = ctx.spill_manager.partitioning_spiller(
-                key_syms, ctx.config.spill_partitions, "agg"
-            )
+                key_syms, grace_P, "agg",
+                on_grow=lambda child, pp: _note_spill_repartition(
+                    node, ctx, child, pp))
+            ctx.track_spill(state["spiller"])
         state["spiller"].spill(acc0)
         freed = mctx.bytes
         state["acc"] = None
@@ -2476,10 +2663,21 @@ def _execute_aggregate(node: Aggregate, ctx: ExecContext) -> Iterator[Batch]:
         state["revoke_requested"] = True
         return 0
 
+    def _ceiling_overflow(mode, entries):
+        if mode == "fail":
+            from presto_tpu.spiller import SpillLimitExceeded
+
+            raise SpillLimitExceeded(
+                "aggregate spill partition exceeds the grace ceiling at "
+                f"max recursion depth {max(0, ctx.config.spill_max_depth)} "
+                "(group keys share too many hash bits to split further)")
+        raise _GraceOverflow(entries)
+
     if can_spill:
         ctx.memory_pool.add_revoker(revoke)
     try:
-        def absorb(stream, step_fn, step0_fn, allow_spill=True):
+        def absorb(stream, step_fn, step0_fn, allow_spill=True,
+                   on_ceiling=None):
             """Merge the stream into the accumulator with OPTIMISTIC
             dispatch: the per-step group count `ng` (the only data-dependent
             control input) is fetched asynchronously and confirmed up to
@@ -2488,8 +2686,19 @@ def _execute_aggregate(node: Aggregate, ctx: ExecContext) -> Iterator[Batch]:
             tunnel — the dominant cost of the old sync-per-batch loop).
             A window of (checkpoint-acc, input-batch) pairs is held; on the
             rare capacity overflow the window replays synchronously from
-            the last confirmed checkpoint at a bigger capacity."""
+            the last confirmed checkpoint at a bigger capacity.
+
+            `on_ceiling` names what growth past the grace ceiling does:
+            "grace" raises _GraceOverflow (hand the input to the
+            hash-partitioned spill path — the mid-stream default and the
+            replay-time recursive-repartition trigger), "grow" keeps
+            growing the table (spill unavailable), "fail" raises
+            SpillLimitExceeded (recursive repartitioning hit its depth
+            bound without converging)."""
             nonlocal cap
+            mode = on_ceiling or ("grace" if allow_spill else "grow")
+            if not can_spill:
+                mode = "grow"
             depth = max(1, ctx.config.agg_pipeline_depth)
             no_overflow = not key_syms  # global agg: ng ≤ 1, never grows
             window = []  # (acc_before, batch, ng_device_scalar)
@@ -2520,8 +2729,8 @@ def _execute_aggregate(node: Aggregate, ctx: ExecContext) -> Iterator[Batch]:
                 nonlocal cap
                 state["acc"] = entries[0][0]
                 want2 = round_up_capacity(ngi)
-                if allow_spill and can_spill and want2 > ceiling:
-                    raise _GraceOverflow(entries)
+                if mode != "grow" and want2 > ceiling:
+                    _ceiling_overflow(mode, entries)
                 cap = want2
                 _bump_replay_wave(node, ctx, hbo_obs, cap_to=cap)
                 for i, (_, b, _) in enumerate(entries):
@@ -2539,10 +2748,10 @@ def _execute_aggregate(node: Aggregate, ctx: ExecContext) -> Iterator[Batch]:
                         # power-of-two bucketing already gives ≤2× headroom;
                         # doubling on top would 4× the memory footprint
                         want2 = round_up_capacity(n2)
-                        if allow_spill and can_spill and want2 > ceiling:
+                        if mode != "grow" and want2 > ceiling:
                             # acc still holds the pre-entry checkpoint:
                             # entries[i:] have not been merged into it
-                            raise _GraceOverflow(entries[i:])
+                            _ceiling_overflow(mode, entries[i:])
                         cap = want2
                     else:
                         raise RuntimeError(
@@ -2580,8 +2789,11 @@ def _execute_aggregate(node: Aggregate, ctx: ExecContext) -> Iterator[Batch]:
                     or ctx.should_spill(out_bytes - mctx.bytes)
                 ):
                     confirm(block=True)  # spill only a confirmed accumulator
+                    was_revoke = state["revoke_requested"]
                     state["revoke_requested"] = False
-                    do_spill()
+                    freed = do_spill()
+                    if was_revoke:
+                        _note_spill_revoke(node, ctx, freed)
                 else:
                     mctx.set_bytes(out_bytes)
             confirm(block=True)
@@ -2712,8 +2924,11 @@ def _execute_aggregate(node: Aggregate, ctx: ExecContext) -> Iterator[Batch]:
                         or ctx.should_spill(out_bytes - mctx.bytes)
                     ):
                         confirm(block=True)
+                        was_revoke = state["revoke_requested"]
                         state["revoke_requested"] = False
-                        do_spill()
+                        freed = do_spill()
+                        if was_revoke:
+                            _note_spill_revoke(node, ctx, freed)
                     else:
                         mctx.set_bytes(out_bytes)
                 confirm(block=True)
@@ -2760,10 +2975,14 @@ def _execute_aggregate(node: Aggregate, ctx: ExecContext) -> Iterator[Batch]:
             return
 
         # spilled: finalize bucket-by-bucket (grouped-execution style).
-        # Spilling is disabled during the per-partition merge — re-spilling
-        # into files being read back would corrupt them; a partition that
-        # still exceeds the limit fails the query (the reference's
-        # unspillable-final-merge failure mode).
+        # Spilling to NEW files stays off during the per-partition merge,
+        # but a partition whose replay outgrows the grace ceiling no longer
+        # fails the query: it re-partitions by the NEXT hash bits
+        # ((hash // divisor) % fanout — fresh entropy, so skewed-but-
+        # distinct keys do split) and recurses, bounded by spill_max_depth.
+        # Only keys that share every hash bit (one-hot identical groups
+        # never overflow a 1-group table, so in practice adversarial
+        # collisions) reach the bound and fail with SPILL_LIMIT_EXCEEDED.
         do_spill()
         ctx.memory_pool.remove_revoker(revoke)
         spiller = state["spiller"]
@@ -2772,27 +2991,55 @@ def _execute_aggregate(node: Aggregate, ctx: ExecContext) -> Iterator[Batch]:
             node, "accstep0", lambda: (lambda b, cap: acc_merge_step(None, b, cap)),
             static_argnums=(1,),
         )
-        n_parts = ctx.config.spill_partitions
-        for p in range(n_parts):
+        max_sdepth = max(0, ctx.config.spill_max_depth)
+
+        def finalize_leaf(rsp, asp, p, sdepth):
+            nonlocal cap
             state["acc"] = None
             # each bucket holds ~1/P of the groups — shrink the table back
             # (it regrows geometrically if a bucket is skewed)
             cap = ctx.config.agg_capacity
-            if raw_spiller is not None:
-                absorb(raw_spiller.read_partition(p), jit_step_raw,
-                       jit_step0_raw, allow_spill=False)
-            if spiller is not None:
-                absorb(spiller.read_partition(p), jit_accstep, jit_accstep0,
-                       allow_spill=False)
+            try:
+                mode = "grace" if sdepth < max_sdepth else "fail"
+                if rsp is not None:
+                    absorb(rsp.read_partition(p), jit_step_raw,
+                           jit_step0_raw, allow_spill=False, on_ceiling=mode)
+                if asp is not None:
+                    absorb(asp.read_partition(p), jit_accstep, jit_accstep0,
+                           allow_spill=False, on_ceiling=mode)
+            except _GraceOverflow:
+                # replay outgrew the ceiling: the partition's files are
+                # still intact on disk, so drop the partial merge, split
+                # by the next hash bits, and finalize the children (raw
+                # and state-page trees split in lockstep → co-partitioned)
+                state["acc"] = None
+                mctx.set_bytes(0)
+                sub_r = rsp.grow_partition(p) if rsp is not None else None
+                sub_a = (asp.grow_partition(
+                    p, fanout=(sub_r.n_partitions if sub_r is not None
+                               else None))
+                    if asp is not None else None)
+                fanout = (sub_r or sub_a).n_partitions
+                for q in range(fanout):
+                    yield from finalize_leaf(sub_r, sub_a, q, sdepth + 1)
+                return
             acc = state["acc"]
             if acc is None:
-                continue
+                return
+            _spill_stats_for(node, ctx)["partitions"] += 1
             if node.step == "partial":
                 yield acc
             else:
                 yield _finalize_aggregate(node, acc, layout, key_syms,
                                           key_types, state_types, in_types)
             mctx.set_bytes(0)
+
+        for p in range((raw_spiller or spiller).n_partitions):
+            yield from finalize_leaf(raw_spiller, spiller, p, 0)
+        spilled_total = ((raw_spiller.spilled_bytes if raw_spiller else 0)
+                         + (spiller.spilled_bytes if spiller else 0))
+        _record_spill_done(node, ctx, "spill_agg", grace_P, spilled_total,
+                           side="group")
         if spiller is not None:
             spiller.close()
         if raw_spiller is not None:
@@ -3179,8 +3426,6 @@ def _radix_join(node: HashJoin, ctx: ExecContext,
     to host spill files (serde page format) and are joined one-at-a-time
     after the in-memory partitions, so an oversized build degrades to disk
     instead of recompiling at ever-larger capacities."""
-    import os
-
     from presto_tpu.memory import batch_device_bytes
     from presto_tpu.obs import metrics as _obs_metrics
     from presto_tpu.scan import metrics as _scan_metrics
@@ -3196,15 +3441,34 @@ def _radix_join(node: HashJoin, ctx: ExecContext,
     def _stat(key, delta):
         ctx.stats[key] = ctx.stats.get(key, 0) + delta
 
-    def _spill_path(tag, p):
-        return os.path.join(ctx.spill_manager.dir,
-                            f"radix-{tag}-p{p}-{id(node)}.bin")
-
     parts: List[List[Batch]] = [[] for _ in range(P)]
     pbytes = [0] * P
     prows = [0] * P
     bfiles: Dict[int, "SpillFile"] = {}
     pfiles: Dict[int, "SpillFile"] = {}
+
+    def spill_build_partition(p):
+        """Move partition p's resident build batches to a host spill file;
+        later build rows for p append straight to it."""
+        f = ctx.spill_manager.spill_file(f"radix-join-build-p{p}")
+        ctx.track_spill(f)
+        for bb in parts[p]:
+            f.append(bb)
+        parts[p] = []
+        pbytes[p] = 0
+        bfiles[p] = f
+        _stat("radix.partitions_spilled", 1)
+        _scan_metrics.record("radix_partitions_spilled", 1)
+
+    rev = {"flag": False}
+
+    def _revoke(_need):
+        # pool-pressure REQUEST honored at the next batch boundary
+        rev["flag"] = True
+        return 0
+
+    if ctx.config.spill_enabled:
+        ctx.memory_pool.add_revoker(_revoke)
     try:
         for b in build_stream:
             rid = _radix_tag(b, P, node.right_keys)
@@ -3225,14 +3489,17 @@ def _radix_join(node: HashJoin, ctx: ExecContext,
                 parts[p].append(sub)
                 pbytes[p] += batch_device_bytes(sub)
                 if budget is not None and pbytes[p] > budget:
-                    f = SpillFile(_spill_path("join-build", p))
-                    for bb in parts[p]:
-                        f.append(bb)
-                    parts[p] = []
-                    pbytes[p] = 0
-                    bfiles[p] = f
-                    _stat("radix.partitions_spilled", 1)
-                    _scan_metrics.record("radix_partitions_spilled", 1)
+                    spill_build_partition(p)
+            if rev["flag"]:
+                # revoke ladder asked for memory back: spill the LARGEST
+                # resident build partition down to host
+                rev["flag"] = False
+                resident = [(pp, pbytes[pp]) for pp in range(P)
+                            if parts[pp] and pp not in bfiles]
+                if resident:
+                    pp, nbytes = max(resident, key=lambda t: t[1])
+                    spill_build_partition(pp)
+                    _note_spill_revoke(node, ctx, nbytes)
         prows = [int(r) for r in prows]
         for p in range(P):
             if prows[p]:
@@ -3266,7 +3533,9 @@ def _radix_join(node: HashJoin, ctx: ExecContext,
                 if p in bfiles:
                     f = pfiles.get(p)
                     if f is None:
-                        f = pfiles[p] = SpillFile(_spill_path("join-probe", p))
+                        f = pfiles[p] = ctx.spill_manager.spill_file(
+                            f"radix-join-probe-p{p}")
+                        ctx.track_spill(f)
                     f.append(sub)
                 else:
                     pend.append((p, probers[p].probe_start(sub)))
@@ -3290,12 +3559,16 @@ def _radix_join(node: HashJoin, ctx: ExecContext,
                 tr.record("radix_spill_replay", "radix_spill_replay", t0,
                           time.time(), partition=p, rows=prows[p])
     finally:
+        if ctx.config.spill_enabled:
+            ctx.memory_pool.remove_revoker(_revoke)
         spilled = (sum(f.bytes for f in bfiles.values())
                    + sum(f.bytes for f in pfiles.values()))
         if spilled:
             _stat("radix.spill_bytes", spilled)
             _scan_metrics.record("radix_spill_bytes", spilled)
             ctx.spill_manager.record(spilled)
+            _obs_metrics.SPILLED_BYTES.observe(
+                float(spilled), plane="worker", side="build")
         for f in bfiles.values():
             f.close()
         for f in pfiles.values():
@@ -3329,24 +3602,48 @@ def _execute_join(node: HashJoin, ctx: ExecContext) -> Iterator[Batch]:
         return
 
     # Collect the build side with memory accounting; crossing the revoke
-    # threshold switches to the partitioned-spill path (HashBuilderOperator's
-    # SPILLING_INPUT state + GenericPartitioningSpiller: both sides are
-    # hash-partitioned to disk on the join keys and each bucket is joined
-    # independently).
+    # threshold (or a pool-pressure revoke request) switches to the
+    # partitioned-spill path (HashBuilderOperator's SPILLING_INPUT state +
+    # GenericPartitioningSpiller): both sides are hash-partitioned to disk
+    # on the join keys and each bucket is joined independently — with the
+    # dynamic hybrid-hash escape hatches (mid-build growth, recursive
+    # repartitioning, per-partition role reversal) when the partition-count
+    # estimate proves wrong.
     mctx = LocalMemoryContext(ctx.memory_pool, "join-build")
     build_batches: List[Batch] = []
     bspiller = None
+    pspiller = None
+    est_p = ctx.config.spill_partitions
+    can_spill = ctx.config.spill_enabled
+    rev = {"flag": False}
+
+    def _revoke(_need: int) -> int:
+        # flag only — the spill happens at the next build-batch boundary
+        # (spilling synchronously inside pool.reserve would re-enter the
+        # ledger mid-update)
+        rev["flag"] = True
+        return 0
+
+    if can_spill:
+        ctx.memory_pool.add_revoker(_revoke)
     try:
         for b in build_stream:
             nb = batch_device_bytes(b)
-            if ctx.config.spill_enabled and ctx.should_spill(nb):
-                P = ctx.config.spill_partitions
+            if can_spill and (rev["flag"] or ctx.should_spill(nb)):
+                est_p = _hbo_spill_partitions(node, ctx, "spill_join",
+                                              ctx.config.spill_partitions)
                 bspiller = ctx.spill_manager.partitioning_spiller(
-                    node.right_keys, P, "join-build"
-                )
+                    node.right_keys, est_p, "join-build",
+                    partition_budget_bytes=_spill_replay_budget(ctx),
+                    max_depth=max(0, ctx.config.spill_max_depth),
+                    on_grow=lambda child, pp: _note_spill_repartition(
+                        node, ctx, child, pp))
+                ctx.track_spill(bspiller)
                 for bb in build_batches:
                     bspiller.spill(bb)
-                ctx.spill_manager.record(mctx.bytes)
+                if rev["flag"]:
+                    _note_spill_revoke(node, ctx, mctx.bytes)
+                    rev["flag"] = False
                 build_batches = []
                 mctx.set_bytes(0)
                 bspiller.spill(b)
@@ -3363,34 +3660,148 @@ def _execute_join(node: HashJoin, ctx: ExecContext) -> Iterator[Batch]:
 
         # spill the (chained) probe side partitioned by the probe keys —
         # co-partitioned with the build because both sides hash the key
-        # CONTENT (string keys by dictionary-independent value hash) % P
-        P = bspiller.n_partitions
+        # CONTENT (string keys by dictionary-independent value hash) with
+        # the same divisor/fanout schedule
         pspiller = ctx.spill_manager.partitioning_spiller(
-            node.left_keys, P, "join-probe"
-        )
-        try:
-            jchain = _node_jit(node, "spill_chain", lambda: chain)
-            for pb in probe_stream:
-                pspiller.spill(jchain(pb))
-            ident = lambda b: b  # noqa: E731 — chain already applied pre-spill
-            for p in range(P):
-                build_in = _collect_concat(bspiller.read_partition(p))
-                if build_in is None and node.kind == "inner":
-                    continue
-                # account the materialized bucket — a skewed partition that
-                # exceeds the pool limit must fail cleanly, not OOM silently
-                if build_in is not None:
-                    mctx.set_bytes(batch_device_bytes(build_in))
-                yield from _join_probe(node, ctx, build_in,
-                                       pspiller.read_partition(p), ident,
-                                       jkey="spill_")
-                mctx.set_bytes(0)
-        finally:
-            pspiller.close()
+            node.left_keys, bspiller.n_partitions, "join-probe")
+        ctx.track_spill(pspiller)
+        jchain = _node_jit(node, "spill_chain", lambda: chain)
+        for pb in probe_stream:
+            pspiller.spill(jchain(pb))
+        # mid-build growth may have split build partitions: mirror the
+        # split tree onto the probe side so replay pairs leaf-for-leaf
+        pspiller.align_to(bspiller)
+        yield from _replay_spilled_join(node, ctx, bspiller, pspiller, mctx)
     finally:
+        if can_spill:
+            ctx.memory_pool.remove_revoker(_revoke)
         if bspiller is not None:
+            spilled = bspiller.spilled_bytes + (
+                pspiller.spilled_bytes if pspiller is not None else 0)
+            ctx.spill_manager.record(spilled)
+            _record_spill_done(node, ctx, "spill_join", est_p, spilled,
+                               side="build")
             bspiller.close()
+        if pspiller is not None:
+            pspiller.close()
         mctx.set_bytes(0)
+
+
+def _reversed_join_shim(node: HashJoin) -> HashJoin:
+    """The same inner join with build/probe roles swapped. Sound only for
+    kind == 'inner' with no residual (match semantics are symmetric there;
+    outer joins and residual filters are side-dependent). Cached on the
+    node so _node_jit reuses one shim's program entries across partitions;
+    build_unique is dropped — uniqueness of the original build side says
+    nothing about the reversed one."""
+    shim = node.__dict__.get("_reversed_shim")
+    if shim is None:
+        shim = HashJoin(kind="inner", left=node.right, right=node.left,
+                        left_keys=list(node.right_keys),
+                        right_keys=list(node.left_keys),
+                        residual=None, build_unique=False)
+        node.__dict__["_reversed_shim"] = shim
+    return shim
+
+
+def _reorder_output(b: Batch, names: List[str]) -> Batch:
+    """Columns of b in `names` order — a reversed-role join emits
+    right-then-left columns while the consumer contracted for the node's
+    left-then-right."""
+    return Batch(list(names), [b.type_of(n) for n in names],
+                 [b.column(n) for n in names], b.live, b.dicts)
+
+
+def _replay_spilled_join(node: HashJoin, ctx: ExecContext,
+                         bspiller, pspiller, mctx) -> Iterator[Batch]:
+    """Replay a co-partitioned spilled join leaf-by-leaf with the dynamic
+    hybrid-hash degradation ladder: a leaf whose nominal build side misses
+    the replay budget first tries ROLE REVERSAL (build from the smaller
+    probe side — inner joins without residuals only), then RECURSIVE
+    REPARTITIONING by the next hash bits (both sides split in lockstep so
+    leaves stay co-partitioned), and only at the depth bound fails with a
+    structured SPILL_LIMIT_EXCEEDED."""
+    from presto_tpu.memory import batch_device_bytes
+    from presto_tpu.scan import metrics as _scan_metrics
+    from presto_tpu.spiller import SpillLimitExceeded
+
+    budget = _spill_replay_budget(ctx)
+    max_depth = max(0, ctx.config.spill_max_depth)
+    st = _spill_stats_for(node, ctx)
+    out_names = [s for s, _ in node.output]
+    ident = lambda b: b  # noqa: E731 — chain already applied pre-spill
+
+    def replay_leaf(bsp, psp, p: int) -> Iterator[Batch]:
+        bc, pc = bsp.children.get(p), psp.children.get(p)
+        if bc is not None or pc is not None:
+            # one side split here (mid-build growth or an earlier replay
+            # pass): mirror so both sides expose the identical leaf set
+            if bc is None:
+                bc = bsp.grow_partition(p, fanout=pc.n_partitions)
+            if pc is None:
+                pc = psp.grow_partition(p, fanout=bc.n_partitions)
+            bc.align_to(pc)
+            pc.align_to(bc)
+            for q in range(bc.n_partitions):
+                yield from replay_leaf(bc, pc, q)
+            return
+
+        bb = bsp.partition_est_bytes(p)
+        pb = psp.partition_est_bytes(p)
+        reversed_ = (budget is not None and bb > budget and pb < bb
+                     and node.kind == "inner" and node.residual is None)
+        build_bytes = pb if reversed_ else bb
+        if budget is not None and build_bytes > budget:
+            # even the smaller side misses the budget: split this leaf by
+            # the NEXT hash bits and recurse — bounded by the depth cap
+            if bsp.depth >= max_depth:
+                raise SpillLimitExceeded(
+                    f"join spill partition is {build_bytes} bytes against a "
+                    f"{budget}-byte replay budget at max recursion depth "
+                    f"{max_depth} (join keys too skewed to split further)")
+            sub_b = bsp.grow_partition(p)
+            sub_p = psp.grow_partition(p, fanout=sub_b.n_partitions)
+            for q in range(sub_b.n_partitions):
+                yield from replay_leaf(sub_b, sub_p, q)
+            return
+
+        if reversed_:
+            st["reversed"] += 1
+            ctx.stats["spill.role_reversals"] = (
+                ctx.stats.get("spill.role_reversals", 0) + 1)
+            _scan_metrics.record("spill_role_reversals", 1)
+            if ctx.tracer.enabled:
+                t = time.time()
+                ctx.tracer.record(
+                    "spill_role_reversal", "spill_role_reversal", t, t,
+                    node=type(node).__name__, partition=int(p),
+                    build_bytes=int(pb), probe_bytes=int(bb))
+            build_sp, probe_sp = psp, bsp
+            jnode, jkey = _reversed_join_shim(node), "spill_rev_"
+        else:
+            build_sp, probe_sp = bsp, psp
+            jnode, jkey = node, "spill_"
+
+        st["partitions"] += 1
+        st["depth"] = max(st["depth"], bsp.depth)
+        build_in = _collect_concat(build_sp.read_partition(p))
+        if build_in is None and node.kind == "inner":
+            return
+        # account the materialized bucket — a skewed partition that
+        # exceeds the pool limit must fail cleanly, not OOM silently
+        if build_in is not None:
+            mctx.set_bytes(batch_device_bytes(build_in))
+        out = _join_probe(jnode, ctx, build_in,
+                          probe_sp.read_partition(p), ident, jkey=jkey)
+        if reversed_:
+            for ob in out:
+                yield _reorder_output(ob, out_names)
+        else:
+            yield from out
+        mctx.set_bytes(0)
+
+    for p in range(bspiller.n_partitions):
+        yield from replay_leaf(bspiller, pspiller, p)
 
 
 def _execute_index_join(node, ctx: ExecContext) -> Iterator[Batch]:
@@ -4836,25 +5247,31 @@ def _mark_fragment_fusion(root: PlanNode, config: ExecConfig) -> None:
 
 def run_plan(qp: QueryPlan, ctx: ExecContext) -> Batch:
     """Execute a QueryPlan to a single host-collectable Batch."""
-    with _obs_trace.use(ctx.tracer), ctx.tracer.span("query", "query"):
-        if getattr(ctx.config, "devprof", "off") != "on":
-            return _run_plan_inner(qp, ctx)
-        # devprof plane: HBM watermarks at the query span boundaries plus
-        # a ledger-vs-device reconciliation once the query's pool peak is
-        # final (obs/devprof.py; activate happens at plan install)
-        from presto_tpu.obs import devprof as _devprof
+    try:
+        with _obs_trace.use(ctx.tracer), ctx.tracer.span("query", "query"):
+            if getattr(ctx.config, "devprof", "off") != "on":
+                return _run_plan_inner(qp, ctx)
+            # devprof plane: HBM watermarks at the query span boundaries
+            # plus a ledger-vs-device reconciliation once the query's pool
+            # peak is final (obs/devprof.py; activate happens at plan
+            # install)
+            from presto_tpu.obs import devprof as _devprof
 
-        _devprof.activate()
-        _devprof.sample_hbm(tag="query_start")
-        try:
-            return _run_plan_inner(qp, ctx)
-        finally:
-            _devprof.sample_hbm(tag="query_end")
+            _devprof.activate()
+            _devprof.sample_hbm(tag="query_start")
             try:
-                _devprof.reconcile(ctx.memory_pool, plane="worker",
-                                   site="local_query")
-            except Exception:
-                pass
+                return _run_plan_inner(qp, ctx)
+            finally:
+                _devprof.sample_hbm(tag="query_end")
+                try:
+                    _devprof.reconcile(ctx.memory_pool, plane="worker",
+                                       site="local_query")
+                except Exception:
+                    pass
+    finally:
+        # spill-file leak guard: whatever the operator generators left
+        # open (mid-spill failure, abandoned iterator) is closed+unlinked
+        ctx.cleanup_spill()
 
 
 def _run_plan_inner(qp: QueryPlan, ctx: ExecContext) -> Batch:

@@ -103,6 +103,21 @@ class MemoryPool:
         except Exception:
             pass
 
+    def request_revoke(self, want_bytes: int = 0) -> int:
+        """Out-of-band revoke signal (MemoryRevokingScheduler's
+        requestMemoryRevoking, as opposed to the reserve()-inline path):
+        ask every registered revocable-state owner to shed state. Flag-based
+        revokers mark themselves and spill at their next batch boundary.
+        Returns the number of revokers signaled."""
+        with self._lock:
+            revokers = list(self._revokers)
+        for fn in revokers:
+            try:
+                fn(int(want_bytes))
+            except Exception:
+                pass
+        return len(revokers)
+
     def free(self, bytes_: int) -> None:
         if bytes_ <= 0:
             return

@@ -76,6 +76,9 @@ class SlowQueryLogger:
         kills: List[dict] = []
         replays = 0
         boosts = 0
+        spill_repartitions = 0
+        spill_revokes = 0
+        spill_reversals = 0
         if spans:
             closed = [s for s in spans if s.end is not None]
             closed.sort(key=lambda s: s.duration_s, reverse=True)
@@ -111,6 +114,15 @@ class SlowQueryLogger:
                 elif s.kind == "memory_kill":
                     kills.append({"reason": a.get("reason"),
                                   "forensics": a.get("forensics")})
+                elif s.kind == "spill_repartition":
+                    # dynamic hybrid hash plane: a slow query that spent
+                    # its time splitting skewed spill partitions says so
+                    # from the log alone
+                    spill_repartitions += 1
+                elif s.kind == "spill_revoke":
+                    spill_revokes += 1
+                elif s.kind == "spill_role_reversal":
+                    spill_reversals += 1
         rec = {
             "event": "queryCompleted",
             "ts": time.time(),
@@ -134,6 +146,10 @@ class SlowQueryLogger:
             rec["memoryRevokedBytes"] = revoked_bytes
         if kills:
             rec["memoryKills"] = kills
+        if spill_repartitions or spill_revokes or spill_reversals:
+            rec["spill"] = {"repartitions": spill_repartitions,
+                            "revocations": spill_revokes,
+                            "roleReversals": spill_reversals}
         if memory:
             # peak/footprint fields from the devprof memory rollup
             rec["memory"] = memory

@@ -142,10 +142,17 @@ LEDGER_DRIFT = Histogram(
     "self-reported peak (obs/devprof.py reconciliation; 1.0 = the "
     "accounting matches the hardware, labeled by reconciliation site)",
     log_buckets(0.01, 100.0))
+SPILLED_BYTES = Histogram(
+    "presto_tpu_spilled_bytes",
+    "bytes written to host spill per spilling operator (hybrid hash "
+    "join builds/probes and grace-agg partitions, labeled by operator "
+    "side; heavy right tails mean partition budgets are mis-sized)",
+    log_buckets(1024.0, 1e12))
 
 ALL_HISTOGRAMS: Tuple[Histogram, ...] = (
     QUERY_LATENCY, TASK_SCHEDULE_DELAY, BATCH_KERNEL_WALL, EXCHANGE_WAIT,
-    RADIX_PARTITION_ROWS, COMPILE_TRACE_WALL, STATS_DRIFT, LEDGER_DRIFT)
+    RADIX_PARTITION_ROWS, COMPILE_TRACE_WALL, STATS_DRIFT, LEDGER_DRIFT,
+    SPILLED_BYTES)
 
 
 def render_histograms(plane: str) -> str:

@@ -488,6 +488,16 @@ def plan_to_string(node: PlanNode, indent: int = 0, node_stats=None,
         if est and actual:
             s += (f"   [est={est:.3g} actual={actual:.3g} "
                   f"drift={actual / est:.2g}x]")
+    sp = node.__dict__.get("_spill_stats")
+    if sp is not None and (sp.get("partitions") or sp.get("repartitions")
+                           or sp.get("revocations")):
+        # dynamic hybrid hash spill shape stamped by exec/runtime.py's
+        # spill drivers: final leaf count, next-hash-bits splits, max
+        # recursion depth, role reversals, pool-pressure revocations
+        s += (f"   [spill: P={sp['partitions']} "
+              f"repartitions={sp['repartitions']} depth={sp['depth']} "
+              f"reversed={sp['reversed']} revoked={sp['revocations']} "
+              f"bytes={sp['bytes']}]")
     frag = node.__dict__.get("_fragment_fusion")
     if frag is not None:
         fs = node.__dict__.get("_fragment_stats")

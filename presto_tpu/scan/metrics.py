@@ -36,6 +36,12 @@ _DISPATCH_COUNTER_NAMES = (
     # regrow / fanout-widening replay a breaker executed — the direct cost
     # of estimate error that HBO correction exists to eliminate
     "breaker_replay_waves",
+    # dynamic hybrid hash spill plane (spiller.py + exec/runtime.py):
+    # partition-tree leaves created, next-hash-bits repartition events,
+    # per-partition build/probe role reversals, and pool-pressure
+    # revocations honored by spillable operators
+    "spill_partitions", "spill_repartitions", "spill_role_reversals",
+    "spill_revocations",
 )
 
 _HELP = {
@@ -82,6 +88,18 @@ _HELP = {
         "overflow-replay waves executed by pipeline breakers (capacity "
         "regrows and join fanout widenings) — the runtime cost of "
         "estimate error, driven to zero by hbo=correct on warm structures",
+    "spill_partitions":
+        "spill partition-tree leaves finalized by hybrid hash join/agg "
+        "replays (the dynamic partition count actually used)",
+    "spill_repartitions":
+        "next-hash-bits repartition events: a spill partition outgrew its "
+        "budget mid-build or at replay and split into a child spiller",
+    "spill_role_reversals":
+        "spilled join partitions replayed with build/probe roles reversed "
+        "because the nominal build side turned out larger",
+    "spill_revocations":
+        "memory-pool revoke requests honored by spillable operator state "
+        "(accumulators / join builds spilled down at a batch boundary)",
 }
 
 _lock = threading.Lock()

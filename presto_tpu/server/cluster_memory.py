@@ -100,6 +100,13 @@ class ClusterMemoryManager:
         # cached-result bytes count toward cluster pressure and are
         # revoked BEFORE any query is killed
         self.result_cache = None
+        # spillable-state hook: a callable () -> int that asks every
+        # worker to revoke spillable operator state (join builds / agg
+        # accumulators spill to disk at their next batch boundary) and
+        # returns how many revokers were signaled. Second rung of the
+        # revoke-before-kill ladder, after the free cache drop.
+        self.spill_revoker = None
+        self._spill_revoked_episode = False  # shared: guarded-by(self._lock)
 
     # -- ingest (called from the heartbeat prober) -------------------------
 
@@ -276,6 +283,19 @@ class ClusterMemoryManager:
         except Exception:
             pass
 
+    @staticmethod
+    def _emit_event(kind: str, query_id: Optional[str] = None,
+                    **attrs) -> None:
+        """Ladder stages onto the unified /v1/events feed — the revoke
+        order (cache → spillable state → kill) is auditable from the
+        stream. Best-effort by contract."""
+        try:
+            from presto_tpu.obs.events import EVENTS
+
+            EVENTS.emit(kind, query_id=query_id, **attrs)
+        except Exception:
+            pass
+
     def enforce(self, query_manager) -> Optional[str]:
         """One enforcement pass (call on the heartbeat cadence). Returns
         the killed query id, if any."""
@@ -297,6 +317,7 @@ class ClusterMemoryManager:
             now = time.monotonic()
             if not under_pressure:
                 self._pressure_since = None
+                self._spill_revoked_episode = False
                 return None
             if self._pressure_since is None:
                 self._pressure_since = now
@@ -320,6 +341,31 @@ class ClusterMemoryManager:
                 with self._lock:
                     self._pressure_since = None
                 return None
+        # second rung: ask workers to revoke SPILLABLE OPERATOR STATE —
+        # hybrid hash join builds and grace-agg accumulators move to disk
+        # at their next batch boundary, which is graceful degradation, not
+        # a failed query. One shot per pressure episode: a workload that
+        # cannot actually shed state must not postpone the kill forever.
+        sr = self.spill_revoker
+        if sr is not None:
+            with self._lock:
+                already = self._spill_revoked_episode
+                self._spill_revoked_episode = True
+            if not already:
+                try:
+                    signaled = int(sr())
+                except Exception:
+                    signaled = 0
+                if signaled > 0:
+                    self._emit_event("spill_revoke_requested",
+                                     revokers=signaled,
+                                     totalReservedBytes=int(total),
+                                     blockedNodes=list(blocked))
+                    with self._lock:
+                        # give the revokers one kill_delay_s worth of
+                        # heartbeats to actually spill before re-arming
+                        self._pressure_since = None
+                    return None
         # kill accounting happens only on a CONFIRMED kill: a stale victim
         # (worker still reporting a finished query) must not reset the
         # pressure timer or count as a kill — fall through to the next hog
@@ -332,6 +378,9 @@ class ClusterMemoryManager:
                 continue
             forensics = self._dump_forensics(victim, nodes, total, blocked)
             self._trace_kill(victim, forensics, total, blocked)
+            self._emit_event("low_memory_kill", query_id=victim,
+                             totalReservedBytes=int(total),
+                             blockedNodes=list(blocked))
             qe.fail(
                 "Query killed because the cluster is out of memory. "
                 "Please try again in a few minutes.",
@@ -339,6 +388,7 @@ class ClusterMemoryManager:
             )
             with self._lock:
                 self._pressure_since = None
+                self._spill_revoked_episode = False
                 self.kills += 1
             return victim
         return None

@@ -747,6 +747,12 @@ class Coordinator:
 
         self.result_cache = _result_cache.CACHE
         self.cluster_memory.result_cache = self.result_cache
+        # revoke-before-kill ladder, second rung: under sustained pressure
+        # the manager asks every active worker to revoke spillable operator
+        # state (join builds / agg accumulators spill at their next batch
+        # boundary) before killing anything
+        self.cluster_memory.spill_revoker = self._revoke_spillable_state
+        self._cluster_secret = cluster_secret
         self.failure_detector = HeartbeatFailureDetector(
             self.node_manager, cluster_memory=self.cluster_memory)
         self.size_monitor = ClusterSizeMonitor(self.node_manager, min_workers)
@@ -1329,6 +1335,26 @@ class Coordinator:
         return Batch(["rows"], [BIGINT],
                      [Column(jnp.asarray(vals), None)],
                      jnp.asarray(live), {})
+
+    def _revoke_spillable_state(self) -> int:
+        """POST /v1/memory/revoke on every active worker: spillable
+        operator state (hybrid hash join builds, grace-agg accumulators)
+        flags itself and spills at the next batch boundary. Returns how
+        many revokers were signaled cluster-wide."""
+        signaled = 0
+        for n in self.node_manager.active_nodes():
+            try:
+                req = urllib.request.Request(
+                    f"{n.uri}/v1/memory/revoke", data=b"{}", method="POST")
+                if self._cluster_secret is not None:
+                    req.add_header("X-Presto-Cluster-Secret",
+                                   self._cluster_secret)
+                with urllib.request.urlopen(req, timeout=3) as r:
+                    doc = json.loads(r.read())
+                signaled += int(doc.get("revokersSignaled") or 0)
+            except Exception:
+                continue
+        return signaled
 
     def _probe_and_exclude(self, n: NodeInfo):
         """One-node version of _reprobe_workers, called when task placement

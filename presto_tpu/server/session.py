@@ -179,6 +179,15 @@ class SystemSessionProperties:
                              "Pool fraction that triggers revocation", float, 0.9),
             PropertyMetadata("memory_revoking_target",
                              "Pool fraction revocation aims for", float, 0.5),
+            # dynamic hybrid hash spill (spiller.py recursive repartitioning)
+            PropertyMetadata("spill_max_depth",
+                             "Recursive-repartition depth bound for spilled "
+                             "hybrid hash joins/aggregations",
+                             int, 4, validator=_positive("spill_max_depth")),
+            PropertyMetadata("spill_dir_budget_mb",
+                             "Live-byte budget for the worker spill "
+                             "directory (0 = unbounded)",
+                             int, 0, validator=_nonneg("spill_dir_budget_mb")),
             # planner
             PropertyMetadata("optimize_plan", "Run optimizer passes", bool, True),
             PropertyMetadata("execution_policy", "all-at-once | phased", str,
@@ -393,6 +402,10 @@ class Session:
             radix_partitions=self.get("radix_partitions"),
             join_spill_budget_bytes=(self.get("join_spill_budget_bytes")
                                      or None),
+            spill_max_depth=self.get("spill_max_depth"),
+            spill_dir_budget_bytes=(
+                self.get("spill_dir_budget_mb") * (1 << 20)
+                if self.get("spill_dir_budget_mb") else None),
             donate_stepping=self.get("donate_stepping"),
             precompile_workers=self.get("precompile_workers"),
             max_compiled_shapes_scan=(self.get("max_compiled_shapes_scan")

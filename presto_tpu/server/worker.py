@@ -279,6 +279,14 @@ class TaskExecution:
         ctx = ExecContext(self.catalog, cfg,
                           memory_pool=self.memory_pool,
                           spill_manager=self.spill_manager)
+        try:
+            self._run_with_ctx(cfg, ctx)
+        finally:
+            # spill-file leak guard: a task that failed or was canceled
+            # mid-spill must not strand spill files on the worker's disk
+            ctx.cleanup_spill()
+
+    def _run_with_ctx(self, cfg: ExecConfig, ctx: ExecContext):
         ctx.tracer = self.tracer
         ctx.task_index = self.update.task_index
         ctx.n_tasks = self.update.n_tasks
@@ -683,6 +691,13 @@ class Worker:
                         m.group(1), update,
                         trace_token=self.headers.get(_obs_trace.TRACE_HEADER))
                     return self._json(info)
+                if self.path == "/v1/memory/revoke":
+                    # cluster ladder rung: the coordinator asks this node's
+                    # spillable operator state to move to disk before any
+                    # query gets killed for memory
+                    if not self._authorized():
+                        return self._json({"error": "unauthorized"}, 403)
+                    return self._json(worker.revoke_spillable())
                 self._json({"error": "not found"}, 404)
 
             def do_GET(self):
@@ -800,6 +815,15 @@ class Worker:
                 target=self._announce_loop, args=(coordinator_url,), daemon=True
             )
             self._announce_thread.start()
+
+    def revoke_spillable(self) -> dict:
+        """Signal every revocable-state owner on this node's pool (hybrid
+        hash join builds, grace-agg accumulators): each flags itself and
+        spills at its next batch boundary. The out-of-band half of the
+        memory contract — reserve()-inline revoking handles local pressure,
+        this handles CLUSTER pressure relayed by the coordinator."""
+        n = self.memory_pool.request_revoke()
+        return {"nodeId": self.node_id, "revokersSignaled": n}
 
     def status(self) -> dict:
         tasks = self.task_manager.tasks

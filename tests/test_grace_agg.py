@@ -42,12 +42,18 @@ SQL = ("select g, count(*) as c, sum(x) as sx, min(f) as mn, max(s) as mx "
        "from t group by g")
 
 
+_BASELINE = {}
+
+
 def _baseline(cat):
-    # big ceiling: the plain in-memory table path
-    r = LocalRunner(cat, ExecConfig(batch_rows=1 << 12,
-                                    agg_capacity=1 << 14,
-                                    agg_cap_ceiling=1 << 22))
-    return r.run(SQL).sort_values("g", ignore_index=True)
+    # big ceiling: the plain in-memory table path. Memoized per catalog —
+    # every caller reads the same immutable answer, no point re-running.
+    if id(cat) not in _BASELINE:
+        r = LocalRunner(cat, ExecConfig(batch_rows=1 << 12,
+                                        agg_capacity=1 << 14,
+                                        agg_cap_ceiling=1 << 22))
+        _BASELINE[id(cat)] = r.run(SQL).sort_values("g", ignore_index=True)
+    return _BASELINE[id(cat)]
 
 
 def _check(df, base):
@@ -183,3 +189,69 @@ def test_grace_distributed_with_pool(cat):
                      memory_pool_bytes=32_000_000)
     with DistributedRunner(cat, n_workers=2, config=cfg) as dist:
         _check(dist.run(SQL), base)
+
+
+# ---- PR 15: dynamic hybrid hash — skew-adversarial grace matrix --------
+
+
+def test_grace_recursive_repartition_high_ndv(cat):
+    """A spilled partition whose group count still exceeds the grace
+    ceiling at finalize must split by the NEXT hash bits and recurse
+    (dynamic hybrid hash), not fail or grow an oversized table: with
+    ~2250 groups per partition against a 512 ceiling, repartition waves
+    are mandatory — and the answer must still match. Deliberately the
+    exact config of test_grace_from_start_matches_baseline so every
+    program comes out of the shared structural cache; this test adds
+    only the stats assertion and the replayed exec."""
+    from presto_tpu.exec.runtime import ExecContext, run_plan
+
+    base = _baseline(cat)
+    r = LocalRunner(cat, ExecConfig(batch_rows=1 << 12,
+                                    agg_capacity=1 << 8,
+                                    agg_cap_ceiling=1 << 9,
+                                    spill_partitions=4))
+    qp = r.plan(SQL)
+    ctx = ExecContext(cat, r.config)
+    got = run_plan(qp, ctx).to_pandas()
+    assert ctx.stats.get("spill.repartitions", 0) > 0, \
+        "finalize never recursively repartitioned"
+    _check(got, base)
+
+
+def test_grace_depth_bound_fails_structured(cat):
+    """spill_max_depth=0 forbids recursive repartitioning: a partition
+    over the grace ceiling must fail with a structured
+    SPILL_LIMIT_EXCEEDED, not loop or silently grow past the ceiling."""
+    from presto_tpu.spiller import SpillLimitExceeded
+
+    r = LocalRunner(cat, ExecConfig(
+        batch_rows=1 << 12, agg_capacity=1 << 8, agg_cap_ceiling=1 << 9,
+        spill_partitions=4, spill_max_depth=0))
+    with pytest.raises(SpillLimitExceeded, match="grace ceiling"):
+        r.run(SQL)
+
+
+def test_grace_one_hot_group_skew(cat):
+    """One-hot skew: 95% of rows share ONE group, the tail spreads over
+    39 more — the hot group concentrates in one spill partition (low NDV
+    there, huge row count) while several partitions land zero rows; both
+    extremes must finalize cleanly and match the in-memory answer."""
+    rng = np.random.default_rng(31)
+    conn = cat.connectors["m"]
+    n = 30_000
+    g = np.where(rng.random(n) < 0.95, 7, rng.integers(0, 40, n))
+    conn.add_table("sk", pd.DataFrame({
+        "g": g.astype(np.int64), "v": rng.integers(0, 1000, n)}))
+    q = "select g, count(*) as c, sum(v) as s from sk group by g"
+    big = LocalRunner(cat, ExecConfig(batch_rows=1 << 12,
+                                      agg_capacity=1 << 13,
+                                      agg_cap_ceiling=1 << 22))
+    grace = LocalRunner(cat, ExecConfig(batch_rows=1 << 12,
+                                        agg_capacity=1 << 4,
+                                        agg_cap_ceiling=1 << 4,
+                                        spill_partitions=16))
+    a = grace.run(q).sort_values("g", ignore_index=True)
+    b = big.run(q).sort_values("g", ignore_index=True)
+    assert a.g.tolist() == b.g.tolist()
+    assert a.c.tolist() == b.c.tolist()
+    assert a.s.tolist() == b.s.tolist()
