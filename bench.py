@@ -49,9 +49,11 @@ Env knobs:
 
 import json
 import os
+import shutil
 import signal
 import subprocess
 import sys
+import tempfile
 import time
 
 _T0 = time.time()
@@ -672,6 +674,110 @@ def _spill_child(n_rows: int):
     }), flush=True)
 
 
+def _compile_tail_child(mode: str):
+    """One serving boot + first-seen-query measurement (PR16 compile
+    farm A/B). The parent sequences four of these against one cache dir:
+    cold (no farm), record (corpus + artifacts), converge (boot #1 — the
+    HBO-informed plan fingerprints settle and their programs persist),
+    armed (boot #2 — every artifact prewarmed, first query should pay
+    neither trace nor backend compile)."""
+    if os.environ.get("BENCH_FORCE_CPU"):
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    import urllib.request
+
+    from presto_tpu.catalog.tpch import tpch_catalog
+    from presto_tpu.exec import farm, programs
+    from presto_tpu.server.coordinator import DistributedRunner
+
+    agg = ("select l_returnflag as f, sum(l_quantity) as q, count(*) as c "
+           "from lineitem where l_discount > 0.02 "
+           "group by l_returnflag order by f")
+    join = ("select o_orderpriority as p, count(*) as c from lineitem "
+            "join orders on l_orderkey = o_orderkey "
+            "group by o_orderpriority order by p")
+
+    cat = tpch_catalog(0.01)
+    t0 = time.perf_counter()
+    dr = DistributedRunner(cat, n_workers=2)
+    boot_s = time.perf_counter() - t0
+    base = dr.coordinator.url
+
+    def run_sql(s):
+        req = urllib.request.Request(
+            base + "/v1/statement", data=s.encode(),
+            headers={"X-Presto-User": "bench",
+                     "Content-Type": "text/plain"})
+        doc = json.load(urllib.request.urlopen(req, timeout=300))
+        while doc.get("nextUri"):
+            doc = json.load(urllib.request.urlopen(doc["nextUri"],
+                                                   timeout=300))
+
+    t0 = time.perf_counter()
+    run_sql(agg)
+    first_agg_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    run_sql(join)
+    first_join_s = time.perf_counter() - t0
+    if mode in ("record", "converge"):
+        farm.drain()  # async artifact persists must land before exit
+    snap = programs.snapshot()
+    armed = getattr(dr.coordinator, "_farm_armed", 0)
+    dr.close()
+    print(json.dumps({
+        "mode": mode, "boot_s": round(boot_s, 3),
+        "first_agg_s": round(first_agg_s, 3),
+        "first_join_s": round(first_join_s, 3),
+        "compiles": int(snap["compiles"]),
+        "restored": int(snap["restored"]),
+        "prewarmed": int(snap["prewarmed"]), "armed": int(armed),
+    }), flush=True)
+
+
+def _run_compile_tail(extra: dict, remaining: float):
+    """Cold-boot vs farm-armed-boot A/B (BENCH_NOTES round 16): serving
+    warmup_s and first-query e2e, four child processes, one cache dir."""
+    d = tempfile.mkdtemp(prefix="bench_farm_")
+    rec = {}
+    try:
+        for mode in ("cold", "record", "converge", "armed"):
+            env = dict(os.environ)
+            for k in ("PRESTO_TPU_FARM", "PRESTO_TPU_PROGRAM_PERSIST",
+                      "PRESTO_TPU_CACHE_DIR"):
+                env.pop(k, None)
+            if mode != "cold":
+                env.update(PRESTO_TPU_CACHE_DIR=d, PRESTO_TPU_FARM="1",
+                           PRESTO_TPU_PROGRAM_PERSIST="1")
+            p = subprocess.run(
+                [sys.executable, os.path.abspath(__file__),
+                 "--compile-tail-child", mode],
+                env=env, stdout=subprocess.PIPE,
+                timeout=min(900, max(180, remaining - 15)))
+            lines = p.stdout.decode().strip().splitlines()
+            if p.returncode != 0 or not lines:
+                rec[mode] = {"error": f"child rc={p.returncode}"}
+                continue
+            rec[mode] = json.loads(lines[-1])
+        cold, armed = rec.get("cold", {}), rec.get("armed", {})
+        if "first_agg_s" in cold and "first_agg_s" in armed:
+            rec["first_query_speedup"] = round(
+                cold["first_agg_s"] / max(armed["first_agg_s"], 1e-9), 2)
+            rec["armed_onpath_compiles"] = armed["compiles"]
+            _log(f"compile_tail: first query {cold['first_agg_s']}s cold "
+                 f"vs {armed['first_agg_s']}s farm-armed "
+                 f"({rec['first_query_speedup']}x; armed boot "
+                 f"{armed['boot_s']}s prewarmed {armed['prewarmed']} "
+                 f"artifacts, {armed['compiles']} on-path compiles)")
+        extra["compile_tail"] = rec
+    except subprocess.TimeoutExpired:
+        extra["compile_tail"] = {"error": "timeout", **rec}
+    except Exception as e:
+        extra["compile_tail"] = {"error": f"{type(e).__name__}: {e}"}
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+
+
 def _run_spill_skew(extra: dict, remaining: float):
     """Skew-adversarial spill bench (see BENCH_NOTES.md round 15): the
     graceful-degradation price of a join that cannot fit memory."""
@@ -889,6 +995,9 @@ def main():
     if len(sys.argv) >= 3 and sys.argv[1] == "--spill-child":
         _spill_child(int(sys.argv[2]))
         return
+    if len(sys.argv) >= 3 and sys.argv[1] == "--compile-tail-child":
+        _compile_tail_child(sys.argv[2])
+        return
 
     signal.signal(signal.SIGTERM, _on_term)
     signal.signal(signal.SIGINT, _on_term)
@@ -906,7 +1015,7 @@ def main():
     wanted = os.environ.get(
         "BENCH_CONFIGS", "q1_sf1,q1_nofuse_sf1,q6_sf10,q3_sf10,join_sf1,"
         "groupby_engine_ab_sf1,groupby_engine_ab_sort_sf1,mesh_scaling,"
-        "serving_slo,serving_slo_cached,spill_skew,q9,q64"
+        "serving_slo,serving_slo_cached,spill_skew,compile_tail,q9,q64"
     ).split(",")
 
     for name in (w.strip() for w in wanted):
@@ -953,6 +1062,17 @@ def main():
                 if not device_ok:
                     os.environ["BENCH_FORCE_CPU"] = "1"
                 _run_spill_skew(extra, remaining)
+            _checkpoint()
+            continue
+        if name == "compile_tail":
+            remaining = budget - (time.time() - _T0)
+            if remaining < 240:
+                _log("compile_tail: SKIPPED (budget exhausted)")
+                extra["compile_tail"] = {"skipped": "budget"}
+            else:
+                if not device_ok:
+                    os.environ["BENCH_FORCE_CPU"] = "1"
+                _run_compile_tail(extra, remaining)
             _checkpoint()
             continue
         if name not in _CONFIGS:

@@ -870,6 +870,191 @@ if [ "$rc" -ne 0 ]; then
   exit "$rc"
 fi
 
+# Compile-tail smoke: three processes against ONE cache dir.
+#   1. record — distributed traffic populates the farm corpus + persisted
+#      program artifacts (plus: shape_bucketing off vs pow2 bit-identical).
+#   2. boot #1 — coordinator pre-arms from the corpus; the first armed
+#      boot still compiles the HBO-converged program set (phase 1's
+#      observed cardinalities shift accumulator capacities, so plan
+#      fingerprints move once) and persists it.
+#   3. boot #2 — pre-arms >0 programs, prewarns every artifact, and a
+#      FIRST-SEEN query of a pre-armed fingerprint must run with zero
+#      on-path compiles and a ~zero lifecycle compile segment (vs ~8 s
+#      without the boot prewarm), with EXPLAIN ANALYZE showing
+#      "[farm: armed]".
+echo "== compile-tail smoke: farm-armed boot + zero on-path compiles =="
+tmp_farm="$(mktemp -d)"
+env JAX_PLATFORMS=cpu PRESTO_TPU_CACHE_DIR="$tmp_farm" \
+    PRESTO_TPU_FARM=1 PRESTO_TPU_PROGRAM_PERSIST=1 python - <<'PYEOF'
+import json, os, urllib.request
+
+from presto_tpu.catalog.tpch import tpch_catalog
+from presto_tpu.exec import ExecConfig, LocalRunner, farm
+from presto_tpu.server.coordinator import DistributedRunner
+
+cat = tpch_catalog(0.01)
+dr = DistributedRunner(cat, n_workers=2)
+base = dr.coordinator.url
+
+AGG = ("select l_returnflag as f, sum(l_quantity) as q, count(*) as c "
+       "from lineitem where l_discount > 0.02 "
+       "group by l_returnflag order by f")
+JOIN = ("select o_orderpriority as p, count(*) as c from lineitem "
+        "join orders on l_orderkey = o_orderkey "
+        "group by o_orderpriority order by p")
+
+
+def run_sql(sql):
+    headers = {"X-Presto-User": "smoke", "Content-Type": "text/plain"}
+    req = urllib.request.Request(base + "/v1/statement",
+                                 data=sql.encode(), headers=headers)
+    doc = json.load(urllib.request.urlopen(req, timeout=120))
+    rows = []
+    while True:
+        rows += doc.get("data") or []
+        nxt = doc.get("nextUri")
+        if not nxt:
+            break
+        doc = json.load(urllib.request.urlopen(nxt, timeout=120))
+    return rows
+
+
+for sql in (AGG, JOIN):
+    assert run_sql(sql), sql
+farm.drain()
+dr.close()
+corpus = farm.load_corpus()
+assert corpus["plans"], "no plans recorded in the farm corpus"
+pdir = os.path.join(os.environ["PRESTO_TPU_CACHE_DIR"], "programs")
+arts = os.listdir(pdir) if os.path.isdir(pdir) else []
+assert arts, "no program artifacts persisted"
+
+# bucketing satellite: pow2 padding must never change a result
+r_off = LocalRunner(cat, ExecConfig(shape_bucketing="off"))
+r_on = LocalRunner(cat, ExecConfig(shape_bucketing="pow2"))
+for sql in (AGG, JOIN):
+    assert r_off.run(sql).equals(r_on.run(sql)), \
+        f"bucketing diverged: {sql}"
+print(f"record OK: {len(corpus['plans'])} plans, {len(arts)} artifacts, "
+      f"bucketing off==pow2")
+PYEOF
+rc=$?
+if [ "$rc" -eq 0 ]; then
+env JAX_PLATFORMS=cpu PRESTO_TPU_CACHE_DIR="$tmp_farm" \
+    PRESTO_TPU_FARM=1 PRESTO_TPU_PROGRAM_PERSIST=1 python - <<'PYEOF'
+import json, urllib.request
+
+from presto_tpu.catalog.tpch import tpch_catalog
+from presto_tpu.exec import farm, programs
+from presto_tpu.server.coordinator import DistributedRunner
+
+cat = tpch_catalog(0.01)
+dr = DistributedRunner(cat, n_workers=2)
+armed = dr.coordinator._farm_armed
+assert armed > 0, f"boot #1 armed nothing ({armed})"
+base = dr.coordinator.url
+
+AGG = ("select l_returnflag as f, sum(l_quantity) as q, count(*) as c "
+       "from lineitem where l_discount > 0.02 "
+       "group by l_returnflag order by f")
+JOIN = ("select o_orderpriority as p, count(*) as c from lineitem "
+        "join orders on l_orderkey = o_orderkey "
+        "group by o_orderpriority order by p")
+
+
+def run_sql(sql):
+    headers = {"X-Presto-User": "smoke", "Content-Type": "text/plain"}
+    req = urllib.request.Request(base + "/v1/statement",
+                                 data=sql.encode(), headers=headers)
+    doc = json.load(urllib.request.urlopen(req, timeout=120))
+    rows = []
+    while True:
+        rows += doc.get("data") or []
+        nxt = doc.get("nextUri")
+        if not nxt:
+            break
+        doc = json.load(urllib.request.urlopen(nxt, timeout=120))
+    return rows
+
+
+for sql in (AGG, JOIN):
+    assert run_sql(sql), sql
+farm.drain()
+dr.close()
+print(f"boot #1 OK: armed={armed} "
+      f"converge_compiles={programs.snapshot()['compiles']}")
+PYEOF
+rc=$?
+fi
+if [ "$rc" -eq 0 ]; then
+env JAX_PLATFORMS=cpu PRESTO_TPU_CACHE_DIR="$tmp_farm" \
+    PRESTO_TPU_FARM=1 PRESTO_TPU_PROGRAM_PERSIST=1 python - <<'PYEOF'
+import json, urllib.request
+
+from presto_tpu.catalog.tpch import tpch_catalog
+from presto_tpu.exec import programs
+from presto_tpu.obs import lifecycle
+from presto_tpu.server.coordinator import DistributedRunner
+
+cat = tpch_catalog(0.01)
+dr = DistributedRunner(cat, n_workers=2)
+armed = dr.coordinator._farm_armed
+assert armed > 0, f"boot #2 armed nothing ({armed})"
+base = dr.coordinator.url
+
+AGG = ("select l_returnflag as f, sum(l_quantity) as q, count(*) as c "
+       "from lineitem where l_discount > 0.02 "
+       "group by l_returnflag order by f")
+JOIN = ("select o_orderpriority as p, count(*) as c from lineitem "
+        "join orders on l_orderkey = o_orderkey "
+        "group by o_orderpriority order by p")
+
+
+def run_sql(sql):
+    headers = {"X-Presto-User": "smoke", "Content-Type": "text/plain"}
+    req = urllib.request.Request(base + "/v1/statement",
+                                 data=sql.encode(), headers=headers)
+    doc = json.load(urllib.request.urlopen(req, timeout=120))
+    qid, rows = doc["id"], []
+    while True:
+        rows += doc.get("data") or []
+        nxt = doc.get("nextUri")
+        if not nxt:
+            break
+        doc = json.load(urllib.request.urlopen(nxt, timeout=120))
+    return qid, rows
+
+
+c0 = programs.snapshot()["compiles"]
+qid, rows = run_sql(AGG)
+c1 = programs.snapshot()["compiles"]
+assert rows
+assert c1 == c0, f"first-seen AGG compiled {c1 - c0} on-path"
+seg = lifecycle.get(qid).timeline.segments()
+assert seg.get("compile", 0.0) < 1.5, \
+    f"compile segment not ~0 on a farm-armed boot: {seg}"
+_, rj = run_sql(JOIN)
+c2 = programs.snapshot()["compiles"]
+assert rj
+assert c2 == c1, f"first-seen JOIN compiled {c2 - c1} on-path"
+_, out = run_sql("explain analyze " + AGG)
+text = "\n".join(str(r[0]) for r in out if r)
+assert "[farm: armed]" in text, text[:400]
+snap = programs.snapshot()
+dr.close()
+print(f"boot #2 OK: armed={armed} prewarmed={snap['prewarmed']} "
+      f"restored={snap['restored']} on-path compiles 0, "
+      f"compile segment {seg['compile']:.2f}s, EXPLAIN shows "
+      f"[farm: armed]")
+PYEOF
+rc=$?
+fi
+rm -rf "$tmp_farm"
+if [ "$rc" -ne 0 ]; then
+  echo "compile-tail smoke FAILED (exit $rc)"
+  exit "$rc"
+fi
+
 # Static-analysis step: the kernel lint must be clean over the shipped
 # tree, the analyzer must actually FAIL on an injected violation (a
 # linter that can't fail is decoration), the plan-invariant checker must

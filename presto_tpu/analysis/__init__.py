@@ -36,6 +36,7 @@ from presto_tpu.analysis.recompile import (
     DEFAULT_SHAPE_BUDGET,
     RecompileBudgetError,
     check_recompiles,
+    distinct_shapes,
     enforce,
     iter_jit_stats,
 )
@@ -57,6 +58,7 @@ __all__ = [
     "DEFAULT_SHAPE_BUDGET",
     "RecompileBudgetError",
     "check_recompiles",
+    "distinct_shapes",
     "enforce",
     "iter_jit_stats",
 ]

@@ -61,15 +61,30 @@ class RecompileBudgetError(RuntimeError):
             f"compiled-shape budget exceeded:\n{lines}")
 
 
+def distinct_shapes(stats: dict) -> int:
+    """Distinct compiled input shapes for one program's stats dict. The
+    program cache records the post-bucketing avals signature of every
+    compile event (exec/programs.py ``wrap``), so under shape bucketing a
+    bucket charges the budget ONCE no matter how many raw avals rounded
+    into it — and a shared-entry re-creation replaying an already-seen
+    shape doesn't double-charge either. Stats dicts predating the
+    signature record fall back to the raw compile-event count (identical
+    when every compile is a fresh shape, which is the unbucketed norm)."""
+    shapes = stats.get("shapes")
+    if isinstance(shapes, dict) and shapes:
+        return len(shapes)
+    return int(stats.get("compiles", 0))
+
+
 def iter_jit_stats(root) -> Iterator[Tuple[object, str, int, float]]:
-    """Yield (node, program_key, compiles, compile_wall_s) for every
-    jitted program under `root` (walks children; works on plan trees
-    and fragment roots alike)."""
+    """Yield (node, program_key, distinct_shapes, compile_wall_s) for
+    every jitted program under `root` (walks children; works on plan
+    trees and fragment roots alike)."""
     stats = root.__dict__.get("_jit_stats") if hasattr(root, "__dict__") \
         else None
     if stats:
         for key, s in stats.items():
-            yield (root, key, int(s.get("compiles", 0)),
+            yield (root, key, distinct_shapes(s),
                    float(s.get("compile_wall_s", 0.0)))
     for c in root.children():
         yield from iter_jit_stats(c)

@@ -229,3 +229,30 @@ class Dictionary:
 
     def __eq__(self, other):
         return self is other
+
+    def content_digest(self) -> str:
+        """16-hex digest of the values — stable across processes, unlike
+        id()/default repr. Memoized (immutable once built)."""
+        d = self._memo.get("__digest")
+        if d is None:
+            import hashlib
+
+            h = hashlib.sha256()
+            if self.values.dtype.kind == "U":
+                h.update(str(self.values.dtype).encode())
+                h.update(self.values.tobytes())
+            else:
+                for v in self.values.flat:
+                    h.update(str(v).encode("utf-8", "surrogatepass"))
+                    h.update(b"\x00")
+            d = h.hexdigest()[:16]
+            self._memo["__digest"] = d
+        return d
+
+    def __repr__(self):
+        # Dictionaries ride in Batch pytree aux, so this repr reaches
+        # repr(treedef) — which keys persisted program artifacts. It must
+        # not contain process-specific state (the default repr's 0x
+        # address broke cross-process artifact restore for every
+        # dict-encoded column).
+        return f"Dictionary({len(self.values)}@{self.content_digest()})"

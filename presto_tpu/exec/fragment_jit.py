@@ -106,33 +106,40 @@ def _pow2_at_least(n: int) -> int:
     return 1 << max(n - 1, 0).bit_length()
 
 
-def iter_windows(stream: Iterable[Batch], width: int) -> Iterator[WindowItem]:
+def iter_windows(stream: Iterable[Batch], width: int,
+                 bucket: bool = False) -> Iterator[WindowItem]:
     """Group CONSECUTIVE same-structure batches into stacked windows of at
     most `width`; a batch whose structure differs from its predecessors
     flushes the pending group first (order is always preserved). Lone
     batches pass through unstacked — padding a single to width would spend
-    width× the compute to save zero dispatches."""
+    width× the compute to save zero dispatches. ``bucket``
+    (shape_bucketing=pow2) pads every MULTI-batch flush to the full
+    window width, collapsing the partial-window pow2 ladder to one
+    stacked shape per structure."""
+    # host generator, never traced: width is a plain Python int
+    bw = _pow2_at_least(int(width)) if bucket else 0  # lint: allow(host-sync)
     pending: List[Batch] = []
     key = None
     for b in stream:
         k = batch_struct_key(b)
         if pending and k != key:
-            yield _flush(pending)
+            yield _flush(pending, bw)
             pending = []
         key = k
         pending.append(b)
         if len(pending) >= width:
-            yield _flush(pending)
+            yield _flush(pending, bw)
             pending = []
     if pending:
-        yield _flush(pending)
+        yield _flush(pending, bw)
 
 
-def _flush(pending: List[Batch]) -> WindowItem:
+def _flush(pending: List[Batch], bucket_width: int = 0) -> WindowItem:
     k = len(pending)
     if k == 1:
         return pending[0]
-    width = _pow2_at_least(k)
+    # host-side stacking decision: bucket_width is a plain Python int
+    width = max(_pow2_at_least(k), int(bucket_width))  # lint: allow(host-sync)
     padded = pending + [dead_like(pending[-1])] * (width - k)
     w = Window(stack_batches(padded), k, width, pending[0])
     from presto_tpu.obs import devprof as _devprof
@@ -161,11 +168,15 @@ class WindowSource:
     grace-overflow path hands these to the spill partitioner so no input
     is lost when the consumer abandons the window loop mid-stream."""
 
-    def __init__(self, stream: Iterable[Batch], width: int):
+    def __init__(self, stream: Iterable[Batch], width: int,
+                 bucket: bool = False):
         self._stream = iter(stream)
         # host-side producer config, not traced code (the module-wide
         # kernel scope is for the stepper builders below)
         self._width = max(2, int(width))  # lint: allow(host-sync)
+        # shape_bucketing=pow2: partial windows pad to the full width so
+        # the fused stepper sees exactly one stacked shape per structure
+        self._bucket_w = _pow2_at_least(self._width) if bucket else 0
         self._q: "queue.Queue" = queue.Queue(maxsize=1)
         self._stop = threading.Event()
         self._exc: Optional[BaseException] = None
@@ -177,22 +188,23 @@ class WindowSource:
     def _produce(self):
         pending = self._pending
         key = None
+        bw = self._bucket_w
         try:
             for b in self._stream:
                 k = batch_struct_key(b)
                 if pending and k != key:
-                    if not self._put(_flush(list(pending))):
+                    if not self._put(_flush(list(pending), bw)):
                         return
                     del pending[:]
                 key = k
                 pending.append(b)
                 if len(pending) >= self._width:
-                    if not self._put(_flush(list(pending))):
+                    if not self._put(_flush(list(pending), bw)):
                         return
                     del pending[:]
                 if self._stop.is_set():
                     return
-            if pending and self._put(_flush(list(pending))):
+            if pending and self._put(_flush(list(pending), bw)):
                 del pending[:]
         except BaseException as e:  # propagated to the consumer
             self._exc = e

@@ -178,6 +178,15 @@ class LocalRunner:
             qp = optimize(plan_query(stmt, self.catalog), self.catalog)
             if not qp.scalar_subqueries and qp.cacheable:
                 self._plan_cache[sql] = qp
+        from presto_tpu.exec import farm as _farm
+
+        if _farm.enabled(self.config):
+            try:
+                # statement→fingerprint corpus record, so queue-wait
+                # speculation can resolve future submissions of this SQL
+                _farm.record_sql(sql, [qp.root])
+            except Exception:
+                pass
         ctx = self._new_ctx()
         out = run_plan(qp, ctx)
         self.last_stats = ctx.stats

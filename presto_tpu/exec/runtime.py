@@ -38,6 +38,7 @@ from presto_tpu.batch import (
     slice_column,
 )
 from presto_tpu.connector import Catalog
+from presto_tpu.exec import farm as _farm
 from presto_tpu.exec import fragment_jit as _fragment_jit
 from presto_tpu.exec import programs as _programs
 from presto_tpu.expr.compile import compile_expr, compile_predicate
@@ -265,6 +266,22 @@ class ExecConfig:
     # no cache consult, no metric families, no events, today's engine
     # bit-for-bit.
     result_cache: str = "off"
+    # pow2 shape bucketing (exec/farm.py subsystem): "pow2" pads
+    # merging-output flushes and partial jit windows up to their
+    # power-of-two bucket (capped at the stream's target capacity), so the
+    # distinct-aval set reaching _node_jit collapses to one shape per
+    # stream instead of a per-flush ladder — fewer avals, fewer compiles,
+    # charged once per bucket against the recompile budgets. "off"
+    # (default) is a strict no-op — today's flush/window shapes
+    # bit-for-bit. Padding only adds dead lanes (live=False), which every
+    # kernel already masks, so results are identical either way.
+    shape_bucketing: str = "off"
+    # ahead-of-traffic compile farm (exec/farm.py): "on" records every
+    # installed plan into the persistent farm corpus under
+    # PRESTO_TPU_CACHE_DIR and lets server planes boot-arm the program
+    # cache / speculatively precompile during queue wait; "off" (default)
+    # is a strict no-op — no corpus writes, no claims, no metric families.
+    compile_farm: str = "off"
 
 
 def _node_jit(node: PlanNode, key: str, builder, _shared=True, **jit_kwargs):
@@ -505,7 +522,8 @@ def execute_node(node: PlanNode, ctx: ExecContext) -> Iterator[Batch]:
         # occupancy can be ~1%; every downstream per-batch cost (sorts,
         # merges, probes) is capacity-shaped, so coalesce before fanning
         # out (reference: operator/project/MergingPageOutput.java)
-        stream = _merging_output(stream, ctx.config.batch_rows)
+        stream = _merging_output(stream, ctx.config.batch_rows,
+                                 bucket=ctx.config.shape_bucketing != "off")
     yield from stream
 
 
@@ -532,15 +550,23 @@ def _pad_batch(b: Batch, cap: int) -> Batch:
     return Batch(b.names, b.types, cols, padp(b.live, False), b.dicts)
 
 
-def _merging_output(stream: Iterator[Batch], target_cap: int) -> Iterator[Batch]:
+def _merging_output(stream: Iterator[Batch], target_cap: int,
+                    bucket: bool = False) -> Iterator[Batch]:
     """MergingPageOutput analog: compact sparse batches (live rows to the
     front), slice them to their power-of-two bucket, and concatenate until
     a full batch accumulates. Dense batches pass through untouched; empty
     batches are dropped. Costs one host sync per input batch (num_live) —
     repaid many times over by the capacity-shaped work it removes
-    downstream on selective multi-join plans."""
+    downstream on selective multi-join plans.
+
+    ``bucket`` (shape_bucketing=pow2) additionally pads every flush —
+    including the single-batch passthrough — up to the stream's pow2
+    target capacity, so downstream programs see ONE flush shape instead
+    of a per-flush pow2 ladder; padding adds only dead lanes (live=False),
+    which every kernel masks, so results are unchanged."""
     pending: List[Batch] = []
     pending_live = 0
+    bucket_cap = round_up_capacity(max(int(target_cap), 1)) if bucket else 0
 
     def flush():
         nonlocal pending, pending_live
@@ -551,6 +577,9 @@ def _merging_output(stream: Iterator[Batch], target_cap: int) -> Iterator[Batch]
             # concat of mixed pow2 slices is no longer pow2 itself —
             # re-bucket so downstream programs see a bounded shape set
             out = _pad_batch(out, round_up_capacity(out.capacity))
+        if bucket:
+            out = _pad_batch(
+                out, max(round_up_capacity(out.capacity), bucket_cap))
         pending, pending_live = [], 0
         return out
 
@@ -658,7 +687,8 @@ def _fused_child(node: PlanNode, ctx: ExecContext):
             base, (HashJoin, SemiJoin, NestedLoopJoin, IndexJoin)):
         # breakers pull children through here, not execute_node — apply
         # the same sparse-output coalescing before the consumer's chain
-        stream = _merging_output(stream, ctx.config.batch_rows)
+        stream = _merging_output(stream, ctx.config.batch_rows,
+                                 bucket=ctx.config.shape_bucketing != "off")
     return stream, (up or (lambda b: b))
 
 
@@ -2908,8 +2938,9 @@ def _execute_aggregate(node: Aggregate, ctx: ExecContext) -> Iterator[Batch]:
                     return _fragment_jit.window_device_bytes(item)
                 return batch_device_bytes(item)
 
-            src = _fragment_jit.WindowSource(stream,
-                                             _hbo_fragment_window(node, ctx))
+            src = _fragment_jit.WindowSource(
+                stream, _hbo_fragment_window(node, ctx),
+                bucket=ctx.config.shape_bucketing != "off")
             try:
                 for item in src:
                     dispatch(item)
@@ -4891,8 +4922,9 @@ def _execute_sort(node: Sort, ctx: ExecContext) -> Iterator[Batch]:
             jfstep0 = _node_jit(
                 node, "fragment_topn0",
                 lambda: _fragment_jit.topn_stepper(topn_step, True))
-            src = _fragment_jit.WindowSource(in_stream,
-                                             ctx.config.fragment_window)
+            src = _fragment_jit.WindowSource(
+                in_stream, ctx.config.fragment_window,
+                bucket=ctx.config.shape_bucketing != "off")
             try:
                 for item in src:
                     if isinstance(item, _fragment_jit.Window):
@@ -5207,9 +5239,16 @@ def install_plan_programs(root: PlanNode, ctx: ExecContext) -> None:
         _mark_breaker_engines(root, ctx)
     except Exception:
         pass  # cosmetic EXPLAIN marker; the executor re-stamps on run
+    if _farm.enabled(ctx.config):
+        try:
+            _farm.record_plan(root, ctx)
+        except Exception:
+            pass  # corpus write is advisory; never fail an install on it
     if ctx.config.precompile_workers > 0:
-        _programs.submit_warmers(_chain_warmers(root, ctx),
-                                 ctx.config.precompile_workers)
+        warmers = _chain_warmers(root, ctx)
+        if _farm.enabled(ctx.config):
+            warmers = _farm.wrap_claims(warmers)
+        _programs.submit_warmers(warmers, ctx.config.precompile_workers)
 
 
 def _mark_breaker_engines(root: PlanNode, ctx: "ExecContext") -> None:

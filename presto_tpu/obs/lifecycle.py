@@ -295,6 +295,9 @@ class QueryLifecycle:
         #: result-cache provenance doc (server/result_cache.py): set on a
         #: cache hit; surfaces in stats.resultCache and the slow-query log
         self.cache_info: Optional[Dict[str, Any]] = None
+        #: compile-farm attribution doc (exec/farm.py): whether this
+        #: query's programs were farm-warmed (armed/live) before it ran
+        self.farm_info: Optional[Dict[str, Any]] = None
         self._max_fraction = 0.0
         self._lock = threading.Lock()
 
@@ -432,9 +435,18 @@ def note_cache(query_id: str, doc: Dict[str, Any]) -> None:
         entry.cache_info = dict(doc)
 
 
+def note_farm(query_id: str, doc: Dict[str, Any]) -> None:
+    """Attach a compile-farm attribution doc to the query's lifecycle
+    entry (no-op for unregistered queries, preserving off-discipline) —
+    a farm-warmed query's compile segment ≈ 0 needs a WHY on record."""
+    entry = get(query_id)
+    if entry is not None:
+        entry.farm_info = dict(doc)
+
+
 def slow_log_annotation(query_id: str) -> Optional[Dict[str, Any]]:
     """Extra fields for the slow-query JSONL record (regression flag,
-    result-cache provenance)."""
+    result-cache provenance, compile-farm attribution)."""
     entry = get(query_id)
     if entry is None:
         return None
@@ -443,6 +455,8 @@ def slow_log_annotation(query_id: str) -> Optional[Dict[str, Any]]:
         extra["latencyRegression"] = dict(entry.regression)
     if entry.cache_info is not None:
         extra["cacheHit"] = dict(entry.cache_info)
+    if entry.farm_info is not None:
+        extra["farm"] = dict(entry.farm_info)
     return extra or None
 
 

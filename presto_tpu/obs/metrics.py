@@ -148,11 +148,21 @@ SPILLED_BYTES = Histogram(
     "join builds/probes and grace-agg partitions, labeled by operator "
     "side; heavy right tails mean partition budgets are mis-sized)",
     log_buckets(1024.0, 1e12))
+FARM_WARM_WALL = Histogram(
+    "presto_tpu_farm_warm_wall_seconds",
+    "wall time of one compile-farm warm task (boot arming or queue-wait "
+    "speculation; exec/farm.py — compile cost the farm absorbed off the "
+    "query critical path)",
+    log_buckets(0.001, 600.0))
 
 ALL_HISTOGRAMS: Tuple[Histogram, ...] = (
     QUERY_LATENCY, TASK_SCHEDULE_DELAY, BATCH_KERNEL_WALL, EXCHANGE_WAIT,
     RADIX_PARTITION_ROWS, COMPILE_TRACE_WALL, STATS_DRIFT, LEDGER_DRIFT,
     SPILLED_BYTES)
+
+# rendered only once the compile farm has done anything, so an unarmed
+# scrape's family set stays bit-for-bit pre-farm
+_ARMED_HISTOGRAMS: Tuple[Histogram, ...] = (FARM_WARM_WALL,)
 
 
 def render_histograms(plane: str) -> str:
@@ -161,10 +171,18 @@ def render_histograms(plane: str) -> str:
     lines: List[str] = []
     for h in ALL_HISTOGRAMS:
         lines.extend(h.render(plane))
+    try:
+        from presto_tpu.exec import farm as _farm
+
+        if _farm.armed():
+            for h in _ARMED_HISTOGRAMS:
+                lines.extend(h.render(plane))
+    except Exception:
+        pass
     return "\n".join(lines) + "\n"
 
 
 def reset() -> None:
     """Test hook — zero every histogram family."""
-    for h in ALL_HISTOGRAMS:
+    for h in ALL_HISTOGRAMS + _ARMED_HISTOGRAMS:
         h.reset()

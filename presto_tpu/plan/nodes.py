@@ -576,11 +576,10 @@ def _shape_headroom(node, jstats, shape_budgets) -> str:
     analysis/recompile.py is the source of truth for both the classes
     and the defaults)."""
     try:
-        from presto_tpu.analysis.recompile import budget_for
+        from presto_tpu.analysis.recompile import budget_for, distinct_shapes
     except Exception:
         return ""
-    worst = max((int(v.get("compiles", 0)) for v in jstats.values()),
-                default=0)
+    worst = max((distinct_shapes(v) for v in jstats.values()), default=0)
     g, sc, br = shape_budgets or (None, None, None)
     budget = budget_for(node, g, sc, br)
     return f", shapes={worst}/{budget}"

@@ -31,6 +31,7 @@ from typing import Dict, List, Optional
 
 from presto_tpu.batch import Batch
 from presto_tpu.connector import Catalog
+from presto_tpu.exec import farm as _farm
 from presto_tpu.exec.runtime import ExecConfig
 from presto_tpu.obs import events as _obs_events
 from presto_tpu.obs import lifecycle as _obs_lifecycle
@@ -867,6 +868,31 @@ class Coordinator:
                         fh.write(line + "\n")
 
             self.query_manager.listeners.append(log_event)
+
+        def _speculate(qe):
+            # queue-wait precompile: farm-compile the statement's recorded
+            # plans while the query waits for admission, spending (and
+            # respecting) the group's compile budget
+            group = qe.resource_group or ""
+            user = qe.session.user
+            _farm.speculate(
+                qe.sql, self.catalog, qe.session.exec_config(),
+                group=group, query_id=qe.query_id,
+                charge_fn=lambda n: self.query_manager.resource_groups
+                .charge_compiles(group, n, user),
+                budget_fn=lambda: self.query_manager.resource_groups
+                .compile_budget_remaining(group, user))
+
+        self.query_manager.speculate_fn = _speculate
+        # ahead-of-traffic farm boot: arm the program cache from the
+        # persisted corpus BEFORE serving starts, so "coordinator ready"
+        # means "known programs warm" (blocking by design; gated on
+        # PRESTO_TPU_FARM=1 + PRESTO_TPU_CACHE_DIR, else a no-op)
+        try:
+            self._farm_armed = _farm.boot(self.catalog, self.config,
+                                          block=True)
+        except Exception:
+            self._farm_armed = 0
         # bind the socket first (determines self.url), wire the protocol,
         # THEN start serving — no request can observe a half-built coordinator
         self._bind_http(port)
@@ -938,6 +964,15 @@ class Coordinator:
             else:
                 rc_state = "bypass"
             rc_line = f"[cache: {rc_state}]"
+        # farm header: would a first-seen run of this structure land on a
+        # warm program cache? armed = boot pre-armed, live = queue-wait
+        # speculation warmed it, miss = cold. Rendered only when the farm
+        # is in play (process or session arming) — off stays bit-for-bit.
+        farm_line = None
+        if _farm.enabled(cfg):
+            farm_line = ("[farm: "
+                         + _farm.status_for(dplan.fragments[dplan.root_fid]
+                                            .root) + "]")
         stats: list = []
         self.size_monitor.wait_for_minimum()
         qid = self.next_query_id()
@@ -967,6 +1002,8 @@ class Coordinator:
         lines = []
         if rc_line is not None:
             lines += [rc_line, ""]
+        if farm_line is not None:
+            lines += [farm_line, ""]
         if entry is not None:
             seg = entry.timeline.segments()
             lines += [
@@ -1812,6 +1849,24 @@ class Coordinator:
                         "bytes": _rc_mod.batch_nbytes(hit)})
                     return hit
         _stamp_fingerprint()
+        if _farm.enabled(cfg):
+            # corpus feed + status attribution: record this statement's
+            # plans for future boots/speculation, and stamp whether THIS
+            # run lands on a farm-warmed cache (armed/live) or cold (miss)
+            try:
+                froot = dplan.fragments[dplan.root_fid].root
+                _farm.record_sql(
+                    sql, [f.root for f in dplan.fragments.values()])
+                fstatus = _farm.status_for(froot)
+                if session_qid:
+                    _obs_lifecycle.note_farm(session_qid, {
+                        "status": fstatus})
+                if fstatus != "miss":
+                    _obs_events.EVENTS.emit(
+                        "precompile_hit", query_id=session_qid or None,
+                        status=fstatus)
+            except Exception:
+                pass
         if lifecycle_on:
             # lifecycle plane: plan ready = plan->compile boundary
             _obs_lifecycle.mark(session_qid, "compiling")

@@ -97,6 +97,10 @@ def worker_metrics(worker) -> str:
     # result-cache families appear only once the cache has been consulted
     # (result_cache=off scrapes stay bit-for-bit pre-cache)
     rows.extend(_result_cache.CACHE.metric_rows({**lbl, "plane": "worker"}))
+    from presto_tpu.exec import farm as _farm
+
+    # compile-farm families appear only once the farm has done anything
+    rows.extend(_farm.metric_rows({**lbl, "plane": "worker"}))
     return render_metrics(rows) + obs_metrics.render_histograms("worker")
 
 
@@ -130,6 +134,9 @@ def coordinator_metrics(coordinator) -> str:
 
     # same armed-gating as the worker plane: no families until consulted
     rows.extend(_result_cache.CACHE.metric_rows({"plane": "coordinator"}))
+    from presto_tpu.exec import farm as _farm
+
+    rows.extend(_farm.metric_rows({"plane": "coordinator"}))
     text = render_metrics(rows) + obs_metrics.render_histograms("coordinator")
     from presto_tpu.obs import lifecycle as obs_lifecycle
 

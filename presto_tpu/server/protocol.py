@@ -366,6 +366,10 @@ class StatementProtocol:
                 _entry = _lc.get(qe.query_id)
                 if _entry is not None and _entry.cache_info is not None:
                     out["stats"]["resultCache"] = dict(_entry.cache_info)
+                # compile-farm attribution (farm off: no farm_info, stays
+                # bit-for-bit)
+                if _entry is not None and _entry.farm_info is not None:
+                    out["stats"]["compileFarm"] = dict(_entry.farm_info)
             except Exception:
                 pass
         try:

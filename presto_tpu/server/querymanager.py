@@ -204,6 +204,10 @@ class QueryManager:
         self._enforcer_stop = threading.Event()
         self._enforcer.start()
         self.listeners: List[Callable[[str, QueryInfo], None]] = []
+        # queue-wait speculative precompile hook (coordinator wires this
+        # to exec.farm.speculate); called with the QueryExecution when it
+        # enters a resource-group queue. None = no speculation.
+        self.speculate_fn: Optional[Callable] = None
 
     def close(self):
         self._enforcer_stop.set()
@@ -263,6 +267,15 @@ class QueryManager:
                 qe._rg_compiles0 = _programs.snapshot()["compiles"]
             except Exception:
                 qe._rg_compiles0 = None
+            try:
+                # farm-attributed compiles (boot arming, queue-wait
+                # speculation) are charged by the farm itself — net them
+                # out of this query's terminal delta
+                from presto_tpu.exec import farm as _farm
+
+                qe._rg_farm0 = _farm.farm_compiles()
+            except Exception:
+                qe._rg_farm0 = None
             _lifecycle.mark(qe.query_id, "admitted")
             if qe.done:
                 # canceled/failed while queued: the group just granted a slot
@@ -276,8 +289,7 @@ class QueryManager:
                 session.user, session.source,
                 session.get("query_priority"), start_from_group,
                 on_group=on_group,
-                on_queued=lambda qe=qe: _lifecycle.mark(qe.query_id,
-                                                        "queued"),
+                on_queued=lambda qe=qe: self._on_queued(qe),
             )
         except Exception as e:  # admission rejection
             if qe.timeline is not None:
@@ -288,6 +300,16 @@ class QueryManager:
             qe.fail(str(e), error_type="QUERY_QUEUE_FULL")
         self._expire_old()
         return qe
+
+    def _on_queued(self, qe: QueryExecution):
+        _lifecycle.mark(qe.query_id, "queued")
+        if self.speculate_fn is not None:
+            try:
+                # queue wait is the farm's window: compile the query's
+                # HBO-predicted programs while it waits for admission
+                self.speculate_fn(qe)
+            except Exception:
+                pass
 
     def _release_slot(self, qe: QueryExecution):
         with qe._rg_lock:
@@ -316,6 +338,16 @@ class QueryManager:
             from presto_tpu.exec import programs as _programs
 
             delta = _programs.snapshot()["compiles"] - base
+            farm0 = getattr(qe, "_rg_farm0", None)
+            if farm0 is not None:
+                try:
+                    from presto_tpu.exec import farm as _farm
+
+                    # farm work charges its own deltas (speculation) or is
+                    # deliberately un-charged (boot) — don't bill it twice
+                    delta -= max(0, _farm.farm_compiles() - farm0)
+                except Exception:
+                    pass
             if delta > 0:
                 self.resource_groups.charge_compiles(
                     qe.resource_group, delta, qe.session.user)

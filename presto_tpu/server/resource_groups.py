@@ -307,6 +307,29 @@ class ResourceGroupManager:
                     g.compiles_used += int(n)
                 g = g.parent
 
+    def compile_budget_remaining(self, group_id: str,
+                                 user: str = "") -> Optional[int]:
+        """Tightest remaining compile headroom on the group's path for
+        the current window (None = no budget configured anywhere on the
+        path). The farm's speculative precompile consults this before
+        spending a group's budget on warmth."""
+        remaining: Optional[int] = None
+        now = time.monotonic()
+        with self._lock:
+            try:
+                g: Optional[_Group] = self._resolve(group_id, user)
+            except KeyError:
+                return None
+            while g is not None:
+                b = g.spec.compile_budget
+                if b > 0:
+                    g._budget_ok(now)  # roll the window first
+                    left = max(0, b - g.compiles_used)
+                    remaining = left if remaining is None \
+                        else min(remaining, left)
+                g = g.parent
+        return remaining
+
     def replenish_compile_budgets(self):
         """Zero every group's window usage and drain newly-eligible
         queued queries (ops hook / tests; rolling windows replenish

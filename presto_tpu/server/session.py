@@ -298,6 +298,23 @@ class SystemSessionProperties:
                              str, "off",
                              validator=_enum("result_cache",
                                              ["OFF", "QUERY", "SUBPLAN"])),
+            # compile farm (exec/farm.py)
+            PropertyMetadata("shape_bucketing",
+                             "pow2 pads merging-output flushes and partial "
+                             "jit windows up to their power-of-two bucket so "
+                             "each stream compiles one shape instead of a "
+                             "per-flush ladder (results identical — padding "
+                             "is dead lanes); off reproduces today's shapes "
+                             "bit-for-bit", str, "off",
+                             validator=_enum("shape_bucketing",
+                                             ["OFF", "POW2"])),
+            PropertyMetadata("compile_farm",
+                             "on records installed plans into the persistent "
+                             "farm corpus under PRESTO_TPU_CACHE_DIR and "
+                             "arms queue-wait speculative precompile; off "
+                             "is a strict no-op (no corpus IO, no claims, "
+                             "no metric families)", str, "off",
+                             validator=_enum("compile_farm", ["OFF", "ON"])),
         ]
 
     def names(self) -> List[str]:
@@ -420,4 +437,6 @@ class Session:
             profile=self.get("profile"),
             lifecycle=self.get("lifecycle").lower(),
             result_cache=self.get("result_cache").lower(),
+            shape_bucketing=self.get("shape_bucketing").lower(),
+            compile_farm=self.get("compile_farm").lower(),
         )

@@ -642,6 +642,18 @@ class Worker:
                                         run_slots=run_slots,
                                         node_id=node_id)
         self.node_state = "active"   # active | shutting_down | shut_down
+        # ahead-of-traffic farm boot: workers arm their own program cache
+        # from the persisted corpus, but NON-blocking — a worker serves
+        # tasks immediately and warms in the background (the coordinator
+        # is the one whose "ready" must mean "warm"). Gated on
+        # PRESTO_TPU_FARM=1 + PRESTO_TPU_CACHE_DIR, else a no-op.
+        try:
+            from presto_tpu.exec import farm as _farm_mod
+
+            if _farm_mod.enabled():
+                _farm_mod.boot(catalog, block=False)
+        except Exception:
+            pass
         worker = self
 
         class Handler(BaseHTTPRequestHandler):

@@ -1,0 +1,346 @@
+"""Compile farm (exec/farm.py): corpus record/load resilience, inflight
+compile claims (exactly-once under farm×live concurrency), boot arming,
+speculative queue-wait precompile with budget gating, pow2 shape
+bucketing equivalence, and the recompile-budget interplay (bucketed
+shapes charge once per bucket).
+
+Reference: the reference engine's generated-bytecode caches are warm by
+the time traffic arrives; these tests pin the analogous contract for XLA
+programs — the farm compiles ahead of traffic, never twice, and never
+changes what any query computes.
+"""
+
+import functools
+import json
+import threading
+
+import pytest
+
+from presto_tpu.analysis.recompile import distinct_shapes, iter_jit_stats
+from presto_tpu.catalog.tpch import tpch_catalog
+from presto_tpu.exec import ExecConfig, LocalRunner, farm, programs
+
+
+@pytest.fixture(scope="module")
+def cat():
+    return tpch_catalog(0.01)
+
+
+@pytest.fixture(autouse=True)
+def _farm_env(tmp_path, monkeypatch):
+    """Every test gets its own cache dir and a clean farm/program state;
+    the farm env gate stays OFF unless a test opts in."""
+    monkeypatch.setenv("PRESTO_TPU_CACHE_DIR", str(tmp_path))
+    monkeypatch.delenv("PRESTO_TPU_FARM", raising=False)
+    monkeypatch.delenv("PRESTO_TPU_PROGRAM_PERSIST", raising=False)
+    farm.reset()
+    programs.reset(counters_only=False)
+    yield
+    farm.reset()
+    programs.reset(counters_only=False)
+
+
+SQL = ("select l_returnflag, sum(l_quantity) as q, count(*) as c "
+       "from lineitem where l_discount > 0.02 "
+       "group by l_returnflag order by l_returnflag")
+SQL_JOIN = ("select l_returnflag, count(*) as c from lineitem "
+            "join orders on l_orderkey = o_orderkey "
+            "where l_discount > 0.03 group by l_returnflag "
+            "order by l_returnflag")
+
+
+def _record_corpus(cat, sql=SQL):
+    """Run once with the farm armed so the corpus holds the plan."""
+    r = LocalRunner(cat, ExecConfig(compile_farm="on"))
+    out = r.run(sql)
+    assert len(farm.load_corpus()["plans"]) >= 1
+    return out
+
+
+# ---------------------------------------------------------------------------
+# corpus
+
+
+def test_farm_off_writes_nothing(cat, tmp_path):
+    LocalRunner(cat, ExecConfig()).run("select count(*) as c from region")
+    assert not (tmp_path / "farm_corpus.jsonl").exists()
+    assert farm.metric_rows({}) == []  # unarmed: no metric families
+
+
+def test_record_and_load_roundtrip(cat):
+    _record_corpus(cat)
+    corpus = farm.load_corpus()
+    assert len(corpus["plans"]) == 1
+    (fp,) = corpus["plans"]
+    assert len(fp) == 24
+
+
+def test_corrupt_and_tombstoned_lines_skipped(cat, tmp_path):
+    _record_corpus(cat)
+    path = tmp_path / "farm_corpus.jsonl"
+    lines = path.read_text().strip().splitlines()
+    good = json.loads([l for l in lines
+                       if json.loads(l)["kind"] == "plan"][0])
+    with path.open("a") as fh:
+        fh.write("{not json at all\n")                       # corrupt
+        fh.write(json.dumps({"v": 1, "kind": "mystery"}) + "\n")
+        fh.write(json.dumps({"v": 1, "kind": "plan", "fp": "f" * 24,
+                             "plan": {"bogus": True}}) + "\n")
+        fh.write(json.dumps({"v": 1, "kind": "plan",
+                             "fp": "d" * 24, "plan": good["plan"],
+                             "deleted": True}) + "\n")        # tombstone
+        fh.write(json.dumps({"v": 1, "kind": "plan",
+                             "fp": good["fp"],
+                             "deleted": True}) + "\n")        # tombstone real
+    farm.reset()
+    corpus = farm.load_corpus()
+    # the real plan was tombstoned by its last line; the bogus-body plan
+    # survives load (decode failures surface at boot, not load)
+    assert good["fp"] not in corpus["plans"]
+    assert farm.snapshot()["skipped"] >= 2
+    # boot over the remaining (undecodable) plan must not raise
+    armed = farm.boot(cat, ExecConfig(compile_farm="on"), block=True)
+    assert armed >= 0  # no exception is the contract
+
+
+def test_boot_skips_undecodable_without_failing(cat, tmp_path):
+    _record_corpus(cat)
+    path = tmp_path / "farm_corpus.jsonl"
+    with path.open("a") as fh:
+        fh.write(json.dumps({"v": 1, "kind": "plan", "fp": "e" * 24,
+                             "plan": {"kind": "NoSuchNode"}}) + "\n")
+    farm.reset()
+    armed = farm.boot(cat, ExecConfig(compile_farm="on"), block=True)
+    assert armed >= 1  # the good plan armed...
+    snap = farm.snapshot()
+    assert snap["skipped"] >= 1  # ...the bogus one was skipped, not fatal
+    assert snap["boot_armed"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# inflight claims: exactly-once
+
+
+class _FakeNode:
+    def __init__(self, ns):
+        self.__dict__["_program_ns"] = ns
+
+
+def test_wrap_claims_exactly_once_across_threads():
+    ran = {}
+    ran_lock = threading.Lock()
+
+    def warm(node, k=None):
+        with ran_lock:
+            ran[node.__dict__["_program_ns"]] = \
+                ran.get(node.__dict__["_program_ns"], 0) + 1
+
+    tasks = [functools.partial(warm, _FakeNode(f"ns{i}"))
+             for i in range(4)]
+    barrier = threading.Barrier(6)
+
+    def racer():
+        barrier.wait()
+        for t in farm.wrap_claims(list(tasks)):
+            t()
+
+    threads = [threading.Thread(target=racer) for _ in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    # 6 racers × 4 shared programs: each program warmed exactly once
+    assert ran == {f"ns{i}": 1 for i in range(4)}
+    assert farm.snapshot()["claims_contended"] == 4 * 5
+
+
+def test_unstamped_tasks_run_unclaimed():
+    calls = []
+    t = functools.partial(lambda node: calls.append(1), object())
+    for w in farm.wrap_claims([t, t]):
+        w()
+    assert len(calls) == 2  # no namespace → nothing shared → no claim
+
+
+def test_boot_concurrent_with_live_query_compiles_each_program_once(cat):
+    _record_corpus(cat, SQL_JOIN)
+    # serial cold baseline: how many compile events one run costs
+    programs.reset(counters_only=False)
+    farm.reset()
+    LocalRunner(cat, ExecConfig()).run(SQL_JOIN)
+    serial = programs.snapshot()["compiles"]
+    assert serial > 0
+    # concurrent: 4 farm boot workers × a live query over the same
+    # structure — claims + the shared entries must keep the total at the
+    # serial count (each program compiled exactly once, never twice)
+    programs.reset(counters_only=False)
+    farm.reset()
+    cfg = ExecConfig(compile_farm="on")
+    booted = []
+    bt = threading.Thread(
+        target=lambda: booted.append(
+            farm.boot(cat, cfg, workers=4, block=True)))
+    bt.start()
+    out = LocalRunner(cat, cfg).run(SQL_JOIN)
+    bt.join()
+    farm.drain()
+    assert booted and booted[0] >= 1
+    assert len(out) > 0
+    assert programs.snapshot()["compiles"] == serial
+
+
+# ---------------------------------------------------------------------------
+# speculation
+
+
+def test_speculate_budget_denied(cat):
+    _record_corpus(cat)
+    fut = farm.speculate(SQL, cat, ExecConfig(compile_farm="on"),
+                         group="global.etl", budget_fn=lambda: 0)
+    assert fut is None
+    assert farm.snapshot()["speculations_budget_denied"] == 1
+    assert farm.snapshot()["speculations"] == 0
+
+
+def test_speculate_marks_status_live_and_charges(cat):
+    _record_corpus(cat)
+    charged = []
+    fut = farm.speculate(SQL, cat, ExecConfig(compile_farm="on"),
+                         group="global.adhoc", charge_fn=charged.append,
+                         budget_fn=lambda: 100, query_id="q-1")
+    assert fut is not None
+    farm.drain()
+    assert farm.snapshot()["speculations"] == 1
+    # the statement's recorded plans are now stamped live
+    corpus = farm.load_corpus()
+    for fp in corpus["plans"]:
+        assert farm.status_fp(fp) == "live"
+    # programs were already warm in-process, so a zero delta charges
+    # nothing; any positive delta must have been handed to charge_fn
+    assert all(n > 0 for n in charged)
+
+
+def test_speculate_unknown_sql_is_noop(cat):
+    _record_corpus(cat)
+    assert farm.speculate("select 1", cat,
+                          ExecConfig(compile_farm="on")) is None
+    assert farm.snapshot()["speculations"] == 0
+
+
+# ---------------------------------------------------------------------------
+# pow2 shape bucketing
+
+
+@pytest.mark.parametrize("sql", [SQL, SQL_JOIN])
+def test_bucketing_results_identical(cat, sql):
+    off = LocalRunner(cat, ExecConfig(shape_bucketing="off")).run(sql)
+    on = LocalRunner(cat, ExecConfig(shape_bucketing="pow2")).run(sql)
+    assert off.equals(on)
+
+
+def test_bucketing_does_not_fork_program_cache(cat):
+    # bucketing is a volatile config field: both modes share entries
+    LocalRunner(cat, ExecConfig(shape_bucketing="off")).run(SQL)
+    n_off = programs.snapshot()["entries"]
+    LocalRunner(cat, ExecConfig(shape_bucketing="pow2")).run(SQL)
+    assert programs.snapshot()["entries"] == n_off
+
+
+def test_bucketed_join_shapes_within_budget(cat):
+    r = LocalRunner(cat, ExecConfig(shape_bucketing="pow2"))
+    qp = r.plan(SQL_JOIN)
+    from presto_tpu.exec.runtime import ExecContext, run_plan
+
+    run_plan(qp, ExecContext(cat, r.config))
+    for node, key, shapes, _wall in iter_jit_stats(qp.root):
+        stats = node.__dict__["_jit_stats"][key]
+        # the distinct-shape count never exceeds raw compile events, and
+        # the signature record exists for every compiling program
+        assert shapes <= int(stats.get("compiles", 0)) or \
+            int(stats.get("compiles", 0)) == 0
+
+
+# ---------------------------------------------------------------------------
+# recompile-budget interplay
+
+
+def test_distinct_shapes_prefers_signature_record():
+    assert distinct_shapes({"compiles": 7}) == 7
+    assert distinct_shapes(
+        {"compiles": 7, "shapes": {"a": 3, "b": 4}}) == 2
+    assert distinct_shapes({"compiles": 0, "shapes": {}}) == 0
+
+
+def test_shape_signatures_recorded_on_compile(cat):
+    r = LocalRunner(cat, ExecConfig())
+    qp = r.plan(SQL)
+    from presto_tpu.exec.runtime import ExecContext, run_plan
+
+    run_plan(qp, ExecContext(cat, r.config))
+    saw = 0
+    for node, key, shapes, _ in iter_jit_stats(qp.root):
+        stats = node.__dict__["_jit_stats"][key]
+        if int(stats.get("compiles", 0)) > 0:
+            saw += 1
+            # unbucketed: every compile is a fresh shape → counts agree
+            assert shapes == len(stats.get("shapes", {})) > 0
+    assert saw > 0
+
+
+# ---------------------------------------------------------------------------
+# restored counter split (persistent compilation cache satellite)
+
+
+def test_restored_split_sums_to_restored(cat, tmp_path, monkeypatch):
+    monkeypatch.setenv("PRESTO_TPU_PROGRAM_PERSIST", "1")
+    exp = LocalRunner(cat, ExecConfig()).run(SQL)
+    pdir = tmp_path / "programs"
+    if not (pdir.exists() and list(pdir.glob("*.jaxexp"))):
+        pytest.skip("jax.export unavailable (persistence best-effort)")
+    programs.reset(counters_only=False)
+    out = LocalRunner(cat, ExecConfig()).run(SQL)
+    snap = programs.snapshot()
+    assert snap["restored"] > 0
+    # honesty contract: every restore is attributed to exactly one side
+    assert (snap["restored_executable"] + snap["restored_retrace"]
+            == snap["restored"])
+    assert out.equals(exp)
+
+
+def test_prewarm_artifacts_shares_callers_with_restore(cat, tmp_path,
+                                                       monkeypatch):
+    monkeypatch.setenv("PRESTO_TPU_PROGRAM_PERSIST", "1")
+    exp = LocalRunner(cat, ExecConfig()).run(SQL)
+    pdir = tmp_path / "programs"
+    arts = sorted(p.name for p in pdir.glob("*.jaxexp")) \
+        if pdir.exists() else []
+    if not arts:
+        pytest.skip("jax.export unavailable (persistence best-effort)")
+    programs.reset(counters_only=False)
+    n = programs.prewarm_artifacts(threads=2)
+    assert n == len(arts)
+    assert programs.snapshot()["prewarmed"] == n
+    # a fresh run's entry restore must reuse the prewarmed callers (one
+    # Exported per artifact process-wide), not deserialize its own copies
+    out = LocalRunner(cat, ExecConfig()).run(SQL)
+    assert out.equals(exp)
+    assert programs.snapshot()["restored"] > 0
+
+
+def test_prewarm_without_persist_dir_is_noop(monkeypatch):
+    monkeypatch.delenv("PRESTO_TPU_PROGRAM_PERSIST", raising=False)
+    assert programs.prewarm_artifacts() == 0
+    assert programs.snapshot()["prewarmed"] == 0
+
+
+# ---------------------------------------------------------------------------
+# metric gating
+
+
+def test_metric_rows_appear_once_armed(cat):
+    assert farm.metric_rows({"plane": "test"}) == []
+    _record_corpus(cat)
+    rows = farm.metric_rows({"plane": "test"})
+    names = {r[0] for r in rows}
+    assert "presto_tpu_farm_corpus_recorded_total" in names
+    assert "presto_tpu_farm_boot_armed_total" in names
