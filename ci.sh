@@ -1055,6 +1055,185 @@ if [ "$rc" -ne 0 ]; then
   exit "$rc"
 fi
 
+# Inflight-telemetry smoke: the mid-flight plane end to end.
+#   off-phase — inflight=off run in a fresh process: the /v1/metrics
+#     scrape must carry ZERO inflight families (armed-gating) and the
+#     query result is the bit-identity baseline.
+#   stall phase — a sleep shim on the breaker dispatch path freezes the
+#     row watermarks mid-query: assert a stall_detected event naming the
+#     injected operator, a forensic JSONL record with >= 2 window
+#     snapshots for that operator, and a /v1/query/{id}/doctor verdict
+#     whose TOP cause names it.
+#   straggler phase — a per-dispatch sleep on task_index 1 skews the
+#     site watermarks: assert straggler_detected fingers that task.
+#   on-phase scrape must lint clean with all 4 inflight families, and
+#     the on-run rows must equal the off-run rows bit for bit.
+echo "== inflight smoke: stall/straggler detection + query doctor =="
+tmp_inf="$(mktemp -d)"
+env JAX_PLATFORMS=cpu PRESTO_TPU_INF_DIR="$tmp_inf" python - <<'PYEOF'
+import json, os, time, urllib.request
+
+from presto_tpu.catalog.tpch import tpch_catalog
+from presto_tpu.exec import runtime as runtime_mod
+from presto_tpu.obs import inflight
+from presto_tpu.obs.exposition import lint_exposition
+from presto_tpu.server.coordinator import DistributedRunner
+
+d = os.environ["PRESTO_TPU_INF_DIR"]
+slow_log = os.path.join(d, "slow.jsonl")
+cat = tpch_catalog(0.01)
+dr = DistributedRunner(cat, n_workers=2, coordinator_kwargs={
+    "slow_query_log": slow_log, "slow_query_threshold_s": 0.0})
+base = dr.coordinator.url
+inflight.configure(forensics_dir=d)
+
+SQL = ("select l_returnflag as f, sum(l_quantity) as q from lineitem "
+       "group by l_returnflag")
+TUNING = "batch_rows=4096,fragment_window=2"
+
+
+def run_sql(sql, session):
+    headers = {"X-Presto-User": "smoke", "Content-Type": "text/plain",
+               "X-Presto-Session": session}
+    req = urllib.request.Request(base + "/v1/statement",
+                                 data=sql.encode(), headers=headers)
+    doc = json.load(urllib.request.urlopen(req, timeout=120))
+    qid, rows = doc["id"], []
+    while True:
+        rows += doc.get("data") or []
+        nxt = doc.get("nextUri")
+        if not nxt:
+            break
+        doc = json.load(urllib.request.urlopen(nxt, timeout=120))
+    assert doc["stats"]["state"] == "FINISHED", doc
+    # group-by output order is not deterministic — compare as sets
+    return qid, sorted(map(repr, rows))
+
+
+def scrape():
+    return urllib.request.urlopen(
+        base + "/v1/metrics", timeout=10).read().decode()
+
+
+INF_FAMS = ("presto_tpu_inflight_queries",
+            "presto_tpu_inflight_publishes_total",
+            "presto_tpu_stalls_total", "presto_tpu_stragglers_total")
+
+# -- off phase: no families, baseline rows (also warms the program cache
+#    so the injected sleeps dominate the stall run's wall)
+q_off, rows_off = run_sql(SQL, "inflight=off," + TUNING)
+body = scrape()
+for fam in INF_FAMS:
+    assert fam not in body, f"{fam} leaked into an inflight=off scrape"
+assert inflight.snapshot_doc(q_off) is None
+assert not inflight.armed()
+
+# -- stall phase: from the 2nd dispatch of whichever breaker op gets
+#    there first, every subsequent dispatch of that op sleeps past the
+#    stall threshold with the row watermarks frozen
+orig_dispatch = runtime_mod._record_fragment_dispatch
+counts, injected = {}, {}
+
+
+def sleepy_dispatch(node, ctx, fused, k=1):
+    orig_dispatch(node, ctx, fused, k)
+    op = type(node).__name__
+    counts[op] = counts.get(op, 0) + 1
+    if counts[op] >= 2 and injected.setdefault("op", op) == op:
+        time.sleep(0.3)
+
+
+runtime_mod._record_fragment_dispatch = sleepy_dispatch
+try:
+    q_stall, rows_stall = run_sql(
+        SQL, "inflight=on,stall_threshold_s=0.12," + TUNING)
+finally:
+    runtime_mod._record_fragment_dispatch = orig_dispatch
+assert rows_stall == rows_off, "inflight=on changed query results"
+op = injected["op"]
+
+ev = json.load(urllib.request.urlopen(
+    base + "/v1/events?kind=stall_detected", timeout=10))
+stalls = [e for e in ev["events"] if e["queryId"] == q_stall]
+assert stalls, "no stall_detected event for the injected-sleep query"
+assert stalls[0]["operator"] == op, (op, stalls[0])
+assert stalls[0]["stalledS"] > 0.12
+
+recs = [json.loads(l)
+        for l in open(os.path.join(d, "inflight_forensics.jsonl"))]
+mine = [r for r in recs if r["queryId"] == q_stall]
+assert mine, "no forensic record for the stalled query"
+snap_lists = [o["snapshots"] for key, o in mine[-1]["ops"].items()
+              if key.endswith("/" + op)]
+assert snap_lists and max(len(s) for s in snap_lists) >= 2, (
+    f"forensics carries < 2 window snapshots for {op}")
+
+doc = json.load(urllib.request.urlopen(
+    base + f"/v1/query/{q_stall}/doctor", timeout=10))
+top = doc["causes"][0]
+assert top["cause"] == "stall" and top.get("operator") == op, doc["causes"]
+assert op in doc["verdict"], doc["verdict"]
+
+inf = json.load(urllib.request.urlopen(
+    base + f"/v1/query/{q_stall}/inflight", timeout=10))
+assert inf["publishes"] > 0 and inf["stalls"] >= 1
+assert op in inf["stallSeconds"]
+
+# -- straggler phase: every dispatch on task_index 1 sleeps, so that
+#    site's window watermark falls behind its sibling's in the same
+#    fragment while the leader runs at full speed
+def lag_dispatch(node, ctx, fused, k=1):
+    orig_dispatch(node, ctx, fused, k)
+    if getattr(ctx, "task_index", 0) == 1:
+        time.sleep(0.15)
+
+
+runtime_mod._record_fragment_dispatch = lag_dispatch
+try:
+    q_strag, rows_strag = run_sql(
+        SQL, "inflight=on,stall_threshold_s=0.6,straggler_factor=1.5,"
+        + TUNING)
+finally:
+    runtime_mod._record_fragment_dispatch = orig_dispatch
+assert rows_strag == rows_off
+
+ev = json.load(urllib.request.urlopen(
+    base + "/v1/events?kind=straggler_detected", timeout=10))
+strag = [e for e in ev["events"] if e["queryId"] == q_strag]
+assert strag, "no straggler_detected event for the lagged-dispatch query"
+lag = strag[0]
+assert lag["taskId"].split(".")[-1] == "1", lag
+assert lag["taskId"] != lag["leaderTaskId"]
+assert lag["leaderWindows"] > lag["laggardWindows"]
+
+# -- armed scrape: all 4 families render and the document lints clean
+body = scrape()
+for fam in INF_FAMS:
+    assert f"# TYPE {fam}" in body, f"{fam} missing from armed scrape"
+errs = lint_exposition(body)
+assert errs == [], errs
+
+# slow-query log carries the doctor verdict for the stalled run
+slow = [json.loads(l) for l in open(slow_log)]
+doctored = [r for r in slow if r.get("queryId") == q_stall
+            and "doctor" in r]
+assert doctored, "slow-query record missing doctor annotation"
+assert op in doctored[0]["doctor"]["verdict"]
+
+dr.close()
+print(f"inflight smoke OK: stall on {op} "
+      f"({stalls[0]['stalledS']:.2f}s, {inf['stalls']} episode(s)), "
+      f"straggler {lag['taskId']} {lag['laggardWindows']}/"
+      f"{lag['leaderWindows']} windows, doctor verdict attributed, "
+      f"off-scrape family-free, on/off rows identical")
+PYEOF
+rc=$?
+rm -rf "$tmp_inf"
+if [ "$rc" -ne 0 ]; then
+  echo "inflight smoke FAILED (exit $rc)"
+  exit "$rc"
+fi
+
 # Static-analysis step: the kernel lint must be clean over the shipped
 # tree, the analyzer must actually FAIL on an injected violation (a
 # linter that can't fail is decoration), the plan-invariant checker must

@@ -169,8 +169,13 @@ class WindowSource:
     is lost when the consumer abandons the window loop mid-stream."""
 
     def __init__(self, stream: Iterable[Batch], width: int,
-                 bucket: bool = False):
+                 bucket: bool = False,
+                 on_window: Optional[Callable[[int, int], None]] = None):
         self._stream = iter(stream)
+        # window-boundary telemetry hook (obs/inflight publish): called
+        # (k, width) from the producer thread after each staged flush —
+        # host-side counts only, never a device sync. None = no-op.
+        self._on_window = on_window
         # host-side producer config, not traced code (the module-wide
         # kernel scope is for the stepper builders below)
         self._width = max(2, int(width))  # lint: allow(host-sync)
@@ -212,6 +217,14 @@ class WindowSource:
             self._put(_SENTINEL, force=True)
 
     def _put(self, item, force: bool = False) -> bool:
+        if item is not _SENTINEL and self._on_window is not None:
+            k, width = (item.k, item.width) if isinstance(item, Window) \
+                else (1, 1)
+            try:
+                self._on_window(k, width)
+            except Exception:
+                # telemetry must never kill the producer thread
+                pass
         while True:
             stopped = self._stop.is_set()
             if stopped and not force:

@@ -282,6 +282,20 @@ class ExecConfig:
     # cache / speculatively precompile during queue wait; "off" (default)
     # is a strict no-op — no corpus writes, no claims, no metric families.
     compile_farm: str = "off"
+    # mid-flight telemetry plane (obs/inflight.py): "on" makes drivers
+    # publish operator watermarks (windows dispatched, rows in/out, spill
+    # depth/repartitions, replay caps, lane util) into the per-query
+    # inflight store at wave/window boundaries — host-held counts only,
+    # never a fresh device sync; "off" (default) is a strict no-op — no
+    # publishes, no watcher, no metric families, today's engine
+    # bit-for-bit.
+    inflight: str = "off"
+    # stall detector bound: row watermarks frozen for this many seconds
+    # while the query executes → stall_detected event + forensics dump
+    stall_threshold_s: float = 2.0
+    # straggler detector bound: a fragment site > factor x behind its
+    # siblings' window watermark → straggler_detected event + slow-log doc
+    straggler_factor: float = 4.0
 
 
 def _node_jit(node: PlanNode, key: str, builder, _shared=True, **jit_kwargs):
@@ -368,6 +382,10 @@ class ExecContext:
         # teardown can close+unlink them even when the operator generator
         # died mid-spill (failed or canceled query) — close() is idempotent
         self.spill_resources: List = []
+        # mid-flight telemetry publisher (obs/inflight.TaskInflight) —
+        # installed by the worker task when the `inflight` session
+        # property is on; None = every publish hook is a no-op
+        self.inflight = None
 
     def track_spill(self, resource) -> None:
         self.spill_resources.append(resource)
@@ -2081,6 +2099,43 @@ def _record_fragment_dispatch(node: PlanNode, ctx: "ExecContext",
         ctx.stats["fragment.batch_dispatches"] = (
             ctx.stats.get("fragment.batch_dispatches", 0) + 1)
         _scan_metrics.record("batch_dispatches", 1)
+    if ctx.inflight is not None:
+        # window-boundary heartbeat: counts the driver already holds —
+        # never a device sync (obs/inflight.py off-discipline)
+        ctx.inflight.publish(type(node).__name__,
+                             windows=1 if fused else 0, batches=k)
+
+
+def _inflight_window_hook(node: PlanNode, ctx: "ExecContext"):
+    """WindowSource on_window callback publishing the staging watermark
+    (windows stacked ahead of the consumer) into the inflight plane.
+    None when the plane is off, so the producer thread pays nothing."""
+    inf = ctx.inflight
+    if inf is None:
+        return None
+    op = type(node).__name__
+    staged = {"n": 0}
+
+    def hook(k: int, width: int) -> None:
+        staged["n"] += 1
+        inf.publish(op, stagedWindows=staged["n"])
+
+    return hook
+
+
+def _inflight_spill_hook(node: PlanNode, ctx: "ExecContext"):
+    """PartitioningSpiller on_spill callback publishing the spill
+    watermark (cumulative bytes + partition-tree depth) per routed
+    batch. None when the plane is off."""
+    inf = ctx.inflight
+    if inf is None:
+        return None
+    op = type(node).__name__
+
+    def hook(nbytes: int, depth: int) -> None:
+        inf.publish(op, spilledBytes=int(nbytes), spillDepth=int(depth))
+
+    return hook
 
 
 def _bump_replay_wave(node: PlanNode, ctx: "ExecContext",
@@ -2104,6 +2159,10 @@ def _bump_replay_wave(node: PlanNode, ctx: "ExecContext",
             attrs["cap_to"] = cap_to
         ctx.tracer.record("overflow_replay", "overflow_replay", t, t,
                           **attrs)
+    if ctx.inflight is not None:
+        ctx.inflight.publish(type(node).__name__,
+                             wave=ctx.stats["breaker.replay_waves"],
+                             cap=cap_to)
 
 
 def _spill_stats_for(node: PlanNode, ctx: "ExecContext") -> dict:
@@ -2132,6 +2191,10 @@ def _note_spill_repartition(node: PlanNode, ctx: "ExecContext",
                           node=type(node).__name__, partition=int(parent_p),
                           depth=int(child.depth),
                           fanout=int(child.n_partitions))
+    if ctx.inflight is not None:
+        ctx.inflight.publish(type(node).__name__,
+                             repartitions=st["repartitions"],
+                             spillDepth=st["depth"])
 
 
 def _note_spill_revoke(node: PlanNode, ctx: "ExecContext",
@@ -2148,6 +2211,9 @@ def _note_spill_revoke(node: PlanNode, ctx: "ExecContext",
         t = time.time()
         ctx.tracer.record("spill_revoke", "spill_revoke", t, t,
                           node=type(node).__name__, freed=int(freed))
+    if ctx.inflight is not None:
+        ctx.inflight.publish(type(node).__name__,
+                             spilledBytes=int(freed))
 
 
 def _spill_replay_budget(ctx: "ExecContext") -> Optional[int]:
@@ -2660,7 +2726,8 @@ def _execute_aggregate(node: Aggregate, ctx: ExecContext) -> Iterator[Batch]:
             state["raw_spiller"] = ctx.spill_manager.partitioning_spiller(
                 key_syms, grace_P, "agg-raw",
                 on_grow=lambda child, pp: _note_spill_repartition(
-                    node, ctx, child, pp))
+                    node, ctx, child, pp),
+                on_spill=_inflight_spill_hook(node, ctx))
             ctx.track_spill(state["raw_spiller"])
         return state["raw_spiller"]
 
@@ -2675,7 +2742,8 @@ def _execute_aggregate(node: Aggregate, ctx: ExecContext) -> Iterator[Batch]:
             state["spiller"] = ctx.spill_manager.partitioning_spiller(
                 key_syms, grace_P, "agg",
                 on_grow=lambda child, pp: _note_spill_repartition(
-                    node, ctx, child, pp))
+                    node, ctx, child, pp),
+                on_spill=_inflight_spill_hook(node, ctx))
             ctx.track_spill(state["spiller"])
         state["spiller"].spill(acc0)
         freed = mctx.bytes
@@ -2940,7 +3008,8 @@ def _execute_aggregate(node: Aggregate, ctx: ExecContext) -> Iterator[Batch]:
 
             src = _fragment_jit.WindowSource(
                 stream, _hbo_fragment_window(node, ctx),
-                bucket=ctx.config.shape_bucketing != "off")
+                bucket=ctx.config.shape_bucketing != "off",
+                on_window=_inflight_window_hook(node, ctx))
             try:
                 for item in src:
                     dispatch(item)
@@ -3668,7 +3737,8 @@ def _execute_join(node: HashJoin, ctx: ExecContext) -> Iterator[Batch]:
                     partition_budget_bytes=_spill_replay_budget(ctx),
                     max_depth=max(0, ctx.config.spill_max_depth),
                     on_grow=lambda child, pp: _note_spill_repartition(
-                        node, ctx, child, pp))
+                        node, ctx, child, pp),
+                    on_spill=_inflight_spill_hook(node, ctx))
                 ctx.track_spill(bspiller)
                 for bb in build_batches:
                     bspiller.spill(bb)
@@ -4924,7 +4994,8 @@ def _execute_sort(node: Sort, ctx: ExecContext) -> Iterator[Batch]:
                 lambda: _fragment_jit.topn_stepper(topn_step, True))
             src = _fragment_jit.WindowSource(
                 in_stream, ctx.config.fragment_window,
-                bucket=ctx.config.shape_bucketing != "off")
+                bucket=ctx.config.shape_bucketing != "off",
+                on_window=_inflight_window_hook(node, ctx))
             try:
                 for item in src:
                     if isinstance(item, _fragment_jit.Window):

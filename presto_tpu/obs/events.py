@@ -208,13 +208,16 @@ class ClusterEventStream:
     def events(self, since: int = 0, query_id: Optional[str] = None,
                kind: Optional[str] = None,
                limit: int = 1000) -> List[Dict[str, Any]]:
+        """Events with seq > ``since``, oldest first, at most ``limit`` —
+        a full page means more may follow, so advancing ``since`` to the
+        page's last seq never skips events the ring still holds."""
         with self._lock:
             out = [dict(r) for r in self._buf if r["seq"] > since]
         if query_id is not None:
             out = [r for r in out if r.get("queryId") == query_id]
         if kind is not None:
             out = [r for r in out if r.get("kind") == kind]
-        return out[-limit:]
+        return out[:limit]
 
     def last_seq(self) -> int:
         with self._lock:

@@ -220,7 +220,14 @@ _SEGMENT_HISTOGRAMS = {
 
 _counter_lock = threading.Lock()
 _slo_violations: Dict[Tuple[str, str], int] = {}   # (group, segment) -> n
-_latency_regressions: Dict[str, int] = {}          # group -> n
+# regressions attributed to the lifecycle segment that moved most vs the
+# group's running baseline: (group, segment) -> n
+_latency_regressions: Dict[Tuple[str, str], int] = {}
+# per-(group, segment) running mean of completed-query segment walls:
+# (group, segment) -> (sum_s, n). Folded AFTER each query's regression
+# check, so attribution always compares against prior completions only.
+_segment_baselines: Dict[Tuple[str, str], Tuple[float, int]] = {}
+_REGRESSION_SEGMENTS = ("queue_wait", "plan", "compile", "exec", "drain")
 
 _armed = False
 
@@ -252,9 +259,10 @@ def metric_rows(labels: Dict[str, str]) -> List[tuple]:
         rows.append(("presto_tpu_slo_violations_total", help_v, 0,
                      dict(labels), "counter"))
     if regr:
-        for group, n in sorted(regr.items()):
+        for (group, seg), n in sorted(regr.items()):
             rows.append(("presto_tpu_latency_regression_total", help_r, n,
-                         {**labels, "group": group}, "counter"))
+                         {**labels, "group": group, "segment": seg},
+                         "counter"))
     else:
         rows.append(("presto_tpu_latency_regression_total", help_r, 0,
                      dict(labels), "counter"))
@@ -478,9 +486,16 @@ def progress_doc(query_id: str,
         root_rows, root_batches = entry.rows, entry.batches
         predicted = dict(entry.predicted) if entry.predicted else None
         waves = entry.replay_waves
+        cache_hit = entry.cache_info is not None
     provenance = "fragments"
     fraction = min(frag_frac, 0.95)
-    if predicted:
+    if cache_hit:
+        # result-cache short circuit: the query never executes, so HBO's
+        # row/wall estimates would pin the fraction below 1.0 forever —
+        # a cache hit IS completion
+        provenance = "cache"
+        fraction = 1.0
+    elif predicted:
         provenance = "hbo"
         estimates = [fraction]
         p_rows = float(predicted.get("rows", 0) or 0)
@@ -565,21 +580,52 @@ def complete(info, spans: Optional[list] = None) -> None:
         factor = entry.regression_factor
         base_wall = float((baseline or {}).get("wall_s", 0) or 0)
         if factor > 0 and base_wall > 0 and wall >= factor * base_wall:
+            seg_attr = _attribute_regression(group, segments)
             entry.regression = {
                 "wallS": round(wall, 6),
                 "baselineWallS": round(base_wall, 6),
                 "factor": factor,
                 "fingerprint": entry.fingerprint,
+                "segment": seg_attr,
             }
             with _counter_lock:
-                _latency_regressions[group] = (
-                    _latency_regressions.get(group, 0) + 1)
+                key = (group, seg_attr)
+                _latency_regressions[key] = (
+                    _latency_regressions.get(key, 0) + 1)
             _obs_events.EVENTS.emit(
                 "latency_regression", query_id=entry.query_id, group=group,
                 **entry.regression)
         w_rows, _ = entry.worker_rows()
         _runstats.note(entry.fingerprint, HBO_SITE,
                        wall_s=wall, rows=entry.rows, sink_rows=w_rows)
+    if state == "finished":
+        # fold AFTER the regression check: baselines are means over prior
+        # completions, never contaminated by the run being judged
+        with _counter_lock:
+            for seg in _REGRESSION_SEGMENTS:
+                s, n = _segment_baselines.get((group, seg), (0.0, 0))
+                _segment_baselines[(group, seg)] = (
+                    s + float(segments.get(seg, 0.0) or 0.0), n + 1)
+
+
+def _attribute_regression(group: str, segments: Dict[str, float]) -> str:
+    """Name the lifecycle segment that regressed most vs the group's
+    running baseline (largest actual/mean ratio over prior completions).
+    Falls back to ``e2e`` when no baseline exists yet — the first slow
+    query in a group has nothing to compare segments against."""
+    best, best_ratio = "e2e", 0.0
+    with _counter_lock:
+        for seg in _REGRESSION_SEGMENTS:
+            s, n = _segment_baselines.get((group, seg), (0.0, 0))
+            if n == 0:
+                continue
+            mean = s / n
+            if mean <= 1e-6:
+                continue
+            ratio = float(segments.get(seg, 0.0) or 0.0) / mean
+            if ratio > best_ratio:
+                best, best_ratio = seg, ratio
+    return best
 
 
 def _span_events(entry: QueryLifecycle, spans: list) -> None:
@@ -618,6 +664,7 @@ def reset() -> None:
     with _counter_lock:
         _slo_violations.clear()
         _latency_regressions.clear()
+        _segment_baselines.clear()
         _armed = False
     for h in SLO_HISTOGRAMS:
         h.reset()

@@ -1008,6 +1008,32 @@ class MeshExecutor:
         _scan_metrics.record("mesh_exchange_bytes", total_bytes)
         _scan_metrics.record("mesh_exchange_lanes_used", total_used)
         _scan_metrics.record("mesh_exchange_lanes_total", total_slots)
+
+        # mid-flight telemetry: per-site overflow watermarks + per-exchange
+        # lane utilization into the inflight plane (no-op unless the query
+        # registered with inflight=on; the vectors above are already host)
+        if getattr(self.config, "inflight", "off") == "on":
+            try:
+                from presto_tpu.obs import inflight as _obs_inflight
+
+                qid = getattr(_obs_trace.current(), "trace_id", None)
+                if qid is not None and _obs_inflight.get(qid) is not None:
+                    labels = meta.get("labels", [])
+                    for i, v in enumerate(ovf):
+                        _obs_inflight.publish(
+                            qid, f"site{i}:{labels[i]}" if i < len(labels)
+                            else f"site{i}", windows=1,
+                            overflow=int(v), site=i)
+                    for e in exchanges:
+                        _obs_inflight.publish(
+                            qid, f"exchange_f{e['fid']}",
+                            task_id=f"mesh.f{e['fid']}",
+                            fragment=int(e["fid"]), windows=1,
+                            laneUtil=round(e["util"], 4),
+                            lanesUsed=e["lanes_used"],
+                            lanesTotal=e["lanes_total"])
+            except Exception:
+                pass
         attempts.append({
             "labels": list(meta.get("labels", ())),
             "site_caps": list(meta.get("caps", ())),

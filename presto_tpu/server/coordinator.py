@@ -34,6 +34,7 @@ from presto_tpu.connector import Catalog
 from presto_tpu.exec import farm as _farm
 from presto_tpu.exec.runtime import ExecConfig
 from presto_tpu.obs import events as _obs_events
+from presto_tpu.obs import inflight as _obs_inflight
 from presto_tpu.obs import lifecycle as _obs_lifecycle
 from presto_tpu.obs import trace as _obs_trace
 from presto_tpu.plan.fragmenter import (
@@ -138,6 +139,12 @@ class HeartbeatFailureDetector:
                 # counts into the progress registry (attempt ids resolve
                 # through the registry's alias map)
                 _obs_lifecycle.merge_worker_progress(n.node_id, progress)
+            inflight = status.get("queryInflight")
+            if inflight:
+                # inflight plane: per-task operator watermarks, merged
+                # per fragment (seq-guarded — in-process clusters whose
+                # publishers already live in the registry are idempotent)
+                _obs_inflight.merge_worker(n.node_id, inflight)
         except Exception:
             n.record_failure()
 
@@ -780,6 +787,16 @@ class Coordinator:
         # a low-memory kill stamps a memory_kill span onto the victim's
         # trace (registry exists only now — created after the manager)
         self.cluster_memory.trace_registry = self.trace_registry
+        # inflight plane: stall forensics get the victim's open span stack
+        # and pool reservations; configure() never arms, so off sessions
+        # stay bit-for-bit
+        _obs_inflight.configure(
+            span_provider=lambda qid: (
+                tr.spans() if (tr := self.trace_registry.get(qid))
+                is not None else None),
+            pool_provider=lambda qid: (
+                (self.cluster_memory.memory_rollup().get("queryMemory")
+                 or {}).get(qid)))
 
         if events_log:
             # unified cluster event stream JSONL sink (/v1/events mirrors
@@ -846,10 +863,17 @@ class Coordinator:
                     mem = doc or None
                 except Exception:
                     mem = None
+                extra = _obs_lifecycle.slow_log_annotation(info.query_id)
+                try:
+                    # inflight plane: doctor verdict + straggler docs ride
+                    # the slow-query record when the plane saw the query
+                    inf = _obs_inflight.slow_log_annotation(info.query_id)
+                    if inf:
+                        extra = {**(extra or {}), **inf}
+                except Exception:
+                    pass
                 _s.log(info, tr.spans() if tr is not None else None,
-                       memory=mem,
-                       extra=_obs_lifecycle.slow_log_annotation(
-                           info.query_id))
+                       memory=mem, extra=extra)
 
             self.query_manager.listeners.append(_log_slow)
         if query_event_log:
@@ -985,6 +1009,10 @@ class Coordinator:
         if entry is not None:
             _obs_lifecycle.mark(session_qid, "compiling")
             _obs_lifecycle.alias(qid, entry.query_id)
+        if session_qid and _obs_inflight.get(session_qid) is not None:
+            # task publishers key by the scheduler attempt id; route them
+            # to the session's inflight entry
+            _obs_inflight.alias(qid, session_qid)
         tracer = _obs_trace.NOOP
         if getattr(cfg, "tracing", True):
             tracer = _obs_trace.Tracer(
@@ -1014,6 +1042,22 @@ class Coordinator:
                               "drain", "e2e")),
                 "",
             ]
+        try:
+            # query doctor: ranked bottleneck attribution over lifecycle +
+            # inflight telemetry (present only when a plane saw the query)
+            tr_spans = (tracer.spans()
+                        if tracer is not _obs_trace.NOOP else None)
+            doctor = _obs_inflight.analyze(session_qid or qid,
+                                           spans=tr_spans)
+            if doctor is not None and doctor.get("verdict"):
+                lines += ["-- doctor --", "  " + doctor["verdict"]]
+                for c in doctor.get("causes", [])[1:3]:
+                    lines.append(
+                        f"    also: {c['cause']}"
+                        f" ({c['score']:.0%}) {c.get('detail', '')}".rstrip())
+                lines.append("")
+        except Exception:
+            pass
         lines += [dplan.to_string(), "", "-- task execution profile --"]
         by_fid: Dict[int, list] = {}
         for tid, fid, info in stats:
@@ -1155,6 +1199,31 @@ class Coordinator:
                             {"error": "no lifecycle for query "
                                       "(unknown id or lifecycle=off)"}, 404)
                     return self._json(doc)
+                m = re.match(r"^/v1/query/([^/]+)/inflight$", self.path)
+                if m:
+                    doc = _obs_inflight.snapshot_doc(m.group(1))
+                    if doc is None:
+                        return self._json(
+                            {"error": "no inflight telemetry for query "
+                                      "(unknown id or inflight=off)"}, 404)
+                    return self._json(doc)
+                m = re.match(r"^/v1/query/([^/]+)/doctor$", self.path)
+                if m:
+                    qid = m.group(1)
+                    state = None
+                    try:
+                        state = coord.query_manager.get(qid).state
+                    except KeyError:
+                        pass
+                    tr = coord.trace_registry.get(qid)
+                    doc = _obs_inflight.analyze(
+                        qid, spans=tr.spans() if tr is not None else None,
+                        state=state)
+                    if doc is None:
+                        return self._json(
+                            {"error": "no telemetry for query (unknown id "
+                                      "or lifecycle+inflight off)"}, 404)
+                    return self._json(doc)
                 m = re.match(r"^/v1/events(?:\?(.*))?$", self.path)
                 if m:
                     import urllib.parse as _up
@@ -1279,6 +1348,9 @@ class Coordinator:
             # minted as the serving query id), so worker heartbeats keyed
             # by this attempt reach the right registry slot
             _obs_lifecycle.alias(qid, tracer.trace_id)
+            # ... and to the inflight entry, so task publishers keyed by
+            # this attempt heartbeat into the serving query's telemetry
+            _obs_inflight.alias(qid, tracer.trace_id)
         entry = _obs_lifecycle.get(qid)
         if entry is None:
             yield from self.scheduler.execute(qid, dplan, workers, config,

@@ -147,6 +147,13 @@ def coordinator_metrics(coordinator) -> str:
         slo_rows = obs_lifecycle.metric_rows({"plane": "coordinator"})
         text += (render_metrics(slo_rows) if slo_rows else "")
         text += obs_lifecycle.render_slo_histograms("coordinator")
+    from presto_tpu.obs import inflight as obs_inflight
+
+    # inflight families are likewise armed-gated: no query ever registered
+    # (inflight=off everywhere) leaves the scrape family-free
+    if obs_inflight.armed():
+        inf_rows = obs_inflight.metric_rows({"plane": "coordinator"})
+        text += (render_metrics(inf_rows) if inf_rows else "")
     return text
 
 

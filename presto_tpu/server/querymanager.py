@@ -243,6 +243,26 @@ class QueryManager:
             qe.timeline = _lifecycle.register(
                 qe.query_id, objectives=objectives,
                 regression_factor=factor).timeline
+        try:
+            inflight_on = str(session.get("inflight")).lower() == "on"
+        except KeyError:
+            inflight_on = False
+        if inflight_on:
+            # inflight plane (obs/inflight.py): operator heartbeats, the
+            # stall/straggler watcher, and the query doctor; registering
+            # arms the plane — off sessions never reach this
+            from presto_tpu.obs import inflight as _inflight
+
+            try:
+                thr = float(session.get("stall_threshold_s"))
+            except (KeyError, TypeError, ValueError):
+                thr = 2.0
+            try:
+                sf = float(session.get("straggler_factor"))
+            except (KeyError, TypeError, ValueError):
+                sf = 4.0
+            _inflight.register(qe.query_id, stall_threshold_s=thr,
+                               straggler_factor=sf)
         with self._lock:
             self._queries[qe.query_id] = qe
         self._emit("queryCreated", qe)
@@ -255,6 +275,14 @@ class QueryManager:
             entry = _lifecycle.get(qe.query_id)
             if entry is not None:
                 entry.group = gid
+            try:
+                from presto_tpu.obs import inflight as _inflight
+
+                inf = _inflight.get(qe.query_id)
+                if inf is not None:
+                    inf.group = gid
+            except Exception:
+                pass
 
         def start_from_group(qe=qe):
             qe._rg_slot_held = True
@@ -322,6 +350,14 @@ class QueryManager:
         if state in TERMINAL:
             self._charge_compiles(qe)
             self._release_slot(qe)
+            try:
+                # inflight plane: close any open stall episode and stop
+                # the watcher from flagging the finished query
+                from presto_tpu.obs import inflight as _inflight
+
+                _inflight.finish(qe.query_id)
+            except Exception:
+                pass
             self._emit("queryCompleted", qe)
 
     def _charge_compiles(self, qe: QueryExecution):

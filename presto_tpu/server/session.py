@@ -315,6 +315,28 @@ class SystemSessionProperties:
                              "is a strict no-op (no corpus IO, no claims, "
                              "no metric families)", str, "off",
                              validator=_enum("compile_farm", ["OFF", "ON"])),
+            # mid-flight telemetry plane (obs/inflight.py)
+            PropertyMetadata("inflight",
+                             "Live operator telemetry: off reproduces the "
+                             "pre-inflight serving path bit-for-bit (no "
+                             "publishes, no watcher thread, no metric "
+                             "families); on makes drivers publish operator "
+                             "watermarks at window boundaries, arms the "
+                             "stall/straggler watcher, and enables "
+                             "/v1/query/{id}/inflight and /doctor", str,
+                             "off", validator=_enum("inflight",
+                                                    ["OFF", "ON"])),
+            PropertyMetadata("stall_threshold_s",
+                             "Stall detector bound: row watermarks frozen "
+                             "this many seconds while the query executes "
+                             "fires stall_detected plus a forensics dump",
+                             float, 2.0,
+                             validator=_positive("stall_threshold_s")),
+            PropertyMetadata("straggler_factor",
+                             "Straggler detector bound: a fragment site "
+                             "this many times behind its siblings' window "
+                             "watermark fires straggler_detected", float,
+                             4.0, validator=_positive("straggler_factor")),
         ]
 
     def names(self) -> List[str]:
@@ -439,4 +461,7 @@ class Session:
             result_cache=self.get("result_cache").lower(),
             shape_bucketing=self.get("shape_bucketing").lower(),
             compile_farm=self.get("compile_farm").lower(),
+            inflight=self.get("inflight").lower(),
+            stall_threshold_s=self.get("stall_threshold_s"),
+            straggler_factor=self.get("straggler_factor"),
         )

@@ -195,6 +195,17 @@ class TaskExecution:
             update.config.get("lifecycle", "on")).lower() == "on"
         self.rows_emitted = 0
         self.batches_emitted = 0
+        # mid-flight telemetry plane (obs/inflight.py): a per-task
+        # publisher operators heartbeat through at window boundaries;
+        # gated — inflight=off keeps the task path bit-for-bit
+        self._inflight = None
+        if str(update.config.get("inflight", "off")).lower() == "on":
+            from presto_tpu.obs import inflight as _obs_inflight
+
+            m = _TASK_ID_RE.match(task_id)
+            self._inflight = _obs_inflight.task(
+                m.group(1) if m else task_id, task_id,
+                fragment=int(m.group(2)) if m else 0)
         f = update.fragment
         self.buffer = OutputBuffer(
             update.n_out_partitions,
@@ -272,6 +283,8 @@ class TaskExecution:
             self.finished_at = time.time()
             self.buffer.fail(self.error)
         finally:
+            if self._inflight is not None:
+                self._inflight.finish()
             for c in self._clients:
                 c.close()
 
@@ -288,6 +301,7 @@ class TaskExecution:
 
     def _run_with_ctx(self, cfg: ExecConfig, ctx: ExecContext):
         ctx.tracer = self.tracer
+        ctx.inflight = self._inflight
         ctx.task_index = self.update.task_index
         ctx.n_tasks = self.update.n_tasks
         ctx.split_assignment = self.update.split_assignment
@@ -384,14 +398,21 @@ class TaskExecution:
 
     def _make_sink(self, f: Fragment, cfg):
         sink = self._make_sink_inner(f, cfg)
-        if not self._count_progress:
+        if not self._count_progress and self._inflight is None:
             return sink
 
         def counting_sink(b: Batch, _sink=sink):
             # live-row accounting happens before the inner sink's own
             # serialize so a sink raise still leaves the rows visible
-            self.rows_emitted += int(np.asarray(b.live).sum())
-            self.batches_emitted += 1
+            rows = 0
+            if self._count_progress:
+                rows = int(np.asarray(b.live).sum())
+                self.rows_emitted += rows
+                self.batches_emitted += 1
+            if self._inflight is not None:
+                # rows ride along only when lifecycle already synced the
+                # live count — inflight alone never adds a device sync
+                self._inflight.publish("output", rows_out=rows, batches=1)
             _sink(b)
 
         return counting_sink
@@ -559,6 +580,20 @@ class TaskManager:
             out[qid]["fragmentsDone"] = sum(
                 1 for states in fmap.values()
                 if all(s != "running" for s in states))
+        return out
+
+    def query_inflight(self) -> Dict[str, dict]:
+        """Per-task inflight telemetry docs keyed by attempt query id ->
+        task id, for the heartbeat (`queryInflight`). Empty when no task
+        publishes, so the heartbeat doc stays bit-for-bit pre-inflight."""
+        with self._lock:
+            tasks = list(self.tasks.values())
+        out: Dict[str, dict] = {}
+        for t in tasks:
+            pub = getattr(t, "_inflight", None)
+            if pub is None or not pub.ops:
+                continue
+            out.setdefault(pub.query_id, {})[t.task_id] = pub.doc()
         return out
 
     def query_memory(self) -> Dict[str, int]:
@@ -854,6 +889,12 @@ class Worker:
             # lifecycle plane: live operator row counts ride the heartbeat
             # so the coordinator's progress endpoint sees mid-query state
             doc["queryProgress"] = progress
+        inflight = self.task_manager.query_inflight()
+        if inflight:
+            # inflight plane: per-task operator watermarks ride the
+            # heartbeat; the coordinator merges them per fragment (seq-
+            # guarded, so the in-process cluster never double-counts)
+            doc["queryInflight"] = inflight
         try:
             from presto_tpu.obs import devprof as _devprof
 

@@ -234,7 +234,8 @@ class PartitioningSpiller:
                  partition_budget_bytes: Optional[int] = None,
                  max_depth: int = 0,
                  on_grow: Optional[Callable[["PartitioningSpiller", int],
-                                            None]] = None):
+                                            None]] = None,
+                 on_spill: Optional[Callable[[int, int], None]] = None):
         self.spill_dir = spill_dir
         self.key_names = tuple(key_names)
         self.n_partitions = n_partitions
@@ -245,6 +246,10 @@ class PartitioningSpiller:
         self.partition_budget_bytes = partition_budget_bytes
         self.max_depth = max_depth
         self.on_grow = on_grow
+        # batch-boundary telemetry hook (obs/inflight plane): called
+        # (spilled_bytes, max_leaf_depth) after each routed batch on the
+        # ROOT spiller only — children report through their root
+        self.on_spill = on_spill
         # per-row device-byte width (schema-static), estimated lazily from
         # the first spilled batch and inherited by children on grow
         self._row_width: Optional[int] = None
@@ -287,6 +292,11 @@ class PartitioningSpiller:
                     and self.files[p].rows * self._row_width
                     > self.partition_budget_bytes):
                 self.grow_partition(p)
+        if self.on_spill is not None:
+            try:
+                self.on_spill(self.spilled_bytes, self.max_leaf_depth())
+            except Exception:
+                pass
 
     def spill_unpartitioned(self, batch: Batch):
         """Whole-batch append to partition 0 (single-stream mode: sort runs,
@@ -426,14 +436,14 @@ class SpillManager:
                              tag: str = "spill",
                              partition_budget_bytes: Optional[int] = None,
                              max_depth: int = 0,
-                             on_grow=None) -> PartitioningSpiller:
+                             on_grow=None, on_spill=None) -> PartitioningSpiller:
         d = self.dir
         with self._lock:
             self.spill_count += 1
         return PartitioningSpiller(
             d, key_names, n_partitions, tag, manager=self,
             partition_budget_bytes=partition_budget_bytes,
-            max_depth=max_depth, on_grow=on_grow)
+            max_depth=max_depth, on_grow=on_grow, on_spill=on_spill)
 
     def charge(self, bytes_: int):
         with self._lock:

@@ -148,6 +148,81 @@ def test_event_stream_ring_and_filters(tmp_path):
     assert recs[-1]["traceToken"] == "qx"
 
 
+def test_event_stream_concurrent_writers_paged_reads_no_gaps():
+    # N writer threads publish while readers page with since=; every
+    # reader must observe every seq exactly once, in order — the emit
+    # critical section assigns seq and appends atomically, and events()
+    # pages oldest-first so a full page never skips what the ring holds
+    es = obs_events.ClusterEventStream(capacity=10000)
+    n_writers, per = 6, 150
+    total = n_writers * per
+    done = threading.Event()
+
+    def writer(i):
+        for j in range(per):
+            es.emit("even" if j % 2 == 0 else "odd",
+                    query_id=f"w{i}", n=j)
+
+    collected = {}
+
+    def reader(name):
+        seqs = []
+        since = 0
+        while True:
+            page = es.events(since=since, limit=37)
+            if page:
+                seqs.extend(e["seq"] for e in page)
+                since = seqs[-1]
+            elif done.is_set() and since >= es.last_seq():
+                break
+            else:
+                time.sleep(0.001)
+        collected[name] = seqs
+
+    readers = [threading.Thread(target=reader, args=(f"r{k}",))
+               for k in range(2)]
+    writers = [threading.Thread(target=writer, args=(i,))
+               for i in range(n_writers)]
+    for t in readers:
+        t.start()
+    for t in writers:
+        t.start()
+    for t in writers:
+        t.join()
+    done.set()
+    for t in readers:
+        t.join()
+
+    assert es.last_seq() == total
+    for seqs in collected.values():
+        assert seqs == list(range(1, total + 1))
+
+
+def test_event_stream_limit_and_kind_filters_compose():
+    es = obs_events.ClusterEventStream(capacity=10000)
+    for i in range(60):
+        es.emit("even" if i % 2 == 0 else "odd", query_id=f"q{i % 3}", n=i)
+    # kind filter then limit: oldest `limit` of the matching events
+    page = es.events(kind="even", limit=10)
+    assert len(page) == 10
+    assert all(e["kind"] == "even" for e in page)
+    assert [e["seq"] for e in page] == list(range(1, 21, 2))
+    # since + kind + limit page through the filtered stream without skips
+    seen = []
+    since = 0
+    while True:
+        page = es.events(since=since, kind="odd", limit=7)
+        if not page:
+            break
+        seen.extend(e["seq"] for e in page)
+        since = page[-1]["seq"]
+    assert seen == list(range(2, 61, 2))
+    # query_id composes with kind
+    both = es.events(query_id="q0", kind="even")
+    assert all(e["queryId"] == "q0" and e["kind"] == "even" for e in both)
+    assert both  # q0, even: i % 3 == 0 and i % 2 == 0 both hold for i=0, 6, ...
+
+
 def test_slow_query_logger_extra_annotation(tmp_path):
     from presto_tpu.server.querymanager import QueryInfo
 
