@@ -45,6 +45,8 @@ Env knobs:
                   serving_slo closed-loop knobs (default 0.1 / 8 / 4)
   BENCH_PALLAS=1  run aggregation configs with the Pallas MXU kernel
   BENCH_SPILL_ROWS  build-side rows for the spill_skew config (default 400000)
+  BENCH_SF_MULTIWAY  scale factor for the multiway_ab join-chain A/B
+                  (default 0.1)
 """
 
 import json
@@ -674,6 +676,109 @@ def _spill_child(n_rows: int):
     }), flush=True)
 
 
+def _multiway_child(sf: float):
+    """Star-chain join A/B (PR18 multiway engine): q3/q9/q64-shaped
+    chains run binary (join_mode=off — the pre-collapse path) vs forced
+    multiway in one process. Per mode: best wall, compiled-program count
+    (process cache reset between modes so each pays its own compiles),
+    and for the q3 shape a 2-worker distributed leg counting exchanged
+    bytes (OutputBuffer page lengths) and plan fragments. The checksum
+    ties the A and B legs to the same answer."""
+    if os.environ.get("BENCH_FORCE_CPU"):
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    from presto_tpu.catalog.tpch import tpch_catalog
+    from presto_tpu.exec import ExecConfig, LocalRunner, programs
+    from presto_tpu.verifier import result_checksum
+
+    cat = tpch_catalog(sf)
+    queries = {
+        "q3_shape": (
+            "select o.o_orderkey, sum(l.l_extendedprice) rev "
+            "from lineitem l "
+            "join orders o on l.l_orderkey = o.o_orderkey "
+            "join customer c on o.o_custkey = c.c_custkey "
+            "where c.c_mktsegment = 'BUILDING' "
+            "group by o.o_orderkey"),
+        "q9_shape": (
+            "select s.s_nationkey, count(*) c, "
+            "sum(l.l_extendedprice * (1 - l.l_discount)) v "
+            "from lineitem l "
+            "join supplier s on l.l_suppkey = s.s_suppkey "
+            "join part p on l.l_partkey = p.p_partkey "
+            "join orders o on l.l_orderkey = o.o_orderkey "
+            "group by s.s_nationkey"),
+        "q64_shape": (
+            "select n.n_name, count(*) c "
+            "from orders o "
+            "join customer c on o.o_custkey = c.c_custkey "
+            "left join nation n on c.c_nationkey = n.n_nationkey "
+            "join lineitem l on o.o_orderkey = l.l_orderkey "
+            "group by n.n_name"),
+    }
+    rec = {"sf_actual": sf}
+    for name, sql in queries.items():
+        entry = {}
+        sums = {}
+        for mode in ("binary", "multiway"):
+            jm = "off" if mode == "binary" else "multiway"
+            r = LocalRunner(cat, ExecConfig(batch_rows=1 << 15,
+                                            join_mode=jm))
+            programs.reset(counters_only=False)
+            r.run_batch(sql)  # warm-up pays compiles
+            compiles = programs.snapshot()["compiles"]
+            times = []
+            for _ in range(2):
+                t0 = time.perf_counter()
+                out = r.run_batch(sql)
+                out.num_live()
+                times.append(time.perf_counter() - t0)
+            sums[mode] = result_checksum(out)
+            entry[mode] = {"wall_s": round(min(times), 4),
+                           "programs": int(compiles)}
+        entry["checksum_equal"] = sums["binary"] == sums["multiway"]
+        b, m = entry["binary"], entry["multiway"]
+        entry["speedup"] = (round(b["wall_s"] / m["wall_s"], 2)
+                            if m["wall_s"] else None)
+        rec[name] = entry
+
+    # distributed leg (q3 shape, small fixed sf): exchanged bytes +
+    # fragment count, with broadcast suppressed so the binary chain pays
+    # its per-join partitioned exchanges
+    from presto_tpu.server import buffers
+    from presto_tpu.server.coordinator import DistributedRunner
+
+    dcat = cat if sf <= 0.1 else tpch_catalog(0.05)
+    counter = {"bytes": 0, "pages": 0}
+    orig = buffers.OutputBuffer.enqueue
+
+    def counted(self, partition, page):
+        counter["bytes"] += len(page)
+        counter["pages"] += 1
+        return orig(self, partition, page)
+
+    buffers.OutputBuffer.enqueue = counted
+    try:
+        dist = {}
+        for mode in ("binary", "multiway"):
+            jm = "off" if mode == "binary" else "multiway"
+            counter["bytes"] = counter["pages"] = 0
+            with DistributedRunner(
+                    dcat, n_workers=2,
+                    config=ExecConfig(batch_rows=1 << 15, join_mode=jm),
+                    broadcast_threshold_rows=0) as dr:
+                dplan = dr.plan_distributed(queries["q3_shape"])
+                dr.run(queries["q3_shape"])
+            dist[mode] = {"exchange_bytes": counter["bytes"],
+                          "exchange_pages": counter["pages"],
+                          "fragments": len(dplan.fragments)}
+        rec["q3_distributed"] = dist
+    finally:
+        buffers.OutputBuffer.enqueue = orig
+    print(json.dumps(rec), flush=True)
+
+
 def _compile_tail_child(mode: str):
     """One serving boot + first-seen-query measurement (PR16 compile
     farm A/B). The parent sequences four of these against one cache dir:
@@ -806,6 +911,39 @@ def _run_spill_skew(extra: dict, remaining: float):
         extra["spill_skew"] = {"error": "timeout"}
     except Exception as e:  # noqa: BLE001
         extra["spill_skew"] = {"error": f"{type(e).__name__}: {e}"}
+
+
+def _run_multiway_ab(extra: dict, remaining: float):
+    """Binary-vs-multiway join chain A/B (see BENCH_NOTES.md round 18):
+    wall, compiled-program count, and distributed exchange bytes for the
+    q3/q9/q64 star-chain shapes."""
+    sf = float(os.environ.get("BENCH_SF_MULTIWAY", "0.1"))
+    env = dict(os.environ)
+    try:
+        p = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--multiway-child",
+             str(sf)],
+            env=env, stdout=subprocess.PIPE,
+            timeout=min(600, max(120, remaining - 15)))
+        lines = p.stdout.decode().strip().splitlines()
+        if p.returncode == 0 and lines:
+            rec = json.loads(lines[-1])
+            q3 = rec.get("q3_shape", {})
+            d = rec.get("q3_distributed", {})
+            _log(f"multiway_ab: q3 {q3.get('speedup')}x "
+                 f"(programs {q3.get('binary', {}).get('programs')}"
+                 f"->{q3.get('multiway', {}).get('programs')}, "
+                 f"exchange "
+                 f"{d.get('binary', {}).get('exchange_bytes')}"
+                 f"->{d.get('multiway', {}).get('exchange_bytes')}B, "
+                 f"checksum_equal={q3.get('checksum_equal')})")
+            extra["multiway_ab"] = rec
+        else:
+            extra["multiway_ab"] = {"error": f"child rc={p.returncode}"}
+    except subprocess.TimeoutExpired:
+        extra["multiway_ab"] = {"error": "timeout"}
+    except Exception as e:  # noqa: BLE001
+        extra["multiway_ab"] = {"error": f"{type(e).__name__}: {e}"}
 
 
 def _run_serving_slo_cached(extra: dict, remaining: float):
@@ -995,6 +1133,9 @@ def main():
     if len(sys.argv) >= 3 and sys.argv[1] == "--spill-child":
         _spill_child(int(sys.argv[2]))
         return
+    if len(sys.argv) >= 3 and sys.argv[1] == "--multiway-child":
+        _multiway_child(float(sys.argv[2]))
+        return
     if len(sys.argv) >= 3 and sys.argv[1] == "--compile-tail-child":
         _compile_tail_child(sys.argv[2])
         return
@@ -1015,7 +1156,8 @@ def main():
     wanted = os.environ.get(
         "BENCH_CONFIGS", "q1_sf1,q1_nofuse_sf1,q6_sf10,q3_sf10,join_sf1,"
         "groupby_engine_ab_sf1,groupby_engine_ab_sort_sf1,mesh_scaling,"
-        "serving_slo,serving_slo_cached,spill_skew,compile_tail,q9,q64"
+        "serving_slo,serving_slo_cached,spill_skew,compile_tail,"
+        "multiway_ab,q9,q64"
     ).split(",")
 
     for name in (w.strip() for w in wanted):
@@ -1051,6 +1193,17 @@ def main():
                 if not device_ok:
                     os.environ["BENCH_FORCE_CPU"] = "1"
                 _run_serving_slo_cached(extra, remaining)
+            _checkpoint()
+            continue
+        if name == "multiway_ab":
+            remaining = budget - (time.time() - _T0)
+            if remaining < 60:
+                _log("multiway_ab: SKIPPED (budget exhausted)")
+                extra["multiway_ab"] = {"skipped": "budget"}
+            else:
+                if not device_ok:
+                    os.environ["BENCH_FORCE_CPU"] = "1"
+                _run_multiway_ab(extra, remaining)
             _checkpoint()
             continue
         if name == "spill_skew":

@@ -1350,6 +1350,86 @@ if [ $? -ne 0 ]; then
 fi
 echo "concurrency self-check OK (exit $rc, 3 rules attributed)"
 
+# Multiway-join smoke: a q3-shaped star chain forced through the fused
+# N-ary probe must (1) return checksum-identical results to the binary
+# path, (2) dispatch strictly fewer breaker programs, (3) plan strictly
+# fewer fragments/exchanges distributed (binary pays per-join partitioned
+# exchanges once broadcast is suppressed), (4) carry the EXPLAIN verdict
+# marker, and (5) leave join_mode=off bit-for-bit on the pre-collapse
+# plan and result.
+echo "== multiway smoke: fused star-chain vs binary join chain =="
+env JAX_PLATFORMS=cpu python - <<'PYEOF'
+from presto_tpu.catalog.tpch import tpch_catalog
+from presto_tpu.exec import ExecConfig, LocalRunner
+from presto_tpu.verifier import result_checksum
+
+cat = tpch_catalog(0.01)
+sql = ("select o.o_orderkey, sum(l.l_extendedprice) rev "
+       "from lineitem l "
+       "join orders o on l.l_orderkey = o.o_orderkey "
+       "join customer c on o.o_custkey = c.c_custkey "
+       "where c.c_mktsegment = 'BUILDING' "
+       "group by o.o_orderkey")
+
+
+def breaker_dispatches(stats):
+    return sum(v for k, v in stats.items()
+               if k.startswith("breaker.engine_"))
+
+
+off = LocalRunner(cat, ExecConfig(batch_rows=1 << 13, join_mode="off"))
+mw = LocalRunner(cat, ExecConfig(batch_rows=1 << 13, join_mode="multiway"))
+ref = off.run_batch(sql)
+got = mw.run_batch(sql)
+assert result_checksum(got) == result_checksum(ref), "checksum mismatch"
+assert mw.last_stats.get("multiway.fused_dispatches", 0) >= 1
+bd_off, bd_mw = breaker_dispatches(off.last_stats), \
+    breaker_dispatches(mw.last_stats)
+assert bd_mw < bd_off, f"breaker dispatches {bd_mw} !< {bd_off}"
+out = mw.explain(sql)
+assert "MultiwayJoin" in out and "[join=multiway" in out, out
+out_off = off.explain(sql)
+assert "MultiwayJoin" not in out_off and "[join=" not in out_off, \
+    "join_mode=off must leave the pre-collapse plan untouched"
+# off is bit-for-bit the binary path: same plan string, same checksum
+binary = LocalRunner(cat, ExecConfig(batch_rows=1 << 13))
+assert result_checksum(binary.run_batch(sql)) == result_checksum(ref)
+print(f"local multiway smoke OK: checksums equal, breaker dispatches "
+      f"{bd_off} binary -> {bd_mw} multiway, EXPLAIN marker present")
+
+# distributed: strictly fewer fragments AND exchanges once broadcast is
+# suppressed (each binary join pays two partitioned exchange edges)
+from presto_tpu.server.coordinator import DistributedRunner
+
+
+def exchange_edges(dplan):
+    return sum(len(f.remote_sources()) for f in dplan.fragments.values())
+
+
+counts = {}
+for jm in ("off", "multiway"):
+    with DistributedRunner(cat, n_workers=2,
+                           config=ExecConfig(batch_rows=1 << 13,
+                                             join_mode=jm),
+                           broadcast_threshold_rows=0) as dr:
+        dplan = dr.plan_distributed(sql)
+        counts[jm] = (len(dplan.fragments), exchange_edges(dplan),
+                      result_checksum(dr.run_batch(sql)))
+assert counts["off"][2] == counts["multiway"][2] == result_checksum(ref)
+assert counts["multiway"][0] < counts["off"][0], \
+    f"fragments {counts['multiway'][0]} !< {counts['off'][0]}"
+assert counts["multiway"][1] < counts["off"][1], \
+    f"exchanges {counts['multiway'][1]} !< {counts['off'][1]}"
+print(f"distributed multiway smoke OK: fragments "
+      f"{counts['off'][0]} -> {counts['multiway'][0]}, exchange edges "
+      f"{counts['off'][1]} -> {counts['multiway'][1]}, checksums equal")
+PYEOF
+rc=$?
+if [ "$rc" -ne 0 ]; then
+  echo "multiway smoke FAILED (exit $rc)"
+  exit "$rc"
+fi
+
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
   --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly \

@@ -8,6 +8,10 @@ rewrite and every fragmenter cut must leave a tree where
   (``dangling-column``),
 - equi-join / set-operation key columns agree on device dtype
   (``key-dtype-mismatch``),
+- a MultiwayJoin's parallel leg arrays agree in length and kind
+  vocabulary (``multiway-shape``), each leg's probe keys resolve against
+  the base probe output or an earlier *unique* build payload, and every
+  per-position key pair agrees on dtype/arity across all N build sides,
 - Aggregate / Window inputs resolve — including the partial/final state
   column vocabulary of a split aggregation (``agg-input`` /
   ``window-input``),
@@ -36,6 +40,7 @@ from presto_tpu.plan.nodes import (
     HostProject,
     IndexJoin,
     Limit,
+    MultiwayJoin,
     NestedLoopJoin,
     OneRow,
     Output,
@@ -181,6 +186,40 @@ class _Checker:
                 self._resolve(node, path, "dangling-column",
                               expr_inputs(node.residual), avail,
                               "join residual")
+        elif isinstance(node, MultiwayJoin):
+            n_legs = len(node.builds)
+            if not (n_legs == len(node.kinds) == len(node.probe_keys)
+                    == len(node.build_keys) == len(node.build_unique)):
+                self.err("multiway-shape", node, path,
+                         f"leg arrays disagree on length: "
+                         f"{n_legs} builds, {len(node.kinds)} kinds, "
+                         f"{len(node.probe_keys)} probe key lists, "
+                         f"{len(node.build_keys)} build key lists, "
+                         f"{len(node.build_unique)} unique flags")
+                return
+            for i, k in enumerate(node.kinds):
+                if k not in ("inner", "left"):
+                    self.err("multiway-shape", node, path,
+                             f"leg {i} kind {k!r} is not inner/left")
+            # probe keys of leg i must resolve against the base probe
+            # output or the payload of an EARLIER unique build — the
+            # collapse pass's eligibility rule; a key sourced from a
+            # NON-unique build would be ill-defined per probe row
+            key_avail = dict(outs[0])
+            for i in range(n_legs):
+                btypes = outs[1 + i]
+                self._resolve(node, path, "dangling-column",
+                              node.probe_keys[i], key_avail,
+                              f"multiway leg {i} probe keys (base probe "
+                              f"output + earlier unique build payloads)")
+                self._resolve(node, path, "dangling-column",
+                              node.build_keys[i], btypes,
+                              f"multiway leg {i} build keys")
+                self._keys_agree(node, path, node.probe_keys[i],
+                                 node.build_keys[i], key_avail, btypes,
+                                 f"multiway {node.kinds[i]} leg {i}")
+                if node.build_unique[i]:
+                    key_avail.update(btypes)
         elif isinstance(node, SemiJoin):
             ltypes, rtypes = outs[0], outs[1]
             self._resolve(node, path, "dangling-column", node.left_keys,

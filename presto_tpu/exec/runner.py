@@ -143,11 +143,21 @@ class LocalRunner:
             self.last_trace = ctx.tracer
         return ctx
 
+    def _optimize(self, qp: QueryPlan) -> QueryPlan:
+        """optimize() + the config-gated multiway collapse — the collapse
+        runs at plan-install time, not inside optimize(), because the
+        verdict depends on the session's join_mode/hbo settings."""
+        qp = optimize(qp, self.catalog)
+        from presto_tpu.plan.multiway import apply_join_mode
+
+        apply_join_mode(qp, self.catalog, self.config)
+        return qp
+
     def plan(self, sql: str) -> QueryPlan:
         qp = self._plan_cache.get(sql)
         if qp is not None:
             return qp
-        qp = optimize(plan_query(sql, self.catalog), self.catalog)
+        qp = self._optimize(plan_query(sql, self.catalog))
         if not qp.scalar_subqueries and qp.cacheable:
             self._plan_cache[sql] = qp
         return qp
@@ -175,7 +185,7 @@ class LocalRunner:
             if is_ddl(stmt):
                 return execute_data_definition(stmt, self.catalog,
                                                self._run_query_ast)
-            qp = optimize(plan_query(stmt, self.catalog), self.catalog)
+            qp = self._optimize(plan_query(stmt, self.catalog))
             if not qp.scalar_subqueries and qp.cacheable:
                 self._plan_cache[sql] = qp
         from presto_tpu.exec import farm as _farm
@@ -193,7 +203,7 @@ class LocalRunner:
         return out
 
     def _run_query_ast(self, q):
-        qp = optimize(plan_query(q, self.catalog), self.catalog)
+        qp = self._optimize(plan_query(q, self.catalog))
         ctx = self._new_ctx()
         out = run_plan(qp, ctx)
         self.last_stats = ctx.stats

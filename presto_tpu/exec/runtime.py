@@ -49,6 +49,7 @@ from presto_tpu.ops.grouping import (KeyCol, StateCol, grouped_merge,
                                      partition_skew)
 from presto_tpu.ops.join import (
     BuildTable,
+    MwSpec,
     align_probe_strings,
     build_side,
     gather_join_output,
@@ -57,6 +58,9 @@ from presto_tpu.ops.join import (
     hash_probe_expand,
     hash_probe_unique,
     join_compare_dtypes,
+    multiway_counts,
+    multiway_expand,
+    multiway_probe_unique,
     probe_counts,
     probe_expand,
     probe_unique,
@@ -82,6 +86,7 @@ from presto_tpu.plan.nodes import (
     HashJoin,
     IndexJoin,
     Limit,
+    MultiwayJoin,
     NestedLoopJoin,
     OneRow,
     Output,
@@ -237,6 +242,14 @@ class ExecConfig:
     # "hash" force one engine everywhere (the hash side of the forcing is
     # what the engine-equivalence verifier sweeps run)
     breaker_engine: str = "auto"
+    # multiway join collapse (plan/multiway.py): "auto" lets the CBO
+    # (plan/stats.choose_join_mode) fold eligible star-schema join chains
+    # into one MultiwayJoin probe program per HBO-corrected build sizes
+    # and selectivities; "multiway" forces every eligible chain;
+    # "binary" runs the pass but always declines (stamping the verdict
+    # in EXPLAIN); "off" skips the pass — the pre-collapse plan
+    # bit-for-bit.
+    join_mode: str = "auto"
     # history-based optimization (obs/runstats.py): "observe" (default)
     # records estimate-vs-actual drift at every stats-driven decision site
     # keyed on structural fingerprints; "correct" additionally feeds
@@ -535,7 +548,8 @@ def execute_node(node: PlanNode, ctx: ExecContext) -> Iterator[Batch]:
         jfn = _node_jit(node, "down", lambda: down)
         stream = (jfn(b) for b in stream)
     if ctx.config.merge_sparse_output and isinstance(
-            base, (HashJoin, SemiJoin, NestedLoopJoin, IndexJoin)):
+            base, (HashJoin, MultiwayJoin, SemiJoin, NestedLoopJoin,
+                   IndexJoin)):
         # selective operators emit batches at probe CAPACITY whose live
         # occupancy can be ~1%; every downstream per-batch cost (sorts,
         # merges, probes) is capacity-shaped, so coalesce before fanning
@@ -702,7 +716,8 @@ def _fused_child(node: PlanNode, ctx: ExecContext):
     if ctx.tracer.enabled:
         stream = _traced(stream, base, ctx)
     if ctx.config.merge_sparse_output and isinstance(
-            base, (HashJoin, SemiJoin, NestedLoopJoin, IndexJoin)):
+            base, (HashJoin, MultiwayJoin, SemiJoin, NestedLoopJoin,
+                   IndexJoin)):
         # breakers pull children through here, not execute_node — apply
         # the same sparse-output coalescing before the consumer's chain
         stream = _merging_output(stream, ctx.config.batch_rows,
@@ -719,6 +734,9 @@ def _execute_base(base: PlanNode, ctx: ExecContext) -> Iterator[Batch]:
         return
     if isinstance(base, HashJoin):
         yield from _execute_join(base, ctx)
+        return
+    if isinstance(base, MultiwayJoin):
+        yield from _execute_multiway_join(base, ctx)
         return
     if isinstance(base, IndexJoin):
         yield from _execute_index_join(base, ctx)
@@ -2253,6 +2271,41 @@ def _hbo_spill_partitions(node: PlanNode, ctx: "ExecContext", site: str,
     return default_p
 
 
+def _hbo_radix_partitions(node: PlanNode, ctx: "ExecContext", site: str,
+                          default_p: int) -> int:
+    """hbo=correct: seed the device-side radix partition count from the
+    row count a previous run of this structure observed (join_build /
+    agg_groups), targeting ~HASH_MAX_BUILD_ROWS rows per partition — the
+    ROADMAP item-3 residual: the radix plane no longer runs a fixed
+    per-plan-node count when history knows the state is bigger (same
+    discipline as _hbo_spill_partitions for the spiller). Pow2, bounded;
+    a changed count is correctness-safe because _radix_tag verifies the
+    producer's partition count and falls back to the splitter on
+    mismatch — only exchange alignment is lost, never rows."""
+    if getattr(ctx.config, "hbo", "observe") != "correct":
+        return default_p
+    try:
+        from presto_tpu.obs import runstats as _runstats
+
+        h = _runstats.lookup_node(node, ctx.catalog, site)
+    except Exception:
+        h = None
+    if h and h.get("actual"):
+        from presto_tpu.plan.stats import HASH_MAX_BUILD_ROWS
+
+        want = round_up_capacity(
+            max(1, int(float(h["actual"])) // HASH_MAX_BUILD_ROWS))
+        if want > default_p:
+            try:
+                from presto_tpu.obs import runstats as _runstats
+
+                _runstats.record_correction("radix_partitions")
+            except Exception:
+                pass
+            return min(want, 256)
+    return default_p
+
+
 def _record_spill_done(node: PlanNode, ctx: "ExecContext", site: str,
                        est_p: int, spilled_bytes: int, side: str) -> None:
     """Close out one spilling operator: final leaf count to the counter
@@ -2546,7 +2599,8 @@ def _execute_aggregate(node: Aggregate, ctx: ExecContext) -> Iterator[Batch]:
         from presto_tpu.spiller import SpillFile
 
         node.__dict__["_fragment_fusion"] = "radix-partitioned"
-        P = ctx.config.radix_partitions
+        P = _hbo_radix_partitions(node, ctx, "agg_groups",
+                                  ctx.config.radix_partitions)
         budget = ctx.config.join_spill_budget_bytes
         split = _radix_splitter(node, ctx, key_syms, P, "agg_")
         jit_accstep0 = _node_jit(
@@ -3532,7 +3586,8 @@ def _radix_join(node: HashJoin, ctx: ExecContext,
     from presto_tpu.spiller import SpillFile
 
     cfg = ctx.config
-    P = cfg.radix_partitions
+    P = _hbo_radix_partitions(node, ctx, "join_build",
+                              cfg.radix_partitions)
     budget = cfg.join_spill_budget_bytes
     tr = ctx.tracer
     split_b = _radix_splitter(node, ctx, node.right_keys, P, "radixb_")
@@ -3701,14 +3756,26 @@ def _execute_join(node: HashJoin, ctx: ExecContext) -> Iterator[Batch]:
         yield from _radix_join(node, ctx, probe_stream, build_stream, chain)
         return
 
-    # Collect the build side with memory accounting; crossing the revoke
-    # threshold (or a pool-pressure revoke request) switches to the
-    # partitioned-spill path (HashBuilderOperator's SPILLING_INPUT state +
-    # GenericPartitioningSpiller): both sides are hash-partitioned to disk
-    # on the join keys and each bucket is joined independently — with the
-    # dynamic hybrid-hash escape hatches (mid-build growth, recursive
-    # repartitioning, per-partition role reversal) when the partition-count
-    # estimate proves wrong.
+    yield from _join_with_spill(node, ctx, probe_stream, build_stream, chain)
+
+
+def _join_with_spill(node: HashJoin, ctx: ExecContext,
+                     probe_stream: Iterator[Batch],
+                     build_stream: Iterator[Batch], chain,
+                     jkey: str = "") -> Iterator[Batch]:
+    """One binary hash join over already-opened child streams. Collect the
+    build side with memory accounting; crossing the revoke threshold (or a
+    pool-pressure revoke request) switches to the partitioned-spill path
+    (HashBuilderOperator's SPILLING_INPUT state +
+    GenericPartitioningSpiller): both sides are hash-partitioned to disk
+    on the join keys and each bucket is joined independently — with the
+    dynamic hybrid-hash escape hatches (mid-build growth, recursive
+    repartitioning, per-partition role reversal) when the partition-count
+    estimate proves wrong. Also the per-leg engine of the multiway
+    executor's binary-cascade fallback (jkey='mwb{i}_'), where the child
+    streams are cascade intermediates rather than plan children."""
+    from presto_tpu.memory import LocalMemoryContext, batch_device_bytes
+
     mctx = LocalMemoryContext(ctx.memory_pool, "join-build")
     build_batches: List[Batch] = []
     bspiller = None
@@ -3756,7 +3823,8 @@ def _execute_join(node: HashJoin, ctx: ExecContext) -> Iterator[Batch]:
 
         if bspiller is None:
             build_in = _collect_concat(iter(build_batches))
-            yield from _join_probe(node, ctx, build_in, probe_stream, chain)
+            yield from _join_probe(node, ctx, build_in, probe_stream, chain,
+                                   jkey=jkey)
             return
 
         # spill the (chained) probe side partitioned by the probe keys —
@@ -3766,7 +3834,7 @@ def _execute_join(node: HashJoin, ctx: ExecContext) -> Iterator[Batch]:
         pspiller = ctx.spill_manager.partitioning_spiller(
             node.left_keys, bspiller.n_partitions, "join-probe")
         ctx.track_spill(pspiller)
-        jchain = _node_jit(node, "spill_chain", lambda: chain)
+        jchain = _node_jit(node, jkey + "spill_chain", lambda: chain)
         for pb in probe_stream:
             pspiller.spill(jchain(pb))
         # mid-build growth may have split build partitions: mirror the
@@ -4004,6 +4072,11 @@ class _JoinProber:
         lsyms = self.lsyms = [n for n, _ in node.left.output]
         rsyms = self.rsyms = [n for n, _ in node.right.output]
         self.overflow_rows = 0
+        # probe-selectivity accumulators (device scalars, summed lazily;
+        # one host sync at tail): output rows / probe rows feeds the
+        # join_probe_sel HBO site for choose_join_mode
+        self._n_probe = jnp.zeros((), jnp.int64)
+        self._n_out = jnp.zeros((), jnp.int64)
         self.empty = build_in is None and node.kind == "inner"
         if self.empty:
             return  # empty build side: no output
@@ -4095,8 +4168,9 @@ class _JoinProber:
                 )
                 if bm is not None:
                     bm = bm.at[idx].max(matched & pb.live, mode="drop")
+                n_probe = jnp.sum(pb.live).astype(jnp.int64)
                 if node.kind == "inner":
-                    return out.with_live(out.live & matched), bm
+                    return out.with_live(out.live & matched), bm, n_probe
                 # left/full outer: keep probe rows; null out build columns
                 # where unmatched
                 cols = list(out.columns)
@@ -4105,7 +4179,8 @@ class _JoinProber:
                         c = cols[i]
                         valid = c.validity if c.validity is not None else jnp.ones(out.capacity, bool)
                         cols[i] = Column(c.values, valid & matched, c.hi)
-                return Batch(out.names, out.types, cols, out.live, out.dicts), bm
+                return (Batch(out.names, out.types, cols, out.live,
+                              out.dicts), bm, n_probe)
 
             self.jfn = _node_jit(node, _ek(jkey + "probe"), lambda: probe_fn)
             return
@@ -4243,9 +4318,11 @@ class _JoinProber:
             return None
         node, table = self.node, self.table
         if node.build_unique:
-            out, self.bm = self.jfn(table, pb_raw, self.bm)
+            out, self.bm, n_probe = self.jfn(table, pb_raw, self.bm)
+            self._n_probe = self._n_probe + n_probe
             return ("u", out)
         pb, pba = self.chain_j(table, pb_raw)
+        self._n_probe = self._n_probe + jnp.sum(pb.live)
         lo, counts, offsets, total, _, ovf = self.counts_fn(table, pba)
         try:
             total.copy_to_host_async()
@@ -4263,6 +4340,7 @@ class _JoinProber:
             return
         node, table = self.node, self.table
         if st[0] == "u":
+            self._n_out = self._n_out + jnp.sum(st[1].live)
             yield st[1]
             return
         (_, pb, pba, lo, counts, offsets, total, ovf, out_cap, out,
@@ -4294,6 +4372,7 @@ class _JoinProber:
                 table, pb, pba, lo, counts, offsets, 0, out_cap, self.bm)
             exists_acc = exists_acc | exists
             ovn = ov_rows  # recorded after the chunk loop
+        self._n_out = self._n_out + jnp.sum(out.live)
         yield out
         tot = int(total)
         base = out_cap
@@ -4301,6 +4380,7 @@ class _JoinProber:
             out, exists, self.bm = self.jexpand(
                 table, pb, pba, lo, counts, offsets, base, out_cap, self.bm)
             exists_acc = exists_acc | exists
+            self._n_out = self._n_out + jnp.sum(out.live)
             yield out
             base += out_cap
         if self.engine != "hash":
@@ -4322,14 +4402,55 @@ class _JoinProber:
                 except Exception:
                     pass
         if node.kind in ("left", "full"):
-            yield self.jnull(table, pb, exists_acc)
+            nb = self.jnull(table, pb, exists_acc)
+            self._n_out = self._n_out + jnp.sum(nb.live)
+            yield nb
 
     def probe_batch(self, pb_raw: Batch) -> Iterator[Batch]:
         yield from self.probe_finish(self.probe_start(pb_raw))
 
     def tail(self) -> Iterator[Batch]:
         if not self.empty and self.want_full:
-            yield self.jremainder(self.table, self.bm)
+            b = self.jremainder(self.table, self.bm)
+            self._n_out = self._n_out + jnp.sum(b.live)
+            yield b
+        self._observe_selectivity()
+
+    def _observe_selectivity(self) -> None:
+        """Record the join's observed probe selectivity (output rows /
+        probe rows) under its structural fingerprint — the site
+        choose_join_mode consults, so the multiway-vs-binary verdict is
+        history-corrected on fingerprint repeat. Whole-build probers only
+        (the radix/spilled drivers see partition slices); one host sync
+        of two already-materialized device scalars."""
+        ctx = self.ctx
+        if (self.empty or self._jkey
+                or getattr(ctx.config, "hbo", "observe") == "off"):
+            return
+        try:
+            from presto_tpu.obs import runstats as _runstats
+            from presto_tpu.plan.stats import derive as _derive
+
+            n_probe = float(self._n_probe)
+            if n_probe <= 0:
+                return
+            fp = _runstats.node_fingerprint(self.node, ctx.catalog)
+            if fp is None:
+                return
+            est = None
+            try:
+                pst = _derive(self.node.left, ctx.catalog)
+                ost = _derive(self.node, ctx.catalog)
+                if pst is not None and ost is not None and pst.rows:
+                    est = ost.rows / pst.rows
+            except Exception:
+                pass
+            _runstats.observe(fp, "join_probe_sel",
+                              type(self.node).__name__.lower(), est,
+                              float(self._n_out) / n_probe,
+                              extra={"probe_rows": n_probe})
+        except Exception:
+            pass
 
 
 def _join_probe(node: HashJoin, ctx: ExecContext, build_in: Optional[Batch],
@@ -4339,6 +4460,478 @@ def _join_probe(node: HashJoin, ctx: ExecContext, build_in: Optional[Batch],
     for pb in probe_stream:
         yield from prober.probe_batch(pb)
     yield from prober.tail()
+
+
+# ---------------------------------------------------------------------------
+# multiway (N-ary) join executor — plan/multiway.py's MultiwayJoin node:
+# N resident build tables, one probe pass through all N probes per batch
+# inside one fragment (ops/join.multiway_*). Budget-exceeded builds fall
+# back to the binary cascade so each leg keeps the partitioned spiller.
+
+
+def _mw_stub_build(node: MultiwayJoin, i: int) -> Batch:
+    """Zero-row stand-in for an empty LEFT-leg build stream (inner legs
+    with an empty build short-circuit the whole node instead)."""
+    schema = node.builds[i].output
+    return Batch([s for s, _ in schema], [t for _, t in schema],
+                 [Column(jnp.zeros(128, t.dtype), None) for _, t in schema],
+                 jnp.zeros(128, bool), {})
+
+
+def _mw_cascade_shims(node: MultiwayJoin) -> List[HashJoin]:
+    """Per-leg binary HashJoin shims: leg i's join with a never-executed
+    scan stub standing in for the cascade intermediate (probe output +
+    payloads of legs < i) on the left. They carry the leg's key/kind/
+    uniqueness contract for _JoinProber / choose_breaker_engine and give
+    _node_jit a stable per-leg home for the fallback path's programs
+    (same trick as _execute_index_join's _probe_shim)."""
+    shims = node.__dict__.get("_mw_shims")
+    if shims is None:
+        shims = []
+        schema = list(node.probe.output)
+        for i in range(len(node.builds)):
+            stub = TableScan(catalog="", table=f"__mw_cascade_{i}__",
+                             assignments={}, output=list(schema))
+            shims.append(HashJoin(
+                kind=node.kinds[i], left=stub, right=node.builds[i],
+                left_keys=list(node.probe_keys[i]),
+                right_keys=list(node.build_keys[i]),
+                build_unique=bool(node.build_unique[i])))
+            schema = schema + list(node.builds[i].output)
+        node.__dict__["_mw_shims"] = shims
+    return shims
+
+
+def _mw_plan_specs(node: MultiwayJoin):
+    """Plan-only per-leg key plumbing, memoized on the node: key sources
+    (-1 = probe batch, j >= 0 = unique build j's payload), the planned
+    probe-side encode dtypes, and the pairwise-promoted compare dtypes
+    (the multiway twin of _join_plan_cdt)."""
+    memo = node.__dict__.get("_mw_plan")
+    if memo is not None:
+        return memo
+    pout = dict(node.probe.output)
+    bouts = [dict(b.output) for b in node.builds]
+    legs = []
+    for i in range(len(node.builds)):
+        sources, pdts = [], []
+        for sym in node.probe_keys[i]:
+            if sym in pout:
+                sources.append(-1)
+                pdts.append(jnp.dtype(pout[sym].dtype))
+            else:
+                for j in range(i):
+                    if node.build_unique[j] and sym in bouts[j]:
+                        sources.append(j)
+                        pdts.append(jnp.dtype(bouts[j][sym].dtype))
+                        break
+                else:
+                    raise KeyError(
+                        f"multiway probe key {sym!r} resolves against no "
+                        f"probe column or earlier unique build payload")
+        cdts = tuple(
+            jnp.result_type(jnp.dtype(bouts[i][bk].dtype), pd)
+            for bk, pd in zip(node.build_keys[i], pdts))
+        legs.append((tuple(sources), tuple(pdts), cdts))
+    node.__dict__["_mw_plan"] = legs
+    return legs
+
+
+def _mw_stat(ctx: ExecContext, key: str, delta: int = 1) -> None:
+    ctx.stats[key] = ctx.stats.get(key, 0) + delta
+
+
+class _MultiwayProber:
+    """N resident build tables, probed in one pass per batch.
+
+    Per leg: unique builds probe through the sorted engine's single-match
+    kernel; fanout builds through the Pallas hash kernel (exact counts —
+    required for LEFT null-extension) or, for inner kinds, the sorted
+    range engine (expand re-verifies keys). All-unique chains — the
+    dominant star shape — run ONE compiled program per probe batch with
+    the fused child chain inlined; general chains run a counts pass (per-
+    leg fanout ladder on hash overflow) plus chunked mixed-radix
+    expansion. ``cascade`` set at construction means a leg cannot run
+    fused (left fanout leg without exact counts) and the caller must fall
+    back to the binary cascade."""
+
+    def __init__(self, node: MultiwayJoin, ctx: ExecContext,
+                 builds_in: List[Optional[Batch]], chain):
+        self.node, self.ctx = node, ctx
+        self.cascade = None  # reason string when fused execution is off
+        self.empty = any(
+            b is None and k == "inner"
+            for b, k in zip(builds_in, node.kinds))
+        if self.empty:
+            return
+        N = len(node.builds)
+        self.psyms = [s for s, _ in node.probe.output]
+        self.bsyms = tuple(
+            tuple(s for s, _ in b.output) for b in node.builds)
+        legs = _mw_plan_specs(node)
+        shims = _mw_cascade_shims(node)
+        override = getattr(ctx.config, "breaker_engine", "auto")
+        hbo = getattr(ctx.config, "hbo", "observe")
+
+        specs, tables = [], []
+        for i in range(N):
+            build_in = builds_in[i]
+            if build_in is None:
+                build_in = _mw_stub_build(node, i)
+            sources, pdts, cdts = legs[i]
+            unique = bool(node.build_unique[i])
+            hash_engine = False
+            if not unique:
+                from presto_tpu.plan.stats import choose_breaker_engine
+                try:
+                    eng, _ = choose_breaker_engine(
+                        shims[i], ctx.catalog, override, hbo=hbo)
+                except Exception:
+                    eng = "sort"
+                hash_engine = eng == "hash"
+                if hash_engine and join_compare_dtypes(
+                        build_in, tuple(node.build_keys[i]), pdts) != cdts:
+                    # executed batch deviates from plan dtypes: the hash
+                    # encode would be wrong — same gate as _JoinProber
+                    hash_engine = False
+                if not hash_engine and node.kinds[i] == "left":
+                    # sorted fanout counts can widen, which breaks the
+                    # left leg's digit-0 null-extension — whole-node
+                    # binary decomposition instead of a wrong answer
+                    self.cascade = (
+                        f"left fanout leg {i} lacks exact counts")
+                    return
+            specs.append(MwSpec(
+                probe_keys=tuple(node.probe_keys[i]),
+                build_keys=tuple(node.build_keys[i]),
+                sources=sources, kind=node.kinds[i], unique=unique,
+                hash_engine=hash_engine,
+                compare_dtypes=cdts if hash_engine else ()))
+            if hash_engine:
+                table = _node_jit(
+                    node, f"mw_build{i}@h", lambda: hash_build_side,
+                    static_argnames=("key_names", "probe_dtypes"))(
+                    build_in, tuple(node.build_keys[i]), pdts)
+            else:
+                table = _node_jit(
+                    node, f"mw_build{i}", lambda: build_side,
+                    static_argnames=("key_names",))(
+                    build_in, tuple(node.build_keys[i]))
+            tables.append(table)
+        self.specs = tuple(specs)
+        self.tables = tuple(tables)
+        self.fanouts = tuple(
+            0 if s.unique else 16 for s in self.specs)
+        self.all_unique = all(s.unique for s in self.specs)
+        self._hbo_observe_builds()
+
+        # selectivity accumulators (device scalars; one host sync in
+        # tail): probe rows in, leg-0 binary-equivalent rows, final rows
+        self._n_probe = jnp.zeros((), jnp.int64)
+        self._n_leg0 = jnp.zeros((), jnp.int64)
+        self._n_out = jnp.zeros((), jnp.int64)
+
+        psyms, bsyms = self.psyms, self.bsyms
+        specs_t = self.specs
+
+        if self.all_unique:
+            def unique_fn(ts, pb_raw):
+                pb = chain(pb_raw)
+                out, n_probe, n_leg0 = multiway_probe_unique(
+                    ts, pb, specs_t, psyms, bsyms)
+                return out, n_probe, n_leg0
+            self.junique = _node_jit(node, "mw_unique", lambda: unique_fn)
+            return
+
+        def expand_fn(ts, pb, state, chats, offsets, T, base, out_cap):
+            return multiway_expand(ts, pb, specs_t, state, chats, offsets,
+                                   T, base, out_cap, psyms, bsyms)
+        self.jexpand = _node_jit(node, "mw_expand", lambda: expand_fn,
+                                 static_argnames=("out_cap",))
+        self._chain = chain
+        self._counts_cache = {}
+
+    def _counts_program(self, fanouts):
+        """Counting-pass program for one per-leg fanout vector (jit-cached
+        per vector: a hash leg's overflow ladder doubles only that leg's
+        width, each combination its own compiled shape). The fused child
+        chain is inlined, so the chained probe batch comes back as an
+        output alongside the per-leg state."""
+        fn = self._counts_cache.get(fanouts)
+        if fn is None:
+            chain, specs = self._chain, self.specs
+
+            def counts_fn(ts, pb_raw):
+                pb = chain(pb_raw)
+                return (pb,) + multiway_counts(ts, pb, specs, fanouts)
+            fn = self._counts_cache[fanouts] = _node_jit(
+                self.node, f"mw_counts@f{','.join(map(str, fanouts))}",
+                lambda: counts_fn)
+        return fn
+
+    def _hbo_observe_builds(self) -> None:
+        """Per-leg build row counts into HBO under the ORIGINAL binary
+        joins' fingerprints (stashed by the collapse pass), so
+        choose_join_mode's per-join build sizing is history-corrected on
+        fingerprint repeat even when the chain ran multiway."""
+        ctx = self.ctx
+        if getattr(ctx.config, "hbo", "observe") == "off":
+            return
+        leg_fps = self.node.__dict__.get("_leg_fps") or []
+        if not leg_fps:
+            return
+        try:
+            from presto_tpu.obs import runstats as _runstats
+
+            for i, fp in enumerate(leg_fps):
+                if fp is None or i >= len(self.tables):
+                    continue
+                actual = float(table_rows(self.tables[i]))
+                if actual <= 0:
+                    continue
+                try:
+                    from presto_tpu.plan.stats import derive as _derive
+                    bst = _derive(self.node.builds[i], ctx.catalog)
+                except Exception:
+                    bst = None
+                est = float(bst.rows) if (bst is not None
+                                          and bst.rows) else None
+                _runstats.observe(fp, "join_build", "multiwayjoin",
+                                  est, actual)
+        except Exception:
+            pass
+
+    def probe_batch(self, pb_raw: Batch) -> Iterator[Batch]:
+        if self.empty:
+            return
+        node, ctx, tables = self.node, self.ctx, self.tables
+        if self.all_unique:
+            out, n_probe, n_leg0 = self.junique(tables, pb_raw)
+            self._n_probe = self._n_probe + n_probe
+            self._n_leg0 = self._n_leg0 + n_leg0
+            self._n_out = self._n_out + jnp.sum(out.live)
+            yield out
+            return
+        fanouts = self.fanouts
+        (pb, state, chats, offsets, T, total,
+         ovfs) = self._counts_program(fanouts)(tables, pb_raw)
+        try:
+            total.copy_to_host_async()
+            ovfs.copy_to_host_async()
+        except Exception:
+            pass
+        out_cap = ctx.config.join_out_capacity or pb.capacity
+        # optimistic chunk-0 dispatch while total/ovfs travel to the host
+        out = self.jexpand(tables, pb, state, chats, offsets, T, 0, out_cap)
+        ovn = np.asarray(ovfs)
+        if int(ovn.sum()):
+            # hash-leg fanout overflow: counts are EXACT but that leg's
+            # match matrix truncated — the dispatched chunk 0 would
+            # duplicate its last held match, so discard it, double the
+            # overflowing legs' widths until every row fits, and redo
+            # chunk 0 (the widening-replay ladder, per table)
+            ov_rows = int(ovn.sum())
+            while int(ovn.sum()):
+                fanouts = tuple(
+                    f * 2 if int(ovn[i]) else f
+                    for i, f in enumerate(fanouts))
+                for i, f in enumerate(fanouts):
+                    if (self.specs[i].hash_engine
+                            and f > int(tables[i].slot_row.shape[0])):
+                        raise RuntimeError(
+                            "multiway join fanout exceeded build table "
+                            f"capacity on leg {i}")
+                _bump_replay_wave(node, ctx, cap_to=max(fanouts))
+                (pb, state, chats, offsets, T, total,
+                 ovfs) = self._counts_program(fanouts)(tables, pb_raw)
+                ovn = np.asarray(ovfs)
+            out = self.jexpand(tables, pb, state, chats, offsets, T, 0,
+                               out_cap)
+            self._note_overflow(ov_rows, ovn)
+        self._n_probe = self._n_probe + jnp.sum(pb.live)
+        self._n_leg0 = self._n_leg0 + jnp.sum(
+            jnp.where(pb.live, chats[0], 0))
+        self._n_out = self._n_out + jnp.sum(out.live)
+        yield out
+        tot = int(total)
+        base = out_cap
+        while base < tot:
+            out = self.jexpand(tables, pb, state, chats, offsets, T, base,
+                               out_cap)
+            self._n_out = self._n_out + jnp.sum(out.live)
+            yield out
+            base += out_cap
+
+    def _note_overflow(self, ov_rows: int, _ovn) -> None:
+        """Per-table overflow accounting into the same counters the binary
+        widening-replay ladder feeds."""
+        from presto_tpu.scan import metrics as _scan_metrics
+
+        _mw_stat(self.ctx, "join.fanout_overflow_rows", ov_rows)
+        _mw_stat(self.ctx, "multiway.fanout_overflow_rows", ov_rows)
+        _scan_metrics.record("join_fanout_overflow_rows", ov_rows)
+        if getattr(self.ctx.config, "hbo", "observe") != "off":
+            try:
+                from presto_tpu.obs import runstats as _runstats
+
+                fp = _runstats.node_fingerprint(self.node,
+                                                self.ctx.catalog)
+                if fp is not None:
+                    _runstats.note(fp, "join_build",
+                                   fanout_overflow_rows=ov_rows)
+            except Exception:
+                pass
+
+    def tail(self) -> None:
+        """Stream end: one host sync of the selectivity accumulators, then
+        the HBO probe-selectivity observations (satellite: history-
+        corrected multiway-vs-binary verdicts). Leg-0's binary-equivalent
+        selectivity lands on the ORIGINAL bottom join's fingerprint (the
+        one choose_join_mode consults); the overall chain selectivity on
+        the node's own fingerprint and the collapsed top join's."""
+        ctx = self.ctx
+        if self.empty or getattr(ctx.config, "hbo", "observe") == "off":
+            return
+        try:
+            from presto_tpu.obs import runstats as _runstats
+
+            n_probe = float(self._n_probe)
+            if n_probe <= 0:
+                return
+            leg0_sel = float(self._n_leg0) / n_probe
+            out_sel = float(self._n_out) / n_probe
+            leg_fps = self.node.__dict__.get("_leg_fps") or []
+            if leg_fps and leg_fps[0] is not None:
+                _runstats.observe(leg_fps[0], "join_probe_sel",
+                                  "multiwayjoin", None, leg0_sel,
+                                  extra={"probe_rows": n_probe})
+            for fp in (
+                    _runstats.node_fingerprint(self.node, ctx.catalog),
+                    self.node.__dict__.get("_origin_fp")):
+                if fp is not None:
+                    _runstats.observe(fp, "join_probe_sel", "multiwayjoin",
+                                      None, out_sel,
+                                      extra={"probe_rows": n_probe})
+        except Exception:
+            pass
+
+
+def _mw_binary_cascade(node: MultiwayJoin, ctx: ExecContext,
+                       probe_stream: Iterator[Batch], chain,
+                       collected: List[List[Batch]],
+                       pressure_at: Optional[int],
+                       partial: List[Batch], bstream,
+                       reason: str) -> Iterator[Batch]:
+    """Binary decomposition of the chain over the already-opened streams:
+    leg i joins the cascade intermediate against build i through the
+    regular binary machinery, so a budget-exceeded build degrades through
+    the PR 15 partitioned spiller (per leaf) instead of failing. Builds
+    collected before the pressure point replay from memory; the
+    pressure-point build resumes its partially-consumed stream; later
+    builds execute normally."""
+    import itertools
+
+    from presto_tpu.scan import metrics as _scan_metrics
+
+    _mw_stat(ctx, "multiway.cascade_fallbacks")
+    _scan_metrics.record("multiway_cascade_fallbacks", 1)
+    if ctx.tracer.enabled:
+        t = time.time()
+        ctx.tracer.record("multiway_cascade", "multiway_cascade", t, t,
+                          node=type(node).__name__, reason=reason)
+    shims = _mw_cascade_shims(node)
+    ident = lambda b: b  # noqa: E731 — chain applied by leg 0 only
+    stream = probe_stream
+    for i, shim in enumerate(shims):
+        leg_chain = chain if i == 0 else ident
+        jkey = f"mwb{i}_"
+        if pressure_at is None or i < pressure_at:
+            build_in = (_collect_concat(iter(collected[i]))
+                        if i < len(collected) else
+                        _collect_concat(execute_node(node.builds[i], ctx)))
+            stream = _join_probe(shim, ctx, build_in, stream, leg_chain,
+                                 jkey=jkey)
+        else:
+            if i == pressure_at:
+                bs = itertools.chain(
+                    iter(partial),
+                    bstream if bstream is not None else iter(()))
+            else:
+                bs = execute_node(node.builds[i], ctx)
+            stream = _join_with_spill(shim, ctx, stream, bs, leg_chain,
+                                      jkey=jkey)
+    yield from stream
+
+
+def _execute_multiway_join(node: MultiwayJoin,
+                           ctx: ExecContext) -> Iterator[Batch]:
+    """MultiwayJoin executor: collect all N build sides (memory-accounted),
+    then run the fused N-ary probe — ONE probe pass per batch, no
+    intermediate materialization between legs. Pool pressure during build
+    collection, or a leg the fused path cannot run exactly, degrades to
+    the binary cascade (each leg keeping the partitioned spiller)."""
+    from presto_tpu.memory import LocalMemoryContext, batch_device_bytes
+
+    probe_stream, chain = _fused_child(node.probe, ctx)
+    N = len(node.builds)
+    _mw_stat(ctx, "multiway.joins", 1)
+    _mw_stat(ctx, "multiway.legs", N)
+
+    mctx = LocalMemoryContext(ctx.memory_pool, "mw-join-build")
+    rev = {"flag": False}
+
+    def _revoke(_need: int) -> int:
+        rev["flag"] = True
+        return 0
+
+    can_spill = ctx.config.spill_enabled
+    if can_spill:
+        ctx.memory_pool.add_revoker(_revoke)
+    try:
+        collected: List[List[Batch]] = []
+        total_bytes = 0
+        pressure_at = None
+        partial: List[Batch] = []
+        bstream = None
+        for i in range(N):
+            bstream = execute_node(node.builds[i], ctx)
+            partial = []
+            for b in bstream:
+                nb = batch_device_bytes(b)
+                if can_spill and (rev["flag"] or ctx.should_spill(nb)):
+                    rev["flag"] = False
+                    pressure_at = i
+                    partial.append(b)
+                    break
+                partial.append(b)
+                total_bytes += nb
+                mctx.set_bytes(total_bytes)
+            if pressure_at is not None:
+                break
+            collected.append(partial)
+            partial, bstream = [], None
+
+        if pressure_at is not None:
+            yield from _mw_binary_cascade(
+                node, ctx, probe_stream, chain, collected, pressure_at,
+                partial, bstream, "build memory pressure")
+            return
+
+        builds_in = [_collect_concat(iter(bb)) for bb in collected]
+        prober = _MultiwayProber(node, ctx, builds_in, chain)
+        if prober.cascade is not None:
+            yield from _mw_binary_cascade(
+                node, ctx, probe_stream, chain, collected, None, [], None,
+                prober.cascade)
+            return
+        _mw_stat(ctx, "multiway.fused_dispatches")
+        for pb in probe_stream:
+            yield from prober.probe_batch(pb)
+        prober.tail()
+    finally:
+        if can_spill:
+            ctx.memory_pool.remove_revoker(_revoke)
+        mctx.set_bytes(0)
 
 
 def _column_chunk(c: Column, off, size: int) -> Column:

@@ -390,6 +390,246 @@ def hash_probe_expand(table: HashJoinTable, mm: jnp.ndarray,
     return probe_row, build_idx, out_live
 
 
+# ---------------------------------------------------------------------------
+# N-ary multiway probe (plan/nodes.MultiwayJoin): N resident build tables,
+# one probe batch walked through all N probes in a single traced pass —
+# no intermediate batch materialization between legs (PAPERS.md
+# 1905.13376). Output row = probe row × one (match | left-null) per leg,
+# decomposed mixed-radix over the per-leg match counts.
+
+
+class MwSpec(NamedTuple):
+    """Static description of one leg of a multiway probe. Drivers close
+    over it (it is NOT a traced value), so every field must be hashable.
+    `sources[k]` locates probe-side key k: -1 = the probe batch itself,
+    j >= 0 = the payload of earlier UNIQUE build j, gathered at that
+    leg's matched row (snowflake chains). Non-unique legs probe through
+    the pallas kernel (`hash_engine`, exact counts) or the sorted engine
+    (counts may widen — inner kinds only; expand re-verifies keys)."""
+
+    probe_keys: tuple
+    build_keys: tuple
+    sources: tuple
+    kind: str                # inner | left
+    unique: bool             # single-match sorted-engine probe
+    hash_engine: bool        # fanout leg probes through the pallas kernel
+    compare_dtypes: tuple    # hash-engine encode dtypes (else ())
+
+
+def _mw_key_batch(probe: Batch, tables, spec: "MwSpec", idxs, matcheds):
+    """Key batch for one leg: key columns assembled from the probe batch
+    and/or earlier unique legs' payloads, with rows unmatched in the
+    source leg invalidated — a NULL key never equi-matches, which is
+    exactly the binary chain's semantics for that row."""
+    names, types, cols, dicts = [], [], [], {}
+    for sym, src in zip(spec.probe_keys, spec.sources):
+        if src < 0:
+            c = probe.column(sym)
+            t = probe.type_of(sym)
+            d = probe.dicts.get(sym)
+        else:
+            tb = tables[src].batch
+            c = tb.column(sym).gather(idxs[src])
+            v = matcheds[src] if c.validity is None else \
+                (c.validity & matcheds[src])
+            c = Column(c.values, v, c.hi, c.sizes, c.evalid, c.keys)
+            t = tb.type_of(sym)
+            d = tb.dicts.get(sym)
+        names.append(sym)
+        types.append(t)
+        cols.append(c)
+        if d is not None:
+            dicts[sym] = d
+    return Batch(names, types, cols, probe.live, dicts)
+
+
+def _mw_unique_state(specs, state):
+    """(idxs, matcheds) maps for the unique legs — key sources for later
+    snowflake legs."""
+    idxs, matcheds = {}, {}
+    for i, spec in enumerate(specs):
+        if spec.unique:
+            idxs[i], matcheds[i] = state[i]
+    return idxs, matcheds
+
+
+def multiway_counts(tables, probe: Batch, specs, fanouts):
+    """Pass 1 of the N-ary probe: per-leg match state, per-leg effective
+    counts (left legs floor at 1 — the null-extension row), the combined
+    per-probe-row product T and its exclusive prefix sum. Counts are
+    exact for unique and hash legs; sorted-engine fanout legs may widen
+    (probe_counts contract) — expand re-verifies keys, so only capacity
+    inflates. ``ovfs[i]`` > 0 means hash leg i truncated its match
+    matrix: the driver doubles that leg's fanout and re-runs (the
+    widening-replay ladder).
+
+    Returns (state, chats, offsets, T, total, ovfs)."""
+    state, chats, ovfs = [], [], []
+    idxs, matcheds = {}, {}
+    for i, spec in enumerate(specs):
+        kb = _mw_key_batch(probe, tables, spec, idxs, matcheds)
+        kb = align_probe_strings(kb, spec.probe_keys, tables[i],
+                                 spec.build_keys)
+        if spec.unique:
+            idx, matched = probe_unique(tables[i], kb, spec.probe_keys,
+                                        spec.build_keys)
+            idxs[i], matcheds[i] = idx, matched
+            c = matched.astype(jnp.int64)
+            state.append((idx, matched))
+            ovfs.append(jnp.zeros((), jnp.int64))
+        elif spec.hash_engine:
+            mm, c, _off, _tot, _live, ovf = hash_probe_counts(
+                tables[i], kb, spec.probe_keys, spec.compare_dtypes,
+                fanouts[i])
+            state.append((mm, c))
+            ovfs.append(ovf)
+        else:
+            lo, c, _off, _tot, _live, ovf = probe_counts(
+                tables[i], kb, spec.probe_keys, spec.build_keys,
+                fanouts[i])
+            state.append((lo, c))
+            ovfs.append(jnp.zeros((), jnp.int64))
+        chats.append(jnp.maximum(c, 1) if spec.kind == "left" else c)
+    T = probe.live.astype(jnp.int64)
+    for chat in chats:
+        T = T * chat
+    offsets = jnp.cumsum(T) - T
+    total = jnp.sum(T)
+    return (tuple(state), tuple(chats), offsets, T, total,
+            jnp.stack(ovfs))
+
+
+def multiway_expand(tables, probe: Batch, specs, state, chats, offsets,
+                    T, chunk_base, out_capacity: int, probe_cols,
+                    build_cols):
+    """Pass 2: materialize output slots [chunk_base, chunk_base +
+    out_capacity). One searchsorted over the inclusive ends of T maps a
+    slot to its probe row; the residual ordinal decomposes mixed-radix
+    across legs (last leg fastest). Left legs emit their null-extension
+    at digit 0 when unmatched. ``build_cols[i]`` are leg i's payload
+    symbols; probe columns gather at probe_row."""
+    N = len(specs)
+    ends = offsets + T
+    i = jnp.arange(out_capacity, dtype=jnp.int64) + chunk_base
+    pcap = T.shape[0]
+    probe_row = jnp.searchsorted(ends, i, side="right").astype(jnp.int32)
+    probe_row = jnp.clip(probe_row, 0, pcap - 1)
+    r = i - offsets[probe_row]
+    in_range = (i < ends[-1]) & (r >= 0) & (r < T[probe_row])
+    digits = [None] * N
+    for t in range(N - 1, -1, -1):
+        c = jnp.maximum(chats[t][probe_row], 1)
+        digits[t] = r % c
+        r = r // c
+    idxs, matcheds = _mw_unique_state(specs, state)
+    out_live = in_range
+    bidx, bvalid = [], []
+    for t, spec in enumerate(specs):
+        d = digits[t]
+        if spec.unique:
+            idx, matched = state[t]
+            bi = idx[probe_row]
+            ok = matched[probe_row]
+        elif spec.hash_engine:
+            mm, c = state[t]
+            oc = jnp.clip(d, 0, mm.shape[1] - 1).astype(jnp.int32)
+            bi = mm[probe_row, oc]
+            ok = (d < c[probe_row]) & (bi >= 0)
+            bi = jnp.clip(bi, 0,
+                          tables[t].batch.capacity - 1).astype(jnp.int32)
+        else:
+            lo, c = state[t]
+            bi = (lo[probe_row] + d).astype(jnp.int32)
+            bi = jnp.clip(bi, 0, tables[t].hashes.shape[0] - 1)
+            ok = d < c[probe_row]
+            # re-verify real keys in the leg's aligned code space (covers
+            # collisions and the widened counting fallback)
+            kb = align_probe_strings(
+                _mw_key_batch(probe, tables, spec, idxs, matcheds),
+                spec.probe_keys, tables[t], spec.build_keys)
+            for pk, bk in zip(spec.probe_keys, spec.build_keys):
+                pv = kb.column(pk).values[probe_row]
+                bv = tables[t].batch.column(bk).values[bi]
+                if pv.dtype != bv.dtype:
+                    pt = jnp.result_type(pv.dtype, bv.dtype)
+                    pv, bv = pv.astype(pt), bv.astype(pt)
+                ok = ok & (pv == bv)
+        if spec.kind == "inner":
+            out_live = out_live & ok
+        bidx.append(bi)
+        bvalid.append(ok)
+    names, types, cols, dicts = [], [], [], {}
+    for sym in probe_cols:
+        names.append(sym)
+        types.append(probe.type_of(sym))
+        cols.append(probe.column(sym).gather(probe_row))
+        if sym in probe.dicts:
+            dicts[sym] = probe.dicts[sym]
+    for t in range(N):
+        tb = tables[t].batch
+        for sym in build_cols[t]:
+            names.append(sym)
+            types.append(tb.type_of(sym))
+            c = tb.column(sym).gather(bidx[t])
+            v = bvalid[t] if c.validity is None else \
+                (c.validity & bvalid[t])
+            cols.append(Column(c.values, v, c.hi, c.sizes, c.evalid,
+                               c.keys))
+            if sym in tb.dicts:
+                dicts[sym] = tb.dicts[sym]
+    return Batch(names, types, cols, out_live, dicts)
+
+
+def multiway_probe_unique(tables, probe: Batch, specs, probe_cols,
+                          build_cols):
+    """All-unique fast path — the dominant star-schema shape: every leg
+    matches at most one build row, so the output is row-aligned with the
+    probe batch. Probe columns pass through untouched, each leg costs
+    one probe + one payload gather, and the whole N-way join is ONE
+    compiled program with no expansion pass.
+
+    Returns (out, n_probe, n_leg0): the probe's live row count and leg
+    0's binary-equivalent output row count ride along for the HBO
+    probe-selectivity observation (one extra reduction each, no extra
+    program)."""
+    out_live = probe.live
+    idxs, matcheds = {}, {}
+    for i, spec in enumerate(specs):
+        kb = _mw_key_batch(probe, tables, spec, idxs, matcheds)
+        kb = align_probe_strings(kb, spec.probe_keys, tables[i],
+                                 spec.build_keys)
+        idx, matched = probe_unique(tables[i], kb, spec.probe_keys,
+                                    spec.build_keys)
+        idxs[i], matcheds[i] = idx, matched
+        if spec.kind == "inner":
+            out_live = out_live & matched
+    n_probe = jnp.sum(probe.live).astype(jnp.int64)
+    if specs[0].kind == "inner":
+        n_leg0 = jnp.sum(probe.live & matcheds[0]).astype(jnp.int64)
+    else:
+        n_leg0 = n_probe
+    names, types, cols, dicts = [], [], [], {}
+    for sym in probe_cols:
+        names.append(sym)
+        types.append(probe.type_of(sym))
+        cols.append(probe.column(sym))
+        if sym in probe.dicts:
+            dicts[sym] = probe.dicts[sym]
+    for t in range(len(specs)):
+        tb = tables[t].batch
+        for sym in build_cols[t]:
+            names.append(sym)
+            types.append(tb.type_of(sym))
+            c = tb.column(sym).gather(idxs[t])
+            v = matcheds[t] if c.validity is None else \
+                (c.validity & matcheds[t])
+            cols.append(Column(c.values, v, c.hi, c.sizes, c.evalid,
+                               c.keys))
+            if sym in tb.dicts:
+                dicts[sym] = tb.dicts[sym]
+    return Batch(names, types, cols, out_live, dicts), n_probe, n_leg0
+
+
 def gather_join_output(
     probe: Batch,
     table: BuildTable,

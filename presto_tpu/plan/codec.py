@@ -29,6 +29,7 @@ from presto_tpu.plan.nodes import (
     HashJoin,
     IndexJoin,
     Limit,
+    MultiwayJoin,
     NestedLoopJoin,
     OneRow,
     Output,
@@ -144,6 +145,14 @@ def node_to_json(n: PlanNode) -> Dict[str, Any]:
                              if n.residual is not None else None),
                 "build_unique": n.build_unique,
                 "colocated": n.colocated}
+    if isinstance(n, MultiwayJoin):
+        return {"k": "mwjoin",
+                "probe": node_to_json(n.probe),
+                "builds": [node_to_json(b) for b in n.builds],
+                "kinds": list(n.kinds),
+                "pkeys": [list(ks) for ks in n.probe_keys],
+                "bkeys": [list(ks) for ks in n.build_keys],
+                "build_unique": [bool(u) for u in n.build_unique]}
     if isinstance(n, NestedLoopJoin):
         return {"k": "nljoin",
                 "left": node_to_json(n.left), "right": node_to_json(n.right),
@@ -265,6 +274,15 @@ def node_from_json(d: Dict[str, Any]) -> PlanNode:
                       if d.get("residual") is not None else None),
             build_unique=bool(d.get("build_unique", False)),
             colocated=int(d.get("colocated", 0)),
+        )
+    if k == "mwjoin":
+        return MultiwayJoin(
+            probe=node_from_json(d["probe"]),
+            builds=[node_from_json(b) for b in d["builds"]],
+            kinds=list(d["kinds"]),
+            probe_keys=[list(ks) for ks in d["pkeys"]],
+            build_keys=[list(ks) for ks in d["bkeys"]],
+            build_unique=[bool(u) for u in d["build_unique"]],
         )
     if k == "nljoin":
         return NestedLoopJoin(

@@ -341,6 +341,24 @@ class _Fragmenter:
             node.right = self.cut(right, rpart, OUT_HASH, node.right_keys,
                                   radix_align=True)
             return node, HASH
+        from presto_tpu.plan.nodes import MultiwayJoin
+
+        if isinstance(node, MultiwayJoin):
+            # the probe pipeline keeps its partitioning; every build table is
+            # replicated to each probe task (the collapse pass only fuses
+            # chains whose build sides are broadcast-sized, so REPLICATED is
+            # always the right distribution here). SINGLE/SINGLE needs no cut.
+            probe, ppart = self.process(node.probe)
+            node.probe = probe
+            new_builds = []
+            for b in node.builds:
+                rb, rpart = self.process(b)
+                if rpart == SINGLE and ppart == SINGLE:
+                    new_builds.append(rb)
+                else:
+                    new_builds.append(self.cut(rb, rpart, OUT_BROADCAST))
+            node.builds = new_builds
+            return node, ppart
         if isinstance(node, SemiJoin):
             left, lpart = self.process(node.left)
             right, rpart = self.process(node.right)

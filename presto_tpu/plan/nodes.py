@@ -205,6 +205,38 @@ class HashJoin(PlanNode):
 
 
 @dataclasses.dataclass
+class MultiwayJoin(PlanNode):
+    """N-ary join: one probe child, N resident build children probed in a
+    single pass (PAPERS.md 1905.13376). Produced by plan/multiway.py when
+    a left-deep chain of inner/left equi-joins shares one probe pipeline
+    (the star-schema shape of q3/q5/q9/q64); semantically identical to the
+    equivalent left-deep HashJoin nesting, with `builds[i]` the build side
+    of the i-th join bottom-up.
+
+    `probe_keys[i]` resolve against the probe output or against the
+    payload of an EARLIER build j<i with `build_unique[j]` — a probe row
+    has at most one match there, so the key value is well-defined per
+    probe row (snowflake chains like lineitem⋈orders⋈customer)."""
+
+    probe: PlanNode
+    builds: List[PlanNode]
+    kinds: List[str]                 # inner | left, per build
+    probe_keys: List[List[str]]
+    build_keys: List[List[str]]
+    build_unique: List[bool]
+
+    @property
+    def output(self):
+        out = list(self.probe.output)
+        for b in self.builds:
+            out.extend(b.output)
+        return out
+
+    def children(self):
+        return [self.probe] + list(self.builds)
+
+
+@dataclasses.dataclass
 class NestedLoopJoin(PlanNode):
     """Inner join with no equi keys (pure cross product or non-equi ON
     condition). Reference: NestedLoopJoinOperator.java + NestedLoopBuild
@@ -453,6 +485,12 @@ def plan_to_string(node: PlanNode, indent: int = 0, node_stats=None,
         s = (f"{pad}HashJoin[{node.kind}; {node.left_keys} = "
              f"{node.right_keys}{'; unique' if node.build_unique else ''}"
              f"{f'; colocated={node.colocated} buckets' if node.colocated else ''}]")
+    elif isinstance(node, MultiwayJoin):
+        legs = "; ".join(
+            f"{k}:{pk} = {bk}{'*' if u else ''}"
+            for k, pk, bk, u in zip(node.kinds, node.probe_keys,
+                                    node.build_keys, node.build_unique))
+        s = f"{pad}MultiwayJoin[{len(node.builds)} builds; {legs}]"
     elif isinstance(node, IndexJoin):
         s = (f"{pad}IndexJoin[{node.kind}; {node.left_keys} = "
              f"{node.catalog}.{node.table}({node.index_key_cols})]")
@@ -480,6 +518,10 @@ def plan_to_string(node: PlanNode, indent: int = 0, node_stats=None,
     if beng is not None:
         why = node.__dict__.get("_breaker_engine_why")
         s += f"   [engine={beng}{f': {why}' if why else ''}]"
+    jm = node.__dict__.get("_join_mode")
+    if jm is not None:
+        jwhy = node.__dict__.get("_join_mode_why")
+        s += f"   [join={jm}{f': {jwhy}' if jwhy else ''}]"
     rs = node.__dict__.get("_runstats")
     if rs is not None and node_stats is not None:
         # estimate-vs-actual drift stamped by obs/runstats observation

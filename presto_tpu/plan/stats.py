@@ -27,6 +27,7 @@ from presto_tpu.plan.nodes import (
     Filter,
     HashJoin,
     Limit,
+    MultiwayJoin,
     Output,
     PlanNode,
     Project,
@@ -249,6 +250,32 @@ def _derive(node: PlanNode, catalog) -> Optional[NodeStats]:
         cols = dict(left.columns)
         cols.update(right.columns)
         return NodeStats(max(1.0, out_rows), cols)
+    if isinstance(node, MultiwayJoin):
+        cur = derive(node.probe, catalog)
+        if cur is None:
+            return None
+        rows = cur.rows
+        cols = dict(cur.columns)
+        # leg-by-leg application of the binary join model — the collapse
+        # is semantics-preserving, so the chain estimate is too
+        for b, kind, pks, bks in zip(node.builds, node.kinds,
+                                     node.probe_keys, node.build_keys):
+            bs = derive(b, catalog)
+            if bs is None:
+                return None
+            ndvs = []
+            for lk, rk in zip(pks, bks):
+                lc, rc = cols.get(lk), bs.col(rk)
+                if lc is not None and lc.ndv:
+                    ndvs.append(lc.ndv)
+                if rc is not None and rc.ndv:
+                    ndvs.append(rc.ndv)
+            out = rows * bs.rows / max(ndvs) if ndvs else max(rows, bs.rows)
+            if kind == "left":
+                out = max(out, rows)
+            rows = out
+            cols.update(bs.columns)
+        return NodeStats(max(1.0, rows), cols)
     if isinstance(node, SemiJoin):
         left = derive(node.left, catalog)
         if left is None:
@@ -463,3 +490,89 @@ def choose_breaker_engine(node: PlanNode, catalog,
             return "sort", f"{src} build {build_rows:.3g} rows > {HASH_MAX_BUILD_ROWS}{suffix}"
         return "hash", f"{src} build {build_rows:.3g} rows{suffix}"
     return "sort", "not an engine-dimensioned breaker"
+
+
+# ---------------------------------------------------------------------------
+# binary-vs-multiway join chain choice (plan/multiway.py collapse pass).
+# Multiway keeps N build tables resident and walks every probe row through
+# all N probes in one compiled pass — it wins when the chain's joins are
+# not so selective that a binary cascade would shrink the intermediate
+# stream early (multiway probes table i for rows a selective join i-1
+# would already have dropped), and when the combined builds fit residency.
+
+# combined build rows past which the resident-builds assumption is off —
+# the collapse declines and the binary chain keeps its PR 15 spill ladder
+MULTIWAY_MAX_BUILD_ROWS = 1 << 22
+# non-unique builds probe through the Pallas fanout kernel; past the
+# binary hash-engine threshold its serial insert loop dominates
+MULTIWAY_MAX_FANOUT_BUILD_ROWS = HASH_MAX_BUILD_ROWS
+# observed probe selectivity (output rows / probe rows) of the bottom
+# join below which the binary cascade's early filtering wins
+MULTIWAY_MIN_SELECTIVITY = 0.02
+
+
+def choose_join_mode(chain, catalog, override: str = "auto",
+                     hbo: str = "off"):
+    """(mode, why) for a collapsible left-deep join chain: ``mode`` ∈
+    {binary, multiway}. ``chain`` is the eligible HashJoin list bottom-up
+    (chain[0] probes the base); ``override`` is the ``join_mode`` session
+    property. Mirrors choose_breaker_engine: ``hbo="correct"`` swaps the
+    estimated build sizes and bottom-join selectivity for runstats history
+    under the joins' structural fingerprints, and the why string carries
+    the ``(hbo: observed)`` provenance suffix."""
+    n = len(chain)
+    if override == "multiway":
+        return "multiway", f"session join_mode=multiway ({n} joins)"
+    if override in ("binary", "off"):
+        return "binary", f"session join_mode={override}"
+    total_build = 0.0
+    src, suffix = "est", ""
+    n_observed = 0
+    for j in chain:
+        build_rows = None
+        if hbo == "correct":
+            h = _observed(j, catalog, "join_build")
+            if h and h.get("actual"):
+                build_rows = float(h["actual"])
+                n_observed += 1
+                src, suffix = "observed", " (hbo: observed)"
+        if build_rows is None:
+            build = derive(j.right, catalog)
+            if build is None or not build.rows:
+                return "binary", "no build-side stats"
+            build_rows = build.rows
+        if not j.build_unique and build_rows > MULTIWAY_MAX_FANOUT_BUILD_ROWS:
+            return "binary", (f"{src} fanout build {build_rows:.3g} rows > "
+                              f"{MULTIWAY_MAX_FANOUT_BUILD_ROWS}{suffix}")
+        total_build += build_rows
+    if total_build > MULTIWAY_MAX_BUILD_ROWS:
+        return "binary", (f"{src} combined builds {total_build:.3g} rows > "
+                          f"{MULTIWAY_MAX_BUILD_ROWS}{suffix}")
+    if n_observed < n:
+        # auto fuses only on observed history: a misestimated chain
+        # compounds the error N ways and pays every build before the
+        # first probe can filter, so estimates alone never flip the
+        # plan shape — the binary run itself lands the history
+        return "binary", (f"{n - n_observed}/{n} builds lack observed "
+                          f"history — binary until hbo=correct repeat")
+    sel = None
+    sel_src, sel_suffix = "est", ""
+    if hbo == "correct":
+        h = _observed(chain[0], catalog, "join_probe_sel")
+        if h and h.get("actual") is not None:
+            sel = float(h["actual"])
+            sel_src, sel_suffix = "observed", " (hbo: observed)"
+            src, suffix = sel_src, sel_suffix
+    if sel is None:
+        probe = derive(chain[0].left, catalog)
+        out = derive(chain[0], catalog)
+        if probe is not None and out is not None and probe.rows:
+            sel = out.rows / probe.rows
+    if sel is not None and sel < MULTIWAY_MIN_SELECTIVITY and n > 2:
+        # deep chain over a near-empty bottom join: the binary cascade
+        # filters before paying the upper probes; multiway pays them all
+        return "binary", (f"{sel_src} bottom-join selectivity {sel:.3g} < "
+                          f"{MULTIWAY_MIN_SELECTIVITY}{sel_suffix}")
+    selpart = f", sel {sel:.3g}" if sel is not None else ""
+    return "multiway", (f"{n} joins, {src} combined builds "
+                        f"{total_build:.3g} rows{selpart}{suffix}")

@@ -1538,12 +1538,19 @@ class Coordinator:
             "BROADCAST": float("inf"),
             "PARTITIONED": 0.0,
         }.get(jdt, self.broadcast_threshold_rows)
-        cache_key = (sql, jdt)
+        jm = ((session.get("join_mode") if session else None) or
+              getattr(self.config, "join_mode", "auto")).lower()
+        cache_key = (sql, jdt, jm)
         hit = self._dplan_cache.get(cache_key) if sql else None
         if hit is not None:
             return hit
         qp = optimize(plan_query(stmt if stmt is not None else sql,
                                  self.catalog), self.catalog)
+        if jm != "off":
+            from presto_tpu.plan.multiway import apply_join_mode
+
+            cfg = session.exec_config() if session else self.config
+            apply_join_mode(qp, self.catalog, cfg)
         cacheable = bool(sql) and not qp.scalar_subqueries and qp.cacheable
         if qp.scalar_subqueries:
             # bind uncorrelated scalar subqueries coordinator-side first

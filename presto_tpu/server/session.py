@@ -238,6 +238,18 @@ class SystemSessionProperties:
                              "sort/hash force one engine", str, "auto",
                              validator=_enum("breaker_engine",
                                              ["AUTO", "SORT", "HASH"])),
+            PropertyMetadata("join_mode",
+                             "Star-schema join chain collapse: auto lets the "
+                             "CBO fold eligible inner/left equi-join chains "
+                             "into one multiway probe program from HBO-"
+                             "corrected build sizes and selectivities; "
+                             "multiway forces every eligible chain; binary "
+                             "declines but stamps the verdict in EXPLAIN; "
+                             "off skips the pass (pre-collapse plan "
+                             "bit-for-bit)", str, "auto",
+                             validator=_enum("join_mode",
+                                             ["AUTO", "MULTIWAY", "BINARY",
+                                              "OFF"])),
             PropertyMetadata("hbo",
                              "History-based optimization: off disables even "
                              "observation (pre-HBO behavior bit-for-bit); "
@@ -454,6 +466,7 @@ class Session:
             fragment_fusion=self.get("fragment_fusion"),
             fragment_window=self.get("fragment_window"),
             breaker_engine=self.get("breaker_engine").lower(),
+            join_mode=self.get("join_mode").lower(),
             hbo=self.get("hbo").lower(),
             devprof=self.get("devprof").lower(),
             profile=self.get("profile"),
