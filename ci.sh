@@ -1234,17 +1234,18 @@ if [ "$rc" -ne 0 ]; then
   exit "$rc"
 fi
 
-# Static-analysis step: the kernel lint must be clean over the shipped
-# tree, the analyzer must actually FAIL on an injected violation (a
-# linter that can't fail is decoration), the plan-invariant checker must
-# pass over every TPC-H tier-1 plan (re-checked after each optimizer
-# pass), and a representative query must execute under the
-# bounded-recompile guard.
-echo "== analysis: kernel lint + plan invariants + recompile guard =="
-env JAX_PLATFORMS=cpu python -m presto_tpu.analysis
+# Static-analysis step, consolidated: ONE `--all` invocation runs every
+# plane — kernel lint, concurrency safety, knob-flow cache-key
+# soundness, stale-suppression hygiene, TPC-H plan invariants, and the
+# bounded-recompile guard — over the shipped tree with per-pass wall
+# timing, and must come back with zero findings. Each plane then proves
+# it can actually FAIL on an injected violation (a checker that can't
+# fail is decoration).
+echo "== analysis: all planes (lint, concurrency, knob-flow, stale, plans, recompile) =="
+env JAX_PLATFORMS=cpu python -m presto_tpu.analysis --all
 rc=$?
 if [ "$rc" -ne 0 ]; then
-  echo "analysis step FAILED: shipped tree does not lint clean (exit $rc)"
+  echo "analysis step FAILED: shipped tree does not analyze clean (exit $rc)"
   exit 1
 fi
 inj="$(mktemp -d)/ops"; mkdir -p "$inj"
@@ -1272,31 +1273,12 @@ if [ $? -ne 0 ]; then
   exit 1
 fi
 echo "injected-violation self-check OK (exit $rc, 3 rules attributed)"
-env JAX_PLATFORMS=cpu python -m presto_tpu.analysis --no-lint --tpch-plans
-rc=$?
-if [ "$rc" -ne 0 ]; then
-  echo "analysis step FAILED: TPC-H plan invariants (exit $rc)"
-  exit 1
-fi
-env JAX_PLATFORMS=cpu python -m presto_tpu.analysis --no-lint --tpch-run q1,q6
-rc=$?
-if [ "$rc" -ne 0 ]; then
-  echo "analysis step FAILED: recompile guard over TPC-H (exit $rc)"
-  exit 1
-fi
 
-# Concurrency-lint step: the whole-program lock-discipline analysis must
-# be clean over the shipped tree, and must FAIL on an injected module
-# carrying the three bug classes it exists for: an unguarded mutation of
-# lock-guarded state, a check-then-act split across two critical
-# sections, and a two-lock lock-order cycle.
-echo "== analysis: concurrency safety (lock discipline + races) =="
-env JAX_PLATFORMS=cpu python -m presto_tpu.analysis --no-lint --concurrency
-rc=$?
-if [ "$rc" -ne 0 ]; then
-  echo "concurrency step FAILED: shipped tree is not race-clean (exit $rc)"
-  exit 1
-fi
+# Concurrency self-check: the pass (already run clean under --all above)
+# must FAIL on an injected module carrying the three bug classes it
+# exists for: an unguarded mutation of lock-guarded state, a
+# check-then-act split across two critical sections, and a two-lock
+# lock-order cycle.
 cinj="$(mktemp -d)"
 cat > "$cinj/injected_conc.py" <<'PYEOF'
 import threading
@@ -1349,6 +1331,107 @@ if [ $? -ne 0 ]; then
   exit 1
 fi
 echo "concurrency self-check OK (exit $rc, 3 rules attributed)"
+
+# Knob-flow self-check: each of the four cache-key soundness rules must
+# fire with file:line attribution on its minimal injected violation — a
+# volatile ExecConfig field captured by a program builder closure, an
+# undeclared PRESTO_TPU_* env read inside traced code, a key consumer
+# reading outside its declared covers() set, and an operator-state
+# NamedTuple missing from the pytree serialization table.
+kinj="$(mktemp -d)"; mkdir -p "$kinj/ops"
+cat > "$kinj/injected_leak.py" <<'PYEOF'
+def build(node, ctx):
+    hbo = ctx.config.hbo
+
+    def fn(x):
+        return x if hbo == "off" else x + 1
+    return _node_jit(node, "probe", lambda: fn)
+PYEOF
+cat > "$kinj/injected_knob.py" <<'PYEOF'
+import os
+
+import jax
+
+
+@jax.jit
+def kernel(x):
+    return x if os.environ.get("PRESTO_TPU_TURBO") else -x
+PYEOF
+cat > "$kinj/injected_drift.py" <<'PYEOF'
+def derive(root):  # fp: key(inj-key) covers(plan-structure)
+    return hash(root)
+
+
+def consume(root, config):  # fp: uses-key(inj-key)
+    k = derive(root)
+    return (k, config.batch_rows)
+PYEOF
+cat > "$kinj/ops/injected_state.py" <<'PYEOF'
+from typing import NamedTuple
+
+
+class InjectedState(NamedTuple):
+    rows: int
+PYEOF
+env JAX_PLATFORMS=cpu python -m presto_tpu.analysis --no-lint --knob-flow \
+    "$kinj" > /tmp/_kinj.log 2>&1
+rc=$?
+rm -rf "$kinj"
+if [ "$rc" -eq 0 ]; then
+  echo "knob-flow step FAILED: injected violations were NOT detected"
+  cat /tmp/_kinj.log
+  exit 1
+fi
+grep -q "injected_leak.py:6: \[volatile-leak\]" /tmp/_kinj.log \
+  && grep -q "injected_knob.py:8: \[unfingerprinted-knob\]" /tmp/_kinj.log \
+  && grep -q "injected_drift.py:7: \[cache-key-drift\]" /tmp/_kinj.log \
+  && grep -q "ops/injected_state.py:4: \[unregistered-state\]" /tmp/_kinj.log
+if [ $? -ne 0 ]; then
+  echo "knob-flow step FAILED: injected findings missing rule/file:line"
+  cat /tmp/_kinj.log
+  exit 1
+fi
+echo "knob-flow self-check OK (exit $rc, 4 rules attributed)"
+
+# Stale-suppression self-check: an allow() whose rule does not fire at
+# its site must be flagged (a suppression that outlives its bug hides
+# the next real one).
+sinj="$(mktemp -d)"
+printf 'x = 1  # lint: allow(host-sync)\n' > "$sinj/injected_stale.py"
+env JAX_PLATFORMS=cpu python -m presto_tpu.analysis --no-lint \
+    --stale-suppressions "$sinj" > /tmp/_sinj.log 2>&1
+rc=$?
+rm -rf "$sinj"
+if [ "$rc" -eq 0 ]; then
+  echo "stale-suppression step FAILED: stale allow() was NOT detected"
+  cat /tmp/_sinj.log
+  exit 1
+fi
+if ! grep -q "injected_stale.py:1: \[stale-suppression\]" /tmp/_sinj.log; then
+  echo "stale-suppression step FAILED: finding missing rule/file:line"
+  cat /tmp/_sinj.log
+  exit 1
+fi
+echo "stale-suppression self-check OK (exit $rc)"
+
+# Knob-inventory drift check: the README's embedded knob table must
+# match the auto-generated one (the inventory is the documentation of
+# record for every knob's cache semantics — a new knob lands with its
+# volatility class decided and published, or CI fails here).
+env JAX_PLATFORMS=cpu python -m presto_tpu.analysis --knobs > /tmp/_knobs.md
+rc=$?
+if [ "$rc" -ne 0 ]; then
+  echo "knob-inventory step FAILED: --knobs exited $rc"
+  exit 1
+fi
+awk '/<!-- knobs:begin -->/{f=1;next} /<!-- knobs:end -->/{f=0} f' \
+    README.md > /tmp/_knobs_readme.md
+if ! diff -u /tmp/_knobs_readme.md /tmp/_knobs.md > /tmp/_knobs.diff; then
+  echo "knob-inventory step FAILED: README table drifted from --knobs output"
+  cat /tmp/_knobs.diff
+  exit 1
+fi
+echo "knob-inventory drift check OK ($(wc -l < /tmp/_knobs.md | tr -d ' ') lines)"
 
 # Multiway-join smoke: a q3-shaped star chain forced through the fused
 # N-ary probe must (1) return checksum-identical results to the binary

@@ -9,6 +9,16 @@ Usage:
       whole-program concurrency-safety analysis (lock discipline,
       check-then-act races, lock-order cycles, locks in jit regions)
       over presto_tpu/ (or the given paths)
+  python -m presto_tpu.analysis --knob-flow [paths...]
+      cache-key soundness: taint from ExecConfig/session/env knob reads
+      to traced-program sinks; volatile-leak, unfingerprinted-knob,
+      cache-key-drift, unregistered-state
+  python -m presto_tpu.analysis --stale-suppressions [paths...]
+      flag `# lint: allow(...)` / `# fp: allow(...)` / `# shared:`
+      annotations whose rule no longer fires at that site
+  python -m presto_tpu.analysis --knobs
+      print the auto-generated knob inventory (session properties ×
+      ExecConfig fields × PRESTO_TPU_* env vars) as a markdown table
   python -m presto_tpu.analysis --tpch-plans [--sf 0.01]
       build + optimize + fragment the canonical TPC-H queries (texts
       loaded from --queries, default tests/test_tpch.py) and run the
@@ -16,6 +26,8 @@ Usage:
   python -m presto_tpu.analysis --tpch-run q1,q6 [--shape-budget N]
       execute the named TPC-H queries with the bounded-recompile guard
       enforced
+  python -m presto_tpu.analysis --all
+      every pass above in one invocation, with per-pass wall timing
 
 Modes compose; findings from all requested planes are merged into one
 text or JSON document and the exit code is 1 iff any finding exists.
@@ -26,15 +38,20 @@ from __future__ import annotations
 import argparse
 import os
 import sys
-from typing import List
+import time
+from typing import List, Tuple
 
 from presto_tpu.analysis.findings import Finding, render_json, render_text
 
 
-def _default_scope() -> List[str]:
+def _pkg_root() -> str:
     import presto_tpu
 
-    pkg = os.path.dirname(os.path.abspath(presto_tpu.__file__))
+    return os.path.dirname(os.path.abspath(presto_tpu.__file__))
+
+
+def _default_scope() -> List[str]:
+    pkg = _pkg_root()
     return [os.path.join(pkg, "ops"),
             os.path.join(pkg, "exec", "runtime.py"),
             os.path.join(pkg, "exec", "fragment_jit.py")]
@@ -134,7 +151,8 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m presto_tpu.analysis",
         description="presto_tpu static analysis: kernel lint, plan "
-                    "invariants, recompile guard")
+                    "invariants, recompile guard, concurrency safety, "
+                    "cache-key soundness")
     ap.add_argument("paths", nargs="*",
                     help="files/directories to lint (default: the kernel "
                          "modules)")
@@ -147,6 +165,17 @@ def main(argv=None) -> int:
     ap.add_argument("--concurrency", action="store_true",
                     help="run the concurrency-safety analysis (default "
                          "scope: the whole presto_tpu package)")
+    ap.add_argument("--knob-flow", action="store_true",
+                    help="run the cache-key soundness taint pass "
+                         "(default scope: the whole presto_tpu package)")
+    ap.add_argument("--stale-suppressions", action="store_true",
+                    help="flag allow()/shared: annotations whose rule "
+                         "no longer fires")
+    ap.add_argument("--knobs", action="store_true",
+                    help="print the auto-generated knob inventory table "
+                         "and exit")
+    ap.add_argument("--all", action="store_true", dest="all_passes",
+                    help="run every analysis pass with per-pass timing")
     ap.add_argument("--tpch-plans", action="store_true",
                     help="check plan invariants over the TPC-H queries")
     ap.add_argument("--tpch-run", default=None, metavar="q1,q6",
@@ -159,55 +188,105 @@ def main(argv=None) -> int:
                     help="compiled-shape budget per node program")
     args = ap.parse_args(argv)
 
+    if args.knobs:
+        from presto_tpu.analysis.knob_flow import (
+            knob_inventory,
+            render_knob_table,
+        )
+
+        rows = knob_inventory()
+        if args.json:
+            import json
+
+            print(json.dumps({"knobs": rows}, indent=2, sort_keys=True))
+        else:
+            print(render_knob_table(rows))
+        return 0
+
+    run_lint = (not args.no_lint) or args.all_passes
+    run_conc = args.concurrency or args.all_passes
+    run_knob = getattr(args, "knob_flow") or args.all_passes
+    run_stale = args.stale_suppressions or args.all_passes
+    run_plans = args.tpch_plans or args.all_passes
+    tpch_run = args.tpch_run or ("q1,q6" if args.all_passes else None)
+
     findings: List[Finding] = []
     planes: List[str] = []
-    if not args.no_lint:
+    timings: List[Tuple[str, float]] = []
+
+    def plane(name: str, fn) -> bool:
+        t0 = time.perf_counter()
+        try:
+            findings.extend(fn())
+        except OSError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return False
+        timings.append((name, time.perf_counter() - t0))
+        planes.append(name)
+        return True
+
+    pkg_scope = args.paths or [_pkg_root()]
+    if run_lint:
         from presto_tpu.analysis.kernel_lint import RULES, lint_paths
 
         rules = (tuple(r.strip() for r in args.rules.split(","))
                  if args.rules else RULES)
         paths = args.paths or _default_scope()
-        try:
-            findings.extend(lint_paths(paths, rules))
-        except OSError as e:
-            print(f"error: {e}", file=sys.stderr)
+        label = f"lint ({', '.join(os.path.relpath(p) for p in paths)})"
+        if not plane(label, lambda: lint_paths(paths, rules)):
             return 2
-        planes.append(f"lint ({', '.join(os.path.relpath(p) for p in paths)})")
-    if args.concurrency:
-        import presto_tpu
+    if run_conc:
         from presto_tpu.analysis import concurrency
 
         crules = (tuple(r.strip() for r in args.rules.split(","))
                   if args.rules else concurrency.RULES)
-        cpaths = args.paths or [
-            os.path.dirname(os.path.abspath(presto_tpu.__file__))]
-        try:
-            findings.extend(concurrency.analyze_paths(cpaths, crules))
-        except OSError as e:
-            print(f"error: {e}", file=sys.stderr)
+        label = ("concurrency "
+                 f"({', '.join(os.path.relpath(p) for p in pkg_scope)})")
+        if not plane(label,
+                     lambda: concurrency.analyze_paths(pkg_scope, crules)):
             return 2
-        planes.append(
-            f"concurrency ({', '.join(os.path.relpath(p) for p in cpaths)})")
-    if args.tpch_plans:
-        findings.extend(_check_tpch_plans(args.sf, args.queries))
-        planes.append("tpch plan invariants")
-    if args.tpch_run:
+    if run_knob:
+        from presto_tpu.analysis import knob_flow
+
+        krules = (tuple(r.strip() for r in args.rules.split(","))
+                  if args.rules else knob_flow.RULES)
+        label = ("knob-flow "
+                 f"({', '.join(os.path.relpath(p) for p in pkg_scope)})")
+        if not plane(label,
+                     lambda: knob_flow.analyze_paths(pkg_scope, krules)):
+            return 2
+    if run_stale:
+        from presto_tpu.analysis import stale
+
+        label = "stale-suppressions"
+        if not plane(label, lambda: stale.analyze_paths(
+                pkg_scope, lint_paths=_default_scope())):
+            return 2
+    if run_plans:
+        plane("tpch plan invariants",
+              lambda: _check_tpch_plans(args.sf, args.queries))
+    if tpch_run:
         from presto_tpu.analysis.recompile import DEFAULT_SHAPE_BUDGET
 
         budget = (DEFAULT_SHAPE_BUDGET if args.shape_budget is None
                   else args.shape_budget)
-        names = [n.strip() for n in args.tpch_run.split(",") if n.strip()]
-        findings.extend(
-            _run_tpch_guarded(names, args.sf, args.queries, budget))
-        planes.append(f"tpch recompile guard ({', '.join(names)})")
+        names = [n.strip() for n in tpch_run.split(",") if n.strip()]
+        plane(f"tpch recompile guard ({', '.join(names)})",
+              lambda: _run_tpch_guarded(names, args.sf, args.queries,
+                                        budget))
 
+    timing_map = {name: round(secs, 3) for name, secs in timings}
     if args.json:
-        print(render_json(findings, {"planes": planes}))
+        print(render_json(findings, {"planes": planes,
+                                     "timings": timing_map}))
     else:
         if findings:
             print(render_text(findings))
         else:
             print(f"clean: {'; '.join(planes)} — 0 findings")
+        if args.all_passes:
+            for name, secs in timings:
+                print(f"  {secs:7.2f}s  {name}")
     return 1 if findings else 0
 
 

@@ -24,7 +24,14 @@ import os
 import re
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
-_ALLOW_RE = re.compile(r"#\s*lint:\s*allow\(([a-z0-9_,\- ]+)\)")
+def _allow_re(marker: str) -> "re.Pattern":
+    """`# <marker>: allow(rule[, rule...])` — `lint` for the kernel/
+    concurrency planes, `fp` for the knob-flow/fingerprint plane."""
+    return re.compile(
+        r"#\s*" + re.escape(marker) + r":\s*allow\(([a-z0-9_,\- ]+)\)")
+
+
+_ALLOW_RE = _allow_re("lint")
 
 
 def _root_name(e: ast.expr) -> Optional[str]:
@@ -44,10 +51,11 @@ class Suppressions:
     """Index of `# lint: allow(rule, ...)` comments: per-line sets plus
     def-level spans (an allow() on a `def` line covers the body)."""
 
-    def __init__(self, source: str):
+    def __init__(self, source: str, marker: str = "lint"):
         self.lines: Dict[int, Set[str]] = {}
+        allow = _ALLOW_RE if marker == "lint" else _allow_re(marker)
         for i, line in enumerate(source.splitlines(), start=1):
-            m = _ALLOW_RE.search(line)
+            m = allow.search(line)
             if m:
                 self.lines[i] = {r.strip() for r in m.group(1).split(",")}
         self.spans: List[Tuple[int, int, Set[str]]] = []

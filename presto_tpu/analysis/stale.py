@@ -1,0 +1,265 @@
+"""Stale-annotation reporter: suppressions must not outlive their bugs.
+
+Every analysis plane lets code opt out of a rule with a trailing
+annotation — ``# lint: allow(...)`` (kernel/concurrency),
+``# fp: allow(...)`` (knob-flow), ``# shared: guarded-by(...)`` /
+``# shared: requires(...)`` (concurrency guard registration). Each one
+is a claim: *the rule fires here and the firing is intentional* (or,
+for ``shared:``, *this state needs a guard contract*). When the code
+under an annotation is refactored, the claim silently stops being
+true and the annotation becomes a booby trap — it will hide the next
+real bug introduced at that site.
+
+This pass re-runs every analysis plane over the tree with all
+annotations stripped (line numbers preserved) and flags each
+annotation whose rule no longer fires at its site:
+
+- ``allow(rule, ...)``: stale unless one of its rules fires at the
+  annotated line (def-line annotations cover the def body, matching
+  the suppression semantics).
+- ``guarded-by(lock)`` / ``requires(lock)``: these are guard
+  *registrations*, not suppressions — removing one changes the
+  concurrency pass's inference rather than necessarily producing a
+  finding, so strip-and-rerun is the wrong test. They go stale by
+  becoming ORPHANED: the pass consumes ``guarded-by`` only on
+  assignment lines (module-level names, ``self.attr`` in methods) and
+  ``requires`` only on ``def`` header lines, so an annotation sitting
+  on any other statement — the usual aftermath of a refactor that
+  moved the code out from under its comment — registers nothing and is
+  flagged.
+
+Rule names that no pass knows are reported as ``unknown-rule``.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from presto_tpu.analysis import astutil, concurrency, kernel_lint, knob_flow
+from presto_tpu.analysis.findings import Finding
+
+PLANE = "hygiene"
+
+_ALLOW_ANN = re.compile(
+    r"#\s*(lint|fp):\s*allow\(([a-z0-9_,\- ]+)\)")
+_SHARED_ANN = re.compile(
+    r"#\s*shared:\s*(guarded-by|requires)\(([^)]*)\)")
+# only allow() suppressions are stripped for the rerun; shared: guard
+# registrations stay in place (they feed inference, see module doc)
+_STRIP_RES = (
+    re.compile(r"#\s*(?:lint|fp):\s*allow\([a-z0-9_,\- ]+\).*"),
+)
+
+_KNOWN_RULES = (set(kernel_lint.RULES) | set(concurrency.RULES)
+                | set(knob_flow.RULES))
+
+_CONC_RULES = {"unguarded", "check-then-act"}
+
+class _Annotation:
+    def __init__(self, kind: str, line: int, rules: Set[str],
+                 col: int = 0):
+        self.kind = kind          # "allow" | "guarded-by" | "requires"
+        self.line = line
+        self.rules = rules
+        self.col = col
+
+
+def _string_spans(tree: ast.AST) -> List[Tuple[int, int, int, int]]:
+    """(lineno, col, end_lineno, end_col) of every string literal —
+    docstrings that MENTION the annotation syntax are not annotations."""
+    out = []
+    for n in ast.walk(tree):
+        if isinstance(n, ast.Constant) and isinstance(n.value, str) \
+                and n.end_lineno is not None:
+            out.append((n.lineno, n.col_offset, n.end_lineno,
+                        n.end_col_offset or 0))
+    return out
+
+
+def _in_string(line: int, col: int,
+               spans: List[Tuple[int, int, int, int]]) -> bool:
+    for lo, lc, hi, hc in spans:
+        if (line, col) >= (lo, lc) and (line, col) < (hi, hc):
+            return True
+        if lo < line < hi:
+            return True
+    return False
+
+
+def _collect_and_strip(source: str,
+                       str_spans: List[Tuple[int, int, int, int]]
+                       ) -> Tuple[str, List[_Annotation]]:
+    anns: List[_Annotation] = []
+    out_lines: List[str] = []
+    for i, line in enumerate(source.splitlines(), start=1):
+        m = _ALLOW_ANN.search(line)
+        if m and not _in_string(i, m.start(), str_spans):
+            rules = {r.strip() for r in m.group(2).split(",") if r.strip()}
+            anns.append(_Annotation("allow", i, rules, m.start()))
+        m = _SHARED_ANN.search(line)
+        if m and not _in_string(i, m.start(), str_spans):
+            anns.append(_Annotation(m.group(1), i, set(), m.start()))
+        stripped = line
+        if not _in_string(i, 0, str_spans) \
+                and not _in_string(i, max(0, len(line) - 1), str_spans):
+            for pat in _STRIP_RES:
+                stripped = pat.sub("", stripped)
+        out_lines.append(stripped.rstrip())
+    return "\n".join(out_lines) + "\n", anns
+
+
+def _consumable_lines(tree: ast.AST) -> Tuple[Set[int], Set[int]]:
+    """(guard_lines, def_lines): the statement lines where the
+    concurrency pass actually reads a guarded-by / requires annotation —
+    assignments to plain names or ``self.attr``, and def headers."""
+    guards: Set[int] = set()
+    defs: Set[int] = set()
+    for n in ast.walk(tree):
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs.add(n.lineno)
+        elif isinstance(n, (ast.Assign, ast.AnnAssign)):
+            targets = (n.targets if isinstance(n, ast.Assign)
+                       else [n.target])
+            if getattr(n, "value", None) is None:
+                continue
+            for t in targets:
+                if isinstance(t, ast.Name) or (
+                        isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"):
+                    guards.add(n.lineno)
+    return guards, defs
+
+
+def _def_spans(tree: ast.AST) -> List[Tuple[int, int]]:
+    return [(n.lineno, n.end_lineno or n.lineno)
+            for n in ast.walk(tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+
+
+def _line_of(f: Finding) -> int:
+    try:
+        return int(f.loc.rsplit(":", 1)[1])
+    except (IndexError, ValueError):
+        return 0
+
+
+def analyze_paths(paths: Sequence[str],
+                  lint_paths: Optional[Sequence[str]] = None
+                  ) -> List[Finding]:
+    """Flag annotations in `paths` whose rule no longer fires.
+
+    `lint_paths` bounds the kernel-lint plane to its usual scope (ops/
+    plus the jit runtime modules); concurrency and knob-flow scan
+    everything, matching the real CLI passes.
+    """
+    files = astutil.iter_py_files(paths)
+    lint_scope = set(astutil.iter_py_files(lint_paths)) \
+        if lint_paths is not None else set(files)
+
+    stripped: Dict[str, str] = {}
+    annotations: Dict[str, List[_Annotation]] = {}
+    triples: List[Tuple[str, str, ast.AST]] = []
+    spans: Dict[str, List[Tuple[int, int]]] = {}
+    consumable: Dict[str, Tuple[Set[int], Set[int]]] = {}
+    out: List[Finding] = []
+    for p in files:
+        try:
+            src, orig_tree = astutil.load_file(p)
+        except (OSError, SyntaxError):
+            continue
+        s_src, anns = _collect_and_strip(src, _string_spans(orig_tree))
+        try:
+            # annotation-free files still parse into the module set: the
+            # concurrency/knob-flow fixpoints are interprocedural
+            tree = astutil.parse(s_src, p)
+        except SyntaxError:
+            continue
+        stripped[p] = s_src
+        annotations[p] = anns
+        triples.append((s_src, p, tree))
+        spans[p] = _def_spans(tree)
+        consumable[p] = _consumable_lines(orig_tree)
+
+    # one stripped-tree run per plane; merged per-file finding index
+    by_file: Dict[str, List[Finding]] = {p: [] for p in stripped}
+    for src, p, tree in triples:
+        if p in lint_scope:
+            for f in kernel_lint.lint_source(src, p, kernel_lint.RULES,
+                                             tree=tree):
+                by_file[p].append(f)
+    for f in concurrency.analyze_modules(triples, concurrency.RULES):
+        if f.loc.rsplit(":", 1)[0] in by_file:
+            by_file[f.loc.rsplit(":", 1)[0]].append(f)
+    for f in knob_flow.analyze_modules(triples, knob_flow.RULES):
+        if f.loc.rsplit(":", 1)[0] in by_file:
+            by_file[f.loc.rsplit(":", 1)[0]].append(f)
+
+    for p, anns in annotations.items():
+        found = by_file.get(p, [])
+        for ann in anns:
+            out.extend(_judge(p, ann, found, spans.get(p, []),
+                              consumable.get(p, (set(), set()))))
+    return sorted(out, key=lambda f: f.loc)
+
+
+def _covering_span(line: int,
+                   spans: List[Tuple[int, int]]) -> Tuple[int, int]:
+    """The innermost def whose header starts at/just below the
+    annotation line; else the line itself."""
+    best = None
+    for lo, hi in spans:
+        if lo <= line + 1 and line <= hi and line >= lo - 1:
+            if lo in (line, line + 1) or lo <= line <= hi:
+                if best is None or lo > best[0]:
+                    best = (lo, hi)
+    if best is not None and best[0] in (line, line + 1):
+        return best  # def-line annotation covers the body
+    return (line, line)
+
+
+def _judge(path: str, ann: _Annotation, found: List[Finding],
+           spans: List[Tuple[int, int]],
+           consumable: Tuple[Set[int], Set[int]]) -> List[Finding]:
+    guard_lines, def_lines = consumable
+    out: List[Finding] = []
+    if ann.kind == "allow":
+        unknown = ann.rules - _KNOWN_RULES
+        for r in sorted(unknown):
+            out.append(Finding(
+                "unknown-rule", f"{path}:{ann.line}",
+                f"allow({r}) names a rule no analysis pass defines",
+                PLANE))
+        rules = ann.rules & _KNOWN_RULES
+        if not rules:
+            return out
+        lo, hi = _covering_span(ann.line, spans)
+        live = any(f.rule in rules and lo <= _line_of(f) <= hi
+                   for f in found)
+        if not live:
+            out.append(Finding(
+                "stale-suppression", f"{path}:{ann.line}",
+                f"allow({', '.join(sorted(rules))}) suppresses nothing: "
+                f"no listed rule fires here when the annotation is "
+                f"removed — delete it so it cannot mask a future bug",
+                PLANE))
+    elif ann.kind == "guarded-by":
+        if ann.line not in guard_lines:
+            out.append(Finding(
+                "stale-suppression", f"{path}:{ann.line}",
+                "guarded-by(...) is orphaned: the concurrency pass "
+                "reads it only on an assignment to a name or "
+                "`self.attr`, and this line has none — the state it "
+                "once registered moved out from under the annotation",
+                PLANE))
+    elif ann.kind == "requires":
+        if ann.line not in def_lines:
+            out.append(Finding(
+                "stale-suppression", f"{path}:{ann.line}",
+                "requires(...) is orphaned: the concurrency pass reads "
+                "it only on a `def` header line, and this line is not "
+                "one — the contract binds nothing", PLANE))
+    return out

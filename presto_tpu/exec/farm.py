@@ -108,13 +108,56 @@ def corpus_path() -> Optional[str]:
     return os.path.join(d, _CORPUS_FILE)
 
 
-def _fp24(root) -> Optional[str]:
+def _fp24(root) -> Optional[str]:  # fp: key(farm-corpus) covers(plan-structure, config)
     """Config-free structural fingerprint of a plan root — the farm's
-    status/corpus key (matches the HBO fingerprint's structural half)."""
+    status/corpus key (matches the HBO fingerprint's structural half).
+    The key covers config even though the sha is config-free because
+    every corpus record CARRIES the recording process's non-volatile
+    config (`cfg`, see record_plan) and the armers warm under it —
+    programs land in the same `_program_ns` the recorded traffic used,
+    not whatever config the booting process happens to hold."""
     from presto_tpu.exec.programs import structural_fingerprint
 
     fp = structural_fingerprint(root)
     return fp[:24] if fp else None
+
+
+def _cfg_doc(config) -> Dict[str, Any]:
+    """JSON-safe dump of the non-volatile (program-relevant) ExecConfig
+    fields — exactly the set config_fingerprint hashes, so a corpus
+    record pins the program identity its plan compiled under."""
+    import dataclasses
+
+    from presto_tpu.exec.programs import _VOLATILE_CONFIG_FIELDS
+
+    out: Dict[str, Any] = {}
+    for f in dataclasses.fields(config):
+        if f.name in _VOLATILE_CONFIG_FIELDS:
+            continue
+        v = getattr(config, f.name, None)
+        if isinstance(v, tuple):
+            v = list(v)
+        if v is None or isinstance(v, (bool, int, float, str, list)):
+            out[f.name] = v
+    return out
+
+
+def _cfg_restore(config, doc) -> Any:
+    """The recorded config, reconstructed over the ambient one: known
+    fields are replaced (JSON lists back to tuples — JSON has no
+    tuples, so any list in a cfg doc started as one), unknown fields
+    (older/newer writer) are ignored."""
+    import dataclasses
+
+    if not isinstance(doc, dict) or not doc:
+        return config
+    known = {f.name for f in dataclasses.fields(config)}
+    fixed = {k: (tuple(v) if isinstance(v, list) else v)
+             for k, v in doc.items() if k in known}
+    try:
+        return dataclasses.replace(config, **fixed)
+    except (TypeError, ValueError):
+        return config
 
 
 def _sql_sha(sql: str) -> str:
@@ -167,6 +210,7 @@ def record_plan(root, ctx) -> bool:
     except (CodecError, TypeError, ValueError):
         return False
     ok = _append({"v": 1, "kind": "plan", "fp": fp, "plan": doc,
+                  "cfg": _cfg_doc(ctx.config),
                   "ts": round(time.time(), 3)})
     if ok:
         with _lock:
@@ -197,7 +241,8 @@ def load_corpus() -> Dict[str, Dict[str, Any]]:
     and skipped; ``deleted`` tombstones drop their key). Cached on the
     file's (mtime, size) so queue-wait speculation stays cheap."""
     path = corpus_path()
-    empty: Dict[str, Dict[str, Any]] = {"plans": {}, "sql": {}}
+    empty: Dict[str, Dict[str, Any]] = {"plans": {}, "sql": {},
+                                        "cfgs": {}}
     if path is None or not os.path.exists(path):
         return empty
     try:
@@ -212,6 +257,7 @@ def load_corpus() -> Dict[str, Dict[str, Any]]:
 
     plans: Dict[str, Any] = {}
     sqls: Dict[str, Any] = {}
+    cfgs: Dict[str, Any] = {}
     skipped = 0
     lk = _flock(path, exclusive=False)
     try:
@@ -227,8 +273,12 @@ def load_corpus() -> Dict[str, Dict[str, Any]]:
                         fp = str(rec["fp"])
                         if rec.get("deleted"):
                             plans.pop(fp, None)
+                            cfgs.pop(fp, None)
                         else:
                             plans[fp] = rec["plan"]
+                            # pre-cfg records (older writers) arm with
+                            # the ambient config, same as before
+                            cfgs[fp] = rec.get("cfg") or {}
                     elif kind == "sql":
                         sqls[str(rec["sql"])] = [str(f)
                                                  for f in rec["fps"]]
@@ -240,7 +290,7 @@ def load_corpus() -> Dict[str, Dict[str, Any]]:
         return empty
     finally:
         _funlock(lk)
-    corpus = {"plans": plans, "sql": sqls}
+    corpus = {"plans": plans, "sql": sqls, "cfgs": cfgs}
     with _lock:
         # stamp-keyed memo: racing parsers store (stamp, corpus) as an
         # atomic pair, so a stale pair self-heals on the next stat probe
@@ -404,11 +454,14 @@ def _warm_tasks_for(root, catalog, config) -> List[Callable]:
     return _chain_warmers(root, ctx)
 
 
-def _run_entry(fp: str, doc, catalog, config, status: str) -> int:
-    """Arm one corpus plan: decode, install, run its warmers under
-    inflight claims, attribute the compile delta to the farm. Returns
-    warm tasks run (≥0), or -1 when the plan was skipped (undecodable /
-    uninstallable) — skips never count as armed."""
+def _run_entry(fp: str, doc, catalog, config, status: str,
+               cfg=None) -> int:  # fp: uses-key(farm-corpus)
+    """Arm one corpus plan: decode, install under the RECORDED config
+    (`cfg`, falling back to the ambient one for pre-cfg records), run
+    its warmers under inflight claims, attribute the compile delta to
+    the farm. Returns warm tasks run (≥0), or -1 when the plan was
+    skipped (undecodable / uninstallable) — skips never count as
+    armed."""
     from presto_tpu.exec import programs as _programs
     from presto_tpu.obs import metrics as _obs_metrics
     from presto_tpu.plan.codec import CodecError, node_from_json
@@ -420,7 +473,7 @@ def _run_entry(fp: str, doc, catalog, config, status: str) -> int:
             _counters["skipped"] += 1
         return -1
     try:
-        tasks = _warm_tasks_for(root, catalog, config)
+        tasks = _warm_tasks_for(root, catalog, _cfg_restore(config, cfg))
     except Exception:
         with _lock:
             _counters["skipped"] += 1
@@ -463,7 +516,8 @@ def _run_entry(fp: str, doc, catalog, config, status: str) -> int:
 
 
 def boot(catalog, config=None, workers: Optional[int] = None,
-         block: bool = True, limit: Optional[int] = None) -> int:
+         block: bool = True,
+         limit: Optional[int] = None) -> int:  # fp: uses-key(farm-corpus)
     """Pre-arm the process-wide program cache from the persisted corpus.
     Returns the number of corpus plans armed. block=True (coordinator
     boot) waits for the pool — "ready" means warm."""
@@ -509,7 +563,8 @@ def boot(catalog, config=None, workers: Optional[int] = None,
     armed_lock = threading.Lock()
 
     def arm(fp):
-        if _run_entry(fp, plans[fp], catalog, config, "armed") >= 0:
+        if _run_entry(fp, plans[fp], catalog, config, "armed",
+                      cfg=corpus["cfgs"].get(fp)) >= 0:
             with armed_lock:
                 armed[0] += 1
 
@@ -540,7 +595,7 @@ def speculate(sql: str, catalog, config, group: Optional[str] = None,
               charge_fn: Optional[Callable[[int], None]] = None,
               budget_fn: Optional[Callable[[], Optional[int]]] = None,
               query_id: Optional[str] = None,
-              workers: Optional[int] = None):
+              workers: Optional[int] = None):  # fp: uses-key(farm-corpus)
     """Queue-wait precompile: while the query queues, compile the corpus
     plans recorded for its statement digest. The compile delta is charged
     to the resource group via `charge_fn`; a dry budget (`budget_fn`
@@ -552,6 +607,7 @@ def speculate(sql: str, catalog, config, group: Optional[str] = None,
     corpus = load_corpus()
     fps = corpus["sql"].get(_sql_sha(sql)) or []
     plans = corpus["plans"]
+    cfgs = corpus["cfgs"]
     todo = [(fp, plans[fp]) for fp in fps if fp in plans]
     if not todo:
         return None
@@ -576,7 +632,8 @@ def speculate(sql: str, catalog, config, group: Optional[str] = None,
         c0 = _programs.snapshot()["compiles"]
         ran = 0
         for fp, doc in todo:
-            ran += max(0, _run_entry(fp, doc, catalog, config, "live"))
+            ran += max(0, _run_entry(fp, doc, catalog, config, "live",
+                                     cfg=cfgs.get(fp)))
         delta = _programs.snapshot()["compiles"] - c0
         if delta > 0 and charge_fn is not None:
             try:

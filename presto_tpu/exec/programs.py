@@ -35,6 +35,7 @@ the same locked accounting — sharing is opt-in via the stamp.
 from __future__ import annotations
 
 import hashlib
+import os
 import threading
 import time
 from collections import OrderedDict
@@ -71,6 +72,15 @@ _VOLATILE_CONFIG_FIELDS = frozenset({
     # programs the live path would compile
     "shape_bucketing", "compile_farm",
 })
+
+# env vars that change what a traced program COMPUTES (not where
+# artifacts live or how many workers warm them) and therefore fork the
+# config fingerprint: PRESTO_TPU_PALLAS selects the Pallas direct-merge
+# kernel inside the grouped-merge dispatch, under what would otherwise
+# be the same program key. Every other PRESTO_TPU_* knob is
+# cache-volatile — the knob-flow pass (analysis/knob_flow.py) enforces
+# that every env read is declared in exactly one of the two classes.
+_FINGERPRINTED_ENVS = ("PRESTO_TPU_PALLAS",)
 
 # program cache bound: one entry is one (structure, program key) identity;
 # a TPC-H query compiles ~10-60 of them, so 512 holds many live plans
@@ -145,8 +155,9 @@ _counters: Dict[str, int] = {  # shared: guarded-by(_lock)
 _trace_wall_s = [0.0]  # shared: guarded-by(_lock)
 
 
-def config_fingerprint(config) -> str:
-    """Stable digest of the program-relevant ExecConfig fields."""
+def config_fingerprint(config) -> str:  # fp: key(program-ns) covers(config, plan-structure, env:PRESTO_TPU_PALLAS)
+    """Stable digest of the program-relevant ExecConfig fields plus the
+    program-affecting env knobs (_FINGERPRINTED_ENVS)."""
     import dataclasses
 
     items = []
@@ -154,6 +165,8 @@ def config_fingerprint(config) -> str:
         if f.name in _VOLATILE_CONFIG_FIELDS:
             continue
         items.append((f.name, repr(getattr(config, f.name, None))))
+    for env in _FINGERPRINTED_ENVS:
+        items.append((f"env:{env}", os.environ.get(env, "")))
     return hashlib.sha256(repr(sorted(items)).encode()).hexdigest()[:16]
 
 
@@ -174,7 +187,7 @@ def structural_fingerprint(node, config=None) -> Optional[str]:
     return h.hexdigest()
 
 
-def install_plan(root, config) -> int:
+def install_plan(root, config) -> int:  # fp: uses-key(program-ns)
     """Stamp every node under `root` with its structural namespace
     (``_program_ns``) so `_node_jit` routes programs through the shared
     cache. Call AFTER scalar-subquery binding and colocation tagging —
@@ -361,7 +374,8 @@ def _register_pytree_serialization() -> bool:
         # structures)
         ok = True
         for mod, names in (
-                ("presto_tpu.ops.join", ("BuildTable", "HashJoinTable")),
+                ("presto_tpu.ops.join",
+                 ("BuildTable", "HashJoinTable", "MwSpec")),
                 ("presto_tpu.ops.grouping", ("StateCol", "KeyCol")),
                 ("presto_tpu.ops.sort", ("SortKey",)),
                 ("presto_tpu.ops.window", ("WindowKeys",)),

@@ -4620,6 +4620,12 @@ class _MultiwayProber:
             tables.append(table)
         self.specs = tuple(specs)
         self.tables = tuple(tables)
+        # per-leg engine vector: hbo/override-chosen engines are volatile
+        # config, so the shared probe-program keys must fork on them the
+        # same way _JoinProber's `@h` suffix forks the binary path
+        self._evec = "".join(
+            "h" if s.hash_engine else "u" if s.unique else "s"
+            for s in self.specs)
         self.fanouts = tuple(
             0 if s.unique else 16 for s in self.specs)
         self.all_unique = all(s.unique for s in self.specs)
@@ -4640,14 +4646,16 @@ class _MultiwayProber:
                 out, n_probe, n_leg0 = multiway_probe_unique(
                     ts, pb, specs_t, psyms, bsyms)
                 return out, n_probe, n_leg0
-            self.junique = _node_jit(node, "mw_unique", lambda: unique_fn)
+            self.junique = _node_jit(
+                node, f"mw_unique@e{self._evec}", lambda: unique_fn)
             return
 
         def expand_fn(ts, pb, state, chats, offsets, T, base, out_cap):
             return multiway_expand(ts, pb, specs_t, state, chats, offsets,
                                    T, base, out_cap, psyms, bsyms)
-        self.jexpand = _node_jit(node, "mw_expand", lambda: expand_fn,
-                                 static_argnames=("out_cap",))
+        self.jexpand = _node_jit(
+            node, f"mw_expand@e{self._evec}", lambda: expand_fn,
+            static_argnames=("out_cap",))
         self._chain = chain
         self._counts_cache = {}
 
@@ -4665,7 +4673,9 @@ class _MultiwayProber:
                 pb = chain(pb_raw)
                 return (pb,) + multiway_counts(ts, pb, specs, fanouts)
             fn = self._counts_cache[fanouts] = _node_jit(
-                self.node, f"mw_counts@f{','.join(map(str, fanouts))}",
+                self.node,
+                f"mw_counts@f{','.join(map(str, fanouts))}"
+                f"@e{self._evec}",
                 lambda: counts_fn)
         return fn
 

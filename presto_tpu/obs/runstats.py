@@ -144,7 +144,7 @@ def catalog_token(catalog) -> str:
     return h[:12]
 
 
-def node_fingerprint(node, catalog) -> Optional[str]:
+def node_fingerprint(node, catalog) -> Optional[str]:  # fp: key(hbo-history) covers(plan-structure, catalog)
     """History key for a plan node: pure structural sha (reusing the
     compile plane's ``_program_ns`` stamp when present — its last 16 hex
     chars are the config fingerprint, which must NOT key history) plus

@@ -75,7 +75,7 @@ def encode_plane(values: jnp.ndarray,
         return v.astype(jnp.int64)
     if jnp.issubdtype(v.dtype, jnp.floating):
         if v.dtype != jnp.float32:
-            v = v.astype(jnp.float64)  # lint: allow(float64)
+            v = v.astype(jnp.float64)
         v = v + jnp.zeros((), v.dtype)  # -0.0 + 0.0 == +0.0
         if v.dtype == jnp.float32:
             bits = jax.lax.bitcast_convert_type(v, jnp.int32).astype(jnp.int64)
@@ -99,7 +99,7 @@ def decode_plane(plane: jnp.ndarray, dtype) -> jnp.ndarray:
             return jax.lax.bitcast_convert_type(
                 plane.astype(jnp.int32), jnp.float32)
         return jax.lax.bitcast_convert_type(
-            plane, jnp.float64).astype(dtype)  # lint: allow(float64)
+            plane, jnp.float64).astype(dtype)
     return plane.astype(dtype)
 
 

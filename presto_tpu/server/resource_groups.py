@@ -267,7 +267,6 @@ class ResourceGroupManager:
         return (g.vtime_path(), (head[0], head[1]))
 
     def _drain_locked(self) -> List[Callable[[], None]]:
-        # shared: requires(self._lock)
         to_start = []
         while True:
             eligible = [g for g in self.root.walk()

@@ -86,9 +86,12 @@ def plan_fingerprint(dplan) -> Optional[str]:
 
 
 def query_key(dplan, catalog, session_catalog: str = "",
-              session_schema: str = "") -> Optional[str]:
+              session_schema: str = "") -> Optional[str]:  # fp: key(result-cache) covers(plan-structure, catalog, session-schema)
     """Full-result cache key for a distributed plan, or None when the plan
-    cannot be fingerprinted (codec-unsupported node)."""
+    cannot be fingerprinted (codec-unsupported node). Deliberately
+    config-free: a query's RESULT is config-invariant (config only picks
+    programs/policies), so forking on config would just shred hit rates
+    — the knob-flow contract records that decision."""
     sha = plan_fingerprint(dplan)
     if sha is None:
         return None
@@ -379,7 +382,6 @@ class ResultCache:
 
     def _pick_victims_locked(self, need: int,
                              new_density: float) -> Optional[List[_Entry]]:
-        # shared: requires(self._lock)
         victims: List[_Entry] = []
         freed = 0
         for e in sorted(self._entries.values(), key=lambda e: e.density):

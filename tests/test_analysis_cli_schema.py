@@ -1,0 +1,106 @@
+"""One JSON contract for every analysis pass.
+
+CI and external tooling parse `python -m presto_tpu.analysis --json`;
+each plane (kernel lint, plan invariants, recompile guard,
+concurrency, knob-flow, stale-suppressions) must emit the same
+top-level document and the same finding record, so a consumer written
+against one pass reads them all.
+"""
+
+import json
+import textwrap
+
+import pytest
+
+from presto_tpu.analysis.__main__ import main
+
+TOP_KEYS = {"findings", "count", "planes", "timings"}
+FINDING_KEYS = {"rule", "loc", "message", "plane"}
+
+
+def _run_json(argv, capsys):
+    rc = main(argv + ["--json"])
+    doc = json.loads(capsys.readouterr().out)
+    return rc, doc
+
+
+def _assert_schema(doc):
+    assert set(doc) == TOP_KEYS
+    assert doc["count"] == len(doc["findings"])
+    assert isinstance(doc["planes"], list) and doc["planes"]
+    assert set(doc["timings"]) == set(doc["planes"])
+    for name, secs in doc["timings"].items():
+        assert isinstance(secs, float) and secs >= 0.0, name
+    for f in doc["findings"]:
+        assert set(f) == FINDING_KEYS
+        assert ":" in f["loc"]
+
+
+# whole-package scans are exercised by ci.sh --all and the
+# tests/test_knob_flow.py clean-tree tests; the schema matrix scopes
+# the interprocedural passes to two packages to stay cheap
+_SCOPE = ["presto_tpu/server", "presto_tpu/obs"]
+
+CASES = {
+    "lint": [],
+    "concurrency": ["--no-lint", "--concurrency"] + _SCOPE,
+    "knob-flow": ["--no-lint", "--knob-flow"] + _SCOPE,
+    "stale-suppressions": ["--no-lint", "--stale-suppressions"] + _SCOPE,
+    "plan": ["--no-lint", "--tpch-plans"],
+    "recompile": ["--no-lint", "--tpch-run", "q6"],
+}
+
+
+@pytest.mark.parametrize("name", sorted(CASES))
+def test_every_pass_emits_uniform_schema(name, capsys):
+    rc, doc = _run_json(CASES[name], capsys)
+    assert rc == 0, doc["findings"]
+    _assert_schema(doc)
+    assert doc["findings"] == []
+
+
+def test_findings_share_one_record_shape(tmp_path, capsys):
+    """A pass WITH findings still honours the schema, exits 1, and the
+    plane tag matches the producing checker."""
+    (tmp_path / "m.py").write_text(textwrap.dedent("""\
+        import os
+
+        import jax
+
+
+        @jax.jit
+        def kernel(x):
+            return x if os.environ.get("PRESTO_TPU_TURBO") else -x
+    """))
+    rc, doc = _run_json(
+        ["--no-lint", "--knob-flow", str(tmp_path / "m.py")], capsys)
+    assert rc == 1
+    _assert_schema(doc)
+    assert [f["rule"] for f in doc["findings"]] == ["unfingerprinted-knob"]
+    assert doc["findings"][0]["plane"] == "knob-flow"
+    assert doc["findings"][0]["loc"].endswith("m.py:8")
+
+
+@pytest.mark.slow  # ~60s; ci.sh runs --all directly on every push
+def test_all_passes_mode_times_each_plane(capsys):
+    rc, doc = _run_json(["--all"], capsys)
+    assert rc == 0, doc["findings"]
+    _assert_schema(doc)
+    # the consolidated CI entrypoint covers every plane in one document
+    labels = " ".join(doc["planes"])
+    for want in ("lint", "concurrency", "knob-flow",
+                 "stale-suppressions", "tpch plan invariants",
+                 "tpch recompile guard"):
+        assert want in labels, doc["planes"]
+
+
+def test_knobs_json_document(capsys):
+    rc = main(["--knobs", "--json"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert set(doc) == {"knobs"}
+    for row in doc["knobs"]:
+        assert set(row) == {"knob", "kind", "lowers_to", "class",
+                            "fingerprinted"}
+    kinds = {r["kind"] for r in doc["knobs"]}
+    assert kinds == {"session", "config", "env"}
