@@ -47,6 +47,8 @@ Env knobs:
   BENCH_SPILL_ROWS  build-side rows for the spill_skew config (default 400000)
   BENCH_SF_MULTIWAY  scale factor for the multiway_ab join-chain A/B
                   (default 0.1)
+  BENCH_ADAPTIVE_ROWS  rows for the adaptive_ab mis-estimated group-by
+                  (default 16000)
 """
 
 import json
@@ -779,6 +781,63 @@ def _multiway_child(sf: float):
     print(json.dumps(rec), flush=True)
 
 
+def _adaptive_child(n_rows: int):
+    """Mis-estimated group-by A/B for in-run adaptation (PR20): grouping
+    through `k % 100000` blinds NDV estimation (est = rows*0.1, actual =
+    full key NDV), so adaptive=off picks the hash engine, overflows, and
+    pays replay waves; adaptive=on flips engines / presizes from the
+    wave's OBSERVED group count. Per mode: best wall of two runs, replay
+    waves, and acted action counts; the checksum proves adaptation
+    changed the schedule, never the answer."""
+    if os.environ.get("BENCH_FORCE_CPU"):
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import pandas as pd
+
+    from presto_tpu.catalog.memory import MemoryConnector
+    from presto_tpu.connector import Catalog
+    from presto_tpu.exec import ExecConfig, LocalRunner
+    from presto_tpu.exec import adaptive as _adaptive
+    from presto_tpu.obs import runstats
+
+    conn = MemoryConnector()
+    conn.add_table("t", pd.DataFrame({
+        "k": np.arange(n_rows, dtype=np.int64),
+        "v": np.ones(n_rows, dtype=np.int64)}))
+    cat = Catalog()
+    cat.register("m", conn, default=True)
+    sql = "select k % 100000 as g, sum(v) as s from m.t group by 1"
+
+    rec = {"rows": n_rows}
+    frames = {}
+    for mode in ("off", "on"):
+        times, df, r = [], None, None
+        for _ in range(2):  # first run doubles as this mode's compile
+            runstats.reset()  # every run is a cold-HBO run with a fresh
+            _adaptive.reset()  # plan (flip-at-most-once pins the node)
+            r = LocalRunner(cat, ExecConfig(adaptive=mode))
+            t0 = time.perf_counter()
+            df = r.run(sql)
+            times.append(time.perf_counter() - t0)
+        frames[mode] = df.sort_values("g", ignore_index=True)
+        m = {"wall_s": round(min(times), 4),
+             "waves": int(r.last_stats.get("breaker.replay_waves", 0)),
+             "engine_flips": int(
+                 r.last_stats.get("breaker.engine_flips", 0))}
+        if mode == "on":
+            acts = {}
+            for a in _adaptive.recent_decisions():
+                if a.get("acted"):
+                    acts[a["kind"]] = acts.get(a["kind"], 0) + 1
+            m["actions"] = acts
+        rec[mode] = m
+    rec["checksum_equal"] = bool(frames["on"].equals(frames["off"]))
+    rec["wave_reduction"] = rec["off"]["waves"] - rec["on"]["waves"]
+    print(json.dumps(rec), flush=True)
+
+
 def _compile_tail_child(mode: str):
     """One serving boot + first-seen-query measurement (PR16 compile
     farm A/B). The parent sequences four of these against one cache dir:
@@ -944,6 +1003,41 @@ def _run_multiway_ab(extra: dict, remaining: float):
         extra["multiway_ab"] = {"error": "timeout"}
     except Exception as e:  # noqa: BLE001
         extra["multiway_ab"] = {"error": f"{type(e).__name__}: {e}"}
+
+
+def _run_adaptive_ab(extra: dict, remaining: float):
+    """In-run adaptation A/B (see BENCH_NOTES.md round 20): replay waves,
+    wall, and acted adaptive-action counts for adaptive=off vs on on the
+    10x-mis-estimated group-by."""
+    n_rows = int(os.environ.get("BENCH_ADAPTIVE_ROWS", "16000"))
+    env = dict(os.environ)
+    if env.get("BENCH_FORCE_CPU"):
+        # match the test topology so the flip-vs-replay accounting is the
+        # same shape it would have on an 8-device slice
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
+                            " --xla_force_host_platform_device_count=8")
+    try:
+        p = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--adaptive-child",
+             str(n_rows)],
+            env=env, stdout=subprocess.PIPE,
+            timeout=min(600, max(120, remaining - 15)))
+        lines = p.stdout.decode().strip().splitlines()
+        if p.returncode == 0 and lines:
+            rec = json.loads(lines[-1])
+            off, on = rec.get("off", {}), rec.get("on", {})
+            _log(f"adaptive_ab: waves {off.get('waves')}->{on.get('waves')} "
+                 f"({off.get('wall_s')}s->{on.get('wall_s')}s, "
+                 f"actions={on.get('actions')}, "
+                 f"checksum_equal={rec.get('checksum_equal')})")
+            extra["adaptive_ab"] = rec
+        else:
+            extra["adaptive_ab"] = {"error": f"child rc={p.returncode}"}
+    except subprocess.TimeoutExpired:
+        extra["adaptive_ab"] = {"error": "timeout"}
+    except Exception as e:  # noqa: BLE001
+        extra["adaptive_ab"] = {"error": f"{type(e).__name__}: {e}"}
 
 
 def _run_serving_slo_cached(extra: dict, remaining: float):
@@ -1139,6 +1233,9 @@ def main():
     if len(sys.argv) >= 3 and sys.argv[1] == "--compile-tail-child":
         _compile_tail_child(sys.argv[2])
         return
+    if len(sys.argv) >= 3 and sys.argv[1] == "--adaptive-child":
+        _adaptive_child(int(sys.argv[2]))
+        return
 
     signal.signal(signal.SIGTERM, _on_term)
     signal.signal(signal.SIGINT, _on_term)
@@ -1157,7 +1254,7 @@ def main():
         "BENCH_CONFIGS", "q1_sf1,q1_nofuse_sf1,q6_sf10,q3_sf10,join_sf1,"
         "groupby_engine_ab_sf1,groupby_engine_ab_sort_sf1,mesh_scaling,"
         "serving_slo,serving_slo_cached,spill_skew,compile_tail,"
-        "multiway_ab,q9,q64"
+        "multiway_ab,adaptive_ab,q9,q64"
     ).split(",")
 
     for name in (w.strip() for w in wanted):
@@ -1204,6 +1301,17 @@ def main():
                 if not device_ok:
                     os.environ["BENCH_FORCE_CPU"] = "1"
                 _run_multiway_ab(extra, remaining)
+            _checkpoint()
+            continue
+        if name == "adaptive_ab":
+            remaining = budget - (time.time() - _T0)
+            if remaining < 60:
+                _log("adaptive_ab: SKIPPED (budget exhausted)")
+                extra["adaptive_ab"] = {"skipped": "budget"}
+            else:
+                if not device_ok:
+                    os.environ["BENCH_FORCE_CPU"] = "1"
+                _run_adaptive_ab(extra, remaining)
             _checkpoint()
             continue
         if name == "spill_skew":

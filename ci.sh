@@ -308,6 +308,86 @@ if [ "$rc" -ne 0 ]; then
   exit "$rc"
 fi
 
+# Adaptive-execution smoke: on the same 10×-mis-estimated group-by,
+# adaptive=on must flip the breaker engine IN-RUN with strictly fewer
+# replay waves than off and an identical result; observe must log the
+# decision without acting; the adaptive_action events must arrive in
+# deterministic seq order with the EXPLAIN [adaptive: ...] marker; and
+# adaptive=off must stay bit-identical to the seed engine — result,
+# wave count, and an UNARMED metric plane (no adaptive rows scraped).
+echo "== adaptive smoke: in-run engine flip, fewer waves, off inert =="
+env JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python - <<'PYEOF'
+import os
+import tempfile
+
+import numpy as np
+import pandas as pd
+
+with tempfile.TemporaryDirectory() as d:
+    os.environ["PRESTO_TPU_CACHE_DIR"] = d
+
+    from presto_tpu.catalog.memory import MemoryConnector
+    from presto_tpu.connector import Catalog
+    from presto_tpu.exec import ExecConfig, LocalRunner
+    from presto_tpu.exec import adaptive as _adaptive
+    from presto_tpu.obs import runstats
+    from presto_tpu.obs.events import EVENTS
+
+    conn = MemoryConnector()
+    conn.add_table("t", pd.DataFrame({"k": np.arange(6000, dtype=np.int64),
+                                      "v": np.ones(6000, dtype=np.int64)}))
+    cat = Catalog()
+    cat.register("m", conn, default=True)
+    sql = "select k % 100000 as g, sum(v) as s from m.t group by 1"
+
+    def run(mode):
+        runstats.reset()
+        _adaptive.reset()
+        r = LocalRunner(cat, ExecConfig(adaptive=mode))
+        df = r.run(sql).sort_values("g", ignore_index=True)
+        # waves from the run itself — explain_analyze re-executes on the
+        # (flip-pinned) cached plan and would overwrite last_stats
+        waves = r.last_stats.get("breaker.replay_waves", 0)
+        txt = r.explain_analyze(sql)
+        return df, waves, txt
+
+    d_off, w_off, t_off = run("off")
+    assert w_off >= 1, w_off
+    assert "[adaptive:" not in t_off
+    assert not _adaptive.armed()
+    # unarmed -> zero rows, so both /v1/metrics planes (which extend
+    # their scrape from these rows) stay bit-for-bit pre-adaptive
+    assert _adaptive.metric_rows({"plane": "worker"}) == []
+
+    d_obs, w_obs, t_obs = run("observe")
+    assert d_obs.equals(d_off)
+    assert w_obs == w_off, (w_obs, w_off)
+    recs = _adaptive.recent_decisions()
+    assert recs and all(not a["acted"] for a in recs), recs
+    assert "would flip" in t_obs, t_obs
+
+    _adaptive.reset()
+    since = EVENTS.last_seq()
+    d_on, w_on, t_on = run("on")
+    assert d_on.equals(d_off), "adaptive=on changed the answer"
+    assert w_on < w_off, (w_on, w_off)
+    assert "[adaptive: flip hash->sort]" in t_on, t_on
+    evs = EVENTS.events(since=since, kind="adaptive_action")
+    assert evs, "no adaptive_action events emitted"
+    seqs = [e["seq"] for e in evs]
+    assert seqs == sorted(seqs), seqs
+    acted = [e for e in evs if e["acted"]]
+    assert acted and acted[0]["action"] == "engine_flip", evs
+    print(f"adaptive smoke OK: off {w_off} wave(s) -> on {w_on}, "
+          f"{len(acted)} acted action(s), off plane unarmed")
+PYEOF
+rc=$?
+if [ "$rc" -ne 0 ]; then
+  echo "adaptive smoke FAILED (exit $rc)"
+  exit "$rc"
+fi
+
 # Mesh data-plane smoke: a Q3-shaped join + keyed aggregation over an
 # 8-device CPU mesh must (a) match the local streaming engine's
 # checksum, (b) ride the fused single-buffer exchange path for every
@@ -1357,6 +1437,14 @@ import jax
 def kernel(x):
     return x if os.environ.get("PRESTO_TPU_TURBO") else -x
 PYEOF
+cat > "$kinj/injected_adaptive.py" <<'PYEOF'
+def build(node, ctx):
+    mode = ctx.config.adaptive
+
+    def fn(x):
+        return x + 1 if mode == "on" else x
+    return _node_jit(node, "probe", lambda: fn)
+PYEOF
 cat > "$kinj/injected_drift.py" <<'PYEOF'
 def derive(root):  # fp: key(inj-key) covers(plan-structure)
     return hash(root)
@@ -1383,6 +1471,7 @@ if [ "$rc" -eq 0 ]; then
   exit 1
 fi
 grep -q "injected_leak.py:6: \[volatile-leak\]" /tmp/_kinj.log \
+  && grep -q "injected_adaptive.py:6: \[volatile-leak\]" /tmp/_kinj.log \
   && grep -q "injected_knob.py:8: \[unfingerprinted-knob\]" /tmp/_kinj.log \
   && grep -q "injected_drift.py:7: \[cache-key-drift\]" /tmp/_kinj.log \
   && grep -q "ops/injected_state.py:4: \[unregistered-state\]" /tmp/_kinj.log
@@ -1391,7 +1480,7 @@ if [ $? -ne 0 ]; then
   cat /tmp/_kinj.log
   exit 1
 fi
-echo "knob-flow self-check OK (exit $rc, 4 rules attributed)"
+echo "knob-flow self-check OK (exit $rc, 4 rules attributed + adaptive leak)"
 
 # Stale-suppression self-check: an allow() whose rule does not fire at
 # its site must be flagged (a suppression that outlives its bug hides

@@ -1,0 +1,189 @@
+"""In-run adaptive execution: act on drift telemetry within the query.
+
+Reference: the robust dynamic hybrid hash join literature (arXiv:2112.02480)
+and the hash-vs-sort crossover study (arXiv:2411.13245) — both show the
+win comes from reacting to OBSERVED cardinality/duplication mid-operator
+instead of trusting estimates. The HBO plane (obs/runstats.py) already
+self-corrects ACROSS runs; this module closes the loop WITHIN one run:
+the drift telemetry the engine already fetches (confirmed group counts,
+traced lane maxima, per-partition byte footprints) feeds decisions the
+same query still has time to act on.
+
+Session property `adaptive` (ExecConfig.adaptive):
+  off      strict no-op — no AdaptiveState is ever constructed, no
+           decisions, no events, no metric families; pre-adaptive engine
+           bit-for-bit.
+  observe  decide-and-log: every decision point evaluates and records
+           what it WOULD do (event, EXPLAIN annotation, doctor record)
+           but never acts — replay ladders, lane boosts, spills proceed
+           exactly as with off.
+  on       act: engine flips between replay waves, forward-propagating
+           presize/lane growth, device-radix partition growth, partial
+           (largest-partition-first) revocation.
+
+Action kinds (the {kind} label of presto_tpu_adaptive_actions_total and
+the `kind` attr of `adaptive_action` events):
+  engine_flip    breaker re-chose sort<->hash from the wave's observed
+                 group count / duplication instead of replaying the loser
+  presize_grow   agg table grew from a completed window's confirmed group
+                 count BEFORE the next window overflowed
+  lane_resize    mesh exchange lanes resized to the failed attempt's
+                 observed per-lane maxima instead of the x2 boost ladder
+  radix_grow     a device-radix partition split by the next hash bit when
+                 its observed bytes blew the partition budget
+  partial_revoke memory pressure spilled the largest resident partitions
+                 instead of a whole operator's state
+
+Every decision emits an `adaptive_action` event (kind, site fingerprint,
+before -> after, trigger telemetry, acted flag) on the unified event
+stream, stamps a short form onto the plan node for the EXPLAIN ANALYZE
+``[adaptive: ...]`` annotation, and — when acted — bumps the labeled
+counter family on both metric planes. Events carry the stream's monotonic
+seq, so the action order of a run is deterministic and auditable.
+
+Off-discipline: the counter family is armed the first time any non-off
+AdaptiveState is constructed; adaptive=off sessions never arm it, so
+their /v1/metrics scrapes stay bit-for-bit pre-adaptive.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional
+
+# process-wide acted-action counts by kind — the
+# presto_tpu_adaptive_actions_total{kind} family (both planes render it)
+_COUNTS: Dict[str, int] = {}
+# recent decision records for the query doctor (bounded ring)
+_RECENT: List[Dict[str, Any]] = []
+_RECENT_MAX = 256
+_ARMED = False
+_LAST_MODE: Optional[str] = None
+_LOCK = threading.Lock()
+
+_HELP = ("in-run adaptive actions taken, by kind (engine_flip, "
+         "presize_grow, lane_resize, radix_grow, partial_revoke)")
+
+
+def armed() -> bool:
+    """Has any non-off adaptive session ever registered? Gates the metric
+    family so adaptive=off scrapes stay bit-for-bit pre-adaptive."""
+    return _ARMED
+
+
+def last_mode() -> Optional[str]:
+    """Mode of the most recent AdaptiveState ("observe"/"on"), or None if
+    none was ever constructed — the query doctor uses this to explain WHY
+    an action did or did not fire."""
+    return _LAST_MODE
+
+
+def metric_rows(labels: Dict[str, str]) -> List[tuple]:
+    """(name, help, value, labels, type) rows for /v1/metrics — empty
+    until armed, one row per action kind after."""
+    if not _ARMED:
+        return []
+    with _LOCK:
+        return [("presto_tpu_adaptive_actions_total", _HELP, v,
+                 {**labels, "kind": k}, "counter")
+                for k, v in sorted(_COUNTS.items())]
+
+
+def recent_decisions(query_id: Optional[str] = None) -> List[Dict[str, Any]]:
+    """Decision records (acted or not), newest last. With a query_id,
+    records stamped for that query only, falling back to unstamped
+    records (LocalRunner paths have no query id)."""
+    with _LOCK:
+        recs = list(_RECENT)
+    if query_id:
+        mine = [r for r in recs if r.get("query_id") == query_id]
+        if mine:
+            return mine
+    return recs
+
+
+def reset() -> None:
+    """Test hook: forget every count/record and disarm the family."""
+    global _ARMED, _LAST_MODE
+    with _LOCK:
+        _COUNTS.clear()
+        _RECENT.clear()
+        _ARMED = False
+        _LAST_MODE = None
+
+
+class AdaptiveState:
+    """Per-query adaptation controller, held as ``ctx.adaptive`` (None
+    when the session property is off — every call site guards on that,
+    keeping off a strict no-op).
+
+    ``decide()`` is the single funnel every adaptation goes through: it
+    records the decision, emits the event, stamps the EXPLAIN annotation
+    and returns whether the caller should ACT (mode == "on"). Acting call
+    sites therefore read as ``if ctx.adaptive.decide(...): <act>``, and
+    observe mode exercises the full decision path with zero behavior
+    change."""
+
+    def __init__(self, mode: str, query_id: str = ""):
+        global _ARMED, _LAST_MODE
+        if mode not in ("observe", "on"):
+            raise ValueError(f"adaptive mode must be observe|on, got {mode!r}")
+        self.mode = mode
+        self.query_id = query_id or None
+        self.actions: List[Dict[str, Any]] = []  # this query's decisions
+        self.acted_count = 0
+        self.decided_count = 0
+        # obs/inflight.TaskInflight handle (set by the worker's task
+        # wiring alongside ctx.inflight): each decision lands in the
+        # mid-flight heartbeat as an adaptive.<kind> operator record
+        self.inflight = None
+        with _LOCK:
+            _ARMED = True
+            _LAST_MODE = mode
+
+    def decide(self, kind: str, node=None, site: Optional[str] = None,
+               before: Any = None, after: Any = None, detail: str = "",
+               **trigger: Any) -> bool:
+        """Record one adaptation decision; True = caller should act.
+
+        ``detail`` is the short human form for the EXPLAIN annotation
+        (e.g. "flip sort->hash"); ``trigger`` carries the telemetry that
+        fired the decision (observed groups, lane max, bytes...)."""
+        acted = self.mode == "on"
+        self.decided_count += 1
+        if acted:
+            self.acted_count += 1
+        rec = {
+            "kind": kind, "site": site, "before": before, "after": after,
+            "acted": acted, "mode": self.mode, "detail": detail,
+            "query_id": self.query_id, **trigger,
+        }
+        self.actions.append(rec)
+        with _LOCK:
+            if acted:
+                _COUNTS[kind] = _COUNTS.get(kind, 0) + 1
+            _RECENT.append(rec)
+            del _RECENT[:-_RECENT_MAX]
+        if node is not None:
+            ann = detail or f"{kind} {before}->{after}"
+            if not acted:
+                ann = f"would {ann}"
+            node.__dict__.setdefault("_adaptive_actions", []).append(ann)
+        if self.inflight is not None:
+            try:
+                self.inflight.publish(
+                    f"adaptive.{kind}", windows=1,
+                    adaptiveActions=self.acted_count,
+                    adaptiveLast=(("" if acted else "would ") + detail))
+            except Exception:
+                pass
+        try:
+            from presto_tpu.obs.events import EVENTS
+
+            EVENTS.emit("adaptive_action", query_id=self.query_id,
+                        action=kind, site=site, before=before, after=after,
+                        acted=acted, mode=self.mode, detail=detail,
+                        **{k: v for k, v in trigger.items()})
+        except Exception:
+            pass
+        return acted

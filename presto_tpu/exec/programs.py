@@ -71,6 +71,10 @@ _VOLATILE_CONFIG_FIELDS = frozenset({
     # on the shapes dynamically; the farm only pre-runs the same
     # programs the live path would compile
     "shape_bucketing", "compile_farm",
+    # adaptive picks BETWEEN programs mid-run: a flipped breaker engine
+    # forks program keys via the @h suffix and grown capacities are
+    # static args — no one program ever computes differently under it
+    "adaptive",
 })
 
 # env vars that change what a traced program COMPUTES (not where

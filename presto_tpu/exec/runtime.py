@@ -309,6 +309,15 @@ class ExecConfig:
     # straggler detector bound: a fragment site > factor x behind its
     # siblings' window watermark → straggler_detected event + slow-log doc
     straggler_factor: float = 4.0
+    # in-run adaptation (exec/adaptive.py): "off" (default) is a strict
+    # no-op — pre-adaptive engine bit-for-bit; "observe" evaluates every
+    # decision point and logs what it WOULD do (events, EXPLAIN, doctor)
+    # without acting; "on" acts — engine flips between replay waves,
+    # forward-propagating presize/lane sizing, device-radix partition
+    # growth, largest-partition-first partial revocation. Cache-volatile:
+    # a flipped engine forks program keys via the existing @h suffix, so
+    # the knob itself never changes what any one program computes.
+    adaptive: str = "off"
 
 
 def _node_jit(node: PlanNode, key: str, builder, _shared=True, **jit_kwargs):
@@ -399,6 +408,14 @@ class ExecContext:
         # installed by the worker task when the `inflight` session
         # property is on; None = every publish hook is a no-op
         self.inflight = None
+        # in-run adaptation controller (exec/adaptive.AdaptiveState) —
+        # None when the `adaptive` session property is off, which keeps
+        # every decision site a single attribute check (strict no-op)
+        self.adaptive = None
+        if getattr(config, "adaptive", "off") != "off":
+            from presto_tpu.exec.adaptive import AdaptiveState
+
+            self.adaptive = AdaptiveState(config.adaptive)
 
     def track_spill(self, resource) -> None:
         self.spill_resources.append(resource)
@@ -1764,6 +1781,107 @@ class _GraceOverflow(Exception):
         self.entries = entries
 
 
+class _EngineFlip(Exception):
+    """Raised from an overflow replay when the adaptive layer flips the
+    breaker engine instead of replaying the loser wider. Only raised when
+    the replay checkpoint is EMPTY (the whole aggregation restarts from
+    batch 0), so no accumulator state needs converting between engine
+    layouts. Carries the unmerged raw input batches, the wave's observed
+    group count, and the engine to restart under."""
+
+    def __init__(self, batches, groups, engine):
+        super().__init__("adaptive breaker engine flip")
+        self.batches = batches
+        self.groups = groups
+        self.engine = engine
+
+
+# Fan-out of one adaptive device-side radix partition growth step: the
+# budget-blowing partition re-splits by the next two hash bits
+# (ops/radix.radix_child_perm), mirroring the host spiller's
+# grow_partition recursion — one level deep, then hybrid spill.
+_RADIX_GROW_FANOUT = 4
+
+
+def _adaptive_site(node: PlanNode, ctx: "ExecContext") -> str:
+    """Site fingerprint for adaptive_action events: the HBO structural
+    fingerprint when derivable (so in-run actions and cross-run history
+    key the same way), else a node-typed fallback."""
+    try:
+        from presto_tpu.obs import runstats as _runstats
+
+        fp = _runstats.node_fingerprint(node, ctx.catalog)
+        if fp:
+            return fp
+    except Exception:
+        pass
+    return f"{type(node).__name__}:{id(node)}"
+
+
+def _adaptive_flip_verdict(node: PlanNode, ctx: "ExecContext", engine: str,
+                           ngi: int, rows_seen: int) -> Optional[str]:
+    """Between replay waves: re-choose the breaker engine from the wave's
+    OBSERVED group count / duplication instead of the estimates the first
+    choice trusted. Returns the engine to restart under when the adaptive
+    layer should act, else None. Flip-at-most-once-per-site: the first
+    overflow wave's verdict pins the site for the rest of the query — no
+    oscillation, and the pin also covers observe-mode so one run logs one
+    would-flip decision per site."""
+    if ctx.adaptive is None or node.__dict__.get("_adaptive_engine_pinned"):
+        return None
+    node.__dict__["_adaptive_engine_pinned"] = True
+    if getattr(ctx.config, "breaker_engine", "auto") != "auto":
+        return None  # session override forced the engine — nothing to flip
+    from presto_tpu.plan.stats import choose_breaker_engine_observed
+
+    try:
+        want, why = choose_breaker_engine_observed(
+            node, float(ngi), float(rows_seen) if rows_seen else None)
+    except Exception:
+        return None
+    if want == engine:
+        return None
+    acted = ctx.adaptive.decide(
+        "engine_flip", node=node, site=_adaptive_site(node, ctx),
+        before=engine, after=want, detail=f"flip {engine}->{want}",
+        groups=int(ngi), rows=int(rows_seen or 0), why=why)
+    if not acted:
+        return None
+    # the CONVERGED verdict is what EXPLAIN shows and HBO records — the
+    # initial guess lives on only inside the why-string provenance
+    node.__dict__["_breaker_engine"] = want
+    node.__dict__["_breaker_engine_why"] = f"{why} (adaptive: flipped)"
+    node.__dict__["_adaptive_engine_flipped"] = True
+    ctx.stats["breaker.engine_flips"] = (
+        ctx.stats.get("breaker.engine_flips", 0) + 1)
+    return want
+
+
+def _adaptive_presize_grow(node: PlanNode, ctx: "ExecContext", ngi: int,
+                           cap: int, limit: Optional[int]) -> Optional[int]:
+    """Forward-propagating presize: a completed window CONFIRMED ``ngi``
+    groups within 1/8 of the table capacity, so the next window is odds-on
+    to overflow and replay. Grow the table now — the next merge step
+    migrates the accumulator to the bigger capacity with zero replay (the
+    pow2 ladder step is the same compile the overflow would have paid,
+    minus the re-merged batches). ``limit`` bounds growth at the grace
+    ceiling when spill is live; per-capacity damping keeps observe mode
+    at one logged decision per proposed size."""
+    if ctx.adaptive is None or ngi * 8 < cap * 7:
+        return None
+    want = cap * 2
+    if limit is not None and want > limit:
+        return None
+    if node.__dict__.get("_adaptive_presize_seen", 0) >= cap:
+        return None
+    node.__dict__["_adaptive_presize_seen"] = cap
+    acted = ctx.adaptive.decide(
+        "presize_grow", node=node, site=_adaptive_site(node, ctx),
+        before=int(cap), after=int(want), detail=f"presize {cap}->{want}",
+        groups=int(ngi))
+    return want if acted else None
+
+
 def _grouped_execution_lifespans(node: Aggregate) -> int:
     """GroupedExecutionTagger (reference PlanFragmenter.java:914): when every
     group key traces — through streaming Filter/Project identity refs — down
@@ -2371,6 +2489,18 @@ def _hbo_record_agg(node: Aggregate, ctx: "ExecContext", obs: dict,
         extra = {"replays": int(obs.get("replays", 0))}
         if skew is not None:
             extra["skew"] = float(skew)
+        if obs.get("final_cap"):
+            # the CONVERGED capacity, not the initial presize — a
+            # hbo=correct structure repeat starts where this run ended
+            extra["final_cap"] = int(obs["final_cap"])
+        made0 = node.__dict__.get("_breaker_engine")
+        if made0:
+            # the CONVERGED engine: after an adaptive flip this is the
+            # winner, with `(adaptive: flipped)` provenance — history
+            # records what the run ended on, not what it guessed
+            extra["engine"] = made0
+            if node.__dict__.get("_adaptive_engine_flipped"):
+                extra["adaptive"] = "flipped"
         if getattr(ctx.config, "devprof", "off") == "on" \
                 and ctx.memory_pool is not None \
                 and getattr(ctx.memory_pool, "peak", 0):
@@ -2505,20 +2635,6 @@ def _execute_aggregate(node: Aggregate, ctx: ExecContext) -> Iterator[Batch]:
     _step_jit_kw = {}
     if ctx.config.donate_stepping and not key_syms:
         _step_jit_kw["donate_argnums"] = (0,)
-    _ek = lambda k: _engine_key(k, engine)  # noqa: E731
-    jit_step = _node_jit(node, _ek("step"), lambda: (lambda acc, b, cap: merge_step(acc, b, cap)), static_argnums=(2,), **_step_jit_kw)
-    jit_step0 = _node_jit(node, _ek("step0"), lambda: (lambda b, cap: merge_step(None, b, cap)), static_argnums=(1,))
-    jit_accstep = _node_jit(node, _ek("accstep"), lambda: acc_merge_step, static_argnums=(2,))
-    # grace (hash-partitioned) aggregation: partition replay feeds batches
-    # that went through `chain` before spilling — merge must not re-chain
-    jit_step_raw = _node_jit(
-        node, _ek("step_raw"),
-        lambda: (lambda acc, b, cap: merge_step(acc, b, cap, prechained=True)),
-        static_argnums=(2,))
-    jit_step0_raw = _node_jit(
-        node, _ek("step0_raw"),
-        lambda: (lambda b, cap: merge_step(None, b, cap, prechained=True)),
-        static_argnums=(1,))
     jit_chain = _node_jit(node, "chain_only", lambda: chain)
 
     from presto_tpu.memory import LocalMemoryContext, batch_device_bytes
@@ -2539,15 +2655,60 @@ def _execute_aggregate(node: Aggregate, ctx: ExecContext) -> Iterator[Batch]:
         frag_why = "grace-from-start spill"
     node.__dict__["_fragment_fusion"] = (
         "fused" if frag_why is None else frag_why)
-    if frag_why is None:
-        jit_frag_step = _node_jit(
-            node, _ek("fragment_step"),
-            lambda: _fragment_jit.scan_stepper(merge_step, False),
+
+    # Every engine-keyed closure lives behind one binder so an adaptive
+    # mid-query flip (_EngineFlip) can re-point all of them at the other
+    # engine's steps under fresh @h-forked program-cache keys. Each call
+    # captures that engine's merge closures by VALUE (`ms`/`ams` are
+    # locals of the call, one cell per invocation): a later rebind must
+    # never leak the new engine's function into a not-yet-traced builder
+    # registered under the old engine's cache key.
+    _ek = None
+    jit_step = jit_step0 = jit_accstep = None
+    jit_step_raw = jit_step0_raw = None
+    jit_frag_step = jit_frag_step0 = None
+
+    def _bind_engine(new_engine):
+        nonlocal engine, steps, merge_step, acc_merge_step, _ek
+        nonlocal jit_step, jit_step0, jit_accstep
+        nonlocal jit_step_raw, jit_step0_raw
+        nonlocal jit_frag_step, jit_frag_step0
+        engine = new_engine
+        steps = _agg_steps(node, engine)
+        ms = merge_step = steps.merge_step
+        ams = acc_merge_step = steps.acc_merge_step
+        _ek = lambda k: _engine_key(k, new_engine)  # noqa: E731
+        jit_step = _node_jit(
+            node, _ek("step"),
+            lambda: (lambda acc, b, cap: ms(acc, b, cap)),
             static_argnums=(2,), **_step_jit_kw)
-        jit_frag_step0 = _node_jit(
-            node, _ek("fragment_step0"),
-            lambda: _fragment_jit.scan_stepper(merge_step, True),
+        jit_step0 = _node_jit(
+            node, _ek("step0"), lambda: (lambda b, cap: ms(None, b, cap)),
             static_argnums=(1,))
+        jit_accstep = _node_jit(node, _ek("accstep"), lambda: ams,
+                                static_argnums=(2,))
+        # grace (hash-partitioned) aggregation: partition replay feeds
+        # batches that went through `chain` before spilling — merge must
+        # not re-chain
+        jit_step_raw = _node_jit(
+            node, _ek("step_raw"),
+            lambda: (lambda acc, b, cap: ms(acc, b, cap, prechained=True)),
+            static_argnums=(2,))
+        jit_step0_raw = _node_jit(
+            node, _ek("step0_raw"),
+            lambda: (lambda b, cap: ms(None, b, cap, prechained=True)),
+            static_argnums=(1,))
+        if frag_why is None:
+            jit_frag_step = _node_jit(
+                node, _ek("fragment_step"),
+                lambda: _fragment_jit.scan_stepper(ms, False),
+                static_argnums=(2,), **_step_jit_kw)
+            jit_frag_step0 = _node_jit(
+                node, _ek("fragment_step0"),
+                lambda: _fragment_jit.scan_stepper(ms, True),
+                static_argnums=(1,))
+
+    _bind_engine(engine)
 
     if node.step == "partial" and grace_from_start:
         node.__dict__["_fragment_fusion"] = "partial passthrough"
@@ -2663,7 +2824,7 @@ def _execute_aggregate(node: Aggregate, ctx: ExecContext) -> Iterator[Batch]:
             _stat("radix.partitions_spilled", 1)
             _scan_metrics.record("radix_partitions_spilled", 1)
 
-        rev = {"flag": False}
+        rev = {"flag": False, "targets": []}
 
         def _revoke(_need):
             # pool-pressure REQUEST honored at the next batch boundary
@@ -2672,8 +2833,159 @@ def _execute_aggregate(node: Aggregate, ctx: ExecContext) -> Iterator[Batch]:
             rev["flag"] = True
             return 0
 
+        # adaptive device-side radix growth (ops/radix.radix_child_perm):
+        # parent partition id -> {"caps","accs","ng"} child state. A
+        # grown partition re-splits its input by the NEXT hash bits down,
+        # so a budget-blowing partition stays on device as F small
+        # children instead of round-tripping through host spill files.
+        grown: Dict[int, dict] = {}
+        _child = {"perm": None, "win": None}
+
+        def _child_split(sub):
+            if _child["perm"] is None:
+                from presto_tpu.ops import radix as _radix
+
+                keys = tuple(key_syms)
+                _child["perm"] = _node_jit(
+                    node, "agg_child_perm",
+                    lambda: (lambda b: _radix.radix_child_perm(
+                        b, keys, P, _RADIX_GROW_FANOUT)))
+                # same gather program the parent splitter compiles — the
+                # shared cache key reuses it instead of re-tracing
+                _child["win"] = _node_jit(
+                    node, "agg_radix_window",
+                    lambda: _radix.radix_window_perm,
+                    static_argnames=("bucket",))
+            sperm, counts = _child["perm"](sub)
+            cnts = np.asarray(counts)
+            starts = np.concatenate([[0], np.cumsum(cnts)])
+            for c in range(_RADIX_GROW_FANOUT):
+                n = int(cnts[c])
+                if n:
+                    yield c, _child["win"](
+                        sub, sperm, np.int32(starts[c]), np.int32(n),
+                        bucket=round_up_capacity(n)), n
+
+        def child_merge(p, c, sub, step_fn, step0_fn):
+            ch = grown[p]
+            for _ in range(ctx.config.max_growth_retries):
+                if ch["accs"][c] is None:
+                    out, ng = step0_fn(sub, ch["caps"][c])
+                else:
+                    out, ng = step_fn(ch["accs"][c], sub, ch["caps"][c])
+                n2 = int(ng)
+                if n2 <= ch["caps"][c]:
+                    ch["accs"][c] = out
+                    ch["ng"][c] = max(ch["ng"][c], n2)
+                    return
+                ch["caps"][c] = round_up_capacity(n2)
+                _bump_replay_wave(node, ctx, hbo_obs, cap_to=ch["caps"][c])
+            raise RuntimeError("aggregate capacity growth exceeded retries")
+
+        def grow_partition_device(p):
+            """Adaptive device-side grow_partition: split resident
+            partition p by the next hash bits. The confirmed accumulator
+            is itself a valid state-page batch, so each child slice
+            re-merges through the acc-merge step at a small capacity —
+            hot-but-distinct keys separate under fresh entropy while the
+            parent decomposition (and any partition-aligned exchange
+            tags at the parent P) stays valid."""
+            acc0 = accs[p]
+            grown[p] = {"caps": [start_cap] * _RADIX_GROW_FANOUT,
+                        "accs": [None] * _RADIX_GROW_FANOUT,
+                        "ng": [0] * _RADIX_GROW_FANOUT}
+            accs[p] = None
+            caps[p] = start_cap
+            _stat("radix.partitions_grown", 1)
+            _scan_metrics.record("radix_partitions_grown", 1)
+            if acc0 is not None:
+                for c, ss, _n in _child_split(acc0):
+                    child_merge(p, c, ss, jit_accstep, jit_accstep0)
+
+        def spill_grown(p):
+            """A grown partition's child blew the budget too: fall back
+            to hybrid spill for the WHOLE parent partition (children
+            rejoin as state pages — child ids refine parent ids, so the
+            end-of-stream replay is untouched by the growth detour)."""
+            ch = grown.pop(p)
+            af = ctx.spill_manager.spill_file(f"radix-agg-acc-p{p}")
+            ctx.track_spill(af)
+            for a in ch["accs"]:
+                if a is not None:
+                    af.append(a)
+            afiles[p] = af
+            rfiles[p] = ctx.spill_manager.spill_file(f"radix-agg-raw-p{p}")
+            ctx.track_spill(rfiles[p])
+            caps[p] = start_cap
+            _stat("radix.partitions_spilled", 1)
+            _scan_metrics.record("radix_partitions_spilled", 1)
+
+        def over_budget(p):
+            """Budget enforcement with the adaptive rung in front: the
+            first breach grows the partition on device (radix_grow); a
+            child breach — or adaptive off/observe — hybrid-spills."""
+            if p in grown:
+                if any(a is not None and _bdb(a) > budget
+                       for a in grown[p]["accs"]):
+                    spill_grown(p)
+                return
+            nbytes = _bdb(accs[p])
+            if nbytes <= budget:
+                return
+            if ctx.adaptive is not None:
+                acted = ctx.adaptive.decide(
+                    "radix_grow", node=node,
+                    site=_adaptive_site(node, ctx),
+                    before=f"p{p}", after=f"p{p}/{_RADIX_GROW_FANOUT}",
+                    detail=(f"grow p{p} into {_RADIX_GROW_FANOUT} "
+                            "device children"),
+                    bytes=int(nbytes), budget=int(budget))
+                if acted:
+                    grow_partition_device(p)
+                    return
+            spill_partition(p)
+
+        # resident-state accounting (LocalMemoryContext protocol, same as
+        # the grace path's mctx): without it the pool never sees radix
+        # residency and partition-granular revocation has no pressure
+        # source to react to. Gated to adaptive=on — off/observe must
+        # keep the seed's exact reserve/replay sequence, and only the
+        # partial-revocation protocol consumes this pressure anyway.
+        from presto_tpu.memory import LocalMemoryContext as _LMC
+        _account_on = ctx.adaptive is not None and ctx.adaptive.mode == "on"
+        mctx_r = _LMC(ctx.memory_pool, "radix-aggregate")
+
+        def _account_resident():
+            if not _account_on:
+                return
+            total = sum(_bdb(a) for a in accs if a is not None)
+            for ch in grown.values():
+                total += sum(_bdb(a) for a in ch["accs"] if a is not None)
+            mctx_r.set_bytes(int(total))
+
+        _partial_fn = None
         if ctx.config.spill_enabled:
-            ctx.memory_pool.add_revoker(_revoke)
+            if ctx.adaptive is not None and ctx.adaptive.mode == "on":
+                # partition-granular revocation: pool pressure marks the
+                # LARGEST partitions (cross-owner largest-first ranking
+                # lives in MemoryPool.request_partial_revoke) instead of
+                # flag-spilling blind — cold partitions leave, hot ones
+                # stay resident
+                def _psizes():
+                    return [(pp, int(_bdb(accs[pp]))) for pp in range(P)
+                            if accs[pp] is not None and pp not in rfiles
+                            and pp not in grown]
+
+                def _prevoke(pp):
+                    est = int(_bdb(accs[pp])) if accs[pp] is not None else 0
+                    rev["targets"].append(pp)
+                    return est
+
+                _partial_fn = ctx.memory_pool.add_partial_revoker(
+                    SimpleNamespace(partition_sizes=_psizes,
+                                    revoke_partition=_prevoke))
+            else:
+                ctx.memory_pool.add_revoker(_revoke)
         try:
             for raw_b in in_stream:
                 rid = _radix_tag(raw_b, P, key_syms)
@@ -2692,6 +3004,15 @@ def _execute_aggregate(node: Aggregate, ctx: ExecContext) -> Iterator[Batch]:
                     if p in rfiles:
                         rfiles[p].append(sub)
                         continue
+                    if p in grown:
+                        # grown partitions merge synchronously per child
+                        # (the sub re-splits by the next hash bits first)
+                        for c, ss, _cn in _child_split(sub):
+                            child_merge(p, c, ss, jit_step_raw,
+                                        jit_step0_raw)
+                        if budget is not None:
+                            over_budget(p)
+                        continue
                     # dispatch wave: split() yields each partition at most
                     # once per batch, so all merges are independent
                     if accs[p] is None:
@@ -2701,23 +3022,64 @@ def _execute_aggregate(node: Aggregate, ctx: ExecContext) -> Iterator[Batch]:
                     pend.append((p, sub, first))
                 for p, sub, first in pend:
                     merge_into(p, sub, jit_step_raw, jit_step0_raw, first)
-                    if budget is not None and _bdb(accs[p]) > budget:
-                        spill_partition(p)
-                if rev["flag"]:
-                    # revoke ladder asked for memory back: spill the
-                    # LARGEST resident partition down to host
-                    rev["flag"] = False
-                    resident = [(pp, _bdb(accs[pp])) for pp in range(P)
-                                if accs[pp] is not None and pp not in rfiles]
-                    if resident:
-                        pp, nbytes = max(resident, key=lambda t: t[1])
+                    if budget is not None:
+                        over_budget(p)
+                if rev["flag"] or rev["targets"]:
+                    # partition-granular marks first (adaptive partial
+                    # revocation, honored here at the batch boundary)
+                    targets = []
+                    while rev["targets"]:
+                        pp = rev["targets"].pop(0)
+                        if (accs[pp] is not None and pp not in rfiles
+                                and pp not in grown and pp not in targets):
+                            targets.append(pp)
+                    for pp in targets:
+                        nbytes = _bdb(accs[pp])
+                        ctx.adaptive.decide(
+                            "partial_revoke", node=node,
+                            site=_adaptive_site(node, ctx),
+                            before=f"p{pp}", after="host",
+                            detail=f"revoke p{pp} to host",
+                            bytes=int(nbytes))
                         spill_partition(pp)
                         _note_spill_revoke(node, ctx, nbytes)
+                    if rev["flag"]:
+                        # whole-operator rung (adaptive off/observe):
+                        # spill the LARGEST resident partition to host
+                        rev["flag"] = False
+                        resident = [(pp, _bdb(accs[pp])) for pp in range(P)
+                                    if accs[pp] is not None
+                                    and pp not in rfiles
+                                    and pp not in grown]
+                        if resident:
+                            pp, nbytes = max(resident, key=lambda t: t[1])
+                            if (ctx.adaptive is not None
+                                    and ctx.adaptive.mode == "observe"):
+                                ctx.adaptive.decide(
+                                    "partial_revoke", node=node,
+                                    site=_adaptive_site(node, ctx),
+                                    before=f"p{pp}", after="host",
+                                    detail=f"revoke p{pp} to host",
+                                    bytes=int(nbytes))
+                            spill_partition(pp)
+                            _note_spill_revoke(node, ctx, nbytes)
+                # post-boundary accounting: a reserve() here that crosses
+                # the pool threshold marks partitions (or sets the flag)
+                # for the NEXT boundary — never frees inline
+                _account_resident()
             rrows = [int(r) for r in rrows]
             for p in range(P):
                 if rrows[p]:
                     _obs_metrics.RADIX_PARTITION_ROWS.observe(
                         rrows[p], plane="worker", side="group")
+                if p in grown:
+                    ch = grown[p]
+                    part_ng[p] = sum(ch["ng"])
+                    for c in range(_RADIX_GROW_FANOUT):
+                        if ch["accs"][c] is not None:
+                            yield _emit(ch["accs"][c])
+                            ch["accs"][c] = None
+                    continue
                 if p in rfiles or accs[p] is None:
                     continue
                 yield _emit(accs[p])
@@ -2743,8 +3105,10 @@ def _execute_aggregate(node: Aggregate, ctx: ExecContext) -> Iterator[Batch]:
                 _hbo_record_agg(node, ctx, hbo_obs,
                                 skew=partition_skew(rrows))
         finally:
+            mctx_r.close()
             if ctx.config.spill_enabled:
-                ctx.memory_pool.remove_revoker(_revoke)
+                ctx.memory_pool.remove_revoker(
+                    _partial_fn if _partial_fn is not None else _revoke)
             spilled = (sum(f.bytes for f in afiles.values())
                        + sum(f.bytes for f in rfiles.values()))
             if spilled:
@@ -2764,8 +3128,10 @@ def _execute_aggregate(node: Aggregate, ctx: ExecContext) -> Iterator[Batch]:
     if ctx.config.radix_partitions > 1:
         in_stream = (_untag_batch(b) for b in in_stream)
 
+    # rows_seen: host-known input watermark (batch capacities — no device
+    # sync) feeding the adaptive flip's observed-duplication estimate
     state = {"acc": None, "spiller": None, "raw_spiller": None,
-             "revoke_requested": False}
+             "revoke_requested": False, "rows_seen": 0}
     mctx = LocalMemoryContext(ctx.memory_pool, "aggregate")
     owner_thread = _threading.get_ident()
     # dynamic hybrid hash: the initial partition count is an ESTIMATE —
@@ -2853,7 +3219,12 @@ def _execute_aggregate(node: Aggregate, ctx: ExecContext) -> Iterator[Batch]:
                 mode = "grow"
             depth = max(1, ctx.config.agg_pipeline_depth)
             no_overflow = not key_syms  # global agg: ng ≤ 1, never grows
-            window = []  # (acc_before, batch, ng_device_scalar)
+            # (acc_before, batch, ng_device_scalar, dispatch_cap): the
+            # capacity each entry was MERGED at rides the window — after
+            # an adaptive presize the overflow check must compare against
+            # the entry's own capacity, not the grown one (an acc built
+            # at the small cap truncated its overflow groups)
+            window = []
 
             def dispatch(b):
                 acc_before = state["acc"]
@@ -2862,6 +3233,7 @@ def _execute_aggregate(node: Aggregate, ctx: ExecContext) -> Iterator[Batch]:
                 else:
                     out, ng = step_fn(acc_before, b, cap)
                 state["acc"] = out
+                state["rows_seen"] += b.capacity
                 _record_fragment_dispatch(node, ctx, fused=False)
                 if no_overflow:
                     return
@@ -2869,7 +3241,7 @@ def _execute_aggregate(node: Aggregate, ctx: ExecContext) -> Iterator[Batch]:
                     ng.copy_to_host_async()
                 except Exception:
                     pass
-                window.append((acc_before, b, ng))
+                window.append((acc_before, b, ng, cap))
 
             def replay(entries, ngi):
                 """Re-merge `entries` from the first entry's checkpoint at a
@@ -2880,12 +3252,23 @@ def _execute_aggregate(node: Aggregate, ctx: ExecContext) -> Iterator[Batch]:
                 millions of dead slots."""
                 nonlocal cap
                 state["acc"] = entries[0][0]
+                if entries[0][0] is None and allow_spill:
+                    # adaptive flip window: the checkpoint is EMPTY, so
+                    # the whole aggregation can restart under the engine
+                    # the OBSERVED group count picks — instead of
+                    # replaying the loser wider
+                    flipped = _adaptive_flip_verdict(
+                        node, ctx, engine, ngi, state["rows_seen"])
+                    if flipped is not None:
+                        raise _EngineFlip([e[1] for e in entries],
+                                          ngi, flipped)
                 want2 = round_up_capacity(ngi)
                 if mode != "grow" and want2 > ceiling:
                     _ceiling_overflow(mode, entries)
                 cap = want2
                 _bump_replay_wave(node, ctx, hbo_obs, cap_to=cap)
-                for i, (_, b, _) in enumerate(entries):
+                for i, e in enumerate(entries):
+                    b = e[1]
                     for _ in range(ctx.config.max_growth_retries):
                         acc_before = state["acc"]
                         if acc_before is None:
@@ -2910,11 +3293,22 @@ def _execute_aggregate(node: Aggregate, ctx: ExecContext) -> Iterator[Batch]:
                             "aggregate capacity growth exceeded retries")
 
             def confirm(block):
+                nonlocal cap
                 while window and (block or len(window) > depth):
                     ngi = int(window[0][2])  # usually already on host
-                    if ngi <= cap:
+                    dcap = window[0][3]  # capacity the entry merged at
+                    if ngi <= dcap:
                         hbo_obs["groups"] = max(hbo_obs["groups"], ngi)
                         window.pop(0)
+                        if ctx.adaptive is not None and allow_spill:
+                            # forward presize: grow BEFORE the overflow
+                            # the near-full table is about to pay (the
+                            # next merge migrates the acc, zero replay)
+                            want = _adaptive_presize_grow(
+                                node, ctx, ngi, cap,
+                                ceiling if mode != "grow" else None)
+                            if want is not None:
+                                cap = want
                         continue
                     entries = list(window)
                     window.clear()
@@ -2932,7 +3326,7 @@ def _execute_aggregate(node: Aggregate, ctx: ExecContext) -> Iterator[Batch]:
                 # its input batch — otherwise spill/revoke fires ~depth×
                 # too late
                 out_bytes = batch_device_bytes(state["acc"])
-                for acc_before, wb, _ in window:
+                for acc_before, wb, _, _dc in window:
                     out_bytes += batch_device_bytes(wb)
                     if acc_before is not None:
                         out_bytes += batch_device_bytes(acc_before)
@@ -2973,7 +3367,9 @@ def _execute_aggregate(node: Aggregate, ctx: ExecContext) -> Iterator[Batch]:
             nonlocal cap
             depth = max(1, ctx.config.agg_pipeline_depth)
             no_overflow = not key_syms
-            window = []  # (acc_before, WindowItem, ng_device_scalar)
+            # (acc_before, WindowItem, ng, dispatch_cap) — see absorb():
+            # each entry confirms against the capacity it merged at
+            window = []
 
             def apply(acc_before, item, c):
                 if isinstance(item, _fragment_jit.Window):
@@ -2988,7 +3384,8 @@ def _execute_aggregate(node: Aggregate, ctx: ExecContext) -> Iterator[Batch]:
                 """Unmerged optimistic-window entries → raw-batch triples
                 the _GraceOverflow handler understands."""
                 out = []
-                for _, item, _ in entries:
+                for e in entries:
+                    item = e[1]
                     if isinstance(item, _fragment_jit.Window):
                         out.extend(
                             (None, rb, None) for rb in
@@ -3003,6 +3400,8 @@ def _execute_aggregate(node: Aggregate, ctx: ExecContext) -> Iterator[Batch]:
                 out, ng = apply(acc_before, item, cap)
                 state["acc"] = out
                 fused = isinstance(item, _fragment_jit.Window)
+                state["rows_seen"] += (item.k * item.width if fused
+                                       else item.capacity)
                 _record_fragment_dispatch(node, ctx, fused,
                                           item.k if fused else 1)
                 if fused and ctx.tracer.enabled:
@@ -3015,17 +3414,26 @@ def _execute_aggregate(node: Aggregate, ctx: ExecContext) -> Iterator[Batch]:
                     ng.copy_to_host_async()
                 except Exception:
                     pass
-                window.append((acc_before, item, ng))
+                window.append((acc_before, item, ng, cap))
 
             def replay(entries, ngi):
                 nonlocal cap
                 state["acc"] = entries[0][0]
+                if entries[0][0] is None:
+                    # adaptive flip window — see absorb().replay
+                    flipped = _adaptive_flip_verdict(
+                        node, ctx, engine, ngi, state["rows_seen"])
+                    if flipped is not None:
+                        raise _EngineFlip(
+                            [rb for _, rb, _ in expand(entries)],
+                            ngi, flipped)
                 want2 = round_up_capacity(ngi)
                 if can_spill and want2 > ceiling:
                     raise _GraceOverflow(expand(entries))
                 cap = want2
                 _bump_replay_wave(node, ctx, hbo_obs, cap_to=cap)
-                for i, (_, item, _) in enumerate(entries):
+                for i, e in enumerate(entries):
+                    item = e[1]
                     for _ in range(ctx.config.max_growth_retries):
                         acc_before = state["acc"]
                         out, ng2 = apply(acc_before, item, cap)
@@ -3045,11 +3453,19 @@ def _execute_aggregate(node: Aggregate, ctx: ExecContext) -> Iterator[Batch]:
                             "aggregate capacity growth exceeded retries")
 
             def confirm(block):
+                nonlocal cap
                 while window and (block or len(window) > depth):
                     ngi = int(window[0][2])
-                    if ngi <= cap:
+                    dcap = window[0][3]
+                    if ngi <= dcap:
                         hbo_obs["groups"] = max(hbo_obs["groups"], ngi)
                         window.pop(0)
+                        if ctx.adaptive is not None:
+                            want = _adaptive_presize_grow(
+                                node, ctx, ngi, cap,
+                                ceiling if can_spill else None)
+                            if want is not None:
+                                cap = want
                         continue
                     entries = list(window)
                     window.clear()
@@ -3069,7 +3485,7 @@ def _execute_aggregate(node: Aggregate, ctx: ExecContext) -> Iterator[Batch]:
                     dispatch(item)
                     confirm(block=False)
                     out_bytes = batch_device_bytes(state["acc"])
-                    for acc_before, wi, _ in window:
+                    for acc_before, wi, _, _dc in window:
                         out_bytes += pinned_bytes(wi)
                         if acc_before is not None:
                             out_bytes += batch_device_bytes(acc_before)
@@ -3092,6 +3508,12 @@ def _execute_aggregate(node: Aggregate, ctx: ExecContext) -> Iterator[Batch]:
                 rest = src.drain()
                 raise _GraceOverflow(list(ov.entries)
                                      + [(None, rb, None) for rb in rest])
+            except _EngineFlip as fl:
+                # same recovery for a flip: the restart must re-absorb the
+                # COMPLETE remaining input under the new engine
+                rest = src.drain()
+                raise _EngineFlip(fl.batches + list(rest), fl.groups,
+                                  fl.engine)
             finally:
                 src.close()
 
@@ -3099,24 +3521,47 @@ def _execute_aggregate(node: Aggregate, ctx: ExecContext) -> Iterator[Batch]:
             grace_ingest(in_stream)
         else:
             try:
-                if frag_why is None:
-                    absorb_fused(in_stream)
-                else:
-                    absorb(in_stream, jit_step, jit_step0)
+                try:
+                    if frag_why is None:
+                        absorb_fused(in_stream)
+                    else:
+                        absorb(in_stream, jit_step, jit_step0)
+                except _EngineFlip as fl:
+                    # the wave's OBSERVED group count re-ran the engine
+                    # choice and the other engine won: re-absorb the
+                    # unmerged input through the flipped engine's programs
+                    # (fresh @h-forked cache keys) at a capacity sized to
+                    # the observed count — instead of replaying the loser
+                    # wider and paying the same overflow again next wave
+                    import itertools as _it
+
+                    _bind_engine(fl.engine)
+                    want = round_up_capacity(int(fl.groups))
+                    cap = min(want, ceiling) if can_spill else want
+                    # rebind in_stream so a later _GraceOverflow's
+                    # grace_ingest still sees the un-pulled remainder
+                    in_stream = _it.chain(iter(fl.batches), in_stream)
+                    if frag_why is None:
+                        absorb_fused(in_stream)
+                    else:
+                        absorb(in_stream, jit_step, jit_step0)
             except _GraceOverflow as ov:
                 # the table outgrew the ceiling mid-stream: spill the
                 # confirmed accumulator as state pages, the unmerged window
                 # + the rest of the input as raw partitions
                 do_spill()
                 raw = mk_raw_spiller()
-                for _, b, _ in ov.entries:
-                    raw.spill(jit_chain(b))
+                # entries are raw-batch triples from expand() or 4-tuple
+                # window entries (batch at [1] either way)
+                for e in ov.entries:
+                    raw.spill(jit_chain(e[1]))
                 grace_ingest(in_stream)
 
         if state["spiller"] is None and state["raw_spiller"] is None:
             if ctx.lifespans is None:
                 # spilled/sweeping runs hold only per-bucket group counts,
                 # which would poison the history as a whole-table total
+                hbo_obs["final_cap"] = cap
                 _hbo_record_agg(node, ctx, hbo_obs)
             acc = state["acc"]
             if node.step == "partial":

@@ -51,6 +51,65 @@ class MemoryPool:
             except ValueError:
                 pass
 
+    def add_partial_revoker(self, owner) -> Callable[[int], int]:
+        """Register a PARTITION-GRANULAR revocable-state owner (the
+        adaptive partial-revocation protocol): `owner` exposes
+        ``partition_sizes() -> [(pid, bytes)]`` and
+        ``revoke_partition(pid) -> estimated bytes`` — the latter MARKS
+        the partition (honored at the owner's next batch boundary, same
+        deferred contract as flag revokers). The owner is wrapped into
+        the ordinary revoker list so reserve()-inline pressure reaches
+        it too, but with largest-partition-first selection instead of
+        whole-operator revocation. Returns the wrapper; pass it to
+        ``remove_revoker`` on operator teardown."""
+
+        def fn(want):
+            self._mark_partial([owner], int(want))
+            return 0  # freeing is deferred to the owner's batch boundary
+
+        fn._partial_owner = owner
+        with self._lock:
+            self._revokers.append(fn)
+        return fn
+
+    @staticmethod
+    def _mark_partial(owners, want: int) -> int:
+        """Largest-partition-first marking across `owners` until the
+        estimated freed bytes cover `want` (want <= 0 sheds exactly one
+        partition — the largest). Returns partitions marked."""
+        ranked = []
+        for o in owners:
+            try:
+                ranked.extend((int(b), o, pid)
+                              for pid, b in o.partition_sizes())
+            except Exception:
+                continue
+        ranked.sort(key=lambda t: -t[0])
+        est = 0
+        marked = 0
+        for b, o, pid in ranked:
+            try:
+                est += int(o.revoke_partition(pid))
+            except Exception:
+                continue
+            marked += 1
+            if want <= 0 or est >= want:
+                break
+        return marked
+
+    def request_partial_revoke(self, want_bytes: int = 0) -> int:
+        """Out-of-band PARTIAL revoke: shed the largest partitions across
+        every partition-granular owner instead of signaling whole
+        operators. Returns partitions marked — 0 when no partial owners
+        are registered, which callers (ClusterMemoryManager's enforce
+        ladder) treat as "fall through to whole-operator revoke"."""
+        with self._lock:
+            owners = [fn._partial_owner for fn in self._revokers
+                      if hasattr(fn, "_partial_owner")]
+        if not owners:
+            return 0
+        return self._mark_partial(owners, int(want_bytes))
+
     def reserve(self, bytes_: int, tag: str = "") -> None:
         if bytes_ <= 0:
             return
@@ -159,6 +218,12 @@ class QueryScopedPool:
 
     def remove_revoker(self, fn):
         self.pool.remove_revoker(fn)
+
+    def add_partial_revoker(self, owner):
+        return self.pool.add_partial_revoker(owner)
+
+    def request_partial_revoke(self, want_bytes: int = 0) -> int:
+        return self.pool.request_partial_revoke(want_bytes)
 
     def reserve(self, bytes_: int, tag: str = "") -> None:
         self.pool.reserve(bytes_, tag or self.query_id)

@@ -55,7 +55,8 @@ SNAPSHOT_DEPTH = 8
 #: driver observed at the window boundary — never a fresh device sync)
 GAUGE_KEYS = ("overflow", "cap", "spillDepth", "repartitions",
               "spilledBytes", "laneUtil", "lanesUsed", "lanesTotal",
-              "wave", "stagedWindows", "site")
+              "wave", "stagedWindows", "site", "adaptiveActions",
+              "adaptiveLast")
 
 
 # ---------------------------------------------------------------------------
@@ -765,6 +766,46 @@ def analyze(query_id: str, spans: Optional[list] = None,
             cause("spill", min(0.5, 0.1 * spills),
                   f"{spills} spill repartition(s) — build exceeded "
                   f"memory budget")
+
+    # -- adaptive layer: what the in-run adaptation did, or what a missed
+    #    action cost. Repeated replay waves with NO acted flip/presize/
+    #    lane-resize attribute to the missing action — /doctor explains
+    #    both why an action fired and why one didn't.
+    try:
+        from presto_tpu.exec import adaptive as _adaptive
+
+        decs = _adaptive.recent_decisions(query_id)
+        adaptive_mode = _adaptive.last_mode()
+    except Exception:
+        decs, adaptive_mode = [], None
+    acted_decs = [d for d in decs if d.get("acted")]
+    if acted_decs:
+        kinds: Dict[str, int] = {}
+        for d in acted_decs:
+            kinds[d["kind"]] = kinds.get(d["kind"], 0) + 1
+        cause("adaptive_action", 0.05,
+              "in-run adaptation acted: " + ", ".join(
+                  f"{k} x{n}" for k, n in sorted(kinds.items())),
+              actions=kinds)
+    replay_spans = sum(1 for s in (spans or ())
+                       if getattr(s, "kind", None) == "overflow_replay")
+    if replay_spans >= 2 and not any(
+            d.get("acted") and d.get("kind") in
+            ("engine_flip", "presize_grow", "lane_resize")
+            for d in decs):
+        if adaptive_mode == "observe":
+            why = ("adaptive=observe logged what it would do without "
+                   "acting — set adaptive=on")
+        elif adaptive_mode == "on":
+            why = ("adaptive=on but no decision point fired (replays "
+                   "grew from a non-empty checkpoint or the site was "
+                   "already pinned)")
+        else:
+            why = ("adaptive off — adaptive=on flips engines / presizes "
+                   "between waves instead of replaying wider")
+        cause("missed_adaptive_action", min(0.5, 0.15 * replay_spans),
+              f"replayed the same configuration {replay_spans} time(s); "
+              f"{why}")
 
     # -- lifecycle segment dominance (exec scored on its residual after
     #    stall/exchange attribution so a named operator outranks the

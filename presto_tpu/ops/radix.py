@@ -131,6 +131,47 @@ def radix_window_perm(batch: Batch, perm, start, count,
     return Batch(batch.names, batch.types, cols, live, batch.dicts)
 
 
+def radix_child_ids(batch: Batch, key_names: Sequence[str],
+                    parent_partitions: int, fanout: int) -> jnp.ndarray:
+    """Row → child index within its parent radix partition: the next
+    ``log2(fanout)`` hash bits BELOW the parent's top ``log2(P)`` bits.
+
+    The adaptive device-side analog of the host spiller's
+    ``grow_partition`` (spiller.py): a partition whose observed footprint
+    blows its budget splits by fresh hash entropy, so skewed-but-distinct
+    keys do separate while the parent decomposition (and any
+    partition-aligned exchange tags at the parent P) stays valid — a
+    child id refines its parent id exactly like a deeper radix pass."""
+    pbits = radix_bits(parent_partitions)
+    fbits = radix_bits(fanout)
+    if pbits + fbits > _HASH_BITS:
+        raise ValueError("radix growth exhausted the hash bits")
+    h = partition_hash(batch, key_names)
+    shifted = jnp.right_shift(h, _HASH_BITS - pbits - fbits)
+    return (shifted & jnp.int64(fanout - 1)).astype(jnp.int32)
+
+
+def radix_child_perm(batch: Batch, key_names: Sequence[str],
+                     parent_partitions: int,
+                     fanout: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """``radix_perm`` over the CHILD ids of one grown partition: stable
+    argsort by the next hash bits down, dead rows last, per-child live
+    counts. The caller guarantees every live row of ``batch`` belongs to
+    the same parent partition (it came out of the parent's splitter), so
+    only the child bits discriminate. Same scatter-free shape contract
+    as ``radix_perm`` — one ``lax.sort`` of two int32 planes plus a
+    ``fanout``-element count transfer, jitted once per input capacity."""
+    n = batch.capacity
+    cid = radix_child_ids(batch, key_names, parent_partitions, fanout)
+    cid = jnp.where(batch.live, cid, fanout)  # dead rows sink
+    perm = jnp.arange(n, dtype=jnp.int32)
+    scid, sperm = jax.lax.sort([cid, perm], num_keys=1, is_stable=True)
+    counts = jax.ops.segment_sum(
+        jnp.ones(n, jnp.int32), scid, num_segments=fanout + 1
+    )[:fanout]
+    return sperm, counts
+
+
 def radix_window(sorted_batch: Batch, start, count, bucket: int) -> Batch:
     """Gather `bucket` rows beginning at (traced) `start` out of a sorted
     batch; rows at rank >= `count` are marked dead.

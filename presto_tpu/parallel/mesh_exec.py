@@ -140,8 +140,13 @@ class _SiteTracker:
     a retry can double exactly the site that overflowed. Each claimed
     site must `record` exactly one overflow diagnostic."""
 
-    def __init__(self, boosts: Dict[int, int]):
+    def __init__(self, boosts: Dict[int, int],
+                 lane_overrides: Optional[Dict[int, int]] = None):
         self._boosts = boosts
+        # adaptive lane resize: fid -> observed lane_max from a failed
+        # attempt THIS run — the retry sizes that exchange exactly instead
+        # of walking the ×2 boost ladder
+        self.lane_overrides = lane_overrides or {}
         self.labels: List[tuple] = []
         self.caps: List[Optional[int]] = []
         self.diags: List[Optional[jnp.ndarray]] = []
@@ -712,7 +717,17 @@ class MeshExecutor:
         if f.output_partitioning == OUT_HASH:
             site, boost = sites.claim(("exchange", fid))
             obs_rows = self._observed_lane_rows(f)
-            per_cap = self._exchange_cap(f, out, boost, obs_rows)
+            ovr = sites.lane_overrides.get(fid)
+            if ovr is not None:
+                # adaptive lane resize: the failed attempt MEASURED this
+                # exchange's true per-lane requirement — size to it
+                # exactly (clamped like _exchange_cap) instead of
+                # replaying through the ×2 boost ladder
+                per_cap = min(round_up_capacity(max(int(ovr), 64),
+                                                minimum=64),
+                              round_up_capacity(out.capacity, minimum=64))
+            else:
+                per_cap = self._exchange_cap(f, out, boost, obs_rows)
             if obs_rows is not None:
                 try:
                     from presto_tpu.obs import runstats
@@ -829,21 +844,60 @@ class MeshExecutor:
         an overflow on one query must not permanently inflate every later
         query's capacities (the old executor-level _cap_boost did)."""
         boosts: Dict[int, int] = {}
+        lane_overrides: Dict[int, int] = {}
+        adaptive_state = None
+        if getattr(self.config, "adaptive", "off") != "off":
+            try:
+                from presto_tpu.exec.adaptive import AdaptiveState
+
+                adaptive_state = AdaptiveState(
+                    self.config.adaptive,
+                    query_id=getattr(_obs_trace.current(), "trace_id",
+                                     "") or "")
+            except Exception:
+                adaptive_state = None
         attempts: List[dict] = []
         last = None
         for _ in range(self.max_retries + 1):
             try:
-                out = self._run_dplan_once(dplan, boosts, attempts)
+                out = self._run_dplan_once(dplan, boosts, attempts,
+                                           lane_overrides)
                 self.last_run = {
                     "retries": len(attempts) - 1,
                     "boosts": dict(boosts),
+                    "lane_overrides": dict(lane_overrides),
                     "attempts": attempts,
                 }
                 return out
             except MeshOverflow as e:
                 last = e
+                # adaptive lane resize: the failed attempt already pmax'd
+                # each exchange's TRUE per-lane requirement — feed it back
+                # as an exact override so the retry fits in one replay
+                # instead of walking the ×2 boost ladder site by site
+                handled = set()
+                if adaptive_state is not None and attempts:
+                    for ex in attempts[-1].get("exchanges", ()):
+                        s = ex.get("site")
+                        if s not in e.sites or ex.get("lane_max", 0) <= 0:
+                            continue
+                        new_cap = round_up_capacity(
+                            max(int(ex["lane_max"]), 64), minimum=64)
+                        if new_cap <= ex["per_cap"]:
+                            continue
+                        acted = adaptive_state.decide(
+                            "lane_resize",
+                            site=f"exchange_f{ex['fid']}",
+                            before=int(ex["per_cap"]), after=int(new_cap),
+                            detail=(f"lane f{ex['fid']} "
+                                    f"{ex['per_cap']}->{new_cap}"),
+                            lane_max=int(ex["lane_max"]))
+                        if acted:
+                            lane_overrides[ex["fid"]] = int(ex["lane_max"])
+                            handled.add(s)
                 for s in e.sites:
-                    boosts[s] = boosts.get(s, 1) * 2
+                    if s not in handled:
+                        boosts[s] = boosts.get(s, 1) * 2
                 _scan_metrics.record("mesh_exchange_overflow_retries", 1)
                 _scan_metrics.record("breaker_replay_waves", 1)
                 tracer = _obs_trace.current()
@@ -856,7 +910,9 @@ class MeshExecutor:
                             str(e.site_caps.get(s, 0) * 2)
                             for s in sorted(e.sites)))
         self.last_run = {"retries": len(attempts) - 1,
-                         "boosts": dict(boosts), "attempts": attempts}
+                         "boosts": dict(boosts),
+                         "lane_overrides": dict(lane_overrides),
+                         "attempts": attempts}
         raise last
 
     def _dplan_key(self, dplan: DistributedPlan):
@@ -891,10 +947,13 @@ class MeshExecutor:
         return h.hexdigest()
 
     def _build_program(self, dplan, scan_nodes, scan_sharded,
-                       boosts: Dict[int, int]) -> _CachedProgram:
+                       boosts: Dict[int, int],
+                       lane_overrides: Optional[Dict[int, int]] = None,
+                       ) -> _CachedProgram:
         fragments = dplan.fragments
         root = fragments[dplan.root_fid]
         boosts = dict(boosts)
+        lane_overrides = dict(lane_overrides or {})
         entry = _CachedProgram()
         meta = entry.meta
 
@@ -904,7 +963,7 @@ class MeshExecutor:
             meta["traces"] = meta.get("traces", 0) + 1
             st = {nid: b for nid, b in zip([id(s) for s in scan_nodes],
                                            scan_batches)}
-            sites = _SiteTracker(boosts)
+            sites = _SiteTracker(boosts, lane_overrides)
             memo: Dict[int, Batch] = {}
             out = self._lower(root.root, fragments, st, memo, sites)
             meta["n_sites"] = len(sites.labels)
@@ -940,7 +999,9 @@ class MeshExecutor:
 
     def _run_dplan_once(self, dplan: DistributedPlan,
                         boosts: Dict[int, int],
-                        attempts: List[dict]) -> Batch:
+                        attempts: List[dict],
+                        lane_overrides: Optional[Dict[int, int]] = None,
+                        ) -> Batch:
         fragments = dplan.fragments
         staged: Dict[int, Batch] = {}
         scan_nodes: List[TableScan] = []
@@ -961,12 +1022,15 @@ class MeshExecutor:
             staged[id(s)] = self._stage_scan(s, sh)
 
         pkey = self._dplan_key(dplan)
+        # lane overrides fork the program key exactly like boosts: an
+        # adaptively resized exchange compiles different lane shapes
         key = (None if pkey is None
-               else (pkey, tuple(sorted(boosts.items()))))
+               else (pkey, tuple(sorted(boosts.items())),
+                     tuple(sorted((lane_overrides or {}).items()))))
         entry = None if key is None else self._progs.get(key)
         if entry is None:
             entry = self._build_program(dplan, scan_nodes, scan_sharded,
-                                        boosts)
+                                        boosts, lane_overrides)
             if key is not None:
                 self._progs[key] = entry
             from presto_tpu.obs import devprof as _devprof

@@ -530,6 +530,12 @@ def plan_to_string(node: PlanNode, indent: int = 0, node_stats=None,
         if est and actual:
             s += (f"   [est={est:.3g} actual={actual:.3g} "
                   f"drift={actual / est:.2g}x]")
+    aa = node.__dict__.get("_adaptive_actions")
+    if aa:
+        # in-run adaptation trail (exec/adaptive.py): every decision the
+        # adaptive layer took (or, in observe mode, WOULD have taken —
+        # prefixed "would") at this node, in decision order
+        s += f"   [adaptive: {'; '.join(aa)}]"
     sp = node.__dict__.get("_spill_stats")
     if sp is not None and (sp.get("partitions") or sp.get("repartitions")
                            or sp.get("revocations")):

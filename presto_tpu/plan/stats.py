@@ -492,6 +492,37 @@ def choose_breaker_engine(node: PlanNode, catalog,
     return "sort", "not an engine-dimensioned breaker"
 
 
+def choose_breaker_engine_observed(node: PlanNode, groups: float,
+                                   rows: Optional[float] = None):
+    """(engine, why) from OBSERVED telemetry — the in-run adaptive analog
+    of ``choose_breaker_engine``. Same sort/hash thresholds, but the
+    group count is the replay wave's confirmed ``ng`` and the row count
+    is the host-known dispatched-capacity watermark, so the verdict
+    reflects what THIS run actually saw instead of derived estimates.
+    Structural guards (key width, payload states, global agg) match the
+    estimate path — a shape the hash engine cannot take never flips."""
+    if isinstance(node, Aggregate):
+        if not node.group_keys:
+            return "sort", "global aggregate"
+        if len(node.group_keys) > HASH_MAX_KEY_WIDTH:
+            return "sort", f"{len(node.group_keys)} group keys > {HASH_MAX_KEY_WIDTH}"
+        if len(node.aggs) > HASH_MAX_PAYLOAD_STATES:
+            return "sort", f"{len(node.aggs)} agg states > {HASH_MAX_PAYLOAD_STATES}"
+        groups = float(max(groups, 1.0))
+        if groups > HASH_MAX_GROUPS:
+            return "sort", (f"observed {groups:.3g} groups > "
+                            f"{HASH_MAX_GROUPS} (adaptive: observed)")
+        if rows is None:
+            rows = groups * HASH_MIN_DUPLICATION
+        dup = float(rows) / groups
+        if dup < HASH_MIN_DUPLICATION:
+            return "sort", (f"observed duplication x{dup:.2g} < "
+                            f"{HASH_MIN_DUPLICATION:.2g} (adaptive: observed)")
+        return "hash", (f"observed {groups:.3g} groups, x{dup:.3g} "
+                        f"duplication (adaptive: observed)")
+    return "sort", "not an engine-dimensioned breaker"
+
+
 # ---------------------------------------------------------------------------
 # binary-vs-multiway join chain choice (plan/multiway.py collapse pass).
 # Multiway keeps N build tables resident and walks every probe row through

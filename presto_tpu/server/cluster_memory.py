@@ -107,6 +107,14 @@ class ClusterMemoryManager:
         # revoke-before-kill ladder, after the free cache drop.
         self.spill_revoker = None
         self._spill_revoked_episode = False  # shared: guarded-by(self._lock)
+        # adaptive partial-revocation hook: a callable () -> int that
+        # asks workers to shed only the LARGEST partitions of
+        # partition-granular owners (adaptive radix aggregations) and
+        # returns partitions revoked. Tried BEFORE spill_revoker — cold
+        # partitions leave while hot ones stay resident; 0 falls through
+        # to the whole-operator rung in the same pass.
+        self.partial_revoker = None
+        self._partial_revoked_episode = False  # guarded-by(self._lock)
 
     # -- ingest (called from the heartbeat prober) -------------------------
 
@@ -318,6 +326,7 @@ class ClusterMemoryManager:
             if not under_pressure:
                 self._pressure_since = None
                 self._spill_revoked_episode = False
+                self._partial_revoked_episode = False
                 return None
             if self._pressure_since is None:
                 self._pressure_since = now
@@ -341,6 +350,29 @@ class ClusterMemoryManager:
                 with self._lock:
                     self._pressure_since = None
                 return None
+        # adaptive rung (before whole-operator revoke): shed only the
+        # LARGEST partitions of partition-granular owners. One shot per
+        # pressure episode, and a pass that revokes nothing falls
+        # straight through to the whole-operator rung below — with no
+        # partial owners registered this rung is invisible.
+        pr = self.partial_revoker
+        if pr is not None:
+            with self._lock:
+                palready = self._partial_revoked_episode
+                self._partial_revoked_episode = True
+            if not palready:
+                try:
+                    revoked = int(pr())
+                except Exception:
+                    revoked = 0
+                if revoked > 0:
+                    self._emit_event("partial_revoke_requested",
+                                     partitions=revoked,
+                                     totalReservedBytes=int(total),
+                                     blockedNodes=list(blocked))
+                    with self._lock:
+                        self._pressure_since = None
+                    return None
         # second rung: ask workers to revoke SPILLABLE OPERATOR STATE —
         # hybrid hash join builds and grace-agg accumulators move to disk
         # at their next batch boundary, which is graceful degradation, not
@@ -389,6 +421,7 @@ class ClusterMemoryManager:
             with self._lock:
                 self._pressure_since = None
                 self._spill_revoked_episode = False
+                self._partial_revoked_episode = False
                 self.kills += 1
             return victim
         return None

@@ -760,6 +760,10 @@ class Coordinator:
         # state (join builds / agg accumulators spill at their next batch
         # boundary) before killing anything
         self.cluster_memory.spill_revoker = self._revoke_spillable_state
+        # adaptive rung tried BEFORE whole-operator revoke: shed only the
+        # largest partitions of partition-granular owners (adaptive radix
+        # aggregations) so hot state stays resident under pressure
+        self.cluster_memory.partial_revoker = self._revoke_partial_state
         self._cluster_secret = cluster_secret
         self.failure_detector = HeartbeatFailureDetector(
             self.node_manager, cluster_memory=self.cluster_memory)
@@ -1464,6 +1468,29 @@ class Coordinator:
             except Exception:
                 continue
         return signaled
+
+    def _revoke_partial_state(self) -> int:
+        """POST /v1/memory/revoke {"partial": true} on every active
+        worker: partition-granular owners (adaptive radix aggregations)
+        shed only their LARGEST partitions at the next batch boundary.
+        Returns partitions revoked cluster-wide — 0 means no partial
+        owner anywhere, and the enforce ladder falls through to the
+        whole-operator rung."""
+        revoked = 0
+        for n in self.node_manager.active_nodes():
+            try:
+                req = urllib.request.Request(
+                    f"{n.uri}/v1/memory/revoke",
+                    data=b'{"partial": true}', method="POST")
+                if self._cluster_secret is not None:
+                    req.add_header("X-Presto-Cluster-Secret",
+                                   self._cluster_secret)
+                with urllib.request.urlopen(req, timeout=3) as r:
+                    doc = json.loads(r.read())
+                revoked += int(doc.get("partitionsRevoked") or 0)
+            except Exception:
+                continue
+        return revoked
 
     def _probe_and_exclude(self, n: NodeInfo):
         """One-node version of _reprobe_workers, called when task placement

@@ -101,6 +101,11 @@ def worker_metrics(worker) -> str:
 
     # compile-farm families appear only once the farm has done anything
     rows.extend(_farm.metric_rows({**lbl, "plane": "worker"}))
+    from presto_tpu.exec import adaptive as _adaptive
+
+    # adaptive-action families are armed-gated the same way: adaptive=off
+    # everywhere leaves the scrape bit-for-bit pre-adaptive
+    rows.extend(_adaptive.metric_rows({**lbl, "plane": "worker"}))
     return render_metrics(rows) + obs_metrics.render_histograms("worker")
 
 
@@ -137,6 +142,10 @@ def coordinator_metrics(coordinator) -> str:
     from presto_tpu.exec import farm as _farm
 
     rows.extend(_farm.metric_rows({"plane": "coordinator"}))
+    from presto_tpu.exec import adaptive as _adaptive
+
+    # armed-gated like the worker plane: adaptive=off scrapes bit-for-bit
+    rows.extend(_adaptive.metric_rows({"plane": "coordinator"}))
     text = render_metrics(rows) + obs_metrics.render_histograms("coordinator")
     from presto_tpu.obs import lifecycle as obs_lifecycle
 

@@ -338,6 +338,20 @@ class SystemSessionProperties:
                              "/v1/query/{id}/inflight and /doctor", str,
                              "off", validator=_enum("inflight",
                                                     ["OFF", "ON"])),
+            # in-run adaptation layer (exec/adaptive.py)
+            PropertyMetadata("adaptive",
+                             "In-run adaptation: off reproduces the "
+                             "pre-adaptive engine bit-for-bit (no "
+                             "decisions, no events, no metric families); "
+                             "observe evaluates every decision point and "
+                             "logs what it would do without acting; on "
+                             "acts — engine flips between replay waves, "
+                             "forward-propagating presize/lane sizing, "
+                             "device-radix partition growth, "
+                             "largest-partition-first partial revocation",
+                             str, "off",
+                             validator=_enum("adaptive",
+                                             ["OFF", "OBSERVE", "ON"])),
             PropertyMetadata("stall_threshold_s",
                              "Stall detector bound: row watermarks frozen "
                              "this many seconds while the query executes "
@@ -475,6 +489,7 @@ class Session:
             shape_bucketing=self.get("shape_bucketing").lower(),
             compile_farm=self.get("compile_farm").lower(),
             inflight=self.get("inflight").lower(),
+            adaptive=self.get("adaptive").lower(),
             stall_threshold_s=self.get("stall_threshold_s"),
             straggler_factor=self.get("straggler_factor"),
         )

@@ -302,6 +302,13 @@ class TaskExecution:
     def _run_with_ctx(self, cfg: ExecConfig, ctx: ExecContext):
         ctx.tracer = self.tracer
         ctx.inflight = self._inflight
+        if ctx.adaptive is not None:
+            # adaptive decisions land in this task's mid-flight heartbeat
+            # as adaptive.<kind> operator records, stamped with the query
+            # so /doctor can attribute them
+            ctx.adaptive.inflight = self._inflight
+            if self._inflight is not None:
+                ctx.adaptive.query_id = self._inflight.query_id
         ctx.task_index = self.update.task_index
         ctx.n_tasks = self.update.n_tasks
         ctx.split_assignment = self.update.split_assignment
@@ -741,10 +748,19 @@ class Worker:
                 if self.path == "/v1/memory/revoke":
                     # cluster ladder rung: the coordinator asks this node's
                     # spillable operator state to move to disk before any
-                    # query gets killed for memory
+                    # query gets killed for memory. Body {"partial": true}
+                    # selects the adaptive partition-granular rung.
                     if not self._authorized():
                         return self._json({"error": "unauthorized"}, 403)
-                    return self._json(worker.revoke_spillable())
+                    n = int(self.headers.get("Content-Length", 0))
+                    partial = False
+                    if n:
+                        try:
+                            partial = bool(json.loads(
+                                self.rfile.read(n) or b"{}").get("partial"))
+                        except (ValueError, AttributeError):
+                            partial = False
+                    return self._json(worker.revoke_spillable(partial))
                 self._json({"error": "not found"}, 404)
 
             def do_GET(self):
@@ -863,12 +879,22 @@ class Worker:
             )
             self._announce_thread.start()
 
-    def revoke_spillable(self) -> dict:
+    def revoke_spillable(self, partial: bool = False) -> dict:
         """Signal every revocable-state owner on this node's pool (hybrid
         hash join builds, grace-agg accumulators): each flags itself and
         spills at its next batch boundary. The out-of-band half of the
         memory contract — reserve()-inline revoking handles local pressure,
-        this handles CLUSTER pressure relayed by the coordinator."""
+        this handles CLUSTER pressure relayed by the coordinator.
+
+        ``partial=True`` is the adaptive rung: shed only the LARGEST
+        partitions of partition-granular owners (adaptive radix
+        aggregations) instead of whole operators — `partitionsRevoked`
+        comes back 0 when no such owner is registered, and the caller
+        falls through to the whole-operator rung."""
+        if partial:
+            n = self.memory_pool.request_partial_revoke()
+            return {"nodeId": self.node_id, "revokersSignaled": 0,
+                    "partitionsRevoked": n}
         n = self.memory_pool.request_revoke()
         return {"nodeId": self.node_id, "revokersSignaled": n}
 

@@ -1,0 +1,380 @@
+"""In-run adaptive execution (exec/adaptive.py + the runtime hooks).
+
+The adaptive plane acts on drift telemetry WITHIN a run: engine flips
+between replay waves, forward-propagating presize, device-radix partition
+growth, partition-granular (partial) revocation, and mesh lane resizing.
+Property matrix: for every action kind, `adaptive=on` must produce the
+same result set as `adaptive=off` on a 10×-mis-estimated workload —
+adaptation changes the execution schedule, never the answer — while
+`observe` logs the decisions it would take with ZERO behavior change.
+
+The mis-estimation lever throughout: grouping through an expression
+(`k % 100000`) blinds the NDV estimator, so the static estimate lands at
+rows×0.1 while the actual group count is the full key NDV.
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from presto_tpu.catalog.memory import MemoryConnector
+from presto_tpu.connector import Catalog
+from presto_tpu.exec import ExecConfig, LocalRunner
+from presto_tpu.exec import adaptive as _adaptive
+from presto_tpu.memory import MemoryPool
+from presto_tpu.obs import runstats
+from presto_tpu.obs.events import EVENTS
+
+from conftest import assert_frames_match
+
+
+def _catalog(df):
+    conn = MemoryConnector()
+    conn.add_table("t", df)
+    cat = Catalog()
+    cat.register("m", conn, default=True)
+    return cat
+
+
+def _run(cat, sql, mode, **kw):
+    runstats.reset()
+    _adaptive.reset()
+    r = LocalRunner(cat, ExecConfig(adaptive=mode, **kw))
+    df = r.run(sql)
+    df = df.sort_values(list(df.columns)[0], ignore_index=True)
+    return df, r
+
+
+# ---------------------------------------------------------------------------
+# engine flip: hash chosen from a 10×-wrong estimate, flipped to sort
+# from the wave's OBSERVED group count
+
+
+@pytest.fixture(scope="module")
+def flip_cat():
+    # 6000 all-distinct keys through an expression: est 600 groups ×10
+    # duplication -> hash engine; actual 6000 groups -> sort territory
+    return _catalog(pd.DataFrame({"k": np.arange(6000, dtype=np.int64),
+                                  "v": np.ones(6000, dtype=np.int64)}))
+
+
+FLIP_SQL = "select k % 100000 as g, sum(v) as s from m.t group by 1"
+
+
+def test_flip_checksum_parity_and_fewer_waves(flip_cat):
+    off, r_off = _run(flip_cat, FLIP_SQL, "off")
+    w_off = r_off.last_stats.get("breaker.replay_waves", 0)
+    assert w_off >= 1, r_off.last_stats
+
+    on, r_on = _run(flip_cat, FLIP_SQL, "on")
+    assert on.equals(off)
+    w_on = r_on.last_stats.get("breaker.replay_waves", 0)
+    assert w_on < w_off, (w_on, w_off)
+    assert r_on.last_stats.get("breaker.engine_flips", 0) == 1
+
+
+def test_flip_at_most_once(flip_cat):
+    _, r_on = _run(flip_cat, FLIP_SQL, "on")
+    assert r_on.last_stats.get("breaker.engine_flips", 0) <= 1
+    flips = [a for a in _adaptive.recent_decisions()
+             if a["kind"] == "engine_flip"]
+    assert len(flips) <= 1, flips
+
+
+def test_observe_decides_without_acting(flip_cat):
+    off, r_off = _run(flip_cat, FLIP_SQL, "off")
+    w_off = r_off.last_stats.get("breaker.replay_waves", 0)
+
+    obs, r_obs = _run(flip_cat, FLIP_SQL, "observe")
+    assert obs.equals(off)
+    # identical schedule: same wave count, no flips
+    assert r_obs.last_stats.get("breaker.replay_waves", 0) == w_off
+    assert r_obs.last_stats.get("breaker.engine_flips", 0) == 0
+    recs = _adaptive.recent_decisions()
+    assert recs, "observe mode must still log decisions"
+    assert all(not a["acted"] for a in recs), recs
+
+
+def test_adaptive_off_is_inert(flip_cat):
+    _run(flip_cat, FLIP_SQL, "off")
+    assert not _adaptive.armed()
+    assert _adaptive.recent_decisions() == []
+    assert _adaptive.metric_rows({"plane": "worker"}) == []
+
+
+def test_events_and_explain_annotation(flip_cat):
+    runstats.reset()
+    _adaptive.reset()
+    since = EVENTS.last_seq()
+    r = LocalRunner(flip_cat, ExecConfig(adaptive="on"))
+    txt = r.explain_analyze(FLIP_SQL)
+    assert "[adaptive: flip hash->sort]" in txt, txt
+    evs = EVENTS.events(since=since, kind="adaptive_action")
+    assert evs, "flip must emit an adaptive_action event"
+    assert evs[0]["action"] == "engine_flip"
+    assert evs[0]["acted"] is True
+    # seq is the stream's monotonic cursor: deterministic action order
+    assert [e["seq"] for e in evs] == sorted(e["seq"] for e in evs)
+    rows = _adaptive.metric_rows({"plane": "worker"})
+    assert any(l["kind"] == "engine_flip" and v >= 1
+               for (_n, _h, v, l, _t) in rows), rows
+
+
+def test_checksum_parity_matrix(flip_cat):
+    """NDV × duplication × skew sweep: every combination under a blind
+    estimate must keep adaptive=on and =off row-for-row identical."""
+    rng = np.random.default_rng(5)
+    for ndv, dup in [(6000, 1), (3000, 4), (800, 24)]:
+        keys = np.repeat(np.arange(ndv, dtype=np.int64), dup)
+        # skewed variant: half the rows land on 1% of the keys
+        skew = rng.integers(0, max(ndv // 100, 1), len(keys) // 2)
+        keys = np.concatenate([keys, skew])
+        rng.shuffle(keys)
+        cat = _catalog(pd.DataFrame({
+            "k": keys, "v": rng.integers(0, 100, len(keys)).astype(np.int64)}))
+        sql = "select k % 100000 as g, sum(v) as s, count(*) as c from m.t group by 1"
+        off, _ = _run(cat, sql, "off")
+        on, _ = _run(cat, sql, "on")
+        assert on.equals(off), (ndv, dup)
+
+
+# ---------------------------------------------------------------------------
+# forward-propagating presize: confirmed group counts grow the table
+# BEFORE the next window overflows
+
+
+def test_presize_grow_avoids_wave():
+    # 6000 groups arriving in key order, 5 rows each: ~100 new groups per
+    # 512-row batch, so the 7/8-full confirm trigger leads the overflow
+    # point by more batches than the optimistic pipeline depth
+    cat = _catalog(pd.DataFrame({
+        "k": np.arange(30000, dtype=np.int64) // 5,
+        "v": np.ones(30000, dtype=np.int64)}))
+    sql = "select k % 100000 as g, sum(v) as s from m.t group by 1"
+    kw = dict(breaker_engine="sort", fragment_fusion=False,
+              batch_rows=1 << 9)
+    off, r_off = _run(cat, sql, "off", **kw)
+    w_off = r_off.last_stats.get("breaker.replay_waves", 0)
+    assert w_off >= 1, r_off.last_stats
+    on, r_on = _run(cat, sql, "on", **kw)
+    assert on.equals(off)
+    assert r_on.last_stats.get("breaker.replay_waves", 0) < w_off
+    grows = [a for a in _adaptive.recent_decisions()
+             if a["kind"] == "presize_grow" and a["acted"]]
+    assert grows, _adaptive.recent_decisions()
+
+
+# ---------------------------------------------------------------------------
+# adaptive device-side radix growth
+
+
+@pytest.fixture(scope="module")
+def wide_cat():
+    rng = np.random.default_rng(7)
+    return _catalog(pd.DataFrame({
+        "k": rng.integers(0, 1 << 40, 20_000),
+        "v": rng.normal(size=20_000)}))
+
+
+WIDE_SQL = "select k, count(*) as c, sum(v) as s from m.t group by k"
+
+
+@pytest.mark.slow
+def test_radix_growth_parity(wide_cat):
+    kw = dict(batch_rows=1 << 11, radix_partitions=4,
+              join_spill_budget_bytes=1 << 16)
+    off, r_off = _run(wide_cat, WIDE_SQL, "off", **kw)
+    assert r_off.last_stats.get("radix.partitions_spilled", 0) >= 1
+    on, r_on = _run(wide_cat, WIDE_SQL, "on", **kw)
+    assert r_on.last_stats.get("radix.partitions_grown", 0) >= 1
+    assert_frames_match(on, off, sort_by=["k"])
+    grows = [a for a in _adaptive.recent_decisions()
+             if a["kind"] == "radix_grow" and a["acted"]]
+    assert grows
+
+
+def test_radix_growth_observe_spills_like_off(wide_cat):
+    kw = dict(batch_rows=1 << 11, radix_partitions=4,
+              join_spill_budget_bytes=1 << 16)
+    off, r_off = _run(wide_cat, WIDE_SQL, "off", **kw)
+    obs, r_obs = _run(wide_cat, WIDE_SQL, "observe", **kw)
+    assert obs.equals(off)
+    assert (r_obs.last_stats.get("radix.partitions_spilled", 0)
+            == r_off.last_stats.get("radix.partitions_spilled", 0))
+    assert r_obs.last_stats.get("radix.partitions_grown", 0) == 0
+    would = [a for a in _adaptive.recent_decisions()
+             if a["kind"] == "radix_grow"]
+    assert would and all(not a["acted"] for a in would)
+
+
+# ---------------------------------------------------------------------------
+# partial (largest-partition-first) revocation
+
+
+def test_memory_pool_partial_revoker_ranking():
+    pool = MemoryPool(1 << 20)
+    revoked = []
+
+    class Owner:
+        def partition_sizes(self):
+            return [(0, 100), (1, 900), (2, 500)]
+
+        def revoke_partition(self, pid):
+            revoked.append(pid)
+            return dict(self.partition_sizes())[pid]
+
+    fn = pool.add_partial_revoker(Owner())
+    # want=600: the largest partition (1, 900 bytes) alone covers it
+    assert pool.request_partial_revoke(600) == 1
+    assert revoked == [1]
+    # want<=0 sheds exactly one partition — the largest
+    revoked.clear()
+    assert pool.request_partial_revoke(0) == 1
+    assert revoked == [1]
+    pool.remove_revoker(fn)
+    assert pool.request_partial_revoke(600) == 0
+
+
+@pytest.mark.slow
+def test_partial_revoke_checksums_under_pressure():
+    rng = np.random.default_rng(7)
+    cat = _catalog(pd.DataFrame({
+        "k": rng.integers(0, 1 << 40, 60_000),
+        "v": rng.normal(size=60_000)}))
+    sql = ("select k % 999983 as g, count(*) as c, sum(v) as s "
+           "from m.t group by 1")
+    kw = dict(batch_rows=1 << 11, radix_partitions=4,
+              join_spill_budget_bytes=1 << 30, spill_partitions=4)
+    off, _ = _run(cat, sql, "off", **kw)
+    # pool sized so radix residency crosses the 90% revoke threshold
+    # mid-query: partition-granular revocation sheds the largest
+    # partitions instead of whole-operator state
+    on, r_on = _run(cat, sql, "on", memory_pool_bytes=1_835_008, **kw)
+    assert_frames_match(on, off, sort_by=["g"])
+    marks = [a for a in _adaptive.recent_decisions()
+             if a["kind"] == "partial_revoke" and a["acted"]]
+    assert marks, _adaptive.recent_decisions()
+    assert r_on.last_stats.get("radix.partitions_spilled", 0) >= 1
+
+
+# ---------------------------------------------------------------------------
+# HBO asymmetry: the record carries the CONVERGED engine + capacity, so
+# run 2 with hbo=correct starts on the winner with zero waves
+
+
+def test_hbo_records_adapted_verdict(flip_cat):
+    runstats.reset()
+    _adaptive.reset()
+    r1 = LocalRunner(flip_cat, ExecConfig(adaptive="on", hbo="observe"))
+    d1 = r1.run(FLIP_SQL)
+    assert r1.last_stats.get("breaker.engine_flips", 0) == 1
+
+    r2 = LocalRunner(flip_cat, ExecConfig(adaptive="off", hbo="correct"))
+    txt = r2.explain_analyze(FLIP_SQL)
+    assert r2.last_stats.get("breaker.replay_waves", 0) == 0, r2.last_stats
+    line = [l for l in txt.splitlines() if "Aggregate" in l][0]
+    assert "engine=sort" in line, line
+    assert "(hbo: observed)" in line, line
+    d2 = r2.run(FLIP_SQL)
+    assert d2.sort_values("g", ignore_index=True).equals(
+        d1.sort_values("g", ignore_index=True))
+
+
+# ---------------------------------------------------------------------------
+# mesh lane resize: observed per-lane maxima replace the x2 boost ladder
+
+
+@pytest.mark.slow
+def test_mesh_lane_resize_fewer_retries():
+    from presto_tpu.parallel.mesh import make_mesh
+    from presto_tpu.parallel.mesh_exec import MeshExecutor
+    from presto_tpu.plan.builder import plan_query
+    from presto_tpu.plan.fragmenter import OUT_HASH, fragment_plan
+    from presto_tpu.plan.optimizer import optimize
+
+    rng = np.random.default_rng(11)
+    nf = 3200
+    conn = MemoryConnector()
+    # one-hot join key under a uniform-stats lie: per-lane caps
+    # under-provision the hot lane by multiple doublings
+    conn.add_table("fact", pd.DataFrame({
+        "k": np.full(nf, 3, np.int64),
+        "v": rng.integers(0, 1000, nf).astype(np.int64)}))
+    conn.add_table("dim", pd.DataFrame({
+        "k": np.arange(8, dtype=np.int64),
+        "w": np.arange(8, dtype=np.int64) * 10}))
+    cat = Catalog()
+    cat.register("m", conn, default=True)
+    sql = ("select sum(fact.v + dim.w) as s from fact, dim "
+           "where fact.k = dim.k")
+
+    def skew_dplan():
+        qp = optimize(plan_query(sql, cat), cat)
+        dplan = fragment_plan(qp, cat, broadcast_threshold_rows=0.0)
+        for f in dplan.fragments.values():
+            if (f.output_partitioning == OUT_HASH and f.est_rows
+                    and f.est_rows > 100):
+                f.est_rows, f.est_key_ndv = float(nf), float(nf)
+        return dplan
+
+    exp = LocalRunner(cat).run(sql)
+    mesh = make_mesh(8)
+
+    _adaptive.reset()
+    mx_off = MeshExecutor(cat, mesh,
+                          ExecConfig(batch_rows=1 << 12, adaptive="off"))
+    g_off = mx_off.run_dplan(skew_dplan()).to_pandas()
+    lr_off = mx_off.last_run
+    assert int(g_off["s"][0]) == int(exp["s"][0])
+    assert lr_off["retries"] >= 2, lr_off
+
+    _adaptive.reset()
+    mx_on = MeshExecutor(cat, mesh,
+                         ExecConfig(batch_rows=1 << 12, adaptive="on"))
+    g_on = mx_on.run_dplan(skew_dplan()).to_pandas()
+    lr_on = mx_on.last_run
+    assert int(g_on["s"][0]) == int(exp["s"][0])
+    # one retry straight to the observed lane_max, not a boost ladder
+    assert lr_on["retries"] < lr_off["retries"], (lr_on, lr_off)
+    assert lr_on["lane_overrides"], lr_on
+    resizes = [a for a in _adaptive.recent_decisions()
+               if a["kind"] == "lane_resize" and a["acted"]]
+    assert resizes
+
+
+# ---------------------------------------------------------------------------
+# doctor attribution
+
+
+def test_doctor_reports_acted_adaptive_actions():
+    from types import SimpleNamespace
+
+    from presto_tpu.obs import inflight, lifecycle
+
+    _adaptive.reset()
+    st = _adaptive.AdaptiveState("on", query_id="q_adapt")
+    st.decide("engine_flip", before="hash", after="sort",
+              detail="flip hash->sort")
+    lifecycle.register("q_adapt").timeline.mark("executing")
+    doc = inflight.analyze("q_adapt")
+    acted = [c for c in doc["causes"] if c["cause"] == "adaptive_action"]
+    assert acted and "engine_flip x1" in acted[0]["detail"], doc["causes"]
+
+
+def test_doctor_attributes_missed_actions():
+    from types import SimpleNamespace
+
+    from presto_tpu.obs import inflight, lifecycle
+
+    _adaptive.reset()
+    st = _adaptive.AdaptiveState("observe", query_id="q_missed")
+    st.decide("engine_flip", before="hash", after="sort",
+              detail="flip hash->sort")
+    lifecycle.register("q_missed").timeline.mark("executing")
+    spans = [SimpleNamespace(kind="overflow_replay"),
+             SimpleNamespace(kind="overflow_replay")]
+    doc = inflight.analyze("q_missed", spans=spans)
+    missed = [c for c in doc["causes"]
+              if c["cause"] == "missed_adaptive_action"]
+    assert missed, doc["causes"]
+    assert "set adaptive=on" in missed[0]["detail"], missed
